@@ -1,0 +1,33 @@
+"""keystone-tpu: a TPU-native large-scale ML pipeline framework.
+
+A ground-up rebuild of the capabilities of KeystoneML (AMPLab's Scala/Spark
+pipeline system) on JAX/XLA over TPU meshes:
+
+- Typed, composable ``Transformer`` / ``Estimator`` pipelines that lower to
+  fused XLA programs instead of Spark RDD stages
+  (reference: ``src/main/scala/pipelines/Transformer.scala``).
+- Distributed dense linear algebra — block least squares, weighted block
+  coordinate descent, normal equations, TSQR, PCA, ZCA, GMM — with gram-matrix
+  reductions expressed as sharded matmuls whose collectives XLA lays onto ICI
+  (reference: the ``mlmatrix`` jar + ``nodes/learning/``).
+- A feature-extraction op library (SIFT, Fisher Vectors, LCS, HOG, DAISY,
+  convolution/pooling, random Fourier features, FFT featurization, n-gram/NLP
+  nodes) implemented as XLA/Pallas programs instead of JNI/C++ kernels
+  (reference: ``src/main/cpp/`` + ``nodes/``).
+- Loaders, evaluators, and runnable end-to-end example pipelines.
+"""
+
+from keystone_tpu.core.pipeline import (
+    Node,
+    Transformer,
+    Estimator,
+    LabelEstimator,
+    FunctionNode,
+    Chain,
+    Cacher,
+    Identity,
+    chain,
+)
+from keystone_tpu.core.dataset import Dataset, LabeledData
+
+__version__ = "0.1.0"

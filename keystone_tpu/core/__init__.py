@@ -1,0 +1,12 @@
+from keystone_tpu.core.pipeline import (
+    Node,
+    Transformer,
+    Estimator,
+    LabelEstimator,
+    FunctionNode,
+    Chain,
+    Cacher,
+    Identity,
+    chain,
+)
+from keystone_tpu.core.dataset import Dataset, LabeledData

@@ -1,0 +1,64 @@
+"""Config/flag system: dataclass configs + argparse.
+
+Replaces the reference's per-pipeline ``case class XConfig`` + scopt
+``OptionParser`` skeleton (e.g. ``MnistRandomFFT.scala:90-116``). Each
+pipeline declares a ``@dataclass`` config; :func:`parse_config` turns its
+fields into ``--flags`` (fields without defaults are required, like scopt's
+``required()``), and ``validate`` hooks mirror scopt's ``validate``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Sequence, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def add_dataclass_args(parser: argparse.ArgumentParser, cls: Type) -> None:
+    for f in dataclasses.fields(cls):
+        if not f.init:
+            continue
+        name = "--" + f.name.replace("_", "-")
+        has_default = (
+            f.default is not dataclasses.MISSING
+            or f.default_factory is not dataclasses.MISSING  # type: ignore[misc]
+        )
+        default = (
+            f.default
+            if f.default is not dataclasses.MISSING
+            else (f.default_factory() if has_default else None)  # type: ignore[misc]
+        )
+        if f.type in (bool, "bool"):
+            parser.add_argument(
+                name,
+                action=argparse.BooleanOptionalAction,
+                default=bool(default) if has_default else False,
+                help=f.metadata.get("help", ""),
+            )
+            continue
+        ftype = f.type
+        if isinstance(ftype, str):
+            ftype = {"int": int, "float": float, "str": str}.get(ftype, str)
+        if ftype not in (int, float, str):
+            ftype = str
+        parser.add_argument(
+            name,
+            type=ftype,
+            default=default,
+            required=not has_default,
+            help=f.metadata.get("help", ""),
+        )
+
+
+def parse_config(cls: Type[T], argv: Optional[Sequence[str]] = None, prog: Optional[str] = None) -> T:
+    parser = argparse.ArgumentParser(prog=prog or cls.__name__)
+    add_dataclass_args(parser, cls)
+    ns = parser.parse_args(argv)
+    kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(cls) if f.init}
+    cfg = cls(**kwargs)
+    validate = getattr(cfg, "validate", None)
+    if callable(validate):
+        validate()
+    return cfg

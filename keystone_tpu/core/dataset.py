@@ -1,0 +1,76 @@
+"""Data plane: the RDD replacement.
+
+A dataset is a pytree of ``jax.Array``s whose leading axis is the item axis,
+optionally sharded over the ``data`` axis of a device mesh and optionally
+carrying a validity mask. The mask is how variable row counts meet XLA's
+static-shape world: rows are padded up to a multiple of the mesh's data-axis
+size and consumers (solvers, scalers, evaluators) weight rows by the mask, so
+padding never corrupts statistics. (The reference got ragged sizes for free
+from RDD partitioning; here padding+masking is a first-class data-plane
+feature — SURVEY.md §7 "hard parts" #1.)
+
+Reference analogs: ``RDD[T]`` partitioning, ``loaders/LabeledData.scala:12-15``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.struct as struct
+
+
+class Dataset(struct.PyTreeNode):
+    """A batch of items: pytree of arrays with leading item axis + row mask."""
+
+    data: Any
+    mask: Optional[jax.Array] = None
+
+    @property
+    def num_items(self) -> int:
+        return jax.tree_util.tree_leaves(self.data)[0].shape[0]
+
+    @property
+    def num_valid(self):
+        if self.mask is None:
+            return self.num_items
+        return int(jnp.sum(self.mask))
+
+
+class LabeledData(struct.PyTreeNode):
+    """(data, labels) pair with aligned leading axes.
+
+    Reference: ``loaders/LabeledData.scala:12-15`` (``RDD[(Label, Datum)]``
+    with ``.data`` / ``.labels`` projections).
+    """
+
+    data: Any
+    labels: Any
+    mask: Optional[jax.Array] = None
+
+
+def pad_rows(x: jax.Array, multiple: int) -> tuple[jax.Array, jax.Array]:
+    """Pad the leading axis of ``x`` up to a multiple; return (padded, mask).
+
+    The mask is float (1.0 valid / 0.0 pad) so it can directly weight sums.
+    """
+    n = x.shape[0]
+    target = -(-n // multiple) * multiple
+    mask = jnp.arange(target) < n
+    if target == n:
+        return x, mask.astype(jnp.float32)
+    pad = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad), mask.astype(jnp.float32)
+
+
+def pad_rows_np(x: np.ndarray, multiple: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side variant of :func:`pad_rows` (no device transfer)."""
+    n = x.shape[0]
+    target = -(-n // multiple) * multiple
+    mask = (np.arange(target) < n).astype(np.float32)
+    if target == n:
+        return x, mask
+    pad = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad), mask

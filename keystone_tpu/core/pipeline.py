@@ -1,0 +1,296 @@
+"""Core pipeline API: Transformer / Estimator / LabelEstimator / FunctionNode.
+
+TPU-native rebuild of KeystoneML's pipeline layer (reference:
+``src/main/scala/pipelines/Transformer.scala:16-82``, ``Estimator.scala:12-33``,
+``LabelEstimator.scala:13-37``, ``FunctionNode.scala:3``).
+
+Design (idiomatic JAX, not a translation of the Spark design):
+
+- A ``Transformer`` is an immutable pytree (``flax.struct.PyTreeNode``): its
+  learned state (weights, means, whiteners, ...) are pytree leaves, its
+  configuration (sizes, seeds, flags) are static fields. Because nodes are
+  pytrees, a whole composed pipeline can be passed *through* ``jax.jit`` as a
+  traced argument: one compiled XLA program per pipeline segment, with XLA
+  fusion doing the work Spark got from stage pipelining. Re-fitting a node
+  re-uses the compiled program (same treedef, new leaves).
+
+- Both of the reference's execution paths exist here:
+  * ``apply(x)``   — the single-item serving path (a pure jax function), and
+  * ``apply_batch(xs)`` — the bulk path over a batch whose leading axis is the
+    item axis (the RDD analog; arrays may be sharded over a device mesh).
+  The default bulk path is ``vmap(apply)``; nodes override it when a batched
+  formulation maps better onto the MXU (one big gemm instead of N small ones
+  — the analog of the reference's per-partition ``rowsToMatrix`` + gemm trick,
+  ``nodes/learning/LinearMapper.scala:37-55``).
+
+- ``then`` / ``>>`` composes nodes into a ``Chain``. Like the reference's
+  anonymous fused Transformer (``Transformer.scala:52-59``) a Chain is itself a
+  Transformer. When *called*, a Chain splits itself into maximal jittable
+  segments: ``Cacher`` and host-side ``FunctionNode``s are segment boundaries
+  (the materialization points the reference expressed with ``.cache()``,
+  ``nodes/util/Cacher.scala:13-21``); everything between boundaries compiles
+  into one fused XLA program.
+
+- ``Estimator.fit(data) -> Transformer`` and
+  ``LabelEstimator.fit(data, labels) -> Transformer`` mirror the reference
+  exactly; ``then_estimator`` / ``then_label_estimator`` defer fitting the
+  same way ``Transformer.scala:37,45`` do.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, ClassVar, Optional, Sequence
+
+import jax
+import flax.struct as struct
+
+from keystone_tpu.core.dataset import Dataset
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _jit_apply_batch(node: "Node", xs: Any) -> Any:
+    """One shared jit entry point for every node/segment.
+
+    Caching is keyed on the node's pytree *structure* (static config) plus the
+    batch's shape/dtype/sharding — so re-running a pipeline with freshly fitted
+    weights hits the compile cache.
+    """
+    return node.apply_batch(xs)
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _jit_apply(node: "Node", x: Any) -> Any:
+    return node.apply(x)
+
+
+class Node(struct.PyTreeNode):
+    """Base of every pipeline node. An immutable pytree with a bulk path."""
+
+    # Nodes that must run on the host (I/O, data-dependent shapes, sampling
+    # with concrete sizes) set this False; Chain treats them as segment
+    # boundaries instead of tracing them.
+    jittable: ClassVar[bool] = True
+
+    def apply_batch(self, xs: Any) -> Any:
+        """Bulk path: ``xs`` is a pytree of arrays with leading item axis."""
+        raise NotImplementedError
+
+    def __call__(self, data: Any) -> Any:
+        """Apply the bulk path, jit-compiled when possible.
+
+        ``data`` may be a raw array/pytree (leading axis = items) or a
+        :class:`Dataset`. Single-item serving goes through :meth:`apply`.
+        """
+        if isinstance(data, Dataset):
+            return data.replace(data=self(data.data))
+        if self.jittable:
+            return _jit_apply_batch(self, data)
+        return self.apply_batch(data)
+
+    # -- composition ------------------------------------------------------
+    def then(self, nxt: Any) -> Any:
+        """Compose with a following node or estimator.
+
+        ``transformer.then(estimator)`` defers fitting, like the reference's
+        ``thenEstimator`` / ``thenLabelEstimator``
+        (``pipelines/Transformer.scala:37-50``).
+        """
+        if isinstance(nxt, LabelEstimator):
+            return self.then_label_estimator(nxt)
+        if isinstance(nxt, Estimator):
+            return self.then_estimator(nxt)
+        return chain(self, nxt)
+
+    def then_estimator(self, est: "Estimator") -> "ChainedEstimator":
+        return ChainedEstimator(self, est)
+
+    def then_label_estimator(self, est: "LabelEstimator") -> "ChainedLabelEstimator":
+        return ChainedLabelEstimator(self, est)
+
+    def __rshift__(self, nxt: Any) -> Any:
+        return self.then(nxt)
+
+
+class Transformer(Node):
+    """A pure function over single items, with a derived (or overridden) bulk path.
+
+    Reference: ``pipelines/Transformer.scala:16-82``.
+    """
+
+    def apply(self, x: Any) -> Any:
+        """Single-item path: one item in, one item out. Pure jax."""
+        raise NotImplementedError
+
+    def apply_batch(self, xs: Any) -> Any:
+        return jax.vmap(self.apply)(xs)
+
+    def serve(self, x: Any) -> Any:
+        """Jit-compiled single-item serving path."""
+        if self.jittable:
+            return _jit_apply(self, x)
+        return self.apply(x)
+
+    @staticmethod
+    def from_fn(fn: Callable[[Any], Any], name: Optional[str] = None) -> "LambdaTransformer":
+        """Wrap a plain jax function, like the reference's companion
+        ``Transformer(f)`` (``Transformer.scala:78-82``)."""
+        return LambdaTransformer(fn=fn, name=name or getattr(fn, "__name__", "fn"))
+
+
+class LambdaTransformer(Transformer):
+    fn: Callable[[Any], Any] = struct.field(pytree_node=False)
+    name: str = struct.field(pytree_node=False, default="fn")
+
+    def apply(self, x):
+        return self.fn(x)
+
+
+class FunctionNode(Node):
+    """A batch-level node whose signature is not an item-wise map: flat-mapping
+    windows, splitting a dataset into column blocks, sampling.
+
+    Reference: ``pipelines/FunctionNode.scala:3`` (bare ``A => B``).
+    Subclasses that need concrete shapes/host work set ``jittable = False``.
+    """
+
+
+class Estimator:
+    """Fits on a batch, emits a Transformer. Reference: ``Estimator.scala:12-33``."""
+
+    def fit(self, data: Any) -> Transformer:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_fn(fn: Callable[[Any], Transformer]) -> "Estimator":
+        est = Estimator()
+        est.fit = fn  # type: ignore[method-assign]
+        return est
+
+
+class LabelEstimator:
+    """Fits on (data, labels), emits a Transformer.
+
+    Reference: ``LabelEstimator.scala:13-37``.
+    """
+
+    def fit(self, data: Any, labels: Any) -> Transformer:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_fn(fn: Callable[[Any, Any], Transformer]) -> "LabelEstimator":
+        est = LabelEstimator()
+        est.fit = fn  # type: ignore[method-assign]
+        return est
+
+
+class ChainedEstimator(Estimator):
+    """``pre.then(est)``: fit applies ``pre`` first, then fits ``est`` on the
+    transformed data, returning the fused chain (``Transformer.scala:37-43``)."""
+
+    def __init__(self, pre: Node, est: Estimator):
+        self.pre = pre
+        self.est = est
+
+    def fit(self, data: Any) -> Transformer:
+        return chain(self.pre, self.est.fit(self.pre(data)))
+
+
+class ChainedLabelEstimator(LabelEstimator):
+    """``pre.then(label_est)`` (``Transformer.scala:45-50``)."""
+
+    def __init__(self, pre: Node, est: LabelEstimator):
+        self.pre = pre
+        self.est = est
+
+    def fit(self, data: Any, labels: Any) -> Transformer:
+        return chain(self.pre, self.est.fit(self.pre(data), labels))
+
+
+class Chain(Transformer):
+    """A fused sequence of nodes. Itself a Transformer (and a pytree, so the
+    whole chain jit-compiles into one XLA program per segment)."""
+
+    stages: tuple = ()
+
+    def apply(self, x):
+        for s in self.stages:
+            x = s.apply(x)
+        return x
+
+    def apply_batch(self, xs):
+        for s in self.stages:
+            xs = s.apply_batch(xs)
+        return xs
+
+    def __call__(self, data: Any) -> Any:
+        # Split into maximal jittable segments; Cacher / host nodes run
+        # between segments and act as materialization boundaries.
+        segment: list = []
+        for s in self.stages:
+            if s.jittable:
+                segment.append(s)
+                continue
+            if segment:
+                data = _run_segment(segment, data)
+                segment = []
+            data = s(data)
+        if segment:
+            data = _run_segment(segment, data)
+        return data
+
+    def serve(self, x: Any) -> Any:
+        for s in self.stages:
+            if not isinstance(s, Transformer):
+                raise TypeError(
+                    f"chain stage {type(s).__name__} has no single-item path"
+                )
+        if all(s.jittable for s in self.stages):
+            return _jit_apply(self, x)
+        return self.apply(x)
+
+
+def _run_segment(segment: Sequence[Node], data: Any) -> Any:
+    node = segment[0] if len(segment) == 1 else Chain(stages=tuple(segment))
+    if isinstance(data, Dataset):
+        return data.replace(data=_jit_apply_batch(node, data.data))
+    return _jit_apply_batch(node, data)
+
+
+def chain(*nodes: Any) -> Chain:
+    """Compose nodes, flattening nested chains."""
+    flat: list = []
+    for n in nodes:
+        if isinstance(n, Chain):
+            flat.extend(n.stages)
+        else:
+            if not isinstance(n, Node):
+                raise TypeError(f"cannot chain non-Node {type(n).__name__}")
+            flat.append(n)
+    return Chain(stages=tuple(flat))
+
+
+class Cacher(Transformer):
+    """Explicit materialization boundary.
+
+    The reference's ``Cacher`` calls ``.cache().setName``
+    (``nodes/util/Cacher.scala:13-21``). Here the analog is: end the current
+    fused XLA segment, force the computation to complete, and hold the result
+    on device. Inside a jitted segment it is the identity.
+    """
+
+    jittable: ClassVar[bool] = False
+    name: str = struct.field(pytree_node=False, default="cached")
+
+    def apply(self, x):
+        return x
+
+    def apply_batch(self, xs):
+        return jax.block_until_ready(xs)
+
+
+class Identity(Transformer):
+    """Reference: ``nodes/util/Identity.scala:12-14``."""
+
+    def apply(self, x):
+        return x

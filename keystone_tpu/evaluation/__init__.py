@@ -1,0 +1,6 @@
+from keystone_tpu.evaluation.multiclass import (
+    MulticlassClassifierEvaluator,
+    MulticlassMetrics,
+)
+from keystone_tpu.evaluation.binary import BinaryClassifierEvaluator, BinaryMetrics
+from keystone_tpu.evaluation.mean_ap import MeanAveragePrecisionEvaluator

@@ -1,0 +1,58 @@
+"""Binary classifier evaluation.
+
+Reference: ``evaluation/BinaryClassifierEvaluator.scala:17-64`` — contingency
+table via map + merge reduce; here one masked reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _contingency(preds, actuals, mask):
+    w = jnp.ones(preds.shape[0], jnp.float32) if mask is None else mask
+    p = preds.astype(bool)
+    a = actuals.astype(bool)
+    tp = jnp.sum(w * (p & a))
+    fp = jnp.sum(w * (p & ~a))
+    fn = jnp.sum(w * (~p & a))
+    tn = jnp.sum(w * (~p & ~a))
+    return tp, fp, fn, tn
+
+
+class BinaryMetrics:
+    def __init__(self, tp: float, fp: float, fn: float, tn: float):
+        self.tp, self.fp, self.fn, self.tn = tp, fp, fn, tn
+        total = tp + fp + fn + tn
+        self.accuracy = (tp + tn) / total if total else 0.0
+        self.precision = tp / (tp + fp) if (tp + fp) else 0.0
+        self.recall = tp / (tp + fn) if (tp + fn) else 0.0
+        self.specificity = tn / (tn + fp) if (tn + fp) else 0.0
+
+    def fscore(self, beta: float = 1.0) -> float:
+        p, r = self.precision, self.recall
+        denom = beta * beta * p + r
+        return (1 + beta * beta) * p * r / denom if denom else 0.0
+
+    def __repr__(self):
+        return (
+            f"BinaryMetrics(acc={self.accuracy:.4f}, p={self.precision:.4f}, "
+            f"r={self.recall:.4f}, f1={self.fscore():.4f})"
+        )
+
+
+class BinaryClassifierEvaluator:
+    def evaluate(self, predictions, actuals, mask: Optional[jax.Array] = None) -> BinaryMetrics:
+        tp, fp, fn, tn = _contingency(
+            jnp.asarray(predictions).reshape(-1),
+            jnp.asarray(actuals).reshape(-1),
+            mask,
+        )
+        return BinaryMetrics(float(tp), float(fp), float(fn), float(tn))
+
+    __call__ = evaluate

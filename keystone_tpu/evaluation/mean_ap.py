@@ -1,0 +1,60 @@
+"""VOC-style Mean Average Precision.
+
+Reference: ``evaluation/MeanAveragePrecisionEvaluator.scala:11-84`` — 11-point
+interpolated AP per class (``getAP``, ``:70-84``); the reference gathers each
+class's scores with ``groupByKey``. Here the whole thing is one vectorized
+sort + cumulative sum per class (vmapped over the class axis).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _average_precision(scores, relevant):
+    """scores: (n,), relevant: (n,) bool -> 11-point interpolated AP."""
+    order = jnp.argsort(-scores)
+    rel = relevant[order].astype(jnp.float32)
+    tp = jnp.cumsum(rel)
+    precision = tp / jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    total = jnp.maximum(jnp.sum(rel), 1.0)
+    recall = tp / total
+    thresholds = jnp.linspace(0.0, 1.0, 11)
+    # max precision at recall >= t, for each of the 11 thresholds
+    p_at_t = jax.vmap(
+        lambda t: jnp.max(jnp.where(recall >= t, precision, 0.0))
+    )(thresholds)
+    return jnp.mean(p_at_t)
+
+
+class MeanAveragePrecisionEvaluator:
+    """Per-class 11-point AP, averaged.
+
+    ``actuals`` is (n, max_labels) int padded with -1 (the static-shape stand-in
+    for the reference's ragged ``Array[Int]``); ``scores`` is (n, num_classes).
+    """
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+
+    def evaluate(self, actuals, scores) -> np.ndarray:
+        actuals = jnp.asarray(actuals)
+        if actuals.ndim == 1:
+            actuals = actuals[:, None]
+        scores = jnp.asarray(scores)
+        classes = jnp.arange(self.num_classes)
+        relevant = jnp.any(
+            actuals[:, :, None] == classes[None, None, :], axis=1
+        )  # (n, C)
+        aps = jax.vmap(_average_precision, in_axes=(1, 1))(scores, relevant)
+        return np.asarray(aps)
+
+    def mean(self, actuals, scores) -> float:
+        return float(np.mean(self.evaluate(actuals, scores)))
+
+    __call__ = evaluate

@@ -1,0 +1,102 @@
+"""Multiclass evaluation: confusion matrix + micro/macro metrics.
+
+Reference: ``evaluation/MulticlassClassifierEvaluator.scala`` — confusion
+matrix accumulated in one ``aggregate`` pass (``:142-152``), ``MulticlassMetrics``
+with micro/macro precision/recall/F1 and a Mahout-style pretty print
+(``:21-118``). Here the one-pass aggregate is a single scatter-add over the
+(row-sharded) predictions; XLA all-reduces the per-shard partials.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def _confusion(preds, actuals, mask, num_classes: int):
+    weights = jnp.ones(preds.shape[0], jnp.float32) if mask is None else mask
+    flat = actuals * num_classes + preds
+    counts = jax.ops.segment_sum(weights, flat, num_segments=num_classes * num_classes)
+    return counts.reshape(num_classes, num_classes)
+
+
+class MulticlassMetrics:
+    """Derived metrics over a confusion matrix (rows = actual, cols = predicted)."""
+
+    def __init__(self, confusion_matrix: np.ndarray, class_names=None):
+        self.confusion_matrix = np.asarray(confusion_matrix, dtype=np.float64)
+        c = self.confusion_matrix.shape[0]
+        self.num_classes = c
+        self.class_names = class_names or [str(i) for i in range(c)]
+        self.total = self.confusion_matrix.sum()
+        tp = np.diag(self.confusion_matrix)
+        actual = self.confusion_matrix.sum(axis=1)  # per-class support
+        predicted = self.confusion_matrix.sum(axis=0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            self.class_precision = np.where(predicted > 0, tp / predicted, 0.0)
+            self.class_recall = np.where(actual > 0, tp / actual, 0.0)
+            pr = self.class_precision + self.class_recall
+            self.class_f1 = np.where(pr > 0, 2 * self.class_precision * self.class_recall / pr, 0.0)
+        self.total_accuracy = float(tp.sum() / self.total) if self.total else 0.0
+        self.total_error = 1.0 - self.total_accuracy
+        # Micro-averaged P/R/F1 all equal accuracy for single-label multiclass.
+        self.micro_precision = self.micro_recall = self.micro_f1 = self.total_accuracy
+        self.macro_precision = float(self.class_precision.mean())
+        self.macro_recall = float(self.class_recall.mean())
+        self.macro_f1 = float(self.class_f1.mean())
+
+    def summary(self, max_classes: int = 20) -> str:
+        """Mahout-style summary (reference ``MulticlassClassifierEvaluator.scala:73-118``)."""
+        lines = [
+            "=" * 48,
+            "Summary Statistics",
+            "-" * 48,
+            f"Accuracy          {self.total_accuracy:.6f}",
+            f"Error             {self.total_error:.6f}",
+            f"Macro Precision   {self.macro_precision:.6f}",
+            f"Macro Recall      {self.macro_recall:.6f}",
+            f"Macro F1          {self.macro_f1:.6f}",
+            f"Total instances   {int(self.total)}",
+            "-" * 48,
+            "Per-class (precision / recall / f1 / support):",
+        ]
+        for i in range(min(self.num_classes, max_classes)):
+            lines.append(
+                f"  {self.class_names[i]:>12}  {self.class_precision[i]:.4f}  "
+                f"{self.class_recall[i]:.4f}  {self.class_f1[i]:.4f}  "
+                f"{int(self.confusion_matrix[i].sum())}"
+            )
+        if self.num_classes > max_classes:
+            lines.append(f"  ... ({self.num_classes - max_classes} more classes)")
+        lines.append("=" * 48)
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return (
+            f"MulticlassMetrics(accuracy={self.total_accuracy:.4f}, "
+            f"macroF1={self.macro_f1:.4f}, n={int(self.total)})"
+        )
+
+
+class MulticlassClassifierEvaluator:
+    """Reference: ``evaluation/MulticlassClassifierEvaluator.scala:142-152``."""
+
+    def __init__(self, num_classes: int, class_names=None):
+        self.num_classes = num_classes
+        self.class_names = class_names
+
+    def evaluate(self, predictions, actuals, mask: Optional[jax.Array] = None) -> MulticlassMetrics:
+        cm = _confusion(
+            jnp.asarray(predictions).astype(jnp.int32).reshape(-1),
+            jnp.asarray(actuals).astype(jnp.int32).reshape(-1),
+            mask,
+            self.num_classes,
+        )
+        return MulticlassMetrics(np.asarray(cm), self.class_names)
+
+    __call__ = evaluate

@@ -1,0 +1,11 @@
+from keystone_tpu.ops.stats.nodes import (
+    CosineRandomFeatures,
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    Sampler,
+    SignedHellingerMapper,
+    BatchSignedHellingerMapper,
+)
+from keystone_tpu.ops.stats.scaler import StandardScaler, StandardScalerModel

@@ -1,0 +1,146 @@
+"""Stats nodes. Reference: ``src/main/scala/nodes/stats/`` (271 LoC).
+
+All of these are elementwise / per-item maps or single gemms — exactly the
+ops XLA fuses into neighbouring matmuls, so each is written as the obvious
+jnp expression and batching is one fused program, not N small kernels.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import ClassVar, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import FunctionNode, Transformer
+
+
+class LinearRectifier(Transformer):
+    """``max(max_val, x - alpha)``. Reference: ``nodes/stats/LinearRectifier.scala:11-16``."""
+
+    max_val: float = struct.field(pytree_node=False, default=0.0)
+    alpha: float = struct.field(pytree_node=False, default=0.0)
+
+    def apply(self, x):
+        return jnp.maximum(self.max_val, x - self.alpha)
+
+
+class RandomSignNode(Transformer):
+    """Elementwise multiply by a fixed ±1 sign vector.
+
+    Reference: ``nodes/stats/RandomSignNode.scala:11-24``.
+    """
+
+    signs: jax.Array
+
+    def apply(self, x):
+        return x * self.signs
+
+    @staticmethod
+    def create(num_features: int, key: jax.Array) -> "RandomSignNode":
+        signs = jax.random.bernoulli(key, 0.5, (num_features,))
+        return RandomSignNode(signs=jnp.where(signs, 1.0, -1.0).astype(jnp.float32))
+
+
+class NormalizeRows(Transformer):
+    """L2-normalize with an epsilon floor.
+
+    Reference: ``nodes/stats/NormalizeRows.scala:10-14`` —
+    ``x / max(‖x‖₂, 2.2e-16)``.
+    """
+
+    def apply(self, x):
+        return x / jnp.maximum(jnp.linalg.norm(x), 2.2e-16)
+
+
+class SignedHellingerMapper(Transformer):
+    """``sign(x)·√|x|``. Reference: ``nodes/stats/SignedHellingerMapper.scala:12-16``."""
+
+    def apply(self, x):
+        return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+# The reference needed a separate Float-matrix batch variant
+# (``SignedHellingerMapper.scala:18-22``); here the same node works on any
+# shape, but the alias keeps the inventory 1:1.
+BatchSignedHellingerMapper = SignedHellingerMapper
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class PaddedFFT(Transformer):
+    """Zero-pad to the next power of two, FFT, keep real parts of the first
+    half. 784 -> 512 for MNIST. Reference: ``nodes/stats/PaddedFFT.scala:13-21``.
+
+    Uses ``jnp.fft.rfft`` (the first ``n/2`` complex bins of the full FFT),
+    which XLA lowers to the TPU's FFT implementation — this replaces the
+    reference's breeze/JTransforms host FFT.
+    """
+
+    def apply(self, x):
+        n = _next_pow2(x.shape[0])
+        return jnp.fft.rfft(x, n=n).real[: n // 2].astype(jnp.float32)
+
+
+class CosineRandomFeatures(Transformer):
+    """Random Fourier features: ``cos(x·Wᵀ + b)``.
+
+    Reference: ``nodes/stats/CosineRandomFeatures.scala:18-57``. The batch
+    path is one ``(n,d)×(d,D)`` gemm — MXU-shaped by construction (the
+    reference hand-batched each partition for the same reason, ``:24-32``).
+    """
+
+    w: jax.Array  # (num_output, num_input)
+    b: jax.Array  # (num_output,)
+
+    def apply(self, x):
+        return jnp.cos(x @ self.w.T + self.b)
+
+    def apply_batch(self, xs):
+        return jnp.cos(xs @ self.w.T + self.b)
+
+    @staticmethod
+    def create(
+        num_input: int,
+        num_output: int,
+        gamma: float,
+        key: jax.Array,
+        distribution: str = "gaussian",
+    ) -> "CosineRandomFeatures":
+        """W ~ gaussian|cauchy scaled by gamma, b ~ U[0, 2π).
+
+        Reference companion: ``CosineRandomFeatures.scala:45-56``.
+        """
+        kw, kb = jax.random.split(key)
+        if distribution == "gaussian":
+            w = jax.random.normal(kw, (num_output, num_input), jnp.float32)
+        elif distribution == "cauchy":
+            u = jax.random.uniform(kw, (num_output, num_input), jnp.float32)
+            w = jnp.tan(jnp.pi * (u - 0.5))
+        else:
+            raise ValueError(f"unknown distribution {distribution!r}")
+        b = jax.random.uniform(kb, (num_output,), jnp.float32, 0.0, 2.0 * math.pi)
+        return CosineRandomFeatures(w=w * gamma, b=b)
+
+
+class Sampler(FunctionNode):
+    """Uniform row sample without replacement (host-side, concrete sizes).
+
+    Reference: ``nodes/stats/Sampling.scala:33-37`` (``takeSample`` with
+    ``seed=42``).
+    """
+
+    jittable: ClassVar[bool] = False
+    size: int = struct.field(pytree_node=False)
+    seed: int = struct.field(pytree_node=False, default=42)
+
+    def apply_batch(self, xs):
+        n = xs.shape[0]
+        take = min(self.size, n)
+        idx = np.random.default_rng(self.seed).choice(n, size=take, replace=False)
+        return xs[np.sort(idx)]

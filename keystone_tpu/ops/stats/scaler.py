@@ -1,0 +1,81 @@
+"""StandardScaler: per-feature mean/std normalization.
+
+Reference: ``nodes/stats/StandardScaler.scala:16-60`` — mean/variance via a
+``treeAggregate`` of Spark's ``MultivariateOnlineSummarizer`` (unbiased n-1
+variance), model applies ``(x-mean)/std`` with a NaN/eps guard.
+
+TPU-native: the moments are masked sums over the row-sharded batch; under jit
+XLA turns them into per-shard partial sums + an ICI all-reduce — the direct
+``treeAggregate`` replacement (SURVEY.md §2.13).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.struct as struct
+
+from keystone_tpu.core.dataset import Dataset
+from keystone_tpu.core.pipeline import Estimator, Transformer
+
+
+class StandardScalerModel(Transformer):
+    mean: jax.Array
+    std: Optional[jax.Array] = None
+
+    def apply(self, x):
+        out = x - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out
+
+    def apply_batch(self, xs):
+        out = xs - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out
+
+
+@functools.partial(jax.jit, static_argnames=("use_std",))
+def _fit_moments(xs, mask, use_std: bool):
+    xs = xs.astype(jnp.float32)
+    if mask is None:
+        n = jnp.float32(xs.shape[0])
+        sum_x = jnp.sum(xs, axis=0)
+        mean = sum_x / n
+        if not use_std:
+            return mean, None
+        var = jnp.sum((xs - mean) ** 2, axis=0) / jnp.maximum(n - 1.0, 1.0)
+    else:
+        n = jnp.sum(mask)
+        mean = jnp.sum(xs * mask[:, None], axis=0) / n
+        if not use_std:
+            return mean, None
+        var = jnp.sum(mask[:, None] * (xs - mean) ** 2, axis=0) / jnp.maximum(
+            n - 1.0, 1.0
+        )
+    std = jnp.sqrt(var)
+    # eps/NaN guard (reference ``StandardScaler.scala:25-31``): constant
+    # features pass through as zeros rather than NaNs.
+    std = jnp.where(jnp.isfinite(std) & (std > 1e-12), std, 1.0)
+    return mean, std
+
+
+class StandardScaler(Estimator):
+    """Reference: ``nodes/stats/StandardScaler.scala:39-60``.
+
+    ``normalize_std_dev=False`` is the centering-only mode the linear solvers
+    use (``nodes/learning/LinearMapper.scala:78-79``).
+    """
+
+    def __init__(self, normalize_std_dev: bool = True):
+        self.normalize_std_dev = normalize_std_dev
+
+    def fit(self, data, mask: Optional[jax.Array] = None) -> StandardScalerModel:
+        if isinstance(data, Dataset):
+            data, mask = data.data, data.mask if mask is None else mask
+        mean, std = _fit_moments(data, mask, self.normalize_std_dev)
+        return StandardScalerModel(mean=mean, std=std)

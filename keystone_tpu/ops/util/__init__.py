@@ -1,0 +1,11 @@
+from keystone_tpu.ops.util.nodes import (
+    Cast,
+    ClassLabelIndicatorsFromIntLabels,
+    ClassLabelIndicatorsFromIntArrayLabels,
+    FloatToDouble,
+    MatrixVectorizer,
+    MaxClassifier,
+    TopKClassifier,
+    VectorSplitter,
+    ZipVectors,
+)

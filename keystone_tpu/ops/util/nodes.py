@@ -1,0 +1,122 @@
+"""Utility nodes. Reference: ``src/main/scala/nodes/util/`` (236 LoC).
+
+``Cacher`` and ``Identity`` live in :mod:`keystone_tpu.core.pipeline`.
+Sparse-feature nodes live in :mod:`keystone_tpu.ops.util.sparse`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, ClassVar, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import FunctionNode, Transformer
+
+
+class ClassLabelIndicatorsFromIntLabels(Transformer):
+    """Int class label -> ±1 indicator vector.
+
+    Reference: ``nodes/util/ClassLabelIndicators.scala:11-20``.
+    """
+
+    num_classes: int = struct.field(pytree_node=False)
+
+    def apply(self, label):
+        return jnp.where(
+            jnp.arange(self.num_classes) == label, 1.0, -1.0
+        ).astype(jnp.float32)
+
+
+class ClassLabelIndicatorsFromIntArrayLabels(Transformer):
+    """Multi-label int array -> ±1 indicator vector.
+
+    Labels are a fixed-width int array padded with -1 (XLA static shapes
+    replace the reference's ragged ``Array[Int]``,
+    ``nodes/util/ClassLabelIndicators.scala:24-36``).
+    """
+
+    num_classes: int = struct.field(pytree_node=False)
+
+    def apply(self, labels):
+        classes = jnp.arange(self.num_classes)
+        hit = jnp.any(labels[:, None] == classes[None, :], axis=0)
+        return jnp.where(hit, 1.0, -1.0).astype(jnp.float32)
+
+
+class MaxClassifier(Transformer):
+    """argmax over scores. Reference: ``nodes/util/MaxClassifier.scala:8-10``."""
+
+    def apply(self, x):
+        return jnp.argmax(x)
+
+
+class TopKClassifier(Transformer):
+    """Top-k class indices, best first.
+
+    Reference: ``nodes/util/TopKClassifier.scala:8-16`` (breeze ``argtopk``).
+    """
+
+    k: int = struct.field(pytree_node=False)
+
+    def apply(self, x):
+        _, idx = jax.lax.top_k(x, self.k)
+        return idx
+
+
+class VectorSplitter(FunctionNode):
+    """Split the feature axis into column blocks of ``block_size`` — the
+    model-parallel splitter feeding the block solvers.
+
+    Reference: ``nodes/util/VectorSplitter.scala:10-34``. The TPU-native block
+    solvers (:mod:`keystone_tpu.learning.block_linear`) usually slice
+    internally instead; this node exists for pipeline-level blocking (e.g.
+    zipping per-FFT feature groups in MnistRandomFFT).
+    """
+
+    block_size: int = struct.field(pytree_node=False)
+
+    def apply_batch(self, xs) -> tuple:
+        d = xs.shape[1]
+        return tuple(
+            xs[:, i : min(i + self.block_size, d)]
+            for i in range(0, d, self.block_size)
+        )
+
+
+class ZipVectors(FunctionNode):
+    """Concatenate a sequence of co-sharded feature blocks back into one
+    feature matrix. Reference: ``nodes/util/ZipVectors.scala:10-14`` (zip +
+    vertcat of co-partitioned RDDs -> same-shard concat on the feature axis).
+    """
+
+    def apply_batch(self, blocks: Sequence[Any]):
+        return jnp.concatenate(list(blocks), axis=1)
+
+
+class MatrixVectorizer(Transformer):
+    """Flatten a matrix to a vector, column-major to match Breeze's
+    ``toDenseVector``. Reference: ``nodes/util/MatrixVectorizer.scala:9-11``.
+    """
+
+    def apply(self, x):
+        return x.T.reshape(-1)
+
+
+class Cast(Transformer):
+    """dtype cast. Stands in for the reference's ``FloatToDouble``
+    (``nodes/util/FloatToDouble.scala:9-11``): TPUs have no fast float64, so
+    solver precision comes from float32 + ``Precision.HIGHEST`` matmuls
+    instead of widening the element type.
+    """
+
+    dtype: Any = struct.field(pytree_node=False)
+
+    def apply(self, x):
+        return x.astype(self.dtype)
+
+
+def FloatToDouble() -> Cast:
+    """Reference-named alias: on TPU this is a float32 cast (see Cast)."""
+    return Cast(dtype=jnp.float32)

@@ -1,0 +1,10 @@
+from keystone_tpu.parallel.mesh import (
+    make_mesh,
+    get_mesh,
+    use_mesh,
+    data_axis_size,
+    shard_rows,
+    shard_cols,
+    replicate,
+    distribute,
+)

@@ -1,0 +1,105 @@
+"""Device mesh + sharding helpers: the distributed substrate.
+
+The reference's distributed substrate is the Spark driver/executor runtime
+(SURVEY.md §2.13): broadcast, treeAggregate/treeReduce, shuffle, zip, collect.
+The TPU-native equivalents, used throughout this framework:
+
+- RDD row partitioning      -> ``NamedSharding(mesh, P('data'))`` on the item axis
+- broadcast of a model      -> replicated sharding (``P()``)
+- treeReduce of gram mats   -> a sharded matmul whose output is replicated:
+  XLA inserts the all-reduce over ICI (``X.T @ X`` with ``X`` row-sharded)
+- mapPartitions             -> ``jax.shard_map`` when per-shard control is needed
+- zip of co-partitioned RDDs-> elementwise op on identically-sharded arrays
+
+Axes convention: ``data`` shards the item/row axis (data parallelism),
+``model`` shards the feature/column axis (the analog of the reference's
+``VectorSplitter`` feature-block model parallelism,
+``nodes/util/VectorSplitter.scala:10-34``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from keystone_tpu.core.dataset import Dataset, pad_rows
+
+_MESH_STACK: list[Mesh] = []
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a 2D ``(data, model)`` mesh over the available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        data = len(devices) // model
+    if data * model != len(devices):
+        devices = devices[: data * model]
+    arr = np.array(devices).reshape(data, model)
+    return Mesh(arr, ("data", "model"))
+
+
+def get_mesh() -> Mesh:
+    """Current mesh: innermost ``use_mesh`` context, else all devices as 1×N data mesh."""
+    if _MESH_STACK:
+        return _MESH_STACK[-1]
+    return make_mesh()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    _MESH_STACK.append(mesh)
+    try:
+        yield mesh
+    finally:
+        _MESH_STACK.pop()
+
+
+def data_axis_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape["data"]
+
+
+def _spec_for_rows(ndim: int) -> P:
+    return P(*(("data",) + (None,) * (ndim - 1)))
+
+
+def shard_rows(x: jax.Array, mesh: Optional[Mesh] = None) -> jax.Array:
+    """Shard the leading (item) axis over the ``data`` mesh axis.
+
+    The row count must be divisible by the data axis; use :func:`distribute`
+    to pad+mask arbitrary row counts.
+    """
+    mesh = mesh or get_mesh()
+    return jax.device_put(x, NamedSharding(mesh, _spec_for_rows(np.ndim(x))))
+
+
+def shard_cols(x: jax.Array, mesh: Optional[Mesh] = None, axis: int = -1) -> jax.Array:
+    """Shard a feature/column axis over the ``model`` mesh axis."""
+    mesh = mesh or get_mesh()
+    axis = axis % np.ndim(x)
+    spec = [None] * np.ndim(x)
+    spec[axis] = "model"
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(x, mesh: Optional[Mesh] = None):
+    """Replicated sharding: the broadcast analog."""
+    mesh = mesh or get_mesh()
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), x)
+
+
+def distribute(x: jax.Array, mesh: Optional[Mesh] = None) -> Dataset:
+    """Pad rows to a multiple of the data axis, shard, and return a masked
+    :class:`Dataset` — the standard way host data enters the mesh."""
+    mesh = mesh or get_mesh()
+    padded, mask = pad_rows(x, data_axis_size(mesh))
+    return Dataset(data=shard_rows(padded, mesh), mask=shard_rows(mask, mesh))

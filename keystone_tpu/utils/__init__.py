@@ -1,0 +1,2 @@
+from keystone_tpu.utils.stats import about_eq, get_err_percent, normalize_rows
+from keystone_tpu.utils.logging import get_logger, Timer, timed

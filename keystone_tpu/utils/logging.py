@@ -1,0 +1,78 @@
+"""Logging + stage timers.
+
+Reference: ``pipelines/Logging.scala:8-67`` (slf4j wrapper) and the ad-hoc
+``System.nanoTime`` wall-clock logs (``MnistRandomFFT.scala:34,86-87``).
+Here timers are a small registry that pipelines use for per-stage wall-clock;
+``jax.profiler`` traces can be layered on via ``Timer(trace=...)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+_configured = False
+
+
+def get_logger(name: str = "keystone_tpu") -> logging.Logger:
+    global _configured
+    if not _configured:
+        logging.basicConfig(level=logging.INFO, format=_FORMAT)
+        _configured = True
+    return logging.getLogger(name)
+
+
+class Timer:
+    """Context manager recording wall-clock into a shared registry.
+
+    Blocks on device work at exit so timings are honest under async dispatch.
+    """
+
+    registry: Dict[str, List[float]] = {}
+
+    def __init__(self, name: str, log: bool = True, block: bool = True):
+        self.name = name
+        self.log = log
+        self.block = block
+        self.elapsed: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self.block:
+            # Flush any outstanding async device work before reading the clock.
+            try:
+                jax.effects_barrier()
+            except Exception:
+                pass
+        self.elapsed = time.perf_counter() - self._t0
+        Timer.registry.setdefault(self.name, []).append(self.elapsed)
+        if self.log:
+            get_logger("keystone_tpu.timing").info(
+                "%s took %.3f s", self.name, self.elapsed
+            )
+        return False
+
+
+def timed(name: Optional[str] = None):
+    """Decorator variant of :class:`Timer`."""
+
+    def wrap(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with Timer(label):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap

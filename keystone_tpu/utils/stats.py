@@ -1,0 +1,43 @@
+"""Numeric helpers. Reference: ``src/main/scala/utils/Stats.scala:12-124``."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def about_eq(a, b, thresh: float = 1e-8) -> bool:
+    """Elementwise |a-b| <= thresh, all entries.
+
+    Reference: ``utils/Stats.scala:25-70`` (scalar/vector/matrix overloads).
+    """
+    return bool(np.all(np.abs(np.asarray(a) - np.asarray(b)) <= thresh))
+
+
+def get_err_percent(predicted, actual, mask=None) -> float:
+    """Top-k error percent: predicted is (n, k) of label indices (top-k first),
+    actual is (n,) single labels. Reference: ``utils/Stats.scala:89-103``.
+    """
+    predicted = np.asarray(predicted)
+    actual = np.asarray(actual).reshape(-1)
+    if predicted.ndim == 1:
+        predicted = predicted[:, None]
+    hit = np.any(predicted == actual[:, None], axis=1)
+    if mask is not None:
+        m = np.asarray(mask, dtype=bool)
+        return float(100.0 * (1.0 - hit[m].mean()))
+    return float(100.0 * (1.0 - hit.mean()))
+
+
+def normalize_rows(mat: jnp.ndarray, alpha: float = 1.0) -> jnp.ndarray:
+    """Per-row: subtract the row mean, divide by sqrt(var + alpha); unbiased
+    (n-1) variance. Used by the Convolver's patch normalization.
+
+    Reference: ``utils/Stats.scala:112-124``.
+    """
+    means = jnp.mean(mat, axis=1, keepdims=True)
+    means = jnp.where(jnp.isnan(means), 0.0, means)
+    var = jnp.sum((mat - means) ** 2, axis=1, keepdims=True) / (mat.shape[1] - 1.0)
+    sds = jnp.sqrt(var + alpha)
+    sds = jnp.where(jnp.isnan(sds), np.sqrt(alpha), sds)
+    return (mat - means) / sds

@@ -1,0 +1,38 @@
+"""Hermetic multi-device test environment.
+
+The reference simulated a cluster with Spark local mode
+(``src/test/scala/pipelines/LocalSparkContext.scala``); here the analog is a
+single-process 8-device CPU mesh via
+``--xla_force_host_platform_device_count=8`` (SURVEY.md §4). Must run before
+jax initializes a backend, hence the env mutation at import time.
+"""
+
+import os
+
+# Belt and braces: env for fresh interpreters, jax.config for the case where
+# site customization already imported jax before pytest ran.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,58 @@
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.evaluation import (
+    BinaryClassifierEvaluator,
+    MeanAveragePrecisionEvaluator,
+    MulticlassClassifierEvaluator,
+)
+
+
+def test_multiclass_confusion_and_accuracy():
+    preds = jnp.array([0, 1, 2, 1, 0])
+    actuals = jnp.array([0, 1, 1, 1, 2])
+    m = MulticlassClassifierEvaluator(num_classes=3)(preds, actuals)
+    # rows = actual, cols = predicted
+    expected = np.array([[1, 0, 0], [0, 2, 1], [1, 0, 0]], dtype=float)
+    np.testing.assert_allclose(m.confusion_matrix, expected)
+    assert abs(m.total_accuracy - 3 / 5) < 1e-9
+    assert m.micro_precision == m.total_accuracy
+    assert "Accuracy" in m.summary()
+
+
+def test_multiclass_masked():
+    preds = jnp.array([0, 1, 0, 0])
+    actuals = jnp.array([0, 1, 1, 1])
+    mask = jnp.array([1.0, 1.0, 1.0, 0.0])
+    m = MulticlassClassifierEvaluator(num_classes=2)(preds, actuals, mask)
+    assert m.total == 3
+    assert abs(m.total_accuracy - 2 / 3) < 1e-9
+
+
+def test_binary_metrics():
+    preds = jnp.array([1, 1, 0, 0, 1])
+    actuals = jnp.array([1, 0, 0, 1, 1])
+    m = BinaryClassifierEvaluator()(preds, actuals)
+    assert (m.tp, m.fp, m.fn, m.tn) == (2, 1, 1, 1)
+    assert abs(m.precision - 2 / 3) < 1e-9
+    assert abs(m.recall - 2 / 3) < 1e-9
+    assert abs(m.fscore() - 2 / 3) < 1e-9
+
+
+def test_mean_average_precision_perfect_ranking():
+    # class 0 relevant items ranked first -> AP = 1
+    actuals = jnp.array([[0], [0], [1]])
+    scores = jnp.array([[0.9, 0.1], [0.8, 0.3], [0.1, 0.7]])
+    ev = MeanAveragePrecisionEvaluator(num_classes=2)
+    aps = ev(actuals, scores)
+    np.testing.assert_allclose(aps, [1.0, 1.0], atol=1e-6)
+
+
+def test_mean_average_precision_voc_11pt():
+    # One relevant item ranked second of three: precision@match = 1/2.
+    # 11-pt interpolated AP = mean over t of max precision with recall>=t = 0.5
+    actuals = jnp.array([[1], [0], [1]])
+    scores = jnp.array([[0.9], [0.8], [0.1]])[:, :1]
+    ev = MeanAveragePrecisionEvaluator(num_classes=1)
+    ap = ev(jnp.array([[0], [-1], [-1]]), jnp.array([[0.5], [0.9], [0.1]]))
+    np.testing.assert_allclose(ap, [0.5], atol=1e-6)

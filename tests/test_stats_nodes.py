@@ -1,0 +1,108 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.stats import (
+    CosineRandomFeatures,
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    SignedHellingerMapper,
+    StandardScaler,
+)
+from keystone_tpu.utils.stats import normalize_rows
+
+
+def test_linear_rectifier():
+    node = LinearRectifier(max_val=0.0, alpha=1.0)
+    out = node(jnp.array([[0.5, 2.0, -3.0]]))
+    np.testing.assert_allclose(np.asarray(out), [[0.0, 1.0, 0.0]])
+
+
+def test_random_sign_node(rng):
+    node = RandomSignNode.create(16, jax.random.key(0))
+    signs = np.asarray(node.signs)
+    assert set(np.unique(signs)) <= {-1.0, 1.0}
+    x = jnp.ones((3, 16))
+    np.testing.assert_allclose(np.asarray(node(x)), np.tile(signs, (3, 1)))
+
+
+def test_normalize_rows_node():
+    x = jnp.array([[3.0, 4.0], [0.0, 0.0]])
+    out = np.asarray(NormalizeRows()(x))
+    np.testing.assert_allclose(out[0], [0.6, 0.8], rtol=1e-6)
+    np.testing.assert_allclose(out[1], [0.0, 0.0])
+
+
+def test_signed_hellinger():
+    out = SignedHellingerMapper()(jnp.array([[-4.0, 9.0]]))
+    np.testing.assert_allclose(np.asarray(out), [[-2.0, 3.0]])
+
+
+def test_padded_fft_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=784).astype(np.float32)
+    out = np.asarray(PaddedFFT()(jnp.asarray(x)[None, :]))[0]
+    assert out.shape == (512,)
+    expected = np.fft.fft(x, n=1024).real[:512]
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-3)
+
+
+def test_cosine_random_features_moments():
+    """Statistical moment checks, like CosineRandomFeaturesSuite.scala:16,36."""
+    key = jax.random.key(1)
+    node = CosineRandomFeatures.create(8, 4096, gamma=1.0, key=key)
+    x = jax.random.normal(jax.random.key(2), (4, 8))
+    feats = np.asarray(node(x))
+    assert feats.shape == (4, 4096)
+    assert np.all(feats >= -1) and np.all(feats <= 1)
+    # E[cos(w·x + b)] = 0 when b ~ U[0, 2pi)
+    assert abs(feats.mean()) < 0.05
+    # direct computation agrees
+    direct = np.cos(np.asarray(x) @ np.asarray(node.w).T + np.asarray(node.b))
+    np.testing.assert_allclose(feats, direct, atol=1e-5)
+
+
+def test_cauchy_random_features():
+    node = CosineRandomFeatures.create(8, 64, gamma=0.5, key=jax.random.key(3), distribution="cauchy")
+    assert np.asarray(node.w).shape == (64, 8)
+
+
+def test_standard_scaler_unbiased(rng):
+    x = rng.normal(loc=3.0, scale=2.0, size=(64, 5)).astype(np.float32)
+    model = StandardScaler().fit(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(model.mean), x.mean(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(model.std), x.std(axis=0, ddof=1), rtol=1e-4
+    )
+    out = np.asarray(model(jnp.asarray(x)))
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=0, ddof=1), 1.0, rtol=1e-4)
+
+
+def test_standard_scaler_masked_ignores_padding(rng):
+    x = rng.normal(size=(10, 3)).astype(np.float32)
+    padded = np.concatenate([x, np.full((6, 3), 1e6, np.float32)])
+    mask = np.concatenate([np.ones(10, np.float32), np.zeros(6, np.float32)])
+    model = StandardScaler().fit(jnp.asarray(padded), mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(model.mean), x.mean(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(model.std), x.std(axis=0, ddof=1), rtol=1e-4)
+
+
+def test_scaler_constant_feature_guard():
+    x = jnp.ones((8, 2))
+    model = StandardScaler().fit(x)
+    out = np.asarray(model(x))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_normalize_rows_util():
+    rng = np.random.default_rng(5)
+    m = rng.normal(size=(4, 10))
+    out = np.asarray(normalize_rows(jnp.asarray(m), alpha=1.0))
+    expected = (m - m.mean(axis=1, keepdims=True)) / np.sqrt(
+        m.var(axis=1, ddof=1, keepdims=True) + 1.0
+    )
+    np.testing.assert_allclose(out, expected, rtol=1e-5)

@@ -1,0 +1,51 @@
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.util import (
+    ClassLabelIndicatorsFromIntLabels,
+    ClassLabelIndicatorsFromIntArrayLabels,
+    MatrixVectorizer,
+    MaxClassifier,
+    TopKClassifier,
+    VectorSplitter,
+    ZipVectors,
+)
+
+
+def test_class_label_indicators():
+    node = ClassLabelIndicatorsFromIntLabels(num_classes=4)
+    out = node(jnp.array([0, 2]))
+    np.testing.assert_allclose(
+        np.asarray(out), [[1, -1, -1, -1], [-1, -1, 1, -1]]
+    )
+
+
+def test_multilabel_indicators_with_padding():
+    node = ClassLabelIndicatorsFromIntArrayLabels(num_classes=5)
+    labels = jnp.array([[0, 3, -1], [2, -1, -1]])
+    out = node(labels)
+    np.testing.assert_allclose(
+        np.asarray(out), [[1, -1, -1, 1, -1], [-1, -1, 1, -1, -1]]
+    )
+
+
+def test_max_and_topk_classifier():
+    scores = jnp.array([[0.1, 0.9, 0.0], [0.5, 0.2, 0.3]])
+    assert np.asarray(MaxClassifier()(scores)).tolist() == [1, 0]
+    topk = TopKClassifier(k=2)(scores)
+    assert np.asarray(topk).tolist() == [[1, 0], [0, 2]]
+
+
+def test_vector_splitter_zip_roundtrip():
+    x = jnp.arange(24.0).reshape(4, 6)
+    blocks = VectorSplitter(block_size=4)(x)
+    assert [b.shape for b in blocks] == [(4, 4), (4, 2)]
+    back = ZipVectors()(blocks)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_matrix_vectorizer_column_major():
+    m = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+    out = MatrixVectorizer().serve(m)
+    # Breeze toDenseVector is column-major
+    np.testing.assert_allclose(np.asarray(out), [1.0, 3.0, 2.0, 4.0])
