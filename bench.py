@@ -1,0 +1,57 @@
+"""Benchmark entry point: MnistRandomFFT fit+eval wall-clock on TPU.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "s", "vs_baseline": N}``.
+
+The flagship workload is the reference's own headline config
+(``--numFFTs 4 --blockSize 2048``, ``README.md:14-22``): 60k×784 train /
+10k×784 test, 4×(sign-flip → 1024-pt FFT → ReLU) featurization to 2048
+features, one-pass block least squares, streaming block evaluation.
+
+The reference publishes no numbers (BASELINE.md) — the Spark baseline must be
+measured on a 64-core cluster we don't have here, so ``vs_baseline`` reports
+against ``baseline_s`` below once BASELINE.md gains a measured value; until
+then it is null. We report the steady-state run (second invocation, compile
+cached) as the headline value and the cold run separately.
+"""
+
+import json
+import time
+
+import jax
+
+# Measured reference wall-clock (Spark, 64-core), to be filled in BASELINE.md.
+BASELINE_S = None
+
+
+def main():
+    from keystone_tpu.pipelines.mnist_random_fft import MnistRandomFFTConfig, run
+
+    config = MnistRandomFFTConfig(
+        num_ffts=4,
+        block_size=2048,
+        lam=10.0,
+        synthetic_train=60000,
+        synthetic_test=10000,
+    )
+    t0 = time.perf_counter()
+    cold = run(config)
+    cold_s = time.perf_counter() - t0
+    warm = run(config)
+
+    value = warm["wallclock_s"]
+    out = {
+        "metric": "mnist_random_fft_fit_eval_wallclock",
+        "value": round(value, 3),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / value, 2) if BASELINE_S else None,
+        "cold_wallclock_s": round(cold_s, 3),
+        "train_error_pct": round(warm["train_error"], 3),
+        "test_error_pct": round(warm["test_error"], 3),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

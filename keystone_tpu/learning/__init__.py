@@ -1,0 +1,5 @@
+from keystone_tpu.learning.linear import LinearMapper, LinearMapEstimator
+from keystone_tpu.learning.block_linear import (
+    BlockLinearMapper,
+    BlockLeastSquaresEstimator,
+)

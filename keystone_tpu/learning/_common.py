@@ -1,0 +1,32 @@
+"""Shared fit scaffolding for the linear estimators: unwrap Datasets, center
+features and labels (``StandardScaler(normalizeStdDev=false)`` in the
+reference), and hand back everything a solver + mapper needs."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.dataset import Dataset
+from keystone_tpu.ops.stats.scaler import StandardScaler, StandardScalerModel
+
+
+def center_for_solve(data, labels, mask: Optional[jax.Array]):
+    """Returns (A_centered, B_centered, feature_scaler, label_scaler, mask)."""
+    if isinstance(data, Dataset):
+        data, mask = data.data, data.mask if mask is None else mask
+    if isinstance(labels, Dataset):
+        labels = labels.data
+    if not isinstance(data, jnp.ndarray):
+        data = jnp.concatenate(list(data), axis=1)
+    feature_scaler = StandardScaler(normalize_std_dev=False).fit(data, mask=mask)
+    label_scaler = StandardScaler(normalize_std_dev=False).fit(labels, mask=mask)
+    return (
+        data - feature_scaler.mean,
+        labels - label_scaler.mean,
+        feature_scaler,
+        label_scaler,
+        mask,
+    )

@@ -1,0 +1,66 @@
+"""Linear model + OLS estimator.
+
+Reference: ``nodes/learning/LinearMapper.scala:18-99`` — model ``xᵀ·in + b``
+with an optional centering scaler; estimator centers features and labels
+(``StandardScaler(normalizeStdDev=false)``), solves the normal equations, and
+uses the label mean as the intercept.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.pipeline import LabelEstimator, Transformer
+from keystone_tpu.learning._common import center_for_solve
+from keystone_tpu.linalg.solvers import normal_equations_solve, tsqr_solve
+from keystone_tpu.ops.stats.scaler import StandardScalerModel
+
+
+class LinearMapper(Transformer):
+    """``(scaled in) @ w + b``. The batch path is one MXU gemm (the analog of
+    the reference's per-partition ``rowsToMatrix`` + gemm,
+    ``LinearMapper.scala:41-55``)."""
+
+    w: jax.Array  # (d, c)
+    b: Optional[jax.Array] = None
+    feature_scaler: Optional[StandardScalerModel] = None
+
+    def apply(self, x):
+        if self.feature_scaler is not None:
+            x = self.feature_scaler.apply(x)
+        out = x @ self.w
+        if self.b is not None:
+            out = out + self.b
+        return out
+
+    def apply_batch(self, xs):
+        if self.feature_scaler is not None:
+            xs = self.feature_scaler.apply_batch(xs)
+        out = xs @ self.w
+        if self.b is not None:
+            out = out + self.b
+        return out
+
+
+class LinearMapEstimator(LabelEstimator):
+    """OLS (optionally ridge) via normal equations or TSQR.
+
+    Reference: ``LinearMapper.scala:63-99``. ``solver="tsqr"`` uses the
+    communication-optimal TSQR path for better conditioning (the upstream
+    ml-matrix TSQR solver named in BASELINE.md's north star).
+    """
+
+    def __init__(self, lam: Optional[float] = None, solver: str = "normal"):
+        self.lam = lam
+        self.solver = solver
+
+    def fit(self, data, labels, mask: Optional[jax.Array] = None) -> LinearMapper:
+        A, B, feature_scaler, label_scaler, mask = center_for_solve(data, labels, mask)
+        if self.solver == "tsqr":
+            w = tsqr_solve(A, B, self.lam or 0.0, mask=mask)
+        else:
+            w = normal_equations_solve(A, B, self.lam, mask=mask)
+        return LinearMapper(w=w, b=label_scaler.mean, feature_scaler=feature_scaler)

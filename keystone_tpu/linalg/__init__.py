@@ -1,0 +1,7 @@
+from keystone_tpu.linalg.solvers import (
+    hdot,
+    normal_equations_solve,
+    tsqr_r,
+    tsqr_solve,
+)
+from keystone_tpu.linalg.bcd import block_coordinate_descent_l2

@@ -1,0 +1,79 @@
+"""Block coordinate descent for L2-regularized least squares.
+
+Rebuild of ``mlmatrix``'s ``BlockCoordinateDescent().solveLeastSquaresWithL2``
+(used at ``nodes/learning/BlockLinearMapper.scala:178-180``): the feature axis
+is processed in HBM-sized column blocks; per block we form the (b×b) gram and
+the (b×c) cross term against the current residual, solve locally, and update
+the residual. Exact BCD for ``min ||AW-b||² + lam·||W||²``:
+
+    (A_kᵀA_k + lam·I) W_k = A_kᵀ(R + A_k W_k)   with  R = b - AW.
+
+TPU mapping (SURVEY.md §7): ``A`` is row-sharded over the ``data`` mesh axis;
+the per-block gram is one sharded matmul — XLA turns the contraction over the
+row axis into per-shard partials + an ICI all-reduce, which *is* the
+reference's ``treeReduce`` of per-partition grams. The block loop is a
+``lax.scan`` with ``dynamic_slice``, so the whole multi-pass solve is one XLA
+program with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.linalg.solvers import hdot
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "num_iter"))
+def block_coordinate_descent_l2(
+    A: jax.Array,
+    b: jax.Array,
+    lam: float,
+    block_size: int,
+    num_iter: int = 1,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
+
+    Masked (padding) rows must be zeroed via ``mask``; the feature dim is
+    padded internally to a multiple of ``block_size`` (padded columns get a
+    unit diagonal in the regularized solve so the system stays nonsingular,
+    and their weights come back exactly zero).
+    """
+    A = jnp.asarray(A, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    if mask is not None:
+        A = A * mask[:, None]
+        b = b * mask[:, None]
+
+    n, d = A.shape
+    c = b.shape[1]
+    d_pad = -(-d // block_size) * block_size
+    if d_pad != d:
+        A = jnp.pad(A, ((0, 0), (0, d_pad - d)))
+    num_blocks = d_pad // block_size
+    # 1.0 on padded columns keeps the per-block system nonsingular even at lam=0.
+    col_pad_reg = (jnp.arange(d_pad) >= d).astype(jnp.float32)
+
+    W0 = jnp.zeros((d_pad, c), A.dtype)
+    eye = jnp.eye(block_size, dtype=A.dtype)
+
+    def block_step(carry, k):
+        W, R = carry
+        start = k * block_size
+        Ak = jax.lax.dynamic_slice(A, (0, start), (n, block_size))
+        Wk = jax.lax.dynamic_slice(W, (start, 0), (block_size, c))
+        regk = jax.lax.dynamic_slice(col_pad_reg, (start,), (block_size,))
+        gram = hdot(Ak.T, Ak)  # sharded matmul -> ICI all-reduce
+        rhs = hdot(Ak.T, R) + hdot(gram, Wk)  # A_kᵀ(R + A_k W_k)
+        Wk_new = jnp.linalg.solve(gram + lam * eye + jnp.diag(regk), rhs)
+        R = R - hdot(Ak, Wk_new - Wk)
+        W = jax.lax.dynamic_update_slice(W, Wk_new, (start, 0))
+        return (W, R), None
+
+    schedule = jnp.tile(jnp.arange(num_blocks), num_iter)
+    (W, _), _ = jax.lax.scan(block_step, (W0, b), schedule)
+    return W[:d]

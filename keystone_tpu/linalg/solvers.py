@@ -1,0 +1,152 @@
+"""Distributed dense least-squares primitives.
+
+Rebuild of the ``mlmatrix`` surface the reference uses (SURVEY.md §2.2):
+``NormalEquations().solveLeastSquares{,WithL2}`` plus the TSQR solver the
+upstream library provides. The Spark pattern — per-partition gram matrices
+tree-reduced to the driver, local solve, broadcast back — becomes: row-sharded
+``X`` on the mesh, gram = one sharded matmul (XLA inserts the ICI all-reduce),
+replicated local solve. No explicit collectives needed except in TSQR, where
+``shard_map`` + ``all_gather`` expresses the R-factor tree exactly.
+
+Numerics: TPUs have no fast float64, so solver matmuls run float32 at
+``Precision.HIGHEST`` (6-pass bf16x6 on the MXU ≈ fp32 accuracy); this is the
+stand-in for the reference's Float→Double widening before solves.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def hdot(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Matmul at HIGHEST precision — use for all gram/solve matmuls."""
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+
+def _apply_mask(A, b, mask):
+    if mask is not None:
+        A = A * mask[:, None]
+        b = b * mask[:, None]
+    return A, b
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _normal_equations(A, b, lam, mask):
+    A, b = _apply_mask(A, b, mask)
+    gram = hdot(A.T, A)
+    atb = hdot(A.T, b)
+    d = A.shape[1]
+    return jnp.linalg.solve(gram + lam * jnp.eye(d, dtype=A.dtype), atb)
+
+
+@jax.jit
+def _normal_equations_lstsq(A, b, mask):
+    A, b = _apply_mask(A, b, mask)
+    gram = hdot(A.T, A)
+    atb = hdot(A.T, b)
+    return jnp.linalg.lstsq(gram, atb)[0]
+
+
+def normal_equations_solve(
+    A: jax.Array,
+    b: jax.Array,
+    lam: Optional[float] = None,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Solve ``min ||AW - b||² (+ lam·||W||²)`` via the normal equations.
+
+    ``A``: (n, d) row-sharded; ``b``: (n, c); returns replicated ``W`` (d, c).
+    With ``lam=None`` uses an SVD min-norm solve of the gram system (robust to
+    rank deficiency, like the unregularized ``solveLeastSquares``).
+    """
+    A = jnp.asarray(A, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    if lam is None or lam == 0.0:
+        return _normal_equations_lstsq(A, b, mask)
+    return _normal_equations(A, b, jnp.float32(lam), mask)
+
+
+def tsqr_r(A: jax.Array, mesh: Mesh) -> jax.Array:
+    """R factor of ``A`` via two-level TSQR over the ``data`` mesh axis.
+
+    Per-shard QR, all-gather the R_i factors over ICI, QR the stack:
+    the communication-optimal tall-skinny factorization (the upstream
+    ml-matrix TSQR path; see also PAPERS.md "Distributed Linear Algebra With
+    TPUs"). Returns a replicated (d, d) upper-triangular R with
+    ``RᵀR = AᵀA`` — computed without ever forming the gram, so the
+    conditioning is κ(A), not κ(A)².
+    """
+    d = A.shape[1]
+
+    def local(Ai):
+        Ri = jnp.linalg.qr(Ai, mode="r")
+        Rs = jax.lax.all_gather(Ri, "data")
+        return jnp.linalg.qr(Rs.reshape(-1, d), mode="r")
+
+    # check_vma=False: every shard computes the same second-level QR from the
+    # all-gathered R_i stack, so the output is replicated by construction —
+    # the static checker just can't prove it through linalg.qr.
+    f = jax.shard_map(
+        local, mesh=mesh, in_specs=P("data", None), out_specs=P(), check_vma=False
+    )
+    return f(A)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "ridge"))
+def _tsqr_solve(A, b, lam, mask, mesh: Mesh, ridge: bool):
+    A, b = _apply_mask(A, b, mask)
+    d = A.shape[1]
+
+    def local(Ai, bi):
+        Qi, Ri = jnp.linalg.qr(Ai, mode="reduced")
+        Zi = hdot(Qi.T, bi)  # this shard's Qᵀb contribution, rotated
+        Rs = jax.lax.all_gather(Ri, "data")  # (k, d, d) over ICI
+        Q2, R2 = jnp.linalg.qr(Rs.reshape(-1, d), mode="reduced")
+        i = jax.lax.axis_index("data")
+        Q2i = jax.lax.dynamic_slice_in_dim(Q2, i * d, d, 0)
+        qtb = jax.lax.psum(hdot(Q2i.T, Zi), "data")
+        return R2, qtb
+
+    # Replicated by construction (identical second-level QR everywhere);
+    # the static checker can't prove it through linalg.qr.
+    R, qtb = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P("data", None), P("data", None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(A, b)
+
+    if ridge:
+        # min ‖AW-b‖²+lam‖W‖² = min ‖[A;√lam·I]W-[b;0]‖²: QR the augmented R.
+        aug = jnp.concatenate(
+            [R, jnp.sqrt(lam) * jnp.eye(d, dtype=A.dtype)], axis=0
+        )
+        Q2, R = jnp.linalg.qr(aug, mode="reduced")
+        qtb = hdot(Q2[:d].T, qtb)
+    return jax.scipy.linalg.solve_triangular(R, qtb, lower=False)
+
+
+def tsqr_solve(
+    A: jax.Array,
+    b: jax.Array,
+    lam: float = 0.0,
+    mask: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Least squares via TSQR, applying Qᵀ to b through the reduction tree —
+    the backward-stable O(κ(A)) path, unlike the normal equations' O(κ²).
+
+    Requires each data shard to hold at least ``d`` rows (tall-skinny).
+    """
+    from keystone_tpu.parallel.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    A = jnp.asarray(A, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    return _tsqr_solve(A, b, jnp.float32(lam), mask, mesh, lam > 0.0)

@@ -1,0 +1,2 @@
+from keystone_tpu.loaders.csv_loader import CsvDataLoader, load_csv
+from keystone_tpu.loaders.mnist import load_mnist_csv, synthetic_mnist

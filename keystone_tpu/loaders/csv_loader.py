@@ -1,0 +1,22 @@
+"""CSV loader. Reference: ``loaders/CsvDataLoader.scala:10-28``
+(``sc.textFile → split(",") → DenseVector``); here one host-side parse into a
+dense float32 matrix, ready for :func:`keystone_tpu.parallel.distribute`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_csv(path: str, dtype=np.float32) -> np.ndarray:
+    return np.loadtxt(path, delimiter=",", dtype=dtype, ndmin=2)
+
+
+class CsvDataLoader:
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> np.ndarray:
+        return load_csv(self.path)
+
+    __call__ = load

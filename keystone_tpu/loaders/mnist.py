@@ -1,0 +1,51 @@
+"""MNIST loading: CSV (the reference's format) or a synthetic stand-in.
+
+The reference's MNIST pipeline reads ``label,pix0..pix783`` CSV rows with
+1-indexed labels (``pipelines/images/mnist/MnistRandomFFT.scala:38-41``).
+``synthetic_mnist`` generates a learnable class-prototype dataset of the same
+shape for benchmarking in environments without the real files (zero egress).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu.loaders.csv_loader import load_csv
+
+MNIST_IMAGE_SIZE = 784
+MNIST_NUM_CLASSES = 10
+
+
+def load_mnist_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (data (n, 784) float32, labels (n,) int32 0-indexed)."""
+    raw = load_csv(path)
+    labels = raw[:, 0].astype(np.int32) - 1  # file labels are 1-indexed
+    return np.ascontiguousarray(raw[:, 1:], dtype=np.float32), labels
+
+
+def synthetic_mnist(
+    n: int,
+    seed: int = 42,
+    num_classes: int = MNIST_NUM_CLASSES,
+    image_size: int = MNIST_IMAGE_SIZE,
+    noise: float = 1.0,
+    prototype_seed: int = 1234,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-prototype + gaussian noise data, MNIST-shaped and learnable.
+
+    ``prototype_seed`` is fixed independently of ``seed`` so train/test splits
+    drawn with different sample seeds share the same class structure.
+    """
+    rng = np.random.default_rng(seed)
+    prototypes = (
+        np.random.default_rng(prototype_seed)
+        .normal(size=(num_classes, image_size))
+        .astype(np.float32)
+    )
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    data = prototypes[labels] + noise * rng.normal(size=(n, image_size)).astype(
+        np.float32
+    )
+    return data, labels

@@ -1,0 +1,147 @@
+"""MnistRandomFFT: the minimum end-to-end slice (SURVEY.md §7 step 3).
+
+Reference: ``pipelines/images/mnist/MnistRandomFFT.scala:17-132`` — N random
+(sign-flip → padded FFT → ReLU) featurizations of MNIST pixels, zipped into
+blocks, solved with block least squares, evaluated with argmax error, with
+the streaming ``applyAndEvaluate`` path reporting error per model block.
+
+Every layer of the framework is exercised: loaders → data plane (pad/shard
+over the mesh) → fused featurizer chains → block solver (sharded grams →
+ICI all-reduce) → classifier → evaluator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.config import parse_config
+from keystone_tpu.core.pipeline import chain
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.learning import BlockLeastSquaresEstimator
+from keystone_tpu.loaders.mnist import (
+    MNIST_IMAGE_SIZE,
+    MNIST_NUM_CLASSES,
+    load_mnist_csv,
+    synthetic_mnist,
+)
+from keystone_tpu.ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels, MaxClassifier
+from keystone_tpu.parallel import distribute, get_mesh, use_mesh
+from keystone_tpu.utils import Timer, get_logger
+
+logger = get_logger("keystone_tpu.pipelines.mnist_random_fft")
+
+# 784 pixels -> 512 PaddedFFT features per FFT (MnistRandomFFT.scala:26-31)
+FEATURES_PER_FFT = 512
+
+
+@dataclasses.dataclass
+class MnistRandomFFTConfig:
+    train_location: str = ""
+    test_location: str = ""
+    num_ffts: int = 4
+    block_size: int = 2048
+    lam: float = 0.0
+    seed: int = 0
+    synthetic_train: int = 60000  # used when train_location is empty
+    synthetic_test: int = 10000
+
+    def validate(self):
+        if self.block_size % FEATURES_PER_FFT != 0:
+            raise ValueError("block_size must be divisible by 512")
+
+
+def build_featurizer(config: MnistRandomFFTConfig):
+    """One fused chain per FFT; each compiles to sign-flip → rfft → relu."""
+    keys = jax.random.split(jax.random.key(config.seed), config.num_ffts)
+    return [
+        chain(
+            RandomSignNode.create(MNIST_IMAGE_SIZE, keys[i]),
+            PaddedFFT(),
+            LinearRectifier(max_val=0.0),
+        )
+        for i in range(config.num_ffts)
+    ]
+
+
+def _load(config: MnistRandomFFTConfig):
+    if config.train_location:
+        train = load_mnist_csv(config.train_location)
+        test = load_mnist_csv(config.test_location)
+    else:
+        train = synthetic_mnist(config.synthetic_train, seed=7)
+        test = synthetic_mnist(config.synthetic_test, seed=8)
+    return train, test
+
+
+def run(config: MnistRandomFFTConfig) -> dict:
+    (train_x, train_y), (test_x, test_y) = _load(config)
+    mesh = get_mesh()
+    evaluator = MulticlassClassifierEvaluator(MNIST_NUM_CLASSES)
+    results: dict = {}
+
+    with use_mesh(mesh), Timer("MnistRandomFFT.pipeline") as total:
+        featurizers = build_featurizer(config)
+        train_ds = distribute(jnp.asarray(train_x))
+        train_labels = distribute(jnp.asarray(train_y)).data
+        labels = ClassLabelIndicatorsFromIntLabels(MNIST_NUM_CLASSES)(train_labels)
+
+        with Timer("featurize.train"):
+            train_feats = jnp.concatenate(
+                [f(train_ds.data) for f in featurizers], axis=1
+            ).block_until_ready()
+
+        with Timer("fit.block_least_squares"):
+            model = BlockLeastSquaresEstimator(
+                config.block_size, num_iter=1, lam=config.lam
+            ).fit(train_feats, labels, mask=train_ds.mask)
+            jax.block_until_ready(model)
+
+        # Streaming evaluation per model block (BlockLinearMapper.scala:104-137)
+        def eval_stream(name, feats, actuals, mask):
+            errors = []
+
+            def cb(partial_preds):
+                preds = MaxClassifier()(partial_preds)
+                m = evaluator(preds, actuals, mask)
+                errors.append(100.0 * m.total_error)
+
+            model.apply_and_evaluate(feats, cb)
+            logger.info("%s error by block: %s", name, [f"{e:.2f}%" for e in errors])
+            return errors[-1]
+
+        with Timer("eval.train"):
+            results["train_error"] = eval_stream(
+                "train", train_feats, train_labels, train_ds.mask
+            )
+
+        test_ds = distribute(jnp.asarray(test_x))
+        with Timer("featurize+eval.test"):
+            test_feats = jnp.concatenate(
+                [f(test_ds.data) for f in featurizers], axis=1
+            )
+            results["test_error"] = eval_stream(
+                "test", test_feats, distribute(jnp.asarray(test_y)).data, test_ds.mask
+            )
+
+    results["wallclock_s"] = total.elapsed
+    logger.info("Train Error is %.2f%%", results["train_error"])
+    logger.info("TEST Error is %.2f%%", results["test_error"])
+    logger.info("Pipeline took %.1f s", results["wallclock_s"])
+    return results
+
+
+def main(argv=None):
+    config = parse_config(MnistRandomFFTConfig, argv, prog="MnistRandomFFT")
+    results = run(config)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

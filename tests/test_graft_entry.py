@@ -1,0 +1,16 @@
+import jax
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
+
+
+def test_dryrun_multichip_8(capsys):
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
+    assert "mesh={'data': 4, 'model': 2}" in capsys.readouterr().out

@@ -1,0 +1,178 @@
+"""Solver correctness via math invariants, mirroring the reference suites
+(``LinearMapperSuite``, ``BlockLinearMapperSuite``,
+``BlockWeightedLeastSquaresSuite`` zero-gradient checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.core.dataset import pad_rows
+from keystone_tpu.linalg import (
+    block_coordinate_descent_l2,
+    normal_equations_solve,
+    tsqr_r,
+    tsqr_solve,
+)
+from keystone_tpu.learning import (
+    BlockLeastSquaresEstimator,
+    BlockLinearMapper,
+    LinearMapEstimator,
+    LinearMapper,
+)
+from keystone_tpu.parallel import distribute, make_mesh, use_mesh
+
+
+def _planted(rng, n=256, d=24, c=3, noise=0.0):
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, c)).astype(np.float32)
+    b = A @ W + noise * rng.normal(size=(n, c)).astype(np.float32)
+    return A, W, b
+
+
+def test_normal_equations_recovers_planted_model(rng):
+    A, W, b = _planted(rng)
+    What = np.asarray(normal_equations_solve(A, b))
+    np.testing.assert_allclose(What, W, atol=1e-2)
+
+
+def test_normal_equations_ridge_gradient_zero(rng):
+    """Ridge solution invariant: Aᵀ(AW-b) + λW = 0."""
+    A, _, b = _planted(rng, noise=0.5)
+    lam = 3.0
+    W = np.asarray(normal_equations_solve(A, b, lam))
+    grad = A.T @ (A @ W - b) + lam * W
+    assert np.abs(grad).max() < 2e-2
+
+
+def test_tsqr_r_matches_gram(rng, devices):
+    mesh = make_mesh()
+    A = rng.normal(size=(64, 8)).astype(np.float32)
+    with use_mesh(mesh):
+        R = np.asarray(tsqr_r(jnp.asarray(A), mesh))
+    np.testing.assert_allclose(R.T @ R, A.T @ A, atol=1e-3)
+
+
+def test_tsqr_solve_matches_normal_equations(rng, devices):
+    A, _, b = _planted(rng, n=128, d=16, noise=0.3)
+    lam = 1.5
+    mesh = make_mesh()
+    with use_mesh(mesh):
+        W1 = np.asarray(tsqr_solve(jnp.asarray(A), jnp.asarray(b), lam, mesh=mesh))
+    W2 = np.asarray(normal_equations_solve(A, b, lam))
+    np.testing.assert_allclose(W1, W2, atol=1e-3)
+
+
+def test_bcd_single_block_equals_normal_equations(rng):
+    A, _, b = _planted(rng, d=16, noise=0.2)
+    lam = 2.0
+    W_bcd = np.asarray(block_coordinate_descent_l2(A, b, lam, block_size=16))
+    W_ne = np.asarray(normal_equations_solve(A, b, lam))
+    np.testing.assert_allclose(W_bcd, W_ne, atol=1e-4)
+
+
+def test_bcd_converges_to_zero_gradient(rng):
+    """Multi-block BCD after several passes: ridge gradient ≈ 0
+    (the reference's independent-gradient check,
+    BlockWeightedLeastSquaresSuite.scala:71)."""
+    A, _, b = _planted(rng, n=200, d=30, noise=0.5)
+    lam = 4.0
+    W = np.asarray(block_coordinate_descent_l2(A, b, lam, block_size=8, num_iter=20))
+    grad = A.T @ (A @ W - b) + lam * W
+    assert np.abs(grad).max() < 1e-2
+
+
+def test_bcd_feature_padding_weights_are_zero(rng):
+    A, _, b = _planted(rng, d=10, noise=0.1)
+    W = np.asarray(block_coordinate_descent_l2(A, b, 1.0, block_size=8, num_iter=3))
+    assert W.shape == (10, 3)  # padded cols trimmed
+
+
+def test_bcd_masked_rows_ignored(rng):
+    A, _, b = _planted(rng, n=100, d=12, noise=0.2)
+    lam = 1.0
+    W_full = np.asarray(block_coordinate_descent_l2(A, b, lam, block_size=4, num_iter=5))
+    Ap, mask = pad_rows(jnp.asarray(A), 16)
+    bp, _ = pad_rows(jnp.asarray(b), 16)
+    # poison the padding rows; mask must hide them
+    Ap = Ap.at[100:].set(99.0)
+    bp = bp.at[100:].set(-99.0)
+    W_masked = np.asarray(
+        block_coordinate_descent_l2(Ap, bp, lam, block_size=4, num_iter=5, mask=mask)
+    )
+    np.testing.assert_allclose(W_masked, W_full, atol=1e-4)
+
+
+def test_linear_map_estimator_centers_and_recovers(rng):
+    """OLS with intercept: recovers model on shifted data
+    (LinearMapperSuite.scala:11-34)."""
+    A, W, b = _planted(rng, noise=0.0)
+    A_shift = A + 5.0
+    b_shift = b + 2.0
+    model = LinearMapEstimator().fit(jnp.asarray(A_shift), jnp.asarray(b_shift))
+    pred = np.asarray(model(jnp.asarray(A_shift)))
+    np.testing.assert_allclose(pred, b_shift, atol=5e-2)
+    # single-item serving path agrees
+    one = np.asarray(model.serve(jnp.asarray(A_shift[0])))
+    np.testing.assert_allclose(one, pred[0], atol=1e-3)
+
+
+def test_linear_map_estimator_tsqr(rng, devices):
+    A, W, b = _planted(rng)
+    mesh = make_mesh()
+    with use_mesh(mesh):
+        model = LinearMapEstimator(lam=0.01, solver="tsqr").fit(
+            jnp.asarray(A), jnp.asarray(b)
+        )
+        pred = np.asarray(model(jnp.asarray(A)))
+    np.testing.assert_allclose(pred, b, atol=5e-2)
+
+
+def test_block_mapper_equals_dense_mapper(rng):
+    """Block model ≡ dense model, incl. the streaming evaluate path
+    (BlockLinearMapperSuite.scala:17-54)."""
+    A, _, b = _planted(rng, n=128, d=32, noise=0.3)
+    est = BlockLeastSquaresEstimator(block_size=8, num_iter=10, lam=2.0)
+    block_model = est.fit(jnp.asarray(A), jnp.asarray(b))
+
+    dense = LinearMapper(
+        w=block_model.w, b=block_model.b,
+        feature_scaler=None,
+    )
+    centered = jnp.asarray(A) - block_model.feature_means
+    np.testing.assert_allclose(
+        np.asarray(block_model(jnp.asarray(A))),
+        np.asarray(dense(centered)),
+        atol=1e-4,
+    )
+
+    # streaming path: last partial equals the full prediction
+    partials = []
+    block_model.apply_and_evaluate(jnp.asarray(A), lambda p: partials.append(np.asarray(p)))
+    assert len(partials) == 4  # 32 / 8
+    np.testing.assert_allclose(
+        partials[-1], np.asarray(block_model(jnp.asarray(A))), atol=1e-4
+    )
+
+
+def test_block_estimator_on_sharded_dataset(rng, devices):
+    A, _, b = _planted(rng, n=120, d=16, noise=0.2)
+    mesh = make_mesh()
+    with use_mesh(mesh):
+        ds = distribute(jnp.asarray(A))
+        labels, _ = pad_rows(jnp.asarray(b), 8)
+        est = BlockLeastSquaresEstimator(block_size=8, num_iter=5, lam=1.0)
+        model = est.fit(ds.data, labels, mask=ds.mask)
+    W_local = np.asarray(
+        block_coordinate_descent_l2(A - A.mean(0), b - b.mean(0), 1.0, block_size=8, num_iter=5)
+    )
+    np.testing.assert_allclose(np.asarray(model.w), W_local, atol=1e-3)
+
+
+def test_block_estimator_accepts_block_sequence(rng):
+    A, _, b = _planted(rng, n=64, d=16, noise=0.1)
+    blocks = [jnp.asarray(A[:, :8]), jnp.asarray(A[:, 8:])]
+    est = BlockLeastSquaresEstimator(block_size=8, num_iter=5, lam=1.0)
+    m1 = est.fit(blocks, jnp.asarray(b))
+    m2 = est.fit(jnp.asarray(A), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(m1.w), np.asarray(m2.w), atol=1e-5)
