@@ -3,3 +3,4 @@ from keystone_tpu.learning.block_linear import (
     BlockLinearMapper,
     BlockLeastSquaresEstimator,
 )
+from keystone_tpu.learning.zca import ZCAWhitener, ZCAWhitenerEstimator

@@ -74,6 +74,58 @@ def _block_contrib(xs, w, start, stop):
     return xs[:, start:stop] @ w[start:stop]
 
 
+# ---------------------------------------------------------------------------
+# Streaming (out-of-core) path: the feature matrix never materializes.
+#
+# The reference caches each 4096-wide feature batch across the cluster
+# (``TimitPipeline.scala:85-100``); on a TPU the full feature matrix
+# (e.g. TIMIT: 50×4096 features) can exceed HBM, so each block is
+# re-featurized from the raw data inside the solver loop — trading MXU FLOPs
+# for memory (SURVEY.md §7 hard part #5). Only the (n, c) residual and the
+# (d, c) model stay resident.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _streaming_block_step_first(feat_node, raw, R, lam, mask):
+    """First pass over a block: derive the (masked) feature mean from the same
+    featurization used for the solve — no separate mean pass."""
+    from keystone_tpu.linalg.solvers import hdot
+
+    feats = feat_node.apply_batch(raw)
+    if mask is None:
+        fmean = jnp.mean(feats, axis=0)
+        feats = feats - fmean
+    else:
+        fmean = jnp.sum(feats * mask[:, None], axis=0) / jnp.sum(mask)
+        feats = (feats - fmean) * mask[:, None]
+    gram = hdot(feats.T, feats)
+    eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
+    Wk = jnp.linalg.solve(gram + lam * eye, hdot(feats.T, R))
+    R = R - hdot(feats, Wk)
+    return fmean, Wk, R
+
+
+@jax.jit
+def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean):
+    from keystone_tpu.linalg.solvers import hdot
+
+    feats = feat_node.apply_batch(raw) - fmean
+    if mask is not None:
+        feats = feats * mask[:, None]
+    gram = hdot(feats.T, feats)
+    rhs = hdot(feats.T, R) + hdot(gram, Wk)
+    eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
+    Wk_new = jnp.linalg.solve(gram + lam * eye, rhs)
+    R = R - hdot(feats, Wk_new - Wk)
+    return Wk_new, R
+
+
+@jax.jit
+def _streaming_contrib(feat_node, raw, wk, fmean):
+    return (feat_node.apply_batch(raw) - fmean) @ wk
+
+
 class BlockLeastSquaresEstimator(LabelEstimator):
     """Fit via block coordinate descent with L2.
 
@@ -100,3 +152,65 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             feature_means=feature_scaler.mean,
             block_size=self.block_size,
         )
+
+    def fit_streaming(
+        self,
+        feature_nodes: Sequence[Transformer],
+        raw,
+        labels,
+        mask: Optional[jax.Array] = None,
+    ) -> BlockLinearMapper:
+        """Fit with one feature block per node, re-featurizing ``raw`` inside
+        the solver loop instead of materializing the feature matrix.
+
+        Every node must emit ``block_size`` features. The returned mapper is
+        dense; use :func:`streaming_apply_and_evaluate` for out-of-core apply.
+        """
+        from keystone_tpu.core.dataset import Dataset
+        from keystone_tpu.ops.stats.scaler import StandardScaler
+
+        if isinstance(raw, Dataset):
+            raw, mask = raw.data, raw.mask if mask is None else mask
+        if isinstance(labels, Dataset):
+            labels = labels.data
+        label_scaler = StandardScaler(normalize_std_dev=False).fit(labels, mask=mask)
+        B = labels - label_scaler.mean
+        if mask is not None:
+            B = B * mask[:, None]
+        lam = jnp.float32(self.lam)
+
+        fmeans: list = [None] * len(feature_nodes)
+        Ws: list = [None] * len(feature_nodes)
+        R = B.astype(jnp.float32)
+        for k, node in enumerate(feature_nodes):
+            fmeans[k], Ws[k], R = _streaming_block_step_first(node, raw, R, lam, mask)
+        for _ in range(self.num_iter - 1):
+            for k, node in enumerate(feature_nodes):
+                Ws[k], R = _streaming_block_step(
+                    node, raw, R, Ws[k], lam, mask, fmeans[k]
+                )
+        return BlockLinearMapper(
+            w=jnp.concatenate(Ws, axis=0),
+            b=label_scaler.mean,
+            feature_means=jnp.concatenate(fmeans),
+            block_size=self.block_size,
+        )
+
+
+def streaming_apply_and_evaluate(
+    model: BlockLinearMapper,
+    feature_nodes: Sequence[Transformer],
+    raw,
+    evaluator: Callable[[jax.Array], None],
+) -> None:
+    """Out-of-core analog of :meth:`BlockLinearMapper.apply_and_evaluate`:
+    featurize block k, add its contribution, hand the running prediction to
+    ``evaluator`` (``BlockLinearMapper.scala:104-137``)."""
+    bs = model.block_size
+    partial = None
+    for k, node in enumerate(feature_nodes):
+        wk = model.w[k * bs : (k + 1) * bs]
+        fm = model.feature_means[k * bs : (k + 1) * bs]
+        contrib = _streaming_contrib(node, raw, wk, fm)
+        partial = contrib if partial is None else partial + contrib
+        evaluator(partial + model.b if model.b is not None else partial)

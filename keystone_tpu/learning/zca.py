@@ -1,0 +1,52 @@
+"""ZCA whitening.
+
+Reference: ``nodes/learning/ZCAWhitener.scala:11-64`` — fit on one local
+matrix via LAPACK ``sgesvd``; whitener ``Vᵀ·diag((s²/(n-1)+eps)^-0.5)·V``;
+transform ``(in - means) @ whitener``. Here the SVD is ``jnp.linalg.svd``
+(XLA's divide-and-conquer on device) and the fit is one jitted program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.dataset import Dataset
+from keystone_tpu.core.pipeline import Estimator, Transformer
+
+
+class ZCAWhitener(Transformer):
+    whitener: jax.Array  # (d, d), symmetric
+    means: jax.Array  # (d,)
+
+    def apply(self, x):
+        return (x - self.means) @ self.whitener
+
+    apply_batch = apply
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _fit_zca(x, eps):
+    means = jnp.mean(x, axis=0)
+    centered = (x - means).astype(jnp.float32)
+    n = x.shape[0]
+    _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
+    scale = (s * s / (n - 1.0) + eps) ** -0.5
+    whitener = (vt.T * scale[None, :]) @ vt
+    return whitener, means
+
+
+class ZCAWhitenerEstimator(Estimator):
+    def __init__(self, eps: float = 1e-12):
+        self.eps = eps
+
+    def fit(self, data) -> ZCAWhitener:
+        if isinstance(data, Dataset):
+            data = data.data
+        return self.fit_single(data)
+
+    def fit_single(self, x) -> ZCAWhitener:
+        whitener, means = _fit_zca(jnp.asarray(x), jnp.float32(self.eps))
+        return ZCAWhitener(whitener=whitener, means=means)

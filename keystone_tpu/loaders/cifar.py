@@ -1,0 +1,48 @@
+"""CIFAR-10 binary loader.
+
+Reference: ``loaders/CifarLoader.scala:13-52`` — records of 1 label byte +
+3072 bytes (three 1024-byte row-major channel planes, R/G/B). Returns
+``(n, 32, 32, 3)`` float32 images (channel-last, our canonical layout) and
+int labels. ``synthetic_cifar`` is the zero-egress stand-in.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+CIFAR_DIM = 32
+CIFAR_CHANNELS = 3
+CIFAR_NUM_CLASSES = 10
+_RECORD = 1 + CIFAR_DIM * CIFAR_DIM * CIFAR_CHANNELS
+
+
+def load_cifar_binary(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    raw = np.fromfile(path, dtype=np.uint8)
+    assert raw.size % _RECORD == 0, f"{path}: not a CIFAR-10 binary"
+    raw = raw.reshape(-1, _RECORD)
+    labels = raw[:, 0].astype(np.int32)
+    imgs = (
+        raw[:, 1:]
+        .reshape(-1, CIFAR_CHANNELS, CIFAR_DIM, CIFAR_DIM)
+        .transpose(0, 2, 3, 1)
+        .astype(np.float32)
+    )
+    return imgs, labels
+
+
+def synthetic_cifar(
+    n: int, seed: int = 42, noise: float = 40.0, prototype_seed: int = 99
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Smooth per-class prototype images + noise, byte range [0, 255]."""
+    proto_rng = np.random.default_rng(prototype_seed)
+    # low-frequency prototypes: random coarse grids upsampled
+    coarse = proto_rng.uniform(
+        40, 215, size=(CIFAR_NUM_CLASSES, 8, 8, CIFAR_CHANNELS)
+    )
+    prototypes = np.repeat(np.repeat(coarse, 4, axis=1), 4, axis=2)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, CIFAR_NUM_CLASSES, size=n).astype(np.int32)
+    imgs = prototypes[labels] + rng.normal(0, noise, size=(n, CIFAR_DIM, CIFAR_DIM, CIFAR_CHANNELS))
+    return np.clip(imgs, 0, 255).astype(np.float32), labels

@@ -1,0 +1,48 @@
+"""TIMIT features loader.
+
+Reference: ``loaders/TimitFeaturesDataLoader.scala:15-70`` — CSV rows of
+440-dim MFCC-derived features plus sparse label files ("row label" lines),
+147 phone classes. (The reference has a latent bug parsing train labels from
+the test path, ``:64`` — not reproduced.) ``synthetic_timit`` is the
+zero-egress stand-in.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from keystone_tpu.loaders.csv_loader import load_csv
+
+TIMIT_DIMENSION = 440
+TIMIT_NUM_CLASSES = 147
+
+
+def load_timit(
+    data_path: str, labels_path: str
+) -> Tuple[np.ndarray, np.ndarray]:
+    data = load_csv(data_path)
+    labels = np.zeros(data.shape[0], np.int32)
+    with open(labels_path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                labels[int(parts[0])] = int(parts[1])
+    return data, labels
+
+
+def synthetic_timit(
+    n: int, seed: int = 42, prototype_seed: int = 7
+) -> Tuple[np.ndarray, np.ndarray]:
+    protos = (
+        np.random.default_rng(prototype_seed)
+        .normal(size=(TIMIT_NUM_CLASSES, TIMIT_DIMENSION))
+        .astype(np.float32)
+    )
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, TIMIT_NUM_CLASSES, size=n).astype(np.int32)
+    data = protos[labels] + 2.0 * rng.normal(size=(n, TIMIT_DIMENSION)).astype(
+        np.float32
+    )
+    return data, labels

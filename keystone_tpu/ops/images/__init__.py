@@ -1,0 +1,9 @@
+from keystone_tpu.ops.images.nodes import (
+    GrayScaler,
+    ImageVectorizer,
+    PixelScaler,
+    SymmetricRectifier,
+)
+from keystone_tpu.ops.images.convolver import Convolver
+from keystone_tpu.ops.images.pooler import Pooler
+from keystone_tpu.ops.images.windower import Windower

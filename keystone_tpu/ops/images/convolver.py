@@ -1,0 +1,80 @@
+"""Filter-bank convolution with optional per-patch normalization/whitening.
+
+Reference: ``nodes/images/Convolver.scala:19-154`` — im2col (``makePatches``)
++ one gemm per image, with optional per-patch mean/variance normalization
+(``Stats.normalizeRows`` with ``varConstant``) and whitening-mean subtraction.
+
+TPU design: the im2col+gemm *is* a convolution, so the main compute is one
+``lax.conv_general_dilated`` over the whole batch (MXU-tiled by XLA). The
+per-patch normalization is decomposed into closed form so no patch matrix is
+ever materialized: with patch p, filter f, n = k·k·C,
+
+    normalize(p)·f = (p·f − mean(p)·Σf) / sd(p)
+
+where mean/sd come from two box-filter convolutions (patch sum and patch
+sum-of-squares), and the whitener-mean subtraction is a constant per filter:
+``(normalize(p) − m)·f = normalize(p)·f − m·f``. Everything fuses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.learning.zca import ZCAWhitener
+
+
+class Convolver(Transformer):
+    """``filters``: (num_filters, k·k·C), rows in the reference's patch layout
+    (y-offset slowest, then x-offset, channel fastest)."""
+
+    filters: jax.Array
+    whitener: Optional[ZCAWhitener] = None
+    num_channels: int = struct.field(pytree_node=False, default=3)
+    normalize_patches: bool = struct.field(pytree_node=False, default=True)
+    var_constant: float = struct.field(pytree_node=False, default=10.0)
+
+    @property
+    def conv_size(self) -> int:
+        k2 = self.filters.shape[1] // self.num_channels
+        k = int(round(k2**0.5))
+        assert k * k == k2, "filters must be square"
+        return k
+
+    def apply(self, img):
+        return self.apply_batch(img[None])[0]
+
+    def apply_batch(self, imgs):
+        k, c = self.conv_size, self.num_channels
+        nf = self.filters.shape[0]
+        kernel = self.filters.reshape(nf, k, k, c).transpose(1, 2, 3, 0)  # HWIO
+        dn = jax.lax.conv_dimension_numbers(
+            imgs.shape, kernel.shape, ("NHWC", "HWIO", "NHWC")
+        )
+        raw = jax.lax.conv_general_dilated(
+            imgs, kernel, (1, 1), "VALID", dimension_numbers=dn
+        )  # (N, resH, resW, nF)
+
+        out = raw
+        if self.normalize_patches:
+            n = k * k * c
+            ones = jnp.ones((k, k, c, 1), imgs.dtype)
+            s1 = jax.lax.conv_general_dilated(
+                imgs, ones, (1, 1), "VALID", dimension_numbers=dn
+            )
+            s2 = jax.lax.conv_general_dilated(
+                imgs * imgs, ones, (1, 1), "VALID", dimension_numbers=dn
+            )
+            mean = s1 / n
+            var = (s2 - s1 * mean) / (n - 1.0)
+            sd = jnp.sqrt(var + self.var_constant)
+            fsum = jnp.sum(self.filters, axis=1)  # (nF,)
+            out = (raw - mean * fsum[None, None, None, :]) / sd
+        if self.whitener is not None:
+            mf = self.whitener.means @ self.filters.T  # (nF,)
+            out = out - mf[None, None, None, :]
+        return out

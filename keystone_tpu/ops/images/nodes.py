@@ -1,0 +1,70 @@
+"""Small per-pixel image nodes.
+
+Image convention throughout this framework: an image is an ``(H, W, C)``
+float32 array (channel fastest in memory), so the reference's channel-major
+vector layout (``utils/images/Image.scala:179``: index ``c + x*C + y*C*X``)
+is exactly ``img.reshape(-1)`` — no layout zoo needed; XLA owns physical
+layout on TPU. The reference's five ``Image`` implementations collapse to
+this one array type, and ``ImageMetadata`` is just ``.shape``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import Transformer
+
+
+class GrayScaler(Transformer):
+    """NTSC grayscale; keeps a single channel.
+
+    Reference: ``utils/images/ImageUtils.scala:55-87`` + ``nodes/images/
+    GrayScaler.scala:9``. The reference hardcodes BGR channel order (its JPEG
+    decode path); this repo's canonical layout is RGB (see loaders/cifar.py),
+    so the default is ``"rgb"`` — pass ``channel_order="bgr"`` for data that
+    arrives BGR. Non-3-channel images use sqrt of the mean square.
+    """
+
+    channel_order: str = struct.field(pytree_node=False, default="rgb")
+
+    def apply(self, img):
+        if img.shape[-1] == 3:
+            rgb = jnp.array([0.2989, 0.5870, 0.1140], img.dtype)
+            w = rgb if self.channel_order == "rgb" else rgb[::-1]
+            return (img @ w)[..., None]
+        return jnp.sqrt(jnp.mean(img**2, axis=-1, keepdims=True))
+
+
+class PixelScaler(Transformer):
+    """Byte pixels -> [0,1]. Reference: ``nodes/images/PixelScaler.scala:10-13``."""
+
+    def apply(self, img):
+        return img / 255.0
+
+
+class ImageVectorizer(Transformer):
+    """Image -> channel-major vector (``nodes/images/ImageVectorizer.scala:11-14``);
+    with the (H, W, C) convention this is a plain flatten."""
+
+    def apply(self, img):
+        return img.reshape(-1)
+
+
+class SymmetricRectifier(Transformer):
+    """Doubles channels: ``max(maxVal, x-α)`` ++ ``max(maxVal, -x-α)``.
+
+    Reference: ``nodes/images/SymmetricRectifier.scala:6-31``.
+    """
+
+    max_val: float = struct.field(pytree_node=False, default=0.0)
+    alpha: float = struct.field(pytree_node=False, default=0.0)
+
+    def apply(self, img):
+        return jnp.concatenate(
+            [
+                jnp.maximum(self.max_val, img - self.alpha),
+                jnp.maximum(self.max_val, -img - self.alpha),
+            ],
+            axis=-1,
+        )

@@ -1,0 +1,57 @@
+"""Strided pooling.
+
+Reference: ``nodes/images/Pooler.scala:20-68`` — pools of ``pool_size`` at
+strides starting from ``pool_size/2``, a ``pixel_function`` pre-map and a
+pooling aggregator; windows at the right/bottom edge are clamped to the
+image. Maps to ``lax.reduce_window`` with asymmetric padding supplying the
+clamped windows (identity element padding keeps them exact).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, ClassVar, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import Transformer
+
+
+def _pool_geometry(dim: int, stride: int, pool_size: int) -> tuple[int, int]:
+    """Returns (num_pools, right_pad) for one spatial dim."""
+    stride_start = pool_size // 2
+    num_pools = -(-(dim - stride_start) // stride)  # ceil
+    # window i covers [i*stride, i*stride + pool_size); pad to reach the last
+    last_end = (num_pools - 1) * stride + pool_size
+    return num_pools, max(0, last_end - dim)
+
+
+class Pooler(Transformer):
+    stride: int = struct.field(pytree_node=False)
+    pool_size: int = struct.field(pytree_node=False)
+    pixel_function: Optional[Callable] = struct.field(pytree_node=False, default=None)
+    pool: str = struct.field(pytree_node=False, default="sum")  # sum | max
+
+    def apply(self, img):
+        h, w, c = img.shape
+        if self.pixel_function is not None:
+            img = self.pixel_function(img)
+        (ph, pad_h) = _pool_geometry(h, self.stride, self.pool_size)
+        (pw, pad_w) = _pool_geometry(w, self.stride, self.pool_size)
+        if self.pool == "sum":
+            init, op = 0.0, jax.lax.add
+        elif self.pool == "max":
+            init, op = -jnp.inf, jax.lax.max
+        else:
+            raise ValueError(f"unknown pool {self.pool!r}")
+        out = jax.lax.reduce_window(
+            img,
+            jnp.asarray(init, img.dtype),
+            op,
+            window_dimensions=(self.pool_size, self.pool_size, 1),
+            window_strides=(self.stride, self.stride, 1),
+            padding=((0, pad_h), (0, pad_w), (0, 0)),
+        )
+        assert out.shape == (ph, pw, c), (out.shape, ph, pw, c)
+        return out

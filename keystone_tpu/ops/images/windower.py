@@ -1,0 +1,34 @@
+"""Windower: flat-map of all (stride, window_size) patches of each image.
+
+Reference: ``nodes/images/Windower.scala:13-56`` (an ``RDD[Image] =>
+RDD[Image]`` FunctionNode). Batch shape (N, H, W, C) ->
+(N·ny·nx, ws, ws, C) via ``conv_general_dilated_patches`` — one XLA op, no
+python loop over windows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import FunctionNode
+
+
+class Windower(FunctionNode):
+    stride: int = struct.field(pytree_node=False)
+    window_size: int = struct.field(pytree_node=False)
+
+    def apply_batch(self, imgs):
+        n, h, w, c = imgs.shape
+        ws = self.window_size
+        patches = jax.lax.conv_general_dilated_patches(
+            imgs,
+            filter_shape=(ws, ws),
+            window_strides=(self.stride, self.stride),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # (N, ny, nx, C*ws*ws) with feature axis ordered (C, wy, wx)
+        ny, nx = patches.shape[1], patches.shape[2]
+        patches = patches.reshape(n * ny * nx, c, ws, ws)
+        return patches.transpose(0, 2, 3, 1)  # back to (windows, ws, ws, C)

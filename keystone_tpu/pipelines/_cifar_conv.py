@@ -1,0 +1,95 @@
+"""Shared body of the conv-featurized CIFAR pipelines (RandomCifar /
+RandomPatchCifar): Convolver → SymmetricRectifier → Pooler(sum) → vectorize →
+StandardScaler, then a linear solve and argmax evaluation."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import chain
+from keystone_tpu.learning import ZCAWhitener, ZCAWhitenerEstimator
+from keystone_tpu.loaders.cifar import CIFAR_NUM_CLASSES
+from keystone_tpu.ops.images import (
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    SymmetricRectifier,
+    Windower,
+)
+from keystone_tpu.ops.stats import StandardScaler
+from keystone_tpu.pipelines._common import error_percent, prepare_labeled
+from keystone_tpu.utils.stats import normalize_rows
+
+
+def learn_patch_filters(
+    imgs: np.ndarray,
+    patch_size: int,
+    patch_steps: int,
+    num_filters: int,
+    whitener_size: int = 100000,
+    seed: int = 42,
+):
+    """RandomPatchCifar's filter construction
+    (``pipelines/images/cifar/RandomPatchCifar.scala:37-51``): sample patches,
+    ZCA-whiten, L2-normalize in whitened space, rotate back through Wᵀ."""
+    windows_per_img = ((imgs.shape[1] - patch_size) // patch_steps + 1) ** 2
+    need_imgs = min(imgs.shape[0], -(-2 * whitener_size // windows_per_img))
+    windows = Windower(stride=patch_steps, window_size=patch_size)(
+        jnp.asarray(imgs[:need_imgs])
+    )
+    patches = np.asarray(windows).reshape(windows.shape[0], -1)
+    rng = np.random.default_rng(seed)
+    take = min(whitener_size, patches.shape[0])
+    patches = patches[rng.choice(patches.shape[0], take, replace=False)]
+
+    base = np.asarray(normalize_rows(jnp.asarray(patches), 10.0))
+    whitener = ZCAWhitenerEstimator().fit_single(jnp.asarray(base))
+    sample = base[rng.choice(base.shape[0], num_filters, replace=False)]
+    unnorm = np.asarray(whitener(jnp.asarray(sample)))
+    norms = np.sqrt((unnorm**2).sum(axis=1))
+    filters = (unnorm / (norms + 1e-10)[:, None]) @ np.asarray(whitener.whitener).T
+    return jnp.asarray(filters, jnp.float32), whitener
+
+
+def conv_featurizer(
+    filters: jax.Array,
+    whitener: Optional[ZCAWhitener],
+    alpha: float,
+    pool_stride: int,
+    pool_size: int,
+):
+    return chain(
+        Convolver(filters=filters, whitener=whitener, num_channels=3),
+        SymmetricRectifier(alpha=alpha),
+        Pooler(stride=pool_stride, pool_size=pool_size, pool="sum"),
+        ImageVectorizer(),
+    )
+
+
+def fit_and_eval(featurizer, solver_fit, train, test) -> dict:
+    """Featurize → fit scaler → solve → train/test error percent.
+
+    The conv featurizer runs exactly once over train (scaler fit, solver, and
+    train error all reuse the materialized features) and once over test.
+    """
+    train_ds, train_y, indicators = prepare_labeled(*train, CIFAR_NUM_CLASSES)
+    raw_feats = featurizer(train_ds)
+    scaler = StandardScaler().fit(raw_feats)
+    feats = scaler(raw_feats)
+    model = solver_fit(feats.data, indicators, feats.mask)
+
+    results = {
+        "train_error": error_percent(
+            model(feats.data), train_y, train_ds.mask, CIFAR_NUM_CLASSES
+        )
+    }
+    predict = featurizer >> scaler >> model
+    test_ds, test_y, _ = prepare_labeled(*test, CIFAR_NUM_CLASSES)
+    results["test_error"] = error_percent(
+        predict(test_ds).data, test_y, test_ds.mask, CIFAR_NUM_CLASSES
+    )
+    return results

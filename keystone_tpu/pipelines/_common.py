@@ -1,0 +1,28 @@
+"""Shared pipeline scaffolding: the load→distribute→labels→evaluate skeleton
+every app repeats (the analog of the reference's per-app boilerplate,
+SURVEY.md §2.11)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from keystone_tpu.core.dataset import Dataset
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels, MaxClassifier
+from keystone_tpu.parallel import distribute
+
+
+def prepare_labeled(x, y, num_classes: int):
+    """Distribute (pad+shard) data and labels; returns
+    (data Dataset, sharded int labels, ±1 indicator matrix)."""
+    ds = distribute(jnp.asarray(x))
+    y_sharded = distribute(jnp.asarray(y)).data
+    indicators = ClassLabelIndicatorsFromIntLabels(num_classes)(y_sharded)
+    return ds, y_sharded, indicators
+
+
+def error_percent(scores, actuals, mask, num_classes: int) -> float:
+    """argmax → masked multiclass error, in percent."""
+    preds = MaxClassifier()(scores)
+    metrics = MulticlassClassifierEvaluator(num_classes)(preds, actuals, mask)
+    return 100.0 * metrics.total_error

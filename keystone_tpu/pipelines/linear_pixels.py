@@ -1,0 +1,66 @@
+"""LinearPixels: CIFAR grayscale → vectorize → OLS.
+
+Reference: ``pipelines/images/cifar/LinearPixels.scala:14-78``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax.numpy as jnp
+
+from keystone_tpu.core.config import parse_config
+from keystone_tpu.core.pipeline import chain
+from keystone_tpu.learning import LinearMapEstimator
+from keystone_tpu.loaders.cifar import CIFAR_NUM_CLASSES, load_cifar_binary, synthetic_cifar
+from keystone_tpu.ops.images import GrayScaler, ImageVectorizer
+from keystone_tpu.pipelines._common import error_percent, prepare_labeled
+from keystone_tpu.parallel import get_mesh, use_mesh
+from keystone_tpu.utils import Timer, get_logger
+
+logger = get_logger("keystone_tpu.pipelines.linear_pixels")
+
+
+@dataclasses.dataclass
+class LinearPixelsConfig:
+    train_location: str = ""
+    test_location: str = ""
+    synthetic_train: int = 10000
+    synthetic_test: int = 2000
+
+
+def run(config: LinearPixelsConfig) -> dict:
+    if config.train_location:
+        train = load_cifar_binary(config.train_location)
+        test = load_cifar_binary(config.test_location)
+    else:
+        train = synthetic_cifar(config.synthetic_train, seed=1)
+        test = synthetic_cifar(config.synthetic_test, seed=2)
+
+    results: dict = {}
+    with use_mesh(get_mesh()), Timer("LinearPixels.pipeline") as total:
+        featurizer = chain(GrayScaler(), ImageVectorizer())
+        train_ds, train_y, indicators = prepare_labeled(*train, CIFAR_NUM_CLASSES)
+        feats = featurizer(train_ds)
+        model = LinearMapEstimator().fit(feats.data, indicators, mask=feats.mask)
+        predict = featurizer >> model
+
+        results["train_error"] = error_percent(
+            predict(train_ds).data, train_y, train_ds.mask, CIFAR_NUM_CLASSES
+        )
+        test_ds, test_y, _ = prepare_labeled(*test, CIFAR_NUM_CLASSES)
+        results["test_error"] = error_percent(
+            predict(test_ds).data, test_y, test_ds.mask, CIFAR_NUM_CLASSES
+        )
+    results["wallclock_s"] = total.elapsed
+    logger.info("Training error: %.2f%%  Test error: %.2f%%", results["train_error"], results["test_error"])
+    return results
+
+
+def main(argv=None):
+    print(json.dumps(run(parse_config(LinearPixelsConfig, argv, prog="LinearPixels"))))
+
+
+if __name__ == "__main__":
+    main()

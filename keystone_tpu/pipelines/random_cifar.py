@@ -1,0 +1,77 @@
+"""RandomCifar: random gaussian conv filters → rectify → pool → OLS.
+
+Reference: ``pipelines/images/cifar/RandomCifar.scala:16-109``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.config import parse_config
+from keystone_tpu.learning import LinearMapEstimator
+from keystone_tpu.loaders.cifar import load_cifar_binary, synthetic_cifar
+from keystone_tpu.pipelines._cifar_conv import conv_featurizer, fit_and_eval
+from keystone_tpu.parallel import get_mesh, use_mesh
+from keystone_tpu.utils import Timer, get_logger
+
+logger = get_logger("keystone_tpu.pipelines.random_cifar")
+
+
+@dataclasses.dataclass
+class RandomCifarConfig:
+    train_location: str = ""
+    test_location: str = ""
+    num_filters: int = 100
+    patch_size: int = 6
+    pool_size: int = 14
+    pool_stride: int = 13
+    alpha: float = 0.25
+    lam: float = 0.0
+    seed: int = 0
+    synthetic_train: int = 10000
+    synthetic_test: int = 2000
+
+
+def run(config: RandomCifarConfig) -> dict:
+    if config.train_location:
+        train = load_cifar_binary(config.train_location)
+        test = load_cifar_binary(config.test_location)
+    else:
+        train = synthetic_cifar(config.synthetic_train, seed=1)
+        test = synthetic_cifar(config.synthetic_test, seed=2)
+
+    with use_mesh(get_mesh()), Timer("RandomCifar.pipeline") as total:
+        filters = jax.random.normal(
+            jax.random.key(config.seed),
+            (config.num_filters, config.patch_size**2 * 3),
+            jnp.float32,
+        )
+        featurizer = conv_featurizer(
+            filters, None, config.alpha, config.pool_stride, config.pool_size
+        )
+        solver = LinearMapEstimator(lam=config.lam or None)
+        results = fit_and_eval(
+            featurizer,
+            lambda a, b, m: solver.fit(a, b, mask=m),
+            train,
+            test,
+        )
+    results["wallclock_s"] = total.elapsed
+    logger.info(
+        "Training error: %.2f%%  Test error: %.2f%%",
+        results["train_error"],
+        results["test_error"],
+    )
+    return results
+
+
+def main(argv=None):
+    print(json.dumps(run(parse_config(RandomCifarConfig, argv, prog="RandomCifar"))))
+
+
+if __name__ == "__main__":
+    main()

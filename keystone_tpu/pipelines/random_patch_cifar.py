@@ -1,0 +1,88 @@
+"""RandomPatchCifar: whitened random-patch filters → conv → rectify → pool →
+block least squares.
+
+Reference: ``pipelines/images/cifar/RandomPatchCifar.scala:16-127``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from keystone_tpu.core.config import parse_config
+from keystone_tpu.learning import BlockLeastSquaresEstimator
+from keystone_tpu.loaders.cifar import load_cifar_binary, synthetic_cifar
+from keystone_tpu.pipelines._cifar_conv import (
+    conv_featurizer,
+    fit_and_eval,
+    learn_patch_filters,
+)
+from keystone_tpu.parallel import get_mesh, use_mesh
+from keystone_tpu.utils import Timer, get_logger
+
+logger = get_logger("keystone_tpu.pipelines.random_patch_cifar")
+
+
+@dataclasses.dataclass
+class RandomPatchCifarConfig:
+    train_location: str = ""
+    test_location: str = ""
+    num_filters: int = 100
+    patch_size: int = 6
+    patch_steps: int = 1
+    pool_size: int = 14
+    pool_stride: int = 13
+    alpha: float = 0.25
+    lam: float = 10.0
+    block_size: int = 4096
+    whitener_size: int = 100000
+    seed: int = 0
+    synthetic_train: int = 10000
+    synthetic_test: int = 2000
+
+
+def run(config: RandomPatchCifarConfig) -> dict:
+    if config.train_location:
+        train = load_cifar_binary(config.train_location)
+        test = load_cifar_binary(config.test_location)
+    else:
+        train = synthetic_cifar(config.synthetic_train, seed=1)
+        test = synthetic_cifar(config.synthetic_test, seed=2)
+
+    with use_mesh(get_mesh()), Timer("RandomPatchCifar.pipeline") as total:
+        with Timer("learn_patch_filters"):
+            filters, whitener = learn_patch_filters(
+                train[0],
+                config.patch_size,
+                config.patch_steps,
+                config.num_filters,
+                config.whitener_size,
+                config.seed,
+            )
+        featurizer = conv_featurizer(
+            filters, whitener, config.alpha, config.pool_stride, config.pool_size
+        )
+        est = BlockLeastSquaresEstimator(config.block_size, 1, config.lam)
+        results = fit_and_eval(
+            featurizer,
+            lambda a, b, m: est.fit(a, b, mask=m),
+            train,
+            test,
+        )
+    results["wallclock_s"] = total.elapsed
+    logger.info(
+        "Training error: %.2f%%  Test error: %.2f%%",
+        results["train_error"],
+        results["test_error"],
+    )
+    return results
+
+
+def main(argv=None):
+    print(
+        json.dumps(run(parse_config(RandomPatchCifarConfig, argv, prog="RandomPatchCifar")))
+    )
+
+
+if __name__ == "__main__":
+    main()

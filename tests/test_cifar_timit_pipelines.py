@@ -1,0 +1,75 @@
+"""End-to-end mini runs of the CIFAR and TIMIT pipelines on the CPU mesh."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.loaders.cifar import load_cifar_binary, synthetic_cifar
+from keystone_tpu.pipelines.linear_pixels import LinearPixelsConfig
+from keystone_tpu.pipelines.linear_pixels import run as run_linear_pixels
+from keystone_tpu.pipelines.random_cifar import RandomCifarConfig
+from keystone_tpu.pipelines.random_cifar import run as run_random_cifar
+from keystone_tpu.pipelines.random_patch_cifar import RandomPatchCifarConfig
+from keystone_tpu.pipelines.random_patch_cifar import run as run_random_patch
+from keystone_tpu.pipelines.timit import TimitConfig
+from keystone_tpu.pipelines.timit import run as run_timit
+
+
+def test_cifar_binary_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 5
+    records = np.zeros((n, 3073), np.uint8)
+    records[:, 0] = np.arange(n)
+    records[:, 1:] = rng.integers(0, 256, size=(n, 3072))
+    p = tmp_path / "batch.bin"
+    p.write_bytes(records.tobytes())
+    imgs, labels = load_cifar_binary(str(p))
+    assert imgs.shape == (5, 32, 32, 3)
+    assert labels.tolist() == [0, 1, 2, 3, 4]
+    # channel planes: record layout R plane then G then B, row-major
+    assert imgs[0, 0, 0, 0] == float(records[0, 1])  # R(0,0)
+    assert imgs[0, 0, 0, 1] == float(records[0, 1 + 1024])  # G(0,0)
+    assert imgs[0, 0, 1, 0] == float(records[0, 2])  # R(0,1)
+
+
+def test_linear_pixels_end_to_end():
+    res = run_linear_pixels(
+        LinearPixelsConfig(synthetic_train=800, synthetic_test=200)
+    )
+    assert res["test_error"] < 30.0  # synthetic prototypes are separable
+
+
+def test_random_cifar_end_to_end():
+    res = run_random_cifar(
+        RandomCifarConfig(
+            num_filters=16, synthetic_train=400, synthetic_test=120, lam=10.0
+        )
+    )
+    assert res["test_error"] < 25.0
+
+
+def test_random_patch_cifar_end_to_end():
+    res = run_random_patch(
+        RandomPatchCifarConfig(
+            num_filters=16,
+            whitener_size=2000,
+            synthetic_train=400,
+            synthetic_test=120,
+            lam=10.0,
+        )
+    )
+    assert res["test_error"] < 25.0
+
+
+def test_timit_end_to_end_streaming():
+    res = run_timit(
+        TimitConfig(
+            num_cosines=3,
+            num_cosine_features=256,
+            num_epochs=2,
+            lam=10.0,
+            gamma=0.02,  # bandwidth matched to the synthetic prototype task
+            synthetic_train=3000,
+            synthetic_test=400,
+        )
+    )
+    assert res["test_error"] < 15.0

@@ -1,0 +1,159 @@
+"""Image node tests: naive im2col implementations of the reference semantics
+(Convolver.scala makePatches, Pooler.scala, Windower.scala) vs the XLA ops."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.learning import ZCAWhitenerEstimator
+from keystone_tpu.ops.images import (
+    Convolver,
+    GrayScaler,
+    ImageVectorizer,
+    PixelScaler,
+    Pooler,
+    SymmetricRectifier,
+    Windower,
+)
+
+
+def naive_patches(img, k):
+    """All k×k patches in the reference layout: rows indexed (x + y*resW),
+    patch vector ordered (poy, pox, chan) channel-fastest.
+    With our (H=y, W=x, C) arrays: row index = x + y*resW, vector =
+    img[y+poy, x+pox, c] flattened (poy, pox, c)."""
+    h, w, c = img.shape
+    rh, rw = h - k + 1, w - k + 1
+    rows = np.zeros((rh * rw, k * k * c), np.float64)
+    for y in range(rh):
+        for x in range(rw):
+            rows[x + y * rw] = img[y : y + k, x : x + k, :].reshape(-1)
+    return rows, rh, rw
+
+
+def naive_normalize_rows(mat, alpha):
+    mu = mat.mean(axis=1, keepdims=True)
+    var = ((mat - mu) ** 2).sum(axis=1, keepdims=True) / (mat.shape[1] - 1)
+    return (mat - mu) / np.sqrt(var + alpha)
+
+
+@pytest.mark.parametrize("normalize", [False, True])
+def test_convolver_matches_naive_im2col(rng, normalize):
+    img = rng.normal(size=(8, 8, 3)).astype(np.float32)
+    filters = rng.normal(size=(5, 4 * 4 * 3)).astype(np.float32)
+    conv = Convolver(
+        filters=jnp.asarray(filters),
+        num_channels=3,
+        normalize_patches=normalize,
+        var_constant=10.0,
+    )
+    out = np.asarray(conv.serve(jnp.asarray(img)))  # (resH, resW, nF)
+    patches, rh, rw = naive_patches(img.astype(np.float64), 4)
+    if normalize:
+        patches = naive_normalize_rows(patches, 10.0)
+    expected = patches @ filters.astype(np.float64).T  # (rh*rw, nF)
+    # our (resH, resW) layout: row index x + y*rw
+    got = out.reshape(rh * rw, -1)
+    np.testing.assert_allclose(got, expected, atol=1e-3)
+
+
+def test_convolver_whitener_mean_subtraction(rng):
+    img = rng.normal(size=(6, 6, 2)).astype(np.float32)
+    filters = rng.normal(size=(3, 3 * 3 * 2)).astype(np.float32)
+    sample = rng.normal(size=(50, 18)).astype(np.float32)
+    whitener = ZCAWhitenerEstimator(eps=0.1).fit_single(jnp.asarray(sample))
+    conv = Convolver(
+        filters=jnp.asarray(filters),
+        whitener=whitener,
+        num_channels=2,
+        normalize_patches=True,
+    )
+    out = np.asarray(conv.serve(jnp.asarray(img)))
+    patches, rh, rw = naive_patches(img.astype(np.float64), 3)
+    patches = naive_normalize_rows(patches, 10.0)
+    patches = patches - np.asarray(whitener.means)
+    expected = (patches @ filters.astype(np.float64).T).reshape(rh, rw, -1)
+    np.testing.assert_allclose(out, expected, atol=1e-3)
+
+
+def test_convolver_batch_matches_single(rng):
+    imgs = rng.normal(size=(4, 8, 8, 3)).astype(np.float32)
+    filters = rng.normal(size=(2, 2 * 2 * 3)).astype(np.float32)
+    conv = Convolver(filters=jnp.asarray(filters), num_channels=3)
+    batch = np.asarray(conv(jnp.asarray(imgs)))
+    single = np.asarray(conv.serve(jnp.asarray(imgs[1])))
+    np.testing.assert_allclose(batch[1], single, atol=1e-5)
+
+
+def test_pooler_sum_hand_computed():
+    """4×4 image, poolSize=2, stride=2 -> strideStart=1, pools at 1,3 clamped.
+    Reference PoolingSuite.scala:11-30 analog."""
+    img = jnp.arange(16.0).reshape(4, 4, 1)
+    out = np.asarray(Pooler(stride=2, pool_size=2, pool="sum").serve(img))
+    # pools: windows starting at 0 and 2 (stride 2, pad right 0): [0:2], [2:4]
+    expected = np.array(
+        [
+            [img[0:2, 0:2, 0].sum(), img[0:2, 2:4, 0].sum()],
+            [img[2:4, 0:2, 0].sum(), img[2:4, 2:4, 0].sum()],
+        ]
+    )
+    np.testing.assert_allclose(out[:, :, 0], expected)
+
+
+def test_pooler_clamped_edge_window():
+    """27×27 (CIFAR post-conv), poolSize=14, stride=13: 2 pools per dim, the
+    second window [13:27) is clamped — matches reference geometry."""
+    img = jnp.ones((27, 27, 2))
+    out = np.asarray(Pooler(stride=13, pool_size=14, pool="sum").serve(img))
+    assert out.shape == (2, 2, 2)
+    np.testing.assert_allclose(out[0, 0], 14 * 14)
+    np.testing.assert_allclose(out[1, 1], 14 * 14)  # pad contributes 0 to sum
+
+
+def test_pooler_max_with_pixel_function():
+    img = jnp.array([[-5.0, 2.0], [3.0, -1.0]]).reshape(2, 2, 1)
+    out = Pooler(stride=1, pool_size=2, pixel_function=jnp.abs, pool="max").serve(img)
+    assert float(out[0, 0, 0]) == 5.0
+
+
+def test_windower_matches_naive(rng):
+    imgs = rng.normal(size=(2, 5, 5, 3)).astype(np.float32)
+    w = Windower(stride=2, window_size=3)
+    out = np.asarray(w(jnp.asarray(imgs)))
+    assert out.shape == (2 * 2 * 2, 3, 3, 3)
+    # first image, window (y=0,x=0); ordering row-major over (ny, nx)
+    np.testing.assert_allclose(out[0], imgs[0, 0:3, 0:3, :])
+    np.testing.assert_allclose(out[1], imgs[0, 0:3, 2:5, :])
+    np.testing.assert_allclose(out[4], imgs[1, 0:3, 0:3, :])
+
+
+def test_symmetric_rectifier_doubles_channels():
+    img = jnp.array([[[1.0, -2.0]]])
+    out = np.asarray(SymmetricRectifier(alpha=0.25).serve(img))
+    np.testing.assert_allclose(out, [[[0.75, 0.0, 0.0, 1.75]]])
+
+
+def test_grayscaler_ntsc():
+    img = jnp.array([[[1.0, 0.5, 0.25]]])  # R, G, B
+    out = float(GrayScaler().serve(img)[0, 0, 0])
+    assert abs(out - (0.2989 * 1.0 + 0.587 * 0.5 + 0.114 * 0.25)) < 1e-6
+    out_bgr = float(GrayScaler(channel_order="bgr").serve(img)[0, 0, 0])
+    assert abs(out_bgr - (0.114 * 1.0 + 0.587 * 0.5 + 0.2989 * 0.25)) < 1e-6
+
+
+def test_pixel_scaler_and_vectorizer():
+    img = jnp.full((2, 2, 3), 255.0)
+    assert float(PixelScaler().serve(img).max()) == 1.0
+    v = ImageVectorizer().serve(img)
+    assert v.shape == (12,)
+
+
+def test_zca_whitened_covariance_is_identity(rng):
+    """Reference ZCAWhiteningSuite.scala:16-33: whitened covariance ≈ I."""
+    x = rng.normal(size=(500, 8)).astype(np.float32) @ rng.normal(
+        size=(8, 8)
+    ).astype(np.float32)
+    zca = ZCAWhitenerEstimator(eps=1e-6).fit_single(jnp.asarray(x))
+    white = np.asarray(zca(jnp.asarray(x)))
+    cov = white.T @ white / (x.shape[0] - 1)
+    np.testing.assert_allclose(cov, np.eye(8), atol=5e-2)
