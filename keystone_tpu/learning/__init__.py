@@ -4,3 +4,12 @@ from keystone_tpu.learning.block_linear import (
     BlockLeastSquaresEstimator,
 )
 from keystone_tpu.learning.zca import ZCAWhitener, ZCAWhitenerEstimator
+from keystone_tpu.learning.pca import (
+    PCAEstimator,
+    PCATransformer,
+    BatchPCATransformer,
+)
+from keystone_tpu.learning.gmm import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+)

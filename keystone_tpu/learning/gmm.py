@@ -1,0 +1,131 @@
+"""Diagonal-covariance Gaussian Mixture Model, fitted with EM on device.
+
+Reference: ``nodes/learning/GaussianMixtureModel.scala:18-90`` delegates to
+the C++ enceval EM (``src/main/cpp/EncEval.cxx:122-180``: ``random_init``
+with seed 42 then ``em()``); the model is means/variances/weights with
+diagonal covariance, loadable from CSVs.
+
+TPU design: the E-step (responsibilities) and M-step (weighted moments) are
+data-parallel reductions over the row-sharded sample — per-shard partial
+sums + ICI all-reduce, exactly the psum pattern SURVEY.md §2.8 prescribes.
+The whole EM loop is one ``lax.fori_loop`` inside a single jitted program.
+We reproduce the reference's *invariants* (planted-mixture recovery), not
+the C library's bitwise behavior.
+
+Layout note: the reference stores means/variances as (dim, k) Breeze
+matrices (column = center); here they are (k, dim) row-major — transpose
+when loading reference CSVs (``GaussianMixtureModel.load``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.dataset import Dataset
+from keystone_tpu.core.pipeline import Estimator, Transformer
+
+_VAR_FLOOR = 1e-4
+
+
+class GaussianMixtureModel(Transformer):
+    means: jax.Array  # (k, d)
+    variances: jax.Array  # (k, d)
+    weights: jax.Array  # (k,)
+
+    @property
+    def k(self) -> int:
+        return self.means.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[1]
+
+    def log_likelihoods(self, x):
+        """(n, d) -> (n, k) per-component weighted log densities."""
+        x = x[:, None, :]  # (n, 1, d)
+        inv_var = 1.0 / self.variances[None]
+        log_det = jnp.sum(jnp.log(self.variances), axis=1)  # (k,)
+        mahal = jnp.sum((x - self.means[None]) ** 2 * inv_var, axis=2)
+        d = self.means.shape[1]
+        log_norm = -0.5 * (d * jnp.log(2.0 * jnp.pi) + log_det)
+        return jnp.log(self.weights)[None] + log_norm[None] - 0.5 * mahal
+
+    def apply(self, x):
+        """Soft assignments (posterior responsibilities) for one point.
+
+        (The reference leaves the single-item path unimplemented,
+        ``GaussianMixtureModel.scala:35``; posteriors are the natural
+        completion.)
+        """
+        ll = self.log_likelihoods(x[None, :])
+        return jax.nn.softmax(ll, axis=1)[0]
+
+    def apply_batch(self, xs):
+        return jax.nn.softmax(self.log_likelihoods(xs), axis=1)
+
+    @staticmethod
+    def load(mean_file: str, vars_file: str, weights_file: str) -> "GaussianMixtureModel":
+        """Load from reference-format CSVs ((dim, k) matrices
+        — ``GaussianMixtureModel.scala:83-90``)."""
+        means = np.loadtxt(mean_file, delimiter=",", ndmin=2).T
+        variances = np.loadtxt(vars_file, delimiter=",", ndmin=2).T
+        weights = np.loadtxt(weights_file, delimiter=",").reshape(-1)
+        return GaussianMixtureModel(
+            means=jnp.asarray(means, jnp.float32),
+            variances=jnp.asarray(variances, jnp.float32),
+            weights=jnp.asarray(weights, jnp.float32),
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("k", "num_iter"))
+def _fit_em(x, mask, key, k: int, num_iter: int):
+    n, d = x.shape
+    weights_row = jnp.ones((n,), jnp.float32) if mask is None else mask
+    total = jnp.sum(weights_row)
+
+    # init (seeded, like enceval's random_init(seed=42)): k distinct samples
+    # as means, global variance, uniform weights
+    idx = jax.random.choice(key, n, (k,), replace=False, p=weights_row / total)
+    means0 = x[idx]
+    gmean = jnp.sum(x * weights_row[:, None], axis=0) / total
+    gvar = jnp.sum((x - gmean) ** 2 * weights_row[:, None], axis=0) / total
+    model0 = (means0, jnp.tile(gvar, (k, 1)) + _VAR_FLOOR, jnp.full((k,), 1.0 / k))
+
+    def em_step(_, model):
+        means, variances, weights = model
+        gmm = GaussianMixtureModel(means=means, variances=variances, weights=weights)
+        # E-step
+        resp = jax.nn.softmax(gmm.log_likelihoods(x), axis=1)  # (n, k)
+        resp = resp * weights_row[:, None]
+        # M-step (each reduce is a sharded-row sum -> psum over ICI)
+        nk = jnp.sum(resp, axis=0) + 1e-10  # (k,)
+        new_means = (resp.T @ x) / nk[:, None]
+        ex2 = (resp.T @ (x * x)) / nk[:, None]
+        new_vars = jnp.maximum(ex2 - new_means**2, _VAR_FLOOR)
+        return new_means, new_vars, nk / total
+
+    means, variances, weights = jax.lax.fori_loop(0, num_iter, em_step, model0)
+    return means, variances, weights
+
+
+class GaussianMixtureModelEstimator(Estimator):
+    """EM with seeded init. Reference: ``GaussianMixtureModel.scala:42-79``."""
+
+    def __init__(self, k: int, num_iter: int = 25, seed: int = 42):
+        self.k = k
+        self.num_iter = num_iter
+        self.seed = seed
+
+    def fit(self, data, mask: Optional[jax.Array] = None) -> GaussianMixtureModel:
+        if isinstance(data, Dataset):
+            data, mask = data.data, data.mask if mask is None else mask
+        data = jnp.asarray(data, jnp.float32)
+        means, variances, weights = _fit_em(
+            data, mask, jax.random.key(self.seed), self.k, self.num_iter
+        )
+        return GaussianMixtureModel(means=means, variances=variances, weights=weights)

@@ -1,0 +1,116 @@
+"""PCA dimensionality reduction.
+
+Reference: ``nodes/learning/PCA.scala:16-106`` — collects a sample to the
+driver, mean-centers, LAPACK ``sgesvd``, matlab-style sign convention
+(largest-|entry| of each component positive), first ``dims`` columns.
+
+TPU design: two fit paths.
+
+- ``svd``: exact SVD of the centered sample on device (the reference path).
+- ``gram``: distributed — the (d, d) covariance is one sharded matmul (the
+  row contraction all-reduces over ICI), then a replicated ``eigh``. This is
+  the path for O(1e7)-row samples that never fit on one host (the reference
+  would have to collect them).
+
+Both transformers keep the reference orientation: ``pca_mat`` is (d, dims)
+and ``apply`` computes ``pca_matᵀ · x``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.dataset import Dataset
+from keystone_tpu.core.pipeline import Estimator, Transformer
+from keystone_tpu.linalg.solvers import hdot
+
+
+class PCATransformer(Transformer):
+    """``x -> pca_matᵀ x`` (``PCA.scala:24-26``)."""
+
+    pca_mat: jax.Array  # (d, dims)
+
+    def apply(self, x):
+        return x @ self.pca_mat
+
+    apply_batch = apply
+
+
+class BatchPCATransformer(Transformer):
+    """Per-item descriptor-matrix projection (``PCA.scala:36-39``): each item
+    is an (n_desc, d) matrix -> (n_desc, dims)."""
+
+    pca_mat: jax.Array
+
+    def apply(self, mat):
+        return mat @ self.pca_mat
+
+    apply_batch = apply
+
+
+def _matlab_sign_convention(v):
+    """Largest-|entry| of each column nonnegative (``PCA.scala:94-101``)."""
+    idx = jnp.argmax(jnp.abs(v), axis=0)
+    signs = jnp.sign(v[idx, jnp.arange(v.shape[1])])
+    return v * jnp.where(signs == 0, 1.0, signs)[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("dims",))
+def _pca_svd(x, mask, dims: int):
+    if mask is not None:
+        n = jnp.sum(mask)
+        mean = jnp.sum(x * mask[:, None], axis=0) / n
+        centered = (x - mean) * mask[:, None]
+    else:
+        mean = jnp.mean(x, axis=0)
+        centered = x - mean
+    _, _, vt = jnp.linalg.svd(centered, full_matrices=False)
+    return _matlab_sign_convention(vt.T)[:, :dims]
+
+
+@functools.partial(jax.jit, static_argnames=("dims",))
+def _pca_gram(x, mask, dims: int):
+    if mask is not None:
+        n = jnp.sum(mask)
+        mean = jnp.sum(x * mask[:, None], axis=0) / n
+        centered = (x - mean) * mask[:, None]
+    else:
+        mean = jnp.mean(x, axis=0)
+        centered = x - mean
+    cov = hdot(centered.T, centered)  # sharded rows -> ICI all-reduce
+    _, v = jnp.linalg.eigh(cov)  # ascending eigenvalues
+    v = v[:, ::-1]
+    return _matlab_sign_convention(v)[:, :dims]
+
+
+class PCAEstimator(Estimator):
+    """``method``: "svd" (exact, reference path), "gram" (distributed
+    covariance + eigh), or "auto" (gram when rows ≥ 4·cols)."""
+
+    def __init__(self, dims: int, method: str = "auto"):
+        self.dims = dims
+        self.method = method
+
+    def compute_pca(self, x, mask=None) -> jax.Array:
+        x = jnp.asarray(x, jnp.float32)
+        method = self.method
+        if method == "auto":
+            method = "gram" if x.shape[0] >= 4 * x.shape[1] else "svd"
+        if method == "svd":
+            return _pca_svd(x, mask, self.dims)
+        if method == "gram":
+            return _pca_gram(x, mask, self.dims)
+        raise ValueError(f"unknown method {self.method!r}")
+
+    def fit(self, data, mask=None) -> PCATransformer:
+        if isinstance(data, Dataset):
+            data, mask = data.data, data.mask if mask is None else mask
+        return PCATransformer(pca_mat=self.compute_pca(data, mask))
+
+    def fit_batch(self, data, mask=None) -> BatchPCATransformer:
+        if isinstance(data, Dataset):
+            data, mask = data.data, data.mask if mask is None else mask
+        return BatchPCATransformer(pca_mat=self.compute_pca(data, mask))

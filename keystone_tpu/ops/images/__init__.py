@@ -7,3 +7,8 @@ from keystone_tpu.ops.images.nodes import (
 from keystone_tpu.ops.images.convolver import Convolver
 from keystone_tpu.ops.images.pooler import Pooler
 from keystone_tpu.ops.images.windower import Windower
+from keystone_tpu.ops.images.fisher_vector import FisherVector
+from keystone_tpu.ops.images.sift import SIFTExtractor
+from keystone_tpu.ops.images.lcs import LCSExtractor
+from keystone_tpu.ops.images.hog import HogExtractor
+from keystone_tpu.ops.images.daisy import DaisyExtractor

@@ -1,0 +1,118 @@
+"""DAISY descriptors.
+
+Reference: ``nodes/images/DaisyExtractor.scala:28-201`` — gradients via
+``conv2D`` with [1,0,-1]/[1,2,1] (``:110-111``), H=8 oriented half-rectified
+gradient maps, Q=3 layers of cumulative Gaussian blurs with
+σ²-differences derived from the ring radii (``:116-135``), per-keypoint
+histograms read at ring offsets (radius (l+1)·R/Q, angle 2π(t−1)/T) and
+L2-normalized with a zero threshold (``:152-200``). Feature size
+H·(T·Q+1) = 200 with the reference's exact layout (center block first).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.ops.images.lcs import conv2d_same
+
+_FEATURE_THRESHOLD = 1e-8
+_CONV_THRESHOLD = 1e-6
+
+
+def _daisy_gaussians(daisy_q: int, daisy_r: int) -> List[np.ndarray]:
+    """The reference's unnormalized incremental Gaussian kernels
+    (``DaisyExtractor.scala:50-63``)."""
+    sigma_sq = [(daisy_r * n / (2.0 * daisy_q)) ** 2 for n in range(daisy_q + 1)]
+    diffs = [b - a for a, b in zip(sigma_sq, sigma_sq[1:])]
+    kernels = []
+    for t in diffs:
+        radius = int(
+            math.ceil(math.sqrt(-2 * t * math.log(_CONV_THRESHOLD) - t * math.log(2 * math.pi * t)))
+        )
+        n = np.arange(-radius, radius + 1, dtype=np.float64)
+        kernels.append(
+            (np.exp(-(n**2) / (2 * t)) / math.sqrt(2 * math.pi * t)).astype(np.float32)
+        )
+    return kernels
+
+
+class DaisyExtractor(Transformer):
+    daisy_t: int = struct.field(pytree_node=False, default=8)
+    daisy_q: int = struct.field(pytree_node=False, default=3)
+    daisy_r: int = struct.field(pytree_node=False, default=7)
+    daisy_h: int = struct.field(pytree_node=False, default=8)
+    pixel_border: int = struct.field(pytree_node=False, default=16)
+    stride: int = struct.field(pytree_node=False, default=4)
+    patch_size: int = struct.field(pytree_node=False, default=24)
+
+    @property
+    def feature_size(self) -> int:
+        return self.daisy_h * (self.daisy_t * self.daisy_q + 1)
+
+    def apply(self, img):
+        """(H, W) or (H, W, 1) grayscale -> (num_keypoints, H·(T·Q+1))."""
+        if img.ndim == 3:
+            img = img[..., 0]
+        h, w = img.shape
+        T, Q, R, H = self.daisy_t, self.daisy_q, self.daisy_r, self.daisy_h
+
+        f1 = np.array([1.0, 0.0, -1.0], np.float32)
+        f2 = np.array([1.0, 2.0, 1.0], np.float32)
+        # ref: ix = conv2D(in, f1, f2) — ref xFilter runs along ref-x, which
+        # is our axis 0, i.e. conv2d_same's y_filter slot
+        ix = conv2d_same(img, f2, f1)
+        iy = conv2d_same(img, f1, f2)
+
+        angles = 2.0 * jnp.pi * jnp.arange(H) / H
+        oriented = jnp.maximum(
+            jnp.cos(angles)[:, None, None] * ix + jnp.sin(angles)[:, None, None] * iy,
+            0.0,
+        )  # (H, h, w)
+
+        kernels = _daisy_gaussians(Q, R)
+        layers = []
+        cur = oriented
+        for q in range(Q):
+            cur = conv2d_same(cur, kernels[q], kernels[q])
+            layers.append(cur)  # cumulative blurs
+
+        kys = jnp.arange(self.pixel_border, h - self.pixel_border, self.stride)
+        kxs = jnp.arange(self.pixel_border, w - self.pixel_border, self.stride)
+        ny, nx = kys.shape[0], kxs.shape[0]
+
+        def normalize(hists):
+            """L2-normalize histogram vectors on the last axis, zeroing those
+            below the threshold (``DaisyExtractor.scala:193-200``)."""
+            nrm = jnp.linalg.norm(hists, axis=-1, keepdims=True)
+            return jnp.where(nrm > _FEATURE_THRESHOLD, hists / jnp.maximum(nrm, 1e-30), 0.0)
+
+        # center histogram: layer 0 at the keypoint
+        center = layers[0][:, kys, :][:, :, kxs]  # (H, ny, nx)
+        center = normalize(center.transpose(1, 2, 0))  # (ny, nx, H)
+
+        # ring histograms: layer l at radius (l+1)R/Q, angle 2π(t-1)/T.
+        # ref: lookupStartX = x + round(r·sinθ), lookupStartY = y + round(r·cosθ),
+        # and ref-x IS our axis 0 (Image.scala:139: xDim is the height)
+        ring_blocks = []
+        for t in range(T):
+            theta = 2.0 * math.pi * (t - 1) / T
+            for l in range(Q):
+                rad = R * (1.0 + l) / Q
+                o0 = int(round(rad * math.sin(theta)))  # ref-x -> axis 0
+                o1 = int(round(rad * math.cos(theta)))  # ref-y -> axis 1
+                hist = layers[l][:, kys + o0, :][:, :, kxs + o1]  # (H, ny, nx)
+                ring_blocks.append(normalize(hist.transpose(1, 2, 0)))
+
+        # layout: center at [0, H), ring block (t, l) at H + t*Q*H + l*H —
+        # exactly [center] + ring_blocks (t outer, l inner) concatenated
+        out = jnp.concatenate([center] + ring_blocks, axis=-1)
+        # reference row order: x*resultWidth + y with ref-x = our axis 0 —
+        # a plain row-major reshape
+        return out.reshape(ny * nx, self.feature_size)

@@ -1,0 +1,58 @@
+"""Fisher Vector encoding of local descriptors against a GMM vocabulary.
+
+Reference: ``nodes/images/external/FisherVector.scala:14-34`` → C++ enceval
+``fisher<float>`` with ``alpha=1.0, pnorm=0.0`` (no power/L2 normalization
+inside the encoder, ``src/main/cpp/EncEval.cxx:67-70``); output is the
+2·D·K gradient block (means then variances).
+
+Math (Perronnin & Dance / Sánchez et al.): with posteriors q_nk over N
+descriptors,
+
+    FV_μk = 1/(N·√w_k)   · Σ_n q_nk (x_n − μ_k)/σ_k
+    FV_σk = 1/(N·√(2w_k)) · Σ_n q_nk [((x_n − μ_k)/σ_k)² − 1]
+
+i.e. the Fisher-normalized gradient of the mean GMM log-likelihood — which
+gives an independent test oracle via ``jax.grad`` (tests verify the encoding
+equals the autodiff gradient up to the closed-form Fisher scaling).
+
+Output shape per item: (dims, 2·k) — column j<k is the mean-gradient for
+center j, column k+j the variance-gradient — matching the reference's
+``numDims×(2·numCentroids)`` (``FisherVector.scala:29-33``).
+
+One item = one (n_desc, dims) descriptor matrix; the whole encoding is two
+matmuls over the posteriors, so batching is MXU-shaped by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.learning.gmm import GaussianMixtureModel
+
+
+class FisherVector(Transformer):
+    gmm: GaussianMixtureModel
+
+    def apply(self, descriptors):
+        """(n_desc, d) -> (d, 2k)."""
+        gmm = self.gmm
+        q = gmm.apply_batch(descriptors)  # posteriors (n, k)
+        n = descriptors.shape[0]
+        sigma = jnp.sqrt(gmm.variances)  # (k, d)
+
+        qsum = jnp.sum(q, axis=0)  # (k,)
+        qx = q.T @ descriptors  # (k, d)
+        qx2 = q.T @ (descriptors * descriptors)  # (k, d)
+
+        # Σ q (x-μ)/σ = (qx - qsum·μ)/σ
+        grad_mu = (qx - qsum[:, None] * gmm.means) / sigma
+        # Σ q [((x-μ)/σ)² - 1] = (qx2 - 2μ·qx + qsum·μ²)/σ² - qsum
+        grad_sig = (
+            qx2 - 2.0 * gmm.means * qx + qsum[:, None] * gmm.means**2
+        ) / gmm.variances - qsum[:, None]
+
+        fv_mu = grad_mu / (n * jnp.sqrt(gmm.weights)[:, None])
+        fv_sig = grad_sig / (n * jnp.sqrt(2.0 * gmm.weights)[:, None])
+        return jnp.concatenate([fv_mu.T, fv_sig.T], axis=1)  # (d, 2k)

@@ -1,0 +1,136 @@
+"""Felzenszwalb HOG features (voc-dpm variant).
+
+Reference: ``nodes/images/HogExtractor.scala:33-296`` (itself a port of the
+voc-dpm C ``features.cc``): per pixel, the max-gradient color channel is
+kept, its orientation snapped to 18 contrast-sensitive bins by maximizing
+``uu[o]·dy + vv[o]·dx``; magnitudes are bilinearly binned into binSize cells;
+cell energies (over 9 folded orientations) feed four 2×2 block norms; output
+per interior cell is 18 contrast-sensitive + 9 insensitive + 4 texture + 1
+truncation feature = 32 dims, each clamped at 0.2.
+
+Axis convention: the reference's ``xDim`` IS the image height
+(``utils/images/Image.scala:139``), so ref-x is our axis 0 and ref-y our
+axis 1 throughout — dx differentiates along the height axis.
+
+Vectorized: the per-pixel loops become one scatter-add; everything else is
+slicing arithmetic. Tie-breaking on exactly-equal gradients/dots differs
+from the scalar reference in measure-zero cases.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import Transformer
+
+_EPSILON = 0.0001
+
+_UU = np.array(
+    [1.0, 0.9397, 0.766, 0.5, 0.1736, -0.1736, -0.5, -0.766, -0.9397], np.float32
+)
+_VV = np.array(
+    [0.0, 0.342, 0.6428, 0.866, 0.9848, 0.9848, 0.866, 0.6428, 0.342], np.float32
+)
+
+
+def _round_half_up(v: float) -> int:
+    """Scala math.round semantics (Python round() is round-half-even)."""
+    return int(math.floor(v + 0.5))
+
+
+class HogExtractor(Transformer):
+    bin_size: int = struct.field(pytree_node=False, default=8)
+
+    def apply(self, img):
+        """(H, W, C) -> ((nxc-2)·(nyc-2), 32). Ref-x = axis 0 (height)."""
+        h, w, c = img.shape
+        nxc = _round_half_up(h / self.bin_size)  # cells along ref-x (height)
+        nyc = _round_half_up(w / self.bin_size)
+        # the visible region may exceed the image when rounding up; pixels
+        # run [1, min(vis, dim) - 1) like the reference's image.get bounds
+        vis_x = min(nxc * self.bin_size, h)
+        vis_y = min(nyc * self.bin_size, w)
+
+        xs = jnp.arange(1, vis_x - 1)  # ref-x pixel coords (axis 0)
+        ys = jnp.arange(1, vis_y - 1)  # ref-y pixel coords (axis 1)
+        sub = img[:vis_x, :vis_y, :]
+        dx = sub[2:, 1:-1, :] - sub[:-2, 1:-1, :]  # d/d(ref-x), shape (X, Y, C)
+        dy = sub[1:-1, 2:, :] - sub[1:-1, :-2, :]
+        mag2 = dx * dx + dy * dy
+        # max-magnitude channel (ref ties -> highest channel; argmax -> lowest)
+        best_c = jnp.argmax(mag2, axis=-1)
+        take = lambda a: jnp.take_along_axis(a, best_c[..., None], axis=-1)[..., 0]
+        bdx, bdy, bmag2 = take(dx), take(dy), take(mag2)
+        magnitude = jnp.sqrt(bmag2)
+
+        # orientation snap: check order o0+, o0-, o1+, o1-, ... first max wins
+        dots = bdy[..., None] * _UU[None, None, :] + bdx[..., None] * _VV[None, None, :]
+        interleaved = jnp.stack([dots, -dots], axis=-1).reshape(*dots.shape[:-1], 18)
+        idx = jnp.argmax(interleaved, axis=-1)
+        orientation = idx // 2 + 9 * (idx % 2)  # (X, Y)
+
+        # bilinear binning into cells
+        xp = (xs.astype(jnp.float32) + 0.5) / self.bin_size - 0.5
+        yp = (ys.astype(jnp.float32) + 0.5) / self.bin_size - 0.5
+        ixp = jnp.floor(xp).astype(jnp.int32)
+        iyp = jnp.floor(yp).astype(jnp.int32)
+        vx0 = xp - ixp
+        vy0 = yp - iyp
+
+        hist = jnp.zeros((nxc, nyc, 18), jnp.float32)
+        X, Y = magnitude.shape
+        ix = jnp.broadcast_to(ixp[:, None], (X, Y))
+        iy = jnp.broadcast_to(iyp[None, :], (X, Y))
+        wx0 = jnp.broadcast_to(vx0[:, None], (X, Y))
+        wy0 = jnp.broadcast_to(vy0[None, :], (X, Y))
+        for dxc, dyc, wgt in (
+            (0, 0, (1 - wx0) * (1 - wy0)),
+            (1, 0, wx0 * (1 - wy0)),
+            (0, 1, (1 - wx0) * wy0),
+            (1, 1, wx0 * wy0),
+        ):
+            cx = ix + dxc
+            cy = iy + dyc
+            ok = (cx >= 0) & (cx < nxc) & (cy >= 0) & (cy < nyc)
+            hist = hist.at[
+                jnp.where(ok, cx, 0), jnp.where(ok, cy, 0), orientation
+            ].add(jnp.where(ok, wgt * magnitude, 0.0))
+
+        # cell energies over folded orientations
+        folded = hist[..., :9] + hist[..., 9:]
+        norm = jnp.sum(folded * folded, axis=-1)  # (nxc, nyc)
+
+        nxf, nyf = max(nxc - 2, 0), max(nyc - 2, 0)
+        if nxf == 0 or nyf == 0:
+            return jnp.zeros((0, 32), jnp.float32)
+
+        def bsum(ox, oy):
+            b = norm[ox : ox + nxf + 1, oy : oy + nyf + 1]
+            return b[:-1, :-1] + b[:-1, 1:] + b[1:, :-1] + b[1:, 1:]
+
+        # reference n1..n4 anchors (HogExtractor.scala:198-212): n1 at
+        # (x+1,y+1), n2 at (x,y+1), n3 at (x+1,y), n4 at (x,y)
+        n1 = 1.0 / jnp.sqrt(bsum(1, 1) + _EPSILON)
+        n2 = 1.0 / jnp.sqrt(bsum(0, 1) + _EPSILON)
+        n3 = 1.0 / jnp.sqrt(bsum(1, 0) + _EPSILON)
+        n4 = 1.0 / jnp.sqrt(bsum(0, 0) + _EPSILON)
+        ns = jnp.stack([n1, n2, n3, n4], axis=-1)  # (nxf, nyf, 4)
+
+        center = hist[1 : 1 + nxf, 1 : 1 + nyf, :]  # (nxf, nyf, 18)
+        hsens = jnp.minimum(center[..., None] * ns[..., None, :], 0.2)
+        f_sens = 0.5 * jnp.sum(hsens, axis=-1)  # (nxf, nyf, 18)
+        csum = center[..., :9] + center[..., 9:]
+        hins = jnp.minimum(csum[..., None] * ns[..., None, :], 0.2)
+        f_ins = 0.5 * jnp.sum(hins, axis=-1)  # (nxf, nyf, 9)
+        f_tex = 0.2357 * jnp.sum(hsens, axis=-2)  # (nxf, nyf, 4)
+        f_trunc = jnp.zeros((nxf, nyf, 1), jnp.float32)
+
+        feats = jnp.concatenate([f_sens, f_ins, f_tex, f_trunc], axis=-1)
+        # reference row order: y + x*numYCellsWithFeatures (ref-x major) —
+        # with ref-x = axis 0 that is a plain row-major reshape
+        return feats.reshape(nxf * nyf, 32)

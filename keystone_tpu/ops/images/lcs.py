@@ -1,0 +1,92 @@
+"""Local Color Statistics (LCS) descriptors.
+
+Reference: ``nodes/images/LCSExtractor.scala:25-130`` — per-channel box-filter
+means/stds (via ``ImageUtils.conv2D``), then for each keypoint on a
+(stride, stride_start) grid, the means and stds of a 4×4 neighborhood of
+sub-patches at offsets ``-2s+s/2-1 .. s+s/2-1`` step ``s`` → 96-dim
+descriptors (3 channels × 16 sub-regions × {mean, std}).
+
+Returns (num_keypoints, 96) rows (the reference emits the 96×N transpose).
+Keypoint ordering differs from the reference (row-major here, column-major
+there) — downstream consumers (PCA/GMM/FisherVector) aggregate over
+descriptors so ordering is immaterial.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import Transformer
+
+
+def conv2d_same(img, x_filter: np.ndarray, y_filter: np.ndarray):
+    """The reference's ``ImageUtils.conv2D`` contract (``:162-274``): true
+    separable convolution (filter flipped), zero padding floor((k-1)/2) low /
+    ceil((k-1)/2) high, output size = input size. ``img``: (..., H, W).
+
+    Note: ``x_filter`` here runs along our axis -1 (width). The reference's
+    ``xFilter`` runs along ref-x = image height — callers translating
+    reference ``conv2D(img, A, B)`` calls should pass ``(B, A)`` here.
+    """
+
+    def pass1d(x, filt, axis):
+        k = len(filt)
+        lo, hi = (k - 1) // 2, k - 1 - (k - 1) // 2
+        kernel = jnp.asarray(np.asarray(filt, np.float32)[::-1])
+        moved = jnp.moveaxis(x, axis, -1)
+        padded = jnp.pad(
+            moved, [(0, 0)] * (moved.ndim - 1) + [(lo, hi)], mode="constant"
+        )
+        flat = padded.reshape(-1, 1, padded.shape[-1])
+        res = jax.lax.conv_general_dilated(
+            flat, kernel.reshape(1, 1, -1), (1,), "VALID",
+            dimension_numbers=("NCH", "OIH", "NCH"),
+        )
+        return jnp.moveaxis(res.reshape(moved.shape), -1, axis)
+
+    return pass1d(pass1d(img, x_filter, -1), y_filter, -2)
+
+
+class LCSExtractor(Transformer):
+    stride: int = struct.field(pytree_node=False, default=4)
+    stride_start: int = struct.field(pytree_node=False, default=16)
+    sub_patch_size: int = struct.field(pytree_node=False, default=6)
+
+    def _neighbor_offsets(self) -> np.ndarray:
+        s = self.sub_patch_size
+        return np.arange(-2 * s + s // 2 - 1, s + s // 2, s)  # e.g. [-10,-4,2,8]
+
+    def apply(self, img):
+        """(H, W, C) -> (num_keypoints, C·16·2)."""
+        h, w, c = img.shape
+        chans = jnp.moveaxis(img, -1, 0)  # (C, H, W)
+        box = np.full(self.sub_patch_size, 1.0 / self.sub_patch_size, np.float32)
+        means = conv2d_same(chans, box, box)
+        sq = conv2d_same(chans * chans, box, box)
+        stds = jnp.sqrt(jnp.maximum(sq - means * means, 0.0))
+
+        ys = jnp.arange(self.stride_start, h - self.stride_start, self.stride)
+        xs = jnp.arange(self.stride_start, w - self.stride_start, self.stride)
+        offs = jnp.asarray(self._neighbor_offsets())
+
+        # sample positions: keypoint grid + neighborhood offsets
+        py = (ys[:, None] + offs[None, :]).reshape(-1)  # (ny*4,)
+        px = (xs[:, None] + offs[None, :]).reshape(-1)  # (nx*4,)
+        m = means[:, py, :][:, :, px]  # (C, ny*4, nx*4)
+        s = stds[:, py, :][:, :, px]
+        ny, nx, k = ys.shape[0], xs.shape[0], offs.shape[0]
+        m = m.reshape(c, ny, k, nx, k)
+        s = s.reshape(c, ny, k, nx, k)
+        # per keypoint: descriptor ordered (c, ref-x offset, ref-y offset,
+        # [mean, std]) — ref-x is our axis 0 (Image.scala:139)
+        stacked = jnp.stack([m, s], axis=-1)  # (C, ny, oy, nx, ox, 2)
+        stacked = stacked.transpose(1, 3, 0, 2, 4, 5)  # (ny, nx, C, oy, ox, 2)
+        return stacked.reshape(ny * nx, c * k * k * 2)
+
+    def num_keypoints(self, h: int, w: int) -> int:
+        ny = len(range(self.stride_start, h - self.stride_start, self.stride))
+        nx = len(range(self.stride_start, w - self.stride_start, self.stride))
+        return ny * nx

@@ -1,0 +1,156 @@
+"""LCS / HOG / DAISY tests: naive-oracle comparisons for the conv2d contract
+and LCS statistics, property tests for HOG/DAISY (the reference compared
+against its original implementations' outputs; those binaries don't exist on
+this platform — see tests/test_sift.py for the same policy)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.images import (
+    DaisyExtractor,
+    HogExtractor,
+    LCSExtractor,
+    SIFTExtractor,
+)
+from keystone_tpu.ops.images.lcs import conv2d_same
+
+
+def naive_conv2d_same(img, xf, yf):
+    """Scalar reimplementation of ImageUtils.conv2D: zero-pad floor/ceil,
+    true convolution per axis."""
+    h, w = img.shape
+    out = np.zeros_like(img)
+    kx, ky = len(xf), len(yf)
+    lox = (kx - 1) // 2
+    loy = (ky - 1) // 2
+    tmp = np.zeros_like(img)
+    for y in range(h):
+        for x in range(w):
+            acc = 0.0
+            for i in range(kx):
+                src = x - lox + i
+                if 0 <= src < w:
+                    acc += img[y, src] * xf[kx - 1 - i]
+            tmp[y, x] = acc
+    for y in range(h):
+        for x in range(w):
+            acc = 0.0
+            for i in range(ky):
+                src = y - loy + i
+                if 0 <= src < h:
+                    acc += tmp[src, x] * yf[ky - 1 - i]
+            out[y, x] = acc
+    return out
+
+
+def test_conv2d_same_matches_naive(rng):
+    img = rng.random((9, 11)).astype(np.float32)
+    for xf, yf in [
+        ([1.0, 0.0, -1.0], [1.0, 2.0, 1.0]),
+        ([1 / 6] * 6, [1 / 6] * 6),  # even-length box
+    ]:
+        got = np.asarray(conv2d_same(jnp.asarray(img), np.array(xf), np.array(yf)))
+        expected = naive_conv2d_same(img.astype(np.float64), np.array(xf), np.array(yf))
+        np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_lcs_statistics_match_naive(rng):
+    img = rng.random((48, 48, 3)).astype(np.float32)
+    node = LCSExtractor(stride=4, stride_start=16, sub_patch_size=6)
+    out = np.asarray(node.serve(jnp.asarray(img)))
+    assert out.shape == (node.num_keypoints(48, 48), 96)
+
+    # check one keypoint/channel/offset against directly computed box stats:
+    # keypoint (y=16, x=16), offset (-10, -10), channel 0: box mean over
+    # rows/cols [y-10-2, y-10+3] (floor/ceil split of 6-wide box)
+    y, x, off = 16, 16, -10
+    py, px = y + off, x + off
+    patch = img[py - 2 : py + 4, px - 2 : px + 4, 0].astype(np.float64)
+    expected_mean = patch.mean()
+    expected_std = np.sqrt(max((patch**2).mean() - expected_mean**2, 0.0))
+    # descriptor layout: (c, ox, oy, 2); keypoint 0 is (y=16, x=16); offset
+    # (-10, -10) is ox=0, oy=0 -> indices 0 (mean) and 1 (std)
+    np.testing.assert_allclose(out[0, 0], expected_mean, atol=1e-4)
+    np.testing.assert_allclose(out[0, 1], expected_std, atol=1e-4)
+
+
+def test_lcs_constant_image_zero_std():
+    img = jnp.full((48, 48, 3), 7.0)
+    out = np.asarray(LCSExtractor(4, 16, 6).serve(img))
+    means = out[:, 0::2]
+    stds = out[:, 1::2]
+    np.testing.assert_allclose(means, 7.0, atol=1e-4)
+    np.testing.assert_allclose(stds, 0.0, atol=1e-4)
+
+
+def test_hog_shape_and_range(rng):
+    img = rng.random((40, 48, 3)).astype(np.float32)
+    node = HogExtractor(bin_size=8)
+    out = np.asarray(node.serve(jnp.asarray(img)))
+    # 48/8=6 x-cells, 40/8=5 y-cells -> (6-2)*(5-2) = 12 interior cells
+    assert out.shape == (12, 32)
+    assert out.min() >= 0.0
+    # clamped features bounded: sensitive/insensitive <= 0.5*4*0.2 = 0.4
+    assert out[:, :27].max() <= 0.4 + 1e-6
+    assert np.allclose(out[:, 31], 0.0)  # truncation feature
+
+
+def test_hog_rounded_up_grid_does_not_crash(rng):
+    # 44/8 = 5.5 -> 6 cells (round half up); visible region clamps to the
+    # image instead of crashing
+    img = jnp.asarray(rng.random((44, 44, 3)).astype(np.float32))
+    out = np.asarray(HogExtractor(bin_size=8).serve(img))
+    assert out.shape == ((6 - 2) * (6 - 2), 32)
+    assert np.isfinite(out).all()
+
+
+def test_hog_uniform_image_is_zero():
+    out = np.asarray(HogExtractor(bin_size=8).serve(jnp.full((32, 32, 3), 0.5)))
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_hog_gradient_energy_shifts_orientation():
+    # vertical stripes -> horizontal gradient -> contrast-sensitive energy
+    # concentrated near orientation 0/9 (dx dominant)
+    img = jnp.tile(jnp.arange(64.0)[None, :, None] % 2, (64, 1, 3))
+    out = np.asarray(HogExtractor(bin_size=8).serve(img))
+    sens = out[:, :18].reshape(-1, 18).sum(0)
+    assert sens.argmax() in (0, 9)
+
+
+def test_daisy_shape_layout_and_norms(rng):
+    img = rng.random((64, 64)).astype(np.float32)
+    node = DaisyExtractor()
+    out = np.asarray(node.serve(jnp.asarray(img)))
+    n_k = len(range(16, 48, 4)) ** 2
+    assert out.shape == (n_k, 200)
+    # every 8-dim histogram block is L2-normalized (or zero)
+    blocks = out.reshape(n_k, 25, 8)
+    norms = np.linalg.norm(blocks, axis=2)
+    ok = np.isclose(norms, 1.0, atol=1e-3) | np.isclose(norms, 0.0, atol=1e-6)
+    assert ok.all()
+
+
+def test_daisy_constant_image_zero_interior():
+    # zero-padded conv2D creates border gradients (reference behavior too);
+    # keypoints far from the border see zero gradient -> zeroed histograms
+    out = np.asarray(DaisyExtractor().serve(jnp.full((128, 128), 3.0)))
+    n_side = len(range(16, 112, 4))
+    center = out.reshape(n_side, n_side, 200)[n_side // 2, n_side // 2]
+    np.testing.assert_allclose(center, 0.0, atol=1e-5)
+
+
+def test_extractors_feed_fv_pipeline(rng):
+    """Integration: extractor -> descriptors usable by PCA/GMM/FV."""
+    from keystone_tpu.learning import GaussianMixtureModelEstimator, PCAEstimator
+    from keystone_tpu.ops.images import FisherVector
+
+    img = rng.random((48, 48)).astype(np.float32)
+    descs = SIFTExtractor(scales=2).serve(jnp.asarray(img))
+    pca = PCAEstimator(dims=16, method="svd").fit(descs)
+    reduced = pca(descs)
+    gmm = GaussianMixtureModelEstimator(k=4, num_iter=10).fit(reduced)
+    fv = FisherVector(gmm=gmm).serve(reduced)
+    assert fv.shape == (16, 8)
+    assert np.isfinite(np.asarray(fv)).all()

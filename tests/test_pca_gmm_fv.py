@@ -1,0 +1,156 @@
+"""PCA, GMM-EM, and Fisher Vector tests, mirroring the reference's
+property/statistical suites (PCASuite, EncEvalSuite planted-Gaussian
+recovery) plus an autodiff oracle for the FV encoding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.learning import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+    PCAEstimator,
+)
+from keystone_tpu.ops.images import FisherVector
+from keystone_tpu.parallel import distribute, make_mesh, use_mesh
+
+
+def _correlated_data(rng, n=400, d=10):
+    basis = rng.normal(size=(d, d))
+    z = rng.normal(size=(n, 4)) * np.array([5.0, 3.0, 1.0, 0.5])
+    return (z @ basis[:4] + 0.05 * rng.normal(size=(n, d))).astype(np.float32)
+
+
+def test_pca_reduced_covariance_is_diagonal(rng):
+    """PCASuite.scala:51-78: covariance of the projected data is diagonal."""
+    x = _correlated_data(rng)
+    pca = PCAEstimator(dims=4, method="svd").fit(jnp.asarray(x))
+    out = np.asarray(pca(jnp.asarray(x - x.mean(0))))
+    cov = out.T @ out / (out.shape[0] - 1)
+    off = cov - np.diag(np.diag(cov))
+    assert np.abs(off).max() < 1e-2 * np.abs(np.diag(cov)).max()
+    # variance ordering: descending
+    dvar = np.diag(cov)
+    assert np.all(dvar[:-1] >= dvar[1:] - 1e-5)
+
+
+def test_pca_gram_matches_svd(rng):
+    x = _correlated_data(rng, n=800)
+    p_svd = np.asarray(PCAEstimator(4, "svd").fit(jnp.asarray(x)).pca_mat)
+    p_gram = np.asarray(PCAEstimator(4, "gram").fit(jnp.asarray(x)).pca_mat)
+    # same subspace and same sign convention -> same matrix (up to fp noise)
+    np.testing.assert_allclose(np.abs(p_svd), np.abs(p_gram), atol=1e-2)
+
+
+def test_pca_sign_convention(rng):
+    x = _correlated_data(rng)
+    p = np.asarray(PCAEstimator(4, "svd").fit(jnp.asarray(x)).pca_mat)
+    for j in range(4):
+        col = p[:, j]
+        assert col[np.argmax(np.abs(col))] >= 0
+
+
+def test_pca_distributed_fit(rng, devices):
+    x = _correlated_data(rng, n=804)
+    with use_mesh(make_mesh()):
+        ds = distribute(jnp.asarray(x))
+        p = PCAEstimator(4, "gram").fit(ds)
+    out = np.asarray(p(jnp.asarray(x - x.mean(0))))
+    cov = out.T @ out / (out.shape[0] - 1)
+    off = cov - np.diag(np.diag(cov))
+    assert np.abs(off).max() < 2e-2 * np.abs(np.diag(cov)).max()
+
+
+def _planted_gmm(rng, n=2000):
+    """Two well-separated planted Gaussians (EncEvalSuite.scala:42-64)."""
+    means = np.array([[-5.0, 0.0, 2.0], [5.0, 3.0, -2.0]])
+    stds = np.array([[1.0, 0.5, 0.8], [0.7, 1.2, 0.6]])
+    labels = rng.integers(0, 2, size=n)
+    x = means[labels] + stds[labels] * rng.normal(size=(n, 3))
+    return x.astype(np.float32), means, stds
+
+
+def test_gmm_recovers_planted_gaussians(rng):
+    x, means, stds = _planted_gmm(rng)
+    gmm = GaussianMixtureModelEstimator(k=2, num_iter=40).fit(jnp.asarray(x))
+    got_means = np.asarray(gmm.means)
+    # match centers up to permutation
+    order = np.argsort(got_means[:, 0])
+    np.testing.assert_allclose(got_means[order], means[np.argsort(means[:, 0])], atol=0.2)
+    got_vars = np.asarray(gmm.variances)[order]
+    np.testing.assert_allclose(
+        got_vars, (stds**2)[np.argsort(means[:, 0])], rtol=0.3
+    )
+    np.testing.assert_allclose(np.asarray(gmm.weights).sum(), 1.0, atol=1e-5)
+
+
+def test_gmm_posteriors_sum_to_one(rng):
+    x, *_ = _planted_gmm(rng, n=100)
+    gmm = GaussianMixtureModelEstimator(k=2, num_iter=10).fit(jnp.asarray(x))
+    post = np.asarray(gmm(jnp.asarray(x)))
+    np.testing.assert_allclose(post.sum(axis=1), 1.0, atol=1e-5)
+    one = np.asarray(gmm.serve(jnp.asarray(x[0])))
+    np.testing.assert_allclose(one, post[0], atol=1e-5)
+
+
+def test_gmm_masked_fit_ignores_padding(rng):
+    x, *_ = _planted_gmm(rng, n=500)
+    xp = np.concatenate([x, np.full((12, 3), 1e4, np.float32)])
+    mask = np.concatenate([np.ones(500, np.float32), np.zeros(12, np.float32)])
+    g1 = GaussianMixtureModelEstimator(k=2, num_iter=20).fit(jnp.asarray(x))
+    g2 = GaussianMixtureModelEstimator(k=2, num_iter=20).fit(
+        jnp.asarray(xp), mask=jnp.asarray(mask)
+    )
+    np.testing.assert_allclose(
+        np.sort(np.asarray(g1.means), 0), np.sort(np.asarray(g2.means), 0), atol=0.3
+    )
+
+
+def test_gmm_csv_roundtrip(tmp_path):
+    means = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])  # (dim=3, k=2) ref layout
+    np.savetxt(tmp_path / "m.csv", means, delimiter=",")
+    np.savetxt(tmp_path / "v.csv", np.ones((3, 2)), delimiter=",")
+    np.savetxt(tmp_path / "w.csv", np.array([0.4, 0.6]), delimiter=",")
+    gmm = GaussianMixtureModel.load(
+        str(tmp_path / "m.csv"), str(tmp_path / "v.csv"), str(tmp_path / "w.csv")
+    )
+    assert gmm.means.shape == (2, 3)  # transposed to (k, dim)
+    np.testing.assert_allclose(np.asarray(gmm.means)[0], [1.0, 3.0, 5.0])
+
+
+def test_fisher_vector_matches_autodiff_gradient(rng):
+    """FV is the Fisher-normalized gradient of the mean log-likelihood:
+    verify against jax.grad — an oracle independent of the encoder code."""
+    k, d, n = 3, 4, 50
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=15, seed=1).fit(
+        jnp.asarray(rng.normal(size=(200, d)).astype(np.float32) * 2)
+    )
+    fv = np.asarray(FisherVector(gmm=gmm).serve(jnp.asarray(x)))  # (d, 2k)
+    assert fv.shape == (d, 2 * k)
+
+    def mean_ll(means, variances):
+        g = GaussianMixtureModel(means=means, variances=variances, weights=gmm.weights)
+        ll = g.log_likelihoods(jnp.asarray(x))
+        return jnp.mean(jax.scipy.special.logsumexp(ll, axis=1))
+
+    g_mu, g_var = jax.grad(mean_ll, argnums=(0, 1))(gmm.means, gmm.variances)
+    sigma = np.sqrt(np.asarray(gmm.variances))
+    w = np.asarray(gmm.weights)
+    # dL/dμ = Σ q (x-μ)/σ² / n  ->  FV_μ = σ·dL/dμ / √w
+    expect_mu = np.asarray(g_mu) * sigma / np.sqrt(w)[:, None]
+    np.testing.assert_allclose(fv[:, :k], expect_mu.T, atol=1e-4)
+    # dL/dσ² = Σ q[(x-μ)²/σ⁴ - 1/σ²]/2n  ->  FV_σ = 2σ²·dL/dσ² / √(2w)
+    expect_sig = 2.0 * np.asarray(g_var) * np.asarray(gmm.variances) / np.sqrt(2 * w)[:, None]
+    np.testing.assert_allclose(fv[:, k:], expect_sig.T, atol=1e-4)
+
+
+def test_fisher_vector_batch(rng):
+    gmm = GaussianMixtureModelEstimator(k=2, num_iter=5).fit(
+        jnp.asarray(rng.normal(size=(100, 4)).astype(np.float32))
+    )
+    descs = jnp.asarray(rng.normal(size=(3, 20, 4)).astype(np.float32))
+    out = np.asarray(FisherVector(gmm=gmm)(descs))
+    assert out.shape == (3, 4, 4)
+    one = np.asarray(FisherVector(gmm=gmm).serve(descs[1]))
+    np.testing.assert_allclose(out[1], one, atol=1e-5)
