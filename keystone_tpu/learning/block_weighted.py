@@ -1,0 +1,211 @@
+"""Weighted block coordinate descent for class-imbalanced least squares.
+
+Reference: ``nodes/learning/BlockWeightedLeastSquares.scala:35-363`` — the
+most complex solver in the inventory (SURVEY.md §2.2). ``mixture_weight`` w
+up-weights each class's own examples: per class c and feature block b,
+
+    jointXTX_c = (1-w)·popCov + w·classCov_c + w(1-w)·(μ_c-μ)(μ_c-μ)ᵀ
+    jointXTR_c = (1-w)·popXTR[:,c] + w·classXTR_c − jointMean_c·meanMixWt_c
+    ΔW_c = (jointXTX_c + λI)⁻¹ (jointXTR_c − λ·W_b[:,c])
+
+with population stats over all rows and class stats over class-c rows; the
+residual update and intercept follow the reference exactly (cites inline).
+
+TPU design (SURVEY.md §7 hard part #2): the reference rides on "one
+partition = one class" (``groupByClasses`` HashPartitioner shuffle,
+``:324-361``). Here rows are *sorted by class* once (the shuffle analog),
+per-class moments are ``segment_sum``s, and the per-class solves run as one
+``lax.scan`` over fixed-size class chunks (``dynamic_slice`` into the sorted
+rows + membership mask) — same FLOPs as the reference's per-executor solves
+when classes are balanced, and every reduction over rows is a sharded
+matmul/psum over the mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.dataset import Dataset
+from keystone_tpu.core.pipeline import LabelEstimator
+from keystone_tpu.learning.block_linear import BlockLinearMapper
+from keystone_tpu.linalg.solvers import hdot
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def _prepare(labels_pm1, mask, num_classes: int):
+    """Sort rows by class; masked rows get a sentinel class sorted last."""
+    class_idx = jnp.argmax(labels_pm1, axis=1)
+    if mask is not None:
+        class_idx = jnp.where(mask > 0, class_idx, num_classes)
+    order = jnp.argsort(class_idx)
+    cls_sorted = class_idx[order]
+    counts = jnp.bincount(cls_sorted, length=num_classes)  # sentinel dropped
+    offsets = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
+    valid = (cls_sorted < num_classes).astype(jnp.float32)
+    return order, cls_sorted, counts, offsets, valid
+
+
+@jax.jit
+def _class_col_means(R, cls_sorted, counts, num_classes_arr):
+    """Per-class column means of the residual, then the mean over classes —
+    the reference's residualMean (``:161-165,283-287``)."""
+    c = R.shape[1]
+    sums = jax.ops.segment_sum(R, cls_sorted, num_segments=c + 1)[:c]
+    per_class = sums / jnp.maximum(counts[:, None].astype(jnp.float32), 1.0)
+    return per_class, jnp.sum(per_class, axis=0) / c
+
+
+@jax.jit
+def _pop_stats(Xb, R, valid, n_eff):
+    """Population mean / covariance / XᵀR for one block (pass 0,
+    ``:190-212``). Row-sharded matmuls -> ICI all-reduce."""
+    Xv = Xb * valid[:, None]
+    pop_mean = jnp.sum(Xv, axis=0) / n_eff
+    pop_cov = hdot(Xv.T, Xv) / n_eff - jnp.outer(pop_mean, pop_mean)
+    pop_xtr = hdot(Xv.T, R) / n_eff
+    return pop_mean, pop_cov, pop_xtr
+
+
+@functools.partial(jax.jit, static_argnames=("max_nc",))
+def _class_solves(
+    Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
+    residual_mean, model_b, lam, w, max_nc: int
+):
+    """One scan step per class: masked chunk moments + the joint solve
+    (``BlockWeightedLeastSquares.scala:228-263``). Returns ΔW (bs, C)."""
+    n, bs = Xb.shape
+    num_classes = pop_xtr.shape[1]
+    eye = jnp.eye(bs, dtype=Xb.dtype)
+
+    def body(carry, c):
+        start = offsets[c]
+        n_c = counts[c].astype(jnp.float32)
+        start_cl = jnp.clip(start, 0, max(n - max_nc, 0)).astype(jnp.int32)
+        Xc = jax.lax.dynamic_slice(Xb, (start_cl, 0), (max_nc, bs))
+        Rc = jax.lax.dynamic_slice(R, (start_cl, 0), (max_nc, num_classes))
+        rows = jnp.arange(max_nc) + start_cl
+        m = ((rows >= start) & (rows < start + counts[c])).astype(Xb.dtype)
+        nc = jnp.maximum(n_c, 1.0)
+
+        class_mean = jnp.sum(Xc * m[:, None], axis=0) / nc
+        Xzm = (Xc - class_mean) * m[:, None]
+        class_cov = hdot(Xzm.T, Xzm) / nc
+        res_local = jnp.take(Rc, c, axis=1) * m
+        class_xtr = (Xc * m[:, None]).T @ res_local / nc
+
+        mean_diff = class_mean - pop_mean
+        joint_xtx = (
+            (1.0 - w) * pop_cov
+            + w * class_cov
+            + (1.0 - w) * w * jnp.outer(mean_diff, mean_diff)
+        )
+        mean_mix = (1.0 - w) * residual_mean[c] + w * jnp.sum(res_local) / nc
+        joint_xtr = (
+            (1.0 - w) * jnp.take(pop_xtr, c, axis=1)
+            + w * class_xtr
+            - joint_means_b[c] * mean_mix
+        )
+        rhs = joint_xtr - lam * jnp.take(model_b, c, axis=1)
+        dW_c = jnp.linalg.solve(joint_xtx + lam * eye, rhs)
+        return carry, dW_c
+
+    _, dW = jax.lax.scan(body, None, jnp.arange(num_classes))
+    return dW.T  # (bs, C)
+
+
+@jax.jit
+def _apply_update(R, Xb, dW, valid):
+    return R - hdot(Xb * valid[:, None], dW)
+
+
+class BlockWeightedLeastSquaresEstimator(LabelEstimator):
+    """Reference: ``BlockWeightedLeastSquares.scala:35-90``."""
+
+    def __init__(self, block_size: int, num_iter: int, lam: float, mixture_weight: float):
+        self.block_size = block_size
+        self.num_iter = num_iter
+        self.lam = lam
+        self.mixture_weight = mixture_weight
+
+    def fit(self, data, labels, mask: Optional[jax.Array] = None) -> BlockLinearMapper:
+        if isinstance(data, Dataset):
+            data, mask = data.data, data.mask if mask is None else mask
+        if isinstance(labels, Dataset):
+            labels = labels.data
+        if not isinstance(data, (jnp.ndarray, np.ndarray)):
+            data = jnp.concatenate(list(data), axis=1)
+        data = jnp.asarray(data, jnp.float32)
+        labels = jnp.asarray(labels, jnp.float32)
+        n, d = data.shape
+        num_classes = labels.shape[1]
+        w = jnp.float32(self.mixture_weight)
+        lam = jnp.float32(self.lam)
+
+        order, cls_sorted, counts, offsets, valid = _prepare(labels, mask, num_classes)
+        Xs = data[order]
+        Ls = labels[order]
+        n_eff = jnp.sum(counts).astype(jnp.float32)
+
+        # jointLabelMean[c] = 2w + 2(1-w)·n_c/n − 1  (``:148-150``)
+        joint_label_mean = (
+            2.0 * w + 2.0 * (1.0 - w) * counts.astype(jnp.float32) / n_eff - 1.0
+        )
+        R = (Ls - joint_label_mean) * valid[:, None]
+        _, residual_mean = _class_col_means(R, cls_sorted, counts, num_classes)
+
+        max_nc = int(jnp.max(counts))  # one host sync; static chunk size
+        max_nc = min(n, max(8, -(-max_nc // 8) * 8))
+
+        d_pad = -(-d // self.block_size) * self.block_size
+        if d_pad != d:
+            Xs = jnp.pad(Xs, ((0, 0), (0, d_pad - d)))
+        num_blocks = d_pad // self.block_size
+
+        models = [
+            jnp.zeros((self.block_size, num_classes), jnp.float32)
+            for _ in range(num_blocks)
+        ]
+        block_stats: list = [None] * num_blocks
+
+        for _ in range(self.num_iter):
+            for b in range(num_blocks):
+                Xb = jax.lax.dynamic_slice_in_dim(
+                    Xs, b * self.block_size, self.block_size, 1
+                )
+                if block_stats[b] is None:
+                    pop_mean, pop_cov, pop_xtr = _pop_stats(Xb, R, valid, n_eff)
+                    # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)
+                    class_sums = jax.ops.segment_sum(
+                        Xb * valid[:, None], cls_sorted, num_segments=num_classes + 1
+                    )[:num_classes]
+                    class_means = class_sums / jnp.maximum(
+                        counts[:, None].astype(jnp.float32), 1.0
+                    )
+                    joint_means_b = w * class_means + (1.0 - w) * pop_mean
+                    block_stats[b] = (pop_mean, pop_cov, joint_means_b)
+                else:
+                    pop_mean, pop_cov, joint_means_b = block_stats[b]
+                    pop_xtr = hdot((Xb * valid[:, None]).T, R) / n_eff
+
+                dW = _class_solves(
+                    Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr,
+                    joint_means_b, residual_mean, models[b], lam, w, max_nc,
+                )
+                models[b] = models[b] + dW
+                R = _apply_update(R, Xb, dW, valid)
+                _, residual_mean = _class_col_means(R, cls_sorted, counts, num_classes)
+
+        W = jnp.concatenate(models, axis=0)[:d]
+        joint_means = jnp.concatenate(
+            [s[2] for s in block_stats], axis=1
+        )[:, :d]  # (C, d)
+        # finalB = jointLabelMean − Σ_d jointMeans[c,d]·W[d,c] (``:305-309``)
+        final_b = joint_label_mean - jnp.einsum("cd,dc->c", joint_means, W)
+        return BlockLinearMapper(
+            w=W, b=final_b, feature_means=None, block_size=self.block_size
+        )

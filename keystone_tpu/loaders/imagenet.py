@@ -1,0 +1,82 @@
+"""ImageNet loader: directory of tars + "className label" map.
+
+Reference: ``loaders/ImageNetLoader.scala:11-39`` — each tar entry lives in a
+class-named directory; the labels file maps class name -> int. Images stream
+through the native ingest layer into fixed (target_h, target_w) frames.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu.native import PrefetchImageLoader
+
+IMAGENET_NUM_CLASSES = 1000
+
+
+def load_labels_map(labels_path: str) -> dict:
+    out = {}
+    with open(labels_path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                out[parts[0]] = int(parts[1])
+    return out
+
+
+def iter_imagenet_batches(
+    data_dir: str,
+    labels_path: str,
+    target_hw: Tuple[int, int] = (256, 256),
+    batch_size: int = 256,
+    num_threads: int = 8,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yields (images (n, H, W, 3) float32, labels (n,) int32)."""
+    labels_map = load_labels_map(labels_path)
+    tars = sorted(
+        os.path.join(data_dir, f)
+        for f in os.listdir(data_dir)
+        if not os.path.isdir(os.path.join(data_dir, f))
+    )
+    loader = PrefetchImageLoader(tars, target_hw[0], target_hw[1], num_threads)
+    for imgs, names in loader.batches(batch_size):
+        labels = np.array(
+            [labels_map.get(n.split("/")[0], -1) for n in names], np.int32
+        )
+        keep = labels >= 0
+        yield imgs[keep], labels[keep]
+
+
+def load_imagenet(
+    data_dir: str, labels_path: str, target_hw=(256, 256), num_threads: int = 8
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Materialize a whole (small) dataset — loader integration tests."""
+    xs, ys = [], []
+    for imgs, labels in iter_imagenet_batches(
+        data_dir, labels_path, target_hw, 256, num_threads
+    ):
+        xs.append(imgs)
+        ys.append(labels)
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def synthetic_imagenet(
+    n: int,
+    num_classes: int = 16,
+    hw: Tuple[int, int] = (96, 96),
+    seed: int = 42,
+    prototype_seed: int = 11,
+    noise: float = 0.08,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Smooth class-prototype RGB images in [0,1] (zero-egress stand-in)."""
+    h, w = hw
+    proto_rng = np.random.default_rng(prototype_seed)
+    coarse = proto_rng.uniform(0.2, 0.8, size=(num_classes, h // 8, w // 8, 3))
+    protos = np.repeat(np.repeat(coarse, 8, axis=1), 8, axis=2)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    imgs = protos[labels] + noise * rng.normal(size=(n, h, w, 3))
+    return np.clip(imgs, 0.0, 1.0).astype(np.float32), labels

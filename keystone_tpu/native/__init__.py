@@ -1,0 +1,6 @@
+from keystone_tpu.native.ingest import (
+    TarImageReader,
+    PrefetchImageLoader,
+    decode_jpeg,
+    native_available,
+)

@@ -1,0 +1,300 @@
+// Host-side image ingestion: tar streaming + JPEG decode + threaded prefetch.
+//
+// TPU-native replacement for the reference's executor-side ingest path
+// (loaders/ImageLoaderUtils.scala:32-94: Hadoop FS tar streams + ImageIO
+// decode, serialized behind a class lock because ImageIO is thread-unsafe —
+// utils/images/ImageUtils.scala:17). Here decode is genuinely parallel:
+// a worker pool drains a shared tar-file queue, each worker owns a libjpeg
+// decompressor, and fixed-shape float batches come out of a bounded queue so
+// the host keeps the chips fed (SURVEY.md §7 hard part #6).
+//
+// C API (ctypes-consumed from keystone_tpu/native/ingest.py):
+//   ks_tar_open/next/read/close     — ustar entry iteration
+//   ks_jpeg_decode                  — JPEG bytes -> RGB u8
+//   ks_loader_create/next/destroy   — threaded prefetching batch loader
+//
+// Build: g++ -O2 -shared -fPIC ingest.cpp -ljpeg -o _ingest.so
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <csetjmp>
+#include <string>
+#include <vector>
+#include <queue>
+#include <thread>
+#include <mutex>
+#include <condition_variable>
+#include <atomic>
+
+#include <jpeglib.h>
+
+// ---------------------------------------------------------------- tar ------
+
+namespace {
+
+struct TarReader {
+  FILE* f = nullptr;
+  long entry_size = 0;      // payload bytes of current entry
+  long entry_remaining = 0; // not yet consumed
+};
+
+static long parse_octal(const char* p, int n) {
+  long v = 0;
+  for (int i = 0; i < n && p[i]; ++i) {
+    if (p[i] >= '0' && p[i] <= '7') v = v * 8 + (p[i] - '0');
+  }
+  return v;
+}
+
+// Advance past any unread payload + padding of the current entry.
+static void tar_skip_rest(TarReader* t) {
+  if (t->entry_size > 0) {
+    long consumed = t->entry_size - t->entry_remaining;
+    long padded = ((t->entry_size + 511) / 512) * 512;
+    fseek(t->f, padded - consumed, SEEK_CUR);
+    t->entry_size = t->entry_remaining = 0;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ks_tar_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  TarReader* t = new TarReader();
+  t->f = f;
+  return t;
+}
+
+// Returns payload size of the next regular-file entry (name copied into
+// name_out), 0 at end of archive, -1 on error.
+long ks_tar_next(void* h, char* name_out, int name_cap) {
+  TarReader* t = (TarReader*)h;
+  tar_skip_rest(t);
+  unsigned char header[512];
+  std::string pending_longname;
+  for (;;) {
+    if (fread(header, 1, 512, t->f) != 512) return 0;
+    // two zero blocks = end; a single all-zero header is terminal enough
+    bool all_zero = true;
+    for (int i = 0; i < 512; ++i)
+      if (header[i]) { all_zero = false; break; }
+    if (all_zero) return 0;
+
+    long size = parse_octal((const char*)header + 124, 12);
+    char type = header[156];
+    long padded = ((size + 511) / 512) * 512;
+
+    if (type == 'L') {  // GNU long name: payload is the real name
+      std::vector<char> buf(padded);
+      if (fread(buf.data(), 1, padded, t->f) != (size_t)padded) return -1;
+      pending_longname.assign(buf.data(), strnlen(buf.data(), size));
+      continue;
+    }
+    if (type == '0' || type == '\0') {  // regular file
+      std::string name = pending_longname.empty()
+          ? std::string((const char*)header, strnlen((const char*)header, 100))
+          : pending_longname;
+      snprintf(name_out, name_cap, "%s", name.c_str());
+      t->entry_size = t->entry_remaining = size;
+      return size;
+    }
+    // directory / link / pax header: skip payload
+    fseek(t->f, padded, SEEK_CUR);
+    pending_longname.clear();
+  }
+}
+
+long ks_tar_read(void* h, unsigned char* buf, long cap) {
+  TarReader* t = (TarReader*)h;
+  long n = t->entry_remaining < cap ? t->entry_remaining : cap;
+  if (n <= 0) return 0;
+  long got = (long)fread(buf, 1, n, t->f);
+  t->entry_remaining -= got;
+  if (t->entry_remaining == 0) {
+    long pad = ((t->entry_size + 511) / 512) * 512 - t->entry_size;
+    fseek(t->f, pad, SEEK_CUR);
+    t->entry_size = 0;
+  }
+  return got;
+}
+
+void ks_tar_close(void* h) {
+  TarReader* t = (TarReader*)h;
+  if (t->f) fclose(t->f);
+  delete t;
+}
+
+// --------------------------------------------------------------- jpeg ------
+
+struct KsJpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+static void ks_jpeg_error_exit(j_common_ptr cinfo) {
+  KsJpegErr* err = (KsJpegErr*)cinfo->err;
+  longjmp(err->jump, 1);
+}
+
+// Decode JPEG bytes into RGB u8 (h*w*3 into out, cap bytes). 0 on success.
+int ks_jpeg_decode(const unsigned char* data, long len, unsigned char* out,
+                   long cap, int* w, int* h, int* c) {
+  jpeg_decompress_struct cinfo;
+  KsJpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = ks_jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  int W = cinfo.output_width, H = cinfo.output_height, C = cinfo.output_components;
+  if ((long)W * H * C > cap) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out + (long)cinfo.output_scanline * W * C;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *w = W; *h = H; *c = C;
+  return 0;
+}
+
+// ------------------------------------------------------------- loader ------
+
+namespace {
+
+struct Sample {
+  std::vector<float> pixels;  // target_h * target_w * 3, [0,1], center-padded
+  std::string name;
+};
+
+struct Loader {
+  std::vector<std::string> tars;
+  int target_h, target_w;
+  std::atomic<size_t> next_tar{0};
+  std::queue<Sample> queue;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  size_t max_queue = 256;
+  std::vector<std::thread> workers;
+  std::atomic<int> live_workers{0};
+  bool done() { return live_workers.load() == 0; }
+};
+
+static void loader_worker(Loader* L) {
+  std::vector<unsigned char> payload, rgb;
+  char name[4096];
+  for (;;) {
+    size_t idx = L->next_tar.fetch_add(1);
+    if (idx >= L->tars.size()) break;
+    void* t = ks_tar_open(L->tars[idx].c_str());
+    if (!t) continue;
+    long sz;
+    while ((sz = ks_tar_next(t, name, sizeof(name))) > 0) {
+      payload.resize(sz);
+      long off = 0, got;
+      while (off < sz && (got = ks_tar_read(t, payload.data() + off, sz - off)) > 0)
+        off += got;
+      rgb.resize((size_t)8192 * 8192 * 3);
+      int w, h, c;
+      if (ks_jpeg_decode(payload.data(), sz, rgb.data(), (long)rgb.size(), &w, &h, &c) != 0)
+        continue;
+      if (w < 36 || h < 36) continue;  // reference rejects tiny images (ImageUtils.scala:16-46)
+
+      Sample s;
+      s.name = name;
+      s.pixels.assign((size_t)L->target_h * L->target_w * 3, 0.0f);
+      // center crop/pad into the fixed target frame
+      int copy_h = h < L->target_h ? h : L->target_h;
+      int copy_w = w < L->target_w ? w : L->target_w;
+      int src_y0 = (h - copy_h) / 2, src_x0 = (w - copy_w) / 2;
+      int dst_y0 = (L->target_h - copy_h) / 2, dst_x0 = (L->target_w - copy_w) / 2;
+      for (int y = 0; y < copy_h; ++y) {
+        const unsigned char* src = rgb.data() + ((size_t)(src_y0 + y) * w + src_x0) * c;
+        float* dst = s.pixels.data() + ((size_t)(dst_y0 + y) * L->target_w + dst_x0) * 3;
+        for (int x = 0; x < copy_w; ++x)
+          for (int ch = 0; ch < 3; ++ch)
+            dst[x * 3 + ch] = src[x * c + (c == 3 ? ch : 0)] / 255.0f;
+      }
+      std::unique_lock<std::mutex> lk(L->mu);
+      L->cv_put.wait(lk, [L] { return L->queue.size() < L->max_queue; });
+      L->queue.push(std::move(s));
+      L->cv_get.notify_one();
+    }
+    ks_tar_close(t);
+  }
+  if (L->live_workers.fetch_sub(1) == 1) {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->cv_get.notify_all();
+  }
+}
+
+}  // namespace
+
+void* ks_loader_create(const char** tar_paths, int n, int target_h,
+                       int target_w, int threads) {
+  Loader* L = new Loader();
+  for (int i = 0; i < n; ++i) L->tars.emplace_back(tar_paths[i]);
+  L->target_h = target_h;
+  L->target_w = target_w;
+  if (threads < 1) threads = 1;
+  L->live_workers = threads;
+  for (int i = 0; i < threads; ++i) L->workers.emplace_back(loader_worker, L);
+  return L;
+}
+
+// Fills up to `batch` images ((batch, H, W, 3) float32) and their entry names
+// ('\n'-joined into names_out). Returns the number filled; 0 at end of data.
+int ks_loader_next(void* h, int batch, float* out_imgs, char* names_out,
+                   long names_cap) {
+  Loader* L = (Loader*)h;
+  size_t img_floats = (size_t)L->target_h * L->target_w * 3;
+  int filled = 0;
+  std::string names;
+  while (filled < batch) {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_get.wait(lk, [L] { return !L->queue.empty() || L->done(); });
+    if (L->queue.empty()) break;
+    Sample s = std::move(L->queue.front());
+    L->queue.pop();
+    L->cv_put.notify_one();
+    lk.unlock();
+    memcpy(out_imgs + (size_t)filled * img_floats, s.pixels.data(),
+           img_floats * sizeof(float));
+    if (!names.empty()) names += '\n';
+    names += s.name;
+    ++filled;
+  }
+  snprintf(names_out, names_cap, "%s", names.c_str());
+  return filled;
+}
+
+void ks_loader_destroy(void* h) {
+  Loader* L = (Loader*)h;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->max_queue = (size_t)-1;  // unblock producers
+    L->next_tar = L->tars.size();
+    L->cv_put.notify_all();
+  }
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -1,0 +1,288 @@
+"""ctypes bindings for the native ingest library (ingest.cpp), with a
+pure-Python fallback (tarfile + PIL) when the toolchain is unavailable.
+
+The native path is the production ingest: parallel tar decode keeping TPU
+chips fed. The fallback keeps the loaders functional everywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import subprocess
+import tarfile
+import threading
+import queue as queue_mod
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from keystone_tpu.utils.logging import get_logger
+
+logger = get_logger("keystone_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_ingest.so")
+_SRC = os.path.join(_DIR, "ingest.cpp")
+_STAMP = _SO + ".srchash"  # hash of the source the .so was built from
+_lib = None
+_build_attempted = False
+
+
+def _src_hash() -> str:
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_attempted
+    if _build_attempted:
+        return None
+    _build_attempted = True
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-ljpeg", "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        with open(_STAMP, "w") as f:
+            f.write(_src_hash())
+        return ctypes.CDLL(_SO)
+    except Exception as e:  # toolchain/libjpeg missing: fall back to python
+        logger.warning("native ingest build failed (%s); using python fallback", e)
+        return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    fresh = False
+    if os.path.exists(_SO) and os.path.exists(_STAMP):
+        with open(_STAMP) as f:
+            fresh = f.read().strip() == _src_hash()
+    if fresh:
+        try:
+            _lib = ctypes.CDLL(_SO)
+        except OSError:
+            _lib = _build()
+    else:
+        _lib = _build()
+    if _lib is not None:
+        _lib.ks_tar_open.restype = ctypes.c_void_p
+        _lib.ks_tar_open.argtypes = [ctypes.c_char_p]
+        _lib.ks_tar_next.restype = ctypes.c_long
+        _lib.ks_tar_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        _lib.ks_tar_read.restype = ctypes.c_long
+        _lib.ks_tar_read.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
+        _lib.ks_tar_close.argtypes = [ctypes.c_void_p]
+        _lib.ks_jpeg_decode.restype = ctypes.c_int
+        _lib.ks_jpeg_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        _lib.ks_loader_create.restype = ctypes.c_void_p
+        _lib.ks_loader_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        _lib.ks_loader_next.restype = ctypes.c_int
+        _lib.ks_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.c_long,
+        ]
+        _lib.ks_loader_destroy.argtypes = [ctypes.c_void_p]
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+_scratch = threading.local()
+
+
+def decode_jpeg(data: bytes) -> Optional[np.ndarray]:
+    """JPEG bytes -> (h, w, 3) uint8 RGB, or None if undecodable."""
+    lib = _get_lib()
+    if lib is not None:
+        cap = 8192 * 8192 * 3
+        out = getattr(_scratch, "buf", None)
+        if out is None:
+            out = _scratch.buf = np.empty(cap, np.uint8)  # reused per thread
+        w = ctypes.c_int()
+        h = ctypes.c_int()
+        c = ctypes.c_int()
+        rc = lib.ks_jpeg_decode(
+            data, len(data), out.ctypes.data_as(ctypes.c_void_p), cap,
+            ctypes.byref(w), ctypes.byref(h), ctypes.byref(c),
+        )
+        if rc != 0:
+            return None
+        arr = out[: h.value * w.value * c.value].reshape(h.value, w.value, c.value)
+        if c.value == 1:
+            arr = np.repeat(arr, 3, axis=2)
+        return arr.copy()
+    try:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        return np.asarray(img)
+    except Exception:
+        return None
+
+
+class TarImageReader:
+    """Iterate (entry_name, rgb_uint8_image) over a tar of JPEGs."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self) -> Iterator[Tuple[str, np.ndarray]]:
+        lib = _get_lib()
+        if lib is not None:
+            yield from self._iter_native(lib)
+        else:
+            yield from self._iter_python()
+
+    def _iter_native(self, lib):
+        h = lib.ks_tar_open(self.path.encode())
+        if not h:
+            raise FileNotFoundError(self.path)
+        try:
+            name_buf = ctypes.create_string_buffer(4096)
+            while True:
+                size = lib.ks_tar_next(h, name_buf, 4096)
+                if size <= 0:
+                    break
+                buf = ctypes.create_string_buffer(size)
+                got = 0
+                while got < size:
+                    r = lib.ks_tar_read(
+                        h, ctypes.cast(ctypes.addressof(buf) + got, ctypes.c_char_p),
+                        size - got,
+                    )
+                    if r <= 0:
+                        break
+                    got += r
+                img = decode_jpeg(buf.raw[:got])
+                if img is not None and img.shape[0] >= 36 and img.shape[1] >= 36:
+                    yield name_buf.value.decode(errors="replace"), img
+        finally:
+            lib.ks_tar_close(h)
+
+    def _iter_python(self):
+        with tarfile.open(self.path) as tf:
+            for entry in tf:
+                if not entry.isfile():
+                    continue
+                data = tf.extractfile(entry).read()
+                img = decode_jpeg(data)
+                if img is not None and img.shape[0] >= 36 and img.shape[1] >= 36:
+                    yield entry.name, img
+
+
+def _center_frame(img: np.ndarray, target_h: int, target_w: int) -> np.ndarray:
+    """Center crop/pad to a fixed (target_h, target_w, 3) float32 [0,1] frame
+    — the static-shape gate into XLA."""
+    h, w = img.shape[:2]
+    out = np.zeros((target_h, target_w, 3), np.float32)
+    ch, cw = min(h, target_h), min(w, target_w)
+    sy, sx = (h - ch) // 2, (w - cw) // 2
+    dy, dx = (target_h - ch) // 2, (target_w - cw) // 2
+    out[dy : dy + ch, dx : dx + cw] = img[sy : sy + ch, sx : sx + cw, :3] / 255.0
+    return out
+
+
+class PrefetchImageLoader:
+    """Threaded batch loader over tar archives: yields (images (n, H, W, 3)
+    float32 in [0,1], entry names). Native path uses the C++ worker pool;
+    fallback runs Python threads over TarImageReader."""
+
+    def __init__(
+        self,
+        tar_paths: Sequence[str],
+        target_h: int,
+        target_w: int,
+        num_threads: int = 4,
+    ):
+        self.tar_paths = list(tar_paths)
+        self.target_h = target_h
+        self.target_w = target_w
+        self.num_threads = num_threads
+
+    def batches(self, batch_size: int) -> Iterator[Tuple[np.ndarray, List[str]]]:
+        lib = _get_lib()
+        if lib is not None:
+            yield from self._batches_native(lib, batch_size)
+        else:
+            yield from self._batches_python(batch_size)
+
+    def _batches_native(self, lib, batch_size: int):
+        paths = (ctypes.c_char_p * len(self.tar_paths))(
+            *[p.encode() for p in self.tar_paths]
+        )
+        h = lib.ks_loader_create(
+            paths, len(self.tar_paths), self.target_h, self.target_w,
+            self.num_threads,
+        )
+        try:
+            while True:
+                out = np.empty(
+                    (batch_size, self.target_h, self.target_w, 3), np.float32
+                )
+                names_buf = ctypes.create_string_buffer(batch_size * 4096)
+                n = lib.ks_loader_next(
+                    h, batch_size, out.ctypes.data_as(ctypes.c_void_p), names_buf,
+                    len(names_buf),
+                )
+                if n <= 0:
+                    break
+                names = names_buf.value.decode(errors="replace").split("\n")
+                yield out[:n], names[:n]
+                if n < batch_size:
+                    break
+        finally:
+            lib.ks_loader_destroy(h)
+
+    def _batches_python(self, batch_size: int):
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=256)
+        path_iter = iter(self.tar_paths)
+        lock = threading.Lock()
+
+        def worker():
+            try:
+                while True:
+                    with lock:
+                        path = next(path_iter, None)
+                    if path is None:
+                        break
+                    for name, img in TarImageReader(path):
+                        q.put((name, _center_frame(img, self.target_h, self.target_w)))
+            except Exception as e:
+                logger.warning("ingest worker failed on %s: %s", path, e)
+            finally:
+                q.put(None)  # sentinel must always arrive or batches() hangs
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(self.num_threads)
+        ]
+        for t in threads:
+            t.start()
+        finished = 0
+        batch: list = []
+        names: list = []
+        while finished < self.num_threads:
+            item = q.get()
+            if item is None:
+                finished += 1
+                continue
+            names.append(item[0])
+            batch.append(item[1])
+            if len(batch) == batch_size:
+                yield np.stack(batch), names
+                batch, names = [], []
+        if batch:
+            yield np.stack(batch), names

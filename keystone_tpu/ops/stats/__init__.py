@@ -1,4 +1,5 @@
 from keystone_tpu.ops.stats.nodes import (
+    ColumnSampler,
     CosineRandomFeatures,
     LinearRectifier,
     NormalizeRows,

@@ -128,6 +128,25 @@ class CosineRandomFeatures(Transformer):
         return CosineRandomFeatures(w=w * gamma, b=b)
 
 
+class ColumnSampler(FunctionNode):
+    """Sample descriptors across a batch of per-item descriptor sets.
+
+    Reference: ``nodes/stats/Sampling.scala:11-29`` (samples columns of an
+    RDD of descriptor matrices). Here items are (n_items, n_desc, d): the
+    sample is over the flattened descriptor axis.
+    """
+
+    jittable: ClassVar[bool] = False
+    num_samples: int = struct.field(pytree_node=False)
+    seed: int = struct.field(pytree_node=False, default=42)
+
+    def apply_batch(self, descs):
+        flat = np.asarray(descs).reshape(-1, descs.shape[-1])
+        return jnp.asarray(
+            Sampler(size=self.num_samples, seed=self.seed).apply_batch(flat)
+        )
+
+
 class Sampler(FunctionNode):
     """Uniform row sample without replacement (host-side, concrete sizes).
 

@@ -1,0 +1,98 @@
+"""Shared Fisher-vector featurization: the extract → PCA → GMM → FV →
+normalize chain used by VOCSIFTFisher and ImageNetSiftLcsFV.
+
+Reference: ``constructFisherFeaturizer`` (``ImageNetSiftLcsFV.scala:29-39``)
+and the PCA/GMM branches (``:41-148``, ``VOCSIFTFisher.scala:40-78``),
+including the load-or-fit switches for precomputed PCA/GMM artifacts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import Chain, Transformer, chain
+from keystone_tpu.learning.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
+from keystone_tpu.learning.pca import BatchPCATransformer, PCAEstimator
+from keystone_tpu.ops.images.fisher_vector import FisherVector
+from keystone_tpu.ops.stats import (
+    BatchSignedHellingerMapper,
+    ColumnSampler,
+    NormalizeRows,
+)
+from keystone_tpu.ops.util import MatrixVectorizer
+from keystone_tpu.utils import Timer, get_logger
+
+logger = get_logger("keystone_tpu.pipelines.fisher")
+
+
+def fisher_featurizer(gmm: GaussianMixtureModel) -> Chain:
+    """FV → vectorize → L2 → signed-Hellinger → L2
+    (``ImageNetSiftLcsFV.scala:29-39``; the Float→Double cast is a no-op on
+    TPU, see ``ops/util/nodes.py::Cast``)."""
+    return chain(
+        FisherVector(gmm=gmm),
+        MatrixVectorizer(),
+        NormalizeRows(),
+        BatchSignedHellingerMapper(),
+        NormalizeRows(),
+    )
+
+
+def fit_fisher_branch(
+    extractor: Transformer,
+    train_images: jax.Array,
+    pca_dims: int,
+    vocab_size: int,
+    num_pca_samples: int,
+    num_gmm_samples: int,
+    seed: int = 42,
+    hellinger_first: bool = False,
+    pca_file: Optional[str] = None,
+    gmm_files: Optional[Tuple[str, str, str]] = None,
+) -> Tuple[Chain, jax.Array]:
+    """Fit one descriptor branch; returns (featurizer chain, train features).
+
+    ``hellinger_first`` applies BatchSignedHellingerMapper to raw descriptors
+    before PCA (the SIFT branch, ``ImageNetSiftLcsFV.scala:52-53``).
+    ``pca_file`` / ``gmm_files`` load precomputed artifacts instead of
+    fitting (``VOCSIFTFisher.scala:40-64``).
+    """
+    stages = [extractor]
+    if hellinger_first:
+        stages.append(BatchSignedHellingerMapper())
+    desc_node = chain(*stages)
+
+    with Timer("fisher.extract_descriptors"):
+        descs = desc_node(train_images)  # (n, n_desc, d)
+
+    if pca_file:
+        pca_mat = jnp.asarray(np.loadtxt(pca_file, delimiter=","), jnp.float32)
+        pca = BatchPCATransformer(pca_mat=pca_mat[:, :pca_dims])
+    else:
+        with Timer("fisher.fit_pca"):
+            sample = ColumnSampler(num_pca_samples, seed=seed)(descs)
+            pca = PCAEstimator(pca_dims).fit_batch(sample)
+
+    with Timer("fisher.apply_pca"):
+        reduced = pca(descs)  # (n, n_desc, pca_dims)
+
+    if gmm_files:
+        gmm = GaussianMixtureModel.load(*gmm_files)
+    else:
+        with Timer("fisher.fit_gmm"):
+            gmm_sample = ColumnSampler(num_gmm_samples, seed=seed + 1)(reduced)
+            gmm = GaussianMixtureModelEstimator(vocab_size).fit(gmm_sample)
+
+    fisher = fisher_featurizer(gmm)
+    with Timer("fisher.encode"):
+        features = fisher(reduced)  # (n, pca_dims * 2 * vocab_size)
+
+    featurizer = chain(desc_node, pca, fisher)
+    logger.info(
+        "fisher branch: descriptors %s -> features %s", descs.shape, features.shape
+    )
+    return featurizer, features

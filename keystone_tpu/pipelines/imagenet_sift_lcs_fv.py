@@ -1,0 +1,143 @@
+"""ImageNetSiftLcsFV: the flagship-scale workload — SIFT+FV and LCS+FV
+branches zipped, weighted block coordinate descent, top-5 error.
+
+Reference: ``pipelines/images/imagenet/ImageNetSiftLcsFV.scala:26-271``
+(flagship config: blockSize 4096, λ=6e-5, mixtureWeight=0.25, 1e7 PCA/GMM
+samples, ``:197-218``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax.numpy as jnp
+
+from keystone_tpu.core.config import parse_config
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.learning.block_weighted import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.loaders.imagenet import (
+    IMAGENET_NUM_CLASSES,
+    load_imagenet,
+    synthetic_imagenet,
+)
+from keystone_tpu.ops.images import GrayScaler, LCSExtractor, SIFTExtractor
+from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels, TopKClassifier
+from keystone_tpu.pipelines._fisher import fit_fisher_branch
+from keystone_tpu.parallel import get_mesh, use_mesh
+from keystone_tpu.utils import Timer, get_logger
+from keystone_tpu.utils.stats import get_err_percent
+
+logger = get_logger("keystone_tpu.pipelines.imagenet_sift_lcs_fv")
+
+
+@dataclasses.dataclass
+class ImageNetSiftLcsFVConfig:
+    train_location: str = ""
+    train_labels: str = ""
+    test_location: str = ""
+    test_labels: str = ""
+    sift_pca_dim: int = 64
+    lcs_pca_dim: int = 64
+    vocab_size: int = 16
+    num_pca_samples: int = 10000000
+    num_gmm_samples: int = 10000000
+    lam: float = 6e-5
+    mixture_weight: float = 0.25
+    block_size: int = 4096
+    num_iter: int = 1
+    image_hw: int = 256
+    lcs_stride: int = 4
+    lcs_border: int = 16
+    lcs_patch: int = 6
+    seed: int = 42
+    # synthetic fallback
+    synthetic_train: int = 96
+    synthetic_test: int = 48
+    synthetic_classes: int = 8
+    synthetic_hw: int = 96
+
+
+def run(config: ImageNetSiftLcsFVConfig) -> dict:
+    if config.train_location:
+        hw = (config.image_hw, config.image_hw)
+        train = load_imagenet(config.train_location, config.train_labels, hw)
+        test = load_imagenet(config.test_location, config.test_labels, hw)
+        num_classes = IMAGENET_NUM_CLASSES
+    else:
+        hw = (config.synthetic_hw, config.synthetic_hw)
+        train = synthetic_imagenet(
+            config.synthetic_train, config.synthetic_classes, hw, seed=1
+        )
+        test = synthetic_imagenet(
+            config.synthetic_test, config.synthetic_classes, hw, seed=2
+        )
+        num_classes = config.synthetic_classes
+
+    results: dict = {}
+    with use_mesh(get_mesh()), Timer("ImageNetSiftLcsFV.pipeline") as total:
+        train_imgs = jnp.asarray(train[0])
+        test_imgs = jnp.asarray(test[0])
+        gray_train = GrayScaler()(train_imgs)[..., 0]
+        gray_test = GrayScaler()(test_imgs)[..., 0]
+
+        # SIFT branch: Hellinger on raw descriptors before PCA (:52-53)
+        sift_featurizer, sift_train = fit_fisher_branch(
+            SIFTExtractor(),
+            gray_train,
+            config.sift_pca_dim,
+            config.vocab_size,
+            config.num_pca_samples,
+            config.num_gmm_samples,
+            seed=config.seed,
+            hellinger_first=True,
+        )
+        # LCS branch on RGB (:96-148)
+        lcs_featurizer, lcs_train = fit_fisher_branch(
+            LCSExtractor(config.lcs_stride, config.lcs_border, config.lcs_patch),
+            train_imgs,
+            config.lcs_pca_dim,
+            config.vocab_size,
+            config.num_pca_samples,
+            config.num_gmm_samples,
+            seed=config.seed + 7,
+        )
+
+        # ZipVectors over the two branches (:179-180)
+        train_feats = jnp.concatenate([sift_train, lcs_train], axis=1)
+        labels = ClassLabelIndicatorsFromIntLabels(num_classes)(jnp.asarray(train[1]))
+
+        with Timer("fit.block_weighted_least_squares"):
+            model = BlockWeightedLeastSquaresEstimator(
+                config.block_size, config.num_iter, config.lam, config.mixture_weight
+            ).fit(train_feats, labels)
+
+        with Timer("eval.top5"):
+            test_feats = jnp.concatenate(
+                [sift_featurizer(gray_test), lcs_featurizer(test_imgs)], axis=1
+            )
+            scores = model(test_feats)
+            top5 = TopKClassifier(k=min(5, num_classes))(scores)
+            results["test_top5_error"] = get_err_percent(top5, test[1])
+            top1 = TopKClassifier(k=1)(scores)
+            results["test_top1_error"] = get_err_percent(top1, test[1])
+
+    results["wallclock_s"] = total.elapsed
+    logger.info(
+        "TEST top-5 error: %.2f%%  top-1: %.2f%%",
+        results["test_top5_error"],
+        results["test_top1_error"],
+    )
+    return results
+
+
+def main(argv=None):
+    print(
+        json.dumps(
+            run(parse_config(ImageNetSiftLcsFVConfig, argv, prog="ImageNetSiftLcsFV"))
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

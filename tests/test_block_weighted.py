@@ -1,0 +1,110 @@
+"""BlockWeightedLeastSquares tests, mirroring the reference suite's
+independently-recomputed-solution checks
+(BlockWeightedLeastSquaresSuite.scala:18-97)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.core.dataset import pad_rows
+from keystone_tpu.learning import BlockLeastSquaresEstimator
+from keystone_tpu.learning.block_weighted import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+
+
+def _toy(rng, n=120, d=10, c=3, balanced=True):
+    if balanced:
+        labels = np.repeat(np.arange(c), n // c).astype(np.int32)
+    else:
+        labels = rng.choice(c, size=n, p=[0.6, 0.3, 0.1]).astype(np.int32)
+    protos = rng.normal(size=(c, d)).astype(np.float32)
+    x = protos[labels] + 0.5 * rng.normal(size=(n, d)).astype(np.float32)
+    rng.shuffle(labels)  # decouple row order from class order
+    x = protos[labels] + 0.5 * rng.normal(size=(n, d)).astype(np.float32)
+    ind = np.asarray(ClassLabelIndicatorsFromIntLabels(c)(jnp.asarray(labels)))
+    return x, labels, ind
+
+
+def _weighted_oracle_single_block(x, ind, lam, w):
+    """Numpy recomputation of the single-block, single-pass solution from the
+    mixture-of-empiricals definitions (weighted distribution D_c =
+    (1-w)·All + w·Class_c per class column)."""
+    n, d = x.shape
+    c = ind.shape[1]
+    labels = ind.argmax(1)
+    counts = np.bincount(labels, minlength=c)
+    jlm = 2 * w + 2 * (1 - w) * counts / n - 1
+    R = ind - jlm
+    mu = x.mean(0)
+    pop_cov = x.T @ x / n - np.outer(mu, mu)
+    pop_xtr = x.T @ R / n
+    class_means = np.stack([x[labels == k].mean(0) for k in range(c)])
+    res_class_means = np.stack([R[labels == k].mean(0) for k in range(c)])
+    residual_mean = res_class_means.mean(0)
+    W = np.zeros((d, c))
+    for k in range(c):
+        xc = x[labels == k]
+        mc = class_means[k]
+        cc = (xc - mc).T @ (xc - mc) / counts[k]
+        cxtr = xc.T @ R[labels == k, k] / counts[k]
+        md = mc - mu
+        jxtx = (1 - w) * pop_cov + w * cc + (1 - w) * w * np.outer(md, md)
+        jm = w * mc + (1 - w) * mu
+        mmw = (1 - w) * residual_mean[k] + w * R[labels == k, k].mean()
+        jxtr = (1 - w) * pop_xtr[:, k] + w * cxtr - jm * mmw
+        W[:, k] = np.linalg.solve(jxtx + lam * np.eye(d), jxtr)
+    joint_means = w * class_means + (1 - w) * mu
+    b = jlm - np.einsum("cd,dc->c", joint_means, W)
+    return W, b
+
+
+def test_weighted_single_block_matches_numpy_oracle(rng):
+    x, labels, ind = _toy(rng, balanced=False)
+    lam, w = 0.5, 0.25
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=x.shape[1], num_iter=1, lam=lam, mixture_weight=w
+    )
+    model = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    W_exp, b_exp = _weighted_oracle_single_block(x.astype(np.float64), ind, lam, w)
+    np.testing.assert_allclose(np.asarray(model.w), W_exp, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(model.b), b_exp, atol=2e-3)
+
+
+def test_weighted_w0_balanced_equals_plain_bcd(rng):
+    """With mixture_weight→0 and balanced classes the weighted solver reduces
+    to centered BCD with lam scaled by n (normalized grams)."""
+    x, labels, ind = _toy(rng, n=120, c=3, balanced=True)
+    n = x.shape[0]
+    lam = 0.3
+    wls = BlockWeightedLeastSquaresEstimator(
+        block_size=5, num_iter=2, lam=lam, mixture_weight=0.0
+    ).fit(jnp.asarray(x), jnp.asarray(ind))
+    bcd = BlockLeastSquaresEstimator(block_size=5, num_iter=2, lam=lam * n).fit(
+        jnp.asarray(x), jnp.asarray(ind)
+    )
+    pred_w = np.asarray(wls(jnp.asarray(x)))
+    pred_b = np.asarray(bcd(jnp.asarray(x)))
+    np.testing.assert_allclose(pred_w, pred_b, atol=5e-3)
+
+
+def test_weighted_masked_rows_ignored(rng):
+    x, labels, ind = _toy(rng, n=90, balanced=False)
+    est = BlockWeightedLeastSquaresEstimator(5, 1, 0.5, 0.25)
+    m1 = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    xp, mask = pad_rows(jnp.asarray(x), 16)
+    indp, _ = pad_rows(jnp.asarray(ind), 16)
+    xp = xp.at[90:].set(123.0)
+    indp = indp.at[90:].set(1.0)
+    m2 = est.fit(xp, indp, mask=mask)
+    np.testing.assert_allclose(np.asarray(m1.w), np.asarray(m2.w), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(m1.b), np.asarray(m2.b), atol=1e-3)
+
+
+def test_weighted_multiblock_classifies_imbalanced(rng):
+    x, labels, ind = _toy(rng, n=200, d=16, balanced=False)
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=8, num_iter=3, lam=0.1, mixture_weight=0.25
+    )
+    model = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    preds = np.asarray(model(jnp.asarray(x))).argmax(1)
+    assert (preds == labels).mean() > 0.95
