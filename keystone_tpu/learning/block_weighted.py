@@ -51,9 +51,10 @@ def _prepare(labels_pm1, mask, num_classes: int):
 
 
 @jax.jit
-def _class_col_means(R, cls_sorted, counts, num_classes_arr):
+def _class_col_means(R, cls_sorted, counts):
     """Per-class column means of the residual, then the mean over classes —
-    the reference's residualMean (``:161-165,283-287``)."""
+    the reference's residualMean (``:161-165,283-287``). The class count is
+    ``R.shape[1]``: labels are class-indicator columns."""
     c = R.shape[1]
     sums = jax.ops.segment_sum(R, cls_sorted, num_segments=c + 1)[:c]
     per_class = sums / jnp.maximum(counts[:, None].astype(jnp.float32), 1.0)
@@ -156,7 +157,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             2.0 * w + 2.0 * (1.0 - w) * counts.astype(jnp.float32) / n_eff - 1.0
         )
         R = (Ls - joint_label_mean) * valid[:, None]
-        _, residual_mean = _class_col_means(R, cls_sorted, counts, num_classes)
+        _, residual_mean = _class_col_means(R, cls_sorted, counts)
 
         max_nc = int(jnp.max(counts))  # one host sync; static chunk size
         max_nc = min(n, max(8, -(-max_nc // 8) * 8))
@@ -198,7 +199,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 )
                 models[b] = models[b] + dW
                 R = _apply_update(R, Xb, dW, valid)
-                _, residual_mean = _class_col_means(R, cls_sorted, counts, num_classes)
+                _, residual_mean = _class_col_means(R, cls_sorted, counts)
 
         W = jnp.concatenate(models, axis=0)[:d]
         joint_means = jnp.concatenate(

@@ -36,11 +36,15 @@ def iter_imagenet_batches(
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Yields (images (n, H, W, 3) float32, labels (n,) int32)."""
     labels_map = load_labels_map(labels_path)
+    # Only tar archives: a labels file / README sitting in data_dir must not
+    # be handed to the tar reader.
     tars = sorted(
         os.path.join(data_dir, f)
         for f in os.listdir(data_dir)
-        if not os.path.isdir(os.path.join(data_dir, f))
+        if f.endswith(".tar") and not os.path.isdir(os.path.join(data_dir, f))
     )
+    if not tars:
+        raise FileNotFoundError(f"no .tar archives found in {data_dir}")
     loader = PrefetchImageLoader(tars, target_hw[0], target_hw[1], num_threads)
     for imgs, names in loader.batches(batch_size):
         labels = np.array(

@@ -51,7 +51,13 @@ def load_voc(
                 continue
             imgs_list.append(imgs[i])
             label_lists.append(labels_map[fname])
-    max_labels = max((len(l) for l in label_lists), default=1)
+    if not imgs_list:
+        raise ValueError(
+            f"no images in {data_path} matched prefix={name_prefix!r} and the "
+            f"{len(labels_map)} filenames in {labels_path}; check the archive "
+            "layout against the prefix/labels CSV"
+        )
+    max_labels = max(len(l) for l in label_lists)
     labels = np.full((len(label_lists), max_labels), -1, np.int32)
     for i, ls in enumerate(label_lists):
         labels[i, : len(ls)] = ls

@@ -69,20 +69,21 @@ void* ks_tar_open(const char* path) {
   return t;
 }
 
-// Returns payload size of the next regular-file entry (name copied into
-// name_out), 0 at end of archive, -1 on error.
+// Returns payload size (>= 0) of the next regular-file entry (name copied
+// into name_out), -1 at end of archive, -2 on error. A 0-byte regular file
+// yields 0 and must NOT be treated as end-of-archive.
 long ks_tar_next(void* h, char* name_out, int name_cap) {
   TarReader* t = (TarReader*)h;
   tar_skip_rest(t);
   unsigned char header[512];
   std::string pending_longname;
   for (;;) {
-    if (fread(header, 1, 512, t->f) != 512) return 0;
+    if (fread(header, 1, 512, t->f) != 512) return -1;
     // two zero blocks = end; a single all-zero header is terminal enough
     bool all_zero = true;
     for (int i = 0; i < 512; ++i)
       if (header[i]) { all_zero = false; break; }
-    if (all_zero) return 0;
+    if (all_zero) return -1;
 
     long size = parse_octal((const char*)header + 124, 12);
     char type = header[156];
@@ -90,7 +91,7 @@ long ks_tar_next(void* h, char* name_out, int name_cap) {
 
     if (type == 'L') {  // GNU long name: payload is the real name
       std::vector<char> buf(padded);
-      if (fread(buf.data(), 1, padded, t->f) != (size_t)padded) return -1;
+      if (fread(buf.data(), 1, padded, t->f) != (size_t)padded) return -2;
       pending_longname.assign(buf.data(), strnlen(buf.data(), size));
       continue;
     }
@@ -138,6 +139,29 @@ struct KsJpegErr {
 static void ks_jpeg_error_exit(j_common_ptr cinfo) {
   KsJpegErr* err = (KsJpegErr*)cinfo->err;
   longjmp(err->jump, 1);
+}
+
+// Read only the header: output dims without decoding. 0 on success.
+int ks_jpeg_peek(const unsigned char* data, long len, int* w, int* h, int* c) {
+  jpeg_decompress_struct cinfo;
+  KsJpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = ks_jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_calc_output_dimensions(&cinfo);
+  *w = cinfo.output_width; *h = cinfo.output_height; *c = cinfo.output_components;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
 }
 
 // Decode JPEG bytes into RGB u8 (h*w*3 into out, cap bytes). 0 on success.
@@ -206,13 +230,15 @@ static void loader_worker(Loader* L) {
     void* t = ks_tar_open(L->tars[idx].c_str());
     if (!t) continue;
     long sz;
-    while ((sz = ks_tar_next(t, name, sizeof(name))) > 0) {
+    while ((sz = ks_tar_next(t, name, sizeof(name))) >= 0) {
+      if (sz == 0) continue;  // empty entry, not end-of-archive
       payload.resize(sz);
       long off = 0, got;
       while (off < sz && (got = ks_tar_read(t, payload.data() + off, sz - off)) > 0)
         off += got;
-      rgb.resize((size_t)8192 * 8192 * 3);
       int w, h, c;
+      if (ks_jpeg_peek(payload.data(), sz, &w, &h, &c) != 0) continue;
+      if ((size_t)w * h * c > rgb.size()) rgb.resize((size_t)w * h * c);
       if (ks_jpeg_decode(payload.data(), sz, rgb.data(), (long)rgb.size(), &w, &h, &c) != 0)
         continue;
       if (w < 36 || h < 36) continue;  // reference rejects tiny images (ImageUtils.scala:16-46)

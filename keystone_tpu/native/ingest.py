@@ -82,6 +82,12 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_int),
         ]
+        _lib.ks_jpeg_peek.restype = ctypes.c_int
+        _lib.ks_jpeg_peek.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
         _lib.ks_loader_create.restype = ctypes.c_void_p
         _lib.ks_loader_create.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
@@ -100,30 +106,28 @@ def native_available() -> bool:
     return _get_lib() is not None
 
 
-_scratch = threading.local()
-
-
 def decode_jpeg(data: bytes) -> Optional[np.ndarray]:
     """JPEG bytes -> (h, w, 3) uint8 RGB, or None if undecodable."""
     lib = _get_lib()
     if lib is not None:
-        cap = 8192 * 8192 * 3
-        out = getattr(_scratch, "buf", None)
-        if out is None:
-            out = _scratch.buf = np.empty(cap, np.uint8)  # reused per thread
         w = ctypes.c_int()
         h = ctypes.c_int()
         c = ctypes.c_int()
+        # Header-only peek sizes the output exactly (no giant scratch buffer).
+        if lib.ks_jpeg_peek(data, len(data), ctypes.byref(w), ctypes.byref(h),
+                            ctypes.byref(c)) != 0:
+            return None
+        out = np.empty(h.value * w.value * c.value, np.uint8)
         rc = lib.ks_jpeg_decode(
-            data, len(data), out.ctypes.data_as(ctypes.c_void_p), cap,
+            data, len(data), out.ctypes.data_as(ctypes.c_void_p), out.size,
             ctypes.byref(w), ctypes.byref(h), ctypes.byref(c),
         )
         if rc != 0:
             return None
-        arr = out[: h.value * w.value * c.value].reshape(h.value, w.value, c.value)
+        arr = out.reshape(h.value, w.value, c.value)
         if c.value == 1:
             arr = np.repeat(arr, 3, axis=2)
-        return arr.copy()
+        return arr
     try:
         from PIL import Image
 
@@ -154,8 +158,10 @@ class TarImageReader:
             name_buf = ctypes.create_string_buffer(4096)
             while True:
                 size = lib.ks_tar_next(h, name_buf, 4096)
-                if size <= 0:
-                    break
+                if size < 0:
+                    break  # end of archive (-1) or malformed entry (-2)
+                if size == 0:
+                    continue  # empty regular file, keep iterating
                 buf = ctypes.create_string_buffer(size)
                 got = 0
                 while got < size:
@@ -258,10 +264,14 @@ class PrefetchImageLoader:
                         path = next(path_iter, None)
                     if path is None:
                         break
-                    for name, img in TarImageReader(path):
-                        q.put((name, _center_frame(img, self.target_h, self.target_w)))
-            except Exception as e:
-                logger.warning("ingest worker failed on %s: %s", path, e)
+                    try:
+                        for name, img in TarImageReader(path):
+                            q.put(
+                                (name, _center_frame(img, self.target_h, self.target_w))
+                            )
+                    except Exception as e:
+                        # one bad tar must not stop this worker's remaining tars
+                        logger.warning("ingest worker failed on %s: %s", path, e)
             finally:
                 q.put(None)  # sentinel must always arrive or batches() hangs
 

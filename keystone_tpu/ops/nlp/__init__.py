@@ -1,0 +1,51 @@
+"""NLP nodes: string preprocessing, n-grams, vocab encoding, language models.
+
+Reference package: ``src/main/scala/nodes/nlp/`` (see SURVEY.md §2.6).
+"""
+
+from keystone_tpu.ops.nlp.strings import Tokenizer, Trim, LowerCase
+from keystone_tpu.ops.nlp.ngrams import (
+    NGram,
+    NGramsFeaturizer,
+    NGramsCounts,
+    NGramsCountsMode,
+    encoded_ngrams,
+)
+from keystone_tpu.ops.nlp.indexers import (
+    BackoffIndexer,
+    NaiveBitPackIndexer,
+    NGramIndexerImpl,
+    PackedNGramIndexer,
+)
+from keystone_tpu.ops.nlp.word_frequency import (
+    WordFrequencyEncoder,
+    WordFrequencyTransformer,
+    OOV,
+)
+from keystone_tpu.ops.nlp.stupid_backoff import (
+    StupidBackoffEstimator,
+    StupidBackoffModel,
+)
+from keystone_tpu.ops.nlp.corenlp import CoreNLPFeatureExtractor, lemmatize
+
+__all__ = [
+    "Tokenizer",
+    "Trim",
+    "LowerCase",
+    "NGram",
+    "NGramsFeaturizer",
+    "NGramsCounts",
+    "NGramsCountsMode",
+    "encoded_ngrams",
+    "BackoffIndexer",
+    "NaiveBitPackIndexer",
+    "NGramIndexerImpl",
+    "PackedNGramIndexer",
+    "WordFrequencyEncoder",
+    "WordFrequencyTransformer",
+    "OOV",
+    "StupidBackoffEstimator",
+    "StupidBackoffModel",
+    "CoreNLPFeatureExtractor",
+    "lemmatize",
+]

@@ -1,0 +1,101 @@
+"""Lemmatizing + entity-substituting n-gram featurizer.
+
+Reference: ``nodes/nlp/CoreNLPFeatureExtractor.scala:18-45`` — tokenize,
+lemmatize, and NER-tag text with the external "sista processors" CoreNLP
+stack, substitute entity class tokens for recognized entities, then emit
+n-grams.
+
+That external NLP stack has no place in a TPU framework image, so this node
+reproduces the *pipeline behavior* (token -> lemma -> entity-substituted
+n-grams) with a dependency-free rule engine:
+
+- tokenization: word/number regex;
+- lemmatization: a small English suffix stripper (plural/verb/adverb rules
+  with a common-irregulars table) — intentionally lightweight, not Porter;
+- entity substitution: numbers -> ``<NUM>``, capitalized non-sentence-initial
+  tokens -> ``<ENT>`` (the same role CoreNLP's NER classes play in the
+  reference's features).
+
+The node is host-side; its output feeds the same TermFrequency /
+CommonSparseFeatures path as the plain tokenizer.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import ClassVar, List, Sequence, Tuple
+
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.ops.nlp.ngrams import NGramsFeaturizer
+
+_TOKEN_RE = re.compile(r"[A-Za-z]+|[0-9]+(?:\.[0-9]+)?")
+
+_IRREGULAR = {
+    "is": "be", "are": "be", "was": "be", "were": "be", "been": "be", "am": "be",
+    "has": "have", "had": "have", "having": "have",
+    "does": "do", "did": "do", "done": "do", "doing": "do",
+    "went": "go", "gone": "go", "goes": "go",
+    "said": "say", "says": "say",
+    "made": "make", "men": "man", "women": "woman", "children": "child",
+    "mice": "mouse", "feet": "foot", "teeth": "tooth", "people": "person",
+    "better": "good", "best": "good", "worse": "bad", "worst": "bad",
+}
+
+
+def lemmatize(word: str) -> str:
+    """Rule-based English lemmatizer (lowercased input)."""
+    w = word.lower()
+    if w in _IRREGULAR:
+        return _IRREGULAR[w]
+    n = len(w)
+    if n > 4 and w.endswith("ies"):
+        return w[:-3] + "y"
+    if n > 4 and w.endswith(("sses", "ches", "shes", "xes", "zes")):
+        return w[:-2]
+    if n > 3 and w.endswith("s") and not w.endswith(("ss", "us", "is")):
+        return w[:-1]
+    if n > 5 and w.endswith("ing"):
+        stem = w[:-3]
+        if len(stem) > 2 and stem[-1] == stem[-2]:  # running -> run
+            stem = stem[:-1]
+        return stem
+    if n > 4 and w.endswith("ed"):
+        stem = w[:-2]
+        if len(stem) > 2 and stem[-1] == stem[-2]:  # stopped -> stop
+            stem = stem[:-1]
+        return stem
+    if n > 4 and w.endswith("ly"):
+        return w[:-2]
+    return w
+
+
+class CoreNLPFeatureExtractor(Transformer):
+    """Text -> entity-substituted lemma n-grams (orders ``orders``)."""
+
+    jittable: ClassVar[bool] = False
+    orders: Tuple[int, ...] = struct.field(pytree_node=False, default=(1, 2))
+
+    def apply(self, text: str) -> List[tuple]:
+        tokens: List[str] = []
+        sentence_start = True
+        prev_end = 0
+        for m in _TOKEN_RE.finditer(text):
+            # sentence boundary lives in the raw text between tokens
+            # ("bark. The" -> '. ' separates), not in the token itself
+            if any(ch in ".!?" for ch in text[prev_end : m.start()]):
+                sentence_start = True
+            tok = m.group(0)
+            if tok[0].isdigit():
+                tokens.append("<NUM>")
+            elif tok[0].isupper() and not sentence_start:
+                tokens.append("<ENT>")
+            else:
+                tokens.append(lemmatize(tok))
+            sentence_start = False
+            prev_end = m.end()
+        return NGramsFeaturizer(orders=self.orders).apply(tokens)
+
+    def apply_batch(self, texts: Sequence[str]) -> List[List[tuple]]:
+        return [self.apply(t) for t in texts]

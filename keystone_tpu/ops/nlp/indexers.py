@@ -1,0 +1,170 @@
+"""N-gram indexers: pack word-id n-grams into integer keys.
+
+Reference: ``nodes/nlp/indexers.scala`` —
+
+- ``BackoffIndexer`` trait (``indexers.scala:22-46``): ``pack`` / ``unpack`` /
+  ``removeFarthestWord`` / ``removeCurrentWord`` / ``ngramOrder``.
+- ``NaiveBitPackIndexer`` (``indexers.scala:49-112``): up to 3 word ids of
+  20 bits each plus 4 control bits in one 64-bit key.
+- ``NGramIndexerImpl`` (``indexers.scala:115-135``): sequence-based, order <= 5.
+
+The TPU-native addition is :class:`PackedNGramIndexer`: vocab-sized bit-widths
+and *vectorized* packing of whole ``[B, order]`` id batches into int64 key
+tensors. Packed keys are what make the language model a device program — count
+tables become sorted int64 arrays and lookup becomes ``searchsorted`` on the
+TPU (see ``stupid_backoff.py``), replacing the reference's ``reduceByKey``
+shuffle and per-partition hash maps.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+WORD_BITS = 20
+WORD_MASK = (1 << WORD_BITS) - 1
+MAX_NAIVE_ORDER = 3
+ORDER_SHIFT = 3 * WORD_BITS  # control bits live above the three word slots
+
+
+class BackoffIndexer:
+    """Protocol shared by all indexers (``indexers.scala:22-46``)."""
+
+    min_order: int = 1
+    max_order: int = 2
+
+    def pack(self, ngram: Sequence[int]):
+        raise NotImplementedError
+
+    def unpack(self, key) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    def ngram_order(self, key) -> int:
+        raise NotImplementedError
+
+    def remove_farthest_word(self, key):
+        """Drop the leftmost (farthest-context) word: (a,b,c) -> (b,c)."""
+        raise NotImplementedError
+
+    def remove_current_word(self, key):
+        """Drop the rightmost (current) word: (a,b,c) -> (a,b)."""
+        raise NotImplementedError
+
+
+class NaiveBitPackIndexer(BackoffIndexer):
+    """Bit-pack <=3 word ids (20 bits each) + order bits into one int.
+
+    Layout (ours, not a copy of the reference's): the *current* word occupies
+    the low 20 bits, earlier context words the next slots, and the order the
+    bits above ``ORDER_SHIFT``. This makes ``remove_current_word`` a right
+    shift and ``remove_farthest_word`` a mask — both O(1), both vectorizable.
+    """
+
+    min_order = 1
+    max_order = MAX_NAIVE_ORDER
+
+    def pack(self, ngram: Sequence[int]) -> int:
+        order = len(ngram)
+        if not 1 <= order <= MAX_NAIVE_ORDER:
+            raise ValueError(f"order must be 1..{MAX_NAIVE_ORDER}, got {order}")
+        key = 0
+        # ngram[-1] is the current word -> low bits.
+        for i, w in enumerate(reversed(ngram)):
+            if not 0 <= w <= WORD_MASK:
+                raise ValueError(f"word id {w} out of 20-bit range")
+            key |= (w + 0) << (i * WORD_BITS)
+        return key | (order << ORDER_SHIFT)
+
+    def ngram_order(self, key: int) -> int:
+        return key >> ORDER_SHIFT
+
+    def unpack(self, key: int) -> Tuple[int, ...]:
+        order = self.ngram_order(key)
+        return tuple(
+            (key >> (i * WORD_BITS)) & WORD_MASK for i in range(order - 1, -1, -1)
+        )
+
+    def remove_farthest_word(self, key: int) -> int:
+        order = self.ngram_order(key)
+        if order < 2:
+            raise ValueError("cannot shorten a unigram")
+        new_order = order - 1
+        payload = key & ((1 << (new_order * WORD_BITS)) - 1)
+        return payload | (new_order << ORDER_SHIFT)
+
+    def remove_current_word(self, key: int) -> int:
+        order = self.ngram_order(key)
+        if order < 2:
+            raise ValueError("cannot shorten a unigram")
+        payload = (key & ~(-1 << ORDER_SHIFT)) >> WORD_BITS
+        return payload | ((order - 1) << ORDER_SHIFT)
+
+
+class NGramIndexerImpl(BackoffIndexer):
+    """Sequence-based indexer, order <= 5 (``indexers.scala:115-135``)."""
+
+    min_order = 1
+    max_order = 5
+
+    def pack(self, ngram: Sequence[int]) -> Tuple[int, ...]:
+        if not self.min_order <= len(ngram) <= self.max_order:
+            raise ValueError(f"order must be 1..{self.max_order}")
+        return tuple(ngram)
+
+    def unpack(self, key: Tuple[int, ...]) -> Tuple[int, ...]:
+        return tuple(key)
+
+    def ngram_order(self, key: Tuple[int, ...]) -> int:
+        return len(key)
+
+    def remove_farthest_word(self, key: Tuple[int, ...]) -> Tuple[int, ...]:
+        return tuple(key[1:])
+
+    def remove_current_word(self, key: Tuple[int, ...]) -> Tuple[int, ...]:
+        return tuple(key[:-1])
+
+
+class PackedNGramIndexer:
+    """Vocab-sized vectorized packing: ``[B, order]`` int ids -> int64 keys.
+
+    Bit width per word is ``ceil(log2(vocab_size + 1))`` (id ``vocab_size`` is
+    reserved so that every real id is distinguishable from an empty slot);
+    ``order * bits`` must fit in 63 bits. For a 1M-word vocab that allows
+    orders up to 3; a 256k vocab allows order 3; a 4k vocab order 5. Longer
+    orders fall back to :class:`NGramIndexerImpl` on the host.
+
+    Keys of the same order sort lexicographically by (farthest, ..., current)
+    word, so a sorted key table supports binary-search lookup on device.
+    """
+
+    def __init__(self, vocab_size: int, max_order: int):
+        self.vocab_size = int(vocab_size)
+        self.max_order = int(max_order)
+        self.word_bits = max(1, int(np.ceil(np.log2(self.vocab_size + 1))))
+        if self.word_bits * self.max_order > 63:
+            raise ValueError(
+                f"cannot pack order-{max_order} ngrams over a {vocab_size}-word "
+                f"vocab into 63 bits ({self.word_bits} bits/word)"
+            )
+
+    def pack_batch(self, ngrams: np.ndarray) -> np.ndarray:
+        """``ngrams``: integer ``[B, order]`` (same order per call) -> int64 ``[B]``.
+
+        Farthest word lands in the highest bits (lexicographic sort order).
+        Works identically on numpy and jax arrays (pure shifts/adds).
+        """
+        order = ngrams.shape[-1]
+        keys = ngrams[..., 0].astype(np.int64)
+        for i in range(1, order):
+            keys = (keys << self.word_bits) | ngrams[..., i].astype(np.int64)
+        return keys
+
+    def drop_current_batch(self, keys: np.ndarray) -> np.ndarray:
+        """Packed ``remove_current_word``: order-n keys -> order-(n-1) keys."""
+        return keys >> self.word_bits
+
+    def drop_farthest_batch(self, keys: np.ndarray, order: int) -> np.ndarray:
+        """Packed ``remove_farthest_word`` for keys of the given order."""
+        mask = (np.int64(1) << (self.word_bits * (order - 1))) - np.int64(1)
+        return keys & mask

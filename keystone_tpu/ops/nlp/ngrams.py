@@ -1,0 +1,111 @@
+"""N-gram featurization and counting.
+
+Reference: ``nodes/nlp/ngrams.scala`` —
+
+- ``NGramsFeaturizer[T]`` (``ngrams.scala:18-89``): for each token sequence,
+  emit all n-grams of every order in ``orders`` (consecutive orders, e.g.
+  1..2).
+- ``NGram[T]`` (``ngrams.scala:98-129``): hashable n-gram wrapper. Python
+  tuples already hash/compare by value, so the wrapper here is just ``tuple``.
+- ``NGramsCounts[T]`` (``ngrams.scala:150-183``): count n-grams. ``Default``
+  mode sums counts across partitions (``reduceByKey`` + sort by descending
+  count); ``NoAdd`` keeps per-partition counts un-merged. On a TPU mesh there
+  is no partitioner to preserve, so ``NoAdd`` simply skips the global sort —
+  both modes produce exact global counts from one host hash-aggregation.
+
+Token-level n-gram work is host-side (tuples of words). The TPU path is the
+*encoded* one: :class:`~keystone_tpu.ops.nlp.word_frequency.WordFrequencyEncoder`
+maps words to dense int32 ids, after which n-gram formation, packing, and
+counting are integer-tensor programs (see ``indexers.py`` / ``stupid_backoff.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+from enum import Enum
+from typing import ClassVar, List, Sequence, Tuple
+
+import flax.struct as struct
+import numpy as np
+
+from keystone_tpu.core.pipeline import FunctionNode, Transformer
+
+NGram = tuple  # value-hashable n-gram (ngrams.scala:98-129)
+
+
+class NGramsFeaturizer(Transformer):
+    """All n-grams of consecutive orders per token sequence.
+
+    ``NGramsFeaturizer(1 to 2)(docs)`` → per doc, every unigram then every
+    bigram, in sequence order (``ngrams.scala:56-79``).
+    """
+
+    jittable: ClassVar[bool] = False
+    orders: Tuple[int, ...] = struct.field(pytree_node=False, default=(1, 2))
+
+    def __post_init__(self):
+        orders = tuple(self.orders)
+        if not orders or min(orders) < 1:
+            raise ValueError(f"orders must be >= 1, got {orders}")
+
+    def apply(self, tokens: Sequence) -> List[tuple]:
+        out: List[tuple] = []
+        n_tokens = len(tokens)
+        for order in self.orders:
+            for i in range(n_tokens - order + 1):
+                out.append(tuple(tokens[i : i + order]))
+        return out
+
+    def apply_batch(self, docs: Sequence[Sequence]) -> List[List[tuple]]:
+        return [self.apply(d) for d in docs]
+
+
+class NGramsCountsMode(Enum):
+    DEFAULT = "default"  # global counts, sorted by descending count
+    NO_ADD = "noadd"  # global counts, unsorted (reference: no cross-partition add)
+
+
+class NGramsCounts(FunctionNode):
+    """Count n-grams across the whole corpus.
+
+    Reference ``ngrams.scala:150-183``: per-partition ``JHashMap`` counting,
+    then ``reduceByKey`` (+ ``sortBy(-count)``) in Default mode. Here one host
+    pass builds exact global counts; Default additionally sorts by descending
+    count like the reference.
+
+    Input: list of per-doc n-gram lists (output of :class:`NGramsFeaturizer`).
+    Output: list of ``(ngram, count)`` pairs.
+    """
+
+    jittable: ClassVar[bool] = False
+    mode: NGramsCountsMode = struct.field(
+        pytree_node=False, default=NGramsCountsMode.DEFAULT
+    )
+
+    def apply_batch(self, docs: Sequence[Sequence[tuple]]) -> List[Tuple[tuple, int]]:
+        counts: collections.Counter = collections.Counter()
+        for doc in docs:
+            counts.update(doc)
+        items = list(counts.items())
+        if self.mode is NGramsCountsMode.DEFAULT:
+            items.sort(key=lambda kv: -kv[1])
+        return items
+
+
+def encoded_ngrams(ids: np.ndarray, lengths: np.ndarray, order: int) -> np.ndarray:
+    """Vectorized n-gram formation over an encoded, padded token batch.
+
+    ``ids``: int32 ``[num_docs, max_len]`` word ids (pad = -1);
+    ``lengths``: ``[num_docs]`` true lengths. Returns all ``order``-grams as an
+    int32 ``[total, order]`` array — the tensorized analog of
+    ``NGramsFeaturizer`` for the post-encoding (device) path.
+    """
+    ids = np.asarray(ids)
+    n_docs, max_len = ids.shape
+    if max_len < order:
+        return np.zeros((0, order), dtype=np.int32)
+    # Sliding windows over each row: [n_docs, max_len - order + 1, order]
+    windows = np.stack([ids[:, i : max_len - order + 1 + i] for i in range(order)], -1)
+    pos = np.arange(max_len - order + 1)[None, :]
+    valid = pos + order <= np.asarray(lengths)[:, None]
+    return windows[valid].astype(np.int32)

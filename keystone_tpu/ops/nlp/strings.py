@@ -1,0 +1,65 @@
+"""String preprocessing nodes: Tokenizer / Trim / LowerCase.
+
+Reference: ``nodes/nlp/StringUtils.scala:13,20,28`` — regex split, trim,
+lowercase over ``RDD[String]``.
+
+Strings never reach the TPU: these are host-side nodes (``jittable = False``)
+whose bulk path maps over a Python list. Everything downstream of
+:class:`~keystone_tpu.ops.nlp.word_frequency.WordFrequencyEncoder` is integer
+tensors and runs on device.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import ClassVar, List, Sequence
+
+import flax.struct as struct
+
+from keystone_tpu.core.pipeline import Transformer
+
+
+class Trim(Transformer):
+    """``_.trim`` (``StringUtils.scala:20``)."""
+
+    jittable: ClassVar[bool] = False
+
+    def apply(self, x: str) -> str:
+        return x.strip()
+
+    def apply_batch(self, xs: Sequence[str]) -> List[str]:
+        return [x.strip() for x in xs]
+
+
+class LowerCase(Transformer):
+    """``_.toLowerCase`` (``StringUtils.scala:28``)."""
+
+    jittable: ClassVar[bool] = False
+
+    def apply(self, x: str) -> str:
+        return x.lower()
+
+    def apply_batch(self, xs: Sequence[str]) -> List[str]:
+        return [x.lower() for x in xs]
+
+
+class Tokenizer(Transformer):
+    """Regex-split tokenizer (``StringUtils.scala:13``; default ``"[\\s]+"``).
+
+    Matches the reference's ``String.split(pattern)`` semantics: split on the
+    pattern, drop trailing empty strings (Java ``split`` behavior), keep a
+    leading empty token when the string starts with a separator.
+    """
+
+    jittable: ClassVar[bool] = False
+    pattern: str = struct.field(pytree_node=False, default="[\\s]+")
+
+    def apply(self, x: str) -> List[str]:
+        toks = re.split(self.pattern, x)
+        # Java split drops trailing empties only.
+        while toks and toks[-1] == "":
+            toks.pop()
+        return toks
+
+    def apply_batch(self, xs: Sequence[str]) -> List[List[str]]:
+        return [self.apply(x) for x in xs]

@@ -1,0 +1,215 @@
+"""Stupid Backoff language model (Brants et al. 2007).
+
+Reference: ``nodes/nlp/StupidBackoff.scala`` —
+
+- ``InitialBigramPartitioner`` (``StupidBackoff.scala:25-57``) partitions
+  n-grams by their first two context words so each partition can score its
+  n-grams against a *local* hash map (``scoreLocally``, ``:60-92``).
+- ``StupidBackoffEstimator.fit`` (``:155-180``): ``reduceByKey`` with that
+  partitioner, then per-partition recursive scoring; the model serves
+  ``score(ngram)`` via ``RDD.lookup`` (``:104-117``).
+
+TPU-native redesign — no partitioner, no shuffle, no per-partition maps:
+
+- Counts for each order live in one **sorted int64 packed-key table** (a pair
+  of arrays) built host-side with ``np.unique`` and shipped to device.
+- Scoring a batch of n-grams is a single XLA program: pack suffixes of every
+  backoff level with bit shifts, binary-search each level's table
+  (``jnp.searchsorted`` — O(log N) per query on sorted keys), and fold the
+  backoff recursion bottom-up with ``jnp.where``:
+
+      S_1(w)        = count(w) / num_tokens
+      S_k(suffix_k) = count_k > 0 ? count_k / count(context)
+                                  : alpha * S_{k-1}(suffix_{k-1})
+
+  The data-locality trick the reference builds from a custom partitioner
+  (co-locating an n-gram with its backoff contexts) is free here: every level
+  of the recursion is just another vectorized gather on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import ClassVar, Dict, List, Sequence, Tuple
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.ops.nlp.indexers import PackedNGramIndexer
+
+DEFAULT_ALPHA = 0.4
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _score_batch_device(
+    model: "StupidBackoffModel", ngrams: jnp.ndarray, order: int, word_bits: int
+) -> jnp.ndarray:
+    """Score ``[B, order]`` id n-grams; one fused XLA program per (order, shapes).
+
+    Must run under ``jax.experimental.enable_x64`` so the int64 packed keys
+    survive tracing (jax's default 32-bit mode would silently truncate any
+    vocab × order combination wider than 31 bits).
+    """
+    b = ngrams.shape[0]
+    total = jnp.maximum(model.num_tokens, 1.0)
+
+    def lookup(keys: jnp.ndarray, valid: jnp.ndarray, k: int):
+        """Count of each order-k packed key (0 where absent/invalid)."""
+        if k == 1:
+            ids = jnp.clip(keys, 0, model.unigram_counts.shape[0] - 1).astype(jnp.int32)
+            c = model.unigram_counts[ids]
+        else:
+            tk = model.table_keys[k - 2]
+            tc = model.table_counts[k - 2]
+            if tk.shape[0] == 0:
+                return jnp.zeros_like(keys, dtype=jnp.float32)
+            pos = jnp.searchsorted(tk, keys)
+            pos = jnp.clip(pos, 0, tk.shape[0] - 1)
+            c = jnp.where(tk[pos] == keys, tc[pos], 0.0)
+        return jnp.where(valid, c, 0.0)
+
+    def pack_suffix(k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Packed key of the last-k-word suffix + validity (no OOV ids)."""
+        suffix = ngrams[:, order - k :]
+        valid = jnp.all(suffix >= 0, axis=1)
+        key = suffix[:, 0].astype(jnp.int64)
+        for i in range(1, k):
+            key = (key << word_bits) | jnp.where(
+                suffix[:, i] >= 0, suffix[:, i], 0
+            ).astype(jnp.int64)
+        return key, valid
+
+    # Bottom-up backoff fold.
+    uni_keys, uni_valid = pack_suffix(1)
+    score = lookup(uni_keys, uni_valid, 1) / total
+    prev_keys = uni_keys
+    for k in range(2, order + 1):
+        keys, valid = pack_suffix(k)
+        c = lookup(keys, valid, k)
+        # context of the k-suffix = its first k-1 words = drop current word.
+        ctx_keys = keys >> word_bits
+        ctx = lookup(ctx_keys, valid, k - 1)
+        hit = (c > 0) & (ctx > 0)
+        score = jnp.where(hit, c / jnp.maximum(ctx, 1.0), model.alpha * score)
+        prev_keys = keys
+    del prev_keys
+    return score.reshape((b,))
+
+
+class StupidBackoffModel(Transformer):
+    """Fitted LM: per-order sorted count tables, device-batch scoring."""
+
+    jittable: ClassVar[bool] = False
+
+    # table_keys[i] / table_counts[i] hold order-(i+2) n-grams.
+    table_keys: Tuple[jnp.ndarray, ...]
+    table_counts: Tuple[jnp.ndarray, ...]
+    unigram_counts: jnp.ndarray  # dense [vocab] float32
+    num_tokens: jnp.ndarray  # scalar float32
+    alpha: float = struct.field(pytree_node=False, default=DEFAULT_ALPHA)
+    word_bits: int = struct.field(pytree_node=False, default=20)
+    max_order: int = struct.field(pytree_node=False, default=3)
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self.unigram_counts.shape[0])
+
+    def score_batch(self, ngrams: np.ndarray) -> np.ndarray:
+        """Score a ``[B, order]`` batch of id n-grams (pad/OOV id = -1)."""
+        ngrams = np.asarray(ngrams, dtype=np.int32)
+        if ngrams.ndim != 2:
+            raise ValueError("score_batch expects [B, order]")
+        order = ngrams.shape[1]
+        if not 1 <= order <= self.max_order:
+            raise ValueError(f"order must be 1..{self.max_order}")
+        with jax.enable_x64():
+            return np.asarray(
+                _score_batch_device(self, jnp.asarray(ngrams), order, self.word_bits)
+            )
+
+    def apply(self, ngram: Sequence[int]) -> float:
+        """Single-item serving path (the reference's ``RDD.lookup`` analog)."""
+        return float(self.score_batch(np.asarray([ngram]))[0])
+
+    def apply_batch(self, ngrams) -> np.ndarray:
+        return self.score_batch(np.asarray(ngrams))
+
+    def scores(self) -> List[Tuple[Tuple[int, ...], float]]:
+        """Score every trained n-gram (the reference's ``scoresRDD``)."""
+        out: List[Tuple[Tuple[int, ...], float]] = []
+        for i, keys in enumerate(self.table_keys):
+            order = i + 2
+            keys_np = np.asarray(keys)
+            if keys_np.size == 0:
+                continue
+            ngrams = np.zeros((keys_np.size, order), dtype=np.int32)
+            rest = keys_np.copy()
+            for j in range(order - 1, -1, -1):
+                ngrams[:, j] = (rest & ((1 << self.word_bits) - 1)).astype(np.int32)
+                rest >>= self.word_bits
+            s = self.score_batch(ngrams)
+            out.extend((tuple(map(int, ng)), float(v)) for ng, v in zip(ngrams, s))
+        return out
+
+
+class StupidBackoffEstimator:
+    """Build the count tables from n-gram counts + unigram counts.
+
+    Reference: ``StupidBackoff.scala:96-180``. ``unigram_counts`` is keyed by
+    encoded word id (the output of ``WordFrequencyEncoder``); ``fit`` takes
+    ``[(id_tuple, count)]`` pairs for orders >= 2 (the output of
+    ``NGramsCounts`` over encoded docs). Duplicate n-grams (e.g. NoAdd-mode
+    partials) are summed here.
+    """
+
+    def __init__(self, unigram_counts: Dict[int, int], alpha: float = DEFAULT_ALPHA):
+        self.unigram_counts = dict(unigram_counts)
+        self.alpha = float(alpha)
+
+    def fit(self, ngram_counts: Sequence[Tuple[Tuple[int, ...], int]]) -> StupidBackoffModel:
+        vocab_size = (max(self.unigram_counts) + 1) if self.unigram_counts else 1
+        max_order = max((len(ng) for ng, _ in ngram_counts), default=2)
+        indexer = PackedNGramIndexer(vocab_size, max_order)
+
+        by_order: Dict[int, List[Tuple[Tuple[int, ...], int]]] = {}
+        for ng, c in ngram_counts:
+            if any(w < 0 for w in ng):
+                continue  # OOV-containing n-grams are unscorable
+            by_order.setdefault(len(ng), []).append((ng, c))
+
+        table_keys: List[jnp.ndarray] = []
+        table_counts: List[jnp.ndarray] = []
+        for order in range(2, max_order + 1):
+            entries = by_order.get(order, [])
+            if entries:
+                arr = np.array([ng for ng, _ in entries], dtype=np.int64)
+                keys = indexer.pack_batch(arr)
+                counts = np.array([c for _, c in entries], dtype=np.float64)
+                # merge duplicates, sort by key: one np pass (reduceByKey analog)
+                uniq, inv = np.unique(keys, return_inverse=True)
+                summed = np.zeros(uniq.shape[0], dtype=np.float64)
+                np.add.at(summed, inv, counts)
+                # Tables stay host-side numpy so int64 keys reach the device
+                # intact (they are converted under enable_x64 at trace time).
+                table_keys.append(uniq)
+                table_counts.append(summed.astype(np.float32))
+            else:
+                table_keys.append(np.zeros((0,), dtype=np.int64))
+                table_counts.append(np.zeros((0,), dtype=np.float32))
+
+        uni = np.zeros((vocab_size,), dtype=np.float32)
+        for wid, c in self.unigram_counts.items():
+            if wid >= 0:
+                uni[wid] = c
+        return StupidBackoffModel(
+            table_keys=tuple(table_keys),
+            table_counts=tuple(table_counts),
+            unigram_counts=uni,
+            num_tokens=np.float32(uni.sum()),
+            alpha=self.alpha,
+            word_bits=indexer.word_bits,
+            max_order=max_order,
+        )

@@ -1,0 +1,71 @@
+"""Word-frequency vocabulary encoding.
+
+Reference: ``nodes/nlp/WordFrequencyEncoder.scala:8-63`` — fit a vocabulary
+ordered by descending corpus frequency (most frequent word -> id 0), broadcast
+the word->id map, encode documents with OOV -> -1, and expose per-id unigram
+counts (consumed by ``StupidBackoffEstimator``).
+
+This node is the host/device frontier of the NLP stack: strings in, dense
+int32 id tensors out. Downstream n-gram counting and language-model scoring
+operate purely on the encoded tensors.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import ClassVar, Dict, List, Sequence, Tuple
+
+import flax.struct as struct
+import numpy as np
+
+from keystone_tpu.core.pipeline import Estimator, Transformer
+
+OOV = -1
+
+
+class WordFrequencyTransformer(Transformer):
+    """Encode token sequences with a fitted frequency-ranked vocabulary."""
+
+    jittable: ClassVar[bool] = False
+    word_index: Dict[str, int] = struct.field(pytree_node=False)
+    unigram_counts: Dict[int, int] = struct.field(pytree_node=False)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.word_index)
+
+    def apply(self, tokens: Sequence[str]) -> List[int]:
+        wi = self.word_index
+        return [wi.get(t, OOV) for t in tokens]
+
+    def apply_batch(self, docs: Sequence[Sequence[str]]) -> List[List[int]]:
+        return [self.apply(d) for d in docs]
+
+    def encode_padded(
+        self, docs: Sequence[Sequence[str]]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Encode to a padded int32 ``[num_docs, max_len]`` batch (+ lengths),
+        the tensor layout the device-side n-gram ops consume."""
+        encoded = self.apply_batch(docs)
+        lengths = np.array([len(e) for e in encoded], dtype=np.int32)
+        max_len = max(1, int(lengths.max(initial=0)))
+        ids = np.full((len(encoded), max_len), OOV, dtype=np.int32)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e
+        return ids, lengths
+
+
+class WordFrequencyEncoder(Estimator):
+    """Fit the frequency-ranked vocabulary (``WordFrequencyEncoder.scala:13-30``)."""
+
+    def fit(self, docs: Sequence[Sequence[str]]) -> WordFrequencyTransformer:
+        counts: collections.Counter = collections.Counter()
+        for doc in docs:
+            counts.update(doc)
+        # Descending count; ties broken by first-seen order like a stable sort.
+        ranked = sorted(counts.items(), key=lambda kv: -kv[1])
+        word_index = {w: i for i, (w, _) in enumerate(ranked)}
+        unigram_counts = {i: c for i, (_, c) in enumerate(ranked)}
+        return WordFrequencyTransformer(
+            word_index=word_index, unigram_counts=unigram_counts
+        )

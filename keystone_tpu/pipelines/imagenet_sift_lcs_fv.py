@@ -14,7 +14,6 @@ import json
 import jax.numpy as jnp
 
 from keystone_tpu.core.config import parse_config
-from keystone_tpu.evaluation import MulticlassClassifierEvaluator
 from keystone_tpu.learning.block_weighted import BlockWeightedLeastSquaresEstimator
 from keystone_tpu.loaders.imagenet import (
     IMAGENET_NUM_CLASSES,

@@ -1,0 +1,228 @@
+"""NLP node tests.
+
+Mirrors the reference suites: ``nodes/nlp/NGramIndexerSuite.scala`` (bit-pack
+round trips), ``pipelines/nlp/StupidBackoffSuite.scala`` (end-to-end toy-corpus
+scores checked against hand-computed backoff values).
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.nlp import (
+    CoreNLPFeatureExtractor,
+    LowerCase,
+    NGramIndexerImpl,
+    NGramsCounts,
+    NGramsCountsMode,
+    NGramsFeaturizer,
+    NaiveBitPackIndexer,
+    PackedNGramIndexer,
+    StupidBackoffEstimator,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+    encoded_ngrams,
+    lemmatize,
+)
+
+
+class TestStrings:
+    def test_tokenizer_java_split_semantics(self):
+        t = Tokenizer("[\\s]+")
+        assert t.apply("a b  c") == ["a", "b", "c"]
+        # Java split keeps a leading empty, drops trailing empties
+        assert t.apply(" a b ") == ["", "a", "b"]
+        assert t.apply_batch(["x y", "z"]) == [["x", "y"], ["z"]]
+
+    def test_trim_lowercase(self):
+        assert Trim()(["  A  ", "b "]) == ["A", "b"]
+        assert LowerCase()(["AbC"]) == ["abc"]
+
+
+class TestNGrams:
+    def test_featurizer_orders(self):
+        f = NGramsFeaturizer(orders=(1, 2))
+        out = f.apply(["a", "b", "c"])
+        assert out == [("a",), ("b",), ("c",), ("a", "b"), ("b", "c")]
+
+    def test_featurizer_short_doc(self):
+        f = NGramsFeaturizer(orders=(2, 3))
+        assert f.apply(["x"]) == []
+
+    def test_counts_default_sorted(self):
+        docs = [[("a",), ("b",), ("a",)], [("a",)]]
+        counts = NGramsCounts(mode=NGramsCountsMode.DEFAULT)(docs)
+        assert counts[0] == (("a",), 3)
+        assert dict(counts)[("b",)] == 1
+
+    def test_encoded_ngrams_matches_naive(self, rng):
+        ids = rng.integers(0, 50, size=(6, 12)).astype(np.int32)
+        lengths = rng.integers(2, 13, size=6).astype(np.int32)
+        for i, l in enumerate(lengths):
+            ids[i, l:] = -1
+        for order in (2, 3):
+            got = encoded_ngrams(ids, lengths, order)
+            expected = []
+            for i in range(6):
+                row = ids[i, : lengths[i]]
+                for j in range(len(row) - order + 1):
+                    expected.append(row[j : j + order])
+            np.testing.assert_array_equal(got, np.array(expected))
+
+
+class TestIndexers:
+    def test_bitpack_round_trip(self):
+        idx = NaiveBitPackIndexer()
+        for ngram in [(7,), (1, 2), (3, 4, 5), (0, 0, 0), ((1 << 20) - 1, 9)]:
+            key = idx.pack(ngram)
+            assert idx.unpack(key) == tuple(ngram)
+            assert idx.ngram_order(key) == len(ngram)
+
+    def test_bitpack_shortening(self):
+        idx = NaiveBitPackIndexer()
+        key = idx.pack((3, 4, 5))
+        assert idx.unpack(idx.remove_farthest_word(key)) == (4, 5)
+        assert idx.unpack(idx.remove_current_word(key)) == (3, 4)
+        with pytest.raises(ValueError):
+            idx.remove_current_word(idx.pack((1,)))
+
+    def test_seq_indexer(self):
+        idx = NGramIndexerImpl()
+        key = idx.pack((9, 8, 7, 6, 5))
+        assert idx.ngram_order(key) == 5
+        assert idx.remove_farthest_word(key) == (8, 7, 6, 5)
+        assert idx.remove_current_word(key) == (9, 8, 7, 6)
+
+    def test_packed_batch_lexicographic(self):
+        idx = PackedNGramIndexer(vocab_size=1000, max_order=3)
+        ngrams = np.array([[1, 2, 3], [1, 2, 4], [2, 0, 0]], dtype=np.int64)
+        keys = idx.pack_batch(ngrams)
+        assert keys[0] < keys[1] < keys[2]  # lexicographic order preserved
+        np.testing.assert_array_equal(
+            idx.drop_current_batch(keys), idx.pack_batch(ngrams[:, :2])
+        )
+        np.testing.assert_array_equal(
+            idx.drop_farthest_batch(keys, 3), idx.pack_batch(ngrams[:, 1:])
+        )
+
+    def test_packed_rejects_overflow(self):
+        with pytest.raises(ValueError):
+            PackedNGramIndexer(vocab_size=1 << 25, max_order=5)
+
+
+class TestWordFrequencyEncoder:
+    def test_rank_and_oov(self):
+        docs = [["b", "a", "b"], ["b", "c"]]
+        enc = WordFrequencyEncoder().fit(docs)
+        assert enc.word_index["b"] == 0  # most frequent -> id 0
+        assert enc.apply(["b", "zzz"]) == [0, -1]
+        assert enc.unigram_counts[0] == 3
+        ids, lengths = enc.encode_padded([["a"], ["b", "c"]])
+        assert ids.shape == (2, 2)
+        assert ids[0, 1] == -1 and list(lengths) == [1, 2]
+
+
+class TestStupidBackoff:
+    """Hand-computed backoff scores on a toy corpus
+    (StupidBackoffSuite.scala:48-70 analog)."""
+
+    @pytest.fixture()
+    def model(self):
+        corpus = [["a", "b", "c"], ["a", "b", "d"], ["b", "c"]]
+        enc = WordFrequencyEncoder().fit(corpus)
+        encoded = enc.apply_batch(corpus)
+        ngrams = NGramsFeaturizer(orders=(2, 3))(encoded)
+        counts = NGramsCounts(mode=NGramsCountsMode.NO_ADD)(ngrams)
+        model = StupidBackoffEstimator(enc.unigram_counts, alpha=0.4).fit(counts)
+        return enc, model
+
+    def test_seen_bigram(self, model):
+        enc, m = model
+        a, b = enc.word_index["a"], enc.word_index["b"]
+        # S(b|a) = c(ab)/c(a) = 2/2
+        assert m.apply((a, b)) == pytest.approx(1.0)
+
+    def test_seen_trigram(self, model):
+        enc, m = model
+        a, b, c = (enc.word_index[w] for w in "abc")
+        # S(c|ab) = c(abc)/c(ab) = 1/2
+        assert m.apply((a, b, c)) == pytest.approx(0.5)
+
+    def test_backoff_to_bigram(self, model):
+        enc, m = model
+        a, b, c, d = (enc.word_index[w] for w in "abcd")
+        # (c,b,d) unseen -> 0.4 * S(d|b); (b,d) seen: c(bd)/c(b) = 1/3
+        assert m.apply((c, b, d)) == pytest.approx(0.4 * (1.0 / 3.0))
+
+    def test_backoff_to_unigram(self, model):
+        enc, m = model
+        c, d = enc.word_index["c"], enc.word_index["d"]
+        # (d,c) unseen -> 0.4 * S(c) = 0.4 * c(c)/N; N=8 tokens, c(c)=2
+        assert m.apply((d, c)) == pytest.approx(0.4 * 2.0 / 8.0)
+
+    def test_unigram_score(self, model):
+        enc, m = model
+        b = enc.word_index["b"]
+        assert m.apply((b,)) == pytest.approx(3.0 / 8.0)
+
+    def test_oov_scores_zero_base(self, model):
+        enc, m = model
+        b = enc.word_index["b"]
+        # (-1, b) backs off to unigram b
+        assert m.apply((-1, b)) == pytest.approx(0.4 * 3.0 / 8.0)
+
+    def test_batch_matches_single(self, model):
+        enc, m = model
+        a, b, c = (enc.word_index[w] for w in "abc")
+        batch = np.array([[a, b], [b, c], [c, a]], dtype=np.int32)
+        got = m.score_batch(batch)
+        for row, s in zip(batch, got):
+            assert m.apply(tuple(row)) == pytest.approx(float(s))
+
+    def test_scores_enumeration(self, model):
+        enc, m = model
+        scores = dict(m.scores())
+        a, b = enc.word_index["a"], enc.word_index["b"]
+        assert scores[(a, b)] == pytest.approx(1.0)
+        # every trained ngram present (3 unique bigrams + 2 trigrams)
+        assert len(scores) == 5
+
+    def test_wide_vocab_keys_survive_device(self):
+        """Packed keys wider than 31 bits must not be truncated (x64 path)."""
+        big = 1 << 18
+        uni = {0: 5, 1: 3, big: 2}
+        counts = [((big, 1), 2), ((big, 0), 1)]
+        m = StupidBackoffEstimator(uni, alpha=0.4).fit(counts)
+        assert m.apply((big, 1)) == pytest.approx(2.0 / 2.0)
+        assert m.apply((big, 0)) == pytest.approx(1.0 / 2.0)
+        # unseen pair with wide ids backs off cleanly
+        assert m.apply((1, big)) == pytest.approx(0.4 * 2.0 / 10.0)
+
+
+class TestCoreNLP:
+    def test_lemmatize(self):
+        assert lemmatize("running") == "run"
+        assert lemmatize("cities") == "city"
+        assert lemmatize("stopped") == "stop"
+        assert lemmatize("children") == "child"
+        assert lemmatize("cats") == "cat"
+
+    def test_entity_substitution(self):
+        ext = CoreNLPFeatureExtractor(orders=(1,))
+        grams = ext.apply("The cats saw Paris in 1990.")
+        toks = [g[0] for g in grams]
+        assert "<NUM>" in toks and "<ENT>" in toks
+        assert "cat" in toks  # lemmatized
+        assert toks[0] == "the"  # sentence-initial capital not an entity
+
+    def test_bigrams(self):
+        ext = CoreNLPFeatureExtractor(orders=(1, 2))
+        grams = ext.apply("dogs run")
+        assert ("dog", "run") in grams
+
+    def test_sentence_boundaries_reset_entity_detection(self):
+        # 'The' after a period is sentence-initial, not an entity
+        ext = CoreNLPFeatureExtractor(orders=(1,))
+        toks = [g[0] for g in ext.apply("Dogs bark. The cat saw Paris. It ran.")]
+        assert toks.count("<ENT>") == 1  # only mid-sentence Paris
+        assert "the" in toks and "it" in toks

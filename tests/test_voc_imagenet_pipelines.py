@@ -97,3 +97,36 @@ def test_imagenet_sift_lcs_fv_end_to_end():
     )
     assert res["test_top5_error"] <= res["test_top1_error"]
     assert res["test_top1_error"] < 30.0
+
+
+def test_imagenet_loader_skips_empty_entry_and_non_tars(tmp_path):
+    """A 0-byte entry mid-archive must not truncate ingestion, and stray
+    non-tar files in data_dir must be ignored (ingest.cpp ks_tar_next
+    end-of-archive vs empty-file disambiguation)."""
+    rng = np.random.default_rng(1)
+    good = [
+        (f"n01/img_{i}.JPEG", (rng.random((48, 48, 3)) * 255).astype(np.uint8))
+        for i in range(2)
+    ]
+    path = tmp_path / "data.tar"
+    with tarfile.open(path, "w") as tf:
+        from PIL import Image
+
+        def add(name, data):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+
+        b = io.BytesIO()
+        Image.fromarray(good[0][1]).save(b, "JPEG", quality=95)
+        add(good[0][0], b.getvalue())
+        add("n01/placeholder.JPEG", b"")  # zero-byte entry in the middle
+        b = io.BytesIO()
+        Image.fromarray(good[1][1]).save(b, "JPEG", quality=95)
+        add(good[1][0], b.getvalue())
+    (tmp_path / "labels.txt").write_text("n01 0\n")
+    (tmp_path / "README").write_text("not a tar\n")
+    imgs, labels = load_imagenet(
+        str(tmp_path), str(tmp_path / "labels.txt"), target_hw=(48, 48)
+    )
+    assert imgs.shape[0] == 2  # both real images survive the empty entry
