@@ -1,0 +1,44 @@
+"""Pipeline launcher: ``python -m keystone_tpu.cli <Pipeline> [flags]``.
+
+Reference: ``bin/run-pipeline.sh:9-28`` — one entry point that dispatches to a
+pipeline class by name and forwards flags (there via spark-submit; here the
+"cluster config" is the TPU mesh, picked up from the environment by
+``keystone_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+PIPELINES = {
+    "MnistRandomFFT": "keystone_tpu.pipelines.mnist_random_fft",
+    "LinearPixels": "keystone_tpu.pipelines.linear_pixels",
+    "RandomCifar": "keystone_tpu.pipelines.random_cifar",
+    "RandomPatchCifar": "keystone_tpu.pipelines.random_patch_cifar",
+    "Timit": "keystone_tpu.pipelines.timit",
+    "VOCSIFTFisher": "keystone_tpu.pipelines.voc_sift_fisher",
+    "ImageNetSiftLcsFV": "keystone_tpu.pipelines.imagenet_sift_lcs_fv",
+    "Newsgroups": "keystone_tpu.pipelines.newsgroups",
+    "StupidBackoff": "keystone_tpu.pipelines.stupid_backoff",
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        names = "\n  ".join(sorted(PIPELINES))
+        print(f"usage: run-pipeline <Pipeline> [flags]\n\npipelines:\n  {names}")
+        return 0 if argv else 2
+    name, rest = argv[0], argv[1:]
+    if name not in PIPELINES:
+        print(f"unknown pipeline {name!r}; run with --help for the list", file=sys.stderr)
+        return 2
+    import importlib
+
+    mod = importlib.import_module(PIPELINES[name])
+    mod.main(rest)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

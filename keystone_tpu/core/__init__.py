@@ -10,3 +10,4 @@ from keystone_tpu.core.pipeline import (
     chain,
 )
 from keystone_tpu.core.dataset import Dataset, LabeledData
+from keystone_tpu.core.checkpoint import save_node, load_node, load_or_fit

@@ -1,0 +1,83 @@
+"""Checkpointing fitted pipeline nodes + load-or-fit switches.
+
+Reference behavior (SURVEY.md §5): KeystoneML has no model checkpoint writer —
+"resume" means loading precomputed artifacts from CSV (``--pcaFile``,
+``VOCSIFTFisher.scala:40-42``; ``GaussianMixtureModel.load``,
+``GaussianMixtureModel.scala:83-90``) and re-fitting everything else.
+
+Here every fitted node is an immutable pytree, so checkpointing is generic:
+flatten, materialize leaves to host numpy, store leaves + treedef. Any node,
+chain, or whole fitted pipeline round-trips through one call — the
+orbax-style upgrade the survey prescribes — while the CSV loaders
+(``GaussianMixtureModel.load``, ``PCATransformer`` from file) remain for
+reference-artifact parity.
+
+Limitation: static fields are pickled with the treedef, so nodes carrying
+non-picklable statics (lambdas) need module-level functions instead.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, TypeVar
+
+import jax
+import numpy as np
+
+from keystone_tpu.utils.logging import get_logger
+
+logger = get_logger("keystone_tpu.checkpoint")
+
+T = TypeVar("T")
+
+_MAGIC = "keystone-tpu-node-v1"
+
+
+def save_node(node: Any, path: str) -> None:
+    """Checkpoint a (fitted) node/chain/pytree to ``path`` atomically."""
+    leaves, treedef = jax.tree.flatten(node)
+    payload = {
+        "magic": _MAGIC,
+        "treedef": treedef,
+        "leaves": [np.asarray(l) for l in leaves],
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_node(path: str) -> Any:
+    """Load a node checkpointed with :func:`save_node`."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("magic") != _MAGIC:
+        raise ValueError(f"{path} is not a keystone-tpu node checkpoint")
+    return jax.tree.unflatten(payload["treedef"], payload["leaves"])
+
+
+def load_or_fit(path: str, fit: Callable[[], T], save: bool = True) -> T:
+    """The reference's load-from-file-or-fit switch, generalized.
+
+    If ``path`` exists, load it; otherwise run ``fit()`` and (by default)
+    checkpoint the result there. An empty path always fits and never saves.
+    """
+    if path:
+        if os.path.exists(path):
+            logger.info("loading fitted node from %s", path)
+            return load_node(path)
+        result = fit()
+        if save:
+            logger.info("checkpointing fitted node to %s", path)
+            save_node(result, path)
+        return result
+    return fit()

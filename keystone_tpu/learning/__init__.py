@@ -13,3 +13,6 @@ from keystone_tpu.learning.gmm import (
     GaussianMixtureModel,
     GaussianMixtureModelEstimator,
 )
+from keystone_tpu.learning.block_weighted import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.learning.naive_bayes import NaiveBayesEstimator, NaiveBayesModel
+from keystone_tpu.learning.lda import LinearDiscriminantAnalysis

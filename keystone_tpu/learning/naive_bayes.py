@@ -1,0 +1,110 @@
+"""Multinomial Naive Bayes over sparse term features.
+
+Reference: ``nodes/learning/NaiveBayesModel.scala:22-70`` — training is
+delegated to Spark MLlib's ``NaiveBayes.train`` (multinomial, Laplace
+smoothing ``lambda``); the fitted model applies ``log pi + theta . x``
+(``:50-52``).
+
+TPU-native: both fit and apply are single XLA programs over the padded-COO
+:class:`~keystone_tpu.ops.util.sparse.SparseBatch`:
+
+- fit: per-class term totals via one scatter-add over (class, term) pairs
+  (the ``reduceByKey`` analog), then the smoothed log-likelihood matrix
+  ``theta[c,v] = log (T_cv + lam) - log (T_c + lam*V)`` and log-priors
+  ``pi[c] = log (N_c + lam) - log (N + lam*C)``.
+- apply: scores = ``pi + x . theta^T`` — a gather over each row's nnz terms,
+  batched; argmax downstream (``MaxClassifier``) yields the prediction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import ClassVar
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import LabelEstimator, Transformer
+from keystone_tpu.ops.util.sparse import SparseBatch
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes", "num_features"))
+def _fit_device(indices, values, labels, lam, num_classes: int, num_features: int):
+    mask = (indices >= 0).astype(jnp.float32)
+    idx = jnp.clip(indices, 0, num_features - 1)
+    vals = values * mask
+
+    # T[c, v]: total weight of term v in class c — one scatter-add.
+    T = jnp.zeros((num_classes, num_features), jnp.float32)
+    rows_cls = jnp.broadcast_to(labels[:, None], idx.shape)
+    T = T.at[rows_cls, idx].add(vals)
+
+    class_totals = jnp.sum(T, axis=1, keepdims=True)
+    theta = jnp.log(T + lam) - jnp.log(class_totals + lam * num_features)
+
+    class_counts = jnp.bincount(labels, length=num_classes).astype(jnp.float32)
+    n = jnp.sum(class_counts)
+    pi = jnp.log(class_counts + lam) - jnp.log(n + lam * num_classes)
+    return pi, theta
+
+
+@jax.jit
+def _apply_device(pi, theta, indices, values):
+    mask = (indices >= 0).astype(jnp.float32)
+    idx = jnp.clip(indices, 0, theta.shape[1] - 1)
+    # gather theta columns for each row's terms: (n, nnz, C)
+    g = jnp.take(theta.T, idx, axis=0)
+    return pi[None, :] + jnp.einsum("nkc,nk->nc", g, values * mask)
+
+
+class NaiveBayesModel(Transformer):
+    """Fitted model: ``apply = log pi + theta . x`` (``:50-52``)."""
+
+    jittable: ClassVar[bool] = False  # input is a SparseBatch, not a raw array
+    pi: jnp.ndarray  # (C,) log priors
+    theta: jnp.ndarray  # (C, V) log likelihoods
+
+    @property
+    def num_classes(self) -> int:
+        return int(self.pi.shape[0])
+
+    def apply_batch(self, xs) -> jnp.ndarray:
+        if isinstance(xs, SparseBatch):
+            return _apply_device(self.pi, self.theta, xs.indices, xs.values)
+        xs = jnp.asarray(xs, jnp.float32)  # dense (n, V) path
+        return self.pi[None, :] + xs @ self.theta.T
+
+    def apply(self, x) -> jnp.ndarray:
+        if isinstance(x, SparseBatch):
+            return self.apply_batch(x)[0]
+        return self.pi + self.theta @ jnp.asarray(x, jnp.float32)
+
+
+class NaiveBayesEstimator(LabelEstimator):
+    """Multinomial NB with Laplace smoothing (``NaiveBayesModel.scala:58-70``)."""
+
+    def __init__(self, num_classes: int, lam: float = 1.0):
+        self.num_classes = int(num_classes)
+        self.lam = float(lam)
+
+    def fit(self, data, labels) -> NaiveBayesModel:
+        labels = jnp.asarray(np.asarray(labels), jnp.int32)
+        if isinstance(data, SparseBatch):
+            pi, theta = _fit_device(
+                data.indices, data.values, labels, jnp.float32(self.lam),
+                self.num_classes, data.num_features,
+            )
+        else:
+            dense = jnp.asarray(data, jnp.float32)
+            n, v = dense.shape
+            onehot = jax.nn.one_hot(labels, self.num_classes, dtype=jnp.float32)
+            T = onehot.T @ dense
+            class_totals = jnp.sum(T, axis=1, keepdims=True)
+            theta = jnp.log(T + self.lam) - jnp.log(class_totals + self.lam * v)
+            class_counts = jnp.sum(onehot, axis=0)
+            pi = jnp.log(class_counts + self.lam) - jnp.log(
+                jnp.float32(n) + self.lam * self.num_classes
+            )
+        return NaiveBayesModel(pi=pi, theta=theta)

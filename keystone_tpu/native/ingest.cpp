@@ -238,10 +238,10 @@ static void loader_worker(Loader* L) {
         off += got;
       int w, h, c;
       if (ks_jpeg_peek(payload.data(), sz, &w, &h, &c) != 0) continue;
+      if (w < 36 || h < 36) continue;  // reference rejects tiny images (ImageUtils.scala:16-46)
       if ((size_t)w * h * c > rgb.size()) rgb.resize((size_t)w * h * c);
       if (ks_jpeg_decode(payload.data(), sz, rgb.data(), (long)rgb.size(), &w, &h, &c) != 0)
         continue;
-      if (w < 36 || h < 36) continue;  // reference rejects tiny images (ImageUtils.scala:16-46)
 
       Sample s;
       s.name = name;

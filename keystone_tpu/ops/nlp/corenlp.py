@@ -22,6 +22,7 @@ CommonSparseFeatures path as the plain tokenizer.
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import ClassVar, List, Sequence, Tuple
 
@@ -31,6 +32,12 @@ from keystone_tpu.core.pipeline import Transformer
 from keystone_tpu.ops.nlp.ngrams import NGramsFeaturizer
 
 _TOKEN_RE = re.compile(r"[A-Za-z]+|[0-9]+(?:\.[0-9]+)?")
+
+
+@functools.lru_cache(maxsize=None)
+def _featurizer(orders: Tuple[int, ...]) -> NGramsFeaturizer:
+    # one immutable featurizer per orders tuple, not one per document
+    return NGramsFeaturizer(orders=orders)
 
 _IRREGULAR = {
     "is": "be", "are": "be", "was": "be", "were": "be", "been": "be", "am": "be",
@@ -95,7 +102,7 @@ class CoreNLPFeatureExtractor(Transformer):
                 tokens.append(lemmatize(tok))
             sentence_start = False
             prev_end = m.end()
-        return NGramsFeaturizer(orders=self.orders).apply(tokens)
+        return _featurizer(self.orders).apply(tokens)
 
     def apply_batch(self, texts: Sequence[str]) -> List[List[tuple]]:
         return [self.apply(t) for t in texts]

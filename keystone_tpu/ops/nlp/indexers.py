@@ -130,9 +130,10 @@ class PackedNGramIndexer:
 
     Bit width per word is ``ceil(log2(vocab_size + 1))`` (id ``vocab_size`` is
     reserved so that every real id is distinguishable from an empty slot);
-    ``order * bits`` must fit in 63 bits. For a 1M-word vocab that allows
-    orders up to 3; a 256k vocab allows order 3; a 4k vocab order 5. Longer
-    orders fall back to :class:`NGramIndexerImpl` on the host.
+    ``order * bits`` must fit in 63 bits (raises ``ValueError`` otherwise).
+    For a 1M-word vocab that allows orders up to 3; a 256k vocab order 3; a
+    4k vocab order 5. ``StupidBackoffEstimator`` catches the overflow and
+    falls back to tuple-keyed host tables.
 
     Keys of the same order sort lexicographically by (farthest, ..., current)
     word, so a sorted key table supports binary-search lookup on device.

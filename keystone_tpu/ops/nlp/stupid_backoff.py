@@ -30,7 +30,7 @@ TPU-native redesign — no partitioner, no shuffle, no per-partition maps:
 from __future__ import annotations
 
 import functools
-from typing import ClassVar, Dict, List, Sequence, Tuple
+from typing import ClassVar, Dict, List, Optional, Sequence, Tuple
 
 import flax.struct as struct
 import jax
@@ -85,7 +85,6 @@ def _score_batch_device(
     # Bottom-up backoff fold.
     uni_keys, uni_valid = pack_suffix(1)
     score = lookup(uni_keys, uni_valid, 1) / total
-    prev_keys = uni_keys
     for k in range(2, order + 1):
         keys, valid = pack_suffix(k)
         c = lookup(keys, valid, k)
@@ -94,13 +93,16 @@ def _score_batch_device(
         ctx = lookup(ctx_keys, valid, k - 1)
         hit = (c > 0) & (ctx > 0)
         score = jnp.where(hit, c / jnp.maximum(ctx, 1.0), model.alpha * score)
-        prev_keys = keys
-    del prev_keys
     return score.reshape((b,))
 
 
 class StupidBackoffModel(Transformer):
-    """Fitted LM: per-order sorted count tables, device-batch scoring."""
+    """Fitted LM: per-order sorted count tables, device-batch scoring.
+
+    When ``host_tables`` is set (vocab × order too wide for 63-bit packed
+    keys), scoring runs the identical recursion on host dict lookups instead
+    — the :class:`NGramIndexerImpl`-style tuple-keyed path.
+    """
 
     jittable: ClassVar[bool] = False
 
@@ -112,6 +114,34 @@ class StupidBackoffModel(Transformer):
     alpha: float = struct.field(pytree_node=False, default=DEFAULT_ALPHA)
     word_bits: int = struct.field(pytree_node=False, default=20)
     max_order: int = struct.field(pytree_node=False, default=3)
+    # order -> {id_tuple: count}; None on the packed/device path.
+    host_tables: Optional[Tuple[Dict[Tuple[int, ...], float], ...]] = struct.field(
+        pytree_node=False, default=None
+    )
+
+    def _score_batch_host(self, ngrams: np.ndarray) -> np.ndarray:
+        """Tuple-keyed host recursion — same math as the device fold."""
+        total = max(float(self.num_tokens), 1.0)
+        uni = np.asarray(self.unigram_counts)
+
+        def count(ng: Tuple[int, ...]) -> float:
+            if any(w < 0 for w in ng):
+                return 0.0
+            if len(ng) == 1:
+                return float(uni[ng[0]]) if ng[0] < uni.shape[0] else 0.0
+            table = self.host_tables[len(ng) - 2]
+            return table.get(ng, 0.0)
+
+        out = np.zeros(ngrams.shape[0], np.float32)
+        for i, row in enumerate(ngrams):
+            ng = tuple(int(w) for w in row)
+            score = count(ng[-1:]) / total
+            for k in range(2, len(ng) + 1):
+                c = count(ng[-k:])
+                ctx = count(ng[-k:-1])
+                score = c / ctx if (c > 0 and ctx > 0) else self.alpha * score
+            out[i] = score
+        return out
 
     @property
     def vocab_size(self) -> int:
@@ -125,6 +155,8 @@ class StupidBackoffModel(Transformer):
         order = ngrams.shape[1]
         if not 1 <= order <= self.max_order:
             raise ValueError(f"order must be 1..{self.max_order}")
+        if self.host_tables is not None:
+            return self._score_batch_host(ngrams)
         with jax.enable_x64():
             return np.asarray(
                 _score_batch_device(self, jnp.asarray(ngrams), order, self.word_bits)
@@ -140,6 +172,16 @@ class StupidBackoffModel(Transformer):
     def scores(self) -> List[Tuple[Tuple[int, ...], float]]:
         """Score every trained n-gram (the reference's ``scoresRDD``)."""
         out: List[Tuple[Tuple[int, ...], float]] = []
+        if self.host_tables is not None:
+            for table in self.host_tables:
+                if not table:
+                    continue
+                ngrams = np.array(sorted(table), dtype=np.int64)
+                s = self._score_batch_host(ngrams)
+                out.extend(
+                    (tuple(map(int, ng)), float(v)) for ng, v in zip(ngrams, s)
+                )
+            return out
         for i, keys in enumerate(self.table_keys):
             order = i + 2
             keys_np = np.asarray(keys)
@@ -172,13 +214,39 @@ class StupidBackoffEstimator:
     def fit(self, ngram_counts: Sequence[Tuple[Tuple[int, ...], int]]) -> StupidBackoffModel:
         vocab_size = (max(self.unigram_counts) + 1) if self.unigram_counts else 1
         max_order = max((len(ng) for ng, _ in ngram_counts), default=2)
-        indexer = PackedNGramIndexer(vocab_size, max_order)
 
         by_order: Dict[int, List[Tuple[Tuple[int, ...], int]]] = {}
         for ng, c in ngram_counts:
             if any(w < 0 for w in ng):
                 continue  # OOV-containing n-grams are unscorable
             by_order.setdefault(len(ng), []).append((ng, c))
+
+        uni = np.zeros((vocab_size,), dtype=np.float32)
+        for wid, c in self.unigram_counts.items():
+            if wid >= 0:
+                uni[wid] = c
+
+        try:
+            indexer = PackedNGramIndexer(vocab_size, max_order)
+        except ValueError:
+            # vocab × order too wide for 63-bit keys: host tuple-dict tables
+            # (the NGramIndexerImpl-style path; device scoring disabled).
+            host_tables = []
+            for order in range(2, max_order + 1):
+                table: Dict[Tuple[int, ...], float] = {}
+                for ng, c in by_order.get(order, []):
+                    table[tuple(ng)] = table.get(tuple(ng), 0.0) + float(c)
+                host_tables.append(table)
+            return StupidBackoffModel(
+                table_keys=(),
+                table_counts=(),
+                unigram_counts=uni,
+                num_tokens=np.float32(uni.sum()),
+                alpha=self.alpha,
+                word_bits=0,
+                max_order=max_order,
+                host_tables=tuple(host_tables),
+            )
 
         table_keys: List[jnp.ndarray] = []
         table_counts: List[jnp.ndarray] = []
@@ -200,10 +268,6 @@ class StupidBackoffEstimator:
                 table_keys.append(np.zeros((0,), dtype=np.int64))
                 table_counts.append(np.zeros((0,), dtype=np.float32))
 
-        uni = np.zeros((vocab_size,), dtype=np.float32)
-        for wid, c in self.unigram_counts.items():
-            if wid >= 0:
-                uni[wid] = c
         return StupidBackoffModel(
             table_keys=tuple(table_keys),
             table_counts=tuple(table_counts),

@@ -9,3 +9,10 @@ from keystone_tpu.ops.util.nodes import (
     VectorSplitter,
     ZipVectors,
 )
+from keystone_tpu.ops.util.sparse import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseBatch,
+    SparseFeatureVectorizer,
+    TermFrequency,
+)

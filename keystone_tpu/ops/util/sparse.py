@@ -1,0 +1,144 @@
+"""Sparse featurization: term frequencies -> padded-COO device batches.
+
+Reference:
+- ``nodes/stats/TermFrequency.scala:18-20``: ``Seq[T] -> Seq[(T, weight(count))]``.
+- ``nodes/util/AllSparseFeatures.scala:13-19``: feature space = every term seen.
+- ``nodes/util/CommonSparseFeatures.scala:15-26``: feature space = top-K terms
+  by total frequency.
+- ``nodes/util/SparseFeatureVectorizer.scala:7-18``: map per-doc term weights
+  into sparse vectors over the fitted feature space.
+
+TPU-native representation: a :class:`SparseBatch` — padded COO with a static
+``max_nnz`` per row (indices int32 padded with -1, values float32 padded with
+0). Static shapes are what XLA needs; the pad/mask convention matches the rest
+of the data plane. Consumers either scatter into dense (vocab fits HBM) or
+gather per-row (``NaiveBayesModel.apply_batch``).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, ClassVar, Dict, List, Optional, Sequence, Tuple
+
+import flax.struct as struct
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import Estimator, Transformer
+
+
+def identity_weight(count: float) -> float:
+    """Raw-count term weighting."""
+    return float(count)
+
+
+def binary_weight(count: float) -> float:
+    """Presence/absence weighting (the reference pipeline's ``x => 1``)."""
+    return 1.0
+
+
+class TermFrequency(Transformer):
+    """Per-doc term counts re-weighted by ``fn`` (``TermFrequency.scala:18-20``).
+
+    ``fn`` maps the raw count to a weight (:func:`identity_weight`,
+    :func:`binary_weight`, log-scaling, ...). Use module-level functions, not
+    lambdas, so fitted pipelines stay checkpointable (``core/checkpoint.py``).
+    """
+
+    jittable: ClassVar[bool] = False
+    fn: Callable[[float], float] = struct.field(
+        pytree_node=False, default=identity_weight
+    )
+
+    def apply(self, terms: Sequence) -> List[Tuple[object, float]]:
+        counts = collections.Counter(terms)
+        return [(t, self.fn(c)) for t, c in counts.items()]
+
+    def apply_batch(self, docs) -> List[List[Tuple[object, float]]]:
+        return [self.apply(d) for d in docs]
+
+
+class SparseBatch(struct.PyTreeNode):
+    """Padded-COO batch: ``indices`` (n, max_nnz) int32 (-1 = pad),
+    ``values`` (n, max_nnz) float32, plus the static feature-space size."""
+
+    indices: jnp.ndarray
+    values: jnp.ndarray
+    num_features: int = struct.field(pytree_node=False)
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[0]
+
+    def to_dense(self) -> jnp.ndarray:
+        """Scatter to (n, num_features) — for feature spaces that fit HBM."""
+        idx = jnp.clip(self.indices, 0, self.num_features - 1)
+        mask = (self.indices >= 0).astype(self.values.dtype)
+        n = self.indices.shape[0]
+        dense = jnp.zeros((n, self.num_features), self.values.dtype)
+        rows = jnp.arange(n)[:, None]
+        return dense.at[rows, idx].add(self.values * mask)
+
+
+class SparseFeatureVectorizer(Transformer):
+    """Vectorize per-doc ``(term, weight)`` lists over a fitted feature map
+    (``SparseFeatureVectorizer.scala:7-18``). Unknown terms are dropped."""
+
+    jittable: ClassVar[bool] = False
+    feature_index: Dict[object, int] = struct.field(pytree_node=False)
+
+    @property
+    def num_features(self) -> int:
+        return len(self.feature_index)
+
+    def apply_batch(self, docs: Sequence[Sequence[Tuple[object, float]]]) -> SparseBatch:
+        fi = self.feature_index
+        per_doc: List[List[Tuple[int, float]]] = []
+        for doc in docs:
+            row = [(fi[t], w) for t, w in doc if t in fi]
+            row.sort()
+            per_doc.append(row)
+        max_nnz = max(1, max((len(r) for r in per_doc), default=1))
+        n = len(per_doc)
+        indices = np.full((n, max_nnz), -1, np.int32)
+        values = np.zeros((n, max_nnz), np.float32)
+        for i, row in enumerate(per_doc):
+            for j, (idx, w) in enumerate(row):
+                indices[i, j] = idx
+                values[i, j] = w
+        return SparseBatch(
+            indices=jnp.asarray(indices),
+            values=jnp.asarray(values),
+            num_features=len(fi),
+        )
+
+    def apply(self, doc: Sequence[Tuple[object, float]]) -> SparseBatch:
+        return self.apply_batch([doc])
+
+
+class AllSparseFeatures(Estimator):
+    """Feature space = every term observed (``AllSparseFeatures.scala:13-19``)."""
+
+    def fit(self, docs: Sequence[Sequence[Tuple[object, float]]]) -> SparseFeatureVectorizer:
+        seen: Dict[object, int] = {}
+        for doc in docs:
+            for t, _ in doc:
+                if t not in seen:
+                    seen[t] = len(seen)
+        return SparseFeatureVectorizer(feature_index=seen)
+
+
+class CommonSparseFeatures(Estimator):
+    """Feature space = top-``num_features`` terms by total weight across the
+    corpus (``CommonSparseFeatures.scala:15-26``)."""
+
+    def __init__(self, num_features: int):
+        self.num_features = int(num_features)
+
+    def fit(self, docs: Sequence[Sequence[Tuple[object, float]]]) -> SparseFeatureVectorizer:
+        totals: collections.Counter = collections.Counter()
+        for doc in docs:
+            for t, w in doc:
+                totals[t] += w
+        top = [t for t, _ in totals.most_common(self.num_features)]
+        return SparseFeatureVectorizer(feature_index={t: i for i, t in enumerate(top)})

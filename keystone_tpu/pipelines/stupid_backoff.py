@@ -40,6 +40,14 @@ class StupidBackoffConfig:
     synthetic_docs: int = 2000
     seed: int = 42
 
+    def validate(self):
+        if self.n < 2:
+            raise ValueError(
+                f"--n must be >= 2 (got {self.n}): Stupid Backoff scores "
+                "n-grams against their contexts; unigram counts alone are "
+                "handled by WordFrequencyEncoder"
+            )
+
 
 def _synthetic_corpus(num_docs: int, seed: int) -> list:
     """Zipf-distributed token stream with local structure (bigram hops)."""

@@ -1,0 +1,62 @@
+"""Profiling hooks: stage annotations + on-demand XLA trace capture.
+
+The reference's only observability was wall-clock logs and the Spark UI
+(SURVEY.md §5 — ``System.nanoTime`` spans, ``.setName`` on RDDs). The TPU
+upgrade: ``jax.profiler`` traces viewable in TensorBoard/Perfetto, with
+pipeline stages labeled via trace annotations so device timelines line up
+with pipeline structure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+from keystone_tpu.utils.logging import Timer, get_logger
+
+logger = get_logger("keystone_tpu.profiling")
+
+_TRACE_ENV = "KEYSTONE_TPU_TRACE_DIR"
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture a device trace for the enclosed block.
+
+    ``trace('/tmp/tb')`` writes a TensorBoard-loadable trace; with no
+    argument, tracing is enabled only when ``KEYSTONE_TPU_TRACE_DIR`` is set
+    (so pipelines can leave the hook permanently in place at zero cost).
+    """
+    log_dir = log_dir or os.environ.get(_TRACE_ENV)
+    if not log_dir:
+        yield
+        return
+    logger.info("capturing jax profiler trace to %s", log_dir)
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Label a region so it shows up on the device timeline *and* the host
+    log: combines ``jax.profiler.TraceAnnotation`` with a wall-clock Timer."""
+    return _Annotated(name)
+
+
+class _Annotated(contextlib.AbstractContextManager):
+    def __init__(self, name: str):
+        self.name = name
+        self._timer = Timer(name)
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._timer.__enter__()
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+        self._timer.__exit__(*exc)
+        return False

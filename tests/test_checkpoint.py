@@ -1,0 +1,124 @@
+"""Checkpoint/resume tests: fitted nodes round-trip through save/load and
+load_or_fit skips refitting (SURVEY.md §5 rebuild implication)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.core import chain, load_node, load_or_fit, save_node
+from keystone_tpu.learning import GaussianMixtureModel, PCAEstimator
+from keystone_tpu.ops.stats import StandardScaler
+from keystone_tpu.utils import annotate, trace
+
+
+def test_fitted_pca_round_trip(tmp_path, rng):
+    x = rng.normal(size=(200, 12)).astype(np.float32)
+    fitted = PCAEstimator(4).fit(x)
+    path = str(tmp_path / "pca.ckpt")
+    save_node(fitted, path)
+    loaded = load_node(path)
+    np.testing.assert_allclose(
+        np.asarray(fitted(x)), np.asarray(loaded(x)), rtol=1e-6
+    )
+
+
+def test_fitted_chain_round_trip(tmp_path, rng):
+    x = rng.normal(size=(100, 8)).astype(np.float32) * 3 + 1
+    fitted = chain(StandardScaler().fit(x), PCAEstimator(3).fit(x))
+    path = str(tmp_path / "chain.ckpt")
+    save_node(fitted, path)
+    loaded = load_node(path)
+    np.testing.assert_allclose(
+        np.asarray(fitted(x)), np.asarray(loaded(x)), rtol=1e-5
+    )
+
+
+def test_gmm_round_trip(tmp_path, rng):
+    k, d = 3, 5
+    gmm = GaussianMixtureModel(
+        means=rng.normal(size=(k, d)).astype(np.float32),
+        variances=rng.uniform(0.5, 2.0, (k, d)).astype(np.float32),
+        weights=np.full(k, 1 / 3, np.float32),
+    )
+    path = str(tmp_path / "gmm.ckpt")
+    save_node(gmm, path)
+    loaded = load_node(path)
+    x = rng.normal(size=(20, d)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(gmm.apply_batch(x)), np.asarray(loaded.apply_batch(x)), rtol=1e-5
+    )
+
+
+def test_load_or_fit_switch(tmp_path, rng):
+    x = rng.normal(size=(80, 6)).astype(np.float32)
+    path = str(tmp_path / "node.ckpt")
+    calls = []
+
+    def fit():
+        calls.append(1)
+        return PCAEstimator(2).fit(x)
+
+    first = load_or_fit(path, fit)
+    second = load_or_fit(path, fit)  # must load, not refit
+    assert len(calls) == 1
+    np.testing.assert_allclose(np.asarray(first(x)), np.asarray(second(x)), rtol=1e-6)
+
+
+def test_load_or_fit_empty_path_always_fits(rng):
+    x = rng.normal(size=(40, 4)).astype(np.float32)
+    calls = []
+
+    def fit():
+        calls.append(1)
+        return PCAEstimator(2).fit(x)
+
+    load_or_fit("", fit)
+    load_or_fit("", fit)
+    assert len(calls) == 2
+
+
+def test_reject_garbage(tmp_path):
+    p = tmp_path / "bad.ckpt"
+    import pickle
+
+    p.write_bytes(pickle.dumps({"not": "a checkpoint"}))
+    with pytest.raises(ValueError):
+        load_node(str(p))
+
+
+def test_text_pipeline_checkpointable(tmp_path):
+    """The fitted newsgroups-style predictor (TermFrequency + sparse
+    vectorizer + NB) must round-trip — regression for the lambda-default
+    TermFrequency that broke pickling."""
+    import numpy as np
+
+    from keystone_tpu.learning.naive_bayes import NaiveBayesEstimator
+    from keystone_tpu.ops.nlp import NGramsFeaturizer, Tokenizer
+    from keystone_tpu.ops.util.sparse import (
+        CommonSparseFeatures,
+        TermFrequency,
+        binary_weight,
+    )
+    from keystone_tpu.ops.util import MaxClassifier
+
+    docs = ["cat dog cat", "dog dog fish", "fish cat fish", "dog cat dog"]
+    labels = np.array([0, 1, 0, 1], np.int32)
+    feats = chain(Tokenizer(), NGramsFeaturizer(orders=(1,)), TermFrequency(fn=binary_weight))
+    predictor = (
+        feats.then(CommonSparseFeatures(10)).fit(docs)
+        .then(NaiveBayesEstimator(2)).fit(docs, labels)
+        .then(MaxClassifier())
+    )
+    path = str(tmp_path / "predictor.ckpt")
+    save_node(predictor, path)
+    loaded = load_node(path)
+    np.testing.assert_array_equal(
+        np.asarray(predictor(docs)), np.asarray(loaded(docs))
+    )
+
+
+def test_profiling_hooks_are_noops_without_dir(rng):
+    import jax.numpy as jnp
+
+    with trace():  # no env var, no dir: must be free
+        with annotate("stage"):
+            _ = jnp.sum(jnp.ones(8)).block_until_ready()

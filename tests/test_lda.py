@@ -1,0 +1,68 @@
+"""LDA tests (reference: nodes/learning/LinearDiscriminantAnalysisSuite -
+iris-style class separation)."""
+
+import numpy as np
+
+from keystone_tpu.learning import LinearDiscriminantAnalysis
+
+
+def _synthetic_classes(rng, n_per=60, d=4):
+    means = np.array(
+        [[0, 0, 0, 0], [4, 1, 0, 0], [0, 3, 3, 0]], dtype=np.float64
+    )
+    xs, ys = [], []
+    for c, mu in enumerate(means):
+        xs.append(rng.normal(size=(n_per, d)) * 0.7 + mu)
+        ys.append(np.full(n_per, c))
+    return np.concatenate(xs).astype(np.float32), np.concatenate(ys).astype(np.int32)
+
+
+def test_lda_matches_generalized_eig(rng):
+    x, y = _synthetic_classes(rng)
+    mapper = LinearDiscriminantAnalysis(num_dims=2).fit(x, y)
+    w = np.asarray(mapper.w, np.float64)  # (d, 2)
+
+    # independent numpy solution of eig(inv(Sw) Sb)
+    d = x.shape[1]
+    sw = np.zeros((d, d))
+    sb = np.zeros((d, d))
+    gm = x.mean(0)
+    for c in range(3):
+        xc = x[y == c].astype(np.float64)
+        mu = xc.mean(0)
+        sw += (xc - mu).T @ (xc - mu)
+        sb += len(xc) * np.outer(mu - gm, mu - gm)
+    evals, evecs = np.linalg.eig(np.linalg.solve(sw, sb))
+    order = np.argsort(-evals.real)
+    ref = evecs[:, order[:2]].real
+
+    # same 2-d subspace: principal angles ~ 0
+    qa, _ = np.linalg.qr(w)
+    qb, _ = np.linalg.qr(ref)
+    sv = np.linalg.svd(qa.T @ qb, compute_uv=False)
+    np.testing.assert_allclose(sv, 1.0, atol=1e-3)
+
+
+def test_lda_projection_separates_classes(rng):
+    x, y = _synthetic_classes(rng)
+    mapper = LinearDiscriminantAnalysis(num_dims=2).fit(x, y)
+    z = np.asarray(mapper(x))
+    # between-class variance dominates within-class variance after projection
+    gm = z.mean(0)
+    within = sum(((z[y == c] - z[y == c].mean(0)) ** 2).sum() for c in range(3))
+    between = sum(len(z[y == c]) * ((z[y == c].mean(0) - gm) ** 2).sum() for c in range(3))
+    assert between / within > 3.0
+
+
+def test_lda_respects_mask(rng):
+    x, y = _synthetic_classes(rng)
+    # poison rows, then mask them out: result must match the clean fit
+    x_aug = np.concatenate([x, rng.normal(size=(20, 4)).astype(np.float32) * 50])
+    y_aug = np.concatenate([y, np.zeros(20, np.int32)])
+    mask = np.concatenate([np.ones(len(x)), np.zeros(20)]).astype(np.float32)
+    clean = np.asarray(LinearDiscriminantAnalysis(2).fit(x, y).w)
+    masked = np.asarray(LinearDiscriminantAnalysis(2).fit(x_aug, y_aug, mask=mask).w)
+    qa, _ = np.linalg.qr(clean.astype(np.float64))
+    qb, _ = np.linalg.qr(masked.astype(np.float64))
+    sv = np.linalg.svd(qa.T @ qb, compute_uv=False)
+    np.testing.assert_allclose(sv, 1.0, atol=1e-3)

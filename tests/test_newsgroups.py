@@ -1,0 +1,136 @@
+"""Sparse featurization + Naive Bayes + Newsgroups pipeline tests.
+
+Reference suites: ``nodes/misc/TermFrequencySuite.scala``,
+``nodes/util/CommonSparseFeaturesSuite`` analogs, and the canonical
+composition chain of ``pipelines/text/NewsgroupsPipeline.scala:24-32``.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.learning.naive_bayes import NaiveBayesEstimator
+from keystone_tpu.loaders.newsgroups import load_newsgroups, synthetic_newsgroups
+from keystone_tpu.ops.util.sparse import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseBatch,
+    TermFrequency,
+)
+from keystone_tpu.pipelines.newsgroups import NewsgroupsConfig, run
+
+
+class TestTermFrequency:
+    def test_counts(self):
+        tf = TermFrequency()
+        out = dict(tf.apply(["a", "b", "a", "a"]))
+        assert out == {"a": 3.0, "b": 1.0}
+
+    def test_weight_fn(self):
+        tf = TermFrequency(fn=lambda c: 1.0)
+        out = dict(tf.apply(["a", "a", "b"]))
+        assert out == {"a": 1.0, "b": 1.0}
+
+
+class TestSparseFeatures:
+    def test_common_top_k(self):
+        docs = [[("a", 5.0), ("b", 1.0)], [("a", 2.0), ("c", 3.0)], [("b", 1.0)]]
+        vec = CommonSparseFeatures(2).fit(docs)
+        assert set(vec.feature_index) == {"a", "c"}  # totals: a=7, c=3, b=2
+        assert vec.feature_index["a"] == 0
+
+    def test_all_features(self):
+        docs = [[("x", 1.0)], [("y", 2.0), ("x", 1.0)]]
+        vec = AllSparseFeatures().fit(docs)
+        assert set(vec.feature_index) == {"x", "y"}
+
+    def test_vectorize_roundtrip(self):
+        docs = [[("a", 2.0), ("c", 1.0)], [("b", 4.0)], []]
+        vec = AllSparseFeatures().fit(docs)
+        batch = vec(docs)
+        assert isinstance(batch, SparseBatch)
+        dense = np.asarray(batch.to_dense())
+        expected = np.zeros((3, 3), np.float32)
+        expected[0, vec.feature_index["a"]] = 2.0
+        expected[0, vec.feature_index["c"]] = 1.0
+        expected[1, vec.feature_index["b"]] = 4.0
+        np.testing.assert_allclose(dense, expected)
+
+    def test_unknown_terms_dropped(self):
+        vec = CommonSparseFeatures(1).fit([[("a", 5.0)], [("b", 1.0)]])
+        batch = vec([[("b", 3.0), ("a", 1.0)]])
+        dense = np.asarray(batch.to_dense())
+        assert dense.shape == (1, 1)
+        assert dense[0, 0] == 1.0  # only 'a' survives
+
+
+class TestNaiveBayes:
+    def test_matches_hand_computation(self):
+        # 2 classes, 3 features, lambda=1 — compute theta/pi by hand
+        X = np.array([[2.0, 0.0, 1.0], [1.0, 1.0, 0.0], [0.0, 3.0, 1.0]], np.float32)
+        y = np.array([0, 0, 1])
+        model = NaiveBayesEstimator(2, lam=1.0).fit(X, y)
+        T = np.array([[3.0, 1.0, 1.0], [0.0, 3.0, 1.0]])
+        theta = np.log(T + 1) - np.log(T.sum(1, keepdims=True) + 3)
+        pi = np.log(np.array([2.0, 1.0]) + 1) - np.log(3.0 + 2.0)
+        np.testing.assert_allclose(np.asarray(model.theta), theta, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(model.pi), pi, rtol=1e-5)
+        # scoring: log pi + theta.x
+        scores = np.asarray(model.apply_batch(X))
+        np.testing.assert_allclose(scores, pi[None] + X @ theta.T, rtol=1e-5)
+
+    def test_sparse_matches_dense(self, rng):
+        n, v, c = 40, 12, 3
+        dense = (rng.random((n, v)) < 0.3) * rng.integers(1, 4, (n, v))
+        dense = dense.astype(np.float32)
+        y = rng.integers(0, c, n).astype(np.int32)
+        docs = [
+            [(j, float(dense[i, j])) for j in range(v) if dense[i, j] > 0]
+            for i in range(n)
+        ]
+        vec_fit = AllSparseFeatures().fit(docs)
+        batch = vec_fit(docs)
+        # remap dense columns into the fitted feature order
+        perm = [vec_fit.feature_index[j] for j in range(v)]
+        dense_perm = np.zeros_like(dense)
+        dense_perm[:, perm] = dense
+        m_sparse = NaiveBayesEstimator(c).fit(batch, y)
+        m_dense = NaiveBayesEstimator(c).fit(dense_perm, y)
+        np.testing.assert_allclose(
+            np.asarray(m_sparse.theta), np.asarray(m_dense.theta), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_sparse.apply_batch(batch)),
+            np.asarray(m_dense.apply_batch(dense_perm)),
+            rtol=1e-4,
+        )
+
+
+class TestLoader:
+    def test_directory_loader(self, tmp_path):
+        for cls, texts in [("rec.autos", ["car fast", "wheel"]), ("sci.med", ["doc"])]:
+            d = tmp_path / cls
+            d.mkdir()
+            for i, t in enumerate(texts):
+                (d / f"{i}.txt").write_text(t)
+        docs, labels, names = load_newsgroups(str(tmp_path))
+        assert names == ["rec.autos", "sci.med"]
+        assert len(docs) == 3
+        assert labels.tolist() == [0, 0, 1]
+
+    def test_synthetic_separable(self):
+        docs, labels, names = synthetic_newsgroups(50, num_classes=4)
+        assert len(docs) == 50 and len(names) == 4
+        assert set(labels) <= set(range(4))
+
+
+def test_newsgroups_pipeline_end_to_end():
+    res = run(
+        NewsgroupsConfig(
+            synthetic_train=400,
+            synthetic_test=100,
+            synthetic_classes=5,
+            common_features=5000,
+        )
+    )
+    assert res["test_error"] < 10.0  # synthetic topics are separable
+    assert res["macro_f1"] > 0.9

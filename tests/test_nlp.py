@@ -198,6 +198,30 @@ class TestStupidBackoff:
         # unseen pair with wide ids backs off cleanly
         assert m.apply((1, big)) == pytest.approx(0.4 * 2.0 / 10.0)
 
+    def test_host_fallback_for_overwide_configs(self):
+        """vocab × order beyond 63 bits must fall back to host tables with
+        identical scoring semantics."""
+        big = (1 << 20) - 1  # 20-bit ids × order 4 = 80 bits > 63
+        uni = {0: 4, 1: 3, 2: 2, big: 1}
+        counts = [
+            ((0, 1, 2, big), 2),
+            ((0, 1, 2), 3),
+            ((1, 2), 4),
+            ((0, 1), 5),
+        ]
+        m = StupidBackoffEstimator(uni, alpha=0.4).fit(counts)
+        assert m.host_tables is not None
+        # seen 4-gram: c(0,1,2,big)/c(0,1,2) = 2/3
+        assert m.apply((0, 1, 2, big)) == pytest.approx(2.0 / 3.0)
+        # unseen 4-gram backs off: (2,1,2,0) -> a*( (1,2,0)? unseen ->
+        # a*( (2,0)? unseen -> a * c(0)/N ) )
+        n = 10.0
+        assert m.apply((2, 1, 2, 0)) == pytest.approx(0.4 * 0.4 * 0.4 * 4.0 / n)
+        # scores() enumerates all trained ngrams on the host path too
+        scores = dict(m.scores())
+        assert scores[(0, 1)] == pytest.approx(5.0 / 4.0)  # c(0,1)/c(0), c(0)=4
+        assert len(scores) == 4
+
 
 class TestCoreNLP:
     def test_lemmatize(self):
