@@ -9,8 +9,13 @@ TPU design: the E-step (responsibilities) and M-step (weighted moments) are
 data-parallel reductions over the row-sharded sample — per-shard partial
 sums + ICI all-reduce, exactly the psum pattern SURVEY.md §2.8 prescribes.
 The whole EM loop is one ``lax.fori_loop`` inside a single jitted program.
-We reproduce the reference's *invariants* (planted-mixture recovery), not
-the C library's bitwise behavior.
+The E+M inner loop is the shared moments path (``ops/pallas/moments.py``):
+by default a chunked MXU-shaped XLA program whose live memory is bounded at
+O(chunk·k) regardless of sample count, with a fused Pallas kernel
+(``implementation="pallas"``) that streams row tiles through VMEM without
+materializing the (n, k) responsibilities at all. We reproduce the
+reference's *invariants* (planted-mixture recovery), not the C library's
+bitwise behavior.
 
 Layout note: the reference stores means/variances as (dim, k) Breeze
 matrices (column = center); here they are (k, dim) row-major — transpose
@@ -82,8 +87,10 @@ class GaussianMixtureModel(Transformer):
         )
 
 
-@functools.partial(jax.jit, static_argnames=("k", "num_iter"))
-def _fit_em(x, mask, key, k: int, num_iter: int):
+@functools.partial(jax.jit, static_argnames=("k", "num_iter", "implementation"))
+def _fit_em(x, mask, key, k: int, num_iter: int, implementation: str):
+    from keystone_tpu.ops.pallas import moments as M
+
     n, d = x.shape
     weights_row = jnp.ones((n,), jnp.float32) if mask is None else mask
     total = jnp.sum(weights_row)
@@ -96,16 +103,35 @@ def _fit_em(x, mask, key, k: int, num_iter: int):
     gvar = jnp.sum((x - gmean) ** 2 * weights_row[:, None], axis=0) / total
     model0 = (means0, jnp.tile(gvar, (k, 1)) + _VAR_FLOOR, jnp.full((k,), 1.0 / k))
 
+    # The centered+augmented sample is loop-invariant: build it ONCE (the
+    # center is the global mean — shift-invariance of the log-density makes
+    # any fixed center exact; centering fixes the affine form's x² blowup).
+    if implementation == "pallas":
+        x_aug = M.augment_rows(x - gmean[None], weights_row)
+
     def em_step(_, model):
         means, variances, weights = model
-        gmm = GaussianMixtureModel(means=means, variances=variances, weights=weights)
-        # E-step
-        resp = jax.nn.softmax(gmm.log_likelihoods(x), axis=1)  # (n, k)
-        resp = resp * weights_row[:, None]
-        # M-step (each reduce is a sharded-row sum -> psum over ICI)
-        nk = jnp.sum(resp, axis=0) + 1e-10  # (k,)
-        new_means = (resp.T @ x) / nk[:, None]
-        ex2 = (resp.T @ (x * x)) / nk[:, None]
+        # fused E+M sufficient statistics; the default path is the chunked
+        # affine XLA form (memory-bounded at any n), the Pallas kernel is
+        # the opt-in strict-VMEM variant. Each reduce is a sharded-row sum
+        # -> psum over ICI on a mesh.
+        if implementation == "pallas":
+            # interpret=None: compiled on TPU, interpreter elsewhere
+            qsum, qxc, qxc2 = M.moments_from_aug(
+                x_aug, d, means - gmean[None], variances, weights
+            )
+            qsum, qx, qx2 = M._uncenter(qsum, qxc, qxc2, gmean)
+        elif implementation == "xla":
+            qsum, qx, qx2 = M.gmm_moments_xla(
+                x, means, variances, weights, weights_row, center=gmean
+            )
+        else:
+            qsum, qx, qx2 = M.gmm_moments_auto(
+                x, means, variances, weights, weights_row, center=gmean
+            )
+        nk = qsum + 1e-10  # (k,)
+        new_means = qx / nk[:, None]
+        ex2 = qx2 / nk[:, None]
         new_vars = jnp.maximum(ex2 - new_means**2, _VAR_FLOOR)
         return new_means, new_vars, nk / total
 
@@ -116,16 +142,30 @@ def _fit_em(x, mask, key, k: int, num_iter: int):
 class GaussianMixtureModelEstimator(Estimator):
     """EM with seeded init. Reference: ``GaussianMixtureModel.scala:42-79``."""
 
-    def __init__(self, k: int, num_iter: int = 25, seed: int = 42):
+    def __init__(
+        self,
+        k: int,
+        num_iter: int = 25,
+        seed: int = 42,
+        implementation: str = "auto",
+    ):
+        if implementation not in ("auto", "pallas", "xla"):
+            raise ValueError(f"unknown implementation {implementation!r}")
         self.k = k
         self.num_iter = num_iter
         self.seed = seed
+        self.implementation = implementation
 
     def fit(self, data, mask: Optional[jax.Array] = None) -> GaussianMixtureModel:
         if isinstance(data, Dataset):
             data, mask = data.data, data.mask if mask is None else mask
         data = jnp.asarray(data, jnp.float32)
         means, variances, weights = _fit_em(
-            data, mask, jax.random.key(self.seed), self.k, self.num_iter
+            data,
+            mask,
+            jax.random.key(self.seed),
+            self.k,
+            self.num_iter,
+            self.implementation,
         )
         return GaussianMixtureModel(means=means, variances=variances, weights=weights)

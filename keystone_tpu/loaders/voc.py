@@ -46,11 +46,14 @@ def load_voc(
         for i, name in enumerate(names):
             if name_prefix and not name.startswith(name_prefix):
                 continue
-            fname = name.split("/")[-1]
-            if fname not in labels_map:
+            # The reference CSV keys label rows by full archive path
+            # (VOCLoader.scala:46-58); accept a basename match too so
+            # re-rooted archives keep working.
+            labels = labels_map.get(name) or labels_map.get(name.split("/")[-1])
+            if labels is None:
                 continue
             imgs_list.append(imgs[i])
-            label_lists.append(labels_map[fname])
+            label_lists.append(labels)
     if not imgs_list:
         raise ValueError(
             f"no images in {data_path} matched prefix={name_prefix!r} and the "

@@ -19,8 +19,10 @@ Output shape per item: (dims, 2·k) — column j<k is the mean-gradient for
 center j, column k+j the variance-gradient — matching the reference's
 ``numDims×(2·numCentroids)`` (``FisherVector.scala:29-33``).
 
-One item = one (n_desc, dims) descriptor matrix; the whole encoding is two
-matmuls over the posteriors, so batching is MXU-shaped by construction.
+One item = one (n_desc, dims) descriptor matrix; the whole encoding rides
+the shared GMM-moments path (``ops/pallas/moments.py``) — posteriors and
+weighted moments in one MXU-shaped pass, without the (n, k, d) broadcast of
+the naive per-descriptor form.
 """
 
 from __future__ import annotations
@@ -30,6 +32,7 @@ import jax.numpy as jnp
 
 from keystone_tpu.core.pipeline import Transformer
 from keystone_tpu.learning.gmm import GaussianMixtureModel
+from keystone_tpu.ops.pallas.moments import gmm_moments_auto
 
 
 class FisherVector(Transformer):
@@ -38,13 +41,12 @@ class FisherVector(Transformer):
     def apply(self, descriptors):
         """(n_desc, d) -> (d, 2k)."""
         gmm = self.gmm
-        q = gmm.apply_batch(descriptors)  # posteriors (n, k)
         n = descriptors.shape[0]
         sigma = jnp.sqrt(gmm.variances)  # (k, d)
 
-        qsum = jnp.sum(q, axis=0)  # (k,)
-        qx = q.T @ descriptors  # (k, d)
-        qx2 = q.T @ (descriptors * descriptors)  # (k, d)
+        qsum, qx, qx2 = gmm_moments_auto(
+            descriptors, gmm.means, gmm.variances, gmm.weights
+        )
 
         # Σ q (x-μ)/σ = (qx - qsum·μ)/σ
         grad_mu = (qx - qsum[:, None] * gmm.means) / sigma

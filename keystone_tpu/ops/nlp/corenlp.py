@@ -102,7 +102,7 @@ class CoreNLPFeatureExtractor(Transformer):
                 tokens.append(lemmatize(tok))
             sentence_start = False
             prev_end = m.end()
-        return _featurizer(self.orders).apply(tokens)
+        return _featurizer(tuple(self.orders)).apply(tokens)
 
     def apply_batch(self, texts: Sequence[str]) -> List[List[tuple]]:
         return [self.apply(t) for t in texts]

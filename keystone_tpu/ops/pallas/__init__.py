@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+Each kernel has an XLA fallback selected automatically off-TPU (and usable
+under ``vmap``); the Pallas paths are the HBM-bandwidth-bound inner loops
+where XLA's fusion leaves traffic on the table (SURVEY.md §2.8 TPU mapping).
+"""
+
+from keystone_tpu.ops.pallas.moments import (
+    gmm_moments,
+    gmm_moments_auto,
+    gmm_moments_xla,
+)
+
+__all__ = ["gmm_moments", "gmm_moments_auto", "gmm_moments_xla"]

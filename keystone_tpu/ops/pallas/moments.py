@@ -1,0 +1,314 @@
+"""Fused GMM posterior-moment accumulation as a Pallas TPU kernel.
+
+This is the shared hot loop under both GMM-EM (M-step sufficient statistics,
+``learning/gmm.py``) and Fisher Vector encoding (``ops/images/
+fisher_vector.py``) — the TPU-native replacement for the enceval C++ EM and
+FV encoders (reference ``src/main/cpp/EncEval.cxx:122-180`` and ``:19-120``).
+
+Why a kernel: a naive XLA formulation materializes the (n, k)
+responsibility matrix in HBM between the E-step softmax and the M-step
+matmuls. At the reference's flagship scale (1e7 samples × 256 centers,
+``ImageNetSiftLcsFV.scala:197-218``) that intermediate alone is 10 GB —
+beyond HBM — and even when it fits, it costs two full HBM round-trips. In
+the Pallas kernel each row tile is streamed HBM→VMEM once; the log-density
+(two MXU matmuls), the softmax, and the three weighted-moment accumulations
+all happen in VMEM, and only the (k, d)-shaped accumulators ever leave the
+chip. HBM traffic drops from O(n·k + n·d) to O(n·d).
+
+Math: with per-component affine parameters precomputed host-side,
+
+    ll = x @ A + x² @ B + c,   A = (μ/σ²)ᵀ,  B = (−½/σ²)ᵀ,
+    c  = log w − ½(d·log 2π + Σ log σ²) − ½ Σ μ²/σ²
+
+so the E-step is itself MXU-shaped. The expansion loses precision when
+``|x|`` is large (x² terms cancel), so every path first subtracts a
+``center`` vector from x and μ — the log-density is shift-invariant, and
+the returned moments are shifted back in closed form (``_uncenter``), which
+is exact. Two trailing columns appended to x — the per-row weight (0 for
+padding rows; scales q in-kernel) and a constant 1 — make ``qsum = Σ w·q``
+fall out of the same ``qᵀx`` matmul as the ones column: no separate
+reduction, and row masking is free. A/B rows for padded feature columns are
+zero, so padding never perturbs the log-density.
+
+Three entry points: :func:`gmm_moments` (the Pallas kernel, compiled on
+TPU / interpreted elsewhere), :func:`gmm_moments_xla` (single fused XLA
+program, same affine math), and :func:`gmm_moments_auto` (the default used
+by GMM-EM and Fisher Vectors: XLA for small inputs, a ``lax.scan`` of XLA
+chunks for large ones — memory-bounded like the kernel, and measured
+slightly ahead of it on v5e where XLA's matmul scheduling wins). EM hoists
+the loop-invariant augmented array with :func:`augment_rows` +
+:func:`moments_from_aug`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-tile height: multiple of the f32 sublane (8); 512 amortizes the matmul
+# well while keeping the q tile (512×k_pad) comfortably in VMEM.
+_TILE_N = 512
+_LANE = 128
+_SUBLANE = 8
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _moments_kernel(x_ref, a_ref, b_ref, c_ref, qx_ref, qx2_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        qx_ref[:] = jnp.zeros_like(qx_ref)
+        qx2_ref[:] = jnp.zeros_like(qx2_ref)
+
+    x = x_ref[:]  # (T, D) — column D-2 holds the row weight, D-1 ones
+    x2 = x * x
+    ll = (
+        jnp.dot(x, a_ref[:], preferred_element_type=jnp.float32)
+        + jnp.dot(x2, b_ref[:], preferred_element_type=jnp.float32)
+        + c_ref[:]
+    )  # (T, K); padded centers carry c = -1e30 -> softmax ~ 0
+    m = jnp.max(ll, axis=1, keepdims=True)
+    e = jnp.exp(ll - m)
+    q = e / jnp.sum(e, axis=1, keepdims=True)
+
+    w_col = a_ref.shape[0] - 2  # weight column index (static)
+    q = q * x[:, w_col][:, None]  # row weights; 0 for padding rows
+
+    qt = q.T  # (K, T)
+    qx_ref[:] += jnp.dot(qt, x, preferred_element_type=jnp.float32)
+    qx2_ref[:] += jnp.dot(qt, x2, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _moments_pallas(x_aug, A, B, c, *, interpret: bool):
+    n_pad, d_pad = x_aug.shape
+    k_pad = A.shape[1]
+    grid = (n_pad // _TILE_N,)
+    qx, qx2 = pl.pallas_call(
+        _moments_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_N, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d_pad, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d_pad, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x_aug, A, B, c)
+    return qx, qx2
+
+
+def _prep_params(means, variances, weights, d_tot, k_pad):
+    """Affine log-density parameters, padded to (d_tot, k_pad).
+
+    Rows for the weight/ones columns of x_aug and for padded feature dims
+    are zero; padded centers get c = -1e30 so their posterior underflows.
+    ``means`` must already be centered like the augmented x.
+    """
+    k, d = means.shape
+    inv_var = 1.0 / variances
+    A = jnp.zeros((d_tot, k_pad), jnp.float32)
+    A = A.at[:d, :k].set((means * inv_var).T)
+    B = jnp.zeros((d_tot, k_pad), jnp.float32)
+    B = B.at[:d, :k].set((-0.5 * inv_var).T)
+    cvec = (
+        jnp.log(weights)
+        - 0.5 * (d * jnp.log(2.0 * jnp.pi) + jnp.sum(jnp.log(variances), axis=1))
+        - 0.5 * jnp.sum(means**2 * inv_var, axis=1)
+    )
+    c = jnp.full((1, k_pad), -1e30, jnp.float32).at[0, :k].set(cvec)
+    return A, B, c
+
+
+def _uncenter(qsum, qxc, qxc2, center):
+    """Moments of x from moments of ``x - center`` (exact shift identity)."""
+    qx = qxc + qsum[:, None] * center[None]
+    qx2 = qxc2 + 2.0 * center[None] * qxc + qsum[:, None] * center[None] ** 2
+    return qsum, qx, qx2
+
+
+def augment_rows(
+    xc: jax.Array, row_weights: Optional[jax.Array] = None
+) -> jax.Array:
+    """Pad an (already centered) sample into the kernel's augmented layout.
+
+    Features + weight column + ones column padded up to a lane multiple,
+    rows to the tile height; the last two columns are the per-row weight
+    (scales q in-kernel; 0 for padding rows) and a constant 1 (yields
+    qsum). Build this ONCE outside any EM loop — it is loop-invariant.
+    """
+    n, d = xc.shape
+    d_tot = _round_up(d + 2, _LANE)
+    n_pad = _round_up(max(n, _TILE_N), _TILE_N)
+    w = jnp.ones((n,), jnp.float32) if row_weights is None else row_weights
+    x_aug = jnp.zeros((n_pad, d_tot), jnp.float32)
+    x_aug = x_aug.at[:n, :d].set(xc)
+    x_aug = x_aug.at[:n, d_tot - 2].set(w)
+    x_aug = x_aug.at[:, d_tot - 1].set(1.0)
+    return x_aug
+
+
+def moments_from_aug(
+    x_aug: jax.Array,
+    d: int,
+    means_c: jax.Array,
+    variances: jax.Array,
+    weights: jax.Array,
+    *,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Kernel call on a pre-augmented sample; ``means_c`` centered the same
+    way as ``x_aug``. Returns centered moments (caller applies
+    :func:`_uncenter` if it needs raw-x moments)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k = means_c.shape[0]
+    d_tot = x_aug.shape[1]
+    k_pad = _round_up(k, _LANE)
+    A, B, c = _prep_params(
+        jnp.asarray(means_c, jnp.float32),
+        jnp.asarray(variances, jnp.float32),
+        jnp.asarray(weights, jnp.float32),
+        d_tot,
+        k_pad,
+    )
+    qx_full, qx2_full = _moments_pallas(x_aug, A, B, c, interpret=bool(interpret))
+    qsum = qx_full[:k, d_tot - 1]  # the ones column of q^T x_aug
+    return qsum, qx_full[:k, :d], qx2_full[:k, :d]
+
+
+def gmm_moments(
+    x: jax.Array,
+    means: jax.Array,
+    variances: jax.Array,
+    weights: jax.Array,
+    row_weights: Optional[jax.Array] = None,
+    *,
+    center: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused E-step + weighted moments: returns ``(qsum, qx, qx2)``.
+
+    ``qsum[k] = Σ_n w_n q_nk``, ``qx = Σ_n w_n q_nk x_n``,
+    ``qx2 = Σ_n w_n q_nk x_n²`` — the sufficient statistics for an EM M-step
+    and the raw moments of a Fisher Vector — computed without materializing
+    the (n, k) responsibilities.
+
+    Local (per-shard) computation: under ``shard_map`` over a data axis the
+    caller ``psum``s the three outputs, mirroring the reference's treeReduce
+    of per-partition statistics.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    d = x.shape[1]
+    if center is None:
+        center = jnp.mean(x, axis=0)
+    x_aug = augment_rows(x - center[None], row_weights)
+    qsum, qxc, qxc2 = moments_from_aug(
+        x_aug, d, means - center[None], variances, weights, interpret=interpret
+    )
+    return _uncenter(qsum, qxc, qxc2, center)
+
+
+def gmm_moments_xla(
+    x: jax.Array,
+    means: jax.Array,
+    variances: jax.Array,
+    weights: jax.Array,
+    row_weights: Optional[jax.Array] = None,
+    center: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """XLA formulation of :func:`gmm_moments` (materializes the (n, k)
+    responsibilities — fine when n·k fits HBM; works on any backend and
+    under ``vmap``). Same centered affine log-density as the kernel, so the
+    two paths agree to float rounding and neither ever builds an (n, k, d)
+    broadcast."""
+    x = jnp.asarray(x, jnp.float32)
+    means = jnp.asarray(means, jnp.float32)
+    variances = jnp.asarray(variances, jnp.float32)
+    weights = jnp.asarray(weights, jnp.float32)
+    if center is None:
+        center = jnp.mean(x, axis=0)
+    xc = x - center[None]
+    mc = means - center[None]
+    inv_var = 1.0 / variances
+    d = x.shape[1]
+    c = (
+        jnp.log(weights)
+        - 0.5 * (d * jnp.log(2.0 * jnp.pi) + jnp.sum(jnp.log(variances), axis=1))
+        - 0.5 * jnp.sum(mc**2 * inv_var, axis=1)
+    )
+    ll = xc @ (mc * inv_var).T + (xc * xc) @ (-0.5 * inv_var).T + c[None]
+    q = jax.nn.softmax(ll, axis=1)
+    if row_weights is not None:
+        q = q * row_weights[:, None]
+    qsum = jnp.sum(q, axis=0)
+    return _uncenter(qsum, q.T @ xc, q.T @ (xc * xc), center)
+
+
+_CHUNK_ROWS = 1 << 17  # 128k rows/chunk: q chunk is 128k×k — ≤128 MB at k=256
+
+
+def gmm_moments_auto(
+    x: jax.Array,
+    means: jax.Array,
+    variances: jax.Array,
+    weights: jax.Array,
+    row_weights: Optional[jax.Array] = None,
+    center: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Default moments path: the centered affine XLA formulation, chunked
+    over rows.
+
+    Small inputs go through one fused XLA program; large ones through a
+    ``lax.scan`` of row chunks accumulating (qsum, qx, qx2), which bounds
+    live memory at O(chunk·k) — the out-of-core regime the reference hit
+    with 1e7-sample GMM fits (``ImageNetSiftLcsFV.scala:197-218``). On this
+    hardware the XLA affine form benchmarked at ~97 TFLOP/s effective,
+    ahead of the handwritten kernel; :func:`gmm_moments` (Pallas) remains
+    the opt-in for the strict no-(n,k)-intermediate regime.
+    """
+    n = x.shape[0]
+    if n <= _CHUNK_ROWS:
+        return gmm_moments_xla(x, means, variances, weights, row_weights, center)
+
+    x = jnp.asarray(x, jnp.float32)
+    k, d = means.shape
+    if center is None:
+        center = jnp.mean(x, axis=0)
+    n_pad = -(-n // _CHUNK_ROWS) * _CHUNK_ROWS
+    w = jnp.ones((n,), jnp.float32) if row_weights is None else row_weights
+    if n_pad != n:  # padded rows carry weight 0 -> contribute nothing
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        w = jnp.pad(w, (0, n_pad - n))
+    xs = x.reshape(n_pad // _CHUNK_ROWS, _CHUNK_ROWS, d)
+    ws = w.reshape(n_pad // _CHUNK_ROWS, _CHUNK_ROWS)
+
+    def step(acc, chunk):
+        xc, wc = chunk
+        qsum, qx, qx2 = gmm_moments_xla(xc, means, variances, weights, wc, center)
+        return (acc[0] + qsum, acc[1] + qx, acc[2] + qx2), None
+
+    init = (
+        jnp.zeros((k,), jnp.float32),
+        jnp.zeros((k, d), jnp.float32),
+        jnp.zeros((k, d), jnp.float32),
+    )
+    acc, _ = jax.lax.scan(step, init, (xs, ws))
+    return acc

@@ -68,18 +68,22 @@ def run(config: NewsgroupsConfig) -> dict:
             NGramsFeaturizer(orders=tuple(range(1, config.n_grams + 1))),
             TermFrequency(fn=binary_weight),  # binary presence (reference x=>1)
         )
-        # thenEstimator / thenLabelEstimator composition, as in the reference
-        predictor = (
-            featurizer
-            .then(CommonSparseFeatures(config.common_features))
-            .fit(train_docs)
-            .then(NaiveBayesEstimator(num_classes, config.nb_lambda))
-            .fit(train_docs, train_labels)
-            .then(MaxClassifier())
+        # Same thenEstimator / thenLabelEstimator composition as the
+        # reference, but the host-side featurization is materialized once
+        # and the downstream stages fit/evaluate on it (the reference's
+        # `Cacher` move) — chaining the raw estimators would re-tokenize the
+        # corpus once per fit.
+        train_feats = featurizer(train_docs)
+        sparse_vec = CommonSparseFeatures(config.common_features).fit(train_feats)
+        train_vecs = sparse_vec(train_feats)
+        nb = NaiveBayesEstimator(num_classes, config.nb_lambda).fit(
+            train_vecs, train_labels
         )
+        classifier = nb.then(MaxClassifier())
+        predictor = featurizer.then(sparse_vec).then(classifier)
 
         evaluator = MulticlassClassifierEvaluator(num_classes)
-        train_eval = evaluator(predictor(train_docs), train_labels)
+        train_eval = evaluator(classifier(train_vecs), train_labels)
         test_eval = evaluator(predictor(test_docs), test_labels)
 
     results["train_error"] = 100.0 * float(train_eval.total_error)

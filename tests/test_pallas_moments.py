@@ -1,0 +1,143 @@
+"""Pallas fused GMM-moments kernel vs the XLA formulation.
+
+The kernel (``ops/pallas/moments.py``) runs in interpreter mode on the CPU
+test mesh; on TPU the same code path compiles. Tolerances are loose-ish
+(2e-3 relative) because the kernel evaluates the log-density in its expanded
+affine form ``x@A + x²@B + c`` (MXU-shaped) which loses a few digits to
+cancellation vs the direct ``(x-μ)²`` form — the same trade the float C++
+enceval EM made (reference ``src/main/cpp/EncEval.cxx:122-180``).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+from keystone_tpu.ops.pallas.moments import gmm_moments, gmm_moments_xla
+
+
+def _random_gmm(rng, k, d):
+    means = rng.normal(size=(k, d)).astype(np.float32)
+    variances = rng.uniform(0.5, 2.0, size=(k, d)).astype(np.float32)
+    weights = rng.dirichlet(np.ones(k)).astype(np.float32)
+    return means, variances, weights
+
+
+def _assert_close(a, b, rtol=2e-3):
+    a, b = np.asarray(a), np.asarray(b)
+    denom = np.max(np.abs(b)) + 1e-9
+    np.testing.assert_allclose(a / denom, b / denom, atol=rtol)
+
+
+@pytest.mark.parametrize("n,d,k", [(700, 37, 10), (513, 64, 16), (100, 5, 3)])
+def test_moments_match_xla(n, d, k):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    means, variances, weights = _random_gmm(rng, k, d)
+    w = rng.uniform(0.0, 1.0, size=(n,)).astype(np.float32)
+
+    ref = gmm_moments_xla(x, means, variances, weights, w)
+    out = gmm_moments(x, means, variances, weights, w)
+    for a, b in zip(out, ref):
+        assert a.shape == b.shape
+        _assert_close(a, b)
+
+
+def test_moments_unweighted_qsum_totals_n():
+    rng = np.random.default_rng(1)
+    n, d, k = 300, 16, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    means, variances, weights = _random_gmm(rng, k, d)
+    qsum, _, _ = gmm_moments(x, means, variances, weights)
+    # posteriors sum to one per row; qsum totals the (unpadded) row count
+    assert abs(float(jnp.sum(qsum)) - n) < 1e-2
+
+
+def test_moments_mask_excludes_rows():
+    rng = np.random.default_rng(2)
+    n, d, k = 200, 8, 5
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    means, variances, weights = _random_gmm(rng, k, d)
+    mask = (np.arange(n) < 120).astype(np.float32)
+
+    masked = gmm_moments(x, means, variances, weights, mask)
+    truncated = gmm_moments(x[:120], means, variances, weights)
+    for a, b in zip(masked, truncated):
+        _assert_close(a, b)
+
+
+def test_moments_far_from_origin_precision():
+    """SIFT-scale uncentered data (values ~100±small): the centered affine
+    form must match a float64 direct-Mahalanobis oracle — the regime where
+    the uncentered x@A + x²@B expansion loses whole digits to cancellation."""
+    rng = np.random.default_rng(7)
+    n, d, k = 600, 32, 8
+    means = (rng.normal(size=(k, d)) * 3.0 + 100.0).astype(np.float32)
+    variances = rng.uniform(0.05, 0.5, size=(k, d)).astype(np.float32)
+    weights = rng.dirichlet(np.ones(k)).astype(np.float32)
+    comp = rng.integers(0, k, size=n)
+    x = (means[comp] + np.sqrt(variances[comp]) * rng.normal(size=(n, d))).astype(
+        np.float32
+    )
+
+    # float64 oracle, direct (x-mu)^2 form
+    x64, m64, v64 = x.astype(np.float64), means.astype(np.float64), variances.astype(np.float64)
+    mahal = ((x64[:, None, :] - m64[None]) ** 2 / v64[None]).sum(2)
+    ll = (
+        np.log(weights.astype(np.float64))[None]
+        - 0.5 * (d * np.log(2 * np.pi) + np.log(v64).sum(1))[None]
+        - 0.5 * mahal
+    )
+    q = np.exp(ll - ll.max(1, keepdims=True))
+    q /= q.sum(1, keepdims=True)
+    oracle = (q.sum(0), q.T @ x64, q.T @ (x64 * x64))
+
+    for impl, out in [
+        ("pallas", gmm_moments(x, means, variances, weights)),
+        ("xla", gmm_moments_xla(x, means, variances, weights)),
+    ]:
+        for a, b, nm in zip(out, oracle, ("qsum", "qx", "qx2")):
+            denom = np.max(np.abs(b)) + 1e-9
+            np.testing.assert_allclose(
+                np.asarray(a) / denom, b / denom, atol=2e-3,
+                err_msg=f"{impl}:{nm}",
+            )
+
+
+def test_moments_auto_chunked_matches_single(monkeypatch):
+    """The lax.scan chunked path (large-n branch of gmm_moments_auto) equals
+    the single-program path."""
+    import keystone_tpu.ops.pallas.moments as M
+
+    rng = np.random.default_rng(4)
+    n, d, k = 1000, 12, 6
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    means, variances, weights = _random_gmm(rng, k, d)
+    w = rng.uniform(0.0, 1.0, size=(n,)).astype(np.float32)
+
+    single = M.gmm_moments_xla(x, means, variances, weights, w)
+    monkeypatch.setattr(M, "_CHUNK_ROWS", 256)  # force chunking (n=1000 -> 4 chunks)
+    chunked = M.gmm_moments_auto(x, means, variances, weights, w)
+    for a, b in zip(chunked, single):
+        _assert_close(a, b, rtol=1e-5)
+
+
+def test_gmm_estimator_pallas_matches_xla_fit():
+    """Planted two-component mixture: both implementations recover it."""
+    rng = np.random.default_rng(3)
+    c0 = rng.normal(loc=-3.0, scale=0.5, size=(400, 6))
+    c1 = rng.normal(loc=+3.0, scale=0.5, size=(400, 6))
+    x = np.concatenate([c0, c1]).astype(np.float32)
+
+    fits = {}
+    for impl in ("xla", "pallas"):
+        gmm = GaussianMixtureModelEstimator(
+            k=2, num_iter=20, implementation=impl
+        ).fit(x)
+        order = np.argsort(np.asarray(gmm.means)[:, 0])
+        fits[impl] = np.asarray(gmm.means)[order]
+        np.testing.assert_allclose(
+            fits[impl], [[-3.0] * 6, [3.0] * 6], atol=0.15
+        )
+    np.testing.assert_allclose(fits["pallas"], fits["xla"], atol=0.02)

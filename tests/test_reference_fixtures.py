@@ -1,0 +1,187 @@
+"""Parity tests against the reference's own test fixtures.
+
+The reference repo ships miniature real datasets and solver matrices under
+``src/test/resources`` (SURVEY.md §4); these tests run the *same assertions
+its suites make* — exact loader counts/labels (``VOCLoaderSuite.scala:10-33``,
+``ImageNetLoaderSuite.scala:10-27``), the weighted-solver zero-gradient
+invariant on the same aMat/bMat matrices
+(``BlockWeightedLeastSquaresSuite.scala:63-95``), and the VOC codebook GMM
+load (``EncEvalSuite.scala:17-23``) — through this framework's loaders and
+solvers. Skipped when the reference checkout isn't mounted.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+_RES = "/root/reference/src/test/resources"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_RES), reason="reference fixtures not mounted"
+)
+
+
+def test_voc_loader_parity():
+    """VOCLoaderSuite.scala:18-32: 10 images; 000104.jpg has labels {14,19};
+    13 labels total, 9 distinct."""
+    from keystone_tpu.loaders.voc import load_voc_labels
+    from keystone_tpu.native import PrefetchImageLoader
+
+    labels_map = load_voc_labels(os.path.join(_RES, "images/voclabels.csv"))
+    loader = PrefetchImageLoader(
+        [os.path.join(_RES, "images/voc/voctest.tar")], 128, 128, 2
+    )
+    seen = {}
+    for imgs, names in loader.batches(64):
+        for i, name in enumerate(names):
+            if name.startswith("VOCdevkit/VOC2007/JPEGImages/") and name in labels_map:
+                seen[name.split("/")[-1]] = (imgs[i], labels_map[name])
+
+    assert len(seen) == 10
+    assert "000104.jpg" in seen
+    img, labels = seen["000104.jpg"]
+    assert img.shape == (128, 128, 3) and np.isfinite(img).all()
+    assert set(labels) == {14, 19}
+    all_labels = [l for _, ls in seen.values() for l in ls]
+    assert len(all_labels) == 13
+    assert len(set(all_labels)) == 9
+
+
+def test_imagenet_loader_parity():
+    """ImageNetLoaderSuite.scala:12-26: 5 images, every label 12, filenames
+    under n15075141."""
+    from keystone_tpu.loaders.imagenet import load_imagenet
+
+    imgs, labels = load_imagenet(
+        os.path.join(_RES, "images/imagenet"),
+        os.path.join(_RES, "images/imagenet-test-labels"),
+        target_hw=(128, 128),
+        num_threads=2,
+    )
+    assert imgs.shape == (5, 128, 128, 3)
+    assert np.isfinite(imgs).all()
+    assert (labels == 12).all()
+
+
+def test_jpeg_decode_matches_pil():
+    """The native libjpeg decode and PIL agree on the fixture photo (the two
+    ingest paths must be interchangeable downstream)."""
+    from keystone_tpu.native import ingest
+
+    with open(os.path.join(_RES, "images/000012.jpg"), "rb") as f:
+        raw = f.read()
+    if ingest._get_lib() is None:
+        pytest.skip("native ingest unavailable; PIL fallback is the path")
+    via_native = ingest.decode_jpeg(raw)  # native path (lib present)
+    from PIL import Image
+    import io
+
+    via_pil = np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"))
+    assert via_native is not None
+    assert via_native.shape == via_pil.shape
+    # both are IDCT'd JPEG pixels; small per-pixel rounding differences only
+    assert np.mean(np.abs(via_native.astype(int) - via_pil.astype(int))) < 2.0
+
+
+def test_voc_codebook_gmm_and_fisher_vector():
+    """EncEvalSuite.scala:17-23: the pretrained VOC codebook loads as a
+    256-center, 80-dim diagonal GMM; Fisher Vectors computed against it have
+    the reference's (dims, 2*centers) shape and finite values."""
+    from keystone_tpu.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.images.fisher_vector import FisherVector
+
+    gmm = GaussianMixtureModel.load(
+        os.path.join(_RES, "images/voc_codebook/means.csv"),
+        os.path.join(_RES, "images/voc_codebook/variances.csv"),
+        os.path.join(_RES, "images/voc_codebook/priors"),
+    )
+    assert gmm.means.shape == (256, 80)
+    assert gmm.variances.shape == (256, 80)
+    assert gmm.weights.shape == (256,)
+    assert float(jnp.sum(gmm.weights)) == pytest.approx(1.0, abs=1e-3)
+    assert float(jnp.min(gmm.variances)) > 0.0
+
+    rng = np.random.default_rng(0)
+    descs = jnp.asarray(
+        rng.normal(size=(500, 80)).astype(np.float32) * 50.0 + 100.0
+    )
+    fv = FisherVector(gmm=gmm).apply(descs)
+    assert fv.shape == (80, 512)
+    assert bool(jnp.isfinite(fv).all())
+
+
+def _load_fixture_mats():
+    a = np.loadtxt(os.path.join(_RES, "aMat.csv"), delimiter=",")
+    b = np.loadtxt(os.path.join(_RES, "bMat.csv"), delimiter=",")
+    return a.astype(np.float32), b.astype(np.float32)
+
+
+def test_block_weighted_zero_gradient_on_fixture():
+    """BlockWeightedLeastSquaresSuite.scala:71-95 with the same matrices and
+    config (blockSize=4, numIter=10, lambda=0.1, mixtureWeight=0.3): the
+    fitted model's weighted-least-squares gradient has ~zero norm.
+    """
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    A, B = _load_fixture_mats()
+    lam, mw = 0.1, 0.3
+    n, d = A.shape
+    c = B.shape[1]
+
+    model = BlockWeightedLeastSquaresEstimator(
+        block_size=4, num_iter=10, lam=lam, mixture_weight=mw
+    ).fit(jnp.asarray(A), jnp.asarray(B))
+    W = np.asarray(model.w)
+    b0 = np.asarray(model.b)
+
+    # independent gradient recomputation (computeGradient, suite lines 18-55)
+    cls = B.argmax(1)
+    counts = np.bincount(cls, minlength=c)
+    wts = np.full((n, c), (1.0 - mw) / n)
+    for i in range(n):
+        wts[i, cls[i]] += mw / counts[cls[i]]
+    resid = (A @ W + b0) - B
+    grad = A.T @ (resid * wts) + lam * W
+    assert np.linalg.norm(grad) < 1e-2
+
+
+def test_least_squares_fixture_recovery():
+    """Ridge regression on the same fixture matrices agrees with an
+    independent numpy solve (LinearMapperSuite-style check on real data)."""
+    from keystone_tpu.linalg.solvers import normal_equations_solve
+
+    A, B = _load_fixture_mats()
+    lam = 0.01
+    w_ne = np.asarray(normal_equations_solve(jnp.asarray(A), jnp.asarray(B), lam=lam))
+    w_np = np.linalg.solve(A.T @ A + lam * np.eye(A.shape[1]), A.T @ B)
+    np.testing.assert_allclose(w_ne, w_np, rtol=0, atol=5e-3 * np.abs(w_np).max())
+
+
+def test_lda_on_iris_fixture():
+    """LinearDiscriminantAnalysisSuite used iris.data; class separation in
+    the discriminant space must be near-perfect for the two separable pairs."""
+    from keystone_tpu.learning.lda import LinearDiscriminantAnalysis
+
+    rows, labels = [], []
+    name_to_id: dict = {}
+    with open(os.path.join(_RES, "iris.data")) as f:
+        for line in f:
+            parts = line.strip().split(",")
+            if len(parts) != 5:
+                continue
+            rows.append([float(v) for v in parts[:4]])
+            labels.append(name_to_id.setdefault(parts[4], len(name_to_id)))
+    x = jnp.asarray(np.asarray(rows, np.float32))
+    y = jnp.asarray(np.asarray(labels, np.int32))
+
+    mapper = LinearDiscriminantAnalysis(num_dims=2).fit(x, y)
+    z = np.asarray(mapper(x))
+    # class centroids well-separated relative to within-class scatter
+    cents = np.stack([z[np.asarray(y) == k].mean(0) for k in range(3)])
+    within = np.mean([z[np.asarray(y) == k].std(0).mean() for k in range(3)])
+    d01 = np.linalg.norm(cents[0] - cents[1])
+    assert d01 / within > 5.0
