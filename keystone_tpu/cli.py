@@ -31,6 +31,10 @@ def main(argv=None) -> int:
         return 0 if argv else 2
     name, rest = argv[0], argv[1:]
     if name not in PIPELINES:
+        # accept snake_case / lowercase spellings: mnist_random_fft == MnistRandomFFT
+        canon = {k.replace("_", "").lower(): k for k in PIPELINES}
+        name = canon.get(name.replace("_", "").replace("-", "").lower(), name)
+    if name not in PIPELINES:
         print(f"unknown pipeline {name!r}; run with --help for the list", file=sys.stderr)
         return 2
     import importlib

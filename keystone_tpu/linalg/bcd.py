@@ -14,6 +14,14 @@ row axis into per-shard partials + an ICI all-reduce, which *is* the
 reference's ``treeReduce`` of per-partition grams. The block loop is a
 ``lax.scan`` with ``dynamic_slice``, so the whole multi-pass solve is one XLA
 program with static shapes.
+
+Feature-axis sharding (the reference's 256k-dim FV regime, SURVEY.md §5):
+``A`` may additionally be column-sharded over the ``model`` axis —
+``NamedSharding(mesh, P('data', 'model'))`` — when one chip cannot hold all
+columns. XLA SPMD resolves the per-block ``dynamic_slice`` against the
+column sharding (a collective-permute of just the active block over ICI)
+and the solve proceeds block-at-a-time exactly like the reference's
+Gauss-Seidel pass; see ``tests/test_solvers.py`` for the 2-D mesh check.
 """
 
 from __future__ import annotations

@@ -115,25 +115,35 @@ def _moments_pallas(x_aug, A, B, c, *, interpret: bool):
     return qx, qx2
 
 
-def _prep_params(means, variances, weights, d_tot, k_pad):
-    """Affine log-density parameters, padded to (d_tot, k_pad).
+def _affine_params(means, variances, weights):
+    """The (A, B, c) of ``ll = x@A + x²@B + c``; ``means`` pre-centered.
 
-    Rows for the weight/ones columns of x_aug and for padded feature dims
-    are zero; padded centers get c = -1e30 so their posterior underflows.
-    ``means`` must already be centered like the augmented x.
+    Single source of truth for both the Pallas and XLA paths (tests assert
+    the two agree — keep them agreeing by construction).
     """
-    k, d = means.shape
+    d = means.shape[1]
     inv_var = 1.0 / variances
-    A = jnp.zeros((d_tot, k_pad), jnp.float32)
-    A = A.at[:d, :k].set((means * inv_var).T)
-    B = jnp.zeros((d_tot, k_pad), jnp.float32)
-    B = B.at[:d, :k].set((-0.5 * inv_var).T)
-    cvec = (
+    A = (means * inv_var).T  # (d, k)
+    B = (-0.5 * inv_var).T  # (d, k)
+    c = (
         jnp.log(weights)
         - 0.5 * (d * jnp.log(2.0 * jnp.pi) + jnp.sum(jnp.log(variances), axis=1))
         - 0.5 * jnp.sum(means**2 * inv_var, axis=1)
-    )
-    c = jnp.full((1, k_pad), -1e30, jnp.float32).at[0, :k].set(cvec)
+    )  # (k,)
+    return A, B, c
+
+
+def _prep_params(means, variances, weights, d_tot, k_pad):
+    """:func:`_affine_params` padded to (d_tot, k_pad) for the kernel.
+
+    Rows for the weight/ones columns of x_aug and for padded feature dims
+    are zero; padded centers get c = -1e30 so their posterior underflows.
+    """
+    k, d = means.shape
+    A0, B0, c0 = _affine_params(means, variances, weights)
+    A = jnp.zeros((d_tot, k_pad), jnp.float32).at[:d, :k].set(A0)
+    B = jnp.zeros((d_tot, k_pad), jnp.float32).at[:d, :k].set(B0)
+    c = jnp.full((1, k_pad), -1e30, jnp.float32).at[0, :k].set(c0)
     return A, B, c
 
 
@@ -246,15 +256,8 @@ def gmm_moments_xla(
     if center is None:
         center = jnp.mean(x, axis=0)
     xc = x - center[None]
-    mc = means - center[None]
-    inv_var = 1.0 / variances
-    d = x.shape[1]
-    c = (
-        jnp.log(weights)
-        - 0.5 * (d * jnp.log(2.0 * jnp.pi) + jnp.sum(jnp.log(variances), axis=1))
-        - 0.5 * jnp.sum(mc**2 * inv_var, axis=1)
-    )
-    ll = xc @ (mc * inv_var).T + (xc * xc) @ (-0.5 * inv_var).T + c[None]
+    A, B, c = _affine_params(means - center[None], variances, weights)
+    ll = xc @ A + (xc * xc) @ B + c[None]
     q = jax.nn.softmax(ll, axis=1)
     if row_weights is not None:
         q = q * row_weights[:, None]
@@ -292,17 +295,17 @@ def gmm_moments_auto(
     k, d = means.shape
     if center is None:
         center = jnp.mean(x, axis=0)
-    n_pad = -(-n // _CHUNK_ROWS) * _CHUNK_ROWS
-    w = jnp.ones((n,), jnp.float32) if row_weights is None else row_weights
-    if n_pad != n:  # padded rows carry weight 0 -> contribute nothing
-        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
-        w = jnp.pad(w, (0, n_pad - n))
-    xs = x.reshape(n_pad // _CHUNK_ROWS, _CHUNK_ROWS, d)
-    ws = w.reshape(n_pad // _CHUNK_ROWS, _CHUNK_ROWS)
+    # Full chunks are read in place via dynamic_slice (no padded copy of x —
+    # transient memory stays O(chunk·(d+k))); the ragged tail is one extra
+    # small call.
+    num_full = n // _CHUNK_ROWS
+    w = row_weights
 
-    def step(acc, chunk):
-        xc, wc = chunk
-        qsum, qx, qx2 = gmm_moments_xla(xc, means, variances, weights, wc, center)
+    def step(acc, i):
+        start = i * _CHUNK_ROWS
+        xi = jax.lax.dynamic_slice_in_dim(x, start, _CHUNK_ROWS, 0)
+        wi = None if w is None else jax.lax.dynamic_slice_in_dim(w, start, _CHUNK_ROWS, 0)
+        qsum, qx, qx2 = gmm_moments_xla(xi, means, variances, weights, wi, center)
         return (acc[0] + qsum, acc[1] + qx, acc[2] + qx2), None
 
     init = (
@@ -310,5 +313,16 @@ def gmm_moments_auto(
         jnp.zeros((k, d), jnp.float32),
         jnp.zeros((k, d), jnp.float32),
     )
-    acc, _ = jax.lax.scan(step, init, (xs, ws))
+    acc, _ = jax.lax.scan(step, init, jnp.arange(num_full))
+    tail = n - num_full * _CHUNK_ROWS
+    if tail:
+        qsum, qx, qx2 = gmm_moments_xla(
+            x[num_full * _CHUNK_ROWS :],
+            means,
+            variances,
+            weights,
+            None if w is None else w[num_full * _CHUNK_ROWS :],
+            center,
+        )
+        acc = (acc[0] + qsum, acc[1] + qx, acc[2] + qx2)
     return acc

@@ -176,3 +176,20 @@ def test_block_estimator_accepts_block_sequence(rng):
     m1 = est.fit(blocks, jnp.asarray(b))
     m2 = est.fit(jnp.asarray(A), jnp.asarray(b))
     np.testing.assert_allclose(np.asarray(m1.w), np.asarray(m2.w), atol=1e-5)
+
+
+def test_bcd_feature_sharded_2d_mesh(rng, devices):
+    """BCD with A sharded over BOTH mesh axes — rows over ``data``, feature
+    columns over ``model`` (the 256k-dim FV regime, SURVEY.md §5): same
+    solution as the replicated-columns solve."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(data=4, model=2)
+    A, Wtrue, b = _planted(rng, n=256, d=64, noise=0.0)
+    with use_mesh(mesh):
+        Aj = jax.device_put(jnp.asarray(A), NamedSharding(mesh, P("data", "model")))
+        bj = jax.device_put(jnp.asarray(b), NamedSharding(mesh, P("data", None)))
+        W = np.asarray(
+            block_coordinate_descent_l2(Aj, bj, 0.0, block_size=16, num_iter=30)
+        )
+    np.testing.assert_allclose(W, np.asarray(Wtrue), atol=1e-4)
