@@ -4,6 +4,13 @@ from keystone_tpu.ops.images.nodes import (
     PixelScaler,
     SymmetricRectifier,
 )
+from keystone_tpu.ops.images.image_utils import (
+    conv2d_same,
+    map_pixels,
+    pixel_combine,
+    split_channels,
+    to_grayscale,
+)
 from keystone_tpu.ops.images.convolver import Convolver
 from keystone_tpu.ops.images.pooler import Pooler
 from keystone_tpu.ops.images.windower import Windower

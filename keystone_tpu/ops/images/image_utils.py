@@ -1,0 +1,90 @@
+"""Functional image utilities — the ``utils/images/ImageUtils.scala`` layer.
+
+The reference's ``Image`` trait + five array-layout implementations
+(``utils/images/Image.scala:19-263``) existed to avoid copies between
+Spark's JVM byte buffers and Breeze; with ``jax.Array`` there is ONE
+canonical layout — ``(H, W, C)`` float32, channel-last so the channel axis
+is the XLA minor (lane) dimension — and the layout zoo collapses to plain
+array ops. ``ImageConversions`` (BufferedImage decode, grayscale
+triplication, ``ImageConversions.scala:10-37``) lives in the native ingest
+(``native/ingest.py:decode_jpeg``). What remains here are the functional
+helpers the reference exposes on ``ImageUtils``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def conv2d_same(img, x_filter: np.ndarray, y_filter: np.ndarray):
+    """The reference's ``ImageUtils.conv2D`` contract (``ImageUtils.scala:
+    162-274``): true separable convolution (filter flipped), zero padding
+    floor((k-1)/2) low / ceil((k-1)/2) high, output size = input size.
+    ``img``: (..., H, W).
+
+    Note: ``x_filter`` here runs along our axis -1 (width). The reference's
+    ``xFilter`` runs along ref-x = image height — callers translating
+    reference ``conv2D(img, A, B)`` calls should pass ``(B, A)`` here.
+    """
+
+    def pass1d(x, filt, axis):
+        k = len(filt)
+        lo, hi = (k - 1) // 2, k - 1 - (k - 1) // 2
+        kernel = jnp.asarray(np.asarray(filt, np.float32)[::-1])
+        moved = jnp.moveaxis(x, axis, -1)
+        padded = jnp.pad(
+            moved, [(0, 0)] * (moved.ndim - 1) + [(lo, hi)], mode="constant"
+        )
+        flat = padded.reshape(-1, 1, padded.shape[-1])
+        res = jax.lax.conv_general_dilated(
+            flat, kernel.reshape(1, 1, -1), (1,), "VALID",
+            dimension_numbers=("NCH", "OIH", "NCH"),
+        )
+        return jnp.moveaxis(res.reshape(moved.shape), -1, axis)
+
+    return pass1d(pass1d(img, x_filter, -1), y_filter, -2)
+
+
+def to_grayscale(img, channel_order: str = "rgb"):
+    """NTSC luminance, keeping a singleton channel axis.
+
+    Reference: ``ImageUtils.toGrayScale`` (``ImageUtils.scala:55-87``; BGR
+    there — its JPEG path decodes BGR — RGB here, see ``decode_jpeg``).
+    """
+    if img.shape[-1] == 3:
+        w = jnp.array([0.2989, 0.5870, 0.1140], img.dtype)
+        if channel_order == "bgr":
+            w = w[::-1]
+        return (img @ w)[..., None]
+    return jnp.sqrt(jnp.mean(img**2, axis=-1, keepdims=True))
+
+
+def map_pixels(img, fn: Callable):
+    """Apply an elementwise function to every pixel value.
+
+    Reference: ``ImageUtils.mapPixels`` (``ImageUtils.scala:97-116``). Under
+    jit this is a fused elementwise op, not a Python loop.
+    """
+    return fn(img)
+
+
+def pixel_combine(a, b, fn: Callable = jnp.add):
+    """Combine two same-shape images pixelwise.
+
+    Reference: ``ImageUtils.pixelCombine`` (``ImageUtils.scala:127-151``).
+    """
+    return fn(a, b)
+
+
+def split_channels(img) -> Tuple[jax.Array, ...]:
+    """Split (H, W, C) into C single-channel (H, W, 1) images.
+
+    Reference: ``ImageUtils.splitChannels`` (``ImageUtils.scala:282-303``).
+    """
+    return tuple(
+        img[..., c : c + 1] for c in range(img.shape[-1])
+    )

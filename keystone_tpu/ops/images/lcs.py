@@ -22,32 +22,8 @@ import flax.struct as struct
 from keystone_tpu.core.pipeline import Transformer
 
 
-def conv2d_same(img, x_filter: np.ndarray, y_filter: np.ndarray):
-    """The reference's ``ImageUtils.conv2D`` contract (``:162-274``): true
-    separable convolution (filter flipped), zero padding floor((k-1)/2) low /
-    ceil((k-1)/2) high, output size = input size. ``img``: (..., H, W).
-
-    Note: ``x_filter`` here runs along our axis -1 (width). The reference's
-    ``xFilter`` runs along ref-x = image height — callers translating
-    reference ``conv2D(img, A, B)`` calls should pass ``(B, A)`` here.
-    """
-
-    def pass1d(x, filt, axis):
-        k = len(filt)
-        lo, hi = (k - 1) // 2, k - 1 - (k - 1) // 2
-        kernel = jnp.asarray(np.asarray(filt, np.float32)[::-1])
-        moved = jnp.moveaxis(x, axis, -1)
-        padded = jnp.pad(
-            moved, [(0, 0)] * (moved.ndim - 1) + [(lo, hi)], mode="constant"
-        )
-        flat = padded.reshape(-1, 1, padded.shape[-1])
-        res = jax.lax.conv_general_dilated(
-            flat, kernel.reshape(1, 1, -1), (1,), "VALID",
-            dimension_numbers=("NCH", "OIH", "NCH"),
-        )
-        return jnp.moveaxis(res.reshape(moved.shape), -1, axis)
-
-    return pass1d(pass1d(img, x_filter, -1), y_filter, -2)
+# Shared ImageUtils.conv2D equivalent; re-exported here for back-compat.
+from keystone_tpu.ops.images.image_utils import conv2d_same  # noqa: E402
 
 
 class LCSExtractor(Transformer):

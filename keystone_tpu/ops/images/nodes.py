@@ -29,11 +29,9 @@ class GrayScaler(Transformer):
     channel_order: str = struct.field(pytree_node=False, default="rgb")
 
     def apply(self, img):
-        if img.shape[-1] == 3:
-            rgb = jnp.array([0.2989, 0.5870, 0.1140], img.dtype)
-            w = rgb if self.channel_order == "rgb" else rgb[::-1]
-            return (img @ w)[..., None]
-        return jnp.sqrt(jnp.mean(img**2, axis=-1, keepdims=True))
+        from keystone_tpu.ops.images.image_utils import to_grayscale
+
+        return to_grayscale(img, self.channel_order)
 
 
 class PixelScaler(Transformer):

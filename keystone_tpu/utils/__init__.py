@@ -1,3 +1,8 @@
-from keystone_tpu.utils.stats import about_eq, get_err_percent, normalize_rows
+from keystone_tpu.utils.stats import (
+    about_eq,
+    classification_error,
+    get_err_percent,
+    normalize_rows,
+)
 from keystone_tpu.utils.logging import get_logger, Timer, timed
 from keystone_tpu.utils.profiling import trace, annotate

@@ -14,6 +14,14 @@ def about_eq(a, b, thresh: float = 1e-8) -> bool:
     return bool(np.all(np.abs(np.asarray(a) - np.asarray(b)) <= thresh))
 
 
+def classification_error(predicted, actual, mask=None) -> float:
+    """Fraction of mismatched labels (0..1).
+
+    Reference: ``utils/Stats.scala:76`` (``classificationError``).
+    """
+    return get_err_percent(predicted, actual, mask) / 100.0
+
+
 def get_err_percent(predicted, actual, mask=None) -> float:
     """Top-k error percent: predicted is (n, k) of label indices (top-k first),
     actual is (n,) single labels. Reference: ``utils/Stats.scala:89-103``.

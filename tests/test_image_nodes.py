@@ -157,3 +157,44 @@ def test_zca_whitened_covariance_is_identity(rng):
     white = np.asarray(zca(jnp.asarray(x)))
     cov = white.T @ white / (x.shape[0] - 1)
     np.testing.assert_allclose(cov, np.eye(8), atol=5e-2)
+
+
+def test_image_utils_functional_layer():
+    """ImageUtils equivalents: split/combine/map round-trips and grayscale.
+
+    Reference: ``utils/images/ImageUtils.scala`` splitChannels (:282-303),
+    pixelCombine (:127-151), mapPixels (:97-116), toGrayScale (:55-87).
+    """
+    from keystone_tpu.ops.images import (
+        map_pixels,
+        pixel_combine,
+        split_channels,
+        to_grayscale,
+    )
+
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.uniform(size=(8, 6, 3)).astype(np.float32))
+
+    chans = split_channels(img)
+    assert len(chans) == 3 and chans[0].shape == (8, 6, 1)
+    resum = pixel_combine(pixel_combine(chans[0], chans[1]), chans[2])
+    np.testing.assert_allclose(
+        np.asarray(resum)[..., 0], np.asarray(img).sum(-1), rtol=1e-6
+    )
+
+    doubled = map_pixels(img, lambda p: p * 2.0)
+    np.testing.assert_allclose(np.asarray(doubled), 2 * np.asarray(img), rtol=1e-6)
+
+    gray = to_grayscale(img)
+    assert gray.shape == (8, 6, 1)
+    expect = np.asarray(img) @ np.array([0.2989, 0.5870, 0.1140], np.float32)
+    np.testing.assert_allclose(np.asarray(gray)[..., 0], expect, rtol=1e-5)
+
+
+def test_classification_error_matches_err_percent():
+    from keystone_tpu.utils import classification_error, get_err_percent
+
+    pred = np.array([0, 1, 2, 1])
+    act = np.array([0, 1, 1, 1])
+    assert classification_error(pred, act) == pytest.approx(0.25)
+    assert get_err_percent(pred, act) == pytest.approx(25.0)
