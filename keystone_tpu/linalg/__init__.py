@@ -5,3 +5,9 @@ from keystone_tpu.linalg.solvers import (
     tsqr_solve,
 )
 from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+from keystone_tpu.linalg.distributed import (
+    BlockCoordinateDescent,
+    NormalEquations,
+    RowShardedMatrix,
+    TSQR,
+)

@@ -1,0 +1,200 @@
+"""Row-sharded distributed matrix: the ``mlmatrix`` surface as a first-class
+TPU component.
+
+The reference leans on the external ``edu.berkeley.cs.amplab.mlmatrix`` jar
+(SURVEY.md §2.2): ``RowPartitionedMatrix`` (an RDD of row-block
+``RowPartition``s), ``NormalEquations().solveLeastSquares{,WithL2}``,
+``BlockCoordinateDescent().solveLeastSquaresWithL2`` and
+``MLMatrixUtils.treeReduce``. Used at
+``nodes/learning/BlockLinearMapper.scala:161,172-180`` and
+``nodes/learning/LinearMapper.scala:87-88``; ``RowPartitionedMatrix.createRandom``
+at ``src/test/scala/nodes/learning/LinearMapperSuite.scala:13``.
+
+TPU-native design (not a port): a :class:`RowShardedMatrix` is one
+``jax.Array`` whose leading axis is sharded over the mesh's ``data`` axis —
+partition boundaries are device boundaries, chosen by XLA's SPMD partitioner
+rather than by an RDD partitioner. The reference's driver/executor choreography
+collapses:
+
+- ``treeReduce`` of per-partition grams  -> one sharded matmul; XLA lowers the
+  row contraction to per-shard partials + an ICI all-reduce (``hdot`` below).
+- collect-to-driver + local solve        -> replicated solve: every chip runs
+  the tiny (d×d) solve on the all-reduced gram, no host round-trip.
+- broadcast of the model                 -> the solve's output is replicated
+  by construction.
+
+Solver classes keep the reference's names/signatures so a KeystoneML user can
+map call sites 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.struct as struct
+from jax.sharding import Mesh
+
+from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+from keystone_tpu.linalg.solvers import hdot, normal_equations_solve, tsqr_r, tsqr_solve
+
+
+class RowShardedMatrix(struct.PyTreeNode):
+    """An (n, d) matrix with the row axis sharded over the ``data`` mesh axis.
+
+    The TPU rebuild of ``mlmatrix.RowPartitionedMatrix``. Padding rows (added
+    so n divides the mesh) carry ``mask=0`` and are excluded from every
+    statistic — the data plane's standard ragged-rows treatment
+    (``core/dataset.py``).
+    """
+
+    data: jax.Array
+    mask: Optional[jax.Array] = None
+
+    # -- constructors (reference: fromArray / createRandom) ----------------
+    @classmethod
+    def from_array(cls, x, mesh: Optional[Mesh] = None) -> "RowShardedMatrix":
+        """``RowPartitionedMatrix.fromArray`` analog: pad + row-shard host data."""
+        from keystone_tpu.parallel.mesh import distribute
+
+        ds = distribute(jnp.asarray(x, jnp.float32), mesh)
+        return cls(data=ds.data, mask=ds.mask)
+
+    @classmethod
+    def create_random(
+        cls, key, num_rows: int, num_cols: int, mesh: Optional[Mesh] = None
+    ) -> "RowShardedMatrix":
+        """``RowPartitionedMatrix.createRandom`` analog: standard normal entries,
+        generated sharded (no host round-trip)."""
+        from keystone_tpu.parallel.mesh import data_axis_size, get_mesh, shard_rows
+
+        mesh = mesh or get_mesh()
+        k = data_axis_size(mesh)
+        n_pad = -(-num_rows // k) * k
+        x = jax.random.normal(key, (n_pad, num_cols), jnp.float32)
+        mask = (jnp.arange(n_pad) < num_rows).astype(jnp.float32)
+        return cls(data=shard_rows(x, mesh), mask=shard_rows(mask, mesh))
+
+    # -- shape -------------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        """Valid (unpadded) row count."""
+        if self.mask is None:
+            return self.data.shape[0]
+        return int(np.sum(np.asarray(self.mask)))
+
+    @property
+    def num_cols(self) -> int:
+        return self.data.shape[1]
+
+    def _masked(self) -> jax.Array:
+        if self.mask is None:
+            return self.data
+        return self.data * self.mask[:, None]
+
+    # -- linear algebra ----------------------------------------------------
+    def gram(self) -> jax.Array:
+        """Replicated XᵀX. The reference's ``treeReduce`` of per-partition
+        grams (``BlockWeightedLeastSquares.scala:203-216``) as one sharded
+        matmul whose row contraction XLA all-reduces over ICI."""
+        X = self._masked()
+        return hdot(X.T, X)
+
+    def t_times(self, other: Union["RowShardedMatrix", jax.Array]) -> jax.Array:
+        """Replicated XᵀY for a co-sharded Y (the ``Aᵀb`` reduction)."""
+        Y = other._masked() if isinstance(other, RowShardedMatrix) else other
+        return hdot(self._masked().T, Y)
+
+    def times(self, w: jax.Array) -> "RowShardedMatrix":
+        """Row-sharded X @ w (w replicated — the broadcast-model gemm,
+        ``BlockLinearMapper.scala:107-115``)."""
+        return self.replace(data=hdot(self.data, w))
+
+    def __add__(self, other: "RowShardedMatrix") -> "RowShardedMatrix":
+        """Elementwise add of co-sharded matrices — the reference's
+        ``rdd.zip(+)`` partial-sum tree (``BlockLinearMapper.scala:62,117-135``)."""
+        return self.replace(data=self.data + other.data)
+
+    def column_means(self) -> jax.Array:
+        X = self._masked()
+        n = X.shape[0] if self.mask is None else jnp.sum(self.mask)
+        return jnp.sum(X, axis=0) / n
+
+    def qr_r(self, mesh: Optional[Mesh] = None) -> jax.Array:
+        """R factor via two-level TSQR over ICI (``linalg/solvers.py``)."""
+        from keystone_tpu.parallel.mesh import get_mesh
+
+        return tsqr_r(self._masked(), mesh or get_mesh())
+
+    def collect(self) -> np.ndarray:
+        """Valid rows as one host array (the reference's ``collect()``;
+        use sparingly — everything above runs without leaving the mesh)."""
+        x = np.asarray(self.data)
+        if self.mask is None:
+            return x
+        return x[np.asarray(self.mask) > 0]
+
+
+def _as_parts(a) -> tuple[jax.Array, Optional[jax.Array]]:
+    if isinstance(a, RowShardedMatrix):
+        return a.data, a.mask
+    return jnp.asarray(a, jnp.float32), None
+
+
+class NormalEquations:
+    """``mlmatrix.NormalEquations`` rebuild: gram + cross-term all-reduced over
+    ICI, replicated (d×d) solve. Reference call sites:
+    ``nodes/learning/LinearMapper.scala:87-88``."""
+
+    def solve_least_squares(self, A, b) -> jax.Array:
+        A, mask = _as_parts(A)
+        b, _ = _as_parts(b)
+        return normal_equations_solve(A, b, lam=None, mask=mask)
+
+    def solve_least_squares_with_l2(self, A, b, lam: float) -> jax.Array:
+        A, mask = _as_parts(A)
+        b, _ = _as_parts(b)
+        return normal_equations_solve(A, b, lam=lam, mask=mask)
+
+
+class TSQR:
+    """The upstream ml-matrix TSQR solver (BASELINE.json north star): QR tree
+    over the ``data`` axis, O(κ(A)) where normal equations are O(κ²)."""
+
+    def solve_least_squares(self, A, b, lam: float = 0.0) -> jax.Array:
+        A, mask = _as_parts(A)
+        b, _ = _as_parts(b)
+        return tsqr_solve(A, b, lam=lam, mask=mask)
+
+
+class BlockCoordinateDescent:
+    """``mlmatrix.BlockCoordinateDescent().solveLeastSquaresWithL2`` rebuild
+    (called at ``nodes/learning/BlockLinearMapper.scala:178-180``).
+
+    The reference takes a per-feature-block ``Seq[RowPartitionedMatrix]`` and
+    an array of lambdas, returning one model per lambda. Here the feature axis
+    lives in one (optionally column-sharded) array and the block loop is a
+    ``lax.scan`` (``linalg/bcd.py``); multiple lambdas map over the same
+    compiled program.
+    """
+
+    def solve_least_squares_with_l2(
+        self,
+        A,
+        b,
+        lams: Union[float, Sequence[float]],
+        num_iter: int = 1,
+        block_size: int = 2048,
+    ) -> Union[jax.Array, list[jax.Array]]:
+        A, mask = _as_parts(A)
+        b, _ = _as_parts(b)
+        if jnp.ndim(lams) == 0:
+            return block_coordinate_descent_l2(
+                A, b, float(lams), block_size, num_iter, mask=mask
+            )
+        return [
+            block_coordinate_descent_l2(A, b, float(l), block_size, num_iter, mask=mask)
+            for l in lams
+        ]

@@ -1,6 +1,10 @@
 from keystone_tpu.ops.images.nodes import (
     GrayScaler,
+    ImageExtractor,
     ImageVectorizer,
+    LabelExtractor,
+    MultiLabelExtractor,
+    MultiLabeledImageExtractor,
     PixelScaler,
     SymmetricRectifier,
 )

@@ -49,6 +49,37 @@ class ImageVectorizer(Transformer):
         return img.reshape(-1)
 
 
+class ImageExtractor(Transformer):
+    """``LabeledData`` -> images. Reference:
+    ``nodes/images/LabeledImageExtractors.scala:16``."""
+
+    def apply(self, item):
+        return item.data
+
+    def apply_batch(self, xs):
+        return xs.data
+
+
+class MultiLabeledImageExtractor(ImageExtractor):
+    """Reference: ``nodes/images/LabeledImageExtractors.scala:30``."""
+
+
+class LabelExtractor(Transformer):
+    """``LabeledData`` -> int labels. Reference:
+    ``nodes/images/LabeledImageExtractors.scala:9``."""
+
+    def apply(self, item):
+        return item.labels
+
+    def apply_batch(self, xs):
+        return xs.labels
+
+
+class MultiLabelExtractor(LabelExtractor):
+    """``LabeledData`` -> multi-hot label rows. Reference:
+    ``nodes/images/LabeledImageExtractors.scala:23``."""
+
+
 class SymmetricRectifier(Transformer):
     """Doubles channels: ``max(maxVal, x-α)`` ++ ``max(maxVal, -x-α)``.
 

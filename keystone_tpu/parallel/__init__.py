@@ -8,3 +8,8 @@ from keystone_tpu.parallel.mesh import (
     replicate,
     distribute,
 )
+from keystone_tpu.parallel.ring import (
+    ring_attention,
+    ring_gram,
+    ulysses_attention,
+)

@@ -1,0 +1,238 @@
+"""Ring / all-to-all sequence-context parallelism over ICI.
+
+The reference has no attention (its LM is count-based — SURVEY.md §5); its
+long-dimension analog is feature-axis blocking (``VectorSplitter`` + block
+solvers). This module makes the TPU-native generalization first-class, per
+SURVEY.md §5's design note ("rotating feature blocks around the ring is the
+natural ICI pattern when a block exceeds per-chip HBM"):
+
+- :func:`ring_gram` — XᵀX with the *feature* axis sharded: each device holds a
+  column block; blocks rotate around the ring via ``lax.ppermute`` so every
+  (i, j) gram tile is computed without ever gathering full X on one chip.
+  This is the beyond-HBM regime of the reference's 256k-dim Fisher-vector
+  features (``ImageNetSiftLcsFV.scala:188``).
+
+- :func:`ring_attention` — blockwise-softmax attention with the *sequence*
+  axis sharded: K/V blocks rotate around the ring while each device keeps its
+  Q block and a running (max, denominator, numerator) online-softmax state —
+  ring attention (Liu et al.; PAPERS.md). Peak memory per chip is O(S·S/k),
+  ICI traffic fully overlappable with the per-step matmuls.
+
+- :func:`ulysses_attention` — the all-to-all alternative (DeepSpeed-Ulysses):
+  reshard sequence-sharded Q/K/V to head-sharded via ``lax.all_to_all``,
+  run exact local attention over the full sequence per head group, reshard
+  back. Cheaper ICI volume than the ring when heads ≥ devices.
+
+All three are ``shard_map`` programs over one mesh axis and compose with the
+``data``/``model`` axes used by the solvers (``parallel/mesh.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from keystone_tpu.linalg.solvers import hdot
+
+
+def _ring_perm(axis_name: str):
+    n = jax.lax.axis_size(axis_name)
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_gram(x: jax.Array, mesh: Optional[Mesh] = None, axis: str = "model") -> jax.Array:
+    """XᵀX for ``x`` (n, d) with the feature axis sharded over ``axis``.
+
+    Returns the gram column-sharded the same way: device j ends with the
+    (d, d/k) tile ``Xᵀ X_j``. One column block circulates the ring; at step t
+    each device multiplies the visiting block's transpose against its own,
+    filling one (d/k, d/k) tile per step — k steps, each overlapping a
+    ppermute with a matmul.
+    """
+    from keystone_tpu.parallel.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    k = mesh.shape[axis]
+    d = x.shape[1]
+    if d % k:
+        raise ValueError(
+            f"feature dim {d} must be divisible by the '{axis}' axis size {k}"
+        )
+    db = d // k
+
+    def local(xj):
+        # xj: (n, db) — this device's resident column block.
+        j = jax.lax.axis_index(axis)
+        perm = _ring_perm(axis)
+
+        def fold(t, visiting, out):
+            # The block visiting at step t started on device (j - t) mod k.
+            src = (j - t) % k
+            tile = hdot(visiting.T, xj)  # (db, db): X_srcᵀ X_j
+            return jax.lax.dynamic_update_slice(out, tile, (src * db, 0))
+
+        def step(t, carry):
+            visiting, out = carry
+            out = fold(t, visiting, out)
+            return jax.lax.ppermute(visiting, axis, perm), out
+
+        # pcast: the zeros are logically replicated but the loop carry becomes
+        # device-varying after the first update, so type them varying up front.
+        out = jax.lax.pcast(jnp.zeros((d, db), xj.dtype), axis, to="varying")
+        # k-1 rotations; the last visiting block is consumed without a
+        # (wasted) final ppermute.
+        visiting, out = jax.lax.fori_loop(0, k - 1, step, (xj, out))
+        return fold(k - 1, visiting, out)
+
+    spec = P(None, axis)
+    return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)(x)
+
+
+def _online_softmax_step(q, kb, vb, state, bias):
+    """One block of numerically-stable streaming softmax attention.
+
+    state = (m, l, acc): running rowwise max, denominator, numerator.
+    """
+    m, l, acc = state
+    s = hdot(q, kb.swapaxes(-1, -2)) * (q.shape[-1] ** -0.5)
+    if bias is not None:
+        s = s + bias
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    scale = jnp.exp(m - m_new)
+    l = l * scale + p.sum(axis=-1)
+    acc = acc * scale[..., None] + hdot(p, vb)
+    return m_new, l, acc
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention with the sequence axis sharded over ``axis``.
+
+    ``q``/``k``/``v``: (batch, seq, heads, head_dim), seq sharded. K/V blocks
+    rotate the ring; each device folds every visiting block into its online
+    softmax state, so the full (S, S) score matrix never exists. ``causal``
+    masks by *global* position, reconstructed from the ring step.
+    """
+    from keystone_tpu.parallel.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    nk = mesh.shape[axis]
+    sb = q.shape[1] // nk
+    neg = jnp.finfo(jnp.float32).min
+
+    def local(qj, kj, vj):
+        j = jax.lax.axis_index(axis)
+        perm = _ring_perm(axis)
+        # (B, Sb, H, D) -> (B, H, Sb, D) for batched matmuls on the MXU.
+        qj, kj, vj = (t.swapaxes(1, 2).astype(jnp.float32) for t in (qj, kj, vj))
+        B, H, S, D = qj.shape
+        q_pos = j * sb + jnp.arange(sb)
+
+        def fold(t, kb, vb, state):
+            src = (j - t) % nk
+            if causal:
+                k_pos = src * sb + jnp.arange(sb)
+                bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, neg)
+            else:
+                bias = None
+            return _online_softmax_step(qj, kb, vb, state, bias)
+
+        def step(t, carry):
+            (kb, vb), state = carry
+            state = fold(t, kb, vb, state)
+            return jax.lax.ppermute((kb, vb), axis, perm), state
+
+        state = jax.lax.pcast(
+            (
+                jnp.full((B, H, S), neg),
+                jnp.zeros((B, H, S)),
+                jnp.zeros((B, H, S, D)),
+            ),
+            axis,
+            to="varying",
+        )
+        # nk-1 rotations; the final visiting block needs no onward ppermute.
+        (kb, vb), state = jax.lax.fori_loop(0, nk - 1, step, ((kj, vj), state))
+        m, l, acc = fold(nk - 1, kb, vb, state)
+        out = acc / l[..., None]
+        return out.swapaxes(1, 2)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+    causal: bool = False,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    Input sequence-sharded (B, S/k, H, D); one ``all_to_all`` reshards to
+    head-sharded (B, S, H/k, D), each device runs exact full-sequence
+    attention on its head group, a second ``all_to_all`` reshards back.
+    Requires heads divisible by the axis size.
+    """
+    from keystone_tpu.parallel.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    nk = mesh.shape[axis]
+    if q.shape[2] % nk:
+        raise ValueError(
+            f"heads {q.shape[2]} must be divisible by the '{axis}' axis size {nk}"
+        )
+    neg = jnp.finfo(jnp.float32).min
+
+    def local(qj, kj, vj):
+        # (B, Sb, H, D) -> (B, S, Hb, D): gather seq, scatter heads.
+        a2a = functools.partial(
+            jax.lax.all_to_all, axis_name=axis, split_axis=2, concat_axis=1, tiled=True
+        )
+        qf, kf, vf = a2a(qj), a2a(kj), a2a(vj)
+        qf, kf, vf = (t.swapaxes(1, 2).astype(jnp.float32) for t in (qf, kf, vf))
+        s = hdot(qf, kf.swapaxes(-1, -2)) * (qf.shape[-1] ** -0.5)
+        if causal:
+            S = s.shape[-1]
+            s = jnp.where(
+                jnp.arange(S)[:, None] >= jnp.arange(S)[None, :], s, neg
+            )
+        out = hdot(jax.nn.softmax(s, axis=-1), vf).swapaxes(1, 2)
+        # (B, S, Hb, D) -> (B, Sb, H, D): gather heads, scatter seq.
+        return jax.lax.all_to_all(
+            out, axis_name=axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def attention_reference(q, k, v, causal: bool = False) -> jax.Array:
+    """Unsharded exact attention (the correctness oracle for the tests)."""
+    q, k, v = (t.swapaxes(1, 2).astype(jnp.float32) for t in (q, k, v))
+    s = hdot(q, k.swapaxes(-1, -2)) * (q.shape[-1] ** -0.5)
+    if causal:
+        S = s.shape[-1]
+        s = jnp.where(
+            jnp.arange(S)[:, None] >= jnp.arange(S)[None, :],
+            s,
+            jnp.finfo(jnp.float32).min,
+        )
+    return hdot(jax.nn.softmax(s, axis=-1), v).swapaxes(1, 2)
