@@ -52,6 +52,9 @@ class RowShardedMatrix(struct.PyTreeNode):
 
     data: jax.Array
     mask: Optional[jax.Array] = None
+    # Valid row count, known statically at construction (None: all rows valid
+    # or derive from mask). Static so reading it never touches device data.
+    valid_rows: Optional[int] = struct.field(pytree_node=False, default=None)
 
     # -- constructors (reference: fromArray / createRandom) ----------------
     @classmethod
@@ -59,8 +62,9 @@ class RowShardedMatrix(struct.PyTreeNode):
         """``RowPartitionedMatrix.fromArray`` analog: pad + row-shard host data."""
         from keystone_tpu.parallel.mesh import distribute
 
+        n = x.shape[0]
         ds = distribute(jnp.asarray(x, jnp.float32), mesh)
-        return cls(data=ds.data, mask=ds.mask)
+        return cls(data=ds.data, mask=ds.mask, valid_rows=n)
 
     @classmethod
     def create_random(
@@ -75,12 +79,17 @@ class RowShardedMatrix(struct.PyTreeNode):
         n_pad = -(-num_rows // k) * k
         x = jax.random.normal(key, (n_pad, num_cols), jnp.float32)
         mask = (jnp.arange(n_pad) < num_rows).astype(jnp.float32)
-        return cls(data=shard_rows(x, mesh), mask=shard_rows(mask, mesh))
+        return cls(
+            data=shard_rows(x, mesh), mask=shard_rows(mask, mesh),
+            valid_rows=num_rows,
+        )
 
     # -- shape -------------------------------------------------------------
     @property
     def num_rows(self) -> int:
         """Valid (unpadded) row count."""
+        if self.valid_rows is not None:
+            return self.valid_rows
         if self.mask is None:
             return self.data.shape[0]
         return int(np.sum(np.asarray(self.mask)))
@@ -137,10 +146,26 @@ class RowShardedMatrix(struct.PyTreeNode):
         return x[np.asarray(self.mask) > 0]
 
 
-def _as_parts(a) -> tuple[jax.Array, Optional[jax.Array]]:
-    if isinstance(a, RowShardedMatrix):
-        return a.data, a.mask
-    return jnp.asarray(a, jnp.float32), None
+def _solver_args(A, b) -> tuple[jax.Array, jax.Array, Optional[jax.Array]]:
+    """Align (A, b) for the solvers: a raw ``b`` with A's *valid* row count is
+    zero-padded and co-sharded to match A's padded rows, so KeystoneML-style
+    call sites (sharded features, host labels) map 1:1."""
+    mask = None
+    if isinstance(A, RowShardedMatrix):
+        A, mask = A.data, A.mask
+    else:
+        A = jnp.asarray(A, jnp.float32)
+    if isinstance(b, RowShardedMatrix):
+        b = b.data
+    else:
+        b = jnp.asarray(b, jnp.float32)
+        if b.shape[0] != A.shape[0]:
+            if b.shape[0] > A.shape[0]:
+                raise ValueError(
+                    f"b has {b.shape[0]} rows but A has only {A.shape[0]}"
+                )
+            b = jnp.pad(b, ((0, A.shape[0] - b.shape[0]),) + ((0, 0),) * (b.ndim - 1))
+    return A, b, mask
 
 
 class NormalEquations:
@@ -149,13 +174,11 @@ class NormalEquations:
     ``nodes/learning/LinearMapper.scala:87-88``."""
 
     def solve_least_squares(self, A, b) -> jax.Array:
-        A, mask = _as_parts(A)
-        b, _ = _as_parts(b)
+        A, b, mask = _solver_args(A, b)
         return normal_equations_solve(A, b, lam=None, mask=mask)
 
     def solve_least_squares_with_l2(self, A, b, lam: float) -> jax.Array:
-        A, mask = _as_parts(A)
-        b, _ = _as_parts(b)
+        A, b, mask = _solver_args(A, b)
         return normal_equations_solve(A, b, lam=lam, mask=mask)
 
 
@@ -164,8 +187,7 @@ class TSQR:
     over the ``data`` axis, O(κ(A)) where normal equations are O(κ²)."""
 
     def solve_least_squares(self, A, b, lam: float = 0.0) -> jax.Array:
-        A, mask = _as_parts(A)
-        b, _ = _as_parts(b)
+        A, b, mask = _solver_args(A, b)
         return tsqr_solve(A, b, lam=lam, mask=mask)
 
 
@@ -188,9 +210,8 @@ class BlockCoordinateDescent:
         num_iter: int = 1,
         block_size: int = 2048,
     ) -> Union[jax.Array, list[jax.Array]]:
-        A, mask = _as_parts(A)
-        b, _ = _as_parts(b)
-        if jnp.ndim(lams) == 0:
+        A, b, mask = _solver_args(A, b)
+        if np.ndim(lams) == 0:
             return block_coordinate_descent_l2(
                 A, b, float(lams), block_size, num_iter, mask=mask
             )

@@ -4,3 +4,4 @@ from keystone_tpu.native.ingest import (
     decode_jpeg,
     native_available,
 )
+from keystone_tpu.native.ngram import count_by_key

@@ -1,0 +1,195 @@
+// Native n-gram/key aggregation: the host-side "reduceByKey" of the NLP track.
+//
+// The reference's count path is per-partition JHashMap counting followed by a
+// reduceByKey shuffle with a custom partitioner (nodes/nlp/ngrams.scala:150-183,
+// nodes/nlp/StupidBackoff.scala:25-57,156-159). The TPU rebuild keeps counting
+// host-side (keyed aggregation is the one genuinely non-dense pattern —
+// SURVEY.md §2.13) but runs it here as a two-phase multithreaded aggregation:
+//
+//   phase 1: T scan threads each take a contiguous slice of the key array and
+//            scatter (key, weight) into T×T hash-partitioned buckets — the
+//            partitioner analog, except partitions are picked by key hash so
+//            phase 2 needs no cross-thread merge conflicts;
+//   phase 2: T merge threads each own one hash partition and fold all T
+//            buckets for it into an open-addressed map — the per-partition
+//            JHashMap analog.
+//
+// Output is key-sorted so the device side can binary-search it directly
+// (jnp.searchsorted over the packed-key tables, ops/nlp/stupid_backoff.py).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct KW {
+  int64_t key;
+  double w;
+};
+
+// 64-bit mix (splitmix64 finalizer) — partition + open-addressing hash.
+static inline uint64_t mix(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+// Open-addressed linear-probe map for int64 keys -> double weights.
+class Map {
+ public:
+  explicit Map(size_t expected) {
+    size_t cap = 16;
+    while (cap < expected * 2) cap <<= 1;
+    mask_ = cap - 1;
+    slots_.assign(cap, Slot{kEmpty, 0.0});
+  }
+
+  void add(int64_t key, double w) {
+    if (size_ * 2 >= slots_.size()) grow();
+    size_t i = mix((uint64_t)key) & mask_;
+    for (;;) {
+      Slot& s = slots_[i];
+      if (s.key == key) {
+        s.w += w;
+        return;
+      }
+      if (s.key == kEmpty) {
+        s.key = key;
+        s.w = w;
+        ++size_;
+        return;
+      }
+      i = (i + 1) & mask_;
+    }
+  }
+
+  size_t size() const { return size_; }
+
+  void drain(std::vector<KW>& out) const {
+    for (const Slot& s : slots_)
+      if (s.key != kEmpty) out.push_back({s.key, s.w});
+  }
+
+ private:
+  // Sentinel for an empty slot; INT64_MIN is never a valid packed n-gram key
+  // (packed keys are non-negative; callers must not pass INT64_MIN).
+  static constexpr int64_t kEmpty = INT64_MIN;
+  struct Slot {
+    int64_t key;
+    double w;
+  };
+
+  void grow() {
+    std::vector<Slot> old = std::move(slots_);
+    slots_.assign(old.size() * 2, Slot{kEmpty, 0.0});
+    mask_ = slots_.size() - 1;
+    size_ = 0;
+    for (const Slot& s : old)
+      if (s.key != kEmpty) add(s.key, s.w);
+  }
+
+  std::vector<Slot> slots_;
+  size_t mask_;
+  size_t size_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Aggregate weights by key. keys[n]; weights may be null (weight 1.0 each).
+// Writes up to `cap` key-sorted distinct (key, total) pairs into
+// out_keys/out_counts. Returns the number of distinct keys (which may exceed
+// `cap`, in which case nothing was written and the caller must retry with a
+// larger buffer), or -1 on invalid arguments.
+long ks_count_by_key(const int64_t* keys, long n, const double* weights,
+                     int64_t* out_keys, double* out_counts, long cap,
+                     int num_threads) {
+  if (n < 0 || !keys || (cap > 0 && (!out_keys || !out_counts))) return -1;
+  if (n == 0) return 0;
+  int T = num_threads < 1 ? 1 : (num_threads > 64 ? 64 : num_threads);
+  if (n < 4096) T = 1;  // threading overhead dominates tiny inputs
+
+  if (T == 1) {  // no bucketing pass needed: scan straight into one map
+    Map map((size_t)n / 4 + 8);
+    for (long i = 0; i < n; ++i) map.add(keys[i], weights ? weights[i] : 1.0);
+    if ((long)map.size() > cap) return (long)map.size();
+    std::vector<KW> out;
+    out.reserve(map.size());
+    map.drain(out);
+    std::sort(out.begin(), out.end(),
+              [](const KW& a, const KW& b) { return a.key < b.key; });
+    for (size_t i = 0; i < out.size(); ++i) {
+      out_keys[i] = out[i].key;
+      out_counts[i] = out[i].w;
+    }
+    return (long)out.size();
+  }
+
+  // Phase 1: scan slices, scatter into per-(scanner, partition) buckets.
+  std::vector<std::vector<std::vector<KW>>> buckets(
+      T, std::vector<std::vector<KW>>(T));
+  {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < T; ++t) {
+      threads.emplace_back([&, t]() {
+        long lo = n * (long)t / T, hi = n * (long)(t + 1) / T;
+        auto& mine = buckets[t];
+        for (auto& b : mine) b.reserve((hi - lo) / T + 8);
+        for (long i = lo; i < hi; ++i) {
+          int p = (int)((mix((uint64_t)keys[i]) >> 32) % (uint64_t)T);
+          mine[p].push_back({keys[i], weights ? weights[i] : 1.0});
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+
+  // Phase 2: each thread owns one partition; fold + sort it.
+  std::vector<std::vector<KW>> merged(T);
+  {
+    std::vector<std::thread> threads;
+    for (int p = 0; p < T; ++p) {
+      threads.emplace_back([&, p]() {
+        size_t total = 0;
+        for (int t = 0; t < T; ++t) total += buckets[t][p].size();
+        Map map(total / 2 + 8);
+        for (int t = 0; t < T; ++t)
+          for (const KW& kw : buckets[t][p]) map.add(kw.key, kw.w);
+        merged[p].reserve(map.size());
+        map.drain(merged[p]);
+        std::sort(merged[p].begin(), merged[p].end(),
+                  [](const KW& a, const KW& b) { return a.key < b.key; });
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+
+  long distinct = 0;
+  for (const auto& m : merged) distinct += (long)m.size();
+  if (distinct > cap) return distinct;  // caller retries with a bigger buffer
+
+  // Partitions are hash-disjoint; k-way merge them into key order.
+  std::vector<size_t> idx(T, 0);
+  long o = 0;
+  for (;;) {
+    int best = -1;
+    for (int p = 0; p < T; ++p)
+      if (idx[p] < merged[p].size() &&
+          (best < 0 || merged[p][idx[p]].key < merged[best][idx[best]].key))
+        best = p;
+    if (best < 0) break;
+    out_keys[o] = merged[best][idx[best]].key;
+    out_counts[o] = merged[best][idx[best]].w;
+    ++o;
+    ++idx[best];
+  }
+  return distinct;
+}
+
+}  // extern "C"

@@ -1,0 +1,131 @@
+"""ctypes binding for the native keyed-aggregation library (ngram.cpp), with a
+numpy fallback (``np.unique`` + ``np.add.at``).
+
+``count_by_key`` is the host-side ``reduceByKey`` of the NLP track
+(SURVEY.md §2.13 — keyed aggregation is the one genuinely non-dense pattern,
+kept host-side by design): packed int64 n-gram keys in, key-sorted distinct
+(key, total-weight) tables out, ready for the device's ``searchsorted``
+lookups (``ops/nlp/stupid_backoff.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu.utils.logging import get_logger
+
+logger = get_logger("keystone_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_ngram.so")
+_SRC = os.path.join(_DIR, "ngram.cpp")
+_STAMP = _SO + ".srchash"
+_lib = None
+_build_attempted = False
+
+
+def _src_hash() -> str:
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_attempted
+    if _build_attempted:
+        return None
+    _build_attempted = True
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        with open(_STAMP, "w") as f:
+            f.write(_src_hash())
+        return ctypes.CDLL(_SO)
+    except Exception as e:
+        logger.warning("native ngram build failed (%s); using numpy fallback", e)
+        return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    fresh = False
+    if os.path.exists(_SO) and os.path.exists(_STAMP):
+        with open(_STAMP) as f:
+            fresh = f.read().strip() == _src_hash()
+    if fresh:
+        try:
+            _lib = ctypes.CDLL(_SO)
+        except OSError:
+            _lib = _build()
+    else:
+        _lib = _build()
+    if _lib is not None:
+        _lib.ks_count_by_key.restype = ctypes.c_long
+        _lib.ks_count_by_key.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
+        ]
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def _count_by_key_np(
+    keys: np.ndarray, weights: Optional[np.ndarray]
+) -> Tuple[np.ndarray, np.ndarray]:
+    uniq, inv = np.unique(keys, return_inverse=True)
+    totals = np.zeros(uniq.shape[0], dtype=np.float64)
+    np.add.at(totals, inv, 1.0 if weights is None else weights)
+    return uniq, totals
+
+
+def count_by_key(
+    keys: np.ndarray,
+    weights: Optional[np.ndarray] = None,
+    num_threads: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Aggregate ``weights`` (default: ones) by int64 key.
+
+    Returns ``(sorted distinct keys int64, totals float64)`` — the host
+    ``reduceByKey``. Keys must be non-negative (packed n-gram keys are).
+    """
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    if keys.ndim != 1:
+        raise ValueError("count_by_key expects a 1-D key array")
+    if weights is not None:
+        weights = np.ascontiguousarray(weights, dtype=np.float64)
+        if weights.shape != keys.shape:
+            raise ValueError("weights must match keys")
+    if keys.size == 0:
+        return np.zeros((0,), np.int64), np.zeros((0,), np.float64)
+
+    lib = _get_lib()
+    if lib is None:
+        return _count_by_key_np(keys, weights)
+    if num_threads <= 0:
+        num_threads = min(16, os.cpu_count() or 1)
+    w_ptr = weights.ctypes.data_as(ctypes.c_void_p) if weights is not None else None
+    cap = keys.size
+    while True:
+        out_keys = np.empty(cap, np.int64)
+        out_counts = np.empty(cap, np.float64)
+        n = lib.ks_count_by_key(
+            keys.ctypes.data_as(ctypes.c_void_p), keys.size, w_ptr,
+            out_keys.ctypes.data_as(ctypes.c_void_p),
+            out_counts.ctypes.data_as(ctypes.c_void_p), cap, num_threads,
+        )
+        if n < 0:
+            return _count_by_key_np(keys, weights)
+        if n <= cap:
+            return out_keys[:n].copy(), out_counts[:n].copy()
+        cap = n

@@ -256,10 +256,11 @@ class StupidBackoffEstimator:
                 arr = np.array([ng for ng, _ in entries], dtype=np.int64)
                 keys = indexer.pack_batch(arr)
                 counts = np.array([c for _, c in entries], dtype=np.float64)
-                # merge duplicates, sort by key: one np pass (reduceByKey analog)
-                uniq, inv = np.unique(keys, return_inverse=True)
-                summed = np.zeros(uniq.shape[0], dtype=np.float64)
-                np.add.at(summed, inv, counts)
+                # merge duplicates, sort by key: the host reduceByKey, run by
+                # the native multithreaded aggregator (numpy fallback inside).
+                from keystone_tpu.native.ngram import count_by_key
+
+                uniq, summed = count_by_key(keys, counts)
                 # Tables stay host-side numpy so int64 keys reach the device
                 # intact (they are converted under enable_x64 at trace time).
                 table_keys.append(uniq)

@@ -128,6 +128,11 @@ def ring_attention(
 
     mesh = mesh or get_mesh()
     nk = mesh.shape[axis]
+    if q.shape[1] % nk:
+        raise ValueError(
+            f"sequence length {q.shape[1]} must be divisible by the "
+            f"'{axis}' axis size {nk}"
+        )
     sb = q.shape[1] // nk
     neg = jnp.finfo(jnp.float32).min
 

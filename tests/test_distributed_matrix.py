@@ -61,6 +61,15 @@ def test_create_random_shape_and_moments(mesh):
     assert abs(x.mean()) < 0.1 and abs(x.std() - 1.0) < 0.1
 
 
+def test_solvers_accept_raw_unpadded_b(mesh, rng):
+    # 13 rows pads to 16; a raw 13-row b must be co-padded internally.
+    x = rng.normal(size=(13, 4)).astype(np.float32)
+    w = rng.normal(size=(4, 2)).astype(np.float32)
+    A = RowShardedMatrix.from_array(x, mesh)
+    W = NormalEquations().solve_least_squares_with_l2(A, x @ w, lam=1e-6)
+    np.testing.assert_allclose(np.asarray(W), w, rtol=1e-2, atol=1e-3)
+
+
 def test_normal_equations_recover_planted_model(mesh, rng):
     # LinearMapperSuite.scala:11-34: OLS recovers a planted model.
     x = rng.normal(size=(200, 7)).astype(np.float32)
@@ -79,8 +88,8 @@ def test_tsqr_r_and_solver(mesh, rng):
     R = np.asarray(A.qr_r(mesh))
     np.testing.assert_allclose(R.T @ R, x.T @ x, rtol=1e-4, atol=1e-4)
     w = rng.normal(size=(5, 2)).astype(np.float32)
-    b = x @ w
-    W = TSQR().solve_least_squares(A, jnp.asarray(np.pad(b, ((0, A.data.shape[0] - 64), (0, 0)))))
+    # raw unpadded b: the solvers co-pad it to A's padded rows internally
+    W = TSQR().solve_least_squares(A, x @ w)
     np.testing.assert_allclose(np.asarray(W), w, rtol=1e-3, atol=1e-4)
 
 

@@ -1,0 +1,59 @@
+"""Native keyed aggregation (native/ngram.cpp): parity with the numpy
+fallback, weight merging, and the big-input threaded path."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.native.ngram import (
+    _count_by_key_np,
+    count_by_key,
+    native_available,
+)
+
+
+def test_count_by_key_small(rng):
+    keys = np.array([5, 3, 5, 5, 3, 9], np.int64)
+    uniq, totals = count_by_key(keys)
+    np.testing.assert_array_equal(uniq, [3, 5, 9])
+    np.testing.assert_array_equal(totals, [2.0, 3.0, 1.0])
+
+
+def test_count_by_key_weights():
+    keys = np.array([1, 2, 1], np.int64)
+    w = np.array([0.5, 2.0, 1.5])
+    uniq, totals = count_by_key(keys, w)
+    np.testing.assert_array_equal(uniq, [1, 2])
+    np.testing.assert_allclose(totals, [2.0, 2.0])
+
+
+def test_count_by_key_empty():
+    uniq, totals = count_by_key(np.zeros((0,), np.int64))
+    assert uniq.size == 0 and totals.size == 0
+
+
+def test_count_by_key_matches_numpy_large(rng):
+    # > threading threshold, skewed key distribution (Zipf-ish n-gram counts)
+    keys = rng.integers(0, 5000, size=200_000).astype(np.int64) ** 2
+    w = rng.random(200_000)
+    ref_u, ref_t = _count_by_key_np(keys, w)
+    # num_threads=4 forces the hash-partitioned threaded path even on 1-core
+    # CI boxes (the default would pick T=1 there).
+    for threads in (1, 4):
+        uniq, totals = count_by_key(keys, w, num_threads=threads)
+        np.testing.assert_array_equal(uniq, ref_u)
+        np.testing.assert_allclose(totals, ref_t, rtol=1e-9)
+        assert np.all(np.diff(uniq) > 0)  # key-sorted, distinct
+
+
+def test_native_library_builds():
+    # The image ships g++, so the native path (not the fallback) must be live.
+    assert native_available()
+
+
+def test_stupid_backoff_uses_aggregated_tables():
+    from keystone_tpu.ops.nlp.stupid_backoff import StupidBackoffEstimator
+
+    # duplicate bigram entries (NoAdd-mode partials) must be summed
+    counts = [((0, 1), 2), ((0, 1), 3), ((1, 2), 1)]
+    model = StupidBackoffEstimator({0: 5, 1: 6, 2: 1}).fit(counts)
+    assert model.apply([0, 1]) == pytest.approx(5.0 / 5.0)
