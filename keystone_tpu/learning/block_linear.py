@@ -89,7 +89,10 @@ def _block_contrib(xs, w, start, stop):
 @jax.jit
 def _streaming_block_step_first(feat_node, raw, R, lam, mask):
     """First pass over a block: derive the (masked) feature mean from the same
-    featurization used for the solve — no separate mean pass."""
+    featurization used for the solve — no separate mean pass. Returns the
+    unregularized gram XᵀX so later passes can skip the 2·n·b² gram gemm
+    (the reference likewise computes XᵀX only on pass 0 and reuses it,
+    ``BlockWeightedLeastSquares.scala:214-221``)."""
     from keystone_tpu.linalg.solvers import hdot
 
     feats = feat_node.apply_batch(raw)
@@ -103,7 +106,7 @@ def _streaming_block_step_first(feat_node, raw, R, lam, mask):
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
     Wk = jnp.linalg.solve(gram + lam * eye, hdot(feats.T, R))
     R = R - hdot(feats, Wk)
-    return fmean, Wk, R
+    return fmean, Wk, R, gram
 
 
 @jax.jit
@@ -114,6 +117,23 @@ def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean):
     if mask is not None:
         feats = feats * mask[:, None]
     gram = hdot(feats.T, feats)
+    rhs = hdot(feats.T, R) + hdot(gram, Wk)
+    eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
+    Wk_new = jnp.linalg.solve(gram + lam * eye, rhs)
+    R = R - hdot(feats, Wk_new - Wk)
+    return Wk_new, R
+
+
+@jax.jit
+def _streaming_block_step_cached(feat_node, raw, R, Wk, lam, mask, fmean, gram):
+    """Later-pass block step with the pass-0 gram: only the n×b×c cross terms
+    and the b³-class solve remain — ~4× cheaper than re-doing the 2·n·b² gram
+    when b ≫ c."""
+    from keystone_tpu.linalg.solvers import hdot
+
+    feats = feat_node.apply_batch(raw) - fmean
+    if mask is not None:
+        feats = feats * mask[:, None]
     rhs = hdot(feats.T, R) + hdot(gram, Wk)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
     Wk_new = jnp.linalg.solve(gram + lam * eye, rhs)
@@ -136,15 +156,21 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     becomes the intercept.
     """
 
-    def __init__(self, block_size: int, num_iter: int = 1, lam: float = 0.0):
+    def __init__(self, block_size: int, num_iter: int = 1, lam: float = 0.0,
+                 cache_grams: bool = True):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
+        # Reuse pass-0 per-block grams on later passes (the reference's
+        # blockStats cache, ``BlockWeightedLeastSquares.scala:214-221``).
+        # Costs num_blocks·b² f32 of HBM; disable for huge block counts.
+        self.cache_grams = cache_grams
 
     def fit(self, data, labels, mask: Optional[jax.Array] = None) -> BlockLinearMapper:
         A, B, feature_scaler, label_scaler, mask = center_for_solve(data, labels, mask)
         w = block_coordinate_descent_l2(
-            A, B, self.lam, self.block_size, self.num_iter, mask=mask
+            A, B, self.lam, self.block_size, self.num_iter, mask=mask,
+            cache_grams=self.cache_grams,
         )
         return BlockLinearMapper(
             w=w,
@@ -181,14 +207,24 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
         fmeans: list = [None] * len(feature_nodes)
         Ws: list = [None] * len(feature_nodes)
+        grams: list = [None] * len(feature_nodes)
         R = B.astype(jnp.float32)
         for k, node in enumerate(feature_nodes):
-            fmeans[k], Ws[k], R = _streaming_block_step_first(node, raw, R, lam, mask)
+            fmeans[k], Ws[k], R, gram = _streaming_block_step_first(
+                node, raw, R, lam, mask
+            )
+            if self.cache_grams and self.num_iter > 1:
+                grams[k] = gram
         for _ in range(self.num_iter - 1):
             for k, node in enumerate(feature_nodes):
-                Ws[k], R = _streaming_block_step(
-                    node, raw, R, Ws[k], lam, mask, fmeans[k]
-                )
+                if grams[k] is not None:
+                    Ws[k], R = _streaming_block_step_cached(
+                        node, raw, R, Ws[k], lam, mask, fmeans[k], grams[k]
+                    )
+                else:
+                    Ws[k], R = _streaming_block_step(
+                        node, raw, R, Ws[k], lam, mask, fmeans[k]
+                    )
         return BlockLinearMapper(
             w=jnp.concatenate(Ws, axis=0),
             b=label_scaler.mean,

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from keystone_tpu.linalg.solvers import hdot
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "num_iter"))
+@functools.partial(jax.jit, static_argnames=("block_size", "num_iter", "cache_grams"))
 def block_coordinate_descent_l2(
     A: jax.Array,
     b: jax.Array,
@@ -43,6 +43,7 @@ def block_coordinate_descent_l2(
     block_size: int,
     num_iter: int = 1,
     mask: Optional[jax.Array] = None,
+    cache_grams: bool = True,
 ) -> jax.Array:
     """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
 
@@ -69,13 +70,29 @@ def block_coordinate_descent_l2(
     W0 = jnp.zeros((d_pad, c), A.dtype)
     eye = jnp.eye(block_size, dtype=A.dtype)
 
+    # Multi-pass solves reuse the per-block grams: XᵀX never changes across
+    # passes, only the residual does — the reference computes grams on pass 0
+    # and caches them (``BlockWeightedLeastSquares.scala:214-221``). Costs
+    # num_blocks·b² HBM (cache_grams=False opts out for memory-tight huge-d
+    # solves); the single-pass (common) case keeps zero extra state.
+    use_cache = num_iter > 1 and cache_grams
+    if use_cache:
+        def gram_k(_, k):
+            Ak = jax.lax.dynamic_slice(A, (0, k * block_size), (n, block_size))
+            return None, hdot(Ak.T, Ak)
+
+        _, grams = jax.lax.scan(gram_k, None, jnp.arange(num_blocks))
+
     def block_step(carry, k):
         W, R = carry
         start = k * block_size
         Ak = jax.lax.dynamic_slice(A, (0, start), (n, block_size))
         Wk = jax.lax.dynamic_slice(W, (start, 0), (block_size, c))
         regk = jax.lax.dynamic_slice(col_pad_reg, (start,), (block_size,))
-        gram = hdot(Ak.T, Ak)  # sharded matmul -> ICI all-reduce
+        if use_cache:
+            gram = grams[k]
+        else:
+            gram = hdot(Ak.T, Ak)  # sharded matmul -> ICI all-reduce
         rhs = hdot(Ak.T, R) + hdot(gram, Wk)  # A_kᵀ(R + A_k W_k)
         Wk_new = jnp.linalg.solve(gram + lam * eye + jnp.diag(regk), rhs)
         R = R - hdot(Ak, Wk_new - Wk)

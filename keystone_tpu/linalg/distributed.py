@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import flax.struct as struct
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
 from keystone_tpu.linalg.solvers import hdot, normal_equations_solve, tsqr_r, tsqr_solve
@@ -147,11 +147,15 @@ class RowShardedMatrix(struct.PyTreeNode):
 
 
 def _solver_args(A, b) -> tuple[jax.Array, jax.Array, Optional[jax.Array]]:
-    """Align (A, b) for the solvers: a raw ``b`` with A's *valid* row count is
-    zero-padded and co-sharded to match A's padded rows, so KeystoneML-style
-    call sites (sharded features, host labels) map 1:1."""
+    """Align (A, b) for the solvers: a raw ``b`` with exactly A's *valid* row
+    count is zero-padded and co-sharded to match A's padded rows, so
+    KeystoneML-style call sites (sharded features, host labels) map 1:1. Any
+    other row-count mismatch is an error — padded rows carry mask=0, so a
+    silently mis-sized ``b`` would bias the solve, not crash it."""
     mask = None
+    valid_rows = None
     if isinstance(A, RowShardedMatrix):
+        valid_rows = A.valid_rows
         A, mask = A.data, A.mask
     else:
         A = jnp.asarray(A, jnp.float32)
@@ -160,11 +164,17 @@ def _solver_args(A, b) -> tuple[jax.Array, jax.Array, Optional[jax.Array]]:
     else:
         b = jnp.asarray(b, jnp.float32)
         if b.shape[0] != A.shape[0]:
-            if b.shape[0] > A.shape[0]:
+            if valid_rows is None or b.shape[0] != valid_rows:
                 raise ValueError(
-                    f"b has {b.shape[0]} rows but A has only {A.shape[0]}"
+                    f"b has {b.shape[0]} rows but A has {A.shape[0]} padded"
+                    + (f" / {valid_rows} valid" if valid_rows is not None else "")
+                    + " rows"
                 )
             b = jnp.pad(b, ((0, A.shape[0] - b.shape[0]),) + ((0, 0),) * (b.ndim - 1))
+    sh = getattr(A, "sharding", None)
+    if isinstance(sh, NamedSharding) and b.ndim >= 1:
+        spec = P(*((sh.spec[0],) + (None,) * (b.ndim - 1)))
+        b = jax.device_put(b, NamedSharding(sh.mesh, spec))
     return A, b, mask
 
 

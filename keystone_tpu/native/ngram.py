@@ -110,7 +110,9 @@ def count_by_key(
         return np.zeros((0,), np.int64), np.zeros((0,), np.float64)
 
     lib = _get_lib()
-    if lib is None:
+    # INT64_MIN is the native map's empty-slot sentinel; route it to the
+    # numpy path rather than silently dropping that key.
+    if lib is None or keys.min() == np.iinfo(np.int64).min:
         return _count_by_key_np(keys, weights)
     if num_threads <= 0:
         num_threads = min(16, os.cpu_count() or 1)
