@@ -83,12 +83,35 @@ class MulticlassMetrics:
         )
 
 
+@jax.jit
+def _error_fraction(preds, actuals, mask):
+    wrong = (preds != actuals).astype(jnp.float32)
+    if mask is None:
+        return jnp.mean(wrong)
+    return jnp.sum(wrong * mask) / jnp.sum(mask)
+
+
 class MulticlassClassifierEvaluator:
     """Reference: ``evaluation/MulticlassClassifierEvaluator.scala:142-152``."""
 
     def __init__(self, num_classes: int, class_names=None):
         self.num_classes = num_classes
         self.class_names = class_names
+
+    def error(self, predictions, actuals, mask: Optional[jax.Array] = None) -> jax.Array:
+        """Classification-error fraction as a DEVICE scalar — no host transfer.
+
+        ``evaluate`` pulls the full confusion matrix to the host (one
+        device→host round-trip per call); streaming paths that only need the
+        running error (``BlockLinearMapper.applyAndEvaluate``'s evaluator
+        callback, ``BlockLinearMapper.scala:104-137``) use this to keep the
+        whole evaluation on device and transfer once at the end.
+        """
+        return _error_fraction(
+            jnp.asarray(predictions).astype(jnp.int32).reshape(-1),
+            jnp.asarray(actuals).astype(jnp.int32).reshape(-1),
+            mask,
+        )
 
     def evaluate(self, predictions, actuals, mask: Optional[jax.Array] = None) -> MulticlassMetrics:
         cm = _confusion(
