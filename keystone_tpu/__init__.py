@@ -24,6 +24,7 @@ from keystone_tpu.core.pipeline import (
     LabelEstimator,
     FunctionNode,
     Chain,
+    ChunkedMap,
     Cacher,
     Identity,
     chain,

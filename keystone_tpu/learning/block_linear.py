@@ -93,7 +93,7 @@ def _streaming_block_step_first(feat_node, raw, R, lam, mask):
     unregularized gram XᵀX so later passes can skip the 2·n·b² gram gemm
     (the reference likewise computes XᵀX only on pass 0 and reuses it,
     ``BlockWeightedLeastSquares.scala:214-221``)."""
-    from keystone_tpu.linalg.solvers import hdot
+    from keystone_tpu.linalg.solvers import hdot, spd_solve
 
     feats = feat_node.apply_batch(raw)
     if mask is None:
@@ -104,14 +104,14 @@ def _streaming_block_step_first(feat_node, raw, R, lam, mask):
         feats = (feats - fmean) * mask[:, None]
     gram = hdot(feats.T, feats)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
-    Wk = jnp.linalg.solve(gram + lam * eye, hdot(feats.T, R))
+    Wk = spd_solve(gram + lam * eye, hdot(feats.T, R))
     R = R - hdot(feats, Wk)
     return fmean, Wk, R, gram
 
 
 @jax.jit
 def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean):
-    from keystone_tpu.linalg.solvers import hdot
+    from keystone_tpu.linalg.solvers import hdot, spd_solve
 
     feats = feat_node.apply_batch(raw) - fmean
     if mask is not None:
@@ -119,7 +119,7 @@ def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean):
     gram = hdot(feats.T, feats)
     rhs = hdot(feats.T, R) + hdot(gram, Wk)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
-    Wk_new = jnp.linalg.solve(gram + lam * eye, rhs)
+    Wk_new = spd_solve(gram + lam * eye, rhs)
     R = R - hdot(feats, Wk_new - Wk)
     return Wk_new, R
 
@@ -129,14 +129,14 @@ def _streaming_block_step_cached(feat_node, raw, R, Wk, lam, mask, fmean, gram):
     """Later-pass block step with the pass-0 gram: only the n×b×c cross terms
     and the b³-class solve remain — ~4× cheaper than re-doing the 2·n·b² gram
     when b ≫ c."""
-    from keystone_tpu.linalg.solvers import hdot
+    from keystone_tpu.linalg.solvers import hdot, spd_solve
 
     feats = feat_node.apply_batch(raw) - fmean
     if mask is not None:
         feats = feats * mask[:, None]
     rhs = hdot(feats.T, R) + hdot(gram, Wk)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
-    Wk_new = jnp.linalg.solve(gram + lam * eye, rhs)
+    Wk_new = spd_solve(gram + lam * eye, rhs)
     R = R - hdot(feats, Wk_new - Wk)
     return Wk_new, R
 

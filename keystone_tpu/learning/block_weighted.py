@@ -33,7 +33,7 @@ import numpy as np
 from keystone_tpu.core.dataset import Dataset
 from keystone_tpu.core.pipeline import LabelEstimator
 from keystone_tpu.learning.block_linear import BlockLinearMapper
-from keystone_tpu.linalg.solvers import hdot
+from keystone_tpu.linalg.solvers import hdot, spd_solve
 
 
 @functools.partial(jax.jit, static_argnames=("num_classes",))
@@ -112,7 +112,7 @@ def _class_solves(
             - joint_means_b[c] * mean_mix
         )
         rhs = joint_xtr - lam * jnp.take(model_b, c, axis=1)
-        dW_c = jnp.linalg.solve(joint_xtx + lam * eye, rhs)
+        dW_c = spd_solve(joint_xtx + lam * eye, rhs)
         return carry, dW_c
 
     _, dW = jax.lax.scan(body, None, jnp.arange(num_classes))

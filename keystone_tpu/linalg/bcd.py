@@ -32,7 +32,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from keystone_tpu.linalg.solvers import hdot
+from keystone_tpu.linalg.solvers import hdot, spd_solve
 
 
 @functools.partial(jax.jit, static_argnames=("block_size", "num_iter", "cache_grams"))
@@ -94,7 +94,7 @@ def block_coordinate_descent_l2(
         else:
             gram = hdot(Ak.T, Ak)  # sharded matmul -> ICI all-reduce
         rhs = hdot(Ak.T, R) + hdot(gram, Wk)  # A_kᵀ(R + A_k W_k)
-        Wk_new = jnp.linalg.solve(gram + lam * eye + jnp.diag(regk), rhs)
+        Wk_new = spd_solve(gram + lam * eye + jnp.diag(regk), rhs)
         R = R - hdot(Ak, Wk_new - Wk)
         W = jax.lax.dynamic_update_slice(W, Wk_new, (start, 0))
         return (W, R), None

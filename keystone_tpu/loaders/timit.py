@@ -32,6 +32,22 @@ def load_timit(
     return data, labels
 
 
+def synthetic_timit_device(n: int, seed: int = 42, prototype_seed: int = 7):
+    """On-device synthetic TIMIT frames (see :func:`synthetic_timit`): the
+    accelerator generates the data, so nothing crosses the host↔device link."""
+    import jax
+    import jax.numpy as jnp
+
+    kp = jax.random.key(prototype_seed)
+    kl, kn = jax.random.split(jax.random.key(seed))
+    protos = jax.random.normal(kp, (TIMIT_NUM_CLASSES, TIMIT_DIMENSION), jnp.float32)
+    labels = jax.random.randint(kl, (n,), 0, TIMIT_NUM_CLASSES, jnp.int32)
+    data = protos[labels] + 2.0 * jax.random.normal(
+        kn, (n, TIMIT_DIMENSION), jnp.float32
+    )
+    return data, labels
+
+
 def synthetic_timit(
     n: int, seed: int = 42, prototype_seed: int = 7
 ) -> Tuple[np.ndarray, np.ndarray]:

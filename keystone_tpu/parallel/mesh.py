@@ -53,6 +53,12 @@ def get_mesh() -> Mesh:
     return make_mesh()
 
 
+def current_mesh() -> Optional[Mesh]:
+    """Innermost ``use_mesh`` mesh, or None when no mesh context is active
+    (unlike :func:`get_mesh`, never constructs one)."""
+    return _MESH_STACK[-1] if _MESH_STACK else None
+
+
 @contextlib.contextmanager
 def use_mesh(mesh: Mesh):
     _MESH_STACK.append(mesh)

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from keystone_tpu.core.pipeline import chain
+from keystone_tpu.core.pipeline import ChunkedMap, chain
 from keystone_tpu.learning import ZCAWhitener, ZCAWhitenerEstimator
 from keystone_tpu.loaders.cifar import CIFAR_NUM_CLASSES
 from keystone_tpu.ops.images import (
@@ -41,18 +41,21 @@ def learn_patch_filters(
     windows = Windower(stride=patch_steps, window_size=patch_size)(
         jnp.asarray(imgs[:need_imgs])
     )
-    patches = np.asarray(windows).reshape(windows.shape[0], -1)
-    rng = np.random.default_rng(seed)
+    # Everything stays on device (the reference samples to the driver,
+    # RandomPatchCifar.scala:37-42; a device-side choice avoids shipping the
+    # ~100k-patch sample over the host link twice).
+    patches = windows.reshape(windows.shape[0], -1)
+    k1, k2 = jax.random.split(jax.random.key(seed))
     take = min(whitener_size, patches.shape[0])
-    patches = patches[rng.choice(patches.shape[0], take, replace=False)]
+    patches = jax.random.choice(k1, patches, (take,), replace=False, axis=0)
 
-    base = np.asarray(normalize_rows(jnp.asarray(patches), 10.0))
-    whitener = ZCAWhitenerEstimator().fit_single(jnp.asarray(base))
-    sample = base[rng.choice(base.shape[0], num_filters, replace=False)]
-    unnorm = np.asarray(whitener(jnp.asarray(sample)))
-    norms = np.sqrt((unnorm**2).sum(axis=1))
-    filters = (unnorm / (norms + 1e-10)[:, None]) @ np.asarray(whitener.whitener).T
-    return jnp.asarray(filters, jnp.float32), whitener
+    base = normalize_rows(patches, 10.0)
+    whitener = ZCAWhitenerEstimator().fit_single(base)
+    sample = jax.random.choice(k2, base, (num_filters,), replace=False, axis=0)
+    unnorm = whitener(sample)
+    norms = jnp.sqrt((unnorm**2).sum(axis=1))
+    filters = (unnorm / (norms + 1e-10)[:, None]) @ whitener.whitener.T
+    return filters.astype(jnp.float32), whitener
 
 
 def conv_featurizer(
@@ -70,26 +73,45 @@ def conv_featurizer(
     )
 
 
-def fit_and_eval(featurizer, solver_fit, train, test) -> dict:
+def _auto_chunks(n_rows: int, per_row_bytes: int, budget_bytes: int = 2 << 30) -> int:
+    """Chunk count keeping each chunk's intermediates under ``budget_bytes``
+    (conv intermediates are ~1 MB/row; a 50k batch would need ~42 GB at
+    once). ChunkedMap pads rows internally, so any count works."""
+    return max(1, min(n_rows, -(-n_rows * per_row_bytes // budget_bytes)))
+
+
+def fit_and_eval(featurizer, solver_fit, train, test,
+                 per_row_intermediate_bytes: int = 0) -> dict:
     """Featurize → fit scaler → solve → train/test error percent.
 
     The conv featurizer runs exactly once over train (scaler fit, solver, and
     train error all reuse the materialized features) and once over test.
+    ``per_row_intermediate_bytes`` > 0 enables ChunkedMap row-chunking of the
+    featurizer so conv intermediates never exceed a fixed HBM budget.
     """
+
+    def chunked(feat, n_rows):
+        if per_row_intermediate_bytes <= 0:
+            return feat
+        return ChunkedMap(
+            node=feat, num_chunks=_auto_chunks(n_rows, per_row_intermediate_bytes)
+        )
+
     train_ds, train_y, indicators = prepare_labeled(*train, CIFAR_NUM_CLASSES)
-    raw_feats = featurizer(train_ds)
+    featurizer_train = chunked(featurizer, train_ds.data.shape[0])
+    raw_feats = featurizer_train(train_ds)
     scaler = StandardScaler().fit(raw_feats)
     feats = scaler(raw_feats)
     model = solver_fit(feats.data, indicators, feats.mask)
 
-    results = {
-        "train_error": error_percent(
-            model(feats.data), train_y, train_ds.mask, CIFAR_NUM_CLASSES
-        )
-    }
-    predict = featurizer >> scaler >> model
+    train_err = error_percent(
+        model(feats.data), train_y, train_ds.mask, CIFAR_NUM_CLASSES
+    )
     test_ds, test_y, _ = prepare_labeled(*test, CIFAR_NUM_CLASSES)
-    results["test_error"] = error_percent(
+    predict = chunked(featurizer, test_ds.data.shape[0]) >> scaler >> model
+    test_err = error_percent(
         predict(test_ds).data, test_y, test_ds.mask, CIFAR_NUM_CLASSES
     )
-    return results
+    # single host sync of the whole fit+eval
+    errs = np.asarray(jnp.stack([train_err, test_err]))
+    return {"train_error": float(errs[0]), "test_error": float(errs[1])}

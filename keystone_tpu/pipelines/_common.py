@@ -21,8 +21,14 @@ def prepare_labeled(x, y, num_classes: int):
     return ds, y_sharded, indicators
 
 
-def error_percent(scores, actuals, mask, num_classes: int) -> float:
-    """argmax → masked multiclass error, in percent."""
+def error_percent(scores, actuals, mask, num_classes: int):
+    """argmax → masked multiclass error, in percent, as a DEVICE scalar.
+
+    Kept on device so pipelines can batch every stage's metric into one
+    device→host transfer at the end (each transfer is a full round-trip on a
+    tunneled runtime); callers ``float()`` / ``np.asarray`` the result(s) once.
+    """
     preds = MaxClassifier()(scores)
-    metrics = MulticlassClassifierEvaluator(num_classes)(preds, actuals, mask)
-    return 100.0 * metrics.total_error
+    return 100.0 * MulticlassClassifierEvaluator(num_classes).error(
+        preds, actuals, mask
+    )

@@ -9,11 +9,12 @@ import dataclasses
 import json
 
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.core.config import parse_config
 from keystone_tpu.core.pipeline import chain
 from keystone_tpu.learning import LinearMapEstimator
-from keystone_tpu.loaders.cifar import CIFAR_NUM_CLASSES, load_cifar_binary, synthetic_cifar
+from keystone_tpu.loaders.cifar import CIFAR_NUM_CLASSES, load_cifar_binary, synthetic_cifar_device
 from keystone_tpu.ops.images import GrayScaler, ImageVectorizer
 from keystone_tpu.pipelines._common import error_percent, prepare_labeled
 from keystone_tpu.parallel import get_mesh, use_mesh
@@ -35,8 +36,8 @@ def run(config: LinearPixelsConfig) -> dict:
         train = load_cifar_binary(config.train_location)
         test = load_cifar_binary(config.test_location)
     else:
-        train = synthetic_cifar(config.synthetic_train, seed=1)
-        test = synthetic_cifar(config.synthetic_test, seed=2)
+        train = synthetic_cifar_device(config.synthetic_train, seed=1)
+        test = synthetic_cifar_device(config.synthetic_test, seed=2)
 
     results: dict = {}
     with use_mesh(get_mesh()), Timer("LinearPixels.pipeline") as total:
@@ -46,13 +47,16 @@ def run(config: LinearPixelsConfig) -> dict:
         model = LinearMapEstimator().fit(feats.data, indicators, mask=feats.mask)
         predict = featurizer >> model
 
-        results["train_error"] = error_percent(
+        train_err = error_percent(
             predict(train_ds).data, train_y, train_ds.mask, CIFAR_NUM_CLASSES
         )
         test_ds, test_y, _ = prepare_labeled(*test, CIFAR_NUM_CLASSES)
-        results["test_error"] = error_percent(
+        test_err = error_percent(
             predict(test_ds).data, test_y, test_ds.mask, CIFAR_NUM_CLASSES
         )
+        # single host sync of the whole pipeline
+        errs = np.asarray(jnp.stack([train_err, test_err]))
+    results["train_error"], results["test_error"] = float(errs[0]), float(errs[1])
     results["wallclock_s"] = total.elapsed
     logger.info("Training error: %.2f%%  Test error: %.2f%%", results["train_error"], results["test_error"])
     return results

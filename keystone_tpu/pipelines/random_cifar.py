@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from keystone_tpu.core.config import parse_config
 from keystone_tpu.learning import LinearMapEstimator
-from keystone_tpu.loaders.cifar import load_cifar_binary, synthetic_cifar
+from keystone_tpu.loaders.cifar import load_cifar_binary, synthetic_cifar_device
 from keystone_tpu.pipelines._cifar_conv import conv_featurizer, fit_and_eval
 from keystone_tpu.parallel import get_mesh, use_mesh
 from keystone_tpu.utils import Timer, get_logger
@@ -41,8 +41,8 @@ def run(config: RandomCifarConfig) -> dict:
         train = load_cifar_binary(config.train_location)
         test = load_cifar_binary(config.test_location)
     else:
-        train = synthetic_cifar(config.synthetic_train, seed=1)
-        test = synthetic_cifar(config.synthetic_test, seed=2)
+        train = synthetic_cifar_device(config.synthetic_train, seed=1)
+        test = synthetic_cifar_device(config.synthetic_test, seed=2)
 
     with use_mesh(get_mesh()), Timer("RandomCifar.pipeline") as total:
         filters = jax.random.normal(
@@ -54,11 +54,15 @@ def run(config: RandomCifarConfig) -> dict:
             filters, None, config.alpha, config.pool_stride, config.pool_size
         )
         solver = LinearMapEstimator(lam=config.lam or None)
+        # conv + doubled-rectifier intermediates per row, f32
+        conv_hw = (32 - config.patch_size + 1) ** 2
+        per_row = 3 * config.num_filters * conv_hw * 4
         results = fit_and_eval(
             featurizer,
             lambda a, b, m: solver.fit(a, b, mask=m),
             train,
             test,
+            per_row_intermediate_bytes=per_row,
         )
     results["wallclock_s"] = total.elapsed
     logger.info(

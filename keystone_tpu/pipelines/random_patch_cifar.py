@@ -11,7 +11,7 @@ import json
 
 from keystone_tpu.core.config import parse_config
 from keystone_tpu.learning import BlockLeastSquaresEstimator
-from keystone_tpu.loaders.cifar import load_cifar_binary, synthetic_cifar
+from keystone_tpu.loaders.cifar import load_cifar_binary, synthetic_cifar_device
 from keystone_tpu.pipelines._cifar_conv import (
     conv_featurizer,
     fit_and_eval,
@@ -46,11 +46,11 @@ def run(config: RandomPatchCifarConfig) -> dict:
         train = load_cifar_binary(config.train_location)
         test = load_cifar_binary(config.test_location)
     else:
-        train = synthetic_cifar(config.synthetic_train, seed=1)
-        test = synthetic_cifar(config.synthetic_test, seed=2)
+        train = synthetic_cifar_device(config.synthetic_train, seed=1)
+        test = synthetic_cifar_device(config.synthetic_test, seed=2)
 
     with use_mesh(get_mesh()), Timer("RandomPatchCifar.pipeline") as total:
-        with Timer("learn_patch_filters"):
+        with Timer("learn_patch_filters.dispatch"):
             filters, whitener = learn_patch_filters(
                 train[0],
                 config.patch_size,
@@ -63,11 +63,15 @@ def run(config: RandomPatchCifarConfig) -> dict:
             filters, whitener, config.alpha, config.pool_stride, config.pool_size
         )
         est = BlockLeastSquaresEstimator(config.block_size, 1, config.lam)
+        # conv + doubled-rectifier intermediates per row, f32
+        conv_hw = (32 - config.patch_size + 1) ** 2
+        per_row = 3 * config.num_filters * conv_hw * 4
         results = fit_and_eval(
             featurizer,
             lambda a, b, m: est.fit(a, b, mask=m),
             train,
             test,
+            per_row_intermediate_bytes=per_row,
         )
     results["wallclock_s"] = total.elapsed
     logger.info(
