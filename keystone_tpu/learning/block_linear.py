@@ -86,8 +86,8 @@ def _block_contrib(xs, w, start, stop):
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def _streaming_block_step_first(feat_node, raw, R, lam, mask):
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _streaming_block_step_first(feat_node, raw, R, lam, mask, precision: str):
     """First pass over a block: derive the (masked) feature mean from the same
     featurization used for the solve — no separate mean pass. Returns the
     unregularized gram XᵀX so later passes can skip the 2·n·b² gram gemm
@@ -102,30 +102,30 @@ def _streaming_block_step_first(feat_node, raw, R, lam, mask):
     else:
         fmean = jnp.sum(feats * mask[:, None], axis=0) / jnp.sum(mask)
         feats = (feats - fmean) * mask[:, None]
-    gram = hdot(feats.T, feats)
+    gram = hdot(feats.T, feats, precision)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
-    Wk = spd_solve(gram + lam * eye, hdot(feats.T, R))
-    R = R - hdot(feats, Wk)
+    Wk = spd_solve(gram + lam * eye, hdot(feats.T, R, precision))
+    R = R - hdot(feats, Wk, precision)
     return fmean, Wk, R, gram
 
 
-@jax.jit
-def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean):
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean, precision: str):
     from keystone_tpu.linalg.solvers import hdot, spd_solve
 
     feats = feat_node.apply_batch(raw) - fmean
     if mask is not None:
         feats = feats * mask[:, None]
-    gram = hdot(feats.T, feats)
-    rhs = hdot(feats.T, R) + hdot(gram, Wk)
+    gram = hdot(feats.T, feats, precision)
+    rhs = hdot(feats.T, R, precision) + hdot(gram, Wk, precision)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
     Wk_new = spd_solve(gram + lam * eye, rhs)
-    R = R - hdot(feats, Wk_new - Wk)
+    R = R - hdot(feats, Wk_new - Wk, precision)
     return Wk_new, R
 
 
-@jax.jit
-def _streaming_block_step_cached(feat_node, raw, R, Wk, lam, mask, fmean, gram):
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _streaming_block_step_cached(feat_node, raw, R, Wk, lam, mask, fmean, gram, precision: str):
     """Later-pass block step with the pass-0 gram: only the n×b×c cross terms
     and the b³-class solve remain — ~4× cheaper than re-doing the 2·n·b² gram
     when b ≫ c."""
@@ -134,10 +134,10 @@ def _streaming_block_step_cached(feat_node, raw, R, Wk, lam, mask, fmean, gram):
     feats = feat_node.apply_batch(raw) - fmean
     if mask is not None:
         feats = feats * mask[:, None]
-    rhs = hdot(feats.T, R) + hdot(gram, Wk)
+    rhs = hdot(feats.T, R, precision) + hdot(gram, Wk, precision)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
     Wk_new = spd_solve(gram + lam * eye, rhs)
-    R = R - hdot(feats, Wk_new - Wk)
+    R = R - hdot(feats, Wk_new - Wk, precision)
     return Wk_new, R
 
 
@@ -204,6 +204,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         if mask is not None:
             B = B * mask[:, None]
         lam = jnp.float32(self.lam)
+        from keystone_tpu.linalg.solvers import get_solver_precision
+
+        precision = get_solver_precision()
 
         fmeans: list = [None] * len(feature_nodes)
         Ws: list = [None] * len(feature_nodes)
@@ -211,7 +214,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         R = B.astype(jnp.float32)
         for k, node in enumerate(feature_nodes):
             fmeans[k], Ws[k], R, gram = _streaming_block_step_first(
-                node, raw, R, lam, mask
+                node, raw, R, lam, mask, precision=precision
             )
             if self.cache_grams and self.num_iter > 1:
                 grams[k] = gram
@@ -219,11 +222,13 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             for k, node in enumerate(feature_nodes):
                 if grams[k] is not None:
                     Ws[k], R = _streaming_block_step_cached(
-                        node, raw, R, Ws[k], lam, mask, fmeans[k], grams[k]
+                        node, raw, R, Ws[k], lam, mask, fmeans[k], grams[k],
+                        precision=precision,
                     )
                 else:
                     Ws[k], R = _streaming_block_step(
-                        node, raw, R, Ws[k], lam, mask, fmeans[k]
+                        node, raw, R, Ws[k], lam, mask, fmeans[k],
+                        precision=precision,
                     )
         return BlockLinearMapper(
             w=jnp.concatenate(Ws, axis=0),

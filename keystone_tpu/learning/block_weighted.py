@@ -61,21 +61,21 @@ def _class_col_means(R, cls_sorted, counts):
     return per_class, jnp.sum(per_class, axis=0) / c
 
 
-@jax.jit
-def _pop_stats(Xb, R, valid, n_eff):
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _pop_stats(Xb, R, valid, n_eff, precision: str):
     """Population mean / covariance / XᵀR for one block (pass 0,
     ``:190-212``). Row-sharded matmuls -> ICI all-reduce."""
     Xv = Xb * valid[:, None]
     pop_mean = jnp.sum(Xv, axis=0) / n_eff
-    pop_cov = hdot(Xv.T, Xv) / n_eff - jnp.outer(pop_mean, pop_mean)
-    pop_xtr = hdot(Xv.T, R) / n_eff
+    pop_cov = hdot(Xv.T, Xv, precision) / n_eff - jnp.outer(pop_mean, pop_mean)
+    pop_xtr = hdot(Xv.T, R, precision) / n_eff
     return pop_mean, pop_cov, pop_xtr
 
 
-@functools.partial(jax.jit, static_argnames=("max_nc",))
+@functools.partial(jax.jit, static_argnames=("max_nc", "precision"))
 def _class_solves(
     Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
-    residual_mean, model_b, lam, w, max_nc: int
+    residual_mean, model_b, lam, w, max_nc: int, precision: str
 ):
     """One scan step per class: masked chunk moments + the joint solve
     (``BlockWeightedLeastSquares.scala:228-263``). Returns ΔW (bs, C)."""
@@ -95,9 +95,9 @@ def _class_solves(
 
         class_mean = jnp.sum(Xc * m[:, None], axis=0) / nc
         Xzm = (Xc - class_mean) * m[:, None]
-        class_cov = hdot(Xzm.T, Xzm) / nc
+        class_cov = hdot(Xzm.T, Xzm, precision) / nc
         res_local = jnp.take(Rc, c, axis=1) * m
-        class_xtr = (Xc * m[:, None]).T @ res_local / nc
+        class_xtr = hdot((Xc * m[:, None]).T, res_local, precision) / nc
 
         mean_diff = class_mean - pop_mean
         joint_xtx = (
@@ -119,9 +119,9 @@ def _class_solves(
     return dW.T  # (bs, C)
 
 
-@jax.jit
-def _apply_update(R, Xb, dW, valid):
-    return R - hdot(Xb * valid[:, None], dW)
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _apply_update(R, Xb, dW, valid, precision: str):
+    return R - hdot(Xb * valid[:, None], dW, precision)
 
 
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
@@ -146,6 +146,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         num_classes = labels.shape[1]
         w = jnp.float32(self.mixture_weight)
         lam = jnp.float32(self.lam)
+        from keystone_tpu.linalg.solvers import get_solver_precision
+
+        precision = get_solver_precision()
 
         order, cls_sorted, counts, offsets, valid = _prepare(labels, mask, num_classes)
         Xs = data[order]
@@ -179,7 +182,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     Xs, b * self.block_size, self.block_size, 1
                 )
                 if block_stats[b] is None:
-                    pop_mean, pop_cov, pop_xtr = _pop_stats(Xb, R, valid, n_eff)
+                    pop_mean, pop_cov, pop_xtr = _pop_stats(
+                        Xb, R, valid, n_eff, precision=precision
+                    )
                     # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)
                     class_sums = jax.ops.segment_sum(
                         Xb * valid[:, None], cls_sorted, num_segments=num_classes + 1
@@ -191,14 +196,15 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     block_stats[b] = (pop_mean, pop_cov, joint_means_b)
                 else:
                     pop_mean, pop_cov, joint_means_b = block_stats[b]
-                    pop_xtr = hdot((Xb * valid[:, None]).T, R) / n_eff
+                    pop_xtr = hdot((Xb * valid[:, None]).T, R, precision) / n_eff
 
                 dW = _class_solves(
                     Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr,
                     joint_means_b, residual_mean, models[b], lam, w, max_nc,
+                    precision=precision,
                 )
                 models[b] = models[b] + dW
-                R = _apply_update(R, Xb, dW, valid)
+                R = _apply_update(R, Xb, dW, valid, precision=precision)
                 _, residual_mean = _class_col_means(R, cls_sorted, counts)
 
         W = jnp.concatenate(models, axis=0)[:d]

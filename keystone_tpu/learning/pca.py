@@ -71,8 +71,8 @@ def _pca_svd(x, mask, dims: int):
     return _matlab_sign_convention(vt.T)[:, :dims]
 
 
-@functools.partial(jax.jit, static_argnames=("dims",))
-def _pca_gram(x, mask, dims: int):
+@functools.partial(jax.jit, static_argnames=("dims", "precision"))
+def _pca_gram(x, mask, dims: int, precision: str = "highest"):
     if mask is not None:
         n = jnp.sum(mask)
         mean = jnp.sum(x * mask[:, None], axis=0) / n
@@ -80,7 +80,7 @@ def _pca_gram(x, mask, dims: int):
     else:
         mean = jnp.mean(x, axis=0)
         centered = x - mean
-    cov = hdot(centered.T, centered)  # sharded rows -> ICI all-reduce
+    cov = hdot(centered.T, centered, precision)  # sharded rows -> ICI all-reduce
     _, v = jnp.linalg.eigh(cov)  # ascending eigenvalues
     v = v[:, ::-1]
     return _matlab_sign_convention(v)[:, :dims]
@@ -102,7 +102,9 @@ class PCAEstimator(Estimator):
         if method == "svd":
             return _pca_svd(x, mask, self.dims)
         if method == "gram":
-            return _pca_gram(x, mask, self.dims)
+            from keystone_tpu.linalg.solvers import get_solver_precision
+
+            return _pca_gram(x, mask, self.dims, get_solver_precision())
         raise ValueError(f"unknown method {self.method!r}")
 
     def fit(self, data, mask=None) -> PCATransformer:

@@ -1,6 +1,9 @@
 from keystone_tpu.linalg.solvers import (
+    get_solver_precision,
     hdot,
     normal_equations_solve,
+    set_solver_precision,
+    spd_solve,
     tsqr_r,
     tsqr_solve,
 )

@@ -32,10 +32,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from keystone_tpu.linalg.solvers import hdot, spd_solve
+from keystone_tpu.linalg.solvers import get_solver_precision, hdot, spd_solve
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "num_iter", "cache_grams"))
 def block_coordinate_descent_l2(
     A: jax.Array,
     b: jax.Array,
@@ -44,6 +43,29 @@ def block_coordinate_descent_l2(
     num_iter: int = 1,
     mask: Optional[jax.Array] = None,
     cache_grams: bool = True,
+    precision: Optional[str] = None,
+) -> jax.Array:
+    """Public entry: resolves the solver precision once (a static jit arg,
+    so changing the global never serves a stale compile) and dispatches."""
+    return _bcd_l2(
+        A, b, lam, block_size, num_iter, mask, cache_grams,
+        precision or get_solver_precision(),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "num_iter", "cache_grams", "precision"),
+)
+def _bcd_l2(
+    A: jax.Array,
+    b: jax.Array,
+    lam: float,
+    block_size: int,
+    num_iter: int = 1,
+    mask: Optional[jax.Array] = None,
+    cache_grams: bool = True,
+    precision: str = "high",
 ) -> jax.Array:
     """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
 
@@ -79,7 +101,7 @@ def block_coordinate_descent_l2(
     if use_cache:
         def gram_k(_, k):
             Ak = jax.lax.dynamic_slice(A, (0, k * block_size), (n, block_size))
-            return None, hdot(Ak.T, Ak)
+            return None, hdot(Ak.T, Ak, precision)
 
         _, grams = jax.lax.scan(gram_k, None, jnp.arange(num_blocks))
 
@@ -92,10 +114,10 @@ def block_coordinate_descent_l2(
         if use_cache:
             gram = grams[k]
         else:
-            gram = hdot(Ak.T, Ak)  # sharded matmul -> ICI all-reduce
-        rhs = hdot(Ak.T, R) + hdot(gram, Wk)  # A_kᵀ(R + A_k W_k)
+            gram = hdot(Ak.T, Ak, precision)  # sharded matmul -> ICI all-reduce
+        rhs = hdot(Ak.T, R, precision) + hdot(gram, Wk, precision)  # A_kᵀ(R + A_k W_k)
         Wk_new = spd_solve(gram + lam * eye + jnp.diag(regk), rhs)
-        R = R - hdot(Ak, Wk_new - Wk)
+        R = R - hdot(Ak, Wk_new - Wk, precision)
         W = jax.lax.dynamic_update_slice(W, Wk_new, (start, 0))
         return (W, R), None
 

@@ -36,7 +36,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from keystone_tpu.linalg.solvers import hdot
+from keystone_tpu.linalg.solvers import hdot as _hdot
+
+
+def hdot(a, b):
+    # Attention/gram matmuls here keep 6-pass f32 accuracy regardless of the
+    # solver-precision knob (which is scoped to least-squares solvers).
+    return _hdot(a, b, "highest")
 
 
 def _ring_perm(axis_name: str):
