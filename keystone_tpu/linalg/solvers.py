@@ -11,14 +11,17 @@ replicated local solve. No explicit collectives needed except in TSQR, where
 Numerics: TPUs have no fast float64, so solver matmuls run float32 with an
 MXU multi-pass precision knob (the stand-in for the reference's Float→Double
 widening before solves). Default ``"high"`` = bf16x3 (3 MXU passes,
-~4e-6 max relative gram error vs the 6-pass ``"highest"``, 2× its
-throughput — measured 64 vs 31 TF/chip on v5e at the 60k×2048 flagship
-shape). ``set_solver_precision("highest")`` restores the 6-pass mode;
-``"default"`` is single-pass bf16 (~172 TF/chip, ~1e-4 error). The setting
-is resolved per solver call and threaded through jit as a static argument,
-so switching it never serves stale compiled programs. Scope: least-squares
-solvers (normal equations, BCD, TSQR, weighted BCD), ``RowShardedMatrix``
-gram/cross reductions, and the PCA covariance; attention matmuls
+~4e-6 max relative gram error vs the 6-pass ``"highest"``; on v5e at the
+60k×2048 flagship shape the bare gram microbenchmarks at 64 vs 31 TF/chip
+and the end-to-end BCD solve at ~53 vs ~26 TF/chip — BASELINE.md records
+the end-to-end numbers). ``set_solver_precision("highest")`` restores the
+6-pass mode; ``"default"`` is single-pass bf16 (~172 TF/chip gram, ~1e-4
+error). The setting is resolved per jitted-solver call and threaded through
+jit as a static argument, so for the solvers (normal equations, BCD, TSQR,
+weighted BCD) and the PCA covariance, switching it never serves stale
+compiled programs. ``RowShardedMatrix`` reductions read the knob eagerly at
+call time — correct when called directly, but wrapping those methods in
+your own ``jax.jit`` bakes in the then-current setting. Attention matmuls
 (``parallel/ring.py``) always run at ``"highest"`` regardless of the knob.
 """
 

@@ -67,6 +67,44 @@ def load_voc(
     return np.stack(imgs_list), labels
 
 
+def synthetic_voc_device(
+    n: int,
+    num_classes: int = VOC_NUM_CLASSES,
+    hw: Tuple[int, int] = (96, 96),
+    max_labels: int = 2,
+    seed: int = 42,
+    prototype_seed: int = 13,
+    noise: float = 0.05,
+):
+    """On-device multi-label synthetic VOC (see :func:`synthetic_voc`):
+    accelerator-generated, nothing crosses the host↔device link. Each image
+    superposes 1..max_labels class prototypes; labels are a (n, max_labels)
+    int array padded with -1."""
+    import jax
+    import jax.numpy as jnp
+
+    h, w = hw
+    kp = jax.random.key(prototype_seed)
+    kk, kc, kn = jax.random.split(jax.random.key(seed), 3)
+    coarse = jax.random.uniform(
+        kp, (num_classes, h // 8, w // 8, 3), jnp.float32, -0.4, 0.4
+    )
+    protos = jnp.repeat(jnp.repeat(coarse, 8, axis=1), 8, axis=2)
+    # per image: k ~ U{1..max_labels} distinct classes, chosen by ranking
+    # per-class random scores (device-friendly sampling without replacement)
+    k = jax.random.randint(kk, (n,), 1, max_labels + 1)
+    scores = jax.random.uniform(kc, (n, num_classes))
+    chosen = jnp.argsort(-scores, axis=1)[:, :max_labels]  # (n, max_labels)
+    valid = jnp.arange(max_labels)[None, :] < k[:, None]
+    labels = jnp.where(valid, jnp.sort(jnp.where(valid, chosen, num_classes), axis=1), -1)
+    onehot = jnp.zeros((n, num_classes)).at[
+        jnp.arange(n)[:, None], jnp.where(valid, chosen, 0)
+    ].add(valid.astype(jnp.float32))
+    imgs = 0.5 + jnp.einsum("nc,chwd->nhwd", onehot, protos)
+    imgs = imgs + noise * jax.random.normal(kn, (n, h, w, 3), jnp.float32)
+    return jnp.clip(imgs, 0.0, 1.0), labels
+
+
 def synthetic_voc(
     n: int,
     num_classes: int = VOC_NUM_CLASSES,

@@ -141,7 +141,12 @@ class ColumnSampler(FunctionNode):
     seed: int = struct.field(pytree_node=False, default=42)
 
     def apply_batch(self, descs):
-        flat = np.asarray(descs).reshape(-1, descs.shape[-1])
+        if isinstance(descs, jax.Array):
+            # Stay on device: pulling a (n·n_desc, d) descriptor tensor to the
+            # host just to subsample costs minutes over a tunneled link.
+            flat = descs.reshape(-1, descs.shape[-1])
+        else:
+            flat = np.asarray(descs).reshape(-1, descs.shape[-1])
         return jnp.asarray(
             Sampler(size=self.num_samples, seed=self.seed).apply_batch(flat)
         )
@@ -161,5 +166,11 @@ class Sampler(FunctionNode):
     def apply_batch(self, xs):
         n = xs.shape[0]
         take = min(self.size, n)
+        if isinstance(xs, jax.Array):
+            # Device-side sample — no host round-trip for device-resident data.
+            idx = jax.random.choice(
+                jax.random.key(self.seed), n, (take,), replace=False
+            )
+            return jnp.take(xs, jnp.sort(idx), axis=0)
         idx = np.random.default_rng(self.seed).choice(n, size=take, replace=False)
         return xs[np.sort(idx)]

@@ -18,7 +18,7 @@ from keystone_tpu.learning.block_weighted import BlockWeightedLeastSquaresEstima
 from keystone_tpu.loaders.imagenet import (
     IMAGENET_NUM_CLASSES,
     load_imagenet,
-    synthetic_imagenet,
+    synthetic_imagenet_device,
 )
 from keystone_tpu.ops.images import GrayScaler, LCSExtractor, SIFTExtractor
 from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels, TopKClassifier
@@ -51,8 +51,8 @@ class ImageNetSiftLcsFVConfig:
     lcs_patch: int = 6
     seed: int = 42
     # synthetic fallback
-    synthetic_train: int = 96
-    synthetic_test: int = 48
+    synthetic_train: int = 512
+    synthetic_test: int = 128
     synthetic_classes: int = 8
     synthetic_hw: int = 96
 
@@ -65,10 +65,10 @@ def run(config: ImageNetSiftLcsFVConfig) -> dict:
         num_classes = IMAGENET_NUM_CLASSES
     else:
         hw = (config.synthetic_hw, config.synthetic_hw)
-        train = synthetic_imagenet(
+        train = synthetic_imagenet_device(
             config.synthetic_train, config.synthetic_classes, hw, seed=1
         )
-        test = synthetic_imagenet(
+        test = synthetic_imagenet_device(
             config.synthetic_test, config.synthetic_classes, hw, seed=2
         )
         num_classes = config.synthetic_classes

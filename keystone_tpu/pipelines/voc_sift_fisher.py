@@ -16,7 +16,7 @@ from keystone_tpu.core.config import parse_config
 from keystone_tpu.core.pipeline import chain
 from keystone_tpu.evaluation import MeanAveragePrecisionEvaluator
 from keystone_tpu.learning import BlockLeastSquaresEstimator
-from keystone_tpu.loaders.voc import VOC_NUM_CLASSES, load_voc, synthetic_voc
+from keystone_tpu.loaders.voc import VOC_NUM_CLASSES, load_voc, synthetic_voc_device
 from keystone_tpu.ops.images import GrayScaler, SIFTExtractor
 from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntArrayLabels
 from keystone_tpu.pipelines._fisher import fit_fisher_branch
@@ -46,8 +46,8 @@ class VOCSIFTFisherConfig:
     gmm_wts_file: str = ""
     seed: int = 42
     # synthetic fallback (zero-egress environments)
-    synthetic_train: int = 80
-    synthetic_test: int = 40
+    synthetic_train: int = 256
+    synthetic_test: int = 128
     synthetic_classes: int = 8
     synthetic_hw: int = 96
 
@@ -59,11 +59,11 @@ def run(config: VOCSIFTFisherConfig) -> dict:
         test = load_voc(config.test_location, config.test_labels, hw)
         num_classes = VOC_NUM_CLASSES
     else:
-        train = synthetic_voc(
+        train = synthetic_voc_device(
             config.synthetic_train, config.synthetic_classes,
             (config.synthetic_hw, config.synthetic_hw), seed=1,
         )
-        test = synthetic_voc(
+        test = synthetic_voc_device(
             config.synthetic_test, config.synthetic_classes,
             (config.synthetic_hw, config.synthetic_hw), seed=2,
         )
