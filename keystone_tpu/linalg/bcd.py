@@ -47,12 +47,10 @@ def block_coordinate_descent_l2(
 ) -> jax.Array:
     """Public entry: resolves the solver precision once (a static jit arg,
     so changing the global never serves a stale compile) and dispatches."""
-    from keystone_tpu.linalg.solvers import _PRECISIONS
+    from keystone_tpu.linalg.solvers import validate_precision
 
-    if precision is not None and precision not in _PRECISIONS:
-        raise ValueError(
-            f"precision must be one of {sorted(_PRECISIONS)}: {precision}"
-        )
+    if precision is not None:
+        validate_precision(precision)
     return _bcd_l2(
         A, b, lam, block_size, num_iter, mask, cache_grams,
         precision or get_solver_precision(),

@@ -42,14 +42,20 @@ _PRECISIONS = {
 _solver_precision = "high"
 
 
+def validate_precision(name: str) -> str:
+    """Validate a precision name; returns it (the shared contract for the
+    global setter and per-call ``precision=`` arguments)."""
+    if name not in _PRECISIONS:
+        raise ValueError(f"precision must be one of {sorted(_PRECISIONS)}: {name}")
+    return name
+
+
 def set_solver_precision(name: str) -> None:
     """Set the MXU precision for all solver gram/cross-term matmuls:
     ``"default"`` (1-pass bf16) | ``"high"`` (bf16x3) | ``"highest"``
     (6-pass, ≈ f32)."""
     global _solver_precision
-    if name not in _PRECISIONS:
-        raise ValueError(f"precision must be one of {sorted(_PRECISIONS)}: {name}")
-    _solver_precision = name
+    _solver_precision = validate_precision(name)
 
 
 def get_solver_precision() -> str:

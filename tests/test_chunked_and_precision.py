@@ -1,0 +1,178 @@
+"""Tests for ChunkedMap row-chunked execution, the solver MXU precision
+knob, and the on-device synthetic generators / samplers.
+
+These are the memory- and link-bandwidth features of the data plane: the
+reference got partition streaming and driver-side sampling from Spark for
+free (SURVEY.md §2.12-2.13); here they are explicit nodes and their
+semantics (equivalence with unchunked execution, determinism, masking)
+must hold exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.core.pipeline import Chain, ChunkedMap, Transformer, chain
+from keystone_tpu.linalg import (
+    block_coordinate_descent_l2,
+    get_solver_precision,
+    set_solver_precision,
+)
+from keystone_tpu.ops.stats import ColumnSampler, Sampler
+from keystone_tpu.parallel import distribute, make_mesh, use_mesh
+
+
+class _Square(Transformer):
+    def apply(self, x):
+        return x * x
+
+
+class _RowSum(Transformer):
+    def apply(self, x):
+        return jnp.sum(x, keepdims=True)
+
+    def apply_batch(self, xs):
+        return jnp.sum(xs, axis=1, keepdims=True)
+
+
+def test_chunked_map_equals_unchunked():
+    xs = jnp.arange(48.0).reshape(12, 4)
+    node = chain(_Square(), _RowSum())
+    expected = node(xs)
+    for c in (1, 2, 3, 4, 6, 12):
+        out = ChunkedMap(node=node, num_chunks=c)(xs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
+
+
+def test_chunked_map_non_divisible_rows():
+    xs = jnp.arange(47.0)[:, None]
+    out = ChunkedMap(node=_Square(), num_chunks=5)(xs)
+    assert out.shape == (47, 1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xs) ** 2)
+
+
+def test_chunked_map_more_chunks_than_rows():
+    xs = jnp.arange(3.0)[:, None]
+    out = ChunkedMap(node=_Square(), num_chunks=8)(xs)
+    assert out.shape == (3, 1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xs) ** 2)
+
+
+def test_chunked_map_serve_path():
+    one = ChunkedMap(node=_Square(), num_chunks=4).serve(jnp.float32(3.0))
+    assert float(one) == 9.0
+
+
+def test_chunked_map_keeps_row_sharding(devices):
+    xs = np.arange(64.0, dtype=np.float32).reshape(16, 4)
+    with use_mesh(make_mesh()):
+        ds = distribute(xs)
+        out = ChunkedMap(node=_Square(), num_chunks=4)(ds)
+        assert out.data.sharding.spec[0] == "data"  # rows stay sharded
+        np.testing.assert_allclose(np.asarray(out.data), xs * xs, rtol=1e-6)
+
+
+def test_chunked_map_preserved_under_chain_composition():
+    node = ChunkedMap(node=_Square(), num_chunks=2) >> _RowSum()
+    assert isinstance(node, Chain)
+    xs = jnp.ones((6, 3))
+    np.testing.assert_allclose(np.asarray(node(xs)), 3.0 * np.ones((6, 1)))
+
+
+# -- solver precision knob --------------------------------------------------
+
+
+def test_precision_knob_roundtrip():
+    assert get_solver_precision() == "high"  # documented default
+    try:
+        for p in ("default", "highest", "high"):
+            set_solver_precision(p)
+            assert get_solver_precision() == p
+    finally:
+        set_solver_precision("high")
+
+
+def test_precision_knob_rejects_unknown():
+    with pytest.raises(ValueError, match="precision"):
+        set_solver_precision("bf16")
+
+
+def test_bcd_precision_arg_validated():
+    A = jnp.ones((16, 4))
+    b = jnp.ones((16, 2))
+    with pytest.raises(ValueError, match="precision"):
+        block_coordinate_descent_l2(A, b, 1.0, 4, precision="hi")
+
+
+def test_bcd_same_result_across_precisions_on_cpu():
+    # On CPU all precision levels are true f32, so results must agree
+    # exactly; this pins the static-arg threading (each precision value is a
+    # separate compile, same math).
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.normal(size=(64, 12)).astype(np.float32))
+    Wt = rng.normal(size=(12, 3)).astype(np.float32)
+    b = A @ jnp.asarray(Wt)
+    sols = [
+        np.asarray(
+            block_coordinate_descent_l2(A, b, 1e-8, 4, num_iter=6, precision=p)
+        )
+        for p in ("default", "high", "highest")
+    ]
+    np.testing.assert_allclose(sols[0], sols[1], atol=1e-6)
+    np.testing.assert_allclose(sols[1], sols[2], atol=1e-6)
+    np.testing.assert_allclose(sols[2], Wt, atol=5e-3)
+
+
+# -- device samplers / generators -------------------------------------------
+
+
+def test_sampler_device_path_deterministic_no_replacement():
+    xs = jnp.arange(500.0)[:, None] * jnp.ones((1, 2))
+    a = Sampler(size=64, seed=9).apply_batch(xs)
+    b = Sampler(size=64, seed=9).apply_batch(xs)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert len(np.unique(np.asarray(a)[:, 0])) == 64
+
+
+def test_sampler_caps_at_population():
+    xs = jnp.arange(10.0)[:, None]
+    out = Sampler(size=100, seed=1).apply_batch(xs)
+    assert out.shape == (10, 1)
+
+
+def test_column_sampler_device_shape():
+    descs = jax.random.normal(jax.random.key(0), (6, 40, 8))
+    out = ColumnSampler(100, seed=2).apply_batch(descs)
+    assert out.shape == (100, 8)
+
+
+def test_synthetic_device_generators_match_host_structure():
+    from keystone_tpu.loaders.cifar import synthetic_cifar_device
+    from keystone_tpu.loaders.imagenet import synthetic_imagenet_device
+    from keystone_tpu.loaders.timit import TIMIT_DIMENSION, synthetic_timit_device
+    from keystone_tpu.loaders.voc import synthetic_voc_device
+
+    imgs, y = synthetic_cifar_device(20, seed=1)
+    assert imgs.shape == (20, 32, 32, 3) and float(imgs.min()) >= 0.0
+    assert float(imgs.max()) <= 255.0 and int(np.asarray(y).max()) < 10
+
+    x, y = synthetic_timit_device(30, seed=2)
+    assert x.shape == (30, TIMIT_DIMENSION) and int(np.asarray(y).max()) < 147
+
+    imgs, y = synthetic_imagenet_device(10, 4, (32, 32))
+    assert imgs.shape == (10, 32, 32, 3) and int(np.asarray(y).max()) < 4
+
+    imgs, labels = synthetic_voc_device(25, 20, (32, 32), max_labels=3, seed=3)
+    labels = np.asarray(labels)
+    assert imgs.shape == (25, 32, 32, 3) and labels.shape == (25, 3)
+    counts = (labels >= 0).sum(axis=1)
+    assert counts.min() >= 1 and counts.max() <= 3
+    for row in labels:
+        v = row[row >= 0]
+        assert sorted(set(v.tolist())) == sorted(v.tolist())  # distinct, sorted
+
+    # train/test splits with different seeds share class structure
+    a, _ = synthetic_cifar_device(4, seed=1)
+    b, _ = synthetic_cifar_device(4, seed=2)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
