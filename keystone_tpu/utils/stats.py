@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,6 +36,19 @@ def get_err_percent(predicted, actual, mask=None) -> float:
         m = np.asarray(mask, dtype=bool)
         return float(100.0 * (1.0 - hit[m].mean()))
     return float(100.0 * (1.0 - hit.mean()))
+
+
+def shuffle_array(x, seed: int = 42):
+    """Deterministic row shuffle (reference ``MatrixUtils.shuffleArray``,
+    ``utils/MatrixUtils.scala:73`` — seed 42). Device arrays shuffle on
+    device; host arrays via numpy."""
+    import numpy as np
+
+    if isinstance(x, jax.Array):
+        perm = jax.random.permutation(jax.random.key(seed), x.shape[0])
+        return jnp.take(x, perm, axis=0)
+    idx = np.random.default_rng(seed).permutation(len(x))
+    return np.asarray(x)[idx]
 
 
 def normalize_rows(mat: jnp.ndarray, alpha: float = 1.0) -> jnp.ndarray:
