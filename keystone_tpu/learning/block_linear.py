@@ -245,13 +245,36 @@ def streaming_apply_and_evaluate(
     evaluator: Callable[[jax.Array], None],
 ) -> None:
     """Out-of-core analog of :meth:`BlockLinearMapper.apply_and_evaluate`:
-    featurize block k, add its contribution, hand the running prediction to
-    ``evaluator`` (``BlockLinearMapper.scala:104-137``)."""
+    featurize block k from ``raw`` (any pytree the nodes understand — see
+    ``BlockWeightedLeastSquaresEstimator.fit_streaming``), add its
+    contribution, hand the running prediction to ``evaluator``
+    (``BlockLinearMapper.scala:104-137``). ``feature_means=None`` models
+    (the weighted solver's) skip centering."""
     bs = model.block_size
     partial = None
     for k, node in enumerate(feature_nodes):
         wk = model.w[k * bs : (k + 1) * bs]
-        fm = model.feature_means[k * bs : (k + 1) * bs]
-        contrib = _streaming_contrib(node, raw, wk, fm)
+        if model.feature_means is None:
+            contrib = node.apply_batch(raw) @ wk
+        else:
+            fm = model.feature_means[k * bs : (k + 1) * bs]
+            contrib = _streaming_contrib(node, raw, wk, fm)
         partial = contrib if partial is None else partial + contrib
         evaluator(partial + model.b if model.b is not None else partial)
+
+
+def streaming_predict(
+    model: BlockLinearMapper,
+    feature_nodes: Sequence[Transformer],
+    raw,
+) -> jax.Array:
+    """Final predictions via :func:`streaming_apply_and_evaluate` (one shared
+    accumulation loop) — the out-of-core apply path for models whose feature
+    matrix exceeds HBM (``BlockLinearMapper.scala:47-74``)."""
+    out: list = []
+
+    def capture(p):
+        out[:] = [p]
+
+    streaming_apply_and_evaluate(model, feature_nodes, raw, capture)
+    return out[0]

@@ -174,33 +174,52 @@ def _apply_update(R, Xb, dW, valid, precision: str):
 
 
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
-    """Reference: ``BlockWeightedLeastSquares.scala:35-90``."""
+    """Reference: ``BlockWeightedLeastSquares.scala:35-90``.
 
-    def __init__(self, block_size: int, num_iter: int, lam: float, mixture_weight: float):
+    Two fit paths share one block-coordinate loop:
+
+    - :meth:`fit` materializes the (class-sorted) feature matrix in HBM —
+      right whenever n·d·4B fits (every reference workload except flagship
+      ImageNet).
+    - :meth:`fit_streaming` re-featurizes each column block from raw inputs
+      inside the solver loop — the out-of-core path for the reference's
+      flagship regime (``ImageNetSiftLcsFV.scala:188,197-218``: 2 branches ×
+      2·64·256 = 65 536-dim FV features over ≥1M rows, solved block-at-a-time
+      precisely because the full matrix exceeds memory,
+      ``BlockWeightedLeastSquares.scala:173-303``).
+
+    HBM arithmetic for the flagship shape (n=100k rows, d=65 536, C=1000,
+    block 4096, one v5e chip = 16 GB):
+      in-core Xs: n·d·4 = 26.2 GB — does not fit; streaming instead keeps
+      resident only the raw descriptors (bf16: n·n_desc·64·2 ≈ 3-6 GB per
+      branch at 200-400 descriptors/image), R (n·C·4 = 0.4 GB), one block
+      Xb (n·4096·4 = 1.6 GB), the model (d·C·4 = 0.26 GB), joint means
+      (C·d·4 = 0.26 GB), and one bs² pop-cov (64 MB) — ~6-9 GB total.
+      With ``cache_stats=True`` and num_iter>1, add num_blocks·bs² f32
+      (16 blocks × 64 MB = 1 GB) of cached per-block covariances.
+    """
+
+    def __init__(self, block_size: int, num_iter: int, lam: float,
+                 mixture_weight: float, cache_stats: bool = True):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
         self.mixture_weight = mixture_weight
+        # Reuse pass-0 per-block pop stats on later passes (the reference's
+        # blockStats cache, ``BlockWeightedLeastSquares.scala:214-221``).
+        # Costs num_blocks·bs² HBM; disable for memory-tight huge-d solves.
+        self.cache_stats = cache_stats
 
-    def fit(self, data, labels, mask: Optional[jax.Array] = None) -> BlockLinearMapper:
-        if isinstance(data, Dataset):
-            data, mask = data.data, data.mask if mask is None else mask
-        if isinstance(labels, Dataset):
-            labels = labels.data
-        if not isinstance(data, (jnp.ndarray, np.ndarray)):
-            data = jnp.concatenate(list(data), axis=1)
-        data = jnp.asarray(data, jnp.float32)
+    def _run(self, get_block, num_blocks: int, labels, mask, precision: str):
+        """Shared weighted-BCD loop. ``get_block(b, order)`` returns the
+        class-sorted (n, block_size) feature block."""
         labels = jnp.asarray(labels, jnp.float32)
-        n, d = data.shape
         num_classes = labels.shape[1]
         w = jnp.float32(self.mixture_weight)
         lam = jnp.float32(self.lam)
-        from keystone_tpu.linalg.solvers import get_solver_precision
-
-        precision = get_solver_precision()
 
         order, cls_sorted, counts, offsets, valid = _prepare(labels, mask, num_classes)
-        Xs = data[order]
+        n = labels.shape[0]
         Ls = labels[order]
         n_eff = jnp.sum(counts).astype(jnp.float32)
 
@@ -215,23 +234,17 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         # sizes within 2× of each class's rows (see _class_buckets).
         buckets, inv_perm = _class_buckets(np.asarray(counts), n)
 
-        d_pad = -(-d // self.block_size) * self.block_size
-        if d_pad != d:
-            Xs = jnp.pad(Xs, ((0, 0), (0, d_pad - d)))
-        num_blocks = d_pad // self.block_size
-
         models = [
             jnp.zeros((self.block_size, num_classes), jnp.float32)
             for _ in range(num_blocks)
         ]
-        block_stats: list = [None] * num_blocks
+        pop_stats_cache: list = [None] * num_blocks
+        joint_means_blocks: list = [None] * num_blocks
 
         for _ in range(self.num_iter):
             for b in range(num_blocks):
-                Xb = jax.lax.dynamic_slice_in_dim(
-                    Xs, b * self.block_size, self.block_size, 1
-                )
-                if block_stats[b] is None:
+                Xb = get_block(b, order)
+                if pop_stats_cache[b] is None:
                     pop_mean, pop_cov, pop_xtr = _pop_stats(
                         Xb, R, valid, n_eff, precision=precision
                     )
@@ -243,9 +256,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         counts[:, None].astype(jnp.float32), 1.0
                     )
                     joint_means_b = w * class_means + (1.0 - w) * pop_mean
-                    block_stats[b] = (pop_mean, pop_cov, joint_means_b)
+                    joint_means_blocks[b] = joint_means_b
+                    if self.cache_stats and self.num_iter > 1:
+                        pop_stats_cache[b] = (pop_mean, pop_cov)
                 else:
-                    pop_mean, pop_cov, joint_means_b = block_stats[b]
+                    pop_mean, pop_cov = pop_stats_cache[b]
+                    joint_means_b = joint_means_blocks[b]
                     pop_xtr = hdot((Xb * valid[:, None]).T, R, precision) / n_eff
 
                 dW = _bucketed_class_solves(
@@ -257,11 +273,105 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 R = _apply_update(R, Xb, dW, valid, precision=precision)
                 _, residual_mean = _class_col_means(R, cls_sorted, counts)
 
-        W = jnp.concatenate(models, axis=0)[:d]
-        joint_means = jnp.concatenate(
-            [s[2] for s in block_stats], axis=1
-        )[:, :d]  # (C, d)
+        W = jnp.concatenate(models, axis=0)
+        joint_means = jnp.concatenate(joint_means_blocks, axis=1)  # (C, d_pad)
         # finalB = jointLabelMean − Σ_d jointMeans[c,d]·W[d,c] (``:305-309``)
+        return W, joint_means, joint_label_mean
+
+    def fit(self, data, labels, mask: Optional[jax.Array] = None) -> BlockLinearMapper:
+        if isinstance(data, Dataset):
+            data, mask = data.data, data.mask if mask is None else mask
+        if isinstance(labels, Dataset):
+            labels = labels.data
+        if not isinstance(data, (jnp.ndarray, np.ndarray)):
+            data = jnp.concatenate(list(data), axis=1)
+        data = jnp.asarray(data, jnp.float32)
+        n, d = data.shape
+        from keystone_tpu.linalg.solvers import get_solver_precision
+
+        precision = get_solver_precision()
+        d_pad = -(-d // self.block_size) * self.block_size
+        if d_pad != d:
+            data = jnp.pad(data, ((0, 0), (0, d_pad - d)))
+        num_blocks = d_pad // self.block_size
+
+        Xs_box: list = []  # sort once, on first block access
+
+        def get_block(b, order):
+            if not Xs_box:
+                Xs_box.append(data[order])
+            return jax.lax.dynamic_slice_in_dim(
+                Xs_box[0], b * self.block_size, self.block_size, 1
+            )
+
+        W, joint_means, joint_label_mean = self._run(
+            get_block, num_blocks, labels, mask, precision
+        )
+        W = W[:d]
+        joint_means = joint_means[:, :d]
+        final_b = joint_label_mean - jnp.einsum("cd,dc->c", joint_means, W)
+        return BlockLinearMapper(
+            w=W, b=final_b, feature_means=None, block_size=self.block_size
+        )
+
+    def fit_streaming(
+        self,
+        feature_nodes: Sequence,
+        raw,
+        labels,
+        mask: Optional[jax.Array] = None,
+        donate_raw: bool = False,
+    ) -> BlockLinearMapper:
+        """Out-of-core weighted fit: block ``b``'s features are recomputed as
+        ``feature_nodes[b].apply_batch(raw_sorted)`` inside the solver loop,
+        so the full (n, d) matrix never materializes (see class docstring for
+        the HBM budget).
+
+        ``raw`` is a pytree whose leaves all have leading axis n (e.g. a dict
+        of per-branch descriptor tensors + per-branch normalization scalars);
+        it is class-sorted ONCE up front — the analog of the reference's
+        ``groupByClasses`` shuffle of the raw rows
+        (``BlockWeightedLeastSquares.scala:324-361``). Every node must emit
+        exactly ``block_size`` features.
+
+        ``donate_raw=True`` donates each raw leaf to the sort gather, so the
+        unsorted buffer is freed as soon as its sorted copy exists (peak =
+        total + one leaf instead of 2× total — the difference between
+        fitting and OOMing at the flagship descriptor footprint). The
+        caller's ``raw`` arrays are invalidated.
+        """
+        from keystone_tpu.core.dataset import Dataset as _DS
+        from keystone_tpu.linalg.solvers import get_solver_precision
+
+        if isinstance(raw, _DS):
+            raw, mask = raw.data, raw.mask if mask is None else mask
+        if isinstance(labels, _DS):
+            labels = labels.data
+        precision = get_solver_precision()
+        num_blocks = len(feature_nodes)
+
+        sorted_box: list = []
+
+        def sort_raw(order):
+            if donate_raw:
+                gather = jax.jit(lambda a, o: a[o], donate_argnums=(0,))
+                return jax.tree.map(lambda a: gather(a, order), raw)
+            return jax.tree.map(lambda a: a[order], raw)
+
+        def get_block(b, order):
+            if not sorted_box:
+                sorted_box.append(sort_raw(order))
+            Xb = feature_nodes[b].apply_batch(sorted_box[0])
+            if Xb.shape[1] != self.block_size:
+                raise ValueError(
+                    f"feature node {b} emitted {Xb.shape[1]} features, "
+                    f"expected block_size={self.block_size}"
+                )
+            return jnp.asarray(Xb, jnp.float32)
+
+        W, joint_means, joint_label_mean = self._run(
+            get_block, num_blocks, labels, mask, precision
+        )
         final_b = joint_label_mean - jnp.einsum("cd,dc->c", joint_means, W)
         return BlockLinearMapper(
             w=W, b=final_b, feature_means=None, block_size=self.block_size

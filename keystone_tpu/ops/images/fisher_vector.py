@@ -29,10 +29,14 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from flax import struct
 
 from keystone_tpu.core.pipeline import Transformer
 from keystone_tpu.learning.gmm import GaussianMixtureModel
-from keystone_tpu.ops.pallas.moments import gmm_moments_auto
+from keystone_tpu.ops.pallas.moments import (
+    _affine_params,
+    gmm_moments_auto,
+)
 
 
 class FisherVector(Transformer):
@@ -58,3 +62,148 @@ class FisherVector(Transformer):
         fv_mu = grad_mu / (n * jnp.sqrt(gmm.weights)[:, None])
         fv_sig = grad_sig / (n * jnp.sqrt(2.0 * gmm.weights)[:, None])
         return jnp.concatenate([fv_mu.T, fv_sig.T], axis=1)  # (d, 2k)
+
+
+# ---------------------------------------------------------------------------
+# Streaming (out-of-core) Fisher features: the flagship ImageNet regime.
+#
+# The standard featurizer chain is FV → vectorize → L2-normalize →
+# signed-Hellinger → L2-normalize (``ImageNetSiftLcsFV.scala:29-39``). The
+# full feature vector (d·2k per branch; 32 768 at PCA-64 / vocab 256) never
+# needs to exist to compute a column block of it:
+#
+# 1. MatrixVectorizer flattens the (d, 2k) FV column-major (the Breeze
+#    convention), so the final feature order is center-major — column j < k
+#    is the d-dim mean-gradient of center j, column k+j the variance
+#    gradient. A contiguous feature block = a contiguous run of FV columns,
+#    and its moments only involve that run's centers (posteriors still need
+#    all k — an (n_desc, k) matmul, cheap next to the solver's grams).
+# 2. The two L2 normalizations cancel:
+#        out = h / ‖h‖₂,  h = sign(z)·√|z|,  z = v/‖v‖₂
+#            = sign(v)·√|v| / √‖v‖₁           (‖h‖₂² = ‖v‖₁/‖v‖₂)
+#    so one scalar per image — the raw FV's L1 norm — fully determines
+#    every block of the normalized output.
+#
+# ``fisher_l1_norms`` computes those scalars in one chunked pre-pass;
+# ``FisherVectorSliceNormalized`` then emits any column run of the final
+# features — exactly the block interface
+# ``BlockWeightedLeastSquaresEstimator.fit_streaming`` wants.
+# ---------------------------------------------------------------------------
+
+
+def _fv_posteriors(descriptors, gmm: GaussianMixtureModel):
+    """Full-k posteriors (n_desc, k), their sums, and the centered
+    descriptors + center (the shared prefix of every column block)."""
+    x = jnp.asarray(descriptors, jnp.float32)
+    center = jnp.mean(x, axis=0)
+    xc = x - center[None]
+    A, B, c = _affine_params(
+        gmm.means - center[None], gmm.variances, gmm.weights
+    )
+    ll = xc @ A + (xc * xc) @ B + c[None]
+    q = jax.nn.softmax(ll, axis=1)
+    return q, jnp.sum(q, axis=0), xc, center
+
+
+def _fv_cols(descriptors, gmm: GaussianMixtureModel, lo: int, hi: int):
+    """Columns [lo, hi) of one descriptor matrix's (d, 2k) FV, flattened
+    column-major — i.e. the contiguous slice [lo·d, hi·d) of the full
+    vectorized FV. Moment work scales with (hi-lo); ``lo``/``hi`` are
+    static."""
+    n = descriptors.shape[0]
+    k = gmm.means.shape[0]
+    q, qsum_full, xc, center = _fv_posteriors(descriptors, gmm)
+    cs = center[None]
+    parts = []
+    if lo < k:  # mean-gradient columns (centers [lo, min(hi,k)))
+        a, b = lo, min(hi, k)
+        qs, qsum = q[:, a:b], qsum_full[a:b][:, None]
+        qx = qs.T @ xc + qsum * cs  # uncentered (shift identity)
+        mu, w = gmm.means[a:b], gmm.weights[a:b]
+        grad = (qx - qsum * mu) / jnp.sqrt(gmm.variances[a:b])
+        parts.append((grad / (n * jnp.sqrt(w)[:, None])).reshape(-1))
+    if hi > k:  # variance-gradient columns (centers [max(lo,k)-k, hi-k))
+        a, b = max(lo, k) - k, hi - k
+        qs, qsum = q[:, a:b], qsum_full[a:b][:, None]
+        qx_c = qs.T @ xc
+        qx = qx_c + qsum * cs
+        qx2 = qs.T @ (xc * xc) + 2.0 * cs * qx_c + qsum * cs**2
+        mu, var, w = gmm.means[a:b], gmm.variances[a:b], gmm.weights[a:b]
+        grad = (qx2 - 2.0 * mu * qx + qsum * mu**2) / var - qsum
+        parts.append((grad / (n * jnp.sqrt(2.0 * w)[:, None])).reshape(-1))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def fisher_l1_norms(
+    descriptors: jax.Array, gmm: GaussianMixtureModel, chunk: int = 512
+) -> jax.Array:
+    """Per-image L1 norm of the raw vectorized FV, computed in row chunks so
+    no more than ``chunk`` full FVs are ever live. Returns (n,), clamped away
+    from zero (the NormalizeRows eps guard, ``Stats.scala:112-124``)."""
+    k = gmm.means.shape[0]
+
+    def one(D):
+        return jnp.sum(jnp.abs(_fv_cols(D, gmm, 0, 2 * k)))
+
+    n = descriptors.shape[0]
+    pad = (-n) % chunk
+    padded = (
+        jnp.pad(descriptors, ((0, pad), (0, 0), (0, 0))) if pad else descriptors
+    )
+    chunked = padded.reshape(-1, chunk, *descriptors.shape[1:])
+    l1 = jax.lax.map(jax.vmap(one), chunked).reshape(-1)[:n]
+    return jnp.maximum(l1, 2.2e-16)
+
+
+class FisherVectorSliceNormalized(Transformer):
+    """One feature block of the normalized Fisher featurizer chain.
+
+    ``apply_batch`` takes the ``fit_streaming`` raw pytree (a dict) and
+    reads ``raw[key]`` = (n, n_desc, d) PCA-reduced descriptors and
+    ``raw[l1_key]`` = (n,) L1 norms from :func:`fisher_l1_norms`; emits the
+    (n, (col_hi-col_lo)·d) block of sign(v)·√|v|/√‖v‖₁ — the exact
+    [col_lo·d, col_hi·d) slice of the reference's FV → vectorize → L2 →
+    Hellinger → L2 output (``ImageNetSiftLcsFV.scala:29-39``; see module
+    comment for the norm-cancellation identity)."""
+
+    gmm: GaussianMixtureModel
+    col_lo: int = struct.field(pytree_node=False, default=0)
+    col_hi: int = struct.field(pytree_node=False, default=0)
+    key: str = struct.field(pytree_node=False, default="descs")
+    l1_key: str = struct.field(pytree_node=False, default="l1")
+
+    def apply_batch(self, raw):
+        descs = raw[self.key]
+        l1 = raw[self.l1_key]
+        fv = jax.vmap(
+            lambda D: _fv_cols(D, self.gmm, self.col_lo, self.col_hi)
+        )(descs)
+        return jnp.sign(fv) * jnp.sqrt(jnp.abs(fv) / l1[:, None])
+
+    def apply(self, raw_one):
+        return self.apply_batch(jax.tree.map(lambda a: a[None], raw_one))[0]
+
+
+def make_fisher_block_nodes(
+    gmm: GaussianMixtureModel,
+    block_size: int,
+    key: str = "descs",
+    l1_key: str = "l1",
+) -> list:
+    """Split one branch's d·2k normalized Fisher features into
+    ``block_size``-wide :class:`FisherVectorSliceNormalized` nodes
+    (``block_size`` must be a multiple of the descriptor dim d)."""
+    k, d = gmm.means.shape
+    if block_size % d:
+        raise ValueError(f"block_size {block_size} not a multiple of dim {d}")
+    cols_per_block = block_size // d
+    if (2 * k) % cols_per_block:
+        raise ValueError(
+            f"2k={2*k} FV columns not divisible by {cols_per_block} per block"
+        )
+    return [
+        FisherVectorSliceNormalized(
+            gmm=gmm, col_lo=lo, col_hi=lo + cols_per_block, key=key, l1_key=l1_key
+        )
+        for lo in range(0, 2 * k, cols_per_block)
+    ]

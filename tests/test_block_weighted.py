@@ -141,6 +141,62 @@ def test_weighted_1000_classes_imbalanced_matches_oracle(rng):
     np.testing.assert_allclose(np.asarray(model.b), b_exp, atol=5e-3)
 
 
+class _SliceNode:
+    """Feature node for fit_streaming tests: emits one column block of
+    raw['x'] (stands in for re-featurization from raw inputs)."""
+
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def apply_batch(self, raw):
+        return raw["x"][:, self.lo : self.hi]
+
+
+@pytest.mark.parametrize("num_iter,cache_stats", [(1, True), (3, True), (3, False)])
+def test_weighted_streaming_matches_incore(rng, num_iter, cache_stats):
+    """fit_streaming (re-featurize per block, nothing materialized) must
+    reproduce the in-core fit exactly — same loop, different block source
+    (VERDICT round-1 item 1)."""
+    x, labels, ind = _toy(rng, n=200, d=24, balanced=False)
+    bs = 8
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=bs, num_iter=num_iter, lam=0.1, mixture_weight=0.25,
+        cache_stats=cache_stats,
+    )
+    m_incore = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    nodes = [_SliceNode(k * bs, (k + 1) * bs) for k in range(x.shape[1] // bs)]
+    m_stream = est.fit_streaming(nodes, {"x": jnp.asarray(x)}, jnp.asarray(ind))
+    np.testing.assert_allclose(
+        np.asarray(m_stream.w), np.asarray(m_incore.w), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_stream.b), np.asarray(m_incore.b), atol=1e-5
+    )
+
+
+def test_weighted_streaming_masked_and_sharded(rng, devices):
+    """Streaming weighted fit on an 8-device mesh with padded (masked) rows:
+    the scaled-down sharded version of the flagship out-of-core solve."""
+    from keystone_tpu.parallel import distribute, make_mesh, use_mesh
+
+    x, labels, ind = _toy(rng, n=90, d=16, balanced=False)
+    est = BlockWeightedLeastSquaresEstimator(8, 2, 0.1, 0.25)
+    m_ref = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    with use_mesh(make_mesh()):
+        ds = distribute(jnp.asarray(x))  # pads to /8, row-shards, masks
+        lds, _ = pad_rows(jnp.asarray(ind), ds.data.shape[0])
+        nodes = [_SliceNode(k * 8, (k + 1) * 8) for k in range(2)]
+        m_stream = est.fit_streaming(
+            nodes, {"x": ds.data}, lds, mask=ds.mask
+        )
+    np.testing.assert_allclose(
+        np.asarray(m_stream.w), np.asarray(m_ref.w), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_stream.b), np.asarray(m_ref.b), atol=1e-4
+    )
+
+
 def test_weighted_multiblock_classifies_imbalanced(rng):
     x, labels, ind = _toy(rng, n=200, d=16, balanced=False)
     est = BlockWeightedLeastSquaresEstimator(

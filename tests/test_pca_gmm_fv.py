@@ -154,3 +154,29 @@ def test_fisher_vector_batch(rng):
     assert out.shape == (3, 4, 4)
     one = np.asarray(FisherVector(gmm=gmm).serve(descs[1]))
     np.testing.assert_allclose(out[1], one, atol=1e-5)
+
+
+def test_fisher_slice_normalized_matches_dense_chain(rng):
+    """Concatenated FisherVectorSliceNormalized blocks must equal the dense
+    FV → vectorize → L2 → Hellinger → L2 chain (the two L2 norms cancel into
+    one per-image L1 scalar — see ops/images/fisher_vector.py)."""
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_fisher_block_nodes,
+    )
+    from keystone_tpu.pipelines._fisher import fisher_featurizer
+
+    k, d = 4, 8
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=10).fit(
+        jnp.asarray(rng.normal(size=(200, d)).astype(np.float32))
+    )
+    descs = jnp.asarray(rng.normal(size=(6, 20, d)).astype(np.float32))
+    dense = np.asarray(fisher_featurizer(gmm)(descs))  # (6, d*2k)
+
+    l1 = fisher_l1_norms(descs, gmm, chunk=4)
+    raw = {"descs": descs, "l1": l1}
+    blocks = make_fisher_block_nodes(gmm, block_size=2 * d)  # 2 cols per block
+    assert len(blocks) == k
+    stream = np.concatenate([np.asarray(b.apply_batch(raw)) for b in blocks], axis=1)
+    assert stream.shape == dense.shape
+    np.testing.assert_allclose(stream, dense, atol=1e-5)
