@@ -8,21 +8,35 @@ The flagship workload is the reference's own headline config
 10k×784 test, 4×(sign-flip → 1024-pt FFT → ReLU) featurization to 2048
 features, one-pass block least squares, streaming block evaluation.
 
-The reference publishes no numbers (BASELINE.md) — the Spark baseline must be
-measured on a 64-core cluster we don't have here, so ``vs_baseline`` reports
-against ``baseline_s`` below once BASELINE.md gains a measured value; until
-then it is null. We report the steady-state run (second invocation, compile
-cached) as the headline value and the cold run separately.
+The reference publishes no numbers (BASELINE.md) — and the 64-core Spark
+cluster of the north star cannot run in this image (no JVM). The measured
+anchor is ``cpu_baseline.json``: the SAME pipeline math on jax-CPU on this
+host (1 core — produced by ``scripts/cpu_baseline.py``, methodology in
+BASELINE.md). ``vs_baseline`` = cpu_warm_s / tpu_warm_s against that anchor;
+the JSON also restates the anchor's core count so the number can't be
+misread as a cluster comparison. We report the steady-state run (second
+invocation, compile cached) as the headline value and the cold run
+separately.
 """
 
 import json
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
-# Measured reference wall-clock (Spark, 64-core), to be filled in BASELINE.md.
-BASELINE_S = None
+def _load_cpu_baseline():
+    """The measured CPU anchor (scripts/cpu_baseline.py); None if absent."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cpu_baseline.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cpu_baseline.json unavailable: {e}", file=sys.stderr)
+        return None
 
 
 def solver_gflops(n: int = 60000, d: int = 2048, c: int = 10, block: int = 2048,
@@ -67,20 +81,24 @@ def solver_gflops(n: int = 60000, d: int = 2048, c: int = 10, block: int = 2048,
 
 def _try_solver_gflops(precision=None):
     """Secondary metric; never let it block the primary JSON line. One retry
-    absorbs transient timing noise (dt<=0 on a contended chip)."""
-    for _ in range(2):
+    absorbs transient timing noise (dt<=0 on a contended chip); genuine
+    failures (e.g. the NaN guard) are logged to stderr before retrying so
+    they are distinguishable from noise in the driver log."""
+    for attempt in range(2):
         try:
             return round(solver_gflops(precision=precision), 1)
-        except Exception:
-            continue
+        except Exception as e:
+            print(
+                f"solver_gflops(precision={precision}) attempt {attempt + 1} "
+                f"failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
     return None
 
 
 def _try_extras():
     """Secondary whole-pipeline wall-clocks (warm), never fatal. Disable with
     BENCH_EXTRAS=0 to keep the run to the primary metric only."""
-    import os
-
     if os.environ.get("BENCH_EXTRAS", "1") == "0":
         return {}
     extras = {}
@@ -126,22 +144,33 @@ def main():
     warm = run(config)
 
     value = warm["wallclock_s"]
+    anchor = _load_cpu_baseline()
+    anchor_s = (anchor or {}).get("mnist_random_fft_cpu_warm_s")
     out = {
         "metric": "mnist_random_fft_fit_eval_wallclock",
         "value": round(value, 3),
         "unit": "s",
-        "vs_baseline": round(BASELINE_S / value, 2) if BASELINE_S else None,
+        # Speedup of 1 TPU v5e chip over the same pipeline on jax-CPU
+        # (host_cores below — NOT the 64-core Spark north-star baseline).
+        "vs_baseline": round(anchor_s / value, 2) if anchor_s else None,
+        "baseline_anchor": None if anchor is None else {
+            "source": "scripts/cpu_baseline.py (same pipeline, jax-CPU)",
+            "host_cores": anchor.get("host_cores"),
+            "mnist_cpu_warm_s": anchor_s,
+        },
         "cold_wallclock_s": round(cold_s, 3),
         "train_error_pct": round(warm["train_error"], 3),
         "test_error_pct": round(warm["test_error"], 3),
         "solver_gflops_per_chip": _try_solver_gflops(),
         "device": str(jax.devices()[0]),
     }
-    import os
-
     if os.environ.get("BENCH_EXTRAS", "1") != "0":
         out["solver_gflops_per_chip_f32_highest"] = _try_solver_gflops("highest")
     out.update(_try_extras())
+    timit_cpu = (anchor or {}).get("timit_cpu_warm_extrapolated_s")
+    timit_tpu = out.get("timit_100k_50x4096_5ep_warm_s")
+    if timit_cpu and timit_tpu:
+        out["timit_vs_cpu_baseline"] = round(timit_cpu / timit_tpu, 1)
     print(json.dumps(out))
 
 

@@ -4,10 +4,26 @@ Reference: ``bin/run-pipeline.sh:9-28`` — one entry point that dispatches to a
 pipeline class by name and forwards flags (there via spark-submit; here the
 "cluster config" is the TPU mesh, picked up from the environment by
 ``keystone_tpu.parallel``).
+
+Multi-host launch (the ``keystone-ec2.sh`` analog — reference
+``bin/keystone-ec2.sh``): instead of provisioning a Spark cluster, every host
+of a TPU pod slice runs the same command with
+
+    run-pipeline --coordinator host0:8476 --num-processes N --process-id I \
+                 [--mesh-model M] <Pipeline> [flags]
+
+which calls ``jax.distributed.initialize`` before any backend use; after
+initialization ``jax.devices()`` is the global device set, so the default
+``(data, model)`` mesh — and therefore every sharded gram/psum in the
+solvers — spans the whole slice (ICI intra-slice, DCN across slices). On
+Cloud TPU metadata-provisioned VMs all three flags may be omitted
+(``jax.distributed.initialize()`` auto-detects). ``--mesh-model M`` sets the
+model-parallel axis of the default mesh (data axis = n_devices / M).
 """
 
 from __future__ import annotations
 
+import argparse
 import sys
 
 PIPELINES = {
@@ -23,12 +39,56 @@ PIPELINES = {
 }
 
 
+def _parse_launch_flags(argv):
+    """Split cluster-launch flags (ours) from pipeline flags (forwarded)."""
+    # allow_abbrev=False: abbreviated pipeline flags (e.g. --dist...) must
+    # reach the pipeline's own parser, not silently become launch flags.
+    ap = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
+    ap.add_argument("--coordinator", default=None,
+                    help="coordinator address host:port for jax.distributed")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--distributed", action="store_true",
+                    help="jax.distributed.initialize() with auto-detection")
+    ap.add_argument("--mesh-model", type=int, default=1,
+                    help="model-parallel axis size of the default mesh")
+    return ap.parse_known_args(argv)
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         names = "\n  ".join(sorted(PIPELINES))
-        print(f"usage: run-pipeline <Pipeline> [flags]\n\npipelines:\n  {names}")
+        print(
+            "usage: run-pipeline [--coordinator HOST:PORT --num-processes N "
+            "--process-id I | --distributed] [--mesh-model M] "
+            f"<Pipeline> [flags]\n\npipelines:\n  {names}"
+        )
         return 0 if argv else 2
+    launch, argv = _parse_launch_flags(argv)
+    if (launch.num_processes is not None or launch.process_id is not None) \
+            and not (launch.coordinator or launch.distributed):
+        print(
+            "--num-processes/--process-id require --coordinator (or "
+            "--distributed for auto-detection); refusing to run "
+            "single-process while the rest of the slice waits at a "
+            "collective", file=sys.stderr,
+        )
+        return 2
+    if launch.coordinator or launch.distributed:
+        import jax
+
+        kwargs = {}
+        if launch.coordinator:
+            kwargs = dict(
+                coordinator_address=launch.coordinator,
+                num_processes=launch.num_processes,
+                process_id=launch.process_id,
+            )
+        jax.distributed.initialize(**kwargs)
+    if not argv:
+        print("missing pipeline name; run with --help", file=sys.stderr)
+        return 2
     name, rest = argv[0], argv[1:]
     if name not in PIPELINES:
         # accept snake_case / lowercase spellings: mnist_random_fft == MnistRandomFFT
@@ -40,7 +100,22 @@ def main(argv=None) -> int:
     import importlib
 
     mod = importlib.import_module(PIPELINES[name])
-    mod.main(rest)
+    if launch.mesh_model > 1:
+        import jax
+
+        from keystone_tpu.parallel import make_mesh, use_mesh
+
+        n_dev = len(jax.devices())
+        if n_dev % launch.mesh_model:
+            print(
+                f"--mesh-model {launch.mesh_model} does not divide the "
+                f"device count {n_dev}", file=sys.stderr,
+            )
+            return 2
+        with use_mesh(make_mesh(model=launch.mesh_model)):
+            mod.main(rest)
+    else:
+        mod.main(rest)
     return 0
 
 

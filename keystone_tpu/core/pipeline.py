@@ -322,6 +322,22 @@ class ChunkedMap(Transformer):
     def apply_batch(self, xs):
         if self.num_chunks <= 1:
             return self.node.apply_batch(xs)
+        # lax.map traces the node; a host node (Cacher, Sampler, ...) — at
+        # any nesting depth inside Chains or ChunkedMaps — would be silently
+        # traced past its materialization semantics. Fail loudly instead.
+        def check(node):
+            if isinstance(node, Chain):
+                for s in node.stages:
+                    check(s)
+            elif isinstance(node, ChunkedMap):
+                check(node.node)
+            elif not node.jittable:
+                raise TypeError(
+                    f"ChunkedMap requires jittable nodes; {type(node).__name__} "
+                    "is a host node (run it outside the chunked segment)"
+                )
+
+        check(self.node)
         n = jax.tree_util.tree_leaves(xs)[0].shape[0]
         chunk = -(-n // self.num_chunks)
         n_pad = chunk * self.num_chunks
@@ -340,14 +356,28 @@ class ChunkedMap(Transformer):
         from keystone_tpu.parallel.mesh import current_mesh
 
         mesh = current_mesh()
-        if mesh is not None and mesh.shape.get("data", 1) > 1 and n % mesh.shape["data"] == 0:
-            from jax.sharding import NamedSharding, PartitionSpec
+        if mesh is not None and mesh.shape.get("data", 1) > 1:
+            if n % mesh.shape["data"] == 0:
+                from jax.sharding import NamedSharding, PartitionSpec
 
-            def pin(a):
-                spec = PartitionSpec("data", *([None] * (a.ndim - 1)))
-                return jax.lax.with_sharding_constraint(
-                    a, NamedSharding(mesh, spec)
+                def pin(a):
+                    spec = PartitionSpec("data", *([None] * (a.ndim - 1)))
+                    return jax.lax.with_sharding_constraint(
+                        a, NamedSharding(mesh, spec)
+                    )
+
+                out = jax.tree.map(pin, out)
+            else:
+                # Ragged n: an even row sharding does not exist, so the pin
+                # is skipped and XLA may leave the output gathered — a perf
+                # cliff on multi-chip meshes. Pad rows to a multiple of the
+                # data axis (core/dataset.py pad_rows / distribute) to keep
+                # the chunk outputs sharded.
+                from keystone_tpu.utils import get_logger
+
+                get_logger("keystone_tpu.core.pipeline").warning(
+                    "ChunkedMap: %d rows not divisible by data axis %d; "
+                    "output sharding not pinned (pad rows to avoid a "
+                    "gather on multi-chip meshes)", n, mesh.shape["data"],
                 )
-
-            out = jax.tree.map(pin, out)
         return out

@@ -320,25 +320,23 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         raw,
         labels,
         mask: Optional[jax.Array] = None,
-        donate_raw: bool = False,
     ) -> BlockLinearMapper:
         """Out-of-core weighted fit: block ``b``'s features are recomputed as
-        ``feature_nodes[b].apply_batch(raw_sorted)`` inside the solver loop,
-        so the full (n, d) matrix never materializes (see class docstring for
-        the HBM budget).
+        ``feature_nodes[b].apply_batch(raw)`` inside the solver loop, so the
+        full (n, d) matrix never materializes (see class docstring for the
+        HBM budget).
 
         ``raw`` is a pytree whose leaves all have leading axis n (e.g. a dict
         of per-branch descriptor tensors + per-branch normalization scalars);
-        it is class-sorted ONCE up front — the analog of the reference's
-        ``groupByClasses`` shuffle of the raw rows
-        (``BlockWeightedLeastSquares.scala:324-361``). Every node must emit
-        exactly ``block_size`` features.
+        every node must emit exactly ``block_size`` features.
 
-        ``donate_raw=True`` donates each raw leaf to the sort gather, so the
-        unsorted buffer is freed as soon as its sorted copy exists (peak =
-        total + one leaf instead of 2× total — the difference between
-        fitting and OOMing at the flagship descriptor footprint). The
-        caller's ``raw`` arrays are invalidated.
+        The class-contiguous row layout the per-class solves need — the
+        analog of the reference's ``groupByClasses`` shuffle
+        (``BlockWeightedLeastSquares.scala:324-361``) — is applied to each
+        *featurized block* (an (n, block_size) f32 gather), never to ``raw``
+        itself: sorting the flagship descriptor tensors would transiently
+        double their ~6 GB footprint, which is what OOMs a v5e chip; the
+        per-block gather is 25× smaller and costs only bandwidth.
         """
         from keystone_tpu.core.dataset import Dataset as _DS
         from keystone_tpu.linalg.solvers import get_solver_precision
@@ -350,24 +348,14 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         precision = get_solver_precision()
         num_blocks = len(feature_nodes)
 
-        sorted_box: list = []
-
-        def sort_raw(order):
-            if donate_raw:
-                gather = jax.jit(lambda a, o: a[o], donate_argnums=(0,))
-                return jax.tree.map(lambda a: gather(a, order), raw)
-            return jax.tree.map(lambda a: a[order], raw)
-
         def get_block(b, order):
-            if not sorted_box:
-                sorted_box.append(sort_raw(order))
-            Xb = feature_nodes[b].apply_batch(sorted_box[0])
+            Xb = feature_nodes[b].apply_batch(raw)
             if Xb.shape[1] != self.block_size:
                 raise ValueError(
                     f"feature node {b} emitted {Xb.shape[1]} features, "
                     f"expected block_size={self.block_size}"
                 )
-            return jnp.asarray(Xb, jnp.float32)
+            return jnp.asarray(Xb, jnp.float32)[order]
 
         W, joint_means, joint_label_mean = self._run(
             get_block, num_blocks, labels, mask, precision

@@ -171,14 +171,37 @@ class FisherVectorSliceNormalized(Transformer):
     col_hi: int = struct.field(pytree_node=False, default=0)
     key: str = struct.field(pytree_node=False, default="descs")
     l1_key: str = struct.field(pytree_node=False, default="l1")
+    # Rows per internal chunk (0 = all at once). Bounds the (rows, n_desc, k)
+    # posterior intermediate; chunks are read in place via dynamic_slice —
+    # unlike a generic pad/reshape chunker (ChunkedMap), the multi-GB
+    # descriptor tensor is never copied.
+    row_chunk: int = struct.field(pytree_node=False, default=0)
 
-    def apply_batch(self, raw):
-        descs = raw[self.key]
-        l1 = raw[self.l1_key]
+    def _fv_batch(self, descs, l1):
         fv = jax.vmap(
             lambda D: _fv_cols(D, self.gmm, self.col_lo, self.col_hi)
         )(descs)
         return jnp.sign(fv) * jnp.sqrt(jnp.abs(fv) / l1[:, None])
+
+    def apply_batch(self, raw):
+        descs = raw[self.key]
+        l1 = raw[self.l1_key]
+        n, ch = descs.shape[0], self.row_chunk
+        if not ch or n <= ch:
+            return self._fv_batch(descs, l1)
+        num_full = n // ch
+
+        def step(i):
+            D = jax.lax.dynamic_slice_in_dim(descs, i * ch, ch, 0)
+            li = jax.lax.dynamic_slice_in_dim(l1, i * ch, ch, 0)
+            return self._fv_batch(D, li)
+
+        out = jax.lax.map(step, jnp.arange(num_full))
+        out = out.reshape(num_full * ch, -1)
+        if n % ch:
+            tail = self._fv_batch(descs[num_full * ch :], l1[num_full * ch :])
+            out = jnp.concatenate([out, tail])
+        return out
 
     def apply(self, raw_one):
         return self.apply_batch(jax.tree.map(lambda a: a[None], raw_one))[0]
@@ -189,6 +212,7 @@ def make_fisher_block_nodes(
     block_size: int,
     key: str = "descs",
     l1_key: str = "l1",
+    row_chunk: int = 0,
 ) -> list:
     """Split one branch's d·2k normalized Fisher features into
     ``block_size``-wide :class:`FisherVectorSliceNormalized` nodes
@@ -203,7 +227,8 @@ def make_fisher_block_nodes(
         )
     return [
         FisherVectorSliceNormalized(
-            gmm=gmm, col_lo=lo, col_hi=lo + cols_per_block, key=key, l1_key=l1_key
+            gmm=gmm, col_lo=lo, col_hi=lo + cols_per_block, key=key,
+            l1_key=l1_key, row_chunk=row_chunk,
         )
         for lo in range(0, 2 * k, cols_per_block)
     ]

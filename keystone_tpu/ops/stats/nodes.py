@@ -157,6 +157,13 @@ class Sampler(FunctionNode):
 
     Reference: ``nodes/stats/Sampling.scala:33-37`` (``takeSample`` with
     ``seed=42``).
+
+    RNG note: the sample indices come from ``jax.random`` for device-resident
+    inputs and from numpy's Generator for host arrays — the same seed picks a
+    *different* (deterministic) subset on the two paths. Real-pipeline
+    descriptors are device arrays, so fits are reproducible run-to-run; only
+    code that moves the same data between host and device sees a different
+    (equally uniform) sample. Applies to :class:`ColumnSampler` too.
     """
 
     jittable: ClassVar[bool] = False

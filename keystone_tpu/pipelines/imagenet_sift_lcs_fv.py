@@ -61,7 +61,7 @@ class ImageNetSiftLcsFVConfig:
     streaming: bool = False
     extract_chunk: int = 2048  # images per descriptor-extraction dispatch
     sample_images: int = 4096  # images whose descriptors feed PCA/GMM fits
-    fv_row_chunks: int = 64  # row chunking of FV block featurization
+    fv_row_chunk: int = 1024  # images per FV block-featurization chunk
     desc_dtype: str = "bfloat16"  # resident reduced-descriptor storage
 
 
@@ -104,7 +104,6 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
     import jax
     import numpy as np
 
-    from keystone_tpu.core.pipeline import ChunkedMap
     from keystone_tpu.learning.block_linear import streaming_predict
     from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
     from keystone_tpu.learning.pca import PCAEstimator
@@ -134,16 +133,22 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
         # first ``sample_images`` images' descriptors (chunked extraction
         # cannot revisit all images twice for free), then the same
         # ColumnSampler seeds as the in-core path.
-        n_sample = min(config.sample_images, train_src.n)
-        # Raw descriptor chunks from pass A are kept (keyed by chunk bounds)
-        # so reduce_split below never re-extracts the sample images.
+        # Sample bound rounded up to a chunk boundary (capped at n) so pass-A
+        # chunk keys line up exactly with reduce_split's — a ragged final
+        # sample chunk would miss the cache AND pin its descriptors for the
+        # whole memory-critical solve.
+        n_sample = min(-(-min(config.sample_images, train_src.n) // chunk) * chunk,
+                       train_src.n)
+        # Raw descriptor chunks from pass A are kept (keyed by chunk bounds,
+        # labels included) so reduce_split below never re-extracts — or even
+        # re-generates/transfers — the sample images.
         desc_cache: dict = {}
         s_parts, l_parts = [], []
         for i0 in range(0, n_sample, chunk):
-            i1 = min(i0 + chunk, n_sample)
-            imgs, _ = train_src.chunk(i0, i1)
+            i1 = min(i0 + chunk, train_src.n)
+            imgs, lbls = train_src.chunk(i0, i1)
             sd, ld = sift_descs(imgs), lcs_descs(imgs)
-            desc_cache[(i0, i1)] = (sd, ld)
+            desc_cache[(i0, i1)] = (sd, ld, lbls)
             s_parts.append(sd)
             l_parts.append(ld)
         sample_s = jnp.concatenate(s_parts) if len(s_parts) > 1 else s_parts[0]
@@ -172,8 +177,7 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
         dtype = jnp.dtype(config.desc_dtype)
         # Chunks land in preallocated buffers via donated dynamic_update_slice
         # (in-place under XLA), not a trailing jnp.concatenate — the concat
-        # would transiently hold parts + result (~2× one branch of HBM),
-        # exactly the peak donate_raw exists to avoid.
+        # would transiently hold parts + result (~2× one branch of HBM).
         _upd = jax.jit(
             lambda buf, part, i0: jax.lax.dynamic_update_slice_in_dim(
                 buf, part, i0, 0
@@ -188,10 +192,10 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             lbl_parts = []
             for i0 in range(0, src.n, chunk):
                 i1 = min(i0 + chunk, src.n)
-                imgs, lbls = src.chunk(i0, i1)
                 if use_cache and (i0, i1) in desc_cache:
-                    sd, ld = desc_cache.pop((i0, i1))
+                    sd, ld, lbls = desc_cache.pop((i0, i1))
                 else:
+                    imgs, lbls = src.chunk(i0, i1)
                     sd, ld = sift_descs(imgs), lcs_descs(imgs)
                 ps = pca_s(sd).astype(dtype)
                 pl = pca_l(ld).astype(dtype)
@@ -211,18 +215,15 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
 
         with Timer("streaming.reduce_train"):
             raw_train, train_labels = reduce_split(train_src, use_cache=True)
+        desc_cache.clear()  # nothing may pin raw descriptors past this point
 
-        nodes = [
-            ChunkedMap(node=b, num_chunks=config.fv_row_chunks)
-            for b in (
-                make_fisher_block_nodes(
-                    gmm_s, config.block_size, key="sift", l1_key="l1_sift"
-                )
-                + make_fisher_block_nodes(
-                    gmm_l, config.block_size, key="lcs", l1_key="l1_lcs"
-                )
-            )
-        ]
+        nodes = make_fisher_block_nodes(
+            gmm_s, config.block_size, key="sift", l1_key="l1_sift",
+            row_chunk=config.fv_row_chunk,
+        ) + make_fisher_block_nodes(
+            gmm_l, config.block_size, key="lcs", l1_key="l1_lcs",
+            row_chunk=config.fv_row_chunk,
+        )
         labels_ind = ClassLabelIndicatorsFromIntLabels(num_classes)(
             jnp.asarray(train_labels)
         )
@@ -231,7 +232,7 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             model = BlockWeightedLeastSquaresEstimator(
                 config.block_size, config.num_iter, config.lam,
                 config.mixture_weight,
-            ).fit_streaming(nodes, raw_train, labels_ind, donate_raw=True)
+            ).fit_streaming(nodes, raw_train, labels_ind)
         del raw_train
 
         with Timer("eval.top5_streaming"):

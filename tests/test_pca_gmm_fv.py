@@ -175,8 +175,11 @@ def test_fisher_slice_normalized_matches_dense_chain(rng):
 
     l1 = fisher_l1_norms(descs, gmm, chunk=4)
     raw = {"descs": descs, "l1": l1}
-    blocks = make_fisher_block_nodes(gmm, block_size=2 * d)  # 2 cols per block
-    assert len(blocks) == k
-    stream = np.concatenate([np.asarray(b.apply_batch(raw)) for b in blocks], axis=1)
-    assert stream.shape == dense.shape
-    np.testing.assert_allclose(stream, dense, atol=1e-5)
+    for row_chunk in (0, 4):  # one-shot and dynamic_slice-chunked (ragged n)
+        blocks = make_fisher_block_nodes(gmm, block_size=2 * d, row_chunk=row_chunk)
+        assert len(blocks) == k
+        stream = np.concatenate(
+            [np.asarray(b.apply_batch(raw)) for b in blocks], axis=1
+        )
+        assert stream.shape == dense.shape
+        np.testing.assert_allclose(stream, dense, atol=1e-5)

@@ -161,6 +161,34 @@ def test_least_squares_fixture_recovery():
     np.testing.assert_allclose(w_ne, w_np, rtol=0, atol=5e-3 * np.abs(w_np).max())
 
 
+def test_solver_precision_parity_on_fixture():
+    """The default solver precision (bf16x3) against the 6-pass
+    f32-equivalent on the reference's real aMat/bMat matrices (round-1
+    ADVICE: synthetic parity tests can't see the bf16x3 gram error). On CPU
+    backends the MXU pass count is moot (all matmuls are f32) so this pins
+    the plumbing; the same check run on a real v5e chip measures ~1.1e-4
+    max relative weight deviation at lam∈{0.01, 1e-5} (recorded in
+    BASELINE.md)."""
+    from keystone_tpu.linalg.solvers import (
+        get_solver_precision,
+        normal_equations_solve,
+        set_solver_precision,
+    )
+
+    A, B = _load_fixture_mats()
+    lam = 0.01
+    prev = get_solver_precision()
+    try:
+        set_solver_precision("highest")
+        w_hi = np.asarray(normal_equations_solve(jnp.asarray(A), jnp.asarray(B), lam=lam))
+        set_solver_precision("high")
+        w_def = np.asarray(normal_equations_solve(jnp.asarray(A), jnp.asarray(B), lam=lam))
+    finally:
+        set_solver_precision(prev)
+    rel = np.abs(w_def - w_hi).max() / np.abs(w_hi).max()
+    assert rel < 1e-3, f"bf16x3 vs highest relative deviation {rel:.2e}"
+
+
 def test_lda_on_iris_fixture():
     """LinearDiscriminantAnalysisSuite used iris.data; class separation in
     the discriminant space must be near-perfect for the two separable pairs."""

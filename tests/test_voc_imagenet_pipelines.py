@@ -119,7 +119,7 @@ def test_imagenet_streaming_end_to_end():
             streaming=True,
             extract_chunk=32,
             sample_images=96,
-            fv_row_chunks=4,
+            fv_row_chunk=40,  # ragged: 96 = 2×40 + 16 tail
             desc_dtype="float32",
         )
     )
