@@ -12,8 +12,11 @@ orbax-style upgrade the survey prescribes — while the CSV loaders
 (``GaussianMixtureModel.load``, ``PCATransformer`` from file) remain for
 reference-artifact parity.
 
-Limitation: static fields are pickled with the treedef, so nodes carrying
-non-picklable statics (lambdas) need module-level functions instead.
+Static fields are pickled with the treedef, so nodes carrying non-picklable
+statics (lambdas, locally-defined functions) cannot checkpoint —
+:func:`save_node` detects this up front and raises a ``ValueError`` naming
+the offending values and the fix (module-level functions), instead of
+surfacing pickle's opaque error mid-write.
 """
 
 from __future__ import annotations
@@ -21,7 +24,7 @@ from __future__ import annotations
 import os
 import pickle
 import tempfile
-from typing import Any, Callable, TypeVar
+from typing import Any, Callable, List, TypeVar
 
 import jax
 import numpy as np
@@ -35,9 +38,55 @@ T = TypeVar("T")
 _MAGIC = "keystone-tpu-node-v1"
 
 
+def _unpicklable_statics(obj: Any, path: str, out: List[str], depth: int = 0) -> None:
+    """Best-effort walk for non-picklable static values (lambdas, local
+    functions, open handles) so checkpoint failures name their culprit."""
+    if depth > 6 or len(out) >= 5:
+        return
+    import dataclasses
+
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            _unpicklable_statics(getattr(obj, f.name), f"{path}.{f.name}", out, depth + 1)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _unpicklable_statics(v, f"{path}[{i}]", out, depth + 1)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _unpicklable_statics(v, f"{path}[{k!r}]", out, depth + 1)
+    elif isinstance(obj, jax.Array) or hasattr(obj, "__array__"):
+        pass  # pytree leaves; never in the treedef, and huge to pickle-test
+    elif not isinstance(obj, (str, bytes, int, float, bool, type(None))):
+        # pickle-test every non-container leaf (lambdas, local functions,
+        # open handles, locks, ...) so the error names whatever actually
+        # fails, not just callables
+        try:
+            pickle.dumps(obj)
+        except Exception:
+            out.append(f"{path} = {getattr(obj, '__qualname__', repr(obj))}")
+
+
 def save_node(node: Any, path: str) -> None:
-    """Checkpoint a (fitted) node/chain/pytree to ``path`` atomically."""
+    """Checkpoint a (fitted) node/chain/pytree to ``path`` atomically.
+
+    Raises ``ValueError`` (naming the offending fields) when the node's
+    static metadata cannot be pickled — e.g. ``LambdaTransformer`` or
+    ``Pooler(pixel_function=lambda ...)`` built from a lambda; use a
+    module-level function instead so the checkpoint can be reloaded in a
+    fresh process.
+    """
     leaves, treedef = jax.tree.flatten(node)
+    try:
+        treedef_bytes = pickle.dumps(treedef)
+    except Exception as e:
+        culprits: List[str] = []
+        _unpicklable_statics(node, type(node).__name__, culprits)
+        raise ValueError(
+            "node statics are not picklable, so this node cannot be "
+            f"checkpointed: {', '.join(culprits) or e}. Replace lambdas/"
+            "locally-defined functions with module-level functions."
+        ) from e
+    del treedef_bytes  # validation only; the payload pickles treedef itself
     payload = {
         "magic": _MAGIC,
         "treedef": treedef,

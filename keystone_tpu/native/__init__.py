@@ -1,5 +1,6 @@
 from keystone_tpu.native.ingest import (
     TarImageReader,
+    BucketedImageLoader,
     PrefetchImageLoader,
     decode_jpeg,
     native_available,

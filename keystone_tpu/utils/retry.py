@@ -1,0 +1,109 @@
+"""Retry-on-device-error for pipeline segments.
+
+What Spark gave the reference for free (SURVEY.md §5): lineage-based
+recompute — a lost executor's partitions were rebuilt from their parent RDDs,
+and failed tasks were retried ``spark.task.maxFailures`` times. A
+single-process JAX runtime has no lineage, but the failure mode worth
+covering on real hardware is transient: a preempted/reconnected TPU runtime,
+a tunneled transport hiccup, an OOM that a smaller retry survives after
+buffers are freed. Pipeline nodes are pure functions of their inputs, so
+"recompute the segment" is exactly a retry.
+
+:func:`call_with_device_retries` wraps any callable; :class:`Retry` wraps a
+pipeline node as a host-boundary stage (the segment before it materializes,
+the wrapped node's own bulk path re-runs on failure). Deliberate
+non-features: no cross-host elasticity (a multi-host mesh that loses a host
+must relaunch — JAX collectives cannot re-shard live), no checkpoint
+integration (compose with ``load_or_fit`` for that).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, ClassVar, Tuple, Type, TypeVar
+
+from flax import struct
+
+from keystone_tpu.core.pipeline import Node, Transformer
+from keystone_tpu.utils.logging import get_logger
+
+logger = get_logger("keystone_tpu.retry")
+
+T = TypeVar("T")
+
+
+def _default_retriable() -> Tuple[Type[BaseException], ...]:
+    try:
+        import jaxlib.xla_extension as xe
+
+        return (xe.XlaRuntimeError,)
+    except Exception:  # pragma: no cover - jaxlib always present in practice
+        return (RuntimeError,)
+
+
+def call_with_device_retries(
+    fn: Callable[..., T],
+    *args: Any,
+    retries: int = 2,
+    backoff_s: float = 1.0,
+    retriable: Tuple[Type[BaseException], ...] = (),
+    **kwargs: Any,
+) -> T:
+    """Run ``fn(*args, **kwargs)``, retrying on device/runtime errors.
+
+    ``retries`` is the number of re-attempts after the first failure;
+    ``backoff_s`` doubles per attempt. Non-retriable exceptions propagate
+    immediately.
+
+    Caution: JAX dispatch is asynchronous — a jitted ``fn`` can "return"
+    before the device error surfaces. Materialize inside the retried
+    callable (``jax.block_until_ready``) or the error escapes the retry;
+    :class:`Retry` does this for you.
+    """
+    retriable = retriable or _default_retriable()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retriable as e:
+            if attempt >= retries:
+                raise
+            attempt += 1
+            wait = backoff_s * (2 ** (attempt - 1))
+            logger.warning(
+                "device error (attempt %d/%d), retrying in %.1fs: %s",
+                attempt, retries, wait, e,
+            )
+            time.sleep(wait)
+
+
+class Retry(Transformer):
+    """Pipeline wrapper: re-run the wrapped node's bulk/serve path on device
+    errors. A host-boundary stage (``jittable=False``) so the chain's
+    preceding segment materializes and only the wrapped node re-executes."""
+
+    node: Node
+    retries: int = struct.field(pytree_node=False, default=2)
+    backoff_s: float = struct.field(pytree_node=False, default=1.0)
+
+    jittable: ClassVar[bool] = False
+
+    def apply_batch(self, xs):
+        def run(v):
+            import jax
+
+            return jax.block_until_ready(self.node(v))
+
+        return call_with_device_retries(
+            run, xs, retries=self.retries, backoff_s=self.backoff_s
+        )
+
+    def apply(self, x):
+        def run(v):
+            import jax
+
+            return jax.block_until_ready(self.node.serve(v))
+
+        return call_with_device_retries(
+            run, x, retries=self.retries, backoff_s=self.backoff_s
+        )

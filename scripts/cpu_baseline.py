@@ -32,8 +32,6 @@ import time
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-timit", action="store_true")
-    ap.add_argument("--timit-epochs", type=int, default=2)
-    ap.add_argument("--timit-blocks", type=int, default=10)
     args = ap.parse_args()
 
     import jax
@@ -87,23 +85,24 @@ def main() -> None:
         # epoch-block solver work (gram + cross-terms + solve). Three
         # measurements identify all three; no term is scaled by a factor it
         # does not actually grow with (a flat e·b scaling would inflate the
-        # featurization and eval components).
-        t_1_5 = timed(1, 5)
-        t_1_10 = timed(1, 10)
-        t_2_10 = timed(2, 10)
-        c2 = (t_2_10 - t_1_10) / 10.0
-        c1 = (t_1_10 - t_1_5) / 5.0 - c2
-        c0 = t_1_5 - 5.0 * (c1 + c2)
+        # featurization and eval components). Configs kept small — each
+        # block-epoch is ~3.4e12 solver FLOPs, minutes on one core.
+        t_1_2 = timed(1, 2)
+        t_1_4 = timed(1, 4)
+        t_2_4 = timed(2, 4)
+        c2 = (t_2_4 - t_1_4) / 4.0
+        c1 = (t_1_4 - t_1_2) / 2.0 - c2
+        c0 = t_1_2 - 2.0 * (c1 + c2)
         full = c0 + c1 * full_blocks + c2 * full_epochs * full_blocks
         out["timit_cpu_warm_measured_s"] = {
-            "1ep_5blk": round(t_1_5, 3),
-            "1ep_10blk": round(t_1_10, 3),
-            "2ep_10blk": round(t_2_10, 3),
+            "1ep_2blk": round(t_1_2, 3),
+            "1ep_4blk": round(t_1_4, 3),
+            "2ep_4blk": round(t_2_4, 3),
         }
         out["timit_cpu_warm_extrapolated_s"] = round(full, 1)
         out["timit_extrapolation"] = (
-            "t(e,b) = c0 + c1*b + c2*e*b fitted on (1ep,5blk), (1ep,10blk), "
-            f"(2ep,10blk); c0={c0:.1f}s c1={c1:.2f}s/blk c2={c2:.2f}s/(ep*blk); "
+            "t(e,b) = c0 + c1*b + c2*e*b fitted on (1ep,2blk), (1ep,4blk), "
+            f"(2ep,4blk); c0={c0:.1f}s c1={c1:.2f}s/blk c2={c2:.2f}s/(ep*blk); "
             f"evaluated at {full_epochs}ep*{full_blocks}blk"
         )
 

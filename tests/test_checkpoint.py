@@ -1,6 +1,7 @@
 """Checkpoint/resume tests: fitted nodes round-trip through save/load and
 load_or_fit skips refitting (SURVEY.md §5 rebuild implication)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -122,3 +123,54 @@ def test_profiling_hooks_are_noops_without_dir(rng):
     with trace():  # no env var, no dir: must be free
         with annotate("stage"):
             _ = jnp.sum(jnp.ones(8)).block_until_ready()
+
+
+def test_lambda_statics_fail_loudly(tmp_path):
+    """Nodes carrying lambdas cannot round-trip through pickle; save_node
+    must raise a ValueError naming the culprit, not pickle's opaque error
+    (VERDICT round-1 weak #8)."""
+    from keystone_tpu.core.pipeline import LambdaTransformer
+
+    node = LambdaTransformer(fn=lambda x: x + 1, name="inc")
+    with pytest.raises(ValueError, match="lambda"):
+        save_node(node, str(tmp_path / "bad.ckpt"))
+
+
+def _double(x):
+    return x * 2.0
+
+
+def test_module_level_fn_statics_round_trip(tmp_path):
+    from keystone_tpu.core.pipeline import LambdaTransformer
+
+    node = LambdaTransformer(fn=_double, name="double")
+    p = str(tmp_path / "ok.ckpt")
+    save_node(node, p)
+    back = load_node(p)
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(np.asarray(back(x[None])), np.asarray(node(x[None])))
+
+
+def test_fitted_fisher_pipeline_round_trip(tmp_path, rng):
+    """Whole fitted VOC-style featurizer (SIFT -> PCA -> GMM -> FV chain) +
+    linear model round-trips through one checkpoint and reproduces
+    predictions exactly (VERDICT round-1 item 8)."""
+    from keystone_tpu.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.images import SIFTExtractor
+    from keystone_tpu.pipelines._fisher import fit_fisher_branch
+
+    imgs = jnp.asarray(rng.random((6, 48, 48)).astype(np.float32))
+    featurizer, feats = fit_fisher_branch(
+        SIFTExtractor(scales=2), imgs, pca_dims=8, vocab_size=2,
+        num_pca_samples=2000, num_gmm_samples=2000,
+    )
+    labels = jnp.asarray(np.eye(3, dtype=np.float32)[[0, 1, 2, 0, 1, 2]] * 2 - 1)
+    model = BlockLeastSquaresEstimator(block_size=16, lam=1.0).fit(feats, labels)
+    pipeline = featurizer.then(model)
+
+    p = str(tmp_path / "voc_pipeline.ckpt")
+    save_node(pipeline, p)
+    back = load_node(p)
+    np.testing.assert_allclose(
+        np.asarray(back(imgs)), np.asarray(pipeline(imgs)), atol=1e-6
+    )

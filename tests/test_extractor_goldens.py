@@ -1,0 +1,141 @@
+"""Committed descriptor-statistics goldens for SIFT/HOG/DAISY/LCS on the
+reference's own test photos (VERDICT round-1 item 4).
+
+Tolerance policy, mirroring the reference's (``VLFeatSuite.scala:44-51`` —
+≥99.5% of entries within 1 after 512× quantization against MATLAB
+``vl_phow``): the vl_phow golden CSVs are absent from the reference checkout
+and no vlfeat binary exists in this image, so bitwise parity is unprovable
+here (gap statement in README "Known capability gaps"). What IS pinned,
+exactly: keypoint geometry per scale (integer — must equal ``vl_dsift``'s
+frame counts), total descriptor counts, the quantized-value histogram
+(integer bins, small drift budget for backend rounding), the mass-threshold
+zero fraction, and float summary moments with 1e-3 relative tolerance. If a
+vlfeat golden file appears, ``test_vl_phow_policy_ready`` documents the
+comparison to run.
+
+Regenerate after an intentional extractor change:
+``JAX_PLATFORMS=cpu python scripts/gen_extractor_goldens.py``.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+_RES = "/root/reference/src/test/resources/images"
+_GOLD = os.path.join(os.path.dirname(__file__), "goldens", "extractor_stats.json")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_RES), reason="reference fixture images not mounted"
+)
+
+
+def _gold():
+    with open(_GOLD) as f:
+        return json.load(f)
+
+
+def _gray(name):
+    from PIL import Image
+
+    return np.asarray(
+        Image.open(os.path.join(_RES, name)).convert("L"), np.float32
+    ) / 255.0
+
+
+def _rgb(name):
+    from PIL import Image
+
+    return np.asarray(
+        Image.open(os.path.join(_RES, name)).convert("RGB"), np.float32
+    ) / 255.0
+
+
+@pytest.mark.parametrize("name", ["000012.jpg", "gantrycrane.png"])
+def test_sift_golden_stats(name):
+    from keystone_tpu.ops.images.sift import SIFTExtractor, dsift_geometry
+
+    g = _gold()[name]["sift"]
+    gray = _gray(name)
+    h, w = _gold()[name]["hw"]
+    assert gray.shape == (h, w)
+
+    sift = SIFTExtractor()
+    # keypoint geometry per scale: integer, must match vl_dsift's frame
+    # counts for (step+s, bin+2s, aligned bounds) exactly
+    per_scale = []
+    for s in range(sift.scales):
+        ny, nx = dsift_geometry(
+            w, h,
+            sift.step_size + s * sift.scale_step,
+            sift.bin_size + 2 * s,
+            (1 + 2 * sift.scales) - 3 * s,
+        )
+        per_scale.append(ny * nx)
+    assert per_scale == g["keypoints_per_scale"]
+
+    descs = np.asarray(sift.apply(jnp.asarray(gray)))
+    assert descs.shape == (g["num_descriptors"], 128)
+    assert sum(per_scale) == g["num_descriptors"]
+
+    # quantized-value histogram: integer bins; allow <=0.1% of mass to move
+    # between bins (backend rounding at bin edges)
+    edges = [0, 1, 2, 4, 8, 16, 32, 64, 128, 256]
+    hist = np.histogram(descs, bins=edges)[0]
+    drift = np.abs(hist - np.asarray(g["quant_histogram"])).sum()
+    assert drift <= max(2, descs.size // 1000), (hist.tolist(), g["quant_histogram"])
+
+    zero_frac = float(np.mean(np.all(descs == 0.0, axis=1)))
+    assert zero_frac == pytest.approx(g["zero_descriptor_fraction"], abs=1e-3)
+    assert float(descs.mean()) == pytest.approx(g["mean"], rel=1e-3)
+
+
+@pytest.mark.parametrize("name", ["000012.jpg", "gantrycrane.png"])
+def test_hog_daisy_lcs_golden_stats(name):
+    from keystone_tpu.ops.images.daisy import DaisyExtractor
+    from keystone_tpu.ops.images.hog import HogExtractor
+    from keystone_tpu.ops.images.lcs import LCSExtractor
+
+    g = _gold()[name]
+    gray, rgb = _gray(name), _rgb(name)
+
+    hog = np.asarray(HogExtractor(bin_size=8).apply(jnp.asarray(rgb)))
+    assert list(hog.shape) == g["hog"]["shape"]
+    assert float(hog.mean()) == pytest.approx(g["hog"]["mean"], rel=1e-3)
+    assert float(hog.std()) == pytest.approx(g["hog"]["std"], rel=1e-3)
+    assert float(np.mean(hog == 0.0)) == pytest.approx(
+        g["hog"]["zero_fraction"], abs=1e-3
+    )
+
+    daisy = np.asarray(DaisyExtractor().apply(jnp.asarray(gray)))
+    assert list(daisy.shape) == g["daisy"]["shape"]
+    assert float(daisy.mean()) == pytest.approx(g["daisy"]["mean"], rel=1e-3)
+    assert float(daisy.std()) == pytest.approx(g["daisy"]["std"], rel=1e-3)
+
+    lcs = np.asarray(LCSExtractor(4, 16, 6).apply(jnp.asarray(rgb)))
+    assert list(lcs.shape) == g["lcs"]["shape"]
+    assert float(lcs.mean()) == pytest.approx(g["lcs"]["mean"], rel=1e-3)
+    assert float(lcs.std()) == pytest.approx(g["lcs"]["std"], rel=1e-3)
+
+
+def test_vl_phow_policy_ready():
+    """The reference's tolerance policy, executable the moment a vl_phow
+    golden appears: load (128, N) golden descriptors, extract with
+    SIFTExtractor on the same image, and require >=99.5% of entries within
+    1 after the 512x quantization (VLFeatSuite.scala:44-51). The golden
+    (feats128.csv) is absent from the reference checkout; this test
+    documents + skips rather than silently not existing."""
+    golden = os.path.join(_RES, "feats128.csv")
+    if not os.path.exists(golden):
+        pytest.skip("vl_phow golden (feats128.csv) not in reference checkout")
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+
+    ref = np.loadtxt(golden, delimiter=",")  # (128, N), already 512x-quantized
+    descs = np.asarray(
+        SIFTExtractor().apply(jnp.asarray(_gray("gantrycrane.png")))
+    ).T
+    assert descs.shape == ref.shape
+    within_1 = np.mean(np.abs(descs - ref) <= 1.0)
+    assert within_1 >= 0.995

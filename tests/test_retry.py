@@ -1,0 +1,61 @@
+"""Retry-on-device-error wrapper (SURVEY.md §5 failure-detection bullet:
+what Spark's task retry gave the reference for free, scoped to the
+transient single-process failures a JAX runtime actually sees)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.stats import LinearRectifier
+from keystone_tpu.utils import Retry, call_with_device_retries
+
+
+class _FakeDeviceError(RuntimeError):
+    pass
+
+
+def test_retries_then_succeeds():
+    calls = []
+
+    def flaky(x):
+        calls.append(1)
+        if len(calls) < 3:
+            raise _FakeDeviceError("transport hiccup")
+        return x + 1
+
+    out = call_with_device_retries(
+        flaky, 41, retries=2, backoff_s=0.0, retriable=(_FakeDeviceError,)
+    )
+    assert out == 42 and len(calls) == 3
+
+
+def test_exhausted_retries_raise():
+    def always_fails():
+        raise _FakeDeviceError("down")
+
+    with pytest.raises(_FakeDeviceError):
+        call_with_device_retries(
+            always_fails, retries=1, backoff_s=0.0,
+            retriable=(_FakeDeviceError,),
+        )
+
+
+def test_non_retriable_propagates_immediately():
+    calls = []
+
+    def typo():
+        calls.append(1)
+        raise ValueError("not a device error")
+
+    with pytest.raises(ValueError):
+        call_with_device_retries(typo, retries=5, backoff_s=0.0)
+    assert len(calls) == 1
+
+
+def test_retry_node_wraps_pipeline_stage():
+    node = Retry(node=LinearRectifier(), retries=1)
+    x = jnp.asarray(np.array([[-1.0, 2.0]], np.float32))
+    out = node(x)
+    np.testing.assert_allclose(np.asarray(out), [[0.0, 2.0]])
+    one = node.apply(x[0])
+    np.testing.assert_allclose(np.asarray(one), [0.0, 2.0])

@@ -159,3 +159,71 @@ def test_imagenet_loader_skips_empty_entry_and_non_tars(tmp_path):
         str(tmp_path), str(tmp_path / "labels.txt"), target_hw=(48, 48)
     )
     assert imgs.shape[0] == 2  # both real images survive the empty entry
+
+
+def test_bucketed_loader_mixed_sizes(tmp_path):
+    """Variable-size ingest (VERDICT round-1 item 6): mixed-size JPEGs land
+    in the smallest containing bucket (pad, no crop) or the largest (crop),
+    and per-bucket SIFT descriptor counts match dsift_geometry for the
+    bucket's static shape."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.native import BucketedImageLoader
+    from keystone_tpu.ops.images import GrayScaler
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+
+    rng = np.random.default_rng(7)
+    entries = [
+        ("a/small_0.JPEG", (rng.random((40, 50, 3)) * 255).astype(np.uint8)),
+        ("a/small_1.JPEG", (rng.random((60, 64, 3)) * 255).astype(np.uint8)),
+        ("a/mid_0.JPEG", (rng.random((80, 100, 3)) * 255).astype(np.uint8)),
+        ("a/huge_0.JPEG", (rng.random((200, 260, 3)) * 255).astype(np.uint8)),
+    ]
+    _make_tar(tmp_path / "mixed.tar", entries)
+    loader = BucketedImageLoader(
+        [str(tmp_path / "mixed.tar")], buckets=[(64, 64), (128, 128)],
+        num_threads=2,
+    )
+    sift = SIFTExtractor(scales=2)
+    by_bucket = {}
+    for hw, imgs, names in loader.batches(batch_size=8):
+        assert imgs.shape[1:] == (*hw, 3)
+        by_bucket.setdefault(hw, []).extend(names)
+        gray = GrayScaler()(jnp.asarray(imgs))[..., 0]
+        descs = sift(gray)
+        assert descs.shape[1] == sift.num_descriptors(*hw)  # dsift_geometry
+    # 40x50 and 60x64 fit (64,64); 80x100 fits (128,128); 200x260 crops
+    # into the largest bucket (128,128).
+    small = {n.split("/")[-1] for n in by_bucket[(64, 64)]}
+    big = {n.split("/")[-1] for n in by_bucket[(128, 128)]}
+    assert small == {"small_0.JPEG", "small_1.JPEG"}
+    assert big == {"mid_0.JPEG", "huge_0.JPEG"}
+
+
+def test_bucketed_loader_abandoned_generator_cleans_up(tmp_path):
+    """Early break out of batches() must not leave worker threads blocked on
+    a full queue (decoded images pinned for the process lifetime)."""
+    import threading
+
+    from keystone_tpu.native import BucketedImageLoader
+
+    rng = np.random.default_rng(3)
+    entries = [
+        (f"a/i{k}.JPEG", (rng.random((48, 48, 3)) * 255).astype(np.uint8))
+        for k in range(12)
+    ]
+    _make_tar(tmp_path / "m.tar", entries)
+    before = threading.active_count()
+    loader = BucketedImageLoader([str(tmp_path / "m.tar")], [(64, 64)], num_threads=2)
+    for hw, imgs, names in loader.batches(batch_size=2):
+        break  # abandon the generator mid-stream
+    import gc
+
+    gc.collect()  # finalize the abandoned generator (runs its finally)
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        import time
+
+        time.sleep(0.1)
+        deadline -= 1
+    assert threading.active_count() <= before
