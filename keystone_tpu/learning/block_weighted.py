@@ -72,13 +72,14 @@ def _pop_stats(Xb, R, valid, n_eff, precision: str):
     return pop_mean, pop_cov, pop_xtr
 
 
-@functools.partial(jax.jit, static_argnames=("max_nc", "precision"))
+@functools.partial(jax.jit, static_argnames=("max_nc", "group", "precision"))
 def _class_solves(
     Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
-    residual_mean, model_b, lam, w, class_ids, max_nc: int, precision: str
+    residual_mean, model_b, lam, w, class_ids, max_nc: int, group: int,
+    precision: str
 ):
-    """One scan step per class in ``class_ids``: masked chunk moments + the
-    joint solve (``BlockWeightedLeastSquares.scala:228-263``). Returns ΔW
+    """Per-class joint solves for the classes in ``class_ids``
+    (``BlockWeightedLeastSquares.scala:228-263``). Returns ΔW
     (bs, len(class_ids)).
 
     ``max_nc`` is the static row-chunk that must cover every class in this
@@ -86,12 +87,18 @@ def _class_solves(
     chunk is within 2× of each class's own count — total gram work stays
     O(n·bs²) per block even with a heavy-tailed class distribution (a single
     global chunk would pay O(C·max_c n_c·bs²), ~10× more for 1000-class
-    ImageNet where the largest class is ~10× the mean)."""
+    ImageNet where the largest class is ~10× the mean).
+
+    Classes are processed ``group`` at a time (scan over groups, vmap
+    within): the class grams become one batched MXU matmul and the bs×bs
+    regularized solves one batched Cholesky, instead of C sequential
+    dispatch-bound steps. ``group`` is chosen by the caller to bound the
+    live set (≈ group·(max_nc·bs + 3·bs²) floats)."""
     n, bs = Xb.shape
     num_classes = pop_xtr.shape[1]
     eye = jnp.eye(bs, dtype=Xb.dtype)
 
-    def body(carry, c):
+    def one(c):
         start = offsets[c]
         n_c = counts[c].astype(jnp.float32)
         start_cl = jnp.clip(start, 0, max(n - max_nc, 0)).astype(jnp.int32)
@@ -120,11 +127,19 @@ def _class_solves(
             - joint_means_b[c] * mean_mix
         )
         rhs = joint_xtr - lam * jnp.take(model_b, c, axis=1)
-        dW_c = spd_solve(joint_xtx + lam * eye, rhs)
-        return carry, dW_c
+        return spd_solve(joint_xtx + lam * eye, rhs)
 
-    _, dW = jax.lax.scan(body, None, class_ids)
-    return dW.T  # (bs, len(class_ids))
+    n_ids = class_ids.shape[0]
+    if group <= 1 or n_ids <= 1:
+        _, dW = jax.lax.scan(lambda _, c: (None, one(c)), None, class_ids)
+        return dW.T
+    g = min(group, n_ids)
+    pad = (-n_ids) % g
+    ids = jnp.concatenate([class_ids, jnp.repeat(class_ids[-1:], pad)])
+    _, dW = jax.lax.scan(
+        lambda _, cs: (None, jax.vmap(one)(cs)), None, ids.reshape(-1, g)
+    )
+    return dW.reshape(-1, bs)[:n_ids].T  # (bs, len(class_ids))
 
 
 def _class_buckets(counts_np: np.ndarray, n: int) -> list:
@@ -152,16 +167,25 @@ def _class_buckets(counts_np: np.ndarray, n: int) -> list:
     return buckets, inv_perm
 
 
+def _solve_group(bs: int, max_nc: int) -> int:
+    """Classes per batched solve step: bound the live set (grams + chunk
+    slices + Cholesky workspace ≈ group·(max_nc·bs + 3·bs²) f32) near
+    512 MB — e.g. 2 at the flagship (bs=4096), 16+ for small blocks."""
+    per_class = max_nc * bs + 3 * bs * bs
+    return max(1, min(16, (1 << 27) // max(per_class, 1)))
+
+
 def _bucketed_class_solves(
     Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
     residual_mean, model_b, lam, w, buckets, inv_perm, precision: str
 ):
     """Run :func:`_class_solves` once per size bucket; returns ΔW (bs, C)."""
+    bs = Xb.shape[1]
     parts = [
         _class_solves(
             Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr,
             joint_means_b, residual_mean, model_b, lam, w,
-            ids, max_nc, precision=precision,
+            ids, max_nc, _solve_group(bs, max_nc), precision=precision,
         )
         for max_nc, ids in buckets
     ]

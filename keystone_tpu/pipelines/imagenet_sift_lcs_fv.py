@@ -55,6 +55,11 @@ class ImageNetSiftLcsFVConfig:
     synthetic_test: int = 128
     synthetic_classes: int = 8
     synthetic_hw: int = 96
+    # prototype-noise stddev for the synthetic generator; at the default
+    # (0.08) the classes are cleanly separable, so 0% error is a plumbing
+    # check, not a quality claim — raise it for a non-vacuous error bar
+    # (BASELINE.md's flagship row states the noise used for its numbers)
+    synthetic_noise: float = 0.08
     # Out-of-core (flagship) mode: features re-computed per column block
     # inside the weighted solver instead of materializing the (n, d) matrix
     # (``fit_streaming``; reference regime ImageNetSiftLcsFV.scala:197-218).
@@ -83,14 +88,17 @@ class _SyntheticSource:
     image tensor (e.g. 100k×64²×3 f32 ≈ 4.9 GB) never exists at once. Fixed
     prototype_seed keeps the class structure consistent across chunks."""
 
-    def __init__(self, n: int, num_classes: int, hw, seed: int):
+    def __init__(self, n: int, num_classes: int, hw, seed: int,
+                 noise: float = 0.08):
         self.n, self._classes, self._hw, self._seed = n, num_classes, hw, seed
+        self._noise = noise
 
     def chunk(self, i0: int, i1: int):
         import numpy as np
 
         imgs, labels = synthetic_imagenet_device(
-            i1 - i0, self._classes, self._hw, seed=self._seed * 1000003 + i0
+            i1 - i0, self._classes, self._hw,
+            seed=self._seed * 1000003 + i0, noise=self._noise,
         )
         return imgs, np.asarray(labels)
 
@@ -269,8 +277,10 @@ def run(config: ImageNetSiftLcsFVConfig) -> dict:
         hw = (config.synthetic_hw, config.synthetic_hw)
         return _run_streaming(
             config,
-            _SyntheticSource(config.synthetic_train, config.synthetic_classes, hw, seed=1),
-            _SyntheticSource(config.synthetic_test, config.synthetic_classes, hw, seed=2),
+            _SyntheticSource(config.synthetic_train, config.synthetic_classes,
+                             hw, seed=1, noise=config.synthetic_noise),
+            _SyntheticSource(config.synthetic_test, config.synthetic_classes,
+                             hw, seed=2, noise=config.synthetic_noise),
             config.synthetic_classes,
         )
     if config.train_location:
@@ -281,10 +291,12 @@ def run(config: ImageNetSiftLcsFVConfig) -> dict:
     else:
         hw = (config.synthetic_hw, config.synthetic_hw)
         train = synthetic_imagenet_device(
-            config.synthetic_train, config.synthetic_classes, hw, seed=1
+            config.synthetic_train, config.synthetic_classes, hw, seed=1,
+            noise=config.synthetic_noise,
         )
         test = synthetic_imagenet_device(
-            config.synthetic_test, config.synthetic_classes, hw, seed=2
+            config.synthetic_test, config.synthetic_classes, hw, seed=2,
+            noise=config.synthetic_noise,
         )
         num_classes = config.synthetic_classes
 

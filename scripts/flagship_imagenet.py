@@ -1,0 +1,51 @@
+"""Run the flagship-regime streaming ImageNet config on the TPU, twice in
+one process, and print cold + warm wall-clocks (warm = XLA compile cache
+hot). The BASELINE.md reference-dim row comes from this script.
+
+Usage: ``python scripts/flagship_imagenet.py [--warm] [--train N]``.
+"""
+
+import argparse
+import json
+
+from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+    ImageNetSiftLcsFVConfig,
+    run,
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--warm", action="store_true",
+                    help="run twice; report the second (compile-cached) run")
+    ap.add_argument("--train", type=int, default=102400)
+    ap.add_argument("--test", type=int, default=5120)
+    args = ap.parse_args()
+
+    cfg = ImageNetSiftLcsFVConfig(
+        sift_pca_dim=64,
+        lcs_pca_dim=64,
+        vocab_size=256,
+        num_pca_samples=2000000,
+        num_gmm_samples=2000000,
+        lam=6e-5,
+        mixture_weight=0.25,
+        block_size=4096,
+        synthetic_train=args.train,
+        synthetic_test=args.test,
+        synthetic_classes=1000,
+        synthetic_hw=64,
+        streaming=True,
+        extract_chunk=2048,
+        sample_images=8192,
+        fv_row_chunk=1024,
+    )
+    cold = run(cfg)
+    out = {"cold": cold}
+    if args.warm:
+        out["warm"] = run(cfg)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
