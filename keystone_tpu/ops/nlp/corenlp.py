@@ -9,15 +9,21 @@ That external NLP stack has no place in a TPU framework image, so this node
 reproduces the *pipeline behavior* (token -> lemma -> entity-substituted
 n-grams) with a dependency-free rule engine:
 
-- tokenization: word/number regex;
-- lemmatization: a small English suffix stripper (plural/verb/adverb rules
-  with a common-irregulars table) — intentionally lightweight, not Porter;
-- entity substitution: numbers -> ``<NUM>``, capitalized non-sentence-initial
-  tokens -> ``<ENT>`` (the same role CoreNLP's NER classes play in the
-  reference's features).
+- tokenization: word/number regex with raw-text sentence boundaries;
+- lemmatization: an English suffix stripper with a ~150-form irregular
+  table, doubled-consonant undoubling, and Porter-style ``e`` restoration —
+  intentionally lightweight, still not a tagger-driven lemmatizer;
+- entity substitution: consecutive capitalized mid-sentence tokens merge
+  into ONE typed entity token — ``<PERSON>``/``<LOCATION>``/
+  ``<ORGANIZATION>`` via small gazetteers/suffix cues, ``<ENT>`` otherwise —
+  and numerals become ``<DATE>`` (years, months, weekdays) or ``<NUM>``,
+  mirroring how the reference substitutes CoreNLP's entity-class strings
+  for recognized mentions (``CoreNLPFeatureExtractor.scala:27-41``).
 
-The node is host-side; its output feeds the same TermFrequency /
-CommonSparseFeatures path as the plain tokenizer.
+Still a stand-in, and labeled as such (README "Known capability gaps"): no
+statistical tagging, no coreference, gazetteer-bounded recall. The node is
+host-side; its output feeds the same TermFrequency / CommonSparseFeatures
+path as the plain tokenizer.
 """
 
 from __future__ import annotations
@@ -45,10 +51,58 @@ _IRREGULAR = {
     "does": "do", "did": "do", "done": "do", "doing": "do",
     "went": "go", "gone": "go", "goes": "go",
     "said": "say", "says": "say",
-    "made": "make", "men": "man", "women": "woman", "children": "child",
+    "made": "make", "making": "make",
+    "took": "take", "taken": "take", "taking": "take",
+    "saw": "see", "seen": "see", "got": "get", "gotten": "get",
+    "came": "come", "coming": "come", "knew": "know", "known": "know",
+    "thought": "think", "found": "find", "gave": "give", "given": "give",
+    "giving": "give", "told": "tell", "became": "become", "left": "leave",
+    "felt": "feel", "brought": "bring", "began": "begin", "begun": "begin",
+    "kept": "keep", "held": "hold", "wrote": "write", "written": "write",
+    "writing": "write", "stood": "stand", "heard": "hear", "meant": "mean",
+    "met": "meet", "ran": "run", "running": "run", "paid": "pay",
+    "sat": "sit", "spoke": "speak", "spoken": "speak", "led": "lead",
+    "grew": "grow", "grown": "grow", "lost": "lose", "losing": "lose",
+    "fell": "fall", "fallen": "fall", "sent": "send", "built": "build",
+    "understood": "understand", "drew": "draw", "drawn": "draw",
+    "broke": "break", "broken": "break", "spent": "spend", "rose": "rise",
+    "risen": "rise", "drove": "drive", "driven": "drive", "bought": "buy",
+    "wore": "wear", "worn": "wear", "chose": "choose", "chosen": "choose",
+    "ate": "eat", "eaten": "eat", "won": "win", "taught": "teach",
+    "caught": "catch", "sold": "sell", "fought": "fight", "sought": "seek",
+    "slept": "sleep", "threw": "throw", "thrown": "throw", "shown": "show",
+    "using": "use", "used": "use",
+    "men": "man", "women": "woman", "children": "child",
     "mice": "mouse", "feet": "foot", "teeth": "tooth", "people": "person",
+    "geese": "goose", "oxen": "ox", "lives": "life", "wives": "wife",
+    "knives": "knife", "leaves": "leaf", "selves": "self",
+    "halves": "half", "shelves": "shelf", "wolves": "wolf",
     "better": "good", "best": "good", "worse": "bad", "worst": "bad",
 }
+
+_VOWELS = set("aeiou")
+
+
+def _cvc(stem: str) -> bool:
+    """Porter's *o: consonant-vowel-consonant ending, last not w/x/y —
+    the shape where the base form ends in silent e (mak+e, lov+e)."""
+    if len(stem) < 3:
+        return False
+    c2, v, c1 = stem[-3], stem[-2], stem[-1]
+    return (
+        c1 not in _VOWELS and c1 not in "wxy"
+        and v in _VOWELS
+        and c2 not in _VOWELS
+    )
+
+
+def _strip_participle(w: str, suffix: str) -> str:
+    stem = w[: -len(suffix)]
+    if len(stem) > 2 and stem[-1] == stem[-2] and stem[-1] not in "lsz":
+        return stem[:-1]  # running -> run, stopped -> stop (keep fall, miss)
+    if stem.endswith(("at", "bl", "iz")) or _cvc(stem):
+        return stem + "e"  # locating -> locate, loved -> love, making -> make
+    return stem
 
 
 def lemmatize(word: str) -> str:
@@ -64,18 +118,57 @@ def lemmatize(word: str) -> str:
     if n > 3 and w.endswith("s") and not w.endswith(("ss", "us", "is")):
         return w[:-1]
     if n > 5 and w.endswith("ing"):
-        stem = w[:-3]
-        if len(stem) > 2 and stem[-1] == stem[-2]:  # running -> run
-            stem = stem[:-1]
-        return stem
+        return _strip_participle(w, "ing")
     if n > 4 and w.endswith("ed"):
-        stem = w[:-2]
-        if len(stem) > 2 and stem[-1] == stem[-2]:  # stopped -> stop
-            stem = stem[:-1]
-        return stem
+        return _strip_participle(w, "ed")
     if n > 4 and w.endswith("ly"):
         return w[:-2]
     return w
+
+
+# Gazetteers for typed entity substitution — deliberately small; anything
+# capitalized mid-sentence that matches nothing stays <ENT>.
+_MONTHS = {
+    "january", "february", "march", "april", "may", "june", "july",
+    "august", "september", "october", "november", "december",
+}
+_WEEKDAYS = {
+    "monday", "tuesday", "wednesday", "thursday", "friday", "saturday",
+    "sunday",
+}
+_FIRST_NAMES = {
+    "john", "mary", "james", "robert", "michael", "william", "david",
+    "richard", "joseph", "thomas", "charles", "margaret", "sarah", "karen",
+    "nancy", "lisa", "barbara", "elizabeth", "jennifer", "maria", "susan",
+    "george", "paul", "peter", "mark", "steven", "andrew", "kenneth",
+    "alice", "anna", "emma", "henry", "jack", "samuel", "daniel",
+}
+_LOCATIONS = {
+    "america", "england", "france", "germany", "china", "japan", "india",
+    "russia", "canada", "australia", "brazil", "mexico", "italy", "spain",
+    "egypt", "israel", "turkey", "iran", "iraq", "korea", "vietnam",
+    "london", "paris", "berlin", "moscow", "tokyo", "beijing", "boston",
+    "chicago", "seattle", "houston", "dallas", "atlanta", "denver",
+    "washington", "california", "texas", "florida", "ohio", "virginia",
+    "europe", "asia", "africa", "arctic", "antarctica",
+}
+_ORG_CUES = {
+    "inc", "corp", "ltd", "co", "company", "university", "institute",
+    "college", "bank", "committee", "association", "department", "agency",
+    "council", "bureau", "commission", "ministry", "society", "union",
+}
+
+
+def _entity_type(run: List[str]) -> str:
+    """Type a run of consecutive capitalized tokens (one entity mention)."""
+    lower = [t.lower() for t in run]
+    if any(t in _ORG_CUES for t in lower):
+        return "<ORGANIZATION>"
+    if any(t in _LOCATIONS for t in lower):
+        return "<LOCATION>"
+    if lower[0] in _FIRST_NAMES:
+        return "<PERSON>"
+    return "<ENT>"
 
 
 class CoreNLPFeatureExtractor(Transformer):
@@ -86,22 +179,46 @@ class CoreNLPFeatureExtractor(Transformer):
 
     def apply(self, text: str) -> List[tuple]:
         tokens: List[str] = []
+        cap_run: List[str] = []  # consecutive capitalized tokens = 1 mention
         sentence_start = True
         prev_end = 0
+
+        def flush_run():
+            if cap_run:
+                tokens.append(_entity_type(cap_run))
+                cap_run.clear()
+
         for m in _TOKEN_RE.finditer(text):
             # sentence boundary lives in the raw text between tokens
             # ("bark. The" -> '. ' separates), not in the token itself
-            if any(ch in ".!?" for ch in text[prev_end : m.start()]):
+            gap = text[prev_end : m.start()]
+            # line breaks end sentences/mentions too: headline- and
+            # list-style text carries no terminal punctuation
+            if any(ch in ".!?\n" for ch in gap):
                 sentence_start = True
+            if cap_run and (gap.strip() or "\n" in gap):
+                flush_run()  # punctuation/comma/newline ends a mention
             tok = m.group(0)
+            low = tok.lower()
             if tok[0].isdigit():
-                tokens.append("<NUM>")
+                flush_run()
+                if len(tok) == 4 and tok.isdigit() and 1000 <= int(tok) <= 2999:
+                    tokens.append("<DATE>")  # year
+                else:
+                    tokens.append("<NUM>")
+            elif tok[0].isupper() and (low in _MONTHS or low in _WEEKDAYS):
+                # capitalization required: lowercase 'may'/'march'/'sat' are
+                # (modal/motion/sit) verbs, not dates
+                flush_run()
+                tokens.append("<DATE>")
             elif tok[0].isupper() and not sentence_start:
-                tokens.append("<ENT>")
+                cap_run.append(tok)
             else:
+                flush_run()
                 tokens.append(lemmatize(tok))
             sentence_start = False
             prev_end = m.end()
+        flush_run()
         return _featurizer(tuple(self.orders)).apply(tokens)
 
     def apply_batch(self, texts: Sequence[str]) -> List[List[tuple]]:

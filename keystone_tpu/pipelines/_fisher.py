@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from keystone_tpu.core.pipeline import Chain, Transformer, chain
+from keystone_tpu.core.pipeline import Chain, ChunkedMap, Transformer, chain
 from keystone_tpu.learning.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
 from keystone_tpu.learning.pca import BatchPCATransformer, PCAEstimator
 from keystone_tpu.ops.images.fisher_vector import FisherVector
@@ -53,6 +53,7 @@ def fit_fisher_branch(
     hellinger_first: bool = False,
     pca_file: Optional[str] = None,
     gmm_files: Optional[Tuple[str, str, str]] = None,
+    row_chunks: int = 1,
 ) -> Tuple[Chain, jax.Array]:
     """Fit one descriptor branch; returns (featurizer chain, train features).
 
@@ -60,11 +61,20 @@ def fit_fisher_branch(
     before PCA (the SIFT branch, ``ImageNetSiftLcsFV.scala:52-53``).
     ``pca_file`` / ``gmm_files`` load precomputed artifacts instead of
     fitting (``VOCSIFTFisher.scala:40-64``).
+
+    ``row_chunks > 1`` wraps the extractor and FV stages in
+    :class:`ChunkedMap` so their per-image intermediates (SIFT pyramids, the
+    (n, n_desc, k) FV posteriors) stay bounded — required at reference VOC
+    scale (5k images × 1266 descriptors × vocab 256, where one-shot
+    posteriors alone are ~6.6 GB). The returned featurizer chain carries the
+    same chunking for the eval pass.
     """
     stages = [extractor]
     if hellinger_first:
         stages.append(BatchSignedHellingerMapper())
-    desc_node = chain(*stages)
+    desc_node: Transformer = chain(*stages)
+    if row_chunks > 1:
+        desc_node = ChunkedMap(node=desc_node, num_chunks=row_chunks)
 
     with Timer("fisher.extract_descriptors"):
         descs = desc_node(train_images)  # (n, n_desc, d)
@@ -87,7 +97,9 @@ def fit_fisher_branch(
             gmm_sample = ColumnSampler(num_gmm_samples, seed=seed + 1)(reduced)
             gmm = GaussianMixtureModelEstimator(vocab_size).fit(gmm_sample)
 
-    fisher = fisher_featurizer(gmm)
+    fisher: Transformer = fisher_featurizer(gmm)
+    if row_chunks > 1:
+        fisher = ChunkedMap(node=fisher, num_chunks=row_chunks)
     with Timer("fisher.encode"):
         features = fisher(reduced)  # (n, pca_dims * 2 * vocab_size)
 

@@ -50,6 +50,9 @@ class VOCSIFTFisherConfig:
     synthetic_test: int = 128
     synthetic_classes: int = 8
     synthetic_hw: int = 96
+    # row-chunk the extractor/FV stages (ChunkedMap) — needed at reference
+    # scale (5k imgs × vocab 256) to bound per-image intermediates
+    row_chunks: int = 1
 
 
 def run(config: VOCSIFTFisherConfig) -> dict:
@@ -92,6 +95,7 @@ def run(config: VOCSIFTFisherConfig) -> dict:
             seed=config.seed,
             pca_file=config.pca_file or None,
             gmm_files=gmm_files,
+            row_chunks=config.row_chunks,
         )
 
         labels = ClassLabelIndicatorsFromIntArrayLabels(num_classes)(

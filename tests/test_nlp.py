@@ -230,14 +230,42 @@ class TestCoreNLP:
         assert lemmatize("stopped") == "stop"
         assert lemmatize("children") == "child"
         assert lemmatize("cats") == "cat"
+        # e-restoration (Porter *o / at-bl-iz) and irregulars
+        assert lemmatize("loved") == "love"
+        assert lemmatize("making") == "make"
+        assert lemmatize("locating") == "locate"
+        assert lemmatize("took") == "take"
+        assert lemmatize("wives") == "wife"
+        assert lemmatize("falling") == "fall"  # ll not undoubled
 
     def test_entity_substitution(self):
         ext = CoreNLPFeatureExtractor(orders=(1,))
         grams = ext.apply("The cats saw Paris in 1990.")
         toks = [g[0] for g in grams]
-        assert "<NUM>" in toks and "<ENT>" in toks
+        # typed mentions, like the reference's CoreNLP entity-class strings
+        assert "<DATE>" in toks  # 1990
+        assert "<LOCATION>" in toks  # Paris
         assert "cat" in toks  # lemmatized
         assert toks[0] == "the"  # sentence-initial capital not an entity
+
+    def test_entity_types_and_run_merging(self):
+        ext = CoreNLPFeatureExtractor(orders=(1,))
+        toks = [g[0] for g in ext.apply(
+            "We met John Smith at Acme Corp near Boston on Monday, "
+            "paying 42 dollars."
+        )]
+        assert "<PERSON>" in toks  # John Smith -> one person mention
+        assert "<ORGANIZATION>" in toks  # Acme Corp
+        assert "<LOCATION>" in toks  # Boston
+        assert "<DATE>" in toks  # Monday
+        assert "<NUM>" in toks  # 42
+        # John Smith merged into ONE token, not two
+        assert toks.count("<PERSON>") == 1
+
+    def test_unknown_capitalized_stays_generic_ent(self):
+        ext = CoreNLPFeatureExtractor(orders=(1,))
+        toks = [g[0] for g in ext.apply("We visited Xyzzy yesterday.")]
+        assert "<ENT>" in toks
 
     def test_bigrams(self):
         ext = CoreNLPFeatureExtractor(orders=(1, 2))
@@ -247,6 +275,23 @@ class TestCoreNLP:
     def test_sentence_boundaries_reset_entity_detection(self):
         # 'The' after a period is sentence-initial, not an entity
         ext = CoreNLPFeatureExtractor(orders=(1,))
-        toks = [g[0] for g in ext.apply("Dogs bark. The cat saw Paris. It ran.")]
-        assert toks.count("<ENT>") == 1  # only mid-sentence Paris
+        toks = [g[0] for g in ext.apply("Dogs bark. The cat saw Berlin. It ran.")]
+        assert toks.count("<LOCATION>") == 1  # only mid-sentence Berlin
         assert "the" in toks and "it" in toks
+
+    def test_lowercase_may_is_not_a_date(self):
+        ext = CoreNLPFeatureExtractor(orders=(1,))
+        toks = [g[0] for g in ext.apply("You may go if they march in May.")]
+        assert toks.count("<DATE>") == 1  # only capitalized May
+        assert "may" in toks and "march" in toks
+
+    def test_newline_separates_mentions(self):
+        # a paragraph break must end the 'Mary' mention (no merge with the
+        # next line's leading capital, which becomes sentence-initial)
+        ext = CoreNLPFeatureExtractor(orders=(1,))
+        toks = [g[0] for g in ext.apply("He met Mary\n\nParis is big")]
+        assert "<PERSON>" in toks  # Mary alone, not merged across the break
+        assert "paris" in toks  # next line's first token = sentence-initial
+        # and mid-sentence mentions after a newline still type correctly
+        toks2 = [g[0] for g in ext.apply("He met Mary\nthen saw Paris")]
+        assert "<PERSON>" in toks2 and "<LOCATION>" in toks2
