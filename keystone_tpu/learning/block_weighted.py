@@ -156,13 +156,12 @@ def _class_buckets(counts_np: np.ndarray, n: int) -> list:
     groups: dict = {}
     for c, ch in enumerate(chunks):
         groups.setdefault(int(ch), []).append(c)
+    ordered = sorted(groups.items())
     # Device id arrays + one inverse permutation prepared once per fit: the
     # bucketed solves run in the num_iter×num_blocks hot loop, so per-call
     # host uploads / per-bucket scatters would be pure dispatch overhead.
-    buckets = [
-        (ch, jnp.asarray(ids, jnp.int32)) for ch, ids in sorted(groups.items())
-    ]
-    perm = np.concatenate([ids for _, ids in sorted(groups.items())])
+    buckets = [(ch, jnp.asarray(ids, jnp.int32)) for ch, ids in ordered]
+    perm = np.concatenate([ids for _, ids in ordered])
     inv_perm = jnp.asarray(np.argsort(perm), jnp.int32)
     return buckets, inv_perm
 

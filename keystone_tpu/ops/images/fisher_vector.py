@@ -19,10 +19,13 @@ Output shape per item: (dims, 2·k) — column j<k is the mean-gradient for
 center j, column k+j the variance-gradient — matching the reference's
 ``numDims×(2·numCentroids)`` (``FisherVector.scala:29-33``).
 
-One item = one (n_desc, dims) descriptor matrix; the whole encoding rides
-the shared GMM-moments path (``ops/pallas/moments.py``) — posteriors and
-weighted moments in one MXU-shaped pass, without the (n, k, d) broadcast of
-the naive per-descriptor form.
+One item = one (n_desc, dims) descriptor matrix. Posteriors use the shared
+centered affine log-density (``_affine_params`` from
+``ops/pallas/moments.py``) and the moments are plain MXU matmuls against
+the (n_desc, k) posterior matrix — never the (n, k, d) broadcast of the
+naive per-descriptor form. Dense and sliced/streaming encodings share one
+implementation (:func:`_fv_cols`); the strict no-(n,k)-intermediate Pallas
+kernel remains available for the GMM *fit* path in ``ops/pallas/moments.py``.
 """
 
 from __future__ import annotations
@@ -33,35 +36,20 @@ from flax import struct
 
 from keystone_tpu.core.pipeline import Transformer
 from keystone_tpu.learning.gmm import GaussianMixtureModel
-from keystone_tpu.ops.pallas.moments import (
-    _affine_params,
-    gmm_moments_auto,
-)
+from keystone_tpu.ops.pallas.moments import _affine_params
 
 
 class FisherVector(Transformer):
     gmm: GaussianMixtureModel
 
     def apply(self, descriptors):
-        """(n_desc, d) -> (d, 2k)."""
-        gmm = self.gmm
-        n = descriptors.shape[0]
-        sigma = jnp.sqrt(gmm.variances)  # (k, d)
-
-        qsum, qx, qx2 = gmm_moments_auto(
-            descriptors, gmm.means, gmm.variances, gmm.weights
-        )
-
-        # Σ q (x-μ)/σ = (qx - qsum·μ)/σ
-        grad_mu = (qx - qsum[:, None] * gmm.means) / sigma
-        # Σ q [((x-μ)/σ)² - 1] = (qx2 - 2μ·qx + qsum·μ²)/σ² - qsum
-        grad_sig = (
-            qx2 - 2.0 * gmm.means * qx + qsum[:, None] * gmm.means**2
-        ) / gmm.variances - qsum[:, None]
-
-        fv_mu = grad_mu / (n * jnp.sqrt(gmm.weights)[:, None])
-        fv_sig = grad_sig / (n * jnp.sqrt(2.0 * gmm.weights)[:, None])
-        return jnp.concatenate([fv_mu.T, fv_sig.T], axis=1)  # (d, 2k)
+        """(n_desc, d) -> (d, 2k). Delegates to :func:`_fv_cols` (the full
+        column range) so the dense and sliced/streaming paths share one
+        implementation of the gradient formulas and cannot drift; the
+        autodiff-oracle test therefore covers both."""
+        k, d = self.gmm.means.shape
+        flat = _fv_cols(descriptors, self.gmm, 0, 2 * k)  # column-major
+        return flat.reshape(2 * k, d).T  # (d, 2k)
 
 
 # ---------------------------------------------------------------------------

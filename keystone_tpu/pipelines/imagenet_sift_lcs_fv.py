@@ -12,6 +12,7 @@ import dataclasses
 import json
 
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.core.config import parse_config
 from keystone_tpu.learning.block_weighted import BlockWeightedLeastSquaresEstimator
@@ -78,8 +79,6 @@ class _ArraySource:
         self._imgs, self._labels = imgs, labels
 
     def chunk(self, i0: int, i1: int):
-        import numpy as np
-
         return jnp.asarray(self._imgs[i0:i1]), np.asarray(self._labels[i0:i1])
 
 
@@ -94,8 +93,6 @@ class _SyntheticSource:
         self._noise = noise
 
     def chunk(self, i0: int, i1: int):
-        import numpy as np
-
         imgs, labels = synthetic_imagenet_device(
             i1 - i0, self._classes, self._hw,
             seed=self._seed * 1000003 + i0, noise=self._noise,
@@ -110,7 +107,6 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
     re-featurization. HBM arithmetic in
     ``BlockWeightedLeastSquaresEstimator`` docstring."""
     import jax
-    import numpy as np
 
     from keystone_tpu.learning.block_linear import streaming_predict
     from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator

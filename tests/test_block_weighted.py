@@ -205,3 +205,25 @@ def test_weighted_multiblock_classifies_imbalanced(rng):
     model = est.fit(jnp.asarray(x), jnp.asarray(ind))
     preds = np.asarray(model(jnp.asarray(x))).argmax(1)
     assert (preds == labels).mean() > 0.95
+
+
+def test_weighted_feature_sharded_2d_mesh(rng, devices):
+    """Weighted BCD with the feature matrix sharded over BOTH mesh axes —
+    rows over ``data``, feature columns over ``model`` (the column-sharded
+    alternative to streaming for the flagship dims, SURVEY.md §5): same
+    model as the unsharded fit."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.parallel import make_mesh, use_mesh
+
+    x, labels, ind = _toy(rng, n=160, d=32, balanced=False)
+    est = BlockWeightedLeastSquaresEstimator(8, 2, 0.1, 0.25)
+    m_ref = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    mesh = make_mesh(data=4, model=2)
+    with use_mesh(mesh):
+        xj = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data", "model")))
+        lj = jax.device_put(jnp.asarray(ind), NamedSharding(mesh, P("data", None)))
+        m_sh = est.fit(xj, lj)
+    np.testing.assert_allclose(np.asarray(m_sh.w), np.asarray(m_ref.w), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(m_sh.b), np.asarray(m_ref.b), atol=1e-4)
