@@ -122,24 +122,49 @@ def _fv_cols(descriptors, gmm: GaussianMixtureModel, lo: int, hi: int):
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
+def _row_chunked_map(fn, arrays, chunk: int):
+    """Apply a batch function over a pytree of arrays (shared leading axis n)
+    in row chunks read in place via ``dynamic_slice`` — unlike a pad/reshape
+    chunker, the (multi-GB, resident) inputs are never copied, only sliced.
+    ``chunk <= 0`` or ``n <= chunk`` runs one shot; a ragged tail is one
+    extra call. The single chunking implementation under both the
+    normalized-FV block nodes and :func:`fisher_l1_norms`."""
+    n = jax.tree_util.tree_leaves(arrays)[0].shape[0]
+    if chunk <= 0 or n <= chunk:
+        return fn(arrays)
+    num_full = n // chunk
+
+    def step(i):
+        sl = jax.tree.map(
+            lambda a: jax.lax.dynamic_slice_in_dim(a, i * chunk, chunk, 0),
+            arrays,
+        )
+        return fn(sl)
+
+    out = jax.lax.map(step, jnp.arange(num_full))
+    out = jax.tree.map(
+        lambda o: o.reshape(num_full * chunk, *o.shape[2:]), out
+    )
+    if n % chunk:
+        tail = fn(jax.tree.map(lambda a: a[num_full * chunk :], arrays))
+        out = jax.tree.map(lambda o, t: jnp.concatenate([o, t]), out, tail)
+    return out
+
+
 def fisher_l1_norms(
     descriptors: jax.Array, gmm: GaussianMixtureModel, chunk: int = 512
 ) -> jax.Array:
     """Per-image L1 norm of the raw vectorized FV, computed in row chunks so
-    no more than ``chunk`` full FVs are ever live. Returns (n,), clamped away
-    from zero (the NormalizeRows eps guard, ``Stats.scala:112-124``)."""
+    no more than ``chunk`` full FVs (and their (chunk, n_desc, k) posterior
+    intermediates) are ever live (:func:`_row_chunked_map`; ``chunk <= 0`` =
+    one shot). Returns (n,), clamped away from zero (the NormalizeRows eps
+    guard, ``Stats.scala:112-124``)."""
     k = gmm.means.shape[0]
 
     def one(D):
         return jnp.sum(jnp.abs(_fv_cols(D, gmm, 0, 2 * k)))
 
-    n = descriptors.shape[0]
-    pad = (-n) % chunk
-    padded = (
-        jnp.pad(descriptors, ((0, pad), (0, 0), (0, 0))) if pad else descriptors
-    )
-    chunked = padded.reshape(-1, chunk, *descriptors.shape[1:])
-    l1 = jax.lax.map(jax.vmap(one), chunked).reshape(-1)[:n]
+    l1 = _row_chunked_map(jax.vmap(one), descriptors, chunk)
     return jnp.maximum(l1, 2.2e-16)
 
 
@@ -172,24 +197,11 @@ class FisherVectorSliceNormalized(Transformer):
         return jnp.sign(fv) * jnp.sqrt(jnp.abs(fv) / l1[:, None])
 
     def apply_batch(self, raw):
-        descs = raw[self.key]
-        l1 = raw[self.l1_key]
-        n, ch = descs.shape[0], self.row_chunk
-        if not ch or n <= ch:
-            return self._fv_batch(descs, l1)
-        num_full = n // ch
-
-        def step(i):
-            D = jax.lax.dynamic_slice_in_dim(descs, i * ch, ch, 0)
-            li = jax.lax.dynamic_slice_in_dim(l1, i * ch, ch, 0)
-            return self._fv_batch(D, li)
-
-        out = jax.lax.map(step, jnp.arange(num_full))
-        out = out.reshape(num_full * ch, -1)
-        if n % ch:
-            tail = self._fv_batch(descs[num_full * ch :], l1[num_full * ch :])
-            out = jnp.concatenate([out, tail])
-        return out
+        return _row_chunked_map(
+            lambda dl: self._fv_batch(*dl),
+            (raw[self.key], raw[self.l1_key]),
+            self.row_chunk,
+        )
 
     def apply(self, raw_one):
         return self.apply_batch(jax.tree.map(lambda a: a[None], raw_one))[0]

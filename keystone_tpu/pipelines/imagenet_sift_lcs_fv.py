@@ -211,9 +211,9 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
                 lbl_parts.append(lbls)
             raw = {
                 "sift": red_s,
-                "l1_sift": fisher_l1_norms(red_s, gmm_s),
+                "l1_sift": fisher_l1_norms(red_s, gmm_s, config.fv_row_chunk),
                 "lcs": red_l,
-                "l1_lcs": fisher_l1_norms(red_l, gmm_l),
+                "l1_lcs": fisher_l1_norms(red_l, gmm_l, config.fv_row_chunk),
             }
             return raw, np.concatenate(lbl_parts)
 
