@@ -27,6 +27,10 @@ from keystone_tpu.ops.nlp.stupid_backoff import (
     StupidBackoffModel,
 )
 from keystone_tpu.ops.nlp.corenlp import CoreNLPFeatureExtractor, lemmatize
+from keystone_tpu.ops.nlp.fast_text import (
+    EncodedCommonSparseFeatures,
+    EncodedNGramVectorizer,
+)
 
 __all__ = [
     "Tokenizer",
@@ -48,4 +52,6 @@ __all__ = [
     "StupidBackoffModel",
     "CoreNLPFeatureExtractor",
     "lemmatize",
+    "EncodedCommonSparseFeatures",
+    "EncodedNGramVectorizer",
 ]

@@ -134,3 +134,121 @@ def test_newsgroups_pipeline_end_to_end():
     )
     assert res["test_error"] < 10.0  # synthetic topics are separable
     assert res["macro_f1"] > 0.9
+
+
+class TestFastTextEquivalence:
+    """The fused integer-key path (ops/nlp/fast_text.py) must produce the
+    same features as the reference-shaped tuple chain."""
+
+    def _tuple_chain(self, docs, orders, k):
+        from keystone_tpu.core.pipeline import chain
+        from keystone_tpu.ops.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
+        from keystone_tpu.ops.util.sparse import binary_weight
+
+        feat = chain(
+            Trim(),
+            LowerCase(),
+            Tokenizer("[\\s]+"),
+            NGramsFeaturizer(orders=orders),
+            TermFrequency(fn=binary_weight),
+        )
+        tf = feat(docs)
+        vec = CommonSparseFeatures(k).fit(tf)
+        return feat, vec, vec(tf)
+
+    @staticmethod
+    def _row_sets(batch):
+        """Per-doc {feature-column-fingerprint: weight} with columns identified
+        by their (sorted) per-corpus value pattern, not by id."""
+        dense = np.asarray(batch.to_dense())
+        cols = [tuple(dense[:, j]) for j in range(dense.shape[1])]
+        return sorted(cols)
+
+    def test_matches_tuple_chain_untruncated(self):
+        from keystone_tpu.ops.nlp import EncodedCommonSparseFeatures
+
+        docs, labels, _ = synthetic_newsgroups(120, num_classes=4, seed=7)
+        docs = list(docs) + ["", "   ", "one", "repeat repeat repeat"]
+        orders = (1, 2)
+        _, _, ref_batch = self._tuple_chain(docs, orders, 10**6)
+        vec, fast_batch = EncodedCommonSparseFeatures(
+            orders=orders, num_features=10**6, weight="binary"
+        ).fit_transform(docs)
+        assert fast_batch.num_features == ref_batch.num_features
+        assert self._row_sets(fast_batch) == self._row_sets(ref_batch)
+
+    def test_matches_tuple_chain_on_test_docs_with_oov(self):
+        from keystone_tpu.ops.nlp import EncodedCommonSparseFeatures
+
+        train, _, _ = synthetic_newsgroups(100, num_classes=3, seed=1)
+        test, _, _ = synthetic_newsgroups(30, num_classes=3, seed=2)
+        test = list(test) + ["totally unseen words xyzzy", ""]
+        orders = (1, 2, 3)
+        feat, ref_vec, _ = self._tuple_chain(train, orders, 10**6)
+        fast_vec = EncodedCommonSparseFeatures(
+            orders=orders, num_features=10**6, weight="binary"
+        ).fit(train)
+        ref_batch = ref_vec(feat(test))
+        fast_batch = fast_vec(test)
+        assert self._row_sets(fast_batch) == self._row_sets(ref_batch)
+
+    def test_topk_truncation_totals_match(self):
+        from keystone_tpu.ops.nlp import EncodedCommonSparseFeatures
+
+        docs, _, _ = synthetic_newsgroups(80, num_classes=4, seed=3)
+        k = 50
+        _, ref_vec, ref_batch = self._tuple_chain(docs, (1, 2), k)
+        _, fast_batch = EncodedCommonSparseFeatures(
+            orders=(1, 2), num_features=k, weight="binary"
+        ).fit_transform(docs)
+        assert fast_batch.num_features == ref_batch.num_features == k
+        # selected features' doc-frequency multisets agree (ties at the cut
+        # may pick different-but-equal-count terms)
+        ref_tot = sorted(np.asarray(ref_batch.to_dense()).sum(0))
+        fast_tot = sorted(np.asarray(fast_batch.to_dense()).sum(0))
+        np.testing.assert_allclose(fast_tot, ref_tot)
+
+    def test_count_weighting(self):
+        from keystone_tpu.ops.nlp import EncodedCommonSparseFeatures
+
+        docs = ["a a a b", "a b b", "c"]
+        vec, batch = EncodedCommonSparseFeatures(
+            orders=(1,), num_features=100, weight="count"
+        ).fit_transform(docs)
+        dense = np.asarray(batch.to_dense())
+        # totals: a=4, b=3, c=1 -> ids 0,1,2 by descending total
+        np.testing.assert_allclose(dense[:, 0], [3.0, 1.0, 0.0])  # 'a'
+        np.testing.assert_allclose(dense[:, 1], [1.0, 2.0, 0.0])  # 'b'
+        np.testing.assert_allclose(dense[:, 2], [0.0, 0.0, 1.0])  # 'c'
+
+    def test_pipeline_both_paths_agree(self):
+        # common_features above the distinct-n-gram count: no truncation cut,
+        # so both paths select identical feature sets and the comparison is
+        # tie-free (at a truncating cut the two paths break count ties among
+        # different-but-equal-frequency n-grams, legitimately).
+        cfg = dict(
+            synthetic_train=200,
+            synthetic_test=60,
+            synthetic_classes=4,
+            common_features=10**6,
+        )
+        fast = run(NewsgroupsConfig(fast_host_path=True, **cfg))
+        slow = run(NewsgroupsConfig(fast_host_path=False, **cfg))
+        assert fast["test_error"] == slow["test_error"]
+        assert fast["train_error"] == slow["train_error"]
+
+    def test_overflow_guard(self):
+        from keystone_tpu.ops.nlp.fast_text import _ngram_keys
+
+        ids = np.arange(10, dtype=np.int64)
+        doc_of = np.zeros(10, np.int64)
+        with pytest.raises(OverflowError):
+            _ngram_keys(ids, doc_of, (1, 2, 3, 4, 5, 6, 7), base=2**10)
+
+    def test_empty_docs_batch(self):
+        from keystone_tpu.ops.nlp import EncodedCommonSparseFeatures
+
+        vec = EncodedCommonSparseFeatures(orders=(1, 2)).fit(["a b", "b c"])
+        batch = vec([])
+        assert batch.indices.shape[0] == 0
+        assert batch.num_features == vec.num_features
