@@ -16,7 +16,8 @@ exists for the solver (HBM tiling of the gram loop) and for the streaming
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional, Sequence, Union
+import inspect
+from typing import Callable, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -238,35 +239,81 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         )
 
 
+def grouped_block_getter(
+    feature_nodes: Sequence[Transformer], raw, cache_dtype=None
+) -> Tuple[Callable[[int], jax.Array], Callable[[], None]]:
+    """Featurize streaming blocks with one-slot cache-group sharing.
+
+    Nodes may declare a ``cache_group`` (hashable; see
+    ``FisherVectorSliceNormalized.group_lo``) plus ``group_node()`` /
+    ``slice_cached()``: consecutive blocks of the same group are then served
+    as slices of one group-wide featurization — computed once, held in
+    ``cache_dtype`` (None = the node's output dtype; the dtype is pushed into
+    ``group_node(out_dtype)`` when supported, so the group buffer is emitted
+    directly in it) until a block of a *different* group is requested (one
+    slot: peak extra HBM = one group's (n, group_width) output). Nodes
+    without ``cache_group`` run directly.
+
+    Returns ``(get(b) -> features, clear())``.
+    """
+    cache: dict = {}
+
+    def get(b: int):
+        node = feature_nodes[b]
+        group = getattr(node, "cache_group", None)
+        if group is None:
+            return node.apply_batch(raw)
+        if cache.get("group") != group:
+            # evict BEFORE computing: the slot must never hold two multi-GB
+            # group buffers at once (the documented one-slot HBM budget)
+            cache.pop("group", None)
+            cache.pop("val", None)
+            if "out_dtype" in inspect.signature(node.group_node).parameters:
+                val = node.group_node(out_dtype=cache_dtype).apply_batch(raw)
+            else:
+                val = node.group_node().apply_batch(raw)
+            if cache_dtype is not None:
+                val = jnp.asarray(val, cache_dtype)
+            cache["group"], cache["val"] = group, val
+        return node.slice_cached(cache["val"])
+
+    return get, cache.clear
+
+
 def streaming_apply_and_evaluate(
     model: BlockLinearMapper,
     feature_nodes: Sequence[Transformer],
     raw,
     evaluator: Callable[[jax.Array], None],
+    cache_dtype=None,
 ) -> None:
     """Out-of-core analog of :meth:`BlockLinearMapper.apply_and_evaluate`:
     featurize block k from ``raw`` (any pytree the nodes understand — see
     ``BlockWeightedLeastSquaresEstimator.fit_streaming``), add its
     contribution, hand the running prediction to ``evaluator``
     (``BlockLinearMapper.scala:104-137``). ``feature_means=None`` models
-    (the weighted solver's) skip centering."""
+    (the weighted solver's) skip centering. Cache-grouped nodes (see
+    :func:`grouped_block_getter`) share their group featurization."""
     bs = model.block_size
+    get_block, clear = grouped_block_getter(feature_nodes, raw, cache_dtype)
     partial = None
     for k, node in enumerate(feature_nodes):
         wk = model.w[k * bs : (k + 1) * bs]
         if model.feature_means is None:
-            contrib = node.apply_batch(raw) @ wk
+            contrib = jnp.asarray(get_block(k), jnp.float32) @ wk
         else:
             fm = model.feature_means[k * bs : (k + 1) * bs]
             contrib = _streaming_contrib(node, raw, wk, fm)
         partial = contrib if partial is None else partial + contrib
         evaluator(partial + model.b if model.b is not None else partial)
+    clear()
 
 
 def streaming_predict(
     model: BlockLinearMapper,
     feature_nodes: Sequence[Transformer],
     raw,
+    cache_dtype=None,
 ) -> jax.Array:
     """Final predictions via :func:`streaming_apply_and_evaluate` (one shared
     accumulation loop) — the out-of-core apply path for models whose feature
@@ -276,5 +323,5 @@ def streaming_predict(
     def capture(p):
         out[:] = [p]
 
-    streaming_apply_and_evaluate(model, feature_nodes, raw, capture)
+    streaming_apply_and_evaluate(model, feature_nodes, raw, capture, cache_dtype)
     return out[0]

@@ -38,25 +38,28 @@ from keystone_tpu.linalg.solvers import hdot, spd_solve
 
 @functools.partial(jax.jit, static_argnames=("num_classes",))
 def _prepare(labels_pm1, mask, num_classes: int):
-    """Sort rows by class; masked rows get a sentinel class sorted last."""
+    """Per-row class ids (masked rows get a sentinel id = num_classes),
+    per-class counts, and the row-validity mask. Rows are NEVER globally
+    sorted: every per-class statistic is either a ``segment_sum`` (order-
+    agnostic) or a per-class row-index gather (``_class_buckets``) — at the
+    flagship config a class sort of the raw descriptors or of each feature
+    block is a multi-GB gather (plus XLA layout copies) that does not fit
+    next to the solver state on a 16 GB chip."""
     class_idx = jnp.argmax(labels_pm1, axis=1)
     if mask is not None:
         class_idx = jnp.where(mask > 0, class_idx, num_classes)
-    order = jnp.argsort(class_idx)
-    cls_sorted = class_idx[order]
-    counts = jnp.bincount(cls_sorted, length=num_classes)  # sentinel dropped
-    offsets = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
-    valid = (cls_sorted < num_classes).astype(jnp.float32)
-    return order, cls_sorted, counts, offsets, valid
+    counts = jnp.bincount(class_idx, length=num_classes)  # sentinel dropped
+    valid = (class_idx < num_classes).astype(jnp.float32)
+    return class_idx, counts, valid
 
 
 @jax.jit
-def _class_col_means(R, cls_sorted, counts):
+def _class_col_means(R, class_idx, counts):
     """Per-class column means of the residual, then the mean over classes —
     the reference's residualMean (``:161-165,283-287``). The class count is
     ``R.shape[1]``: labels are class-indicator columns."""
     c = R.shape[1]
-    sums = jax.ops.segment_sum(R, cls_sorted, num_segments=c + 1)[:c]
+    sums = jax.ops.segment_sum(R, class_idx, num_segments=c + 1)[:c]
     per_class = sums / jnp.maximum(counts[:, None].astype(jnp.float32), 1.0)
     return per_class, jnp.sum(per_class, axis=0) / c
 
@@ -64,8 +67,10 @@ def _class_col_means(R, cls_sorted, counts):
 @functools.partial(jax.jit, static_argnames=("precision",))
 def _pop_stats(Xb, R, valid, n_eff, precision: str):
     """Population mean / covariance / XᵀR for one block (pass 0,
-    ``:190-212``). Row-sharded matmuls -> ICI all-reduce."""
-    Xv = Xb * valid[:, None]
+    ``:190-212``). Row-sharded matmuls -> ICI all-reduce. ``Xb`` may arrive
+    bf16 (the streaming group cache); the f32 upcast lives only inside this
+    program."""
+    Xv = Xb.astype(jnp.float32) * valid[:, None]
     pop_mean = jnp.sum(Xv, axis=0) / n_eff
     pop_cov = hdot(Xv.T, Xv, precision) / n_eff - jnp.outer(pop_mean, pop_mean)
     pop_xtr = hdot(Xv.T, R, precision) / n_eff
@@ -74,13 +79,15 @@ def _pop_stats(Xb, R, valid, n_eff, precision: str):
 
 @functools.partial(jax.jit, static_argnames=("max_nc", "group", "precision"))
 def _class_solves(
-    Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
-    residual_mean, model_b, lam, w, class_ids, max_nc: int, group: int,
-    precision: str
+    Xb, R, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
+    residual_mean, model_b, lam, w, class_ids, class_rows, max_nc: int,
+    group: int, precision: str
 ):
     """Per-class joint solves for the classes in ``class_ids``
     (``BlockWeightedLeastSquares.scala:228-263``). Returns ΔW
-    (bs, len(class_ids)).
+    (bs, len(class_ids)). ``class_rows`` is the (len(class_ids), max_nc)
+    row-index matrix from ``_class_buckets`` — each class's rows are
+    gathered by index, so neither ``Xb`` nor ``R`` needs class-sorted rows.
 
     ``max_nc`` is the static row-chunk that must cover every class in this
     call; callers bucket classes by size (:func:`_class_buckets`) so the
@@ -95,23 +102,23 @@ def _class_solves(
     dispatch-bound steps. ``group`` is chosen by the caller to bound the
     live set (≈ group·(max_nc·bs + 3·bs²) floats)."""
     n, bs = Xb.shape
+    Xb = Xb.astype(jnp.float32)  # bf16 streaming blocks upcast in-program
     num_classes = pop_xtr.shape[1]
     eye = jnp.eye(bs, dtype=Xb.dtype)
 
-    def one(c):
-        start = offsets[c]
+    def one(c, rows):
         n_c = counts[c].astype(jnp.float32)
-        start_cl = jnp.clip(start, 0, max(n - max_nc, 0)).astype(jnp.int32)
-        Xc = jax.lax.dynamic_slice(Xb, (start_cl, 0), (max_nc, bs))
-        Rc = jax.lax.dynamic_slice(R, (start_cl, 0), (max_nc, num_classes))
-        rows = jnp.arange(max_nc) + start_cl
-        m = ((rows >= start) & (rows < start + counts[c])).astype(Xb.dtype)
+        Xc = jnp.take(Xb, rows, axis=0)  # (max_nc, bs)
+        # only column c of the residual is needed — a (max_nc,) gather, vs
+        # the (max_nc, C) slice the sorted layout used to take
+        res_local = jnp.take(jnp.take(R, c, axis=1), rows)
+        m = (jnp.arange(max_nc) < counts[c]).astype(Xb.dtype)
         nc = jnp.maximum(n_c, 1.0)
+        res_local = res_local * m
 
         class_mean = jnp.sum(Xc * m[:, None], axis=0) / nc
         Xzm = (Xc - class_mean) * m[:, None]
         class_cov = hdot(Xzm.T, Xzm, precision) / nc
-        res_local = jnp.take(Rc, c, axis=1) * m
         class_xtr = hdot((Xc * m[:, None]).T, res_local, precision) / nc
 
         mean_diff = class_mean - pop_mean
@@ -131,36 +138,61 @@ def _class_solves(
 
     n_ids = class_ids.shape[0]
     if group <= 1 or n_ids <= 1:
-        _, dW = jax.lax.scan(lambda _, c: (None, one(c)), None, class_ids)
+        _, dW = jax.lax.scan(
+            lambda _, cr: (None, one(*cr)), None, (class_ids, class_rows)
+        )
         return dW.T
     g = min(group, n_ids)
     pad = (-n_ids) % g
     ids = jnp.concatenate([class_ids, jnp.repeat(class_ids[-1:], pad)])
+    rows_p = jnp.concatenate(
+        [class_rows, jnp.repeat(class_rows[-1:], pad, axis=0)]
+    )
     _, dW = jax.lax.scan(
-        lambda _, cs: (None, jax.vmap(one)(cs)), None, ids.reshape(-1, g)
+        lambda _, cr: (None, jax.vmap(one)(*cr)),
+        None,
+        (ids.reshape(-1, g), rows_p.reshape(-1, g, max_nc)),
     )
     return dW.reshape(-1, bs)[:n_ids].T  # (bs, len(class_ids))
 
 
-def _class_buckets(counts_np: np.ndarray, n: int) -> list:
-    """Group classes into buckets sharing a static row-chunk size.
+def _class_buckets(counts_np: np.ndarray, class_idx_np: np.ndarray) -> list:
+    """Group classes into buckets sharing a static row-chunk size, each with
+    its per-class row-index matrix.
 
     Chunk = class count rounded up to the next power of two (min 8, capped
     at n); classes with equal chunks share one ``lax.scan``. At most
     log2(n) compiled variants; per-bucket work is within 2× of the exact
     Σ n_c·bs² — the TPU answer to the reference's one-partition-per-class
     layout (``BlockWeightedLeastSquares.scala:324-361``), where each
-    executor's gram was exactly its class's rows."""
+    executor's gram was exactly its class's rows. Bucket entries are
+    ``(chunk, class_ids, class_rows)`` with ``class_rows`` the (len(ids),
+    chunk) int32 matrix of each class's row positions (padded entries are
+    masked out by the solve's ``arange < count`` mask) — row indices instead
+    of a global class sort, which at flagship scale is a multi-GB gather."""
+    n = len(class_idx_np)
     chunks = np.maximum(8, 2 ** np.ceil(np.log2(np.maximum(counts_np, 1))))
     chunks = np.minimum(chunks.astype(np.int64), max(n, 1))
+    num_classes = len(counts_np)
+    sorted_rows = np.argsort(class_idx_np, kind="stable")
+    offsets = np.concatenate([[0], np.cumsum(counts_np)]).astype(np.int64)
     groups: dict = {}
     for c, ch in enumerate(chunks):
         groups.setdefault(int(ch), []).append(c)
     ordered = sorted(groups.items())
-    # Device id arrays + one inverse permutation prepared once per fit: the
-    # bucketed solves run in the num_iter×num_blocks hot loop, so per-call
-    # host uploads / per-bucket scatters would be pure dispatch overhead.
-    buckets = [(ch, jnp.asarray(ids, jnp.int32)) for ch, ids in ordered]
+    # Device id/row arrays + one inverse permutation prepared once per fit:
+    # the bucketed solves run in the num_iter×num_blocks hot loop, so
+    # per-call host uploads / per-bucket scatters would be pure dispatch
+    # overhead.
+    buckets = []
+    for ch, ids in ordered:
+        rows = np.zeros((len(ids), ch), np.int32)
+        for i, c in enumerate(ids):
+            r = sorted_rows[offsets[c] : offsets[c] + counts_np[c]]
+            rows[i, : len(r)] = r
+        buckets.append(
+            (ch, jnp.asarray(ids, jnp.int32), jnp.asarray(rows, jnp.int32))
+        )
     perm = np.concatenate([ids for _, ids in ordered])
     inv_perm = jnp.asarray(np.argsort(perm), jnp.int32)
     return buckets, inv_perm
@@ -175,25 +207,34 @@ def _solve_group(bs: int, max_nc: int) -> int:
 
 
 def _bucketed_class_solves(
-    Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
+    Xb, R, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
     residual_mean, model_b, lam, w, buckets, inv_perm, precision: str
 ):
     """Run :func:`_class_solves` once per size bucket; returns ΔW (bs, C)."""
     bs = Xb.shape[1]
     parts = [
         _class_solves(
-            Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr,
+            Xb, R, counts, pop_cov, pop_mean, pop_xtr,
             joint_means_b, residual_mean, model_b, lam, w,
-            ids, max_nc, _solve_group(bs, max_nc), precision=precision,
+            ids, rows, max_nc, _solve_group(bs, max_nc), precision=precision,
         )
-        for max_nc, ids in buckets
+        for max_nc, ids, rows in buckets
     ]
     return jnp.concatenate(parts, axis=1)[:, inv_perm]
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))
 def _apply_update(R, Xb, dW, valid, precision: str):
-    return R - hdot(Xb * valid[:, None], dW, precision)
+    return R - hdot(Xb.astype(jnp.float32) * valid[:, None], dW, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def _class_sums(Xb, cls_sorted, num_classes: int):
+    """f32 per-class column sums; padded rows land in the dropped sentinel
+    segment (``_prepare``). The upcast stays inside the program."""
+    return jax.ops.segment_sum(
+        Xb.astype(jnp.float32), cls_sorted, num_segments=num_classes + 1
+    )[:num_classes]
 
 
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
@@ -234,28 +275,29 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         self.cache_stats = cache_stats
 
     def _run(self, get_block, num_blocks: int, labels, mask, precision: str):
-        """Shared weighted-BCD loop. ``get_block(b, order)`` returns the
-        class-sorted (n, block_size) feature block."""
+        """Shared weighted-BCD loop. ``get_block(b)`` returns the
+        (n, block_size) feature block in original row order — no global
+        class sort exists anywhere (see ``_prepare``)."""
         labels = jnp.asarray(labels, jnp.float32)
         num_classes = labels.shape[1]
         w = jnp.float32(self.mixture_weight)
         lam = jnp.float32(self.lam)
 
-        order, cls_sorted, counts, offsets, valid = _prepare(labels, mask, num_classes)
-        n = labels.shape[0]
-        Ls = labels[order]
+        class_idx, counts, valid = _prepare(labels, mask, num_classes)
         n_eff = jnp.sum(counts).astype(jnp.float32)
 
         # jointLabelMean[c] = 2w + 2(1-w)·n_c/n − 1  (``:148-150``)
         joint_label_mean = (
             2.0 * w + 2.0 * (1.0 - w) * counts.astype(jnp.float32) / n_eff - 1.0
         )
-        R = (Ls - joint_label_mean) * valid[:, None]
-        _, residual_mean = _class_col_means(R, cls_sorted, counts)
+        R = (labels - joint_label_mean) * valid[:, None]
+        _, residual_mean = _class_col_means(R, class_idx, counts)
 
-        # One host sync of the C class counts; buckets give static chunk
-        # sizes within 2× of each class's rows (see _class_buckets).
-        buckets, inv_perm = _class_buckets(np.asarray(counts), n)
+        # One host sync of the class counts + row ids; buckets give static
+        # chunk sizes within 2× of each class's rows (see _class_buckets).
+        buckets, inv_perm = _class_buckets(
+            np.asarray(counts), np.asarray(class_idx)
+        )
 
         models = [
             jnp.zeros((self.block_size, num_classes), jnp.float32)
@@ -266,15 +308,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         for _ in range(self.num_iter):
             for b in range(num_blocks):
-                Xb = get_block(b, order)
+                Xb = get_block(b)
                 if pop_stats_cache[b] is None:
                     pop_mean, pop_cov, pop_xtr = _pop_stats(
                         Xb, R, valid, n_eff, precision=precision
                     )
                     # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)
-                    class_sums = jax.ops.segment_sum(
-                        Xb * valid[:, None], cls_sorted, num_segments=num_classes + 1
-                    )[:num_classes]
+                    class_sums = _class_sums(Xb, class_idx, num_classes)
                     class_means = class_sums / jnp.maximum(
                         counts[:, None].astype(jnp.float32), 1.0
                     )
@@ -285,16 +325,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 else:
                     pop_mean, pop_cov = pop_stats_cache[b]
                     joint_means_b = joint_means_blocks[b]
-                    pop_xtr = hdot((Xb * valid[:, None]).T, R, precision) / n_eff
+                    pop_xtr = hdot(
+                        (Xb.astype(jnp.float32) * valid[:, None]).T, R, precision
+                    ) / n_eff
 
                 dW = _bucketed_class_solves(
-                    Xb, R, offsets, counts, pop_cov, pop_mean, pop_xtr,
+                    Xb, R, counts, pop_cov, pop_mean, pop_xtr,
                     joint_means_b, residual_mean, models[b], lam, w, buckets,
                     inv_perm, precision=precision,
                 )
                 models[b] = models[b] + dW
                 R = _apply_update(R, Xb, dW, valid, precision=precision)
-                _, residual_mean = _class_col_means(R, cls_sorted, counts)
+                _, residual_mean = _class_col_means(R, class_idx, counts)
 
         W = jnp.concatenate(models, axis=0)
         joint_means = jnp.concatenate(joint_means_blocks, axis=1)  # (C, d_pad)
@@ -318,13 +360,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             data = jnp.pad(data, ((0, 0), (0, d_pad - d)))
         num_blocks = d_pad // self.block_size
 
-        Xs_box: list = []  # sort once, on first block access
-
-        def get_block(b, order):
-            if not Xs_box:
-                Xs_box.append(data[order])
+        def get_block(b):
             return jax.lax.dynamic_slice_in_dim(
-                Xs_box[0], b * self.block_size, self.block_size, 1
+                data, b * self.block_size, self.block_size, 1
             )
 
         W, joint_means, joint_label_mean = self._run(
@@ -343,6 +381,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         raw,
         labels,
         mask: Optional[jax.Array] = None,
+        cache_dtype=None,
     ) -> BlockLinearMapper:
         """Out-of-core weighted fit: block ``b``'s features are recomputed as
         ``feature_nodes[b].apply_batch(raw)`` inside the solver loop, so the
@@ -353,16 +392,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         of per-branch descriptor tensors + per-branch normalization scalars);
         every node must emit exactly ``block_size`` features.
 
-        The class-contiguous row layout the per-class solves need — the
-        analog of the reference's ``groupByClasses`` shuffle
-        (``BlockWeightedLeastSquares.scala:324-361``) — is applied to each
-        *featurized block* (an (n, block_size) f32 gather), never to ``raw``
-        itself: sorting the flagship descriptor tensors would transiently
-        double their ~6 GB footprint, which is what OOMs a v5e chip; the
-        per-block gather is 25× smaller and costs only bandwidth.
+        The class-contiguous layout the reference builds with its
+        ``groupByClasses`` shuffle (``BlockWeightedLeastSquares.scala:324-361``)
+        is not materialized at all here: the per-class solves gather their
+        rows by index (``_class_buckets``) and every other statistic is a
+        ``segment_sum`` — no multi-GB row sort of raw descriptors or feature
+        blocks ever runs (either one OOMs a 16 GB chip at the flagship
+        config next to the solver state).
         """
         from keystone_tpu.core.dataset import Dataset as _DS
         from keystone_tpu.linalg.solvers import get_solver_precision
+
+        from keystone_tpu.learning.block_linear import grouped_block_getter
 
         if isinstance(raw, _DS):
             raw, mask = raw.data, raw.mask if mask is None else mask
@@ -370,19 +411,28 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             labels = labels.data
         precision = get_solver_precision()
         num_blocks = len(feature_nodes)
-
-        def get_block(b, order):
-            Xb = feature_nodes[b].apply_batch(raw)
+        # Cache-grouped nodes (FisherVectorSliceNormalized.group_lo) share one
+        # group featurization across consecutive blocks — the posterior work
+        # is column-independent, so per-block recompute wastes a factor of
+        # the group size. ``cache_dtype`` bounds the resident group buffer
+        # (bf16 halves it; the flagship pipeline's descriptors are bf16
+        # already, so the features carry that precision regardless).
+        get_cached, clear_cache = grouped_block_getter(
+            feature_nodes, raw, cache_dtype
+        )
+        def get_block(b):
+            Xb = get_cached(b)
             if Xb.shape[1] != self.block_size:
                 raise ValueError(
                     f"feature node {b} emitted {Xb.shape[1]} features, "
                     f"expected block_size={self.block_size}"
                 )
-            return jnp.asarray(Xb, jnp.float32)[order]
+            return Xb
 
         W, joint_means, joint_label_mean = self._run(
             get_block, num_blocks, labels, mask, precision
         )
+        clear_cache()
         final_b = joint_label_mean - jnp.einsum("cd,dc->c", joint_means, W)
         return BlockLinearMapper(
             w=W, b=final_b, feature_means=None, block_size=self.block_size

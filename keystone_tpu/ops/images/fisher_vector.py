@@ -189,12 +189,51 @@ class FisherVectorSliceNormalized(Transformer):
     # unlike a generic pad/reshape chunker (ChunkedMap), the multi-GB
     # descriptor tensor is never copied.
     row_chunk: int = struct.field(pytree_node=False, default=0)
+    # Cache-group column range [group_lo, group_hi) ⊇ [col_lo, col_hi).
+    # The per-block FV cost is posterior-dominated and the posteriors are
+    # column-independent (measured: a 512-column FV costs the same as a
+    # 64-column one), so recomputing them per block wastes a factor of
+    # (#blocks in group). A streaming consumer (fit_streaming /
+    # streaming_apply_and_evaluate) that sees ``cache_group`` computes
+    # ``group_node()`` once and serves each block via ``slice_cached``.
+    # group_hi == 0 disables grouping.
+    group_lo: int = struct.field(pytree_node=False, default=0)
+    group_hi: int = struct.field(pytree_node=False, default=0)
+    # Output dtype of apply_batch ("float32" default). A group node emitting
+    # its multi-GB (n, group_width) buffer casts each row chunk inside the
+    # chunk loop, so no full-width f32 intermediate ever exists.
+    out_dtype: str = struct.field(pytree_node=False, default="float32")
+
+    @property
+    def cache_group(self):
+        """Hashable group id, or None when grouping is disabled / pointless."""
+        if self.group_hi <= self.group_lo or (
+            self.col_lo == self.group_lo and self.col_hi == self.group_hi
+        ):
+            return None
+        return (self.key, self.l1_key, self.group_lo, self.group_hi)
+
+    def group_node(self, out_dtype=None) -> "FisherVectorSliceNormalized":
+        """The node computing the whole group's columns in one pass."""
+        return self.replace(
+            col_lo=self.group_lo, col_hi=self.group_hi, group_lo=0, group_hi=0,
+            out_dtype=str(jnp.dtype(out_dtype)) if out_dtype is not None
+            else self.out_dtype,
+        )
+
+    def slice_cached(self, group_out):
+        """This block's features out of ``group_node()``'s output."""
+        d = self.gmm.means.shape[1]
+        lo = (self.col_lo - self.group_lo) * d
+        hi = (self.col_hi - self.group_lo) * d
+        return group_out[:, lo:hi]
 
     def _fv_batch(self, descs, l1):
         fv = jax.vmap(
             lambda D: _fv_cols(D, self.gmm, self.col_lo, self.col_hi)
         )(descs)
-        return jnp.sign(fv) * jnp.sqrt(jnp.abs(fv) / l1[:, None])
+        out = jnp.sign(fv) * jnp.sqrt(jnp.abs(fv) / l1[:, None])
+        return out.astype(jnp.dtype(self.out_dtype))
 
     def apply_batch(self, raw):
         return _row_chunked_map(
@@ -213,10 +252,17 @@ def make_fisher_block_nodes(
     key: str = "descs",
     l1_key: str = "l1",
     row_chunk: int = 0,
+    cache_blocks: int = 0,
 ) -> list:
     """Split one branch's d·2k normalized Fisher features into
     ``block_size``-wide :class:`FisherVectorSliceNormalized` nodes
-    (``block_size`` must be a multiple of the descriptor dim d)."""
+    (``block_size`` must be a multiple of the descriptor dim d).
+
+    ``cache_blocks > 0`` tags runs of that many consecutive blocks as one
+    cache group (see the ``group_lo`` field comment): a group-aware streaming
+    consumer computes the shared posteriors once per group instead of once
+    per block, at the cost of holding the group's (n, cache_blocks·block_size)
+    features resident while its blocks are consumed."""
     k, d = gmm.means.shape
     if block_size % d:
         raise ValueError(f"block_size {block_size} not a multiple of dim {d}")
@@ -225,10 +271,19 @@ def make_fisher_block_nodes(
         raise ValueError(
             f"2k={2*k} FV columns not divisible by {cols_per_block} per block"
         )
-    return [
-        FisherVectorSliceNormalized(
-            gmm=gmm, col_lo=lo, col_hi=lo + cols_per_block, key=key,
-            l1_key=l1_key, row_chunk=row_chunk,
+    total_cols = 2 * k
+    group_cols = max(0, cache_blocks) * cols_per_block
+    nodes = []
+    for lo in range(0, total_cols, cols_per_block):
+        if group_cols:
+            glo = (lo // group_cols) * group_cols
+            ghi = min(glo + group_cols, total_cols)
+        else:
+            glo = ghi = 0
+        nodes.append(
+            FisherVectorSliceNormalized(
+                gmm=gmm, col_lo=lo, col_hi=lo + cols_per_block, key=key,
+                l1_key=l1_key, row_chunk=row_chunk, group_lo=glo, group_hi=ghi,
+            )
         )
-        for lo in range(0, 2 * k, cols_per_block)
-    ]
+    return nodes

@@ -261,8 +261,11 @@ class EncodedCommonSparseFeatures(Estimator):
         keys, kdocs = _ngram_keys(ids, doc_of, self.orders, base)
         uk, ud, w = _per_doc_weights(keys, kdocs, self.weight)
 
-        distinct, inv = np.unique(uk, return_inverse=True)
-        totals = np.bincount(inv, weights=w)
+        # keyed aggregation via the native multithreaded reducer (sorted
+        # distinct keys + totals; numpy fallback inside)
+        from keystone_tpu.native.ngram import count_by_key
+
+        distinct, totals = count_by_key(uk, w.astype(np.float64))
         if self.num_features < len(distinct):
             cut = np.argpartition(-totals, self.num_features - 1)[: self.num_features]
             distinct, totals = distinct[cut], totals[cut]

@@ -169,18 +169,20 @@ class StupidBackoffModel(Transformer):
     def apply_batch(self, ngrams) -> np.ndarray:
         return self.score_batch(np.asarray(ngrams))
 
-    def scores(self) -> List[Tuple[Tuple[int, ...], float]]:
-        """Score every trained n-gram (the reference's ``scoresRDD``)."""
-        out: List[Tuple[Tuple[int, ...], float]] = []
+    def scores_arrays(self) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Score every trained n-gram, as per-order arrays.
+
+        Returns ``[(ngrams int32 [N, order], scores float32 [N]), ...]`` in
+        ascending order, each sorted by packed key — the allocation-free form
+        of :meth:`scores` (no per-n-gram Python tuples)."""
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
         if self.host_tables is not None:
             for table in self.host_tables:
                 if not table:
                     continue
                 ngrams = np.array(sorted(table), dtype=np.int64)
                 s = self._score_batch_host(ngrams)
-                out.extend(
-                    (tuple(map(int, ng)), float(v)) for ng, v in zip(ngrams, s)
-                )
+                out.append((ngrams.astype(np.int32), s))
             return out
         for i, keys in enumerate(self.table_keys):
             order = i + 2
@@ -192,7 +194,13 @@ class StupidBackoffModel(Transformer):
             for j in range(order - 1, -1, -1):
                 ngrams[:, j] = (rest & ((1 << self.word_bits) - 1)).astype(np.int32)
                 rest >>= self.word_bits
-            s = self.score_batch(ngrams)
+            out.append((ngrams, self.score_batch(ngrams)))
+        return out
+
+    def scores(self) -> List[Tuple[Tuple[int, ...], float]]:
+        """Score every trained n-gram (the reference's ``scoresRDD``)."""
+        out: List[Tuple[Tuple[int, ...], float]] = []
+        for ngrams, s in self.scores_arrays():
             out.extend((tuple(map(int, ng)), float(v)) for ng, v in zip(ngrams, s))
         return out
 
@@ -263,6 +271,67 @@ class StupidBackoffEstimator:
                 uniq, summed = count_by_key(keys, counts)
                 # Tables stay host-side numpy so int64 keys reach the device
                 # intact (they are converted under enable_x64 at trace time).
+                table_keys.append(uniq)
+                table_counts.append(summed.astype(np.float32))
+            else:
+                table_keys.append(np.zeros((0,), dtype=np.int64))
+                table_counts.append(np.zeros((0,), dtype=np.float32))
+
+        return StupidBackoffModel(
+            table_keys=tuple(table_keys),
+            table_counts=tuple(table_counts),
+            unigram_counts=uni,
+            num_tokens=np.float32(uni.sum()),
+            alpha=self.alpha,
+            word_bits=indexer.word_bits,
+            max_order=max_order,
+        )
+
+    def fit_encoded(
+        self, ids: np.ndarray, lengths: np.ndarray, orders: Sequence[int]
+    ) -> StupidBackoffModel:
+        """Vectorized fit from a padded encoded batch — no per-n-gram tuples.
+
+        ``ids``/``lengths`` are ``WordFrequencyTransformer.encode_padded``
+        output; windows come from :func:`~keystone_tpu.ops.nlp.ngrams.encoded_ngrams`,
+        keys from :class:`PackedNGramIndexer`, aggregation from the native
+        ``count_by_key``. Produces the same tables as
+        ``fit(NGramsCounts()(NGramsFeaturizer(orders)(encoded)))`` —
+        equivalence pinned in ``tests/test_nlp.py``. OOV-containing windows
+        (id < 0) are dropped, like ``fit``. Falls back to the tuple path when
+        vocab × order overflows 63-bit packing.
+        """
+        from keystone_tpu.native.ngram import count_by_key
+        from keystone_tpu.ops.nlp.ngrams import encoded_ngrams
+
+        orders = sorted(o for o in set(orders) if o >= 2)
+        vocab_size = (max(self.unigram_counts) + 1) if self.unigram_counts else 1
+        max_order = max(orders, default=2)
+        try:
+            indexer = PackedNGramIndexer(vocab_size, max_order)
+        except ValueError:
+            counts: List[Tuple[Tuple[int, ...], int]] = []
+            for o in orders:
+                grams = encoded_ngrams(ids, lengths, o)
+                grams = grams[(grams >= 0).all(axis=1)]
+                counts.extend((tuple(map(int, g)), 1) for g in grams)
+            return self.fit(counts)
+
+        uni = np.zeros((vocab_size,), dtype=np.float32)
+        for wid, c in self.unigram_counts.items():
+            if wid >= 0:
+                uni[wid] = c
+
+        table_keys: List[np.ndarray] = []
+        table_counts: List[np.ndarray] = []
+        for order in range(2, max_order + 1):
+            if order in orders:
+                grams = encoded_ngrams(ids, lengths, order)
+                grams = grams[(grams >= 0).all(axis=1)]
+            else:
+                grams = np.zeros((0, order), np.int32)
+            if grams.shape[0]:
+                uniq, summed = count_by_key(indexer.pack_batch(grams))
                 table_keys.append(uniq)
                 table_counts.append(summed.astype(np.float32))
             else:

@@ -227,3 +227,80 @@ def test_weighted_feature_sharded_2d_mesh(rng, devices):
         m_sh = est.fit(xj, lj)
     np.testing.assert_allclose(np.asarray(m_sh.w), np.asarray(m_ref.w), atol=1e-4)
     np.testing.assert_allclose(np.asarray(m_sh.b), np.asarray(m_ref.b), atol=1e-4)
+
+
+def test_weighted_streaming_grouped_fisher_matches_ungrouped(rng):
+    """fit_streaming with cache-grouped Fisher nodes (shared-posterior group
+    featurization, f32 cache) must solve identically to per-block nodes, and
+    bf16 cache must stay close — the flagship HBM configuration."""
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_fisher_block_nodes,
+    )
+
+    k, d = 4, 8
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=10).fit(
+        jnp.asarray(rng.normal(size=(300, d)).astype(np.float32))
+    )
+    n = 96
+    descs = jnp.asarray(rng.normal(size=(n, 12, d)).astype(np.float32))
+    raw = {"descs": descs, "l1": fisher_l1_norms(descs, gmm, chunk=32)}
+    labels = rng.integers(0, 5, n)
+    ind = np.full((n, 5), -1.0, np.float32)
+    ind[np.arange(n), labels] = 1.0
+
+    est = BlockWeightedLeastSquaresEstimator(2 * d, 1, 0.1, 0.25)
+    plain = make_fisher_block_nodes(gmm, block_size=2 * d)
+    m_ref = est.fit_streaming(plain, raw, jnp.asarray(ind))
+    grouped = make_fisher_block_nodes(gmm, block_size=2 * d, cache_blocks=2)
+    m_f32 = est.fit_streaming(grouped, raw, jnp.asarray(ind))
+    np.testing.assert_allclose(np.asarray(m_f32.w), np.asarray(m_ref.w), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m_f32.b), np.asarray(m_ref.b), atol=1e-5)
+
+    m_bf16 = est.fit_streaming(
+        grouped, raw, jnp.asarray(ind), cache_dtype=jnp.bfloat16
+    )
+    # bf16 feature storage: ~3 decimal digits; weights stay within a relative
+    # envelope of the f32 solution
+    ref_w = np.asarray(m_ref.w)
+    np.testing.assert_allclose(
+        np.asarray(m_bf16.w), ref_w, atol=0.02 * np.abs(ref_w).max() + 1e-4
+    )
+
+    # streaming prediction: grouped == ungrouped
+    from keystone_tpu.learning.block_linear import streaming_predict
+
+    p_ref = np.asarray(streaming_predict(m_ref, plain, raw))
+    p_grp = np.asarray(streaming_predict(m_ref, grouped, raw))
+    np.testing.assert_allclose(p_grp, p_ref, atol=1e-4)
+
+
+def test_weighted_streaming_leaves_raw_untouched(rng):
+    """No global class sort exists anywhere in the solver: the caller's raw
+    pytree must come back bit-identical (per-class row access is by index
+    gather inside the solves)."""
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_fisher_block_nodes,
+    )
+
+    k, d = 4, 8
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=10).fit(
+        jnp.asarray(rng.normal(size=(300, d)).astype(np.float32))
+    )
+    n = 64
+    descs = jnp.asarray(rng.normal(size=(n, 12, d)).astype(np.float32))
+    l1 = fisher_l1_norms(descs, gmm, chunk=32)
+    labels = rng.integers(0, 5, n)
+    ind = np.full((n, 5), -1.0, np.float32)
+    ind[np.arange(n), labels] = 1.0
+    descs_before = np.asarray(descs).copy()
+
+    est = BlockWeightedLeastSquaresEstimator(2 * d, 1, 0.1, 0.25)
+    nodes = make_fisher_block_nodes(gmm, block_size=2 * d, cache_blocks=2)
+    raw = {"descs": descs, "l1": l1}
+    est.fit_streaming(nodes, raw, jnp.asarray(ind), cache_dtype=jnp.bfloat16)
+    assert raw["descs"] is descs and raw["l1"] is l1
+    np.testing.assert_array_equal(np.asarray(raw["descs"]), descs_before)

@@ -295,3 +295,87 @@ class TestCoreNLP:
         # and mid-sentence mentions after a newline still type correctly
         toks2 = [g[0] for g in ext.apply("He met Mary\nthen saw Paris")]
         assert "<PERSON>" in toks2 and "<LOCATION>" in toks2
+
+
+class TestFitEncodedEquivalence:
+    """fit_encoded (vectorized windows + packed keys + native count_by_key)
+    must build the same model as fit over the tuple-based NGrams chain."""
+
+    def _both_models(self, docs, orders, alpha=0.4):
+        enc = WordFrequencyEncoder().fit(docs)
+        est = StupidBackoffEstimator(enc.unigram_counts, alpha=alpha)
+        encoded = enc.apply_batch(docs)
+        ngrams = NGramsFeaturizer(orders=orders)(encoded)
+        counts = NGramsCounts(mode=NGramsCountsMode.NO_ADD)(ngrams)
+        ref = est.fit(counts)
+        ids, lengths = enc.encode_padded(docs)
+        fast = est.fit_encoded(ids, lengths, orders)
+        return ref, fast
+
+    @staticmethod
+    def _assert_same_tables(ref, fast):
+        assert ref.max_order == fast.max_order
+        assert ref.word_bits == fast.word_bits
+        assert len(ref.table_keys) == len(fast.table_keys)
+        for rk, fk, rc, fc in zip(
+            ref.table_keys, fast.table_keys, ref.table_counts, fast.table_counts
+        ):
+            np.testing.assert_array_equal(np.asarray(rk), np.asarray(fk))
+            np.testing.assert_allclose(np.asarray(rc), np.asarray(fc))
+        np.testing.assert_allclose(
+            np.asarray(ref.unigram_counts), np.asarray(fast.unigram_counts)
+        )
+
+    def test_toy_corpus(self):
+        docs = [["a", "b", "c"], ["a", "b", "d"], ["b", "c"], ["a"]]
+        ref, fast = self._both_models(docs, (2, 3))
+        self._assert_same_tables(ref, fast)
+
+    def test_zipf_corpus_with_short_docs(self):
+        rng = np.random.default_rng(5)
+        vocab = [f"w{i}" for i in range(80)]
+        probs = 1.0 / np.arange(1, 81)
+        probs /= probs.sum()
+        docs = [
+            [vocab[i] for i in rng.choice(80, size=int(rng.integers(1, 12)), p=probs)]
+            for _ in range(150)
+        ]
+        ref, fast = self._both_models(docs, (2, 3, 4))
+        self._assert_same_tables(ref, fast)
+        # and the served scores agree
+        q = np.array([[0, 1, 2, 3], [3, 2, 1, 0], [0, 0, 0, 0]], np.int32)
+        np.testing.assert_allclose(ref.score_batch(q), fast.score_batch(q), rtol=1e-6)
+
+    def test_oov_windows_dropped(self):
+        # encode test-side docs against a vocab missing some words: windows
+        # containing OOV (-1) must not enter the tables on either path
+        train = [["a", "b"], ["b", "c"]]
+        enc = WordFrequencyEncoder().fit(train)
+        est = StupidBackoffEstimator(enc.unigram_counts)
+        other = [["a", "zz", "b"], ["b", "c", "a"]]
+        encoded = enc.apply_batch(other)
+        counts = NGramsCounts(mode=NGramsCountsMode.NO_ADD)(
+            NGramsFeaturizer(orders=(2,))(encoded)
+        )
+        ref = est.fit(counts)
+        ids, lengths = enc.encode_padded(other)
+        fast = est.fit_encoded(ids, lengths, (2,))
+        self._assert_same_tables(ref, fast)
+
+    def test_scores_arrays_matches_scores(self):
+        docs = [["a", "b", "c"], ["b", "c", "a", "b"]]
+        ref, fast = self._both_models(docs, (2, 3))
+        flat = [
+            (tuple(map(int, ng)), float(s))
+            for ngrams, scores in fast.scores_arrays()
+            for ng, s in zip(ngrams, scores)
+        ]
+        assert flat == fast.scores()
+
+    def test_pipeline_both_paths_agree(self):
+        from keystone_tpu.pipelines.stupid_backoff import StupidBackoffConfig, run
+
+        fast = run(StupidBackoffConfig(synthetic_docs=300, fast_host_path=True))
+        slow = run(StupidBackoffConfig(synthetic_docs=300, fast_host_path=False))
+        assert fast["num_scored"] == slow["num_scored"]
+        assert fast["sample_scores"] == slow["sample_scores"]

@@ -183,3 +183,80 @@ def test_fisher_slice_normalized_matches_dense_chain(rng):
         )
         assert stream.shape == dense.shape
         np.testing.assert_allclose(stream, dense, atol=1e-5)
+
+
+def test_fisher_block_cache_groups_match_ungrouped(rng):
+    """cache_blocks grouping must be a pure featurization refactor: grouped
+    nodes (slices of one shared-posterior group pass) emit exactly what the
+    per-block nodes emit, for every group size incl. ragged last groups."""
+    from keystone_tpu.learning.block_linear import grouped_block_getter
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_fisher_block_nodes,
+    )
+
+    k, d = 4, 8
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=10).fit(
+        jnp.asarray(rng.normal(size=(200, d)).astype(np.float32))
+    )
+    descs = jnp.asarray(rng.normal(size=(6, 20, d)).astype(np.float32))
+    raw = {"descs": descs, "l1": fisher_l1_norms(descs, gmm, chunk=4)}
+    plain = make_fisher_block_nodes(gmm, block_size=2 * d)
+    ref = [np.asarray(b.apply_batch(raw)) for b in plain]
+    for cache_blocks in (1, 2, 3, 4):
+        nodes = make_fisher_block_nodes(
+            gmm, block_size=2 * d, cache_blocks=cache_blocks
+        )
+        get, clear = grouped_block_getter(nodes, raw)
+        for b in range(len(nodes)):
+            np.testing.assert_allclose(
+                np.asarray(get(b)), ref[b], atol=1e-6,
+                err_msg=f"cache_blocks={cache_blocks} block={b}",
+            )
+        clear()
+    # group metadata sanity: cache_blocks=1 and full-width groups disable
+    # caching (group == block / group == everything is still one pass each)
+    solo = make_fisher_block_nodes(gmm, block_size=2 * d, cache_blocks=1)
+    assert all(n.cache_group is None for n in solo)
+    grouped = make_fisher_block_nodes(gmm, block_size=2 * d, cache_blocks=2)
+    assert grouped[0].cache_group == grouped[1].cache_group is not None
+    assert grouped[2].cache_group == grouped[3].cache_group != grouped[0].cache_group
+
+
+def test_grouped_getter_caches_once_per_group(rng):
+    """The one-slot cache computes each group exactly once for in-order
+    access and serves slices from it."""
+    from keystone_tpu.learning.block_linear import grouped_block_getter
+
+    calls = []
+
+    class _Node:
+        def __init__(self, i):
+            self.i = i
+            self.cache_group = ("g", i // 2)
+
+        def group_node(self):
+            node = self
+
+            class _G:
+                def apply_batch(self, raw):
+                    calls.append(node.cache_group)
+                    return raw["x"][:, (node.i // 2) * 4 : (node.i // 2) * 4 + 4]
+
+            return _G()
+
+        def slice_cached(self, out):
+            lo = (self.i % 2) * 2
+            return out[:, lo : lo + 2]
+
+        def apply_batch(self, raw):
+            raise AssertionError("grouped node must be served from the cache")
+
+    raw = {"x": jnp.asarray(rng.normal(size=(5, 8)).astype(np.float32))}
+    nodes = [_Node(i) for i in range(4)]
+    get, clear = grouped_block_getter(nodes, raw)
+    out = [np.asarray(get(b)) for b in range(4)]
+    assert calls == [("g", 0), ("g", 1)]  # one featurization per group
+    full = np.asarray(raw["x"])
+    np.testing.assert_allclose(np.concatenate(out, axis=1), full)
+    clear()
