@@ -96,35 +96,43 @@ def _try_solver_gflops(precision=None):
     return None
 
 
+# (key, pipeline module, config kwargs) — each runs twice, reports the warm
+# wall-clock, and never blocks the primary metric on failure.
+_EXTRA_PIPELINES = (
+    ("timit_100k_50x4096_5ep_warm_s", "keystone_tpu.pipelines.timit",
+     dict(synthetic_train=100000, synthetic_test=20000)),
+    ("random_patch_cifar_50k_warm_s",
+     "keystone_tpu.pipelines.random_patch_cifar",
+     dict(synthetic_train=50000, synthetic_test=10000)),
+    ("newsgroups_20k_warm_s", "keystone_tpu.pipelines.newsgroups",
+     dict(synthetic_train=20000, synthetic_test=4000, synthetic_classes=20,
+          common_features=100000)),
+    ("stupid_backoff_20k_warm_s", "keystone_tpu.pipelines.stupid_backoff",
+     dict(synthetic_docs=20000)),
+)
+
+
 def _try_extras():
     """Secondary whole-pipeline wall-clocks (warm), never fatal. Disable with
     BENCH_EXTRAS=0 to keep the run to the primary metric only."""
     if os.environ.get("BENCH_EXTRAS", "1") == "0":
         return {}
+    import importlib
+
     extras = {}
-    try:
-        from keystone_tpu.pipelines.timit import TimitConfig, run as run_timit
-
-        cfg = TimitConfig(synthetic_train=100000, synthetic_test=20000)
-        run_timit(cfg)
-        extras["timit_100k_50x4096_5ep_warm_s"] = round(
-            run_timit(cfg)["wallclock_s"], 3
-        )
-    except Exception:
-        extras["timit_100k_50x4096_5ep_warm_s"] = None
-    try:
-        from keystone_tpu.pipelines.random_patch_cifar import (
-            RandomPatchCifarConfig,
-            run as run_rpc,
-        )
-
-        cfg = RandomPatchCifarConfig(synthetic_train=50000, synthetic_test=10000)
-        run_rpc(cfg)
-        extras["random_patch_cifar_50k_warm_s"] = round(
-            run_rpc(cfg)["wallclock_s"], 3
-        )
-    except Exception:
-        extras["random_patch_cifar_50k_warm_s"] = None
+    for key, module, kwargs in _EXTRA_PIPELINES:
+        try:
+            mod = importlib.import_module(module)
+            config_cls = next(
+                v for k, v in vars(mod).items() if k.endswith("Config")
+            )
+            cfg = config_cls(**kwargs)
+            mod.run(cfg)  # cold (compile)
+            extras[key] = round(mod.run(cfg)["wallclock_s"], 3)
+        except Exception as e:
+            print(f"extras[{key}] failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            extras[key] = None
     return extras
 
 
@@ -171,6 +179,15 @@ def main():
     timit_tpu = out.get("timit_100k_50x4096_5ep_warm_s")
     if timit_cpu and timit_tpu:
         out["timit_vs_cpu_baseline"] = round(timit_cpu / timit_tpu, 1)
+    for cpu_key, tpu_key, ratio_key in (
+        ("newsgroups_cpu_warm_s", "newsgroups_20k_warm_s",
+         "newsgroups_vs_cpu_baseline"),
+        ("stupid_backoff_cpu_warm_s", "stupid_backoff_20k_warm_s",
+         "stupid_backoff_vs_cpu_baseline"),
+    ):
+        cpu_s, tpu_s = (anchor or {}).get(cpu_key), out.get(tpu_key)
+        if cpu_s and tpu_s:
+            out[ratio_key] = round(cpu_s / tpu_s, 1)
     print(json.dumps(out))
 
 

@@ -306,13 +306,20 @@ class StupidBackoffEstimator:
 
         orders = sorted(o for o in set(orders) if o >= 2)
         vocab_size = (max(self.unigram_counts) + 1) if self.unigram_counts else 1
-        max_order = max(orders, default=2)
+        # Windows per order, pre-OOV-filter: fit() derives max_order from
+        # the n-grams present (incl. OOV-containing ones, which it drops
+        # only afterwards), so the data — not the request — sets the model's
+        # order here too (exact-equivalence contract with fit()).
+        raw_grams = {o: encoded_ngrams(ids, lengths, o) for o in orders}
+        max_order = max(
+            (o for o, g in raw_grams.items() if g.shape[0]), default=2
+        )
         try:
             indexer = PackedNGramIndexer(vocab_size, max_order)
         except ValueError:
             counts: List[Tuple[Tuple[int, ...], int]] = []
             for o in orders:
-                grams = encoded_ngrams(ids, lengths, o)
+                grams = raw_grams[o]
                 grams = grams[(grams >= 0).all(axis=1)]
                 counts.extend((tuple(map(int, g)), 1) for g in grams)
             return self.fit(counts)
@@ -325,11 +332,8 @@ class StupidBackoffEstimator:
         table_keys: List[np.ndarray] = []
         table_counts: List[np.ndarray] = []
         for order in range(2, max_order + 1):
-            if order in orders:
-                grams = encoded_ngrams(ids, lengths, order)
-                grams = grams[(grams >= 0).all(axis=1)]
-            else:
-                grams = np.zeros((0, order), np.int32)
+            grams = raw_grams.get(order, np.zeros((0, order), np.int32))
+            grams = grams[(grams >= 0).all(axis=1)]
             if grams.shape[0]:
                 uniq, summed = count_by_key(indexer.pack_batch(grams))
                 table_keys.append(uniq)

@@ -71,10 +71,12 @@ class ImageNetSiftLcsFVConfig:
     desc_dtype: str = "bfloat16"  # resident reduced-descriptor storage
     # FV cache grouping: consecutive solver blocks per shared-posterior
     # featurization pass (0 = recompute per block). Peak extra HBM = one
-    # group's (n, fv_cache_blocks·block_size) features in fv_cache_dtype;
-    # at the flagship config (n=102 400, 4 blocks, bf16) that is ~3.4 GB
-    # against an 8× cut in posterior recompute per branch.
-    fv_cache_blocks: int = 4
+    # group's (n, fv_cache_blocks·block_size) features in fv_cache_dtype.
+    # Default 2 = the HBM-validated flagship configuration (~1.7 GB bf16
+    # group buffer at n=102 400 next to ~6.4 GB resident descriptors on a
+    # 16 GB chip); 4-block groups OOM there and buy no further posterior
+    # savings worth the memory.
+    fv_cache_blocks: int = 2
     fv_cache_dtype: str = "bfloat16"
 
 

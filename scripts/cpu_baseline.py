@@ -32,6 +32,8 @@ import time
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-timit", action="store_true")
+    ap.add_argument("--skip-mnist", action="store_true")
+    ap.add_argument("--skip-text", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -42,26 +44,58 @@ def main() -> None:
     assert jax.default_backend() == "cpu", (
         "could not select jax-cpu (got %s)" % jax.default_backend()
     )
-    out = {
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "cpu_baseline.json")
+    # merge into any existing anchor file so sections can be re-measured
+    # independently (each --skip-* leaves the old entry intact)
+    out = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            out = json.load(f)
+    out.update({
         "host_cores": multiprocessing.cpu_count(),
         "platform": platform.platform(),
         "backend": "jax-cpu",
-    }
+    })
 
-    from keystone_tpu.pipelines.mnist_random_fft import (
-        MnistRandomFFTConfig,
-        run as run_mnist,
-    )
+    if not args.skip_mnist:
+        from keystone_tpu.pipelines.mnist_random_fft import (
+            MnistRandomFFTConfig,
+            run as run_mnist,
+        )
 
-    cfg = MnistRandomFFTConfig(
-        num_ffts=4, block_size=2048, lam=10.0,
-        synthetic_train=60000, synthetic_test=10000,
-    )
-    run_mnist(cfg)  # cold (compile)
-    t0 = time.perf_counter()
-    res = run_mnist(cfg)
-    out["mnist_random_fft_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
-    out["mnist_train_error_pct"] = round(res["train_error"], 3)
+        cfg = MnistRandomFFTConfig(
+            num_ffts=4, block_size=2048, lam=10.0,
+            synthetic_train=60000, synthetic_test=10000,
+        )
+        run_mnist(cfg)  # cold (compile)
+        t0 = time.perf_counter()
+        res = run_mnist(cfg)
+        out["mnist_random_fft_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
+        out["mnist_train_error_pct"] = round(res["train_error"], 3)
+
+    if not args.skip_text:
+        from keystone_tpu.pipelines.newsgroups import (
+            NewsgroupsConfig,
+            run as run_news,
+        )
+        from keystone_tpu.pipelines.stupid_backoff import (
+            StupidBackoffConfig,
+            run as run_sb,
+        )
+
+        ncfg = NewsgroupsConfig(synthetic_train=20000, synthetic_test=4000,
+                                synthetic_classes=20, common_features=100000)
+        run_news(ncfg)  # cold
+        t0 = time.perf_counter()
+        run_news(ncfg)
+        out["newsgroups_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
+
+        scfg = StupidBackoffConfig(synthetic_docs=20000)
+        run_sb(scfg)  # cold
+        t0 = time.perf_counter()
+        run_sb(scfg)
+        out["stupid_backoff_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
 
     if not args.skip_timit:
         from keystone_tpu.pipelines.timit import TimitConfig, run as run_timit
@@ -106,8 +140,6 @@ def main() -> None:
             f"evaluated at {full_epochs}ep*{full_blocks}blk"
         )
 
-    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                        "cpu_baseline.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

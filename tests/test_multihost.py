@@ -1,0 +1,117 @@
+"""True multi-process distributed execution (the DCN / multi-host analog).
+
+The reference's distributed backend is Spark's driver/executor runtime over a
+cluster (SURVEY.md §2.13); the rebuild's is a JAX process group —
+``jax.distributed.initialize`` (what ``run-pipeline --coordinator ...``
+calls, ``cli.py``) + XLA collectives over the global mesh. The 8-device
+single-process mesh used everywhere else in this suite exercises the
+collectives but not the *multi-controller* path: global arrays assembled
+from process-local shards, cross-process psum/all-gather (Gloo on CPU here,
+ICI/DCN on real pods).
+
+This test spawns TWO OS processes, each exposing 4 CPU devices, forms the
+8-device global mesh across them, and drives the framework's distributed
+linalg through it:
+
+- a global array built with ``jax.make_array_from_process_local_data``
+  (each process contributes only its rows),
+- ``tsqr_solve`` (shard_map QR tree + psum'd Qᵀb) on the global mesh,
+- a jitted global reduction (the gram/psum pattern under NormalEquations),
+
+asserting both processes agree with a local numpy solution.
+"""
+
+import os
+import subprocess
+import sys
+
+_WORKER = r"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+pid = int(sys.argv[1])
+port = sys.argv[2]
+jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from keystone_tpu.linalg.solvers import tsqr_solve
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+n, d, c = 128, 8, 3
+rng = np.random.default_rng(0)  # same seed on both processes
+A_full = rng.normal(size=(n, d)).astype(np.float32)
+b_full = rng.normal(size=(n, c)).astype(np.float32)
+
+rows = NamedSharding(mesh, P("data"))
+half = n // 2
+A = jax.make_array_from_process_local_data(
+    rows, A_full[pid * half : (pid + 1) * half], A_full.shape
+)
+b = jax.make_array_from_process_local_data(
+    rows, b_full[pid * half : (pid + 1) * half], b_full.shape
+)
+
+# 1. cross-process reduction (the gram/psum pattern): AtA over all rows
+AtA = jax.jit(
+    lambda x: x.T @ x, out_shardings=NamedSharding(mesh, P())
+)(A)
+np.testing.assert_allclose(
+    np.asarray(AtA), A_full.T @ A_full, rtol=1e-4, atol=1e-4
+)
+
+# 2. TSQR least squares across the process group
+lam = 0.1
+with mesh:
+    w = tsqr_solve(A, b, lam=lam)
+jax.block_until_ready(w)
+w_ref = np.linalg.solve(
+    A_full.T @ A_full + lam * np.eye(d), A_full.T @ b_full
+)
+np.testing.assert_allclose(np.asarray(w), w_ref, rtol=1e-3, atol=1e-3)
+
+print(f"MULTIHOST_OK proc={pid}", flush=True)
+"""
+
+
+def test_two_process_distributed_tsqr(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    port = "12719"
+    env = dict(os.environ)
+    # the workers pin their own platform/device count before distributed
+    # init; drop any inherited platform pin (e.g. the axon TPU plugin owns
+    # the real chip in the parent test process)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), port],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc{i} failed:\n{out[-3000:]}"
+        assert f"MULTIHOST_OK proc={i}" in out, out[-3000:]

@@ -379,3 +379,11 @@ class TestFitEncodedEquivalence:
         slow = run(StupidBackoffConfig(synthetic_docs=300, fast_host_path=False))
         assert fast["num_scored"] == slow["num_scored"]
         assert fast["sample_scores"] == slow["sample_scores"]
+
+    def test_max_order_follows_data_not_request(self):
+        # every doc shorter than 3: both paths must produce a max_order-2
+        # model (fit derives order from the data; fit_encoded must match)
+        docs = [["a", "b"], ["b", "c"], ["a"]]
+        ref, fast = self._both_models(docs, (2, 3))
+        assert ref.max_order == fast.max_order == 2
+        self._assert_same_tables(ref, fast)
