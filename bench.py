@@ -96,19 +96,20 @@ def _try_solver_gflops(precision=None):
     return None
 
 
-# (key, pipeline module, config kwargs) — each runs twice, reports the warm
-# wall-clock, and never blocks the primary metric on failure.
+# (key, pipeline module, config class name, config kwargs) — each runs
+# twice, reports the warm wall-clock, and never blocks the primary metric.
 _EXTRA_PIPELINES = (
     ("timit_100k_50x4096_5ep_warm_s", "keystone_tpu.pipelines.timit",
-     dict(synthetic_train=100000, synthetic_test=20000)),
+     "TimitConfig", dict(synthetic_train=100000, synthetic_test=20000)),
     ("random_patch_cifar_50k_warm_s",
-     "keystone_tpu.pipelines.random_patch_cifar",
+     "keystone_tpu.pipelines.random_patch_cifar", "RandomPatchCifarConfig",
      dict(synthetic_train=50000, synthetic_test=10000)),
     ("newsgroups_20k_warm_s", "keystone_tpu.pipelines.newsgroups",
+     "NewsgroupsConfig",
      dict(synthetic_train=20000, synthetic_test=4000, synthetic_classes=20,
           common_features=100000)),
     ("stupid_backoff_20k_warm_s", "keystone_tpu.pipelines.stupid_backoff",
-     dict(synthetic_docs=20000)),
+     "StupidBackoffConfig", dict(synthetic_docs=20000)),
 )
 
 
@@ -120,13 +121,10 @@ def _try_extras():
     import importlib
 
     extras = {}
-    for key, module, kwargs in _EXTRA_PIPELINES:
+    for key, module, config_name, kwargs in _EXTRA_PIPELINES:
         try:
             mod = importlib.import_module(module)
-            config_cls = next(
-                v for k, v in vars(mod).items() if k.endswith("Config")
-            )
-            cfg = config_cls(**kwargs)
+            cfg = getattr(mod, config_name)(**kwargs)
             mod.run(cfg)  # cold (compile)
             extras[key] = round(mod.run(cfg)["wallclock_s"], 3)
         except Exception as e:

@@ -77,11 +77,13 @@ def _pop_stats(Xb, R, valid, n_eff, precision: str):
     return pop_mean, pop_cov, pop_xtr
 
 
-@functools.partial(jax.jit, static_argnames=("max_nc", "group", "precision"))
+@functools.partial(
+    jax.jit, static_argnames=("max_nc", "group", "precision", "woodbury")
+)
 def _class_solves(
     Xb, R, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
-    residual_mean, model_b, lam, w, class_ids, class_rows, max_nc: int,
-    group: int, precision: str
+    residual_mean, model_b, lam, w, class_ids, class_rows, base_inv,
+    max_nc: int, group: int, precision: str, woodbury: bool
 ):
     """Per-class joint solves for the classes in ``class_ids``
     (``BlockWeightedLeastSquares.scala:228-263``). Returns ΔW
@@ -100,7 +102,25 @@ def _class_solves(
     within): the class grams become one batched MXU matmul and the bs×bs
     regularized solves one batched Cholesky, instead of C sequential
     dispatch-bound steps. ``group`` is chosen by the caller to bound the
-    live set (≈ group·(max_nc·bs + 3·bs²) floats)."""
+    live set (≈ group·(max_nc·bs + 3·bs²) floats).
+
+    ``woodbury=True`` (small classes, ``max_nc + 1 ≪ bs``) exploits the
+    structure of the per-class system: every class shares the constant SPD
+    base ``B = (1-w)·pop_cov + λI``, and its own matrix differs only by the
+    PSD rank-(n_c+1) update ``Vᵀ V`` with
+    ``V = [√(w/n_c)·X̃_c ; √((1-w)w)·(μ_c-μ)ᵀ]``. With ``base_inv = B⁻¹``
+    (one bs×bs factorization per block, amortized over all C classes) the
+    Woodbury identity turns each class solve into MXU gemms plus one TINY
+    (max_nc+1)² Cholesky:
+
+        x = B⁻¹r − (VB⁻¹)ᵀ (I + V B⁻¹ Vᵀ)⁻¹ (V B⁻¹ r)
+
+    For 1000-class ImageNet (bs=4096, mean n_c≈102) this replaces 1000
+    dense 4096³/3 Cholesky factorizations per block — the dominant solver
+    cost, and not MXU-shaped — with ~4·n·bs² gemm FLOPs per block. The
+    reference pays the dense factorizations on CPU executors
+    (``BlockWeightedLeastSquares.scala:253``: a Breeze ``\\`` per class).
+    """
     n, bs = Xb.shape
     Xb = Xb.astype(jnp.float32)  # bf16 streaming blocks upcast in-program
     num_classes = pop_xtr.shape[1]
@@ -118,15 +138,9 @@ def _class_solves(
 
         class_mean = jnp.sum(Xc * m[:, None], axis=0) / nc
         Xzm = (Xc - class_mean) * m[:, None]
-        class_cov = hdot(Xzm.T, Xzm, precision) / nc
         class_xtr = hdot((Xc * m[:, None]).T, res_local, precision) / nc
 
         mean_diff = class_mean - pop_mean
-        joint_xtx = (
-            (1.0 - w) * pop_cov
-            + w * class_cov
-            + (1.0 - w) * w * jnp.outer(mean_diff, mean_diff)
-        )
         mean_mix = (1.0 - w) * residual_mean[c] + w * jnp.sum(res_local) / nc
         joint_xtr = (
             (1.0 - w) * jnp.take(pop_xtr, c, axis=1)
@@ -134,6 +148,26 @@ def _class_solves(
             - joint_means_b[c] * mean_mix
         )
         rhs = joint_xtr - lam * jnp.take(model_b, c, axis=1)
+
+        if woodbury:
+            V = jnp.concatenate(
+                [
+                    jnp.sqrt(w / nc) * Xzm,
+                    jnp.sqrt((1.0 - w) * w) * mean_diff[None, :],
+                ]
+            )  # (max_nc + 1, bs); joint_xtx + λI = B + VᵀV
+            t0 = hdot(base_inv, rhs, precision)
+            T = hdot(V, base_inv, precision)  # (max_nc + 1, bs)
+            S = jnp.eye(max_nc + 1, dtype=Xb.dtype) + hdot(T, V.T, precision)
+            y = spd_solve(S, hdot(T, rhs, precision))
+            return t0 - hdot(T.T, y, precision)
+
+        class_cov = hdot(Xzm.T, Xzm, precision) / nc
+        joint_xtx = (
+            (1.0 - w) * pop_cov
+            + w * class_cov
+            + (1.0 - w) * w * jnp.outer(mean_diff, mean_diff)
+        )
         return spd_solve(joint_xtx + lam * eye, rhs)
 
     n_ids = class_ids.shape[0]
@@ -198,25 +232,57 @@ def _class_buckets(counts_np: np.ndarray, class_idx_np: np.ndarray) -> list:
     return buckets, inv_perm
 
 
-def _solve_group(bs: int, max_nc: int) -> int:
-    """Classes per batched solve step: bound the live set (grams + chunk
-    slices + Cholesky workspace ≈ group·(max_nc·bs + 3·bs²) f32) near
-    512 MB — e.g. 2 at the flagship (bs=4096), 16+ for small blocks."""
+def _solve_group(bs: int, max_nc: int, woodbury: bool = False) -> int:
+    """Classes per batched solve step: bound the live set near 512 MB.
+
+    Dense path: grams + chunk slices + Cholesky workspace ≈
+    group·(max_nc·bs + 3·bs²) f32 — e.g. 2 at the flagship (bs=4096).
+    Woodbury path: no bs×bs per-class matrices exist (only V/T at
+    (max_nc+1)·bs plus the tiny (max_nc+1)² system), so groups can be much
+    larger — bigger batched gemms, fewer scan steps."""
+    if woodbury:
+        per_class = 4 * (max_nc + 1) * bs + 2 * (max_nc + 1) ** 2
+        return max(1, min(64, (1 << 27) // max(per_class, 1)))
     per_class = max_nc * bs + 3 * bs * bs
     return max(1, min(16, (1 << 27) // max(per_class, 1)))
 
 
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _base_inverse(pop_cov, lam, w, precision: str):
+    """B⁻¹ for the shared Woodbury base B = (1-w)·pop_cov + λI — one bs×bs
+    SPD inversion per block, amortized over every class's solve."""
+    bs = pop_cov.shape[0]
+    eye = jnp.eye(bs, dtype=pop_cov.dtype)
+    return spd_solve((1.0 - w) * pop_cov + lam * eye, eye)
+
+
+def _use_woodbury(max_nc: int, bs: int) -> bool:
+    """Rank-update solves win when the update rank is well below the block
+    size: per class, Woodbury costs ~4·max_nc·bs² gemm FLOPs (MXU) vs the
+    dense bs³/3 Cholesky (not MXU-shaped) — crossover left conservative."""
+    return max_nc + 1 <= bs // 8
+
+
+def _needs_base_inverse(buckets, bs: int) -> bool:
+    return any(_use_woodbury(max_nc, bs) for max_nc, _, _ in buckets)
+
+
 def _bucketed_class_solves(
     Xb, R, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
-    residual_mean, model_b, lam, w, buckets, inv_perm, precision: str
+    residual_mean, model_b, lam, w, buckets, inv_perm, base_inv,
+    precision: str
 ):
-    """Run :func:`_class_solves` once per size bucket; returns ΔW (bs, C)."""
+    """Run :func:`_class_solves` once per size bucket; returns ΔW (bs, C).
+    ``base_inv`` is the cached per-block Woodbury base inverse (None when no
+    bucket takes the Woodbury path — see :func:`_needs_base_inverse`)."""
     bs = Xb.shape[1]
     parts = [
         _class_solves(
             Xb, R, counts, pop_cov, pop_mean, pop_xtr,
             joint_means_b, residual_mean, model_b, lam, w,
-            ids, rows, max_nc, _solve_group(bs, max_nc), precision=precision,
+            ids, rows, base_inv, max_nc,
+            _solve_group(bs, max_nc, _use_woodbury(max_nc, bs)),
+            precision=precision, woodbury=_use_woodbury(max_nc, bs),
         )
         for max_nc, ids, rows in buckets
     ]
@@ -306,12 +372,19 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         pop_stats_cache: list = [None] * num_blocks
         joint_means_blocks: list = [None] * num_blocks
 
+        need_binv = _needs_base_inverse(buckets, self.block_size)
         for _ in range(self.num_iter):
             for b in range(num_blocks):
                 Xb = get_block(b)
                 if pop_stats_cache[b] is None:
                     pop_mean, pop_cov, pop_xtr = _pop_stats(
                         Xb, R, valid, n_eff, precision=precision
+                    )
+                    # base inverse depends only on pop_cov/λ/w: once per
+                    # block, cached with the pop stats across iterations
+                    base_inv = (
+                        _base_inverse(pop_cov, lam, w, precision)
+                        if need_binv else None
                     )
                     # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)
                     class_sums = _class_sums(Xb, class_idx, num_classes)
@@ -321,9 +394,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     joint_means_b = w * class_means + (1.0 - w) * pop_mean
                     joint_means_blocks[b] = joint_means_b
                     if self.cache_stats and self.num_iter > 1:
-                        pop_stats_cache[b] = (pop_mean, pop_cov)
+                        pop_stats_cache[b] = (pop_mean, pop_cov, base_inv)
                 else:
-                    pop_mean, pop_cov = pop_stats_cache[b]
+                    pop_mean, pop_cov, base_inv = pop_stats_cache[b]
                     joint_means_b = joint_means_blocks[b]
                     pop_xtr = hdot(
                         (Xb.astype(jnp.float32) * valid[:, None]).T, R, precision
@@ -332,7 +405,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 dW = _bucketed_class_solves(
                     Xb, R, counts, pop_cov, pop_mean, pop_xtr,
                     joint_means_b, residual_mean, models[b], lam, w, buckets,
-                    inv_perm, precision=precision,
+                    inv_perm, base_inv, precision=precision,
                 )
                 models[b] = models[b] + dW
                 R = _apply_update(R, Xb, dW, valid, precision=precision)

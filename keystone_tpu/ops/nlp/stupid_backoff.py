@@ -317,11 +317,12 @@ class StupidBackoffEstimator:
         try:
             indexer = PackedNGramIndexer(vocab_size, max_order)
         except ValueError:
+            # hand fit() the UNfiltered windows: it drops OOV-containing
+            # n-grams itself but derives max_order before doing so, and the
+            # two paths must agree on that (exact-equivalence contract)
             counts: List[Tuple[Tuple[int, ...], int]] = []
             for o in orders:
-                grams = raw_grams[o]
-                grams = grams[(grams >= 0).all(axis=1)]
-                counts.extend((tuple(map(int, g)), 1) for g in grams)
+                counts.extend((tuple(map(int, g)), 1) for g in raw_grams[o])
             return self.fit(counts)
 
         uni = np.zeros((vocab_size,), dtype=np.float32)

@@ -47,16 +47,27 @@ def main() -> None:
     path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                         "cpu_baseline.json")
     # merge into any existing anchor file so sections can be re-measured
-    # independently (each --skip-* leaves the old entry intact)
-    out = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            out = json.load(f)
-    out.update({
+    # independently (each --skip-* leaves the old entry intact) — but only
+    # when the old entries come from THIS host; mixing hosts would silently
+    # misattribute timings to the recorded host_cores/platform
+    host = {
         "host_cores": multiprocessing.cpu_count(),
         "platform": platform.platform(),
         "backend": "jax-cpu",
-    })
+    }
+    out = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            prev = json.load(f)
+        if all(prev.get(k) == v for k, v in host.items()):
+            out = prev
+        else:
+            print(
+                "cpu_baseline.json is from a different host "
+                f"({prev.get('platform')}, {prev.get('host_cores')} cores); "
+                "discarding its entries", file=sys.stderr,
+            )
+    out.update(host)
 
     if not args.skip_mnist:
         from keystone_tpu.pipelines.mnist_random_fft import (

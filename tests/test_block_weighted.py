@@ -304,3 +304,34 @@ def test_weighted_streaming_leaves_raw_untouched(rng):
     est.fit_streaming(nodes, raw, jnp.asarray(ind), cache_dtype=jnp.bfloat16)
     assert raw["descs"] is descs and raw["l1"] is l1
     np.testing.assert_array_equal(np.asarray(raw["descs"]), descs_before)
+
+
+def test_woodbury_class_solves_match_dense(rng, monkeypatch):
+    """Small-class solves via the shared-base Woodbury identity (rank-n_c
+    updates against one B=(1-w)popCov+lam*I inverse per block) must match
+    the dense per-class Cholesky to float tolerance. bs=128 with ~8-row
+    classes crosses the max_nc+1 <= bs//8 threshold, so the default path IS
+    Woodbury here; the dense reference is obtained by forcing the
+    crossover off."""
+    import keystone_tpu.learning.block_weighted as bw
+
+    c, d, n = 40, 128, 320
+    labels = np.concatenate([np.arange(c), rng.choice(c, size=n - c)]).astype(np.int32)
+    rng.shuffle(labels)
+    protos = rng.normal(size=(c, d)).astype(np.float32)
+    x = protos[labels] + 0.3 * rng.normal(size=(n, d)).astype(np.float32)
+    ind = np.asarray(ClassLabelIndicatorsFromIntLabels(c)(jnp.asarray(labels)))
+
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=d, num_iter=1, lam=0.05, mixture_weight=0.25
+    )
+    assert bw._use_woodbury(8, d)  # the small-class buckets take this path
+    m_wood = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    monkeypatch.setattr(bw, "_use_woodbury", lambda max_nc, bs: False)
+    m_dense = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    np.testing.assert_allclose(
+        np.asarray(m_wood.w), np.asarray(m_dense.w), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_wood.b), np.asarray(m_dense.b), atol=2e-4
+    )

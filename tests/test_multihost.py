@@ -84,9 +84,14 @@ print(f"MULTIHOST_OK proc={pid}", flush=True)
 
 
 def test_two_process_distributed_tsqr(tmp_path):
+    import socket
+
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
-    port = "12719"
+    # ephemeral free port: a fixed one collides across concurrent suite runs
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
     env = dict(os.environ)
     # the workers pin their own platform/device count before distributed
     # init; drop any inherited platform pin (e.g. the axon TPU plugin owns
