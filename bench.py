@@ -110,6 +110,10 @@ _EXTRA_PIPELINES = (
           common_features=100000)),
     ("stupid_backoff_20k_warm_s", "keystone_tpu.pipelines.stupid_backoff",
      "StupidBackoffConfig", dict(synthetic_docs=20000)),
+    ("voc_small_warm_s", "keystone_tpu.pipelines.voc_sift_fisher",
+     "VOCSIFTFisherConfig",
+     dict(synthetic_train=1024, synthetic_test=256, vocab_size=16,
+          num_pca_samples=1000000, num_gmm_samples=1000000)),
 )
 
 
@@ -182,6 +186,8 @@ def main():
          "newsgroups_vs_cpu_baseline"),
         ("stupid_backoff_cpu_warm_s", "stupid_backoff_20k_warm_s",
          "stupid_backoff_vs_cpu_baseline"),
+        ("voc_small_cpu_warm_s", "voc_small_warm_s",
+         "voc_small_vs_cpu_baseline"),
     ):
         cpu_s, tpu_s = (anchor or {}).get(cpu_key), out.get(tpu_key)
         if cpu_s and tpu_s:

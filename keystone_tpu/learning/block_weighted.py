@@ -308,9 +308,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
     Two fit paths share one block-coordinate loop:
 
-    - :meth:`fit` materializes the (class-sorted) feature matrix in HBM —
-      right whenever n·d·4B fits (every reference workload except flagship
-      ImageNet).
+    - :meth:`fit` materializes the feature matrix in HBM (original row
+      order — see ``_prepare``) — right whenever n·d·4B fits (every
+      reference workload except flagship ImageNet).
     - :meth:`fit_streaming` re-featurizes each column block from raw inputs
       inside the solver loop — the out-of-core path for the reference's
       flagship regime (``ImageNetSiftLcsFV.scala:188,197-218``: 2 branches ×
@@ -325,8 +325,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
       branch at 200-400 descriptors/image), R (n·C·4 = 0.4 GB), one block
       Xb (n·4096·4 = 1.6 GB), the model (d·C·4 = 0.26 GB), joint means
       (C·d·4 = 0.26 GB), and one bs² pop-cov (64 MB) — ~6-9 GB total.
-      With ``cache_stats=True`` and num_iter>1, add num_blocks·bs² f32
-      (16 blocks × 64 MB = 1 GB) of cached per-block covariances.
+      With ``cache_stats=True`` and num_iter>1, add 2·num_blocks·bs² f32
+      (16 blocks × 2 × 64 MB = 2 GB) of cached per-block covariances plus
+      their Woodbury base inverses (``_base_inverse``; the inverse is
+      cached so later passes pay zero bs³ factorizations).
     """
 
     def __init__(self, block_size: int, num_iter: int, lam: float,

@@ -34,6 +34,7 @@ def main() -> None:
     ap.add_argument("--skip-timit", action="store_true")
     ap.add_argument("--skip-mnist", action="store_true")
     ap.add_argument("--skip-text", action="store_true")
+    ap.add_argument("--skip-voc", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -107,6 +108,26 @@ def main() -> None:
         t0 = time.perf_counter()
         run_sb(scfg)
         out["stupid_backoff_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
+
+    if not args.skip_voc:
+        # the image track's anchor: VOC small-config (1024/256 imgs 96²,
+        # vocab 16) — full SIFT→PCA→GMM→FV→solve→mAP on jax-CPU. The
+        # reference-dim config (vocab 256, 9 216 imgs) extrapolates
+        # linearly in images and ~16× in FV/GMM width; stated, not run
+        # (hours on one core).
+        from keystone_tpu.pipelines.voc_sift_fisher import (
+            VOCSIFTFisherConfig,
+            run as run_voc,
+        )
+
+        vcfg = VOCSIFTFisherConfig(
+            synthetic_train=1024, synthetic_test=256, vocab_size=16,
+            num_pca_samples=1000000, num_gmm_samples=1000000,
+        )
+        run_voc(vcfg)  # cold
+        t0 = time.perf_counter()
+        run_voc(vcfg)
+        out["voc_small_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
 
     if not args.skip_timit:
         from keystone_tpu.pipelines.timit import TimitConfig, run as run_timit

@@ -260,3 +260,28 @@ def test_grouped_getter_caches_once_per_group(rng):
     full = np.asarray(raw["x"])
     np.testing.assert_allclose(np.concatenate(out, axis=1), full)
     clear()
+
+
+def test_fv_cols_batch_matches_per_image(rng):
+    """The flat-gemm batched FV (_fv_cols_batch, global affine params) must
+    agree with the per-image centered path (_fv_cols) — same math, different
+    schedule — across column ranges and descriptor scales."""
+    from keystone_tpu.ops.images.fisher_vector import _fv_cols, _fv_cols_batch
+
+    k, d = 8, 16
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=15).fit(
+        jnp.asarray(rng.normal(size=(400, d)).astype(np.float32))
+    )
+    for scale in (1.0, 8.0):
+        descs = jnp.asarray(
+            scale * rng.normal(size=(5, 30, d)).astype(np.float32)
+        )
+        for lo, hi in ((0, 2 * k), (0, 4), (6, 12), (k, 2 * k)):
+            ref = np.stack(
+                [np.asarray(_fv_cols(D, gmm, lo, hi)) for D in descs]
+            )
+            got = np.asarray(_fv_cols_batch(descs, gmm, lo, hi))
+            np.testing.assert_allclose(
+                got, ref, rtol=2e-4, atol=2e-5,
+                err_msg=f"scale={scale} cols=[{lo},{hi})",
+            )
