@@ -1,0 +1,34 @@
+# Build/test/bench entry points (the reference's Makefile builds its JNI
+# native layer, Makefile:66-110; here the native layer is two small ctypes
+# libraries that also self-build lazily on first import — `make native`
+# just builds them eagerly).
+
+PY ?= python
+
+.PHONY: all native test bench cpu-baseline flagship clean
+
+all: native test
+
+native: keystone_tpu/native/_ingest.so keystone_tpu/native/_ngram.so
+
+keystone_tpu/native/_ingest.so: keystone_tpu/native/ingest.cpp
+	$(PY) -c "from keystone_tpu.native import ingest; ingest.ensure_built()"
+
+keystone_tpu/native/_ngram.so: keystone_tpu/native/ngram.cpp
+	$(PY) -c "from keystone_tpu.native import ngram; ngram.ensure_built()"
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+cpu-baseline:
+	JAX_PLATFORMS=cpu $(PY) scripts/cpu_baseline.py
+
+flagship:
+	$(PY) scripts/flagship_imagenet.py --warm
+
+clean:
+	rm -f keystone_tpu/native/_ingest.so keystone_tpu/native/_ngram.so \
+	      keystone_tpu/native/*.srchash

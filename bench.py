@@ -110,10 +110,13 @@ _EXTRA_PIPELINES = (
           common_features=100000)),
     ("stupid_backoff_20k_warm_s", "keystone_tpu.pipelines.stupid_backoff",
      "StupidBackoffConfig", dict(synthetic_docs=20000)),
+    # the small-config image rows use the pipelines' shared small_config()
+    # factories — the CPU anchor (scripts/cpu_baseline.py) measures the
+    # exact same construction, so the vs-CPU ratios cannot drift
     ("voc_small_warm_s", "keystone_tpu.pipelines.voc_sift_fisher",
-     "VOCSIFTFisherConfig",
-     dict(synthetic_train=1024, synthetic_test=256, vocab_size=16,
-          num_pca_samples=1000000, num_gmm_samples=1000000)),
+     "small_config", {}),
+    ("imagenet_small_warm_s", "keystone_tpu.pipelines.imagenet_sift_lcs_fv",
+     "small_config", {}),
 )
 
 
@@ -177,6 +180,31 @@ def main():
     if os.environ.get("BENCH_EXTRAS", "1") != "0":
         out["solver_gflops_per_chip_f32_highest"] = _try_solver_gflops("highest")
     out.update(_try_extras())
+    if os.environ.get("BENCH_FLAGSHIP", "0") == "1":
+        # Opt-in: the reference-dim streaming ImageNet regime (BASELINE.md
+        # flagship row) — ~2-6 min cold compile + ~25 s warm, so not part
+        # of the default bench budget.
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir", "/tmp/keystone_xla_cache"
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+            from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+                flagship_config,
+                run as run_flagship,
+            )
+
+            fcfg = flagship_config()
+            run_flagship(fcfg)
+            out["imagenet_refdim_streaming_warm_s"] = round(
+                run_flagship(fcfg)["wallclock_s"], 3
+            )
+        except Exception as e:
+            print(f"flagship bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            out["imagenet_refdim_streaming_warm_s"] = None
     timit_cpu = (anchor or {}).get("timit_cpu_warm_extrapolated_s")
     timit_tpu = out.get("timit_100k_50x4096_5ep_warm_s")
     if timit_cpu and timit_tpu:
@@ -188,6 +216,8 @@ def main():
          "stupid_backoff_vs_cpu_baseline"),
         ("voc_small_cpu_warm_s", "voc_small_warm_s",
          "voc_small_vs_cpu_baseline"),
+        ("imagenet_small_cpu_warm_s", "imagenet_small_warm_s",
+         "imagenet_small_vs_cpu_baseline"),
     ):
         cpu_s, tpu_s = (anchor or {}).get(cpu_key), out.get(tpu_key)
         if cpu_s and tpu_s:

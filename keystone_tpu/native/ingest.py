@@ -106,6 +106,14 @@ def native_available() -> bool:
     return _get_lib() is not None
 
 
+def ensure_built() -> None:
+    """Eagerly build/load the native library (``make native``); raises if
+    the toolchain cannot produce it (the lazy import path would fall back
+    to numpy/pure-Python instead)."""
+    if _get_lib() is None:
+        raise RuntimeError("failed to build ingest native library (see log)")
+
+
 def decode_jpeg(data: bytes) -> Optional[np.ndarray]:
     """JPEG bytes -> (h, w, 3) uint8 RGB, or None if undecodable."""
     lib = _get_lib()

@@ -80,6 +80,14 @@ def native_available() -> bool:
     return _get_lib() is not None
 
 
+def ensure_built() -> None:
+    """Eagerly build/load the native library (``make native``); raises if
+    the toolchain cannot produce it (the lazy import path would fall back
+    to numpy/pure-Python instead)."""
+    if _get_lib() is None:
+        raise RuntimeError("failed to build ngram native library (see log)")
+
+
 def _count_by_key_np(
     keys: np.ndarray, weights: Optional[np.ndarray]
 ) -> Tuple[np.ndarray, np.ndarray]:

@@ -274,6 +274,51 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
     return results
 
 
+def flagship_config(**overrides) -> ImageNetSiftLcsFVConfig:
+    """The measured reference-dim streaming configuration (BASELINE.md
+    flagship row; `ImageNetSiftLcsFV.scala:197-218` dims): vocab 256,
+    PCA-64, 2 branches → d=65 536, 1000 classes, out-of-core weighted BCD.
+    Used by ``scripts/flagship_imagenet.py`` and ``BENCH_FLAGSHIP=1``."""
+    cfg = dict(
+        sift_pca_dim=64,
+        lcs_pca_dim=64,
+        vocab_size=256,
+        num_pca_samples=2000000,
+        num_gmm_samples=2000000,
+        lam=6e-5,
+        mixture_weight=0.25,
+        block_size=4096,
+        synthetic_train=102400,
+        synthetic_test=5120,
+        synthetic_classes=1000,
+        synthetic_hw=64,
+        streaming=True,
+        extract_chunk=2048,
+        sample_images=8192,
+        fv_row_chunk=1024,
+        # 2-block cache groups: the 16 GB chip holds descriptors (~6.4 GB
+        # bf16) + the bf16 group buffer + residual/solve state; wider
+        # groups OOM at this n
+        fv_cache_blocks=2,
+    )
+    cfg.update(overrides)
+    return ImageNetSiftLcsFVConfig(**cfg)
+
+
+def small_config(**overrides) -> ImageNetSiftLcsFVConfig:
+    """The BASELINE.md small-config row (2048/512 imgs 64², 16 classes,
+    vocab 16) — ONE definition shared by ``bench.py`` and
+    ``scripts/cpu_baseline.py`` so the TPU/CPU sides of
+    ``imagenet_small_vs_cpu_baseline`` can never drift apart."""
+    cfg = dict(
+        synthetic_train=2048, synthetic_test=512, synthetic_classes=16,
+        vocab_size=16, sift_pca_dim=64, lcs_pca_dim=64,
+        num_pca_samples=1000000, num_gmm_samples=1000000,
+    )
+    cfg.update(overrides)
+    return ImageNetSiftLcsFVConfig(**cfg)
+
+
 def run(config: ImageNetSiftLcsFVConfig) -> dict:
     if config.streaming:
         if config.train_location:

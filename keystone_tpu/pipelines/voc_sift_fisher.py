@@ -55,6 +55,19 @@ class VOCSIFTFisherConfig:
     row_chunks: int = 1
 
 
+def small_config(**overrides) -> VOCSIFTFisherConfig:
+    """The BASELINE.md small-config row (1024/256 imgs 96², vocab 16) —
+    ONE definition shared by ``bench.py`` and ``scripts/cpu_baseline.py``
+    so the TPU/CPU sides of ``voc_small_vs_cpu_baseline`` can never drift
+    apart."""
+    cfg = dict(
+        synthetic_train=1024, synthetic_test=256, vocab_size=16,
+        num_pca_samples=1000000, num_gmm_samples=1000000,
+    )
+    cfg.update(overrides)
+    return VOCSIFTFisherConfig(**cfg)
+
+
 def run(config: VOCSIFTFisherConfig) -> dict:
     if config.train_location:
         hw = (config.image_hw, config.image_hw)

@@ -34,7 +34,7 @@ def main() -> None:
     ap.add_argument("--skip-timit", action="store_true")
     ap.add_argument("--skip-mnist", action="store_true")
     ap.add_argument("--skip-text", action="store_true")
-    ap.add_argument("--skip-voc", action="store_true")
+    ap.add_argument("--skip-images", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -109,25 +109,34 @@ def main() -> None:
         run_sb(scfg)
         out["stupid_backoff_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
 
-    if not args.skip_voc:
-        # the image track's anchor: VOC small-config (1024/256 imgs 96²,
-        # vocab 16) — full SIFT→PCA→GMM→FV→solve→mAP on jax-CPU. The
-        # reference-dim config (vocab 256, 9 216 imgs) extrapolates
+    if not args.skip_images:
+        # the image track's anchors: VOC small-config (1024/256 imgs 96²,
+        # vocab 16) and ImageNet small-config (2048/512 imgs 64², SIFT+LCS
+        # branches) — full extract→PCA→GMM→FV→solve→eval on jax-CPU. The
+        # reference-dim configs (vocab 256, 1000 classes) extrapolate
         # linearly in images and ~16× in FV/GMM width; stated, not run
         # (hours on one core).
         from keystone_tpu.pipelines.voc_sift_fisher import (
-            VOCSIFTFisherConfig,
+            small_config as voc_small_config,
             run as run_voc,
         )
 
-        vcfg = VOCSIFTFisherConfig(
-            synthetic_train=1024, synthetic_test=256, vocab_size=16,
-            num_pca_samples=1000000, num_gmm_samples=1000000,
-        )
+        vcfg = voc_small_config()  # the SAME construction bench.py times
         run_voc(vcfg)  # cold
         t0 = time.perf_counter()
         run_voc(vcfg)
         out["voc_small_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
+
+        from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+            small_config as imagenet_small_config,
+            run as run_imagenet,
+        )
+
+        icfg = imagenet_small_config()
+        run_imagenet(icfg)  # cold
+        t0 = time.perf_counter()
+        run_imagenet(icfg)
+        out["imagenet_small_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
 
     if not args.skip_timit:
         from keystone_tpu.pipelines.timit import TimitConfig, run as run_timit

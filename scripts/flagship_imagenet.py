@@ -31,32 +31,14 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
-        ImageNetSiftLcsFVConfig,
+        flagship_config,
         run,
     )
 
-    cfg = ImageNetSiftLcsFVConfig(
-        sift_pca_dim=64,
-        lcs_pca_dim=64,
-        vocab_size=256,
-        num_pca_samples=2000000,
-        num_gmm_samples=2000000,
-        lam=6e-5,
-        mixture_weight=0.25,
-        block_size=4096,
+    cfg = flagship_config(
         synthetic_train=args.train,
         synthetic_test=args.test,
-        synthetic_classes=1000,
-        synthetic_hw=64,
         synthetic_noise=args.noise,
-        streaming=True,
-        extract_chunk=2048,
-        sample_images=8192,
-        fv_row_chunk=1024,
-        # 2-block cache groups: the 16 GB chip holds descriptors (~6.4 GB
-        # bf16) + the bf16 group buffer + residual/solve state; wider groups
-        # give no further posterior savings worth the HBM at this n
-        fv_cache_blocks=2,
     )
     out = {"cold": run(cfg)}
     if args.warm:
