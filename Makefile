@@ -13,9 +13,11 @@ native: keystone_tpu/native/_ingest.so keystone_tpu/native/_ngram.so
 
 keystone_tpu/native/_ingest.so: keystone_tpu/native/ingest.cpp
 	$(PY) -c "from keystone_tpu.native import ingest; ingest.ensure_built()"
+	@touch $@
 
 keystone_tpu/native/_ngram.so: keystone_tpu/native/ngram.cpp
 	$(PY) -c "from keystone_tpu.native import ngram; ngram.ensure_built()"
+	@touch $@
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -306,8 +306,8 @@ def flagship_config(**overrides) -> ImageNetSiftLcsFVConfig:
 
 
 def small_config(**overrides) -> ImageNetSiftLcsFVConfig:
-    """The BASELINE.md small-config row (2048/512 imgs 64², 16 classes,
-    vocab 16) — ONE definition shared by ``bench.py`` and
+    """The BASELINE.md small-config row (2048/512 imgs at the default 96²,
+    16 classes, vocab 16) — ONE definition shared by ``bench.py`` and
     ``scripts/cpu_baseline.py`` so the TPU/CPU sides of
     ``imagenet_small_vs_cpu_baseline`` can never drift apart."""
     cfg = dict(

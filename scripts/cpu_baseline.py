@@ -111,7 +111,7 @@ def main() -> None:
 
     if not args.skip_images:
         # the image track's anchors: VOC small-config (1024/256 imgs 96²,
-        # vocab 16) and ImageNet small-config (2048/512 imgs 64², SIFT+LCS
+        # vocab 16) and ImageNet small-config (2048/512 imgs 96², SIFT+LCS
         # branches) — full extract→PCA→GMM→FV→solve→eval on jax-CPU. The
         # reference-dim configs (vocab 256, 1000 classes) extrapolate
         # linearly in images and ~16× in FV/GMM width; stated, not run

@@ -278,3 +278,41 @@ def test_imagenet_pipeline_end_to_end_on_reference_tar():
     # rank the true class in its top-5 on the training images themselves.
     assert res["test_top5_error"] == 0.0
     assert np.isfinite(res["test_top1_error"])
+
+
+def test_imagenet_streaming_pipeline_on_reference_tar():
+    """The flagship out-of-core path on REAL data: the reference's miniature
+    ImageNet archive through chunked JPEG ingest → SIFT+LCS → PCA/GMM →
+    Fisher cache-grouped block nodes → Woodbury weighted BCD → streaming
+    eval. Same archive as the in-core test above; this pins that streaming
+    mode (fit_streaming + grouped FisherVectorSliceNormalized) is not a
+    synthetic-only configuration."""
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        run as run_imagenet,
+    )
+
+    cfg = ImageNetSiftLcsFVConfig(
+        train_location=os.path.join(_RES, "images/imagenet"),
+        train_labels=os.path.join(_RES, "images/imagenet-test-labels"),
+        test_location=os.path.join(_RES, "images/imagenet"),
+        test_labels=os.path.join(_RES, "images/imagenet-test-labels"),
+        sift_pca_dim=16,
+        lcs_pca_dim=16,
+        vocab_size=4,
+        num_pca_samples=4000,
+        num_gmm_samples=4000,
+        image_hw=128,
+        lam=1e-3,
+        block_size=32,
+        streaming=True,
+        extract_chunk=4,
+        sample_images=8,
+        fv_row_chunk=4,
+        fv_cache_blocks=2,
+        desc_dtype="float32",
+    )
+    res = run_imagenet(cfg)
+    assert res["feature_dim"] == 2 * (16 + 16) * 4
+    assert res["test_top5_error"] == 0.0
+    assert np.isfinite(res["test_top1_error"])
