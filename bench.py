@@ -27,6 +27,21 @@ import time
 import jax
 import jax.numpy as jnp
 
+# Persistent XLA compilation cache: the extras cover seven pipelines whose
+# first-compile cost (~10 min total) would otherwise recur on every bench
+# invocation; with the cache only the first run on a machine pays it. The
+# reported cold_wallclock_s measures THIS process's first run, which on a
+# pre-populated cache is mostly cache-deserialize time — the JSON states
+# the cache state (``xla_cache_prewarmed``) so cold numbers can't be
+# misread across runs.
+_CACHE_DIR = os.environ.get("BENCH_XLA_CACHE", "/tmp/keystone_xla_cache")
+_CACHE_PREWARMED = os.path.isdir(_CACHE_DIR) and bool(os.listdir(_CACHE_DIR))
+try:
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception as e:  # never let cache config block the benchmark
+    print(f"compilation cache unavailable: {e}", file=sys.stderr)
+
 def _load_cpu_baseline():
     """The measured CPU anchor (scripts/cpu_baseline.py); None if absent."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -172,6 +187,7 @@ def main():
             "mnist_cpu_warm_s": anchor_s,
         },
         "cold_wallclock_s": round(cold_s, 3),
+        "xla_cache_prewarmed": _CACHE_PREWARMED,
         "train_error_pct": round(warm["train_error"], 3),
         "test_error_pct": round(warm["test_error"], 3),
         "solver_gflops_per_chip": _try_solver_gflops(),
@@ -185,12 +201,6 @@ def main():
         # flagship row) — ~2-6 min cold compile + ~25 s warm, so not part
         # of the default bench budget.
         try:
-            jax.config.update(
-                "jax_compilation_cache_dir", "/tmp/keystone_xla_cache"
-            )
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 1.0
-            )
             from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
                 flagship_config,
                 run as run_flagship,

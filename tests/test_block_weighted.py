@@ -335,3 +335,59 @@ def test_woodbury_class_solves_match_dense(rng, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(m_wood.b), np.asarray(m_dense.b), atol=2e-4
     )
+
+
+def test_weighted_streaming_grouped_fisher_sharded_mesh(rng, devices):
+    """The full flagship configuration shape on the 8-device mesh:
+    row-sharded bf16 descriptors + cache-grouped Fisher block nodes +
+    bf16 group cache + Woodbury-eligible class buckets, through
+    fit_streaming and streaming_predict, vs the unsharded f32 reference."""
+    from keystone_tpu.learning.block_linear import streaming_predict
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_fisher_block_nodes,
+    )
+    from keystone_tpu.parallel import distribute, make_mesh, use_mesh
+
+    k, d = 4, 16
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=10).fit(
+        jnp.asarray(rng.normal(size=(300, d)).astype(np.float32))
+    )
+    # n NOT divisible by 8: distribute() really pads, so masked rows flow
+    # through the grouped featurization, solves, and predict paths
+    n, c = 100, 24  # ~4 rows/class -> every bucket takes the Woodbury path
+    descs = jnp.asarray(rng.normal(size=(n, 10, d)).astype(np.float32))
+    labels = np.concatenate([np.arange(c), rng.choice(c, size=n - c)]).astype(np.int32)
+    rng.shuffle(labels)
+    ind = np.asarray(ClassLabelIndicatorsFromIntLabels(c)(jnp.asarray(labels)))
+    bs = 2 * d  # 4 blocks per branch-width 2k*d = 128 -> block 32
+    nodes = make_fisher_block_nodes(gmm, block_size=bs, cache_blocks=2)
+    l1 = fisher_l1_norms(descs, gmm, chunk=32)
+
+    est = BlockWeightedLeastSquaresEstimator(bs, 1, 0.05, 0.25)
+    m_ref = est.fit_streaming(nodes, {"descs": descs, "l1": l1}, jnp.asarray(ind))
+
+    with use_mesh(make_mesh()):
+        ds = distribute(descs)  # pads to /8, row-shards, masks
+        n_pad = ds.data.shape[0]
+        l1_p, _ = pad_rows(l1[:, None], n_pad)
+        ind_p, _ = pad_rows(jnp.asarray(ind), n_pad)
+        raw = {
+            "descs": jnp.asarray(ds.data, jnp.bfloat16),
+            # pad l1 with 1s: padded rows divide by it before masking
+            "l1": jnp.where(ds.mask > 0, l1_p[:, 0], 1.0),
+        }
+        m_sh = est.fit_streaming(
+            nodes, raw, ind_p, mask=ds.mask, cache_dtype=jnp.bfloat16
+        )
+        preds = streaming_predict(m_sh, nodes, raw, jnp.bfloat16)
+    # bf16 descriptors + bf16 group cache: expect ~3-digit agreement
+    ref_w = np.asarray(m_ref.w)
+    np.testing.assert_allclose(
+        np.asarray(m_sh.w), ref_w, atol=0.05 * np.abs(ref_w).max() + 1e-3
+    )
+    p_ref = np.asarray(streaming_predict(m_ref, nodes, {"descs": descs, "l1": l1}))
+    np.testing.assert_allclose(
+        np.asarray(preds)[:n], p_ref, atol=0.05 * np.abs(p_ref).max() + 1e-3
+    )
