@@ -350,19 +350,26 @@ def test_weighted_streaming_grouped_fisher_sharded_mesh(rng, devices):
     )
     from keystone_tpu.parallel import distribute, make_mesh, use_mesh
 
-    k, d = 4, 16
+    import keystone_tpu.learning.block_weighted as bw
+
+    k, d = 4, 32
     gmm = GaussianMixtureModelEstimator(k=k, num_iter=10).fit(
         jnp.asarray(rng.normal(size=(300, d)).astype(np.float32))
     )
     # n NOT divisible by 8: distribute() really pads, so masked rows flow
     # through the grouped featurization, solves, and predict paths
-    n, c = 100, 24  # ~4 rows/class -> every bucket takes the Woodbury path
+    n, c = 100, 24
     descs = jnp.asarray(rng.normal(size=(n, 10, d)).astype(np.float32))
     labels = np.concatenate([np.arange(c), rng.choice(c, size=n - c)]).astype(np.int32)
     rng.shuffle(labels)
     ind = np.asarray(ClassLabelIndicatorsFromIntLabels(c)(jnp.asarray(labels)))
-    bs = 2 * d  # 4 blocks per branch-width 2k*d = 128 -> block 32
+    # bs=128: the ~4-row classes land in min-chunk-8 buckets, and
+    # 8 + 1 <= 128//8 crosses the Woodbury threshold — the flagship
+    # combination (Woodbury + sharding + bf16 cache) genuinely runs
+    bs = 4 * d  # 2 blocks over the 2k*d = 256 branch width
+    assert bw._use_woodbury(8, bs)
     nodes = make_fisher_block_nodes(gmm, block_size=bs, cache_blocks=2)
+    assert nodes[0].cache_group is not None  # grouping active too
     l1 = fisher_l1_norms(descs, gmm, chunk=32)
 
     est = BlockWeightedLeastSquaresEstimator(bs, 1, 0.05, 0.25)

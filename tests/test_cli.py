@@ -1,0 +1,94 @@
+"""CLI launcher tests: `bin/run-pipeline` / `keystone_tpu.cli`.
+
+Reference surface: ``bin/run-pipeline.sh`` (class + flags dispatch,
+``run-pipeline.sh:9-28``); the cluster-launch flags map to
+``jax.distributed.initialize`` (multi-process execution itself is covered
+by ``tests/test_multihost.py``).
+"""
+
+import io
+import sys
+
+import pytest
+
+from keystone_tpu import cli
+
+
+def _run_capture(argv):
+    out, err = io.StringIO(), io.StringIO()
+    old = sys.stdout, sys.stderr
+    sys.stdout, sys.stderr = out, err
+    try:
+        rc = cli.main(argv)
+    finally:
+        sys.stdout, sys.stderr = old
+    return rc, out.getvalue(), err.getvalue()
+
+
+def test_help_lists_every_pipeline():
+    rc, out, _ = _run_capture(["--help"])
+    assert rc == 0
+    for name in cli.PIPELINES:
+        assert name in out
+
+
+def test_every_pipeline_parses_help():
+    """Each registered pipeline must import and expose a parseable config
+    (argparse --help exits 0) — catches registry typos and import rot."""
+    import importlib
+
+    for name, module in cli.PIPELINES.items():
+        mod = importlib.import_module(module)
+        with pytest.raises(SystemExit) as e:
+            _run_capture_help = io.StringIO()
+            old = sys.stdout
+            sys.stdout = _run_capture_help
+            try:
+                mod.main(["--help"])
+            finally:
+                sys.stdout = old
+        assert e.value.code == 0, name
+
+
+def test_empty_and_unknown_names_error_cleanly():
+    rc, out, _ = _run_capture([])
+    assert rc == 2
+    # unknown name reports an error instead of raising
+    rc, _, err = _run_capture(["NoSuchPipeline"])
+    assert rc == 2 and "unknown pipeline" in err
+
+
+def test_case_insensitive_name_resolves(monkeypatch):
+    import importlib
+
+    called = {}
+    mod = importlib.import_module(cli.PIPELINES["MnistRandomFFT"])
+    monkeypatch.setattr(mod, "main", lambda rest: called.setdefault("argv", rest))
+    rc, _, _ = _run_capture(["MNISTRANDOMFFT"])
+    assert rc == 0 and called["argv"] == []
+
+
+def test_partial_distributed_flags_refused():
+    rc, _, err = _run_capture(
+        ["--num-processes", "2", "MnistRandomFFT"]
+    )
+    assert rc == 2
+    assert "require --coordinator" in err
+
+
+def test_mesh_model_must_divide_devices():
+    rc, _, err = _run_capture(["--mesh-model", "7", "MnistRandomFFT"])
+    assert rc == 2
+    assert "does not divide" in err
+
+
+def test_snake_case_resolves(monkeypatch):
+    """mnist_random_fft resolves to MnistRandomFFT and runs its main."""
+    import importlib
+
+    called = {}
+    mod = importlib.import_module(cli.PIPELINES["MnistRandomFFT"])
+    monkeypatch.setattr(mod, "main", lambda rest: called.setdefault("argv", rest))
+    rc, _, _ = _run_capture(["mnist_random_fft", "--num-ffts", "2"])
+    assert rc == 0
+    assert called["argv"] == ["--num-ffts", "2"]
