@@ -11,6 +11,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import logging
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -31,7 +32,13 @@ def get_logger(name: str = "keystone_tpu") -> logging.Logger:
 class Timer:
     """Context manager recording wall-clock into a shared registry.
 
-    Blocks on device work at exit so timings are honest under async dispatch.
+    By default a Timer measures *dispatch* time: exit flushes async dispatch
+    (``jax.effects_barrier``) but does NOT wait for queued device programs —
+    under the pipelines' single-sync design, stage timers therefore read as
+    enqueue + backpressure, and only end-to-end timers (whose bodies force a
+    result) are device time. Set ``KEYSTONE_SYNC_TIMERS=1`` to hard-barrier
+    every local device at each Timer exit for honest per-stage device
+    timings (diagnostics only: each barrier costs a host round-trip).
     """
 
     registry: Dict[str, List[float]] = {}
@@ -48,9 +55,29 @@ class Timer:
 
     def __exit__(self, *exc):
         if self.block:
-            # Flush any outstanding async device work before reading the clock.
+            # Flush any outstanding async dispatch before reading the clock.
             try:
                 jax.effects_barrier()
+            except Exception:
+                pass
+        if os.environ.get("KEYSTONE_SYNC_TIMERS", "0") == "1":
+            # Diagnostics mode: hard-barrier EVERY local device. Each device
+            # executes its queued programs in order, so a fresh marker put on
+            # it completes only after everything enqueued before — per-stage
+            # timings then measure device time, not enqueue+backpressure.
+            # Costs host round-trips per Timer (~100 ms each over a tunnel);
+            # keep OFF for benchmarking (the async single-sync design is the
+            # point). Multi-controller note: this barriers THIS process's
+            # devices; remote hosts' tails are not observed.
+            try:
+                import numpy as _np
+
+                for _d in jax.local_devices():
+                    # a computation (not a bare transfer, which can ride the
+                    # DMA path concurrently) so it queues behind the device's
+                    # in-order program stream
+                    m = jax.device_put(_np.float32(time.perf_counter() % 1.0), _d)
+                    jax.block_until_ready(m + 1.0)
             except Exception:
                 pass
         self.elapsed = time.perf_counter() - self._t0
