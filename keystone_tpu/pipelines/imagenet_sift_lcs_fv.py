@@ -230,13 +230,21 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             raw_train, train_labels = reduce_split(train_src, use_cache=True)
         desc_cache.clear()  # nothing may pin raw descriptors past this point
 
-        nodes = make_fisher_block_nodes(
-            gmm_s, config.block_size, key="sift", l1_key="l1_sift",
-            row_chunk=config.fv_row_chunk, cache_blocks=config.fv_cache_blocks,
-        ) + make_fisher_block_nodes(
-            gmm_l, config.block_size, key="lcs", l1_key="l1_lcs",
-            row_chunk=config.fv_row_chunk, cache_blocks=config.fv_cache_blocks,
-        )
+        blocks_s = 2 * config.vocab_size // (config.block_size // config.sift_pca_dim)
+        blocks_l = 2 * config.vocab_size // (config.block_size // config.lcs_pca_dim)
+
+        def make_nodes(cache_s: int, cache_l: int):
+            """Both branches' block nodes — ONE construction site so solver
+            and eval features can only differ in cache grouping."""
+            return make_fisher_block_nodes(
+                gmm_s, config.block_size, key="sift", l1_key="l1_sift",
+                row_chunk=config.fv_row_chunk, cache_blocks=cache_s,
+            ) + make_fisher_block_nodes(
+                gmm_l, config.block_size, key="lcs", l1_key="l1_lcs",
+                row_chunk=config.fv_row_chunk, cache_blocks=cache_l,
+            )
+
+        nodes = make_nodes(config.fv_cache_blocks, config.fv_cache_blocks)
         cache_dtype = jnp.dtype(config.fv_cache_dtype) if config.fv_cache_blocks else None
         labels_ind = ClassLabelIndicatorsFromIntLabels(num_classes)(
             jnp.asarray(train_labels)
@@ -254,8 +262,27 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
         with Timer("eval.top5_streaming"):
             with Timer("eval.reduce_test"):
                 raw_test, test_labels = reduce_split(test_src)
+            # Test-side nodes regroup to FULL-branch cache groups when a
+            # branch's test FV fits a modest budget: one posterior pass per
+            # branch instead of blocks/fv_cache_blocks passes (the solver's
+            # groups are sized for the 10-20x larger train set). Each
+            # branch gated on its OWN buffer size in the actual cache dtype.
+            eval_nodes = nodes
+            if config.fv_cache_blocks:
+                item = cache_dtype.itemsize
+                budget = 1 << 30  # per-branch group-buffer cap
+
+                def eval_cache(blocks: int) -> int:
+                    bytes_ = test_src.n * blocks * config.block_size * item
+                    return blocks if bytes_ < budget else config.fv_cache_blocks
+
+                eval_nodes = make_nodes(
+                    eval_cache(blocks_s), eval_cache(blocks_l)
+                )
             with Timer("eval.predict"):
-                scores = streaming_predict(model, nodes, raw_test, cache_dtype)
+                scores = streaming_predict(
+                    model, eval_nodes, raw_test, cache_dtype
+                )
             top5 = TopKClassifier(k=min(5, num_classes))(scores)
             results["test_top5_error"] = get_err_percent(top5, test_labels)
             top1 = TopKClassifier(k=1)(scores)

@@ -72,12 +72,17 @@ class Timer:
             try:
                 import numpy as _np
 
-                for _d in jax.local_devices():
-                    # a computation (not a bare transfer, which can ride the
-                    # DMA path concurrently) so it queues behind the device's
-                    # in-order program stream
-                    m = jax.device_put(_np.float32(time.perf_counter() % 1.0), _d)
-                    jax.block_until_ready(m + 1.0)
+                # enqueue a marker COMPUTATION on every device (a bare
+                # transfer can ride the DMA path concurrently with compute),
+                # then block on all of them at once so the per-device waits
+                # overlap — ~one host round-trip per Timer exit, not one per
+                # device
+                markers = [
+                    jax.device_put(_np.float32(time.perf_counter() % 1.0), _d)
+                    + 1.0
+                    for _d in jax.local_devices()
+                ]
+                jax.block_until_ready(markers)
             except Exception:
                 pass
         self.elapsed = time.perf_counter() - self._t0
