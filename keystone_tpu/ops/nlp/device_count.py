@@ -1,0 +1,152 @@
+"""Device-side keyed counting: sort + segment-reduce over packed int64 keys.
+
+Reference: ``nodes/nlp/ngrams.scala:150-183`` (``NGramsCounts``: per-partition
+``JHashMap`` counting merged by ``reduceByKey``) and
+``StupidBackoff.scala:156-159`` (``reduceByKey`` under the backoff
+partitioner). The reference counts on CPU executors with hash maps; here the
+count *is* a device program — the same sort + segment-reduce XLA primitives
+the scoring side already uses (``stupid_backoff.py``), so the whole
+fit-to-score path runs on chip without per-n-gram host objects.
+
+Everything is static-shape jittable: variable-size results (the set of
+distinct keys) are returned **sentinel-padded** to the input length, with the
+true size as a traced scalar. The sentinel is ``int64 max``, which is
+strictly greater than any packable key, so padded tables remain valid inputs
+to ``searchsorted``-based lookup (a padded slot can never equal a real query
+key, and its count is 0).
+
+All entry points require x64 (wrap calls in ``with jax.enable_x64():`` —
+the packed-key convention of ``indexers.PackedNGramIndexer``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SENTINEL = np.iinfo(np.int64).max
+
+
+def window_keys(
+    ids: jnp.ndarray, lengths: jnp.ndarray, order: int, word_bits: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """All order-``order`` n-gram windows of a padded id batch, as packed keys.
+
+    ``ids``: int ``[D, L]`` (pad/OOV = -1), ``lengths``: ``[D]``. Returns
+    ``(keys [D*(L-order+1)], valid bool [same])`` — the device analog of
+    :func:`~keystone_tpu.ops.nlp.ngrams.encoded_ngrams` +
+    ``PackedNGramIndexer.pack_batch`` fused: farthest word in the highest
+    bits (lexicographic sort order). Windows that cross the true length or
+    contain an OOV id are invalid. ``L < order`` yields empty outputs.
+
+    Keys are int32 when ``order * word_bits <= 31`` (the downstream sort —
+    the dominant cost — is ~2x cheaper in 32 bits), int64 otherwise; callers
+    widen as needed.
+    """
+    # <= 30 (not 31): the int32 sentinel (2^31-1) must stay strictly above
+    # every packable key
+    dt = jnp.int32 if order * word_bits <= 30 else jnp.int64
+    d, max_len = ids.shape
+    w = max_len - order + 1
+    if w <= 0:
+        z = jnp.zeros((0,), dt)
+        return z, jnp.zeros((0,), bool)
+    key = ids[:, :w].astype(dt)
+    ok = ids[:, :w] >= 0
+    for j in range(1, order):
+        nxt = ids[:, j : w + j]
+        key = (key << word_bits) | jnp.where(nxt >= 0, nxt, 0).astype(dt)
+        ok &= nxt >= 0
+    pos = jnp.arange(w)[None, :]
+    ok &= pos + order <= lengths[:, None]
+    return key.reshape(-1), ok.reshape(-1)
+
+
+def sum_by_key(
+    keys: jnp.ndarray, valid: jnp.ndarray, weights: jnp.ndarray = None
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Group-by-key sum on device: the ``reduceByKey`` primitive.
+
+    Returns ``(uniq_keys [N], totals float32 [N], n_unique int32)``
+    with ``N = len(keys)``: distinct valid keys in ascending order at the
+    front, sentinel (``iinfo(dtype).max``) padding behind, per-key totals
+    aligned (0 on padding). ``weights`` defaults to 1 per valid element
+    (pure counting). Key dtype is preserved (int32 in, int32 out).
+    """
+    n = keys.shape[0]
+    sentinel = np.iinfo(np.dtype(keys.dtype.name)).max
+    if n == 0:
+        return keys, jnp.zeros((0,), jnp.float32), jnp.int32(0)
+    k = jnp.where(valid, keys, sentinel)
+    if weights is None:
+        # pure counting: the weight of a sorted element is just its validity,
+        # which is positional after the sort (valid keys < SENTINEL sort to
+        # the front) — no permutation needed
+        s = jnp.sort(k)
+        sw = (s != sentinel).astype(jnp.float32)
+    else:
+        # co-sort (key, weight) pairs in one pass (cheaper than
+        # argsort + gather)
+        s, sw = jax.lax.sort(
+            (k, jnp.where(valid, weights.astype(jnp.float32), 0.0)), num_keys=1
+        )
+    isvalid = s != sentinel
+    new = jnp.concatenate([isvalid[:1], (s[1:] != s[:-1]) & isvalid[1:]])
+    seg = jnp.maximum(jnp.cumsum(new) - 1, 0)
+    totals = jax.ops.segment_sum(sw, seg, num_segments=n)
+    # scatter each boundary element's key to its segment slot; padding stays
+    # sentinel (non-boundary writes are routed out of bounds and dropped)
+    idx = jnp.where(new, seg, n)
+    uniq = jnp.full((n,), sentinel, k.dtype).at[idx].set(s, mode="drop")
+    return uniq, totals, new.sum().astype(jnp.int32)
+
+
+def count_ngrams_device(
+    ids: jnp.ndarray, lengths: jnp.ndarray, order: int, word_bits: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Count all order-``order`` n-grams of a padded batch on device.
+
+    ``NGramsCounts`` for one order over encoded ids: returns sentinel-padded
+    ``(uniq_keys, counts, n_unique)`` (see :func:`sum_by_key`).
+    """
+    keys, valid = window_keys(ids, lengths, order, word_bits)
+    return sum_by_key(keys, valid)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def unigram_table_device(
+    ids: jnp.ndarray, vocab_size: int, lengths: jnp.ndarray = None
+) -> jnp.ndarray:
+    """Dense per-id counts ``float32 [vocab_size]`` from a padded id batch.
+
+    The device analog of ``WordFrequencyEncoder``'s unigram count map
+    (``WordFrequencyEncoder.scala:13-30``); pad/OOV ids (< 0) are dropped.
+    """
+    flat = ids.reshape(-1)
+    ok = flat >= 0
+    if lengths is not None:
+        pos = jnp.arange(ids.shape[1])[None, :] < lengths[:, None]
+        ok &= pos.reshape(-1)
+    return jax.ops.segment_sum(
+        ok.astype(jnp.float32), jnp.where(ok, flat, 0), num_segments=vocab_size
+    )
+
+
+def frequency_rank_ids(
+    ids: jnp.ndarray, counts: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Re-encode ids so id 0 is the most frequent word (device analog of the
+    fitted ``WordFrequencyEncoder`` vocabulary ordering; ties broken by
+    original id — the host encoder breaks them by first occurrence, which has
+    no tensor analog and is documented as the one divergence).
+
+    Returns ``(ranked_ids [same shape], ranked_counts [vocab])``; pad/OOV
+    ids pass through unchanged.
+    """
+    rank_of = jnp.argsort(jnp.argsort(-counts, stable=True))
+    ranked = jnp.where(ids >= 0, rank_of[jnp.maximum(ids, 0)], ids)
+    return ranked, jnp.sort(counts)[::-1]

@@ -43,54 +43,138 @@ from keystone_tpu.ops.nlp.indexers import PackedNGramIndexer
 DEFAULT_ALPHA = 0.4
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _fit_tables_device(
+    ids: jnp.ndarray,
+    lengths: jnp.ndarray,
+    orders: Tuple[int, ...],
+    word_bits: int,
+    vocab_size: int,
+    uni: Optional[jnp.ndarray] = None,
+):
+    """Count every requested order's n-grams + unigrams in one XLA program.
+
+    Returns ``(uni [vocab] f32, table_keys tuple, table_counts tuple,
+    sizes [n_tables] i32)`` with one (sentinel-padded) table per order in
+    ``2..max(orders)`` — orders not requested get empty tables, matching
+    ``fit_encoded``'s layout. ``uni`` overrides the unigram table (the
+    estimator's encoder-provided counts, which may come from a different
+    corpus than the n-gram batch — the ``fit``/``fit_encoded`` contract);
+    when None it is counted from ``ids`` itself.
+    """
+    from keystone_tpu.ops.nlp.device_count import (
+        count_ngrams_device,
+        unigram_table_device,
+    )
+
+    if uni is None:
+        uni = unigram_table_device(ids, vocab_size, lengths)
+    table_keys, table_counts, sizes = [], [], []
+    for order in range(2, max(orders) + 1):
+        if order in orders:
+            uniq, counts, n = count_ngrams_device(ids, lengths, order, word_bits)
+        else:
+            uniq = jnp.zeros((0,), jnp.int64)
+            counts = jnp.zeros((0,), jnp.float32)
+            n = jnp.int32(0)
+        table_keys.append(uniq)
+        table_counts.append(counts)
+        sizes.append(n)
+    return uni, tuple(table_keys), tuple(table_counts), jnp.stack(sizes)
+
+
+def _table_lookup(model: "StupidBackoffModel", qk: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Count of each order-``k`` packed query key (0 where absent).
+
+    Casts queries to the table's own dtype (fit may keep tables int32 when
+    the packed width allows — value-preserving for any order-k suffix) and
+    picks the searchsorted algorithm by dtype: the co-sorting ``sort`` method
+    is ~19x faster than the binary-search ``scan`` on TPU for int32 keys but
+    ~4x *slower* for int64 (measured, v5e).
+    """
+    if k == 1:
+        ids = jnp.clip(qk, 0, model.unigram_counts.shape[0] - 1).astype(jnp.int32)
+        return model.unigram_counts[ids]
+    tk = model.table_keys[k - 2]
+    tc = model.table_counts[k - 2]
+    if tk.shape[0] == 0:
+        return jnp.zeros(qk.shape, jnp.float32)
+    qk = qk.astype(tk.dtype)
+    method = "sort" if tk.dtype == jnp.int32 else "scan"
+    pos = jnp.clip(jnp.searchsorted(tk, qk, method=method), 0, tk.shape[0] - 1)
+    return jnp.where(tk[pos] == qk, tc[pos], 0.0)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _score_table_device(
+    model: "StupidBackoffModel", i: int, word_bits: int
+) -> jnp.ndarray:
+    """Score table ``i``'s own keys (order ``i+2``) — the ``scoresRDD`` path.
+
+    Exploits self-alignment: the top level's count *is* the table's own count
+    column (no lookup), so an order-2 table scores with zero binary searches
+    (its context counts are the dense unigram array) and an order-k table
+    needs searches only for levels 2..k-1 and the top context.
+    """
+    order = i + 2
+    keys = model.table_keys[i]
+    total = jnp.maximum(model.num_tokens, 1.0)
+
+    def suffix(k: int) -> jnp.ndarray:
+        return keys & jnp.asarray((1 << (k * word_bits)) - 1, keys.dtype)
+
+    score = _table_lookup(model, suffix(1), 1) / total
+    for k in range(2, order):
+        sk = suffix(k)
+        c = _table_lookup(model, sk, k)
+        ctx = _table_lookup(model, sk >> word_bits, k - 1)
+        hit = (c > 0) & (ctx > 0)
+        score = jnp.where(hit, c / jnp.maximum(ctx, 1.0), model.alpha * score)
+    c = model.table_counts[i]  # own counts: trained keys are their own hits
+    ctx = _table_lookup(model, keys >> word_bits, order - 1)
+    hit = (c > 0) & (ctx > 0)
+    return jnp.where(hit, c / jnp.maximum(ctx, 1.0), model.alpha * score)
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _score_batch_device(
     model: "StupidBackoffModel", ngrams: jnp.ndarray, order: int, word_bits: int
 ) -> jnp.ndarray:
     """Score ``[B, order]`` id n-grams; one fused XLA program per (order, shapes).
 
-    Must run under ``jax.experimental.enable_x64`` so the int64 packed keys
+    Must run under ``jax.experimental.enable_x64`` so int64 packed keys
     survive tracing (jax's default 32-bit mode would silently truncate any
-    vocab × order combination wider than 31 bits).
+    vocab × order combination wider than 31 bits). Invalid n-grams (any
+    id < 0) score through the masked fold: every level containing the OOV
+    word misses its table and takes the backoff branch.
     """
     b = ngrams.shape[0]
+    dt = jnp.int32 if order * word_bits <= 30 else jnp.int64
+
+    # Pack the full n-gram once; per-level masks carve out suffixes. An OOV
+    # id packs as 0 but its level is forced onto the backoff branch below.
+    key = jnp.where(ngrams[:, 0] >= 0, ngrams[:, 0], 0).astype(dt)
+    for i in range(1, order):
+        key = (key << word_bits) | jnp.where(
+            ngrams[:, i] >= 0, ngrams[:, i], 0
+        ).astype(dt)
+
     total = jnp.maximum(model.num_tokens, 1.0)
 
-    def lookup(keys: jnp.ndarray, valid: jnp.ndarray, k: int):
-        """Count of each order-k packed key (0 where absent/invalid)."""
-        if k == 1:
-            ids = jnp.clip(keys, 0, model.unigram_counts.shape[0] - 1).astype(jnp.int32)
-            c = model.unigram_counts[ids]
-        else:
-            tk = model.table_keys[k - 2]
-            tc = model.table_counts[k - 2]
-            if tk.shape[0] == 0:
-                return jnp.zeros_like(keys, dtype=jnp.float32)
-            pos = jnp.searchsorted(tk, keys)
-            pos = jnp.clip(pos, 0, tk.shape[0] - 1)
-            c = jnp.where(tk[pos] == keys, tc[pos], 0.0)
-        return jnp.where(valid, c, 0.0)
+    def lookup(qk: jnp.ndarray, valid: jnp.ndarray, k: int):
+        return jnp.where(valid, _table_lookup(model, qk, k), 0.0)
 
-    def pack_suffix(k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """Packed key of the last-k-word suffix + validity (no OOV ids)."""
-        suffix = ngrams[:, order - k :]
-        valid = jnp.all(suffix >= 0, axis=1)
-        key = suffix[:, 0].astype(jnp.int64)
-        for i in range(1, k):
-            key = (key << word_bits) | jnp.where(
-                suffix[:, i] >= 0, suffix[:, i], 0
-            ).astype(jnp.int64)
-        return key, valid
+    def suffix(k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        sk = key & jnp.asarray((1 << (k * word_bits)) - 1, dt) if k < order else key
+        valid = jnp.all(ngrams[:, order - k :] >= 0, axis=1)
+        return sk, valid
 
-    # Bottom-up backoff fold.
-    uni_keys, uni_valid = pack_suffix(1)
+    uni_keys, uni_valid = suffix(1)
     score = lookup(uni_keys, uni_valid, 1) / total
     for k in range(2, order + 1):
-        keys, valid = pack_suffix(k)
-        c = lookup(keys, valid, k)
-        # context of the k-suffix = its first k-1 words = drop current word.
-        ctx_keys = keys >> word_bits
-        ctx = lookup(ctx_keys, valid, k - 1)
+        sk, valid = suffix(k)
+        c = lookup(sk, valid, k)
+        ctx = lookup(sk >> word_bits, valid, k - 1)
         hit = (c > 0) & (ctx > 0)
         score = jnp.where(hit, c / jnp.maximum(ctx, 1.0), model.alpha * score)
     return score.reshape((b,))
@@ -102,6 +186,12 @@ class StupidBackoffModel(Transformer):
     When ``host_tables`` is set (vocab × order too wide for 63-bit packed
     keys), scoring runs the identical recursion on host dict lookups instead
     — the :class:`NGramIndexerImpl`-style tuple-keyed path.
+
+    Tables built on device (:meth:`StupidBackoffEstimator.fit_device`) are
+    **sentinel-padded** to a static length (``device_count.SENTINEL`` keys
+    with count 0 behind the real entries); ``table_sizes`` records the true
+    entry counts. Padding is invisible to lookups — a sentinel slot can never
+    equal a real query key.
     """
 
     jittable: ClassVar[bool] = False
@@ -116,6 +206,11 @@ class StupidBackoffModel(Transformer):
     max_order: int = struct.field(pytree_node=False, default=3)
     # order -> {id_tuple: count}; None on the packed/device path.
     host_tables: Optional[Tuple[Dict[Tuple[int, ...], float], ...]] = struct.field(
+        pytree_node=False, default=None
+    )
+    # true entry count per table when sentinel-padded (device fit); None
+    # means every table is exact-size (host fit).
+    table_sizes: Optional[Tuple[int, ...]] = struct.field(
         pytree_node=False, default=None
     )
 
@@ -169,6 +264,33 @@ class StupidBackoffModel(Transformer):
     def apply_batch(self, ngrams) -> np.ndarray:
         return self.score_batch(np.asarray(ngrams))
 
+    def scores_device(self) -> List[Tuple[jnp.ndarray, jnp.ndarray, int]]:
+        """Score every trained n-gram without leaving the device.
+
+        Returns ``[(order, keys [N], scores float32 [N], true_size), ...]``
+        per non-empty order >= 2 — keys stay packed (scoring operates on them
+        directly, :func:`_score_table_device`) and arrays stay on device.
+        ``fit_device`` trims sentinel padding at fit time, so ``true_size``
+        equals the array length for its models; the size is still returned
+        for host-fit models and any future padded producer. The reference's
+        ``scoresRDD`` without the collect.
+        """
+        if self.host_tables is not None:
+            raise ValueError("scores_device requires packed (device) tables")
+        out = []
+        with jax.enable_x64():
+            for i, keys in enumerate(self.table_keys):
+                if keys.shape[0] == 0:
+                    continue
+                size = (
+                    self.table_sizes[i]
+                    if self.table_sizes is not None
+                    else int(keys.shape[0])
+                )
+                s = _score_table_device(self, i, self.word_bits)
+                out.append((i + 2, jnp.asarray(keys), s, size))
+        return out
+
     def scores_arrays(self) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Score every trained n-gram, as per-order arrays.
 
@@ -187,6 +309,8 @@ class StupidBackoffModel(Transformer):
         for i, keys in enumerate(self.table_keys):
             order = i + 2
             keys_np = np.asarray(keys)
+            if self.table_sizes is not None:
+                keys_np = keys_np[: self.table_sizes[i]]
             if keys_np.size == 0:
                 continue
             ngrams = np.zeros((keys_np.size, order), dtype=np.int32)
@@ -285,6 +409,73 @@ class StupidBackoffEstimator:
             alpha=self.alpha,
             word_bits=indexer.word_bits,
             max_order=max_order,
+        )
+
+    def fit_device(
+        self,
+        ids,
+        lengths,
+        orders: Sequence[int],
+        vocab_size: Optional[int] = None,
+    ) -> StupidBackoffModel:
+        """Fit entirely on device: counting is sort + segment-reduce on chip.
+
+        The device analog of :meth:`fit_encoded` (same tables up to sentinel
+        padding — pinned in ``tests/test_nlp.py``): window packing, n-gram
+        counting (``device_count.count_ngrams_device``), and unigram counts
+        all run as one XLA program over the padded id batch; nothing but the
+        true table sizes (a few scalars) ever returns to the host. The
+        reference pays this as a ``reduceByKey`` shuffle over executor hash
+        maps (``StupidBackoff.scala:156-159``, ``ngrams.scala:150-183``).
+
+        One contract difference from ``fit``/``fit_encoded``, stated: the
+        model's ``max_order`` is ``max(orders)`` as *requested* (a static
+        property of the compiled program), not re-derived from which orders
+        happen to be present in the data. Raises ``ValueError`` when
+        vocab × order overflows 63-bit packing (no silent host fallback —
+        callers choose their fallback path).
+        """
+        orders = tuple(sorted(o for o in set(orders) if o >= 2))
+        if not orders:
+            raise ValueError("fit_device needs at least one order >= 2")
+        max_order = max(orders)
+        if vocab_size is None:
+            vocab_size = (max(self.unigram_counts) + 1) if self.unigram_counts else 1
+        indexer = PackedNGramIndexer(vocab_size, max_order)
+        uni_in = None
+        if self.unigram_counts:
+            # honor the encoder-provided counts (they may come from a
+            # different corpus than this n-gram batch — fit_encoded contract)
+            uni_np = np.zeros((int(vocab_size),), np.float32)
+            for wid, c in self.unigram_counts.items():
+                if wid >= 0:
+                    uni_np[wid] = c
+            uni_in = jnp.asarray(uni_np)
+        with jax.enable_x64():
+            uni, keys, counts, sizes = _fit_tables_device(
+                jnp.asarray(ids),
+                jnp.asarray(lengths),
+                orders,
+                indexer.word_bits,
+                int(vocab_size),
+                uni_in,
+            )
+            table_sizes = tuple(int(s) for s in np.asarray(sizes))
+            # the size pull is the fit's one host sync; once sizes are known
+            # (static), trim the sentinel padding with static slices so every
+            # later lookup binary-searches the true table, not the padded
+            # window count (~6x smaller tables at Zipf-corpus scales)
+            keys = tuple(k[:n] for k, n in zip(keys, table_sizes))
+            counts = tuple(c[:n] for c, n in zip(counts, table_sizes))
+        return StupidBackoffModel(
+            table_keys=keys,
+            table_counts=counts,
+            unigram_counts=uni,
+            num_tokens=uni.sum(),
+            alpha=self.alpha,
+            word_bits=indexer.word_bits,
+            max_order=max_order,
+            table_sizes=table_sizes,
         )
 
     def fit_encoded(

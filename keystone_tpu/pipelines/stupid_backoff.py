@@ -30,6 +30,9 @@ from keystone_tpu.utils import Timer, get_logger
 
 logger = get_logger("keystone_tpu.pipelines.stupid_backoff")
 
+_SYNTH_VOCAB = 500
+_SYNTH_LEN = (5, 30)  # rng.integers bounds: lengths 5..29
+
 
 @dataclasses.dataclass
 class StupidBackoffConfig:
@@ -39,7 +42,14 @@ class StupidBackoffConfig:
     num_sample_scores: int = 100
     synthetic_docs: int = 2000
     seed: int = 42
-    # Vectorized fit over the padded encoded batch (fit_encoded: numpy
+    # Count n-grams ON DEVICE (sort + segment-reduce over packed int64 keys,
+    # ops/nlp/device_count.py) and keep tables/scoring on chip; the synthetic
+    # corpus is likewise generated on device as id tensors (the image
+    # pipelines' protocol — strings never exist for synthetic data). Falls
+    # back to the host paths below when vocab x order overflows 63-bit
+    # packing. Table equivalence vs the host fit pinned in tests/test_nlp.py.
+    device_path: bool = True
+    # Vectorized HOST fit over the padded encoded batch (fit_encoded: numpy
     # windows + packed int64 keys + native count_by_key) instead of per-
     # n-gram Python tuples; table equivalence pinned in tests/test_nlp.py.
     fast_host_path: bool = True
@@ -56,53 +66,155 @@ class StupidBackoffConfig:
 def _synthetic_corpus(num_docs: int, seed: int) -> list:
     """Zipf-distributed token stream with local structure (bigram hops)."""
     rng = np.random.default_rng(seed)
-    vocab = [f"w{i}" for i in range(500)]
+    vocab = [f"w{i}" for i in range(_SYNTH_VOCAB)]
     probs = 1.0 / np.arange(1, len(vocab) + 1)
     probs /= probs.sum()
     docs = []
     for _ in range(num_docs):
-        length = int(rng.integers(5, 30))
+        length = int(rng.integers(*_SYNTH_LEN))
         ids = rng.choice(len(vocab), size=length, p=probs)
         docs.append(" ".join(vocab[i] for i in ids))
     return docs
 
 
+def _synthetic_ids_device(num_docs: int, seed: int):
+    """The same corpus distribution as :func:`_synthetic_corpus`, sampled
+    directly as device id tensors (Zipf over the vocab, uniform lengths) —
+    followed by the WordFrequencyEncoder step on device: re-rank ids by
+    descending corpus frequency so id 0 is the most frequent word.
+
+    Returns ``(ids int32 [D, L], lengths int32 [D], vocab_size)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.nlp.device_count import (
+        frequency_rank_ids,
+        unigram_table_device,
+    )
+
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    probs = 1.0 / jnp.arange(1, _SYNTH_VOCAB + 1, dtype=jnp.float32)
+    max_len = _SYNTH_LEN[1] - 1
+    # inverse-CDF categorical: searchsorted over the cumulative Zipf weights
+    # (log V binary-search steps/token vs the V-way Gumbel reduction of
+    # jax.random.categorical — the sampler is not the benchmark's subject)
+    cdf = jnp.cumsum(probs) / probs.sum()
+    u = jax.random.uniform(k1, (num_docs, max_len))
+    ids = jnp.minimum(
+        jnp.searchsorted(cdf, u), _SYNTH_VOCAB - 1
+    ).astype(jnp.int32)
+    lengths = jax.random.randint(k2, (num_docs,), *_SYNTH_LEN).astype(jnp.int32)
+    counts = unigram_table_device(ids, _SYNTH_VOCAB, lengths)
+    ranked, _ = frequency_rank_ids(ids, counts)
+    return ranked, lengths, _SYNTH_VOCAB
+
+
 def run(config: StupidBackoffConfig) -> dict:
+    lines = None
     if config.text_path:
         with open(config.text_path) as f:
             lines = [ln for ln in f.read().splitlines() if ln.strip()]
-    else:
+    elif not config.device_path:
         lines = _synthetic_corpus(config.synthetic_docs, config.seed)
 
     results: dict = {}
     orders = tuple(range(2, config.n + 1))
     with Timer("StupidBackoffPipeline") as total:
-        tokens = Tokenizer("[\\s]+")(lines)
-        encoder = WordFrequencyEncoder().fit(tokens)
-        estimator = StupidBackoffEstimator(encoder.unigram_counts, config.alpha)
-        if config.fast_host_path:
-            ids, lengths = encoder.encode_padded(tokens)
-            model = estimator.fit_encoded(ids, lengths, orders)
-            num_ngrams = int(sum(k.shape[0] for k in model.table_keys))
+        if lines is not None:
+            tokens = Tokenizer("[\\s]+")(lines)
+            encoder = WordFrequencyEncoder().fit(tokens)
+            vocab_size = encoder.vocab_size
+            estimator = StupidBackoffEstimator(encoder.unigram_counts, config.alpha)
         else:
+            ids, lengths, vocab_size = _synthetic_ids_device(
+                config.synthetic_docs, config.seed
+            )
+            estimator = StupidBackoffEstimator({}, config.alpha)
+
+        model = None
+        encoded_pad = None
+        if config.device_path:
+            if lines is not None:
+                encoded_pad = encoder.encode_padded(tokens)
+                ids, lengths = encoded_pad
+            try:
+                model = estimator.fit_device(ids, lengths, orders, vocab_size)
+            except ValueError as e:
+                logger.info("device fit unavailable (%s); host fit", e)
+                if lines is None:
+                    ids, lengths = np.asarray(ids), np.asarray(lengths)
+        if model is None and (config.fast_host_path or not lines):
+            if lines is not None:
+                ids, lengths = encoded_pad or encoder.encode_padded(tokens)
+            if not config.text_path and lines is None:
+                # rebuild the encoder contract host-side: ids are already
+                # frequency-ranked, counts come from the id batch itself
+                estimator = StupidBackoffEstimator(
+                    _unigram_dict(np.asarray(ids), np.asarray(lengths)), config.alpha
+                )
+            model = estimator.fit_encoded(ids, lengths, orders)
+        elif model is None:
             encoded = encoder.apply_batch(tokens)
             ngrams = NGramsFeaturizer(orders=orders)(encoded)
             counts = NGramsCounts(mode=NGramsCountsMode.NO_ADD)(ngrams)
             model = estimator.fit(counts)
-            num_ngrams = len(counts)
-        score_arrays = model.scores_arrays()
 
-    results["vocab_size"] = encoder.vocab_size
+        if model.table_sizes is not None:
+            import jax
+
+            num_ngrams = int(sum(model.table_sizes))
+            num_scored = num_ngrams
+            score_tables = model.scores_device()
+            # ONE transfer for everything the host reports — a checksum over
+            # every score (the barrier that materializes the fit+score
+            # program) plus the sample rows. Separate fetches would each pay
+            # the host<->device round trip (~100 ms tunneled).
+            fetch = [sum(s[:size].sum() for _, _, s, size in score_tables)]
+            sample_spec = []
+            remaining = config.num_sample_scores
+            for order, keys, s, size in score_tables:
+                take = min(remaining, size)
+                if take <= 0:
+                    break
+                fetch.extend((keys[:take], s[:take]))
+                sample_spec.append((order, take))
+                remaining -= take
+            fetched = jax.device_get(fetch)
+            checksum = float(fetched[0])
+        else:
+            score_arrays = model.scores_arrays()
+            num_ngrams = (
+                int(sum(len(t) for t in model.host_tables))
+                if model.host_tables is not None
+                else int(sum(k.shape[0] for k in model.table_keys))
+            )
+            num_scored = int(sum(s.shape[0] for _, s in score_arrays))
+            checksum = float(sum(float(s.sum()) for _, s in score_arrays))
+
+    results["vocab_size"] = int(vocab_size)
     results["num_ngrams"] = num_ngrams
-    results["num_scored"] = int(sum(s.shape[0] for _, s in score_arrays))
+    results["num_scored"] = num_scored
+    results["score_checksum"] = checksum
     sample = []
-    for ngrams_arr, scores_arr in score_arrays:
-        for ng, s in zip(ngrams_arr, scores_arr):
+    if model.table_sizes is not None:
+        mask = (1 << model.word_bits) - 1
+        for i, (order, take) in enumerate(sample_spec):
+            kk, ss = fetched[1 + 2 * i], fetched[2 + 2 * i]
+            for key, s in zip(kk, ss):
+                ng = [
+                    int((int(key) >> (j * model.word_bits)) & mask)
+                    for j in range(order - 1, -1, -1)
+                ]
+                sample.append({"ngram": ng, "score": float(s)})
+    else:
+        for ngrams_arr, scores_arr in score_arrays:
+            for ng, s in zip(ngrams_arr, scores_arr):
+                if len(sample) >= config.num_sample_scores:
+                    break
+                sample.append({"ngram": [int(w) for w in ng], "score": float(s)})
             if len(sample) >= config.num_sample_scores:
                 break
-            sample.append({"ngram": [int(w) for w in ng], "score": float(s)})
-        if len(sample) >= config.num_sample_scores:
-            break
     results["sample_scores"] = sample
     results["wallclock_s"] = total.elapsed
     logger.info(
@@ -111,6 +223,16 @@ def run(config: StupidBackoffConfig) -> dict:
         total.elapsed,
     )
     return results
+
+
+def _unigram_dict(ids: np.ndarray, lengths: np.ndarray) -> dict:
+    """Per-id counts of a padded id batch as the dict the host estimator
+    expects (device-synthetic fallback path only)."""
+    pos = np.arange(ids.shape[1])[None, :] < lengths[:, None]
+    flat = ids[pos]
+    flat = flat[flat >= 0]
+    counts = np.bincount(flat)
+    return {i: int(c) for i, c in enumerate(counts) if c}
 
 
 def main(argv=None):

@@ -372,11 +372,19 @@ class TestFitEncodedEquivalence:
         ]
         assert flat == fast.scores()
 
-    def test_pipeline_both_paths_agree(self):
+    def test_pipeline_both_host_paths_agree(self):
         from keystone_tpu.pipelines.stupid_backoff import StupidBackoffConfig, run
 
-        fast = run(StupidBackoffConfig(synthetic_docs=300, fast_host_path=True))
-        slow = run(StupidBackoffConfig(synthetic_docs=300, fast_host_path=False))
+        fast = run(
+            StupidBackoffConfig(
+                synthetic_docs=300, fast_host_path=True, device_path=False
+            )
+        )
+        slow = run(
+            StupidBackoffConfig(
+                synthetic_docs=300, fast_host_path=False, device_path=False
+            )
+        )
         assert fast["num_scored"] == slow["num_scored"]
         assert fast["sample_scores"] == slow["sample_scores"]
 
@@ -387,3 +395,126 @@ class TestFitEncodedEquivalence:
         ref, fast = self._both_models(docs, (2, 3))
         assert ref.max_order == fast.max_order == 2
         self._assert_same_tables(ref, fast)
+
+
+class TestFitDeviceEquivalence:
+    """fit_device (on-chip sort + segment-reduce counting,
+    ops/nlp/device_count.py) must build the same model as fit_encoded —
+    table keys/counts, unigrams, and served scores — for both int32-packed
+    and int64-packed key widths."""
+
+    def _models(self, docs, orders):
+        enc = WordFrequencyEncoder().fit(docs)
+        est = StupidBackoffEstimator(enc.unigram_counts, alpha=0.4)
+        ids, lengths = enc.encode_padded(docs)
+        host = est.fit_encoded(ids, lengths, orders)
+        dev = est.fit_device(ids, lengths, orders, enc.vocab_size)
+        return host, dev
+
+    @staticmethod
+    def _assert_same(host, dev):
+        assert dev.table_sizes is not None
+        for hk, dk, hc, dc in zip(
+            host.table_keys, dev.table_keys, host.table_counts, dev.table_counts
+        ):
+            np.testing.assert_array_equal(np.asarray(hk), np.asarray(dk))
+            np.testing.assert_allclose(np.asarray(hc), np.asarray(dc))
+        np.testing.assert_allclose(
+            np.asarray(host.unigram_counts), np.asarray(dev.unigram_counts)
+        )
+        assert float(host.num_tokens) == float(dev.num_tokens)
+
+    def test_toy_corpus(self):
+        docs = [["a", "b", "c"], ["a", "b", "d"], ["b", "c"], ["a"]]
+        host, dev = self._models(docs, (2, 3))
+        self._assert_same(host, dev)
+        for (hng, hs), (dng, ds) in zip(host.scores_arrays(), dev.scores_arrays()):
+            np.testing.assert_array_equal(hng, dng)
+            np.testing.assert_allclose(hs, ds, rtol=1e-6)
+
+    def test_zipf_corpus_and_served_scores(self):
+        rng = np.random.default_rng(7)
+        vocab = [f"w{i}" for i in range(90)]
+        probs = 1.0 / np.arange(1, 91)
+        probs /= probs.sum()
+        docs = [
+            [vocab[i] for i in rng.choice(90, size=int(rng.integers(1, 14)), p=probs)]
+            for _ in range(200)
+        ]
+        host, dev = self._models(docs, (2, 3))
+        self._assert_same(host, dev)
+        q = np.array([[0, 1, 2], [3, 2, 1], [89, 0, 5], [-1, 0, 1]], np.int32)
+        np.testing.assert_allclose(
+            host.score_batch(q), dev.score_batch(q), rtol=1e-6
+        )
+        # scores_device (the self-aligned table fold the pipeline reports)
+        # must agree with the host model's scores over the same sorted keys
+        host_arrays = host.scores_arrays()
+        for (order, keys, s, size), (hng, hs) in zip(
+            dev.scores_device(), host_arrays
+        ):
+            assert size == hng.shape[0]
+            np.testing.assert_allclose(np.asarray(s)[:size], hs, rtol=1e-6)
+
+    def test_oov_windows_dropped_on_device(self):
+        train = [["a", "b"], ["b", "c"]]
+        enc = WordFrequencyEncoder().fit(train)
+        est = StupidBackoffEstimator(enc.unigram_counts)
+        ids, lengths = enc.encode_padded(enc_docs := [["a", "zz", "b"], ["b", "c", "a"]])
+        host = est.fit_encoded(ids, lengths, (2,))
+        dev = est.fit_device(ids, lengths, (2,), enc.vocab_size)
+        self._assert_same(host, dev)
+
+    def test_int64_key_path(self):
+        # vocab wide enough that order-3 keys exceed 30 bits -> int64 sort
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, 70000, size=(60, 10)).astype(np.int32)
+        lengths = rng.integers(3, 11, size=(60,)).astype(np.int32)
+        uni = {}
+        for row, n in zip(ids, lengths):
+            for w in row[:n]:
+                uni[int(w)] = uni.get(int(w), 0) + 1
+        est = StupidBackoffEstimator(uni, 0.4)
+        host = est.fit_encoded(ids, lengths, (2, 3))
+        dev = est.fit_device(ids, lengths, (2, 3))  # vocab from the dict
+        assert dev.table_keys[1].dtype.name == "int64"
+        self._assert_same(host, dev)
+        for (hng, hs), (dng, ds) in zip(host.scores_arrays(), dev.scores_arrays()):
+            np.testing.assert_array_equal(hng, dng)
+            np.testing.assert_allclose(hs, ds, rtol=1e-6)
+
+    def test_pipeline_device_synthetic_runs(self):
+        from keystone_tpu.pipelines.stupid_backoff import StupidBackoffConfig, run
+
+        r = run(StupidBackoffConfig(synthetic_docs=400, device_path=True))
+        assert r["num_ngrams"] > 0 and r["num_scored"] == r["num_ngrams"]
+        assert len(r["sample_scores"]) > 0
+        assert all(s["score"] > 0 for s in r["sample_scores"])
+        assert np.isfinite(r["score_checksum"])
+
+    def test_sum_by_key_matches_numpy_unique(self):
+        import jax.numpy as jnp
+
+        from keystone_tpu.ops.nlp.device_count import sum_by_key
+
+        rng = np.random.default_rng(11)
+        keys = rng.integers(0, 50, size=300).astype(np.int32)
+        valid = rng.random(300) < 0.8
+        uniq, totals, n = sum_by_key(jnp.asarray(keys), jnp.asarray(valid))
+        n = int(n)
+        ref_k, ref_c = np.unique(keys[valid], return_counts=True)
+        np.testing.assert_array_equal(np.asarray(uniq)[:n], ref_k)
+        np.testing.assert_allclose(np.asarray(totals)[:n], ref_c)
+        # weighted variant
+        w = rng.random(300).astype(np.float32)
+        uniq2, totals2, n2 = sum_by_key(
+            jnp.asarray(keys), jnp.asarray(valid), jnp.asarray(w)
+        )
+        ref = {}
+        for k, ww in zip(keys[valid], w[valid]):
+            ref[int(k)] = ref.get(int(k), 0.0) + float(ww)
+        np.testing.assert_allclose(
+            np.asarray(totals2)[: int(n2)],
+            [ref[int(k)] for k in np.asarray(uniq2)[: int(n2)]],
+            rtol=1e-5,
+        )
