@@ -52,7 +52,53 @@ def _parse_launch_flags(argv):
                     help="jax.distributed.initialize() with auto-detection")
     ap.add_argument("--mesh-model", type=int, default=1,
                     help="model-parallel axis size of the default mesh")
+    ap.add_argument("--hosts", default=None,
+                    help="comma-separated host list: print the per-host "
+                         "launch commands (coordinator election, process "
+                         "ids, mesh shape) instead of running — the "
+                         "keystone-ec2.sh analog minus provisioning")
+    ap.add_argument("--devices-per-host", type=int, default=4,
+                    help="accelerators per host for the --hosts mesh-shape "
+                         "note (v5e hosts expose 4)")
+    ap.add_argument("--port", type=int, default=8476,
+                    help="coordinator port for --hosts")
     return ap.parse_known_args(argv)
+
+
+def emit_host_commands(hosts, rest, devices_per_host: int = 4,
+                       port: int = 8476, mesh_model: int = 1):
+    """Per-host launch lines for a multi-controller run (the
+    ``bin/keystone-ec2.sh`` analog, ``:9-28`` of the reference launcher,
+    minus EC2 provisioning — topology only).
+
+    The first host is elected coordinator; every host gets the same command
+    with its own ``--process-id``. Returns (lines, mesh_note)."""
+    hosts = [h.strip() for h in hosts if h.strip()]
+    if not hosts:
+        raise ValueError("--hosts needs at least one host")
+    coordinator = f"{hosts[0]}:{port}"
+    n = len(hosts)
+    total_dev = n * devices_per_host
+    model = max(1, mesh_model)
+    if total_dev % model:
+        raise ValueError(
+            f"--mesh-model {model} does not divide the global device count "
+            f"{total_dev} ({n} hosts x {devices_per_host})"
+        )
+    import shlex
+
+    flags = f" --mesh-model {model}" if model > 1 else ""
+    pipeline = shlex.join(rest) if rest else "<Pipeline> [flags]"
+    lines = [
+        (h, f"run-pipeline --coordinator {coordinator} --num-processes {n} "
+            f"--process-id {i}{flags} {pipeline}")
+        for i, h in enumerate(hosts)
+    ]
+    mesh_note = (
+        f"global mesh: {total_dev} devices -> (data={total_dev // model}, "
+        f"model={model}); ICI within each host's slice, DCN across hosts"
+    )
+    return lines, mesh_note
 
 
 def main(argv=None) -> int:
@@ -66,6 +112,19 @@ def main(argv=None) -> int:
         )
         return 0 if argv else 2
     launch, argv = _parse_launch_flags(argv)
+    if launch.hosts is not None:
+        try:
+            lines, mesh_note = emit_host_commands(
+                launch.hosts.split(","), argv, launch.devices_per_host,
+                launch.port, launch.mesh_model,
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        print(f"# {mesh_note}")
+        for host, cmd in lines:
+            print(f"{host}: {cmd}")
+        return 0
     if (launch.num_processes is not None or launch.process_id is not None) \
             and not (launch.coordinator or launch.distributed):
         print(

@@ -16,7 +16,6 @@ exists for the solver (HBM tiling of the gram loop) and for the streaming
 from __future__ import annotations
 
 import functools
-import inspect
 from typing import Callable, Optional, Sequence, Tuple, Union
 
 import jax
@@ -268,7 +267,12 @@ def grouped_block_getter(
             # group buffers at once (the documented one-slot HBM budget)
             cache.pop("group", None)
             cache.pop("val", None)
-            if "out_dtype" in inspect.signature(node.group_node).parameters:
+            # explicit protocol (not signature inspection, which silently
+            # misses functools.partial / **kwargs / C-accelerated
+            # callables): a node advertising group_node_supports_out_dtype
+            # emits the group buffer directly in cache_dtype — no
+            # full-width f32 intermediate ever exists
+            if getattr(node, "group_node_supports_out_dtype", False):
                 val = node.group_node(out_dtype=cache_dtype).apply_batch(raw)
             else:
                 val = node.group_node().apply_batch(raw)

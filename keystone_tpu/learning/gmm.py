@@ -111,10 +111,11 @@ def _fit_em(x, mask, key, k: int, num_iter: int, implementation: str):
 
     def em_step(_, model):
         means, variances, weights = model
-        # fused E+M sufficient statistics; the default path is the chunked
-        # affine XLA form (memory-bounded at any n), the Pallas kernel is
-        # the opt-in strict-VMEM variant. Each reduce is a sharded-row sum
-        # -> psum over ICI on a mesh.
+        # fused E+M sufficient statistics; the default (auto) path is one
+        # XLA program for small n and the copy-free Pallas kernel for large
+        # n on TPU (measured winner at the 1e7x256 design point — see
+        # gmm_moments_auto). Each reduce is a sharded-row sum -> psum over
+        # ICI on a mesh.
         if implementation == "pallas":
             # interpret=None: compiled on TPU, interpreter elsewhere
             qsum, qxc, qxc2 = M.moments_from_aug(

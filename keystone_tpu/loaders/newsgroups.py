@@ -47,6 +47,41 @@ def load_newsgroups(
     return docs, np.asarray(labels, np.int32), list(class_names)
 
 
+def synthetic_newsgroups_device(
+    n_docs: int,
+    num_classes: int = 20,
+    vocab_per_class: int = 30,
+    shared_vocab: int = 200,
+    doc_len: Tuple[int, int] = (30, 120),
+    seed: int = 42,
+):
+    """:func:`synthetic_newsgroups`'s distribution sampled directly as device
+    id tensors (the image pipelines' on-device data protocol — strings never
+    exist). Id space: ``0..shared_vocab-1`` shared words, then
+    ``shared_vocab + c*vocab_per_class + i`` for class c's i-th word.
+
+    Returns ``(ids int32 [D, L], lengths int32 [D], labels int32 [D],
+    vocab_size)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    kc, kl, kp, kw, ks = jax.random.split(jax.random.key(seed), 5)
+    max_len = doc_len[1] - 1  # rng.integers semantics: lengths in [lo, hi)
+    labels = jax.random.randint(kc, (n_docs,), 0, num_classes)
+    lengths = jax.random.randint(kl, (n_docs,), *doc_len).astype(jnp.int32)
+    use_class = jax.random.uniform(kp, (n_docs, max_len)) < 0.35
+    class_words = (
+        shared_vocab
+        + labels[:, None] * vocab_per_class
+        + jax.random.randint(kw, (n_docs, max_len), 0, vocab_per_class)
+    )
+    shared_words = jax.random.randint(ks, (n_docs, max_len), 0, shared_vocab)
+    ids = jnp.where(use_class, class_words, shared_words).astype(jnp.int32)
+    vocab_size = shared_vocab + num_classes * vocab_per_class
+    return ids, lengths, labels.astype(jnp.int32), vocab_size
+
+
 def synthetic_newsgroups(
     n_docs: int,
     num_classes: int = 20,

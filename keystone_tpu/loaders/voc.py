@@ -67,6 +67,54 @@ def load_voc(
     return np.stack(imgs_list), labels
 
 
+def load_voc_bucketed(
+    data_path: str,
+    labels_path: str,
+    buckets,
+    name_prefix: Optional[str] = None,
+    num_threads: int = 4,
+):
+    """:func:`load_voc` without the global resize: images land in the
+    smallest (H, W) bucket that contains them (pad; crop only past the
+    largest — ``native.BucketedImageLoader``), matching the reference's
+    native-size processing (``loaders/ImageLoaderUtils.scala:47-93``) up to
+    the static-shape ladder XLA requires.
+
+    Returns a list of ``(bucket_hw, images (n, bh, bw, 3) float32,
+    labels (n, max_labels) int32 padded with -1)`` groups, non-empty buckets
+    only.
+    """
+    from keystone_tpu.native import BucketedImageLoader
+
+    labels_map = load_voc_labels(labels_path)
+    loader = BucketedImageLoader([data_path], buckets, num_threads)
+    groups: dict = {}
+    for hw, imgs, names in loader.batches(256):
+        for i, name in enumerate(names):
+            if name_prefix and not name.startswith(name_prefix):
+                continue
+            labels = labels_map.get(name) or labels_map.get(name.split("/")[-1])
+            if labels is None:
+                continue
+            il, ll = groups.setdefault(hw, ([], []))
+            il.append(imgs[i])
+            ll.append(labels)
+    if not groups:
+        raise ValueError(
+            f"no images in {data_path} matched prefix={name_prefix!r} and the "
+            f"{len(labels_map)} filenames in {labels_path}"
+        )
+    max_labels = max(len(ls) for _, ll in groups.values() for ls in ll)
+    out = []
+    for hw in sorted(groups):
+        il, ll = groups[hw]
+        labels = np.full((len(ll), max_labels), -1, np.int32)
+        for i, ls in enumerate(ll):
+            labels[i, : len(ls)] = ls
+        out.append((hw, np.stack(il), labels))
+    return out
+
+
 def synthetic_voc_device(
     n: int,
     num_classes: int = VOC_NUM_CLASSES,

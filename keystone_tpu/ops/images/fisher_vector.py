@@ -30,6 +30,8 @@ kernel remains available for the GMM *fit* path in ``ops/pallas/moments.py``.
 
 from __future__ import annotations
 
+from typing import ClassVar
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -146,17 +148,27 @@ def _fv_cols_batch(x, gmm: GaussianMixtureModel, lo: int, hi: int):
     inv_n = 1.0 / nd
     # Center ranges: mean-gradient cols need centers [lo, min(hi,k)),
     # variance cols [max(lo,k)-k, hi-k). They overlap for any full-range
-    # call (fisher_l1_norms), so compute the first-moment einsum ONCE over
-    # the union and slice — it is the dominant moment FLOPs.
+    # call (fisher_l1_norms), where ONE first-moment einsum over the union
+    # is cheapest — it is the dominant moment FLOPs. For a group straddling
+    # the mean/variance boundary with lo > 0 the union would also cover
+    # centers [0, lo) whose moments are discarded, so disjoint ranges get
+    # separate einsums instead (ADVICE r2).
     m_rng = (lo, min(hi, k)) if lo < k else None
     v_rng = (max(lo, k) - k, hi - k) if hi > k else None
     ranges = [r for r in (m_rng, v_rng) if r is not None]
-    u_lo, u_hi = min(r[0] for r in ranges), max(r[1] for r in ranges)
-    qx_u = jnp.einsum("nik,nij->nkj", q[:, :, u_lo:u_hi], x)
+    overlap = len(ranges) < 2 or (
+        max(m_rng[0], v_rng[0]) < min(m_rng[1], v_rng[1])
+    )
+    if overlap:
+        u_lo, u_hi = min(r[0] for r in ranges), max(r[1] for r in ranges)
+        qx_u = jnp.einsum("nik,nij->nkj", q[:, :, u_lo:u_hi], x)
+        qx_of = lambda a, b: qx_u[:, a - u_lo : b - u_lo]
+    else:
+        qx_of = lambda a, b: jnp.einsum("nik,nij->nkj", q[:, :, a:b], x)
     parts = []
     if m_rng is not None:
         a, b = m_rng
-        qx = qx_u[:, a - u_lo : b - u_lo]
+        qx = qx_of(a, b)
         qsum = qsum_full[:, a:b, None]
         mu, w = gmm.means[a:b], gmm.weights[a:b]
         grad = (qx - qsum * mu[None]) / jnp.sqrt(gmm.variances[a:b])[None]
@@ -165,7 +177,7 @@ def _fv_cols_batch(x, gmm: GaussianMixtureModel, lo: int, hi: int):
         )
     if v_rng is not None:
         a, b = v_rng
-        qx = qx_u[:, a - u_lo : b - u_lo]
+        qx = qx_of(a, b)
         qsum = qsum_full[:, a:b, None]
         qx2 = jnp.einsum("nik,nij->nkj", q[:, :, a:b], x * x)
         mu, var, w = gmm.means[a:b], gmm.variances[a:b], gmm.weights[a:b]
@@ -258,6 +270,9 @@ class FisherVectorSliceNormalized(Transformer):
     # its multi-GB (n, group_width) buffer casts each row chunk inside the
     # chunk loop, so no full-width f32 intermediate ever exists.
     out_dtype: str = struct.field(pytree_node=False, default="float32")
+    # grouped_block_getter's push-down protocol: group_node(out_dtype=...)
+    # is accepted and the group buffer is emitted directly in that dtype
+    group_node_supports_out_dtype: ClassVar[bool] = True
 
     @property
     def cache_group(self):

@@ -8,7 +8,8 @@ where XLA's fusion leaves traffic on the table (SURVEY.md §2.8 TPU mapping).
 from keystone_tpu.ops.pallas.moments import (
     gmm_moments,
     gmm_moments_auto,
+    gmm_moments_sep,
     gmm_moments_xla,
 )
 
-__all__ = ["gmm_moments", "gmm_moments_auto", "gmm_moments_xla"]
+__all__ = ["gmm_moments", "gmm_moments_auto", "gmm_moments_sep", "gmm_moments_xla"]

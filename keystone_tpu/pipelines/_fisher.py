@@ -108,3 +108,94 @@ def fit_fisher_branch(
         "fisher branch: descriptors %s -> features %s", descs.shape, features.shape
     )
     return featurizer, features
+
+
+def fit_fisher_branch_buckets(
+    extractor: Transformer,
+    images_by_bucket,
+    pca_dims: int,
+    vocab_size: int,
+    num_pca_samples: int,
+    num_gmm_samples: int,
+    seed: int = 42,
+    hellinger_first: bool = False,
+    row_chunks: int = 1,
+) -> Tuple[Chain, jax.Array, list]:
+    """:func:`fit_fisher_branch` over size-bucketed image groups.
+
+    The reference processes native-size images
+    (``loaders/ImageLoaderUtils.scala:47-93``, one descriptor set per image
+    size); XLA needs static shapes, so variable-size ingest lands in a small
+    ladder of (H, W) buckets (``native.BucketedImageLoader``) and the
+    extractor/PCA/FV chain compiles **once per bucket shape** — descriptor
+    counts per bucket follow ``extractor.num_descriptors(bh, bw)`` with no
+    global resize. PCA and GMM fit once, on samples pooled across buckets in
+    proportion to each bucket's share of the corpus descriptors; the FV
+    feature width is bucket-independent, so per-bucket features concatenate
+    into one training matrix.
+
+    ``images_by_bucket``: list of ``(bucket_hw, gray_images (n, bh, bw))``.
+    Returns ``(featurizer, features, desc_counts)`` — features are row-
+    concatenated in the given bucket order (callers must order labels the
+    same way) and ``desc_counts[i]`` is bucket i's per-image descriptor
+    count (for parity assertions against ``num_descriptors``).
+    """
+    stages = [extractor]
+    if hellinger_first:
+        stages.append(BatchSignedHellingerMapper())
+    desc_node: Transformer = chain(*stages)
+    if row_chunks > 1:
+        desc_node = ChunkedMap(node=desc_node, num_chunks=row_chunks)
+
+    with Timer("fisher.extract_descriptors"):
+        descs_by_bucket = [
+            (hw, desc_node(imgs)) for hw, imgs in images_by_bucket
+        ]
+    desc_counts = [int(d.shape[1]) for _, d in descs_by_bucket]
+    total = sum(int(d.shape[0]) * int(d.shape[1]) for _, d in descs_by_bucket)
+
+    def pooled_sample(arrs, num_samples, seed_):
+        parts = []
+        for i, (_, d) in enumerate(arrs):
+            share = int(d.shape[0]) * int(d.shape[1]) / max(total, 1)
+            k = max(1, int(round(num_samples * share)))
+            parts.append(ColumnSampler(k, seed=seed_ + i)(d))
+        return jnp.concatenate(parts, axis=0)
+
+    with Timer("fisher.fit_pca"):
+        pca = PCAEstimator(pca_dims).fit_batch(
+            pooled_sample(descs_by_bucket, num_pca_samples, seed)
+        )
+
+    with Timer("fisher.apply_pca"):
+        reduced_by_bucket = [(hw, pca(d)) for hw, d in descs_by_bucket]
+
+    with Timer("fisher.fit_gmm"):
+        gmm = GaussianMixtureModelEstimator(vocab_size).fit(
+            pooled_sample(reduced_by_bucket, num_gmm_samples, seed + 1000)
+        )
+
+    fisher: Transformer = fisher_featurizer(gmm)
+    if row_chunks > 1:
+        fisher = ChunkedMap(node=fisher, num_chunks=row_chunks)
+    with Timer("fisher.encode"):
+        features = jnp.concatenate(
+            [fisher(r) for _, r in reduced_by_bucket], axis=0
+        )
+
+    featurizer = chain(desc_node, pca, fisher)
+    logger.info(
+        "fisher branch (bucketed): %s -> features %s",
+        [(hw, c) for (hw, _), c in zip(images_by_bucket, desc_counts)],
+        features.shape,
+    )
+    return featurizer, features, desc_counts
+
+
+def apply_featurizer_buckets(featurizer, images_by_bucket) -> jax.Array:
+    """Apply a fitted (shape-polymorphic) featurizer per bucket and
+    row-concatenate — the eval-side pairing of
+    :func:`fit_fisher_branch_buckets`."""
+    return jnp.concatenate(
+        [featurizer(imgs) for _, imgs in images_by_bucket], axis=0
+    )

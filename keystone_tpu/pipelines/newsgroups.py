@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+from typing import Optional
 
 import numpy as np
 
@@ -50,6 +51,13 @@ class NewsgroupsConfig:
     synthetic_test: int = 500
     synthetic_classes: int = 20
     seed: int = 42
+    # Featurize ON DEVICE (ops/nlp/device_text.py): n-gram packing, per-doc
+    # term collapse, top-K selection, and COO vectorization as XLA
+    # sort/segment programs; the synthetic corpus is generated on device as
+    # id tensors (the image pipelines' protocol). Real text still tokenizes/
+    # encodes on the host (the documented string frontier). Falls back to
+    # the host paths below when vocab x order overflows 63-bit packing.
+    device_path: bool = True
     # Fused integer-key host featurization (ops/nlp/fast_text.py): the same
     # features as the tuple chain up to tie-breaks at the top-K truncation
     # cut (exact equivalence below the cut is pinned in tests; both paths
@@ -58,7 +66,81 @@ class NewsgroupsConfig:
     fast_host_path: bool = True
 
 
+def _run_device(config: NewsgroupsConfig) -> Optional[dict]:
+    """The all-device track: id tensors in, error rates out. Returns None
+    when the key width cannot pack (callers fall back to the host paths)."""
+    from keystone_tpu.loaders.newsgroups import synthetic_newsgroups_device
+    from keystone_tpu.ops.nlp import Tokenizer, Trim, LowerCase, WordFrequencyEncoder
+    from keystone_tpu.ops.nlp.device_text import DeviceCommonSparseFeatures
+
+    orders = tuple(range(1, config.n_grams + 1))
+    if config.train_location:
+        # disk IO stays outside the Timer (matching the host paths, which
+        # also load before timing); the string->id frontier runs INSIDE it
+        # so device-vs-host wall-clocks stay comparable on real corpora
+        train_docs, train_labels, class_names = load_newsgroups(config.train_location)
+        test_docs, test_labels, _ = load_newsgroups(config.test_location, class_names)
+        num_classes = len(class_names)
+        gen = None
+    else:
+        num_classes = config.synthetic_classes
+        gen = lambda n, seed: synthetic_newsgroups_device(
+            n, num_classes, seed=seed
+        )
+
+    results: dict = {}
+    with Timer("NewsgroupsPipeline") as total:
+        if gen is None:
+            tokenize = lambda docs: Tokenizer("[\\s]+")(LowerCase()(Trim()(docs)))
+            train_tokens = tokenize(train_docs)
+            encoder = WordFrequencyEncoder().fit(train_tokens)
+            train_ids, train_len = encoder.encode_padded(train_tokens)
+            test_ids, test_len = encoder.encode_padded(tokenize(test_docs))
+            vocab_size = encoder.vocab_size
+        else:
+            train_ids, train_len, train_labels, vocab_size = gen(
+                config.synthetic_train, config.seed
+            )
+            test_ids, test_len, test_labels, _ = gen(
+                config.synthetic_test, config.seed + 1
+            )
+        try:
+            est = DeviceCommonSparseFeatures(
+                base=vocab_size + 1,
+                orders=orders,
+                num_features=config.common_features,
+                weight="binary",
+            )
+        except OverflowError as e:
+            logger.info("device featurization unavailable (%s); host path", e)
+            return None
+        vectorizer, train_vecs = est.fit_transform(train_ids, train_len)
+        test_vecs = vectorizer.apply_encoded(test_ids, test_len)
+        nb = NaiveBayesEstimator(num_classes, config.nb_lambda).fit(
+            train_vecs, train_labels
+        )
+        classifier = nb.then(MaxClassifier())
+        evaluator = MulticlassClassifierEvaluator(num_classes)
+        train_eval = evaluator(classifier(train_vecs), train_labels)
+        test_eval = evaluator(classifier(test_vecs), test_labels)
+        results["train_error"] = 100.0 * float(train_eval.total_error)
+        results["test_error"] = 100.0 * float(test_eval.total_error)
+        results["macro_f1"] = float(test_eval.macro_f1)
+    results["num_features"] = vectorizer.num_features
+    results["wallclock_s"] = total.elapsed
+    logger.info("Train error: %.2f%%", results["train_error"])
+    logger.info(
+        "Test error: %.2f%%  macro-F1: %.3f",
+        results["test_error"], results["macro_f1"],
+    )
+    return results
+
+
 def run(config: NewsgroupsConfig) -> dict:
+    if config.device_path:
+        results = _run_device(config)
+        if results is not None:
+            return results
     if config.train_location:
         train_docs, train_labels, class_names = load_newsgroups(config.train_location)
         test_docs, test_labels, _ = load_newsgroups(config.test_location, class_names)

@@ -11,6 +11,7 @@ import dataclasses
 import json
 
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.core.config import parse_config
 from keystone_tpu.core.pipeline import chain
@@ -40,6 +41,13 @@ class VOCSIFTFisherConfig:
     block_size: int = 4096
     sift_scales: int = 4
     image_hw: int = 256
+    # size-bucketed variable-shape ingest: comma-separated HxW ladder (e.g.
+    # "128x128,192x256,256x256"). Images land in the smallest containing
+    # bucket (pad, no resize) and every extractor stage compiles once per
+    # bucket shape — the reference's native-size processing
+    # (loaders/ImageLoaderUtils.scala:47-93) under XLA static shapes. Empty
+    # -> single-frame ingest at image_hw. Real-archive paths only.
+    buckets: str = ""
     pca_file: str = ""
     gmm_mean_file: str = ""
     gmm_var_file: str = ""
@@ -53,6 +61,14 @@ class VOCSIFTFisherConfig:
     # row-chunk the extractor/FV stages (ChunkedMap) — needed at reference
     # scale (5k imgs × vocab 256) to bound per-image intermediates
     row_chunks: int = 1
+
+    def validate(self):
+        if self.buckets and not self.train_location:
+            raise ValueError(
+                "--buckets is variable-size ingest for real archives; the "
+                "synthetic generator emits one size (drop --buckets or set "
+                "--train-location)"
+            )
 
 
 def small_config(**overrides) -> VOCSIFTFisherConfig:
@@ -68,7 +84,87 @@ def small_config(**overrides) -> VOCSIFTFisherConfig:
     return VOCSIFTFisherConfig(**cfg)
 
 
+def parse_buckets(s: str):
+    """``"128x128,192x256"`` -> ``[(128, 128), (192, 256)]``."""
+    out = []
+    for part in s.split(","):
+        part = part.strip().lower()
+        if not part:
+            continue
+        h, w = part.split("x")
+        out.append((int(h), int(w)))
+    if not out:
+        raise ValueError(f"no buckets parsed from {s!r}")
+    return out
+
+
+def _run_bucketed(config: VOCSIFTFisherConfig) -> dict:
+    """Variable-size ingest track: no global resize — per-bucket static
+    shapes through SIFT, descriptors pooled for PCA/GMM, FV rows
+    concatenated (``_fisher.fit_fisher_branch_buckets``)."""
+    from keystone_tpu.loaders.voc import load_voc_bucketed
+    from keystone_tpu.pipelines._fisher import (
+        apply_featurizer_buckets,
+        fit_fisher_branch_buckets,
+    )
+
+    buckets = parse_buckets(config.buckets)
+    train = load_voc_bucketed(config.train_location, config.train_labels, buckets)
+    test = load_voc_bucketed(config.test_location, config.test_labels, buckets)
+    num_classes = VOC_NUM_CLASSES
+
+    results: dict = {}
+    with use_mesh(get_mesh()), Timer("VOCSIFTFisher.pipeline") as total:
+        gray = [
+            (hw, GrayScaler()(jnp.asarray(imgs))[..., 0]) for hw, imgs, _ in train
+        ]
+        extractor = SIFTExtractor(scales=config.sift_scales)
+        featurizer, train_feats, desc_counts = fit_fisher_branch_buckets(
+            extractor,
+            gray,
+            config.desc_dim,
+            config.vocab_size,
+            config.num_pca_samples,
+            config.num_gmm_samples,
+            seed=config.seed,
+            row_chunks=config.row_chunks,
+        )
+        train_labels = jnp.asarray(
+            np.concatenate([lb for _, _, lb in train])
+        )
+        labels = ClassLabelIndicatorsFromIntArrayLabels(num_classes)(train_labels)
+        with Timer("fit.block_least_squares"):
+            model = BlockLeastSquaresEstimator(
+                config.block_size, 1, config.lam
+            ).fit(train_feats, labels)
+
+        with Timer("eval.test_map"):
+            test_gray = [
+                (hw, GrayScaler()(jnp.asarray(imgs))[..., 0]) for hw, imgs, _ in test
+            ]
+            test_feats = apply_featurizer_buckets(featurizer, test_gray)
+            scores = model(test_feats)
+            test_labels = jnp.asarray(
+                np.concatenate([lb for _, _, lb in test])
+            )
+            evaluator = MeanAveragePrecisionEvaluator(num_classes)
+            results["test_map"] = evaluator.mean(test_labels, scores)
+
+    results["buckets"] = {
+        f"{hw[0]}x{hw[1]}": {"images": int(imgs.shape[0]), "descriptors": dc}
+        for (hw, imgs, _), dc in zip(train, desc_counts)
+    }
+    results["wallclock_s"] = total.elapsed
+    logger.info(
+        "TEST APs mean: %.4f  buckets: %s", results["test_map"], results["buckets"]
+    )
+    return results
+
+
 def run(config: VOCSIFTFisherConfig) -> dict:
+    if config.buckets:
+        config.validate()  # bucketed ingest is the real-archive path only
+        return _run_bucketed(config)
     if config.train_location:
         hw = (config.image_hw, config.image_hw)
         train = load_voc(config.train_location, config.train_labels, hw)

@@ -35,6 +35,7 @@ def main() -> None:
     ap.add_argument("--skip-mnist", action="store_true")
     ap.add_argument("--skip-text", action="store_true")
     ap.add_argument("--skip-images", action="store_true")
+    ap.add_argument("--skip-flagship", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -96,14 +97,22 @@ def main() -> None:
             run as run_sb,
         )
 
+        # The CPU anchor runs each text pipeline in its BEST CPU
+        # configuration: device_path=False selects the fused host
+        # featurization (numpy + native C++ count_by_key), which on one
+        # jax-CPU core is ~10-20x faster than forcing the TPU-shaped XLA
+        # sort/segment programs through a single core. The TPU side of the
+        # ratio uses its own best path (device counting) — both sides
+        # best-vs-best, stated in BASELINE.md.
         ncfg = NewsgroupsConfig(synthetic_train=20000, synthetic_test=4000,
-                                synthetic_classes=20, common_features=100000)
+                                synthetic_classes=20, common_features=100000,
+                                device_path=False)
         run_news(ncfg)  # cold
         t0 = time.perf_counter()
         run_news(ncfg)
         out["newsgroups_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
 
-        scfg = StupidBackoffConfig(synthetic_docs=20000)
+        scfg = StupidBackoffConfig(synthetic_docs=20000, device_path=False)
         run_sb(scfg)  # cold
         t0 = time.perf_counter()
         run_sb(scfg)
@@ -137,6 +146,73 @@ def main() -> None:
         t0 = time.perf_counter()
         run_imagenet(icfg)
         out["imagenet_small_cpu_warm_s"] = round(time.perf_counter() - t0, 3)
+
+    if not args.skip_flagship:
+        # Flagship (reference-dim streaming ImageNet) anchor, TIMIT-style:
+        # the full config (n=102 400 rows, d=65 536 -> B=16 feature blocks)
+        # is days on one core, so measure four scaled configs of the SAME
+        # streaming construction (fit_streaming + FV cache groups + Woodbury
+        # class solves) and fit t(n, B) = c0 + c1*n + c2*B + c3*n*B — the
+        # bilinear model of the two axes the flagship actually scales
+        # (featurization + gram work are ~n*B; per-block solve overhead ~B;
+        # per-row extraction ~n). B is set by vocab: d = 2*(64+64)*vocab,
+        # B = d/4096 = vocab/16. Class count scales with n at the flagship's
+        # rows-per-class ratio (n/102) so the per-class solve population is
+        # represented, not degenerate. All four points + the fit constants
+        # are published here; the extrapolation factor is large (200-400x in
+        # n) and stated — same protocol as the TIMIT row.
+        from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+            flagship_config,
+            run as run_flagship,
+        )
+
+        def timed_flagship(n: int, vocab: int) -> float:
+            cfg = flagship_config(
+                synthetic_train=n,
+                synthetic_test=max(64, n // 8),
+                synthetic_classes=max(2, n // 102),
+                vocab_size=vocab,
+                num_pca_samples=100000,
+                num_gmm_samples=100000,
+                sample_images=min(n, 512),
+                extract_chunk=256,
+                fv_row_chunk=256,
+            )
+            run_flagship(cfg)  # cold (compile)
+            best = float("inf")
+            for _ in range(2):  # best-of-2: robust to background host load
+                t0 = time.perf_counter()
+                run_flagship(cfg)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        # vocab sets B = 2*(64+64)*vocab / 4096 = vocab/16; vocab >= 32 so a
+        # branch's FV (2*vocab*64) spans at least one 4096 solver block (the
+        # sliced-FV layout constraint) — so B in {2, 4}, same bs as flagship
+        n1, n2, b1, b2 = 512, 1024, 2, 4
+        t11 = timed_flagship(n1, 16 * b1)
+        t21 = timed_flagship(n2, 16 * b1)
+        t12 = timed_flagship(n1, 16 * b2)
+        t22 = timed_flagship(n2, 16 * b2)
+        c3 = (t22 - t21 - t12 + t11) / ((n2 - n1) * (b2 - b1))
+        c1 = (t21 - t11) / (n2 - n1) - c3 * b1
+        c2 = (t12 - t11) / (b2 - b1) - c3 * n1
+        c0 = t11 - c1 * n1 - c2 * b1 - c3 * n1 * b1
+        n_full, b_full = 102400, 16
+        full = c0 + c1 * n_full + c2 * b_full + c3 * n_full * b_full
+        out["imagenet_flagship_cpu_warm_measured_s"] = {
+            f"{n1}n_{b1}B": round(t11, 2), f"{n2}n_{b1}B": round(t21, 2),
+            f"{n1}n_{b2}B": round(t12, 2), f"{n2}n_{b2}B": round(t22, 2),
+        }
+        out["imagenet_flagship_cpu_warm_extrapolated_s"] = round(full, 1)
+        out["imagenet_flagship_extrapolation"] = (
+            f"t(n,B) = c0 + c1*n + c2*B + c3*n*B fitted on ({n1},{b1}), "
+            f"({n2},{b1}), ({n1},{b2}), ({n2},{b2}) rows x feature-blocks "
+            f"(best-of-2 warm runs each); c0={c0:.1f}s "
+            f"c1={c1*1000:.2f}ms/row c2={c2:.1f}s/blk c3={c3*1000:.3f}ms/(row*blk); "
+            f"evaluated at n={n_full}, B={b_full} (d=65536). Classes scale "
+            "with n at the flagship rows-per-class ratio; hw=64 as flagship."
+        )
 
     if not args.skip_timit:
         from keystone_tpu.pipelines.timit import TimitConfig, run as run_timit

@@ -18,7 +18,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run twice; also report the second (cache-hot) run")
     ap.add_argument("--train", type=int, default=102400)
     ap.add_argument("--test", type=int, default=5120)
-    ap.add_argument("--noise", type=float, default=0.08)
+    ap.add_argument("--noise", type=float, default=0.6,
+                    help="0.6 = the non-vacuous quality regime (flagship "
+                         "default); 0.08 = separable prototypes, 0%% error "
+                         "plumbing check")
+    ap.add_argument("--control-shuffled-labels", action="store_true",
+                    help="also run the shuffled-label control: train labels "
+                         "drawn independently of images; top-5 error must "
+                         "collapse to ~chance (1 - 5/classes)")
     ap.add_argument("--cache-dir", default="/tmp/keystone_xla_cache")
     return ap
 
@@ -43,6 +50,18 @@ def main() -> None:
     out = {"cold": run(cfg)}
     if args.warm:
         out["warm"] = run(cfg)
+    if args.control_shuffled_labels:
+        ctrl = flagship_config(
+            synthetic_train=args.train,
+            synthetic_test=args.test,
+            synthetic_noise=args.noise,
+            shuffle_labels=True,
+        )
+        res = run(ctrl)
+        chance = 100.0 * (1.0 - 5.0 / ctrl.synthetic_classes)
+        res["chance_top5_error"] = chance
+        res["collapsed_to_chance"] = bool(res["test_top5_error"] > 0.9 * chance)
+        out["shuffled_label_control"] = res
     print(json.dumps(out))
 
 

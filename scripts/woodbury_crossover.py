@@ -1,0 +1,103 @@
+"""Measure the dense-vs-Woodbury class-solve crossover on the real chip.
+
+VERDICT r2 weak #8: ``_use_woodbury``'s threshold (``max_nc + 1 <= bs // 8``)
+was set conservatively without on-chip evidence. This script times
+``_bucketed_class_solves`` at the flagship block size (bs=4096) with the
+Woodbury path forced ON and OFF at several max_nc/bs ratios and prints one
+JSON line per point — the measured basis for the threshold (quoted in the
+``_use_woodbury`` docstring).
+
+Run on the TPU: ``python scripts/woodbury_crossover.py``.
+Timing is latency-cancelled: each measurement chains K solves and subtracts
+a 1-solve run, so the tunnel round-trip (~100 ms) drops out.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import keystone_tpu.learning.block_weighted as bw
+
+
+def build_case(bs: int, nc: int, num_classes: int, seed: int = 0):
+    n = nc * num_classes
+    rng = np.random.default_rng(seed)
+    X = jnp.asarray(rng.normal(size=(n, bs)).astype(np.float32))
+    lab = np.arange(n) % num_classes
+    rng.shuffle(lab)
+    ind = -np.ones((n, num_classes), np.float32)
+    ind[np.arange(n), lab] = 1.0
+    labels = jnp.asarray(ind)
+    class_idx, counts, valid = bw._prepare(labels, None, num_classes)
+    n_eff = jnp.sum(counts).astype(jnp.float32)
+    R = (labels - 0.1) * valid[:, None]
+    buckets, inv_perm = bw._class_buckets(
+        np.asarray(counts), np.asarray(class_idx)
+    )
+    prec = "high"
+    pop_mean, pop_cov, pop_xtr = jax.jit(
+        bw._pop_stats, static_argnames=("precision",)
+    )(X, R, valid, n_eff, precision=prec)
+    w, lam = jnp.float32(0.25), jnp.float32(6e-5)
+    base_inv = bw._base_inverse(pop_cov, lam, w, prec)
+    class_sums = bw._class_sums(X, class_idx, num_classes)
+    class_means = class_sums / jnp.maximum(
+        counts[:, None].astype(jnp.float32), 1.0
+    )
+    joint_means_b = w * class_means + (1.0 - w) * pop_mean
+    _, residual_mean = bw._class_col_means(R, class_idx, counts)
+    model0 = jnp.zeros((bs, num_classes), jnp.float32)
+    return dict(
+        Xb=X, R=R, counts=counts, pop_cov=pop_cov, pop_mean=pop_mean,
+        pop_xtr=pop_xtr, joint_means_b=joint_means_b,
+        residual_mean=residual_mean, model_b=model0, lam=lam, w=w,
+        buckets=buckets, inv_perm=inv_perm, base_inv=base_inv,
+        precision=prec,
+    )
+
+
+def timed_solves(case, woodbury: bool, iters: int = 3) -> float:
+    orig = bw._use_woodbury
+    bw._use_woodbury = lambda max_nc, bs: woodbury
+    try:
+        def once(shift):
+            return bw._bucketed_class_solves(
+                case["Xb"], case["R"] + shift, case["counts"], case["pop_cov"],
+                case["pop_mean"], case["pop_xtr"], case["joint_means_b"],
+                case["residual_mean"], case["model_b"], case["lam"], case["w"],
+                case["buckets"], case["inv_perm"], case["base_inv"],
+                precision=case["precision"],
+            )
+
+        def chain(k):
+            outs = [once(1e-6 * i) for i in range(k)]
+            float(outs[-1].sum())  # warm + drain
+            t0 = time.perf_counter()
+            outs = [once(1e-5 * i) for i in range(k)]
+            float(outs[-1].sum())
+            return time.perf_counter() - t0
+
+        return (chain(1 + iters) - chain(1)) / iters
+    finally:
+        bw._use_woodbury = orig
+
+
+def main():
+    bs = 4096
+    for ratio_name, nc, C in (("1/16", 256, 32), ("1/8", 512, 16),
+                              ("1/4", 1024, 8), ("1/2", 2048, 4)):
+        case = build_case(bs, nc, C)
+        t_w = timed_solves(case, True)
+        t_d = timed_solves(case, False)
+        print(json.dumps({
+            "bs": bs, "max_nc_over_bs": ratio_name, "nc": nc, "classes": C,
+            "woodbury_s": round(t_w, 4), "dense_s": round(t_d, 4),
+            "woodbury_speedup": round(t_d / t_w, 2),
+        }))
+
+
+if __name__ == "__main__":
+    main()

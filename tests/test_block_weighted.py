@@ -398,3 +398,173 @@ def test_weighted_streaming_grouped_fisher_sharded_mesh(rng, devices):
     np.testing.assert_allclose(
         np.asarray(preds)[:n], p_ref, atol=0.05 * np.abs(p_ref).max() + 1e-3
     )
+
+
+class _FailingSliceNode(_SliceNode):
+    """Raises on the k-th apply call — the mid-fit crash injector."""
+
+    calls = 0
+
+    def __init__(self, lo, hi, fail_at):
+        super().__init__(lo, hi)
+        self.fail_at = fail_at
+
+    def apply_batch(self, raw):
+        _FailingSliceNode.calls += 1
+        if _FailingSliceNode.calls == self.fail_at:
+            raise RuntimeError("injected mid-fit crash")
+        return super().apply_batch(raw)
+
+
+@pytest.mark.parametrize("num_iter", [1, 2])
+def test_streaming_checkpoint_kill_and_resume_bit_exact(rng, tmp_path, num_iter):
+    """Mid-fit checkpoint/resume (VERDICT r2 next #6): kill the streaming
+    fit partway (a feature node raises), resume from the checkpoint, and
+    the resumed fit must equal the uninterrupted fit BIT-exactly — the
+    saved state (residual, models, joint means, cursor) plus deterministic
+    recomputation of the pass-0 caches is the whole loop state."""
+    x, labels, ind = _toy(rng, n=160, d=32, balanced=False)
+    bs = 8
+    nblocks = x.shape[1] // bs
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=bs, num_iter=num_iter, lam=0.1, mixture_weight=0.25
+    )
+    raw = {"x": jnp.asarray(x)}
+    nodes = [_SliceNode(k * bs, (k + 1) * bs) for k in range(nblocks)]
+    m_ref = est.fit_streaming(nodes, raw, jnp.asarray(ind))
+
+    ckpt = str(tmp_path / "midfit.ckpt")
+    # crash on the 3rd block visit of the LAST iteration, after two
+    # checkpoints have been written in that iteration
+    fail_at = (num_iter - 1) * nblocks + 3
+    _FailingSliceNode.calls = 0
+    failing = [
+        _FailingSliceNode(k * bs, (k + 1) * bs, fail_at) for k in range(nblocks)
+    ]
+    with pytest.raises(RuntimeError, match="injected"):
+        est.fit_streaming(
+            failing, raw, jnp.asarray(ind),
+            checkpoint_path=ckpt, checkpoint_every=1,
+        )
+    assert (tmp_path / "midfit.ckpt").exists()
+
+    # resume with healthy nodes from the same path
+    m_res = est.fit_streaming(
+        nodes, raw, jnp.asarray(ind),
+        checkpoint_path=ckpt, checkpoint_every=1,
+    )
+    np.testing.assert_array_equal(np.asarray(m_res.w), np.asarray(m_ref.w))
+    np.testing.assert_array_equal(np.asarray(m_res.b), np.asarray(m_ref.b))
+
+
+def test_streaming_checkpoint_rejects_mismatched_shape(rng, tmp_path):
+    x, labels, ind = _toy(rng, n=80, d=16, balanced=False)
+    est = BlockWeightedLeastSquaresEstimator(8, 1, 0.1, 0.25)
+    ckpt = str(tmp_path / "c.ckpt")
+    # interrupt after block 1 so a checkpoint survives (a COMPLETED fit
+    # removes its checkpoint — pinned below)
+    _FailingSliceNode.calls = 0
+    failing = [_FailingSliceNode(k * 8, (k + 1) * 8, 2) for k in range(2)]
+    with pytest.raises(RuntimeError, match="injected"):
+        est.fit_streaming(failing, {"x": jnp.asarray(x)}, jnp.asarray(ind),
+                          checkpoint_path=ckpt, checkpoint_every=1)
+    assert (tmp_path / "c.ckpt").exists()
+    est4 = BlockWeightedLeastSquaresEstimator(4, 1, 0.1, 0.25)
+    nodes4 = [_SliceNode(k * 4, (k + 1) * 4) for k in range(4)]
+    with pytest.raises(ValueError, match="checkpoint"):
+        est4.fit_streaming(nodes4, {"x": jnp.asarray(x)}, jnp.asarray(ind),
+                           checkpoint_path=ckpt, checkpoint_every=1)
+
+
+def test_streaming_checkpoint_removed_after_completed_fit(rng, tmp_path):
+    """A completed fit deletes its checkpoint: a rerun with the same path on
+    different same-shape data must FIT, not silently resume a stale cursor
+    (code-review r3 finding)."""
+    x, labels, ind = _toy(rng, n=80, d=16, balanced=False)
+    est = BlockWeightedLeastSquaresEstimator(8, 1, 0.1, 0.25)
+    nodes = [_SliceNode(k * 8, (k + 1) * 8) for k in range(2)]
+    ckpt = str(tmp_path / "done.ckpt")
+    est.fit_streaming(nodes, {"x": jnp.asarray(x)}, jnp.asarray(ind),
+                      checkpoint_path=ckpt, checkpoint_every=1)
+    assert not (tmp_path / "done.ckpt").exists()
+    # rerun on different data: must produce that data's own solution
+    x2 = x[::-1].copy()
+    m2 = est.fit_streaming(nodes, {"x": jnp.asarray(x2)}, jnp.asarray(ind),
+                           checkpoint_path=ckpt, checkpoint_every=1)
+    m2_ref = est.fit_streaming(nodes, {"x": jnp.asarray(x2)}, jnp.asarray(ind))
+    np.testing.assert_array_equal(np.asarray(m2.w), np.asarray(m2_ref.w))
+
+
+def test_woodbury_matches_dense_at_flagship_conditioning(rng, monkeypatch):
+    """ADVICE r2: the Woodbury path forms B^-1 = ((1-w)popCov + lam*I)^-1
+    explicitly, and the r2 equivalence evidence ran at lam=0.05 / bs=128 —
+    far better conditioned than the flagship (lam=6e-5, correlated FV-like
+    features). This pins Woodbury == dense under flagship-like conditioning:
+    low-rank-dominated covariance (features = loadings @ factors + small
+    noise, condition number >> 1e4) and the flagship lambda."""
+    import keystone_tpu.learning.block_weighted as bw
+
+    n, d, c, rank = 512, 128, 32, 12
+    # strongly correlated features: 12 latent factors + 1e-3 noise floor
+    loadings = rng.normal(size=(n, rank)).astype(np.float32)
+    factors = rng.normal(size=(rank, d)).astype(np.float32)
+    x = loadings @ factors + 1e-3 * rng.normal(size=(n, d)).astype(np.float32)
+    cov = np.cov(x.T)
+    evals = np.linalg.eigvalsh(cov)
+    assert evals.max() / max(evals.min(), 1e-30) > 1e4  # genuinely ill-posed
+    labels = (np.arange(n) % c).astype(np.int32)
+    rng.shuffle(labels)
+    ind = np.asarray(ClassLabelIndicatorsFromIntLabels(c)(jnp.asarray(labels)))
+
+    bs = d  # one block
+    m_wood = BlockWeightedLeastSquaresEstimator(
+        bs, 1, 6e-5, 0.25, woodbury="always"
+    ).fit(jnp.asarray(x), jnp.asarray(ind))
+    m_dense = BlockWeightedLeastSquaresEstimator(
+        bs, 1, 6e-5, 0.25, woodbury="never"
+    ).fit(jnp.asarray(x), jnp.asarray(ind))
+    # At this conditioning f32 WEIGHTS are not comparable (the objective is
+    # flat along the near-null space and the two algorithms pick different
+    # near-minimizers; vs an f64 oracle BOTH carry O(0.1) weight error).
+    # The meaningful solver contract is the OBJECTIVE: both must reach the
+    # same residual to well under 1%.
+    pred_w = np.asarray(x @ np.asarray(m_wood.w)) + np.asarray(m_wood.b)
+    pred_d = np.asarray(x @ np.asarray(m_dense.w)) + np.asarray(m_dense.b)
+    res_w = np.linalg.norm(pred_w - ind)
+    res_d = np.linalg.norm(pred_d - ind)
+    assert abs(res_w - res_d) / res_d < 0.01, (res_w, res_d)
+    # and the dense escape hatch (woodbury="never") must exist and agree
+    # with the f64 oracle's predictions much more tightly than Woodbury —
+    # the documented envelope in BlockWeightedLeastSquaresEstimator.__init__
+    W64, _ = _weighted_oracle_single_block(
+        x.astype(np.float64), ind.astype(np.float64), 6e-5, 0.25
+    )
+    po = x @ W64
+    err_d = np.abs(x @ np.asarray(m_dense.w) - po).max()
+    err_w = np.abs(x @ np.asarray(m_wood.w) - po).max()
+    assert err_d < 0.1 * np.abs(po).max()
+    assert err_d < err_w  # dense is the accuracy-side choice here
+
+
+def test_woodbury_threshold_boundary_both_ways(rng, monkeypatch):
+    """The boundary bucket (max_nc straddling bs//4) must produce the same
+    solution whichever side of the crossover it lands on — the threshold is
+    a performance choice, never a correctness one. Measured basis for the
+    bs//4 value: scripts/woodbury_crossover.py (quoted in _use_woodbury)."""
+    import keystone_tpu.learning.block_weighted as bw
+
+    bs = 64
+    # exactly AT the threshold: max_nc + 1 == bs // 4
+    nc = bs // 4 - 1
+    assert bw._use_woodbury(nc, bs) and not bw._use_woodbury(nc + 1, bs)
+    c = 8
+    n = nc * c
+    x, labels = _toy(rng, n=n, d=bs, c=c, balanced=True)[:2]
+    ind = np.asarray(ClassLabelIndicatorsFromIntLabels(c)(jnp.asarray(labels)))
+    est = BlockWeightedLeastSquaresEstimator(bs, 1, 0.05, 0.25)
+    m_auto = est.fit(jnp.asarray(x), jnp.asarray(ind))  # Woodbury side
+    monkeypatch.setattr(bw, "_use_woodbury", lambda max_nc, bs: False)
+    m_dense = est.fit(jnp.asarray(x), jnp.asarray(ind))
+    np.testing.assert_allclose(
+        np.asarray(m_auto.w), np.asarray(m_dense.w), atol=2e-4
+    )

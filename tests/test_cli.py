@@ -92,3 +92,39 @@ def test_snake_case_resolves(monkeypatch):
     rc, _, _ = _run_capture(["mnist_random_fft", "--num-ffts", "2"])
     assert rc == 0
     assert called["argv"] == ["--num-ffts", "2"]
+
+
+def test_hosts_emits_per_host_commands(capsys):
+    from keystone_tpu.cli import main
+
+    rc = main(["--hosts", "h0,h1,h2", "--mesh-model", "2",
+               "--devices-per-host", "4", "Timit", "--num-epochs", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l and not l.startswith("#")]
+    assert len(lines) == 3
+    for i, line in enumerate(lines):
+        assert f"--process-id {i}" in line
+        assert "--coordinator h0:8476" in line  # first host elected
+        assert "--num-processes 3" in line
+        assert "--mesh-model 2" in line
+        assert "Timit --num-epochs 5" in line
+    assert "12 devices -> (data=6, model=2)" in out
+
+
+def test_hosts_rejects_indivisible_mesh(capsys):
+    from keystone_tpu.cli import main
+
+    rc = main(["--hosts", "h0,h1", "--mesh-model", "3", "Timit"])
+    assert rc == 2
+    assert "does not divide" in capsys.readouterr().err
+
+
+def test_emit_host_commands_unit():
+    from keystone_tpu.cli import emit_host_commands
+
+    lines, note = emit_host_commands(["a", " b "], ["MnistRandomFFT"],
+                                     devices_per_host=8, port=9000)
+    assert lines[0][0] == "a" and lines[1][0] == "b"
+    assert "--coordinator a:9000" in lines[1][1]
+    assert "16 devices" in note

@@ -1,0 +1,148 @@
+"""Communication-pattern pins for the sharded streaming weighted solve.
+
+SURVEY §2.13/§7: the multi-chip design is *psum over ICI* — per-block gram
+and cross-term reductions lower to all-reduces, and neither the feature
+block nor the raw descriptors are ever all-gathered (a silent all-gather of
+a (n, 4096) block is the classic sharding regression: correct numerics,
+cluster-killing traffic). These tests compile the actual solver step and
+the grouped Fisher featurization under the 8-device mesh with row-sharded
+inputs and assert the collective mix in the optimized HLO text — catching
+regressions that the numeric mesh tests (``test_block_weighted.py``) cannot
+see.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import keystone_tpu.learning.block_weighted as bw
+
+
+def _collectives(hlo_text: str):
+    return {
+        "all-reduce": len(re.findall(r"all-reduce\(|all-reduce-start\(", hlo_text)),
+        "all-gather": len(re.findall(r"all-gather\(|all-gather-start\(", hlo_text)),
+        "all-to-all": len(re.findall(r"all-to-all\(", hlo_text)),
+    }
+
+
+@pytest.fixture()
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def test_weighted_block_step_all_reduces_never_gathers(mesh, rng):
+    """One full streaming-solver block step (pop stats + Woodbury-eligible
+    bucketed class solves + residual update) with row-sharded X/R: the HLO
+    must contain all-reduces (the psum-over-ICI reductions) and NO
+    all-gather / all-to-all — X stays sharded end to end."""
+    n, bs, C = 512, 64, 128  # nc = 4 exactly -> Woodbury at bs//8=8
+    X = rng.normal(size=(n, bs)).astype(np.float32)
+    lab = np.arange(n) % C  # balanced so every bucket stays under threshold
+    rng.shuffle(lab)
+    ind = -np.ones((n, C), np.float32)
+    ind[np.arange(n), lab] = 1.0
+
+    rows = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+
+    labels = jnp.asarray(ind)
+    class_idx, counts, valid = bw._prepare(labels, None, C)
+    n_eff = jnp.sum(counts).astype(jnp.float32)
+    R = (labels - 0.1) * valid[:, None]
+    buckets, inv_perm = bw._class_buckets(
+        np.asarray(counts), np.asarray(class_idx)
+    )
+    max_nc = int(np.asarray(counts).max())
+    assert bw._use_woodbury(max_nc, bs), "test must exercise the Woodbury path"
+    w, lam, prec = jnp.float32(0.25), jnp.float32(0.05), "high"
+    model0 = jnp.zeros((bs, C), jnp.float32)
+    _, residual_mean = bw._class_col_means(R, class_idx, counts)
+    class_sums = bw._class_sums(jnp.asarray(X), class_idx, C)
+
+    def step(Xb, R, valid, counts, inv_perm, residual_mean, model):
+        pop_mean, pop_cov, pop_xtr = bw._pop_stats(
+            Xb, R, valid, n_eff, precision=prec
+        )
+        base_inv = (
+            bw._base_inverse(pop_cov, lam, w, prec)
+            if bw._needs_base_inverse(buckets, bs)
+            else None
+        )
+        class_means = class_sums / jnp.maximum(
+            counts[:, None].astype(jnp.float32), 1.0
+        )
+        joint_means_b = w * class_means + (1.0 - w) * pop_mean
+        dW = bw._bucketed_class_solves(
+            Xb, R, counts, pop_cov, pop_mean, pop_xtr, joint_means_b,
+            residual_mean, model, lam, w, buckets, inv_perm, base_inv,
+            precision=prec,
+        )
+        R2 = bw._apply_update(R, Xb, dW, valid, precision=prec)
+        return dW, R2
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(rows, rows, rows, rep, rep, rep, rep),
+        out_shardings=(rep, rows),
+    )
+    args = (
+        jnp.asarray(X), R, valid, counts, inv_perm, residual_mean, model0,
+    )
+    txt = jitted.lower(*args).compile().as_text()
+    cols = _collectives(txt)
+    # per-block reductions ride all-reduce (psum) — XLA merges adjacent
+    # reductions, so the count floor is deliberately loose (observed: 2 with
+    # the Woodbury path, 8 with dense solves); the hard pin is gather==0
+    assert cols["all-reduce"] >= 1, cols
+    assert cols["all-gather"] == 0, (
+        f"sharded solver step all-gathers (X or R replicated!): {cols}"
+    )
+    assert cols["all-to-all"] == 0, cols
+    # and the numbers must still be right: sharded step == replicated step
+    dW_sh, _ = jitted(*args)
+    dW_ref, _ = jax.jit(step)(*args)
+    np.testing.assert_allclose(
+        np.asarray(dW_sh), np.asarray(dW_ref), atol=2e-4
+    )
+
+
+def test_grouped_fisher_block_featurization_never_gathers_descriptors(
+    mesh, rng
+):
+    """The grouped FV block featurization (what fit_streaming calls per
+    cache group) on row-sharded bf16 descriptors: per-row work only — the
+    HLO must contain no collective at all (descriptors never leave their
+    shard; the only cross-shard traffic of the streaming fit is the solver's
+    all-reduces, pinned above)."""
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_fisher_block_nodes,
+    )
+
+    k, d, n = 4, 16, 256
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=5).fit(
+        jnp.asarray(rng.normal(size=(200, d)).astype(np.float32))
+    )
+    bs = 2 * d  # 2k*d = 128 branch width -> 4 blocks of 32
+    nodes = make_fisher_block_nodes(gmm, block_size=bs, cache_blocks=2)
+    descs = jnp.asarray(rng.normal(size=(n, 6, d)), jnp.bfloat16)
+    l1 = fisher_l1_norms(descs.astype(jnp.float32), gmm, chunk=64)
+    rows = NamedSharding(mesh, P("data"))
+
+    node = nodes[0]
+    assert node.cache_group is not None  # grouping active
+    gnode = node.group_node()
+
+    def featurize(descs, l1):
+        return gnode({"descs": descs, "l1": l1})
+
+    jitted = jax.jit(featurize, in_shardings=(rows, rows), out_shardings=rows)
+    txt = jitted.lower(descs, l1).compile().as_text()
+    cols = _collectives(txt)
+    assert cols["all-gather"] == 0 and cols["all-to-all"] == 0, cols

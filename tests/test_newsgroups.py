@@ -221,7 +221,7 @@ class TestFastTextEquivalence:
         np.testing.assert_allclose(dense[:, 1], [1.0, 2.0, 0.0])  # 'b'
         np.testing.assert_allclose(dense[:, 2], [0.0, 0.0, 1.0])  # 'c'
 
-    def test_pipeline_both_paths_agree(self):
+    def test_pipeline_both_host_paths_agree(self):
         # common_features above the distinct-n-gram count: no truncation cut,
         # so both paths select identical feature sets and the comparison is
         # tie-free (at a truncating cut the two paths break count ties among
@@ -232,8 +232,8 @@ class TestFastTextEquivalence:
             synthetic_classes=4,
             common_features=10**6,
         )
-        fast = run(NewsgroupsConfig(fast_host_path=True, **cfg))
-        slow = run(NewsgroupsConfig(fast_host_path=False, **cfg))
+        fast = run(NewsgroupsConfig(fast_host_path=True, device_path=False, **cfg))
+        slow = run(NewsgroupsConfig(fast_host_path=False, device_path=False, **cfg))
         assert fast["test_error"] == slow["test_error"]
         assert fast["train_error"] == slow["train_error"]
 
@@ -252,3 +252,112 @@ class TestFastTextEquivalence:
         batch = vec([])
         assert batch.indices.shape[0] == 0
         assert batch.num_features == vec.num_features
+
+
+class TestDeviceTextEquivalence:
+    """The on-device featurizer (ops/nlp/device_text.py) must produce the
+    same features as the host fused path when fed the same id encoding."""
+
+    @staticmethod
+    def _encode_padded(docs, vocab=None):
+        """Tokenize/encode with the host fast path's vocabulary (first-seen
+        order) and pad to [D, L] — so device keys are bit-identical."""
+        from keystone_tpu.ops.nlp.fast_text import _tokenize_encode
+
+        grow = vocab is None
+        if vocab is None:
+            vocab = {}
+        ids, doc_of = _tokenize_encode(docs, "[\\s]+", vocab, grow=grow)
+        n_docs = len(docs)
+        lengths = np.bincount(doc_of, minlength=n_docs).astype(np.int32)
+        max_len = max(1, int(lengths.max(initial=0)))
+        out = np.full((n_docs, max_len), -1, np.int32)
+        starts = np.cumsum(lengths) - lengths
+        col = np.arange(len(ids)) - starts[doc_of]
+        out[doc_of, col] = ids
+        return out, lengths, vocab
+
+    def _both(self, docs, orders, k, weight="binary"):
+        from keystone_tpu.ops.nlp import EncodedCommonSparseFeatures
+        from keystone_tpu.ops.nlp.device_text import DeviceCommonSparseFeatures
+
+        host_vec, host_batch = EncodedCommonSparseFeatures(
+            orders=orders, num_features=k, weight=weight
+        ).fit_transform(docs)
+        ids, lengths, vocab = self._encode_padded(docs)
+        dev_vec, dev_batch = DeviceCommonSparseFeatures(
+            base=len(vocab) + 1, orders=orders, num_features=k, weight=weight
+        ).fit_transform(ids, lengths)
+        return host_vec, host_batch, dev_vec, dev_batch, vocab
+
+    def test_untruncated_exact_match(self):
+        docs, _, _ = synthetic_newsgroups(100, num_classes=4, seed=9)
+        docs = list(docs) + ["", "   ", "one", "repeat repeat repeat"]
+        hv, hb, dv, db, _ = self._both(docs, (1, 2), 10**6)
+        assert dv.num_features == hv.num_features
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(dv.keys_sorted)), hv.keys_sorted
+        )
+        np.testing.assert_allclose(
+            np.asarray(db.to_dense()), np.asarray(hb.to_dense())
+        )
+
+    def test_oov_test_docs_exact_match(self):
+        from keystone_tpu.ops.nlp import EncodedCommonSparseFeatures
+        from keystone_tpu.ops.nlp.device_text import DeviceCommonSparseFeatures
+
+        train, _, _ = synthetic_newsgroups(80, num_classes=3, seed=4)
+        test, _, _ = synthetic_newsgroups(25, num_classes=3, seed=5)
+        test = list(test) + ["totally unseen xyzzy words", ""]
+        orders = (1, 2, 3)
+        host_vec = EncodedCommonSparseFeatures(
+            orders=orders, num_features=10**6, weight="binary"
+        ).fit(train)
+        ids, lengths, vocab = self._encode_padded(train)
+        dev_vec = DeviceCommonSparseFeatures(
+            base=len(vocab) + 1, orders=orders, num_features=10**6
+        ).fit(ids, lengths)
+        t_ids, t_lengths, _ = self._encode_padded(test, vocab)
+        np.testing.assert_allclose(
+            np.asarray(dev_vec.apply_encoded(t_ids, t_lengths).to_dense()),
+            np.asarray(host_vec.apply_batch(test).to_dense()),
+        )
+
+    def test_count_weighting_exact(self):
+        docs = ["a a a b", "a b b", "c"]
+        hv, hb, dv, db, _ = self._both(docs, (1,), 100, weight="count")
+        np.testing.assert_allclose(
+            np.asarray(db.to_dense()), np.asarray(hb.to_dense())
+        )
+
+    def test_truncation_totals_match(self):
+        docs, _, _ = synthetic_newsgroups(60, num_classes=4, seed=6)
+        k = 40
+        hv, hb, dv, db, _ = self._both(docs, (1, 2), k)
+        assert db.num_features == hb.num_features == k
+        ref_tot = sorted(np.asarray(hb.to_dense()).sum(0))
+        dev_tot = sorted(np.asarray(db.to_dense()).sum(0))
+        np.testing.assert_allclose(dev_tot, ref_tot)
+
+    def test_pipeline_device_matches_host_errors(self):
+        cfg = dict(
+            synthetic_train=300,
+            synthetic_test=80,
+            synthetic_classes=4,
+            common_features=10**6,
+        )
+        dev = run(NewsgroupsConfig(device_path=True, **cfg))
+        host = run(NewsgroupsConfig(device_path=False, **cfg))
+        # different corpora realizations (device ids vs host strings of the
+        # same distribution) — both must separate the synthetic topics
+        assert dev["test_error"] < 10.0 and host["test_error"] < 10.0
+        assert dev["macro_f1"] > 0.9 and host["macro_f1"] > 0.9
+
+    def test_device_synthetic_generator_shapes(self):
+        from keystone_tpu.loaders.newsgroups import synthetic_newsgroups_device
+
+        ids, lengths, labels, vocab = synthetic_newsgroups_device(50, 6, seed=0)
+        assert ids.shape[0] == 50 and labels.shape == (50,)
+        assert int(lengths.min()) >= 30 and int(lengths.max()) < 120
+        assert vocab == 200 + 6 * 30
+        assert int(ids.max()) < vocab and int(ids.min()) >= 0
