@@ -146,6 +146,73 @@ def _streaming_contrib(feat_node, raw, wk, fmean):
     return (feat_node.apply_batch(raw) - fmean) @ wk
 
 
+def _chunk_of(raw, start: int, size: int):
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, start, size, 0), raw
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("size", "precision"))
+def _chunk_accum(feat_node, raw, R, mask, fmean, acc, start, size, precision):
+    """One row chunk of the streaming-block moment accumulation.
+
+    ``start`` is a traced scalar (``size`` static): 2.2M rows / 131k-chunk
+    = 17 offsets, and a static start would recompile the featurize+gram
+    program per offset — traced, there are exactly two compilations (full
+    chunk + ragged tail).
+
+    Raw mode (``fmean=None``): accumulates (Σf, FᵀF, FᵀR, Σ_rows R) over
+    masked featurized rows — centering is applied in closed form afterwards.
+    Centered mode (``fmean`` given; later passes): accumulates the centered
+    gram/cross directly; ``acc`` entries set to None are skipped (gram-cached
+    passes need only the cross term, keeping their cost at O(n·b·c))."""
+    from keystone_tpu.linalg.solvers import hdot
+
+    rc = _chunk_of(raw, start, size)
+    Rc = jax.lax.dynamic_slice_in_dim(R, start, size, 0)
+    f = feat_node.apply_batch(rc).astype(jnp.float32)
+    if mask is not None:
+        mc = jax.lax.dynamic_slice_in_dim(mask, start, size, 0)
+        f = f * mc[:, None]
+    if fmean is not None:
+        f = f - fmean
+        if mask is not None:
+            f = f * mc[:, None]
+    s, G, C, rsum = acc
+    if s is not None:
+        s = s + jnp.sum(f, axis=0)
+    if G is not None:
+        G = G + hdot(f.T, f, precision)
+    C = C + hdot(f.T, Rc, precision)
+    if rsum is not None:
+        rsum = rsum + jnp.sum(Rc, axis=0)
+    return s, G, C, rsum
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("size", "precision"),
+    donate_argnums=(2,),
+)
+def _chunk_update(feat_node, raw, R, mask, fmean, dW, start, size, precision):
+    """One row chunk of the residual update ``R -= (F - fmean)·mask @ dW``.
+
+    ``R`` is donated: at full-TIMIT scale the residual is 1.3 GB and the
+    async dispatch queue holds many pending updates — without input-output
+    aliasing every queued update pins its own copy and the allocator
+    exhausts HBM before execution catches up."""
+    from keystone_tpu.linalg.solvers import hdot
+
+    rc = _chunk_of(raw, start, size)
+    Rc = jax.lax.dynamic_slice_in_dim(R, start, size, 0)
+    f = feat_node.apply_batch(rc).astype(jnp.float32) - fmean
+    if mask is not None:
+        mc = jax.lax.dynamic_slice_in_dim(mask, start, size, 0)
+        f = f * mc[:, None]
+    Rc = Rc - hdot(f, dW, precision)
+    return jax.lax.dynamic_update_slice_in_dim(R, Rc, start, 0)
+
+
 class BlockLeastSquaresEstimator(LabelEstimator):
     """Fit via block coordinate descent with L2.
 
@@ -185,12 +252,23 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         raw,
         labels,
         mask: Optional[jax.Array] = None,
+        row_chunk: int = 0,
     ) -> BlockLinearMapper:
         """Fit with one feature block per node, re-featurizing ``raw`` inside
         the solver loop instead of materializing the feature matrix.
 
         Every node must emit ``block_size`` features. The returned mapper is
         dense; use :func:`streaming_apply_and_evaluate` for out-of-core apply.
+
+        ``row_chunk > 0`` additionally row-chunks every block pass: grams,
+        cross terms, and residual updates accumulate over (chunk, b) feature
+        tiles, so not even ONE full (n, block_size) feature block ever
+        materializes — the regime where n itself is HBM-scale (full-TIMIT:
+        2.2M rows × 4096-wide blocks = 36 GB/block; with chunking the live
+        set is the raw data + residual + one (chunk, b) tile). Costs one
+        extra featurization pass per block visit (the accumulate pass and
+        the residual-update pass each featurize); exact equivalence with the
+        unchunked path is pinned in ``tests/test_block_linear_streaming.py``.
         """
         from keystone_tpu.core.dataset import Dataset
         from keystone_tpu.ops.stats.scaler import StandardScaler
@@ -207,6 +285,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         from keystone_tpu.linalg.solvers import get_solver_precision
 
         precision = get_solver_precision()
+
+        if row_chunk > 0:
+            return self._fit_streaming_chunked(
+                feature_nodes, raw, B.astype(jnp.float32), mask, lam,
+                label_scaler, row_chunk, precision,
+            )
 
         fmeans: list = [None] * len(feature_nodes)
         Ws: list = [None] * len(feature_nodes)
@@ -230,6 +314,85 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                         node, raw, R, Ws[k], lam, mask, fmeans[k],
                         precision=precision,
                     )
+        return BlockLinearMapper(
+            w=jnp.concatenate(Ws, axis=0),
+            b=label_scaler.mean,
+            feature_means=jnp.concatenate(fmeans),
+            block_size=self.block_size,
+        )
+
+    def _fit_streaming_chunked(
+        self, feature_nodes, raw, R, mask, lam, label_scaler, chunk: int,
+        precision: str,
+    ) -> BlockLinearMapper:
+        """Row-chunked fit_streaming body (see its docstring): per block,
+        pass A accumulates (Σf, FᵀF, FᵀR, ΣR) over row chunks, the centered
+        gram/cross follow in closed form (centering is affine:
+        Σ(f−μ)(f−μ)ᵀ = FᵀF − ssᵀ/n over the same masked rows), and pass B
+        applies the residual update chunk by chunk."""
+        from keystone_tpu.linalg.solvers import spd_solve
+
+        n = R.shape[0]
+        n_eff = jnp.sum(mask) if mask is not None else jnp.float32(n)
+        starts = [(s, min(chunk, n - s)) for s in range(0, n, chunk)]
+
+        def accumulate(node, R, fmean, need_gram: bool, b: int):
+            s = None if fmean is not None else jnp.zeros((b,), jnp.float32)
+            G = jnp.zeros((b, b), jnp.float32) if need_gram else None
+            C = jnp.zeros((b, R.shape[1]), jnp.float32)
+            rsum = None if fmean is not None else jnp.zeros(
+                (R.shape[1],), jnp.float32
+            )
+            acc = (s, G, C, rsum)
+            for start, size in starts:
+                acc = _chunk_accum(
+                    node, raw, R, mask, fmean, acc,
+                    jnp.int32(start), size, precision,
+                )
+            return acc
+
+        def update(node, R, fmean, dW):
+            for start, size in starts:
+                R = _chunk_update(
+                    node, raw, R, mask, fmean, dW,
+                    jnp.int32(start), size, precision,
+                )
+            return R
+
+        # feature width without featurizing: abstract evaluation only
+        probe = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct((starts[0][1],) + a.shape[1:], a.dtype),
+            raw,
+        )
+
+        fmeans: list = [None] * len(feature_nodes)
+        Ws: list = [None] * len(feature_nodes)
+        grams: list = [None] * len(feature_nodes)
+        for k, node in enumerate(feature_nodes):
+            b = jax.eval_shape(node.apply_batch, probe).shape[1]
+            s, G, C, rsum = accumulate(node, R, None, True, b)
+            fmean = s / n_eff
+            gram = G - jnp.outer(s, s) / n_eff
+            cross = C - jnp.outer(fmean, rsum)
+            eye = jnp.eye(b, dtype=jnp.float32)
+            Wk = spd_solve(gram + lam * eye, cross)
+            R = update(node, R, fmean, Wk)
+            fmeans[k], Ws[k] = fmean, Wk
+            if self.cache_grams and self.num_iter > 1:
+                grams[k] = gram
+        for _ in range(self.num_iter - 1):
+            for k, node in enumerate(feature_nodes):
+                b = Ws[k].shape[0]
+                need_gram = grams[k] is None
+                _, G, C, _ = accumulate(node, R, fmeans[k], need_gram, b)
+                gram = grams[k] if grams[k] is not None else G
+                eye = jnp.eye(b, dtype=jnp.float32)
+                from keystone_tpu.linalg.solvers import hdot
+
+                rhs = C + hdot(gram, Ws[k], precision)
+                Wk_new = spd_solve(gram + lam * eye, rhs)
+                R = update(node, R, fmeans[k], Wk_new - Ws[k])
+                Ws[k] = Wk_new
         return BlockLinearMapper(
             w=jnp.concatenate(Ws, axis=0),
             b=label_scaler.mean,

@@ -55,6 +55,18 @@ def _key_dtype(base: int, orders: Tuple[int, ...]):
     return jnp.int64
 
 
+def _x64_if_needed(base: int, orders):
+    """int64 keys only exist under ``jax.enable_x64`` (jax's default 32-bit
+    mode silently canonicalizes jnp.int64 to int32 — the Horner packing
+    would wrap and distinct n-grams would collide on exactly the real-corpus
+    vocab sizes the int64 path exists for). No-op for int32-packable
+    configs."""
+    from contextlib import nullcontext
+
+    return jax.enable_x64() if _key_dtype(base, tuple(orders)) == jnp.int64 \
+        else nullcontext()
+
+
 def _pack_orders(
     ids: jnp.ndarray,
     lengths: jnp.ndarray,
@@ -227,16 +239,17 @@ class DeviceNGramVectorizer(Transformer):
         max_nnz = sum(
             max(0, ids.shape[1] - o + 1) for o in self.orders
         ) or 1
-        indices, values = _vectorize(
-            ids,
-            jnp.asarray(lengths),
-            self.keys_sorted,
-            self.feat_of_pos,
-            self.orders,
-            self.base,
-            self.weight,
-            max_nnz,
-        )
+        with _x64_if_needed(self.base, self.orders):
+            indices, values = _vectorize(
+                ids,
+                jnp.asarray(lengths),
+                self.keys_sorted,
+                self.feat_of_pos,
+                self.orders,
+                self.base,
+                self.weight,
+                max_nnz,
+            )
         return SparseBatch(
             indices=indices, values=values, num_features=self.num_features
         )
@@ -282,11 +295,12 @@ class DeviceCommonSparseFeatures(Estimator):
     def fit(self, ids, lengths) -> DeviceNGramVectorizer:
         ids = jnp.asarray(ids)
         lengths = jnp.asarray(lengths)
-        distinct, totals, n_keys = _fit_totals(
-            ids, lengths, self.orders, self.base, self.weight
-        )
-        k = min(self.num_features, int(n_keys))  # the fit's one host sync
-        keys_sorted, feat_of_pos = _select_top_k(distinct, totals, max(k, 1))
+        with _x64_if_needed(self.base, self.orders):
+            distinct, totals, n_keys = _fit_totals(
+                ids, lengths, self.orders, self.base, self.weight
+            )
+            k = min(self.num_features, int(n_keys))  # the fit's one host sync
+            keys_sorted, feat_of_pos = _select_top_k(distinct, totals, max(k, 1))
         return DeviceNGramVectorizer(
             keys_sorted=keys_sorted,
             feat_of_pos=feat_of_pos,

@@ -209,12 +209,16 @@ def gmm_moments_sep(
         center = jnp.mean(x, axis=0)
     k = means.shape[0]
     k_pad = _round_up(k, _LANE)
-    n_pad = _round_up(max(n, _TILE_N), _TILE_N)
+    # Ragged tail (< _TILE_N rows) goes through one small XLA call instead
+    # of padding: jnp.pad of a multi-GB x would copy the WHOLE input — the
+    # exact allocation class this kernel exists to avoid (at n=1e7 the tail
+    # is 128 rows; a pad would transiently double 2.56 GB).
+    n_main = (n // _TILE_N) * _TILE_N
+    if n_main == 0:
+        return gmm_moments_xla(x, means, variances, weights, row_weights,
+                               center)
     w = jnp.ones((n,), jnp.float32) if row_weights is None else row_weights
     w = w.reshape(n, 1).astype(jnp.float32)
-    if n_pad != n:
-        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
-        w = jnp.pad(w, ((0, n_pad - n), (0, 0)))
     A, B, c = _prep_params(
         jnp.asarray(means, jnp.float32) - center[None],
         jnp.asarray(variances, jnp.float32),
@@ -223,9 +227,17 @@ def gmm_moments_sep(
         k_pad,
     )
     qsum_p, qxc, qxc2 = _moments_pallas_sep(
-        x, w, center.reshape(1, d), A, B, c, interpret=bool(interpret)
+        x[:n_main], w[:n_main], center.reshape(1, d), A, B, c,
+        interpret=bool(interpret),
     )
-    return _uncenter(qsum_p[0, :k], qxc[:k], qxc2[:k], center)
+    out = _uncenter(qsum_p[0, :k], qxc[:k], qxc2[:k], center)
+    if n_main != n:
+        tail = gmm_moments_xla(
+            x[n_main:], means, variances, weights,
+            None if row_weights is None else w[n_main:, 0], center,
+        )
+        out = tuple(a + b for a, b in zip(out, tail))
+    return out
 
 
 def _affine_params(means, variances, weights):

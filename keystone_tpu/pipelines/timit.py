@@ -51,6 +51,15 @@ class TimitConfig:
     seed: int = 123
     synthetic_train: int = 20000
     synthetic_test: int = 4000
+    # Row-chunk every streaming-solver block pass AND the per-batch scaler
+    # fits (chunked moment accumulation): nothing wider than (row_chunk,
+    # 4096) ever materializes, which is what lets the FULL reference config
+    # (2.2M frames — TimitPipeline.scala:23-34's whole corpus) run on one
+    # chip. 0 = off (whole-batch featurization, fine up to ~150k rows).
+    row_chunk: int = 0
+    # pass-0 gram cache costs num_cosines*4096^2 f32 (3.4 GB at 50 blocks);
+    # turn off if the full-scale resident set does not fit alongside it
+    cache_grams: bool = True
 
 
 def run(config: TimitConfig) -> dict:
@@ -77,16 +86,30 @@ def run(config: TimitConfig) -> dict:
                     distribution=config.rf_type,
                 )
                 # per-batch scaler fit (TimitPipeline.scala:81): one pass over
-                # the featurized batch, which is then discarded
-                scaler = StandardScaler().fit(rf(train_ds.data), mask=train_ds.mask)
+                # the featurized batch, which is then discarded; at full scale
+                # the pass itself is row-chunked (fit_node_scaler_chunked)
+                if config.row_chunk > 0:
+                    from keystone_tpu.ops.stats.scaler import (
+                        fit_node_scaler_chunked,
+                    )
+
+                    scaler = fit_node_scaler_chunked(
+                        rf, train_ds.data, train_ds.mask, config.row_chunk
+                    )
+                else:
+                    scaler = StandardScaler().fit(
+                        rf(train_ds.data), mask=train_ds.mask
+                    )
                 feature_nodes.append(chain(rf, scaler))
 
         with Timer("fit.streaming_block_least_squares.dispatch"):
             est = BlockLeastSquaresEstimator(
-                config.num_cosine_features, config.num_epochs, config.lam
+                config.num_cosine_features, config.num_epochs, config.lam,
+                cache_grams=config.cache_grams,
             )
             model = est.fit_streaming(
-                feature_nodes, train_ds.data, indicators, mask=train_ds.mask
+                feature_nodes, train_ds.data, indicators, mask=train_ds.mask,
+                row_chunk=config.row_chunk,
             )
 
         test_ds, test_y, _ = prepare_labeled(*test, TIMIT_NUM_CLASSES)

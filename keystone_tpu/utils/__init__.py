@@ -6,4 +6,8 @@ from keystone_tpu.utils.stats import (
 )
 from keystone_tpu.utils.logging import get_logger, Timer, timed
 from keystone_tpu.utils.profiling import trace, annotate
-from keystone_tpu.utils.retry import Retry, call_with_device_retries
+from keystone_tpu.utils.retry import (
+    Retry,
+    call_with_device_retries,
+    fit_streaming_elastic,
+)

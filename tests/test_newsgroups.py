@@ -361,3 +361,32 @@ class TestDeviceTextEquivalence:
         assert int(lengths.min()) >= 30 and int(lengths.max()) < 120
         assert vocab == 200 + 6 * 30
         assert int(ids.max()) < vocab and int(ids.min()) >= 0
+
+
+def test_device_text_int64_key_path(rng):
+    """A packing base wide enough that order-2 keys exceed int32 must still
+    produce correct features (the int64 programs run under enable_x64 —
+    without it jax silently canonicalizes the keys to int32 and distinct
+    n-grams collide). Features must be identical — up to feature-id
+    permutation from tie-breaks — to the same corpus packed with a small
+    base, since keys are only identifiers."""
+    from keystone_tpu.ops.nlp.device_text import (
+        DeviceCommonSparseFeatures,
+        _key_dtype,
+    )
+    import jax.numpy as jnp
+
+    ids = rng.integers(0, 500, size=(40, 12)).astype(np.int32)
+    lengths = rng.integers(3, 13, size=(40,)).astype(np.int32)
+    small = DeviceCommonSparseFeatures(base=501, orders=(1, 2), num_features=10**6)
+    big = DeviceCommonSparseFeatures(base=70001, orders=(1, 2), num_features=10**6)
+    assert _key_dtype(70001, (1, 2)) == jnp.int64
+    _, b_small = small.fit_transform(ids, lengths)
+    _, b_big = big.fit_transform(ids, lengths)
+    assert b_small.num_features == b_big.num_features
+
+    def col_fingerprints(batch):
+        dense = np.asarray(batch.to_dense())
+        return sorted(tuple(dense[:, j]) for j in range(dense.shape[1]))
+
+    assert col_fingerprints(b_small) == col_fingerprints(b_big)
