@@ -314,6 +314,31 @@ def main():
             print(f"flagship bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
             out["imagenet_refdim_streaming_warm_s"] = None
+    if os.environ.get("BENCH_TIMIT_FULL", "0") == "1":
+        # Opt-in: TIMIT at the FULL reference scale (2.2M frames, 50x4096,
+        # 5 epochs, row-chunked streaming) — ~4 min warm + compile, so not
+        # part of the default budget; BASELINE.md carries the measured row.
+        try:
+            from keystone_tpu.pipelines.timit import TimitConfig, run as run_timit
+
+            tcfg = TimitConfig(
+                synthetic_train=2_200_000, synthetic_test=100_000,
+                num_epochs=5, row_chunk=131072,
+            )
+            run_timit(tcfg)  # cold
+            out["timit_full_2p2m_warm_s"] = round(
+                run_timit(tcfg)["wallclock_s"], 1
+            )
+            timit_full_cpu = (anchor or {}).get("timit_cpu_warm_extrapolated_s")
+            if timit_full_cpu:
+                # per-block-epoch costs scale linearly in rows (22x)
+                out["timit_full_vs_cpu_baseline"] = round(
+                    timit_full_cpu * 22.0 / out["timit_full_2p2m_warm_s"], 1
+                )
+        except Exception as e:
+            print(f"full-TIMIT bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            out["timit_full_2p2m_warm_s"] = None
     flagship_cpu = (anchor or {}).get("imagenet_flagship_cpu_warm_extrapolated_s")
     flagship_tpu = out.get("imagenet_refdim_streaming_warm_s")
     if flagship_cpu and flagship_tpu:

@@ -269,6 +269,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         extra featurization pass per block visit (the accumulate pass and
         the residual-update pass each featurize); exact equivalence with the
         unchunked path is pinned in ``tests/test_block_linear_streaming.py``.
+
+        Chunking is the SINGLE-CHIP out-of-core lever: its row slices cut
+        across a row-sharded axis, so on a mesh prefer sharding itself (each
+        device's row count shrinks by the data-axis size and the unchunked
+        per-block step fits again; its grams already psum over ICI). Scale
+        out first, chunk what remains per device.
         """
         from keystone_tpu.core.dataset import Dataset
         from keystone_tpu.ops.stats.scaler import StandardScaler

@@ -190,6 +190,18 @@ def _class_solves(
     return dW.reshape(-1, bs)[:n_ids].T  # (bs, len(class_ids))
 
 
+def _host_global(x) -> np.ndarray:
+    """Global host value of a (possibly row-sharded) array, multi-controller
+    safe: a plain ``np.asarray`` raises on arrays spanning non-addressable
+    devices (each process owns only its shard), so under a process group the
+    global value is assembled with ``process_allgather``."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def _class_buckets(counts_np: np.ndarray, class_idx_np: np.ndarray) -> list:
     """Group classes into buckets sharing a static row-chunk size, each with
     its per-class row-index matrix.
@@ -412,8 +424,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         # One host sync of the class counts + row ids; buckets give static
         # chunk sizes within 2× of each class's rows (see _class_buckets).
+        # class_idx is row-sharded: under multi-controller execution each
+        # process addresses only its rows, so the global value is gathered
+        # (every controller must build IDENTICAL buckets — they are static
+        # arguments of the jitted solves).
         buckets, inv_perm = _class_buckets(
-            np.asarray(counts), np.asarray(class_idx)
+            _host_global(counts), _host_global(class_idx)
         )
 
         models = [

@@ -54,6 +54,7 @@ def fit_fisher_branch(
     pca_file: Optional[str] = None,
     gmm_files: Optional[Tuple[str, str, str]] = None,
     row_chunks: int = 1,
+    gmm_n_init: int = 1,
 ) -> Tuple[Chain, jax.Array]:
     """Fit one descriptor branch; returns (featurizer chain, train features).
 
@@ -95,7 +96,9 @@ def fit_fisher_branch(
     else:
         with Timer("fisher.fit_gmm"):
             gmm_sample = ColumnSampler(num_gmm_samples, seed=seed + 1)(reduced)
-            gmm = GaussianMixtureModelEstimator(vocab_size).fit(gmm_sample)
+            gmm = GaussianMixtureModelEstimator(
+                vocab_size, n_init=gmm_n_init
+            ).fit(gmm_sample)
 
     fisher: Transformer = fisher_featurizer(gmm)
     if row_chunks > 1:
@@ -120,6 +123,7 @@ def fit_fisher_branch_buckets(
     seed: int = 42,
     hellinger_first: bool = False,
     row_chunks: int = 1,
+    gmm_n_init: int = 1,
 ) -> Tuple[Chain, jax.Array, list]:
     """:func:`fit_fisher_branch` over size-bucketed image groups.
 
@@ -171,7 +175,7 @@ def fit_fisher_branch_buckets(
         reduced_by_bucket = [(hw, pca(d)) for hw, d in descs_by_bucket]
 
     with Timer("fisher.fit_gmm"):
-        gmm = GaussianMixtureModelEstimator(vocab_size).fit(
+        gmm = GaussianMixtureModelEstimator(vocab_size, n_init=gmm_n_init).fit(
             pooled_sample(reduced_by_bucket, num_gmm_samples, seed + 1000)
         )
 

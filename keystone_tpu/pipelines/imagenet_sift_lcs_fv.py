@@ -95,6 +95,13 @@ class ImageNetSiftLcsFVConfig:
     solver_checkpoint: str = ""
     solver_checkpoint_every: int = 0
     fv_cache_dtype: str = "bfloat16"
+    # best-of-n GMM-EM restarts by data log-likelihood (learning/gmm.py).
+    # Measured caveat: a higher-likelihood GMM is NOT a more discriminative
+    # FV codebook — best-of-4 landed mid-band (top-5 15.3%) while single
+    # draws spanned 4.7-16.5% — so the flagship keeps n_init=1 and
+    # BASELINE.md reports the band, not a point (the knob remains for
+    # density-model uses where likelihood IS the objective)
+    gmm_n_init: int = 1
 
     def validate(self):
         if self.buckets and not self.train_location:
@@ -210,7 +217,9 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             pca_s = PCAEstimator(config.sift_pca_dim).fit_batch(
                 ColumnSampler(config.num_pca_samples, seed=config.seed)(sample_s)
             )
-            gmm_s = GaussianMixtureModelEstimator(config.vocab_size).fit(
+            gmm_s = GaussianMixtureModelEstimator(
+                config.vocab_size, n_init=config.gmm_n_init
+            ).fit(
                 ColumnSampler(config.num_gmm_samples, seed=config.seed + 1)(
                     pca_s(sample_s)
                 )
@@ -218,7 +227,9 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             pca_l = PCAEstimator(config.lcs_pca_dim).fit_batch(
                 ColumnSampler(config.num_pca_samples, seed=config.seed + 7)(sample_l)
             )
-            gmm_l = GaussianMixtureModelEstimator(config.vocab_size).fit(
+            gmm_l = GaussianMixtureModelEstimator(
+                config.vocab_size, n_init=config.gmm_n_init
+            ).fit(
                 ColumnSampler(config.num_gmm_samples, seed=config.seed + 8)(
                     pca_l(sample_l)
                 )
@@ -543,6 +554,7 @@ def run(config: ImageNetSiftLcsFVConfig) -> dict:
             config.num_gmm_samples,
             seed=config.seed,
             hellinger_first=True,
+            gmm_n_init=config.gmm_n_init,
         )
         # LCS branch on RGB (:96-148)
         lcs_featurizer, lcs_train = fit_fisher_branch(
@@ -553,6 +565,7 @@ def run(config: ImageNetSiftLcsFVConfig) -> dict:
             config.num_pca_samples,
             config.num_gmm_samples,
             seed=config.seed + 7,
+            gmm_n_init=config.gmm_n_init,
         )
 
         # ZipVectors over the two branches (:179-180)
