@@ -518,3 +518,77 @@ class TestFitDeviceEquivalence:
             [ref[int(k)] for k in np.asarray(uniq2)[: int(n2)]],
             rtol=1e-5,
         )
+
+
+class TestDeviceModelCheckpointing:
+    def test_device_fit_backoff_model_roundtrip(self, tmp_path):
+        """A device-fit StupidBackoffModel (sentinel-trimmed tables +
+        static table_sizes) must checkpoint and reload bit-exactly through
+        core.checkpoint — the serving-side artifact of the device path."""
+        from keystone_tpu.core.checkpoint import load_node, save_node
+
+        docs = [["a", "b", "c"], ["b", "c", "a", "b"], ["c", "a"]] * 4
+        enc = WordFrequencyEncoder().fit(docs)
+        ids, lengths = enc.encode_padded(docs)
+        est = StupidBackoffEstimator(enc.unigram_counts, 0.4)
+        model = est.fit_device(ids, lengths, (2, 3), enc.vocab_size)
+        path = str(tmp_path / "backoff.ckpt")
+        save_node(model, path)
+        loaded = load_node(path)
+        assert loaded.table_sizes == model.table_sizes
+        q = np.array([[0, 1, 2], [2, 1, 0], [-1, 0, 1]], np.int32)
+        np.testing.assert_allclose(
+            loaded.score_batch(q), model.score_batch(q)
+        )
+
+    def test_device_vectorizer_roundtrip(self, tmp_path):
+        from keystone_tpu.core.checkpoint import load_node, save_node
+        from keystone_tpu.ops.nlp.device_text import DeviceCommonSparseFeatures
+
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, 50, size=(30, 8)).astype(np.int32)
+        lengths = rng.integers(2, 9, size=(30,)).astype(np.int32)
+        vec = DeviceCommonSparseFeatures(base=51, orders=(1, 2)).fit(ids, lengths)
+        path = str(tmp_path / "vec.ckpt")
+        save_node(vec, path)
+        loaded = load_node(path)
+        a = np.asarray(vec.apply_encoded(ids, lengths).to_dense())
+        b = np.asarray(loaded.apply_encoded(ids, lengths).to_dense())
+        np.testing.assert_allclose(a, b)
+
+
+def test_sum_by_key_fuzz_matches_numpy(rng):
+    """Randomized sweep of the device reduceByKey primitive across sizes,
+    dtypes, validity densities, and weighted/unweighted modes."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.nlp.device_count import sum_by_key
+
+    for trial in range(12):
+        n = int(rng.integers(1, 400))
+        hi = int(rng.integers(2, 1000))
+        dt = np.int32 if trial % 2 else np.int64
+        keys = rng.integers(0, hi, size=n).astype(dt)
+        valid = rng.random(n) < rng.random()  # varying density incl. ~0
+        weights = rng.random(n).astype(np.float32) if trial % 3 == 0 else None
+        with jax.enable_x64():
+            uniq, totals, cnt = sum_by_key(
+                jnp.asarray(keys), jnp.asarray(valid),
+                None if weights is None else jnp.asarray(weights),
+            )
+        cnt = int(cnt)
+        ref_k = np.unique(keys[valid])
+        assert cnt == len(ref_k), (trial, cnt, len(ref_k))
+        np.testing.assert_array_equal(np.asarray(uniq)[:cnt], ref_k)
+        ref_tot = {}
+        for k, v, w in zip(
+            keys, valid, weights if weights is not None else np.ones(n)
+        ):
+            if v:
+                ref_tot[int(k)] = ref_tot.get(int(k), 0.0) + float(w)
+        np.testing.assert_allclose(
+            np.asarray(totals)[:cnt],
+            [ref_tot[int(k)] for k in ref_k],
+            rtol=1e-5, atol=1e-5,
+        )
