@@ -139,6 +139,10 @@ def _fv_cols_batch(x, gmm: GaussianMixtureModel, lo: int, hi: int):
     agreement."""
     n_img, nd, d = x.shape
     k = gmm.means.shape[0]
+    if n_img == 0:
+        # zero-row buckets (ladder alignment): the -1 reshapes below cannot
+        # infer a dimension from a size-0 array
+        return jnp.zeros((0, (hi - lo) * d), jnp.float32)
     x = jnp.asarray(x, jnp.float32)
     A, B, c0 = _affine_params(gmm.means, gmm.variances, gmm.weights)
     flat = x.reshape(-1, d)
@@ -355,3 +359,66 @@ def make_fisher_block_nodes(
             )
         )
     return nodes
+
+
+class BucketConcatNode:
+    """Row-concatenate one feature block across size buckets.
+
+    Variable-size ingest gives each (H, W) bucket its own resident
+    descriptor tensor (different per-image descriptor counts — static
+    shapes per bucket); the streaming solver wants ONE (n_total, block)
+    feature block per column range. This wrapper holds the same column
+    range's :class:`FisherVectorSliceNormalized` node for every bucket
+    (distinct ``key``/``l1_key`` per bucket) and concatenates their rows —
+    making bucketed raw data a drop-in ``fit_streaming`` input. The cache-
+    group protocol forwards: the group featurization concatenates per-bucket
+    group outputs, and a block's slice is a pure column slice, which
+    commutes with row concatenation.
+    """
+
+    group_node_supports_out_dtype = True
+
+    def __init__(self, nodes):
+        self.nodes = tuple(nodes)
+
+    def apply_batch(self, raw):
+        outs = [n.apply_batch(raw) for n in self.nodes]
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+    @property
+    def cache_group(self):
+        groups = tuple(n.cache_group for n in self.nodes)
+        if any(g is None for g in groups):
+            return None
+        return groups
+
+    def group_node(self, out_dtype=None):
+        return BucketConcatNode(
+            [n.group_node(out_dtype=out_dtype) for n in self.nodes]
+        )
+
+    def slice_cached(self, group_out):
+        # same column range in every bucket: one column slice of the
+        # row-concatenated group output
+        return self.nodes[0].slice_cached(group_out)
+
+
+def make_bucketed_fisher_block_nodes(
+    gmm: GaussianMixtureModel,
+    block_size: int,
+    bucket_keys,
+    row_chunk: int = 0,
+    cache_blocks: int = 0,
+) -> list:
+    """:func:`make_fisher_block_nodes` across size buckets: one
+    :class:`BucketConcatNode` per column block, wrapping that block's node
+    for every bucket. ``bucket_keys``: list of ``(key, l1_key)`` raw-pytree
+    names, one per bucket, in the row order the labels use."""
+    per_bucket = [
+        make_fisher_block_nodes(
+            gmm, block_size, key=key, l1_key=l1_key,
+            row_chunk=row_chunk, cache_blocks=cache_blocks,
+        )
+        for key, l1_key in bucket_keys
+    ]
+    return [BucketConcatNode(nodes) for nodes in zip(*per_bucket)]

@@ -113,6 +113,25 @@ def fit_fisher_branch(
     return featurizer, features
 
 
+def pooled_bucket_sample(parts, num_samples: int, seed: int) -> jax.Array:
+    """Descriptor sample pooled across bucket tensors in proportion to each
+    bucket's share of the corpus descriptors (empty buckets contribute
+    nothing). ONE implementation for the in-core and streaming bucketed
+    paths — the share rounding and per-bucket seed convention must not
+    drift between them."""
+    total = sum(int(d.shape[0]) * int(d.shape[1]) for d in parts)
+    out = []
+    for i, d in enumerate(parts):
+        cnt = int(d.shape[0]) * int(d.shape[1])
+        if cnt == 0:
+            continue
+        k = max(1, int(round(num_samples * cnt / max(total, 1))))
+        out.append(ColumnSampler(k, seed=seed + i)(d))
+    if not out:
+        raise ValueError("every bucket is empty — nothing to sample")
+    return jnp.concatenate(out, axis=0)
+
+
 def fit_fisher_branch_buckets(
     extractor: Transformer,
     images_by_bucket,
@@ -156,19 +175,12 @@ def fit_fisher_branch_buckets(
             (hw, desc_node(imgs)) for hw, imgs in images_by_bucket
         ]
     desc_counts = [int(d.shape[1]) for _, d in descs_by_bucket]
-    total = sum(int(d.shape[0]) * int(d.shape[1]) for _, d in descs_by_bucket)
-
-    def pooled_sample(arrs, num_samples, seed_):
-        parts = []
-        for i, (_, d) in enumerate(arrs):
-            share = int(d.shape[0]) * int(d.shape[1]) / max(total, 1)
-            k = max(1, int(round(num_samples * share)))
-            parts.append(ColumnSampler(k, seed=seed_ + i)(d))
-        return jnp.concatenate(parts, axis=0)
 
     with Timer("fisher.fit_pca"):
         pca = PCAEstimator(pca_dims).fit_batch(
-            pooled_sample(descs_by_bucket, num_pca_samples, seed)
+            pooled_bucket_sample(
+                [d for _, d in descs_by_bucket], num_pca_samples, seed
+            )
         )
 
     with Timer("fisher.apply_pca"):
@@ -176,7 +188,9 @@ def fit_fisher_branch_buckets(
 
     with Timer("fisher.fit_gmm"):
         gmm = GaussianMixtureModelEstimator(vocab_size, n_init=gmm_n_init).fit(
-            pooled_sample(reduced_by_bucket, num_gmm_samples, seed + 1000)
+            pooled_bucket_sample(
+                [d for _, d in reduced_by_bucket], num_gmm_samples, seed + 1000
+            )
         )
 
     fisher: Transformer = fisher_featurizer(gmm)

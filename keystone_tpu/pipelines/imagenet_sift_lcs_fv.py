@@ -110,12 +110,7 @@ class ImageNetSiftLcsFVConfig:
                 "synthetic generator emits one size (drop --buckets or set "
                 "--train-location)"
             )
-        if self.buckets and self.streaming:
-            raise ValueError(
-                "--buckets is not wired into the streaming path yet — the "
-                "out-of-core solver consumes fixed-shape resident "
-                "descriptors; run bucketed configs in-core (no --streaming)"
-            )
+
 
 
 class _ArraySource:
@@ -152,6 +147,220 @@ class _SyntheticSource:
             rng = np.random.default_rng(self._seed * 7 + i0)
             labels = rng.integers(0, self._classes, size=i1 - i0)
         return imgs, np.asarray(labels)
+
+
+def _run_streaming_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
+    """Out-of-core weighted fit over VARIABLE-SIZE real archives: bucketed
+    ingest (no global resize) + the streaming solver.
+
+    Each (H, W) bucket of the ladder keeps its own resident bf16
+    reduced-descriptor tensors (static shapes per bucket; per-image
+    descriptor counts follow ``num_descriptors(bh, bw)``); PCA/GMM fit once
+    on samples pooled across buckets; and every solver block is a
+    :class:`~keystone_tpu.ops.images.fisher_vector.BucketConcatNode` that
+    row-concatenates the bucket featurizations — so
+    ``BlockWeightedLeastSquaresEstimator.fit_streaming`` (cache groups,
+    Woodbury solves, mid-fit checkpointing) runs unchanged on bucketed
+    data. Train and test are BOTH aligned to the full ladder (a bucket a
+    split happens not to populate gets a zero-row tensor, shapes from
+    ``jax.eval_shape`` — no extraction runs), so the node keys can never
+    miss and labels always match featurized rows; the test archive loads
+    only at eval time and eval nodes regroup to full-branch cache groups
+    under the same 1 GiB gate as the fixed-shape streaming path.
+    """
+    import jax
+
+    from keystone_tpu.learning.block_linear import streaming_predict
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.learning.pca import PCAEstimator
+    from keystone_tpu.loaders.imagenet import load_imagenet_bucketed
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_bucketed_fisher_block_nodes,
+    )
+    from keystone_tpu.ops.stats import BatchSignedHellingerMapper
+    from keystone_tpu.pipelines._fisher import pooled_bucket_sample
+    from keystone_tpu.pipelines.voc_sift_fisher import parse_buckets
+
+    ladder = parse_buckets(config.buckets)
+    num_classes = IMAGENET_NUM_CLASSES
+
+    sift = SIFTExtractor()
+    hellinger = BatchSignedHellingerMapper()
+    lcs = LCSExtractor(config.lcs_stride, config.lcs_border, config.lcs_patch)
+    dtype = jnp.dtype(config.desc_dtype)
+
+    def desc_shapes(hw):
+        """Per-image descriptor shapes for a bucket, WITHOUT computing:
+        abstract evaluation of the two branch extractors."""
+        spec = jax.ShapeDtypeStruct((1, hw[0], hw[1], 3), jnp.float32)
+        s_sh = jax.eval_shape(
+            lambda im: hellinger(sift(GrayScaler()(im)[..., 0])), spec
+        ).shape
+        l_sh = jax.eval_shape(lcs, spec).shape
+        return s_sh[1:], l_sh[1:]
+
+    def load_aligned(location, labels_path):
+        """Ladder-aligned (hw, imgs, labels) list: every ladder bucket
+        present, zero-row entries for buckets this split does not populate."""
+        groups = {hw: (imgs, labels) for hw, imgs, labels
+                  in load_imagenet_bucketed(location, labels_path, ladder)}
+        out = []
+        for hw in ladder:
+            imgs, labels = groups.get(hw, (
+                np.zeros((0, hw[0], hw[1], 3), np.float32),
+                np.zeros((0,), np.int32),
+            ))
+            out.append((hw, imgs, labels))
+        return out
+
+    def extract(groups):
+        """Per ladder bucket: (sift descs, lcs descs, labels) — chunked by
+        extract_chunk within each bucket (one compile per bucket shape);
+        zero-row buckets get correctly-shaped empty tensors for free."""
+        out = []
+        for hw, imgs, labels in groups:
+            if imgs.shape[0] == 0:
+                (nd_s, d_s), (nd_l, d_l) = desc_shapes(hw)
+                sd = jnp.zeros((0, nd_s, d_s), jnp.float32)
+                ld = jnp.zeros((0, nd_l, d_l), jnp.float32)
+            else:
+                sd_parts, ld_parts = [], []
+                for i0 in range(0, imgs.shape[0], config.extract_chunk):
+                    part = jnp.asarray(imgs[i0 : i0 + config.extract_chunk])
+                    sd_parts.append(hellinger(sift(GrayScaler()(part)[..., 0])))
+                    ld_parts.append(lcs(part))
+                sd = jnp.concatenate(sd_parts) if len(sd_parts) > 1 else sd_parts[0]
+                ld = jnp.concatenate(ld_parts) if len(ld_parts) > 1 else ld_parts[0]
+            out.append((hw, sd, ld, labels))
+        return out
+
+    results: dict = {}
+    with use_mesh(get_mesh()), Timer("ImageNetSiftLcsFV.streaming") as total:
+        train = load_aligned(config.train_location, config.train_labels)
+        bucket_counts = {
+            f"{hw[0]}x{hw[1]}": int(imgs.shape[0]) for hw, imgs, _ in train
+        }
+        tr = extract(train)
+        del train  # raw images are not needed past extraction
+
+        with Timer("streaming.fit_pca_gmm"):
+            sample_s = pooled_bucket_sample(
+                [sd for _, sd, _, _ in tr], config.num_pca_samples, config.seed
+            )
+            pca_s = PCAEstimator(config.sift_pca_dim).fit_batch(sample_s)
+            gmm_s = GaussianMixtureModelEstimator(
+                config.vocab_size, n_init=config.gmm_n_init
+            ).fit(pooled_bucket_sample(
+                [pca_s(sd) for _, sd, _, _ in tr],
+                config.num_gmm_samples, config.seed + 1,
+            ))
+            sample_l = pooled_bucket_sample(
+                [ld for _, _, ld, _ in tr], config.num_pca_samples,
+                config.seed + 7,
+            )
+            pca_l = PCAEstimator(config.lcs_pca_dim).fit_batch(sample_l)
+            gmm_l = GaussianMixtureModelEstimator(
+                config.vocab_size, n_init=config.gmm_n_init
+            ).fit(pooled_bucket_sample(
+                [pca_l(ld) for _, _, ld, _ in tr],
+                config.num_gmm_samples, config.seed + 8,
+            ))
+            del sample_s, sample_l
+
+        def reduce_groups(groups_ex):
+            raw, lbl_parts = {}, []
+            for i, (hw, sd, ld, labels) in enumerate(groups_ex):
+                rs = pca_s(sd).astype(dtype)
+                rl = pca_l(ld).astype(dtype)
+                raw[f"sift_b{i}"] = rs
+                raw[f"l1_sift_b{i}"] = fisher_l1_norms(
+                    rs, gmm_s, config.fv_row_chunk
+                )
+                raw[f"lcs_b{i}"] = rl
+                raw[f"l1_lcs_b{i}"] = fisher_l1_norms(
+                    rl, gmm_l, config.fv_row_chunk
+                )
+                lbl_parts.append(labels)
+            return raw, np.concatenate(lbl_parts)
+
+        with Timer("streaming.reduce_train"):
+            raw_train, train_labels = reduce_groups(tr)
+        del tr
+
+        bidx = list(range(len(ladder)))
+        blocks_s = 2 * config.vocab_size // (
+            config.block_size // config.sift_pca_dim
+        )
+        blocks_l = 2 * config.vocab_size // (
+            config.block_size // config.lcs_pca_dim
+        )
+
+        def make_nodes(cache_s, cache_l):
+            return make_bucketed_fisher_block_nodes(
+                gmm_s, config.block_size,
+                [(f"sift_b{i}", f"l1_sift_b{i}") for i in bidx],
+                row_chunk=config.fv_row_chunk, cache_blocks=cache_s,
+            ) + make_bucketed_fisher_block_nodes(
+                gmm_l, config.block_size,
+                [(f"lcs_b{i}", f"l1_lcs_b{i}") for i in bidx],
+                row_chunk=config.fv_row_chunk, cache_blocks=cache_l,
+            )
+
+        nodes = make_nodes(config.fv_cache_blocks, config.fv_cache_blocks)
+        cache_dtype = (
+            jnp.dtype(config.fv_cache_dtype) if config.fv_cache_blocks else None
+        )
+        labels_ind = ClassLabelIndicatorsFromIntLabels(num_classes)(
+            jnp.asarray(train_labels)
+        )
+        with Timer("fit.block_weighted_least_squares_streaming"):
+            model = BlockWeightedLeastSquaresEstimator(
+                config.block_size, config.num_iter, config.lam,
+                config.mixture_weight,
+            ).fit_streaming(
+                nodes, raw_train, labels_ind, cache_dtype=cache_dtype,
+                checkpoint_path=config.solver_checkpoint or None,
+                checkpoint_every=config.solver_checkpoint_every,
+            )
+        del raw_train
+
+        with Timer("eval.top5_streaming"):
+            # test archive loads only now — nothing test-side was resident
+            # through the memory-critical solve
+            raw_test, test_labels = reduce_groups(
+                extract(load_aligned(config.test_location, config.test_labels))
+            )
+            eval_nodes = nodes
+            if config.fv_cache_blocks:
+                n_test = int(test_labels.shape[0])
+                item = cache_dtype.itemsize
+                budget = 1 << 30  # per-branch group-buffer cap (as _run_streaming)
+
+                def eval_cache(blocks: int) -> int:
+                    bytes_ = n_test * blocks * config.block_size * item
+                    return blocks if bytes_ < budget else config.fv_cache_blocks
+
+                eval_nodes = make_nodes(
+                    eval_cache(blocks_s), eval_cache(blocks_l)
+                )
+            scores = streaming_predict(model, eval_nodes, raw_test, cache_dtype)
+            top5 = TopKClassifier(k=min(5, num_classes))(scores)
+            results["test_top5_error"] = get_err_percent(top5, test_labels)
+            top1 = TopKClassifier(k=1)(scores)
+            results["test_top1_error"] = get_err_percent(top1, test_labels)
+
+    results["buckets"] = bucket_counts
+    results["wallclock_s"] = total.elapsed
+    results["feature_dim"] = 2 * (
+        config.sift_pca_dim + config.lcs_pca_dim
+    ) * config.vocab_size
+    logger.info(
+        "bucketed streaming TEST top-5: %.2f%%  top-1: %.2f%%  buckets: %s",
+        results["test_top5_error"], results["test_top1_error"],
+        results["buckets"],
+    )
+    return results
 
 
 def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
@@ -494,7 +703,9 @@ def _run_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
 
 def run(config: ImageNetSiftLcsFVConfig) -> dict:
     if config.buckets:
-        config.validate()  # bucketed ingest has real-archive/in-core limits
+        config.validate()  # bucketed ingest is the real-archive path only
+        if config.streaming:
+            return _run_streaming_bucketed(config)
         return _run_bucketed(config)
     if config.streaming:
         if config.train_location:

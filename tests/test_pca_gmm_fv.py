@@ -313,3 +313,59 @@ def test_gmm_n_init_picks_best_likelihood(rng):
         x, w_row, single.means, single.variances, single.weights
     ))
     assert ll_best >= ll_single - 1e-3, (ll_best, ll_single)
+
+
+def test_bucketed_streaming_blocks_match_dense_fit(rng):
+    """BucketConcatNode blocks (per-bucket descriptor tensors with different
+    per-image descriptor counts, row-concatenated per column block) must
+    reproduce the dense featurizer exactly — raw, through the grouped cache,
+    and through the full streaming weighted fit."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.learning.block_linear import grouped_block_getter
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_bucketed_fisher_block_nodes,
+    )
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+    from keystone_tpu.pipelines._fisher import fisher_featurizer
+
+    k, d = 4, 8
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=10).fit(
+        jnp.asarray(rng.normal(size=(300, d)).astype(np.float32))
+    )
+    d0 = jnp.asarray(rng.normal(size=(7, 12, d)).astype(np.float32))
+    d1 = jnp.asarray(rng.normal(size=(5, 20, d)).astype(np.float32))
+    dense = jnp.concatenate(
+        [fisher_featurizer(gmm)(d0), fisher_featurizer(gmm)(d1)], axis=0
+    )
+    labels = np.array([0, 1, 2, 0, 1, 2, 0, 1, 2, 0, 1, 2], np.int32)
+    ind = np.asarray(ClassLabelIndicatorsFromIntLabels(3)(jnp.asarray(labels)))
+    bs = 2 * d  # 4 blocks over the 2k*d = 64 feature columns
+    raw = {
+        "b0": d0, "l1_b0": fisher_l1_norms(d0, gmm, chunk=4),
+        "b1": d1, "l1_b1": fisher_l1_norms(d1, gmm, chunk=4),
+    }
+    nodes = make_bucketed_fisher_block_nodes(
+        gmm, bs, [("b0", "l1_b0"), ("b1", "l1_b1")], cache_blocks=2
+    )
+    assert nodes[0].cache_group is not None  # grouping active across buckets
+    feats = jnp.concatenate([n.apply_batch(raw) for n in nodes], axis=1)
+    np.testing.assert_allclose(
+        np.asarray(feats), np.asarray(dense), atol=5e-6
+    )
+    get, clear = grouped_block_getter(nodes, raw, None)
+    cached = jnp.concatenate([get(b) for b in range(len(nodes))], axis=1)
+    clear()
+    np.testing.assert_allclose(
+        np.asarray(cached), np.asarray(dense), atol=5e-6
+    )
+    est = BlockWeightedLeastSquaresEstimator(bs, 1, 0.05, 0.25)
+    m_ref = est.fit(dense, jnp.asarray(ind))
+    m_st = est.fit_streaming(nodes, raw, jnp.asarray(ind))
+    np.testing.assert_allclose(
+        np.asarray(m_st.w), np.asarray(m_ref.w), atol=1e-5
+    )
