@@ -47,11 +47,12 @@ class ImageNetSiftLcsFVConfig:
     block_size: int = 4096
     num_iter: int = 1
     image_hw: int = 256
-    # size-bucketed variable-shape ingest for the real-archive in-core path:
-    # comma-separated HxW ladder (e.g. "128x128,256x256") — images land in
-    # the smallest containing bucket (pad, no resize), both branches compile
-    # once per bucket shape (see voc_sift_fisher.parse_buckets /
-    # _fisher.fit_fisher_branch_buckets). Empty -> single frame at image_hw.
+    # size-bucketed variable-shape ingest for real archives: comma-separated
+    # HxW ladder (e.g. "128x128,256x256") — images land in the smallest
+    # containing bucket (pad, no resize), both branches compile once per
+    # bucket shape. Works in-core (_run_bucketed) AND with --streaming
+    # (_run_streaming_bucketed: per-bucket resident descriptors through the
+    # out-of-core solver). Empty -> single frame at image_hw.
     buckets: str = ""
     lcs_stride: int = 4
     lcs_border: int = 16
