@@ -31,6 +31,12 @@ import numpy as np
 SENTINEL = np.iinfo(np.int64).max
 
 
+def sentinel_for(dtype) -> int:
+    """The padding sentinel for a key dtype — the single definition of the
+    convention (``iinfo(dtype).max``; strictly above every packable key)."""
+    return int(np.iinfo(np.dtype(jnp.dtype(dtype).name)).max)
+
+
 def window_keys(
     ids: jnp.ndarray, lengths: jnp.ndarray, order: int, word_bits: int
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -78,7 +84,7 @@ def sum_by_key(
     (pure counting). Key dtype is preserved (int32 in, int32 out).
     """
     n = keys.shape[0]
-    sentinel = np.iinfo(np.dtype(keys.dtype.name)).max
+    sentinel = sentinel_for(keys.dtype)
     if n == 0:
         return keys, jnp.zeros((0,), jnp.float32), jnp.int32(0)
     k = jnp.where(valid, keys, sentinel)

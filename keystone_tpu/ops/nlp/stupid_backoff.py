@@ -209,10 +209,15 @@ class StupidBackoffModel(Transformer):
         pytree_node=False, default=None
     )
     # true entry count per table when sentinel-padded (device fit); None
-    # means every table is exact-size (host fit).
+    # means every table is exact-size (host fit) OR sizes live on device
+    # only (``table_sizes_dev`` below, the trim=False fit).
     table_sizes: Optional[Tuple[int, ...]] = struct.field(
         pytree_node=False, default=None
     )
+    # device-resident true sizes ([n_tables] int32) for trim=False fits —
+    # no host sync happened; host-materializing APIs pull it on demand and
+    # latency-critical consumers fold it into their one batched fetch.
+    table_sizes_dev: Optional[jnp.ndarray] = None
 
     def _score_batch_host(self, ngrams: np.ndarray) -> np.ndarray:
         """Tuple-keyed host recursion — same math as the device fold."""
@@ -270,9 +275,10 @@ class StupidBackoffModel(Transformer):
         Returns ``[(order, keys [N], scores float32 [N], true_size), ...]``
         per non-empty order >= 2 — keys stay packed (scoring operates on them
         directly, :func:`_score_table_device`) and arrays stay on device.
-        ``fit_device`` trims sentinel padding at fit time, so ``true_size``
-        equals the array length for its models; the size is still returned
-        for host-fit models and any future padded producer. The reference's
+        ``true_size`` is a python int for trimmed/host-fit models and a
+        device scalar (no sync) for trim=False fits, where the tables carry
+        sentinel padding and rows past ``true_size`` are meaningless —
+        consumers fold the scalar into their own fetch. The reference's
         ``scoresRDD`` without the collect.
         """
         if self.host_tables is not None:
@@ -282,11 +288,12 @@ class StupidBackoffModel(Transformer):
             for i, keys in enumerate(self.table_keys):
                 if keys.shape[0] == 0:
                     continue
-                size = (
-                    self.table_sizes[i]
-                    if self.table_sizes is not None
-                    else int(keys.shape[0])
-                )
+                if self.table_sizes is not None:
+                    size = self.table_sizes[i]
+                elif self.table_sizes_dev is not None:
+                    size = self.table_sizes_dev[i]
+                else:
+                    size = int(keys.shape[0])
                 s = _score_table_device(self, i, self.word_bits)
                 out.append((i + 2, jnp.asarray(keys), s, size))
         return out
@@ -306,11 +313,16 @@ class StupidBackoffModel(Transformer):
                 s = self._score_batch_host(ngrams)
                 out.append((ngrams.astype(np.int32), s))
             return out
+        sizes = self.table_sizes
+        if sizes is None and self.table_sizes_dev is not None:
+            # trim=False fit: the sizes never crossed to the host — this
+            # host-materializing API pulls them now (one sync)
+            sizes = tuple(int(n) for n in np.asarray(self.table_sizes_dev))
         for i, keys in enumerate(self.table_keys):
             order = i + 2
             keys_np = np.asarray(keys)
-            if self.table_sizes is not None:
-                keys_np = keys_np[: self.table_sizes[i]]
+            if sizes is not None:
+                keys_np = keys_np[: sizes[i]]
             if keys_np.size == 0:
                 continue
             ngrams = np.zeros((keys_np.size, order), dtype=np.int32)
@@ -417,6 +429,7 @@ class StupidBackoffEstimator:
         lengths,
         orders: Sequence[int],
         vocab_size: Optional[int] = None,
+        trim: bool = True,
     ) -> StupidBackoffModel:
         """Fit entirely on device: counting is sort + segment-reduce on chip.
 
@@ -434,6 +447,17 @@ class StupidBackoffEstimator:
         happen to be present in the data. Raises ``ValueError`` when
         vocab × order overflows 63-bit packing (no silent host fallback —
         callers choose their fallback path).
+
+        ``trim=False`` skips the fit's only host sync (the table-size pull
+        that enables static trimming): tables stay sentinel-padded, the true
+        sizes stay on device (``table_sizes_dev``), and lookups binary-search
+        the padded length. Worth it only for int32-packable configs
+        (``max_order * word_bits <= 30``), where padded searches ride the
+        fast ``sort`` method; int64 corpora pay the ~4x-slower ``scan`` over
+        ~6x-longer tables — keep the default there. The latency-critical
+        pipeline path uses this to run fit-to-score with a SINGLE host round
+        trip; serve-oriented callers should keep the default (smaller
+        resident tables, per-fit static shapes).
         """
         orders = tuple(sorted(o for o in set(orders) if o >= 2))
         if not orders:
@@ -460,13 +484,17 @@ class StupidBackoffEstimator:
                 int(vocab_size),
                 uni_in,
             )
-            table_sizes = tuple(int(s) for s in np.asarray(sizes))
-            # the size pull is the fit's one host sync; once sizes are known
-            # (static), trim the sentinel padding with static slices so every
-            # later lookup binary-searches the true table, not the padded
-            # window count (~6x smaller tables at Zipf-corpus scales)
-            keys = tuple(k[:n] for k, n in zip(keys, table_sizes))
-            counts = tuple(c[:n] for c, n in zip(counts, table_sizes))
+            table_sizes = None
+            sizes_dev = None if trim else sizes
+            if trim:
+                table_sizes = tuple(int(s) for s in np.asarray(sizes))
+                # the size pull is the fit's one host sync; once sizes are
+                # known (static), trim the sentinel padding with static
+                # slices so every later lookup binary-searches the true
+                # table, not the padded window count (~6x smaller tables at
+                # Zipf-corpus scales)
+                keys = tuple(k[:n] for k, n in zip(keys, table_sizes))
+                counts = tuple(c[:n] for c, n in zip(counts, table_sizes))
         return StupidBackoffModel(
             table_keys=keys,
             table_counts=counts,
@@ -476,6 +504,7 @@ class StupidBackoffEstimator:
             word_bits=indexer.word_bits,
             max_order=max_order,
             table_sizes=table_sizes,
+            table_sizes_dev=sizes_dev,
         )
 
     def fit_encoded(

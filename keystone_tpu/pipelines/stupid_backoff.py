@@ -133,13 +133,25 @@ def run(config: StupidBackoffConfig) -> dict:
             estimator = StupidBackoffEstimator({}, config.alpha)
 
         model = None
+        used_device = False
         encoded_pad = None
         if config.device_path:
             if lines is not None:
                 encoded_pad = encoder.encode_padded(tokens)
                 ids, lengths = encoded_pad
             try:
-                model = estimator.fit_device(ids, lengths, orders, vocab_size)
+                # trim=False (int32-packable configs only): no mid-fit size
+                # sync — the whole fit-to-score path runs with ONE host
+                # round trip (the fetch below), and the padded-table
+                # searches ride the fast int32 sort method. Wider-key
+                # corpora keep the trimmed fit: their padded scan searches
+                # would cost more than the round trip saves.
+                word_bits = max(1, int(np.ceil(np.log2(vocab_size + 1))))
+                trimless = max(orders, default=2) * word_bits <= 30
+                model = estimator.fit_device(
+                    ids, lengths, orders, vocab_size, trim=not trimless
+                )
+                used_device = True
             except ValueError as e:
                 logger.info("device fit unavailable (%s); host fit", e)
                 if lines is None:
@@ -160,28 +172,27 @@ def run(config: StupidBackoffConfig) -> dict:
             counts = NGramsCounts(mode=NGramsCountsMode.NO_ADD)(ngrams)
             model = estimator.fit(counts)
 
-        if model.table_sizes is not None:
+        if used_device:
             import jax
+            import jax.numpy as jnp
 
-            num_ngrams = int(sum(model.table_sizes))
-            num_scored = num_ngrams
             score_tables = model.scores_device()
-            # ONE transfer for everything the host reports — a checksum over
-            # every score (the barrier that materializes the fit+score
-            # program) plus the sample rows. Separate fetches would each pay
-            # the host<->device round trip (~100 ms tunneled).
-            fetch = [sum(s[:size].sum() for _, _, s, size in score_tables)]
-            sample_spec = []
-            remaining = config.num_sample_scores
-            for order, keys, s, size in score_tables:
-                take = min(remaining, size)
-                if take <= 0:
-                    break
-                fetch.extend((keys[:take], s[:take]))
+            # ONE transfer for everything the host reports — the per-table
+            # true sizes (device scalars the fit computed and never synced),
+            # a size-masked checksum over every score (the barrier that
+            # materializes the whole fit+score program), and the sample
+            # rows. Separate fetches (or a trim-time size pull) would each
+            # pay the host<->device round trip (~100 ms tunneled).
+            fetch, sample_spec = [], []
+            for order, keys, sc, size in score_tables:
+                masked = jnp.where(jnp.arange(keys.shape[0]) < size, sc, 0.0)
+                take = min(config.num_sample_scores, int(keys.shape[0]))
+                fetch.extend((size, masked.sum(), keys[:take], sc[:take]))
                 sample_spec.append((order, take))
-                remaining -= take
             fetched = jax.device_get(fetch)
-            checksum = float(fetched[0])
+            sizes = [int(fetched[4 * i]) for i in range(len(score_tables))]
+            checksum = float(sum(fetched[4 * i + 1] for i in range(len(score_tables))))
+            num_ngrams = num_scored = int(sum(sizes))
         else:
             score_arrays = model.scores_arrays()
             num_ngrams = (
@@ -197,11 +208,13 @@ def run(config: StupidBackoffConfig) -> dict:
     results["num_scored"] = num_scored
     results["score_checksum"] = checksum
     sample = []
-    if model.table_sizes is not None:
+    if used_device:
         mask = (1 << model.word_bits) - 1
         for i, (order, take) in enumerate(sample_spec):
-            kk, ss = fetched[1 + 2 * i], fetched[2 + 2 * i]
-            for key, s in zip(kk, ss):
+            kk, ss = fetched[4 * i + 2], fetched[4 * i + 3]
+            for key, s in zip(kk[: min(take, sizes[i])], ss):
+                if len(sample) >= config.num_sample_scores:
+                    break
                 ng = [
                     int((int(key) >> (j * model.word_bits)) & mask)
                     for j in range(order - 1, -1, -1)

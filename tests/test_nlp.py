@@ -592,3 +592,59 @@ def test_sum_by_key_fuzz_matches_numpy(rng):
             [ref_tot[int(k)] for k in ref_k],
             rtol=1e-5, atol=1e-5,
         )
+
+
+class TestTrimlessDeviceFit:
+    """trim=False (the pipeline's single-round-trip path) must expose the
+    SAME model behavior as the trimmed fit through every public surface —
+    padded tables are an internal layout, never a semantic difference."""
+
+    def test_padded_model_matches_trimmed_everywhere(self):
+        docs = [["a", "b", "c"], ["a", "b", "d"], ["b", "c"], ["c", "a", "b"]] * 3
+        enc = WordFrequencyEncoder().fit(docs)
+        ids, lengths = enc.encode_padded(docs)
+        est = StupidBackoffEstimator(enc.unigram_counts, 0.4)
+        trimmed = est.fit_device(ids, lengths, (2, 3), enc.vocab_size)
+        padded = est.fit_device(
+            ids, lengths, (2, 3), enc.vocab_size, trim=False
+        )
+        assert padded.table_sizes is None
+        assert padded.table_sizes_dev is not None
+        # scores_arrays pulls the device sizes itself and must trim
+        sa_t, sa_p = trimmed.scores_arrays(), padded.scores_arrays()
+        assert len(sa_t) == len(sa_p)
+        for (ng_t, s_t), (ng_p, s_p) in zip(sa_t, sa_p):
+            np.testing.assert_array_equal(ng_t, ng_p)
+            np.testing.assert_allclose(s_t, s_p, rtol=1e-6)
+        # scores_device sizes (device scalars) match the trimmed statics
+        for (o_t, k_t, s_t, sz_t), (o_p, k_p, s_p, sz_p) in zip(
+            trimmed.scores_device(), padded.scores_device()
+        ):
+            assert o_t == o_p
+            assert sz_t == int(sz_p)
+            np.testing.assert_allclose(
+                np.asarray(s_p)[: int(sz_p)], np.asarray(s_t)[:sz_t], rtol=1e-6
+            )
+        # query scoring identical
+        q = np.array([[0, 1, 2], [2, 1, 0], [-1, 0, 1]], np.int32)
+        np.testing.assert_allclose(
+            trimmed.score_batch(q), padded.score_batch(q), rtol=1e-6
+        )
+
+    def test_pipeline_reports_match_across_trim_modes(self, monkeypatch):
+        from keystone_tpu.pipelines import stupid_backoff as sb
+
+        r_dev = sb.run(sb.StupidBackoffConfig(synthetic_docs=250))
+        # force the trimmed path by making the trimless predicate false
+        monkeypatch.setattr(
+            sb.StupidBackoffEstimator, "fit_device",
+            lambda self, ids, lengths, orders, vocab=None, trim=True,
+            _orig=sb.StupidBackoffEstimator.fit_device:
+            _orig(self, ids, lengths, orders, vocab, trim=True),
+        )
+        r_trim = sb.run(sb.StupidBackoffConfig(synthetic_docs=250))
+        assert r_dev["num_ngrams"] == r_trim["num_ngrams"]
+        assert r_dev["sample_scores"] == r_trim["sample_scores"]
+        np.testing.assert_allclose(
+            r_dev["score_checksum"], r_trim["score_checksum"], rtol=1e-5
+        )
