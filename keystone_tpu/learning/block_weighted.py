@@ -404,7 +404,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         stored — they are pass-0 caches, not state). The reference's only
         recovery at this layer is Spark lineage re-execution
         (``TimitPipeline.scala:38``); a multi-hour flagship fit here
-        resumes from the last block boundary instead."""
+        resumes from the last block boundary instead.
+
+        Under a multi-controller process group the sharded residual is
+        gathered (``_host_global``) and process 0 alone writes/removes the
+        file; resume requires checkpoint_path reachable from every
+        controller. Bit-exact resume is validated single-controller
+        (``tests/test_block_weighted.py``)."""
         import os as _os
 
         labels = jnp.asarray(labels, jnp.float32)
@@ -450,7 +456,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     f"{state['num_blocks']} blocks x {state['num_iter']} iters, "
                     f"not {num_blocks} x {self.num_iter}"
                 )
-            R = jnp.asarray(state["R"])
+            # restore the checkpointed residual IN the live R's sharding —
+            # a bare jnp.asarray would land the full (n, C) array on every
+            # controller's default device, silently undoing the row-sharding
+            # the solver step is compiled against
+            R = jax.device_put(jnp.asarray(state["R"]), R.sharding)
             residual_mean = jnp.asarray(state["residual_mean"])
             models = [jnp.asarray(m) for m in state["models"]]
             joint_means_blocks = [
@@ -473,9 +483,21 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         def _save_checkpoint(it: int, next_b: int) -> None:
             from keystone_tpu.core.checkpoint import save_node
 
+            # R is row-sharded: under a process group each controller
+            # addresses only its shard (np.asarray would raise) and every
+            # controller shares checkpoint_path — so the global residual is
+            # assembled first and only process 0 writes. On resume the load
+            # path re-shards the global value back into the live R's
+            # sharding; bit-exact resume is validated single-controller
+            # (tests/test_block_weighted.py), multi-controller relaunch must
+            # reuse the same process count and a path visible to all.
+            R_global = _host_global(R) if jax.process_count() > 1 else R
+            if jax.process_index() != 0:
+                return
             save_node(
                 {
-                    "R": R, "residual_mean": residual_mean, "models": models,
+                    "R": R_global, "residual_mean": residual_mean,
+                    "models": models,
                     "joint_means_blocks": joint_means_blocks,
                     "pop_stats_cache": pop_stats_cache,
                     "iter": it, "block": next_b,
@@ -534,12 +556,16 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 ):
                     _save_checkpoint(it, b + 1)
 
-        if checkpoint_path and checkpoint_every > 0 and _os.path.exists(
+        if (
             checkpoint_path
+            and checkpoint_every > 0
+            and jax.process_index() == 0
+            and _os.path.exists(checkpoint_path)
         ):
             # a COMPLETED fit must not leave its cursor behind: a later fit
             # with the same path (same shapes, different data) would
-            # silently resume past every block and return stale state
+            # silently resume past every block and return stale state.
+            # Process 0 owns the file (it alone writes, _save_checkpoint).
             _os.remove(checkpoint_path)
 
         W = jnp.concatenate(models, axis=0)

@@ -464,7 +464,14 @@ class StupidBackoffEstimator:
             raise ValueError("fit_device needs at least one order >= 2")
         max_order = max(orders)
         if vocab_size is None:
-            vocab_size = (max(self.unigram_counts) + 1) if self.unigram_counts else 1
+            if not self.unigram_counts:
+                # defaulting to 1 would set word_bits=1 and silently mis-pack
+                # every real id — fail loudly instead
+                raise ValueError(
+                    "fit_device needs vocab_size when no unigram_counts are "
+                    "present (cannot infer the id range)"
+                )
+            vocab_size = max(self.unigram_counts) + 1
         indexer = PackedNGramIndexer(vocab_size, max_order)
         uni_in = None
         if self.unigram_counts:

@@ -117,7 +117,8 @@ def _moments_pallas(x_aug, A, B, c, *, interpret: bool):
 
 
 def _moments_kernel_sep(
-    x_ref, w_ref, ctr_ref, a_ref, b_ref, c_ref, qsum_ref, qx_ref, qx2_ref
+    x_ref, w_ref, ctr_ref, a_ref, b_ref, c_ref, qsum_ref, qx_ref, qx2_ref,
+    *, n_rows: int
 ):
     """Separate-input kernel: raw x tile + (T, 1) row weights + (1, D)
     center. Centering happens in VMEM (``x - center`` never exists in HBM)
@@ -125,7 +126,12 @@ def _moments_kernel_sep(
     tiny operands — so unlike :func:`_moments_kernel` there is NO padded
     (n, round_up(d+2, 128)) copy of the input. For the flagship moments
     regime (1e7×256, d=64) that copy alone (5.1 GB next to the 2.6 GB
-    input) pushed the augmented kernel out of HBM."""
+    input) pushed the augmented kernel out of HBM.
+
+    ``n_rows`` is the true (unpadded) row count, static at trace time: the
+    grid ceil-divides n, the final tile's out-of-bounds lanes read garbage,
+    and this mask zeroes both x and w there — so ragged n costs one VPU
+    compare+select per tile instead of an ``x[:n_main]`` device copy."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -134,7 +140,12 @@ def _moments_kernel_sep(
         qx_ref[:] = jnp.zeros_like(qx_ref)
         qx2_ref[:] = jnp.zeros_like(qx2_ref)
 
-    x = x_ref[:] - ctr_ref[:]  # (T, D) centered in VMEM
+    tile_n = x_ref.shape[0]
+    row_ids = i * tile_n + jax.lax.broadcasted_iota(
+        jnp.int32, (tile_n, 1), 0
+    )
+    valid = row_ids < n_rows  # (T, 1); False only in the final ragged tile
+    x = jnp.where(valid, x_ref[:] - ctr_ref[:], 0.0)  # (T, D) centered
     x2 = x * x
     ll = (
         jnp.dot(x, a_ref[:], preferred_element_type=jnp.float32)
@@ -144,7 +155,8 @@ def _moments_kernel_sep(
     m = jnp.max(ll, axis=1, keepdims=True)
     e = jnp.exp(ll - m)
     q = e / jnp.sum(e, axis=1, keepdims=True)
-    q = q * w_ref[:]  # (T, 1) row weights; 0 for padding rows
+    w = jnp.where(valid, w_ref[:], 0.0)
+    q = q * w  # (T, 1) row weights; 0 for padding / out-of-bounds rows
 
     qsum_ref[:] += jnp.sum(q, axis=0, keepdims=True)
     qt = q.T  # (K, T)
@@ -154,11 +166,11 @@ def _moments_kernel_sep(
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _moments_pallas_sep(x, w, center, A, B, c, *, interpret: bool):
-    n_pad, d_pad = x.shape
+    n, d_pad = x.shape
     k_pad = A.shape[1]
-    grid = (n_pad // _TILE_N,)
+    grid = (pl.cdiv(n, _TILE_N),)
     qsum, qx, qx2 = pl.pallas_call(
-        _moments_kernel_sep,
+        functools.partial(_moments_kernel_sep, n_rows=n),
         grid=grid,
         in_specs=[
             pl.BlockSpec((_TILE_N, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -195,11 +207,13 @@ def gmm_moments_sep(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """:func:`gmm_moments` through the copy-free separate-input kernel.
 
-    The only per-n allocations beyond x itself are the (n, 1) row-weight
-    column and tile padding of the trailing rows — the kernel that actually
-    holds the module docstring's O(n·d)-traffic promise at the design point
-    (the augmented kernel pays an extra lane-padded input copy, fatal at
-    1e7×64 on a 16 GB chip).
+    The only per-n allocation beyond x itself is the (n, 1) row-weight
+    column — the kernel that actually holds the module docstring's
+    O(n·d)-traffic promise at the design point (the augmented kernel pays
+    an extra lane-padded input copy, fatal at 1e7×64 on a 16 GB chip).
+    Ragged n is handled by the kernel's in-tile row mask (the grid
+    ceil-divides n and x is consumed whole), so at n=1e7 — where
+    1e7 % 512 = 128 — no near-full slice copy of x is ever materialized.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -209,12 +223,9 @@ def gmm_moments_sep(
         center = jnp.mean(x, axis=0)
     k = means.shape[0]
     k_pad = _round_up(k, _LANE)
-    # Ragged tail (< _TILE_N rows) goes through one small XLA call instead
-    # of padding: jnp.pad of a multi-GB x would copy the WHOLE input — the
-    # exact allocation class this kernel exists to avoid (at n=1e7 the tail
-    # is 128 rows; a pad would transiently double 2.56 GB).
-    n_main = (n // _TILE_N) * _TILE_N
-    if n_main == 0:
+    if n < _TILE_N:
+        # A single sub-tile call gains nothing from Pallas; one small XLA
+        # program is cheaper than a one-tile kernel launch.
         return gmm_moments_xla(x, means, variances, weights, row_weights,
                                center)
     w = jnp.ones((n,), jnp.float32) if row_weights is None else row_weights
@@ -227,17 +238,9 @@ def gmm_moments_sep(
         k_pad,
     )
     qsum_p, qxc, qxc2 = _moments_pallas_sep(
-        x[:n_main], w[:n_main], center.reshape(1, d), A, B, c,
-        interpret=bool(interpret),
+        x, w, center.reshape(1, d), A, B, c, interpret=bool(interpret)
     )
-    out = _uncenter(qsum_p[0, :k], qxc[:k], qxc2[:k], center)
-    if n_main != n:
-        tail = gmm_moments_xla(
-            x[n_main:], means, variances, weights,
-            None if row_weights is None else w[n_main:, 0], center,
-        )
-        out = tuple(a + b for a, b in zip(out, tail))
-    return out
+    return _uncenter(qsum_p[0, :k], qxc[:k], qxc2[:k], center)
 
 
 def _affine_params(means, variances, weights):

@@ -646,6 +646,7 @@ def _run_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
             config.num_gmm_samples,
             seed=config.seed,
             hellinger_first=True,
+            gmm_n_init=config.gmm_n_init,
         )
         lcs_featurizer, lcs_train, lcs_counts = fit_fisher_branch_buckets(
             LCSExtractor(config.lcs_stride, config.lcs_border, config.lcs_patch),
@@ -655,6 +656,7 @@ def _run_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
             config.num_pca_samples,
             config.num_gmm_samples,
             seed=config.seed + 7,
+            gmm_n_init=config.gmm_n_init,
         )
 
         train_feats = jnp.concatenate([sift_train, lcs_train], axis=1)

@@ -61,6 +61,9 @@ class VOCSIFTFisherConfig:
     # row-chunk the extractor/FV stages (ChunkedMap) — needed at reference
     # scale (5k imgs × vocab 256) to bound per-image intermediates
     row_chunks: int = 1
+    # independent GMM-EM restarts; best likelihood wins (density-fit tool —
+    # see BASELINE.md on why it does not stabilize classifier quality)
+    gmm_n_init: int = 1
 
     def validate(self):
         if self.buckets and not self.train_location:
@@ -128,6 +131,7 @@ def _run_bucketed(config: VOCSIFTFisherConfig) -> dict:
             config.num_gmm_samples,
             seed=config.seed,
             row_chunks=config.row_chunks,
+            gmm_n_init=config.gmm_n_init,
         )
         train_labels = jnp.asarray(
             np.concatenate([lb for _, _, lb in train])
@@ -205,6 +209,7 @@ def run(config: VOCSIFTFisherConfig) -> dict:
             pca_file=config.pca_file or None,
             gmm_files=gmm_files,
             row_chunks=config.row_chunks,
+            gmm_n_init=config.gmm_n_init,
         )
 
         labels = ClassLabelIndicatorsFromIntArrayLabels(num_classes)(

@@ -40,7 +40,7 @@ def test_weighted_block_step_all_reduces_never_gathers(mesh, rng):
     bucketed class solves + residual update) with row-sharded X/R: the HLO
     must contain all-reduces (the psum-over-ICI reductions) and NO
     all-gather / all-to-all — X stays sharded end to end."""
-    n, bs, C = 512, 64, 128  # nc = 4 exactly -> Woodbury at bs//8=8
+    n, bs, C = 512, 64, 128  # nc = 4 exactly -> Woodbury (threshold bs//4=16)
     X = rng.normal(size=(n, bs)).astype(np.float32)
     lab = np.arange(n) % C  # balanced so every bucket stays under threshold
     rng.shuffle(lab)
