@@ -446,6 +446,24 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         joint_means_blocks: list = [None] * num_blocks
 
         start_iter = start_block = 0
+        if checkpoint_path and jax.process_count() > 1:
+            # fail loudly on a non-shared path: if controllers disagree on
+            # whether the checkpoint exists, some would resume mid-cursor
+            # while others start at (0,0) and the collective schedules
+            # diverge (hang / silent corruption)
+            from jax.experimental import multihost_utils
+
+            flags = np.asarray(
+                multihost_utils.process_allgather(
+                    jnp.asarray([int(_os.path.exists(checkpoint_path))])
+                )
+            )
+            if flags.min() != flags.max():
+                raise ValueError(
+                    f"checkpoint_path {checkpoint_path!r} is visible on "
+                    "some controllers but not others — it must be on a "
+                    "filesystem shared by every process"
+                )
         if checkpoint_path and _os.path.exists(checkpoint_path):
             from keystone_tpu.core.checkpoint import load_node
 
@@ -492,6 +510,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # sharding; bit-exact resume is validated single-controller
             # (tests/test_block_weighted.py), multi-controller relaunch must
             # reuse the same process count and a path visible to all.
+            # NB: the allgather lands the global residual on EVERY
+            # controller's host RAM (~n·C·4 bytes; ~1.3 GB at the flagship)
+            # though only process 0 writes — the collective has no
+            # gather-to-one form. Acceptable for checkpoint_every-paced
+            # saves; per-process shard files would avoid it at the cost of
+            # a resume format tied to the process count.
             R_global = _host_global(R)  # no-op host copy single-controller
             if jax.process_index() != 0:
                 return

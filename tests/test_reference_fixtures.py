@@ -85,31 +85,91 @@ def test_jpeg_decode_matches_pil():
     assert np.mean(np.abs(via_native.astype(int) - via_pil.astype(int))) < 2.0
 
 
-def test_voc_codebook_gmm_and_fisher_vector():
-    """EncEvalSuite.scala:17-23: the pretrained VOC codebook loads as a
-    256-center, 80-dim diagonal GMM; Fisher Vectors computed against it have
-    the reference's (dims, 2*centers) shape and finite values."""
+def _load_voc_codebook():
     from keystone_tpu.learning.gmm import GaussianMixtureModel
-    from keystone_tpu.ops.images.fisher_vector import FisherVector
 
-    gmm = GaussianMixtureModel.load(
+    return GaussianMixtureModel.load(
         os.path.join(_RES, "images/voc_codebook/means.csv"),
         os.path.join(_RES, "images/voc_codebook/variances.csv"),
         os.path.join(_RES, "images/voc_codebook/priors"),
     )
+
+
+def test_voc_codebook_gmm_and_fisher_vector():
+    """EncEvalSuite.scala:17-38 against the one reference-blessed numeric
+    artifact in the checkout (the pretrained 256x80 VOC codebook): the FV
+    encoding must EQUAL the ``jax.grad`` Fisher-score oracle value-by-value
+    (not just in shape) — any change to the FV math fails this."""
+    import jax
+
+    from keystone_tpu.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.images.fisher_vector import FisherVector
+
+    gmm = _load_voc_codebook()
     assert gmm.means.shape == (256, 80)
     assert gmm.variances.shape == (256, 80)
     assert gmm.weights.shape == (256,)
     assert float(jnp.sum(gmm.weights)) == pytest.approx(1.0, abs=1e-3)
     assert float(jnp.min(gmm.variances)) > 0.0
 
+    # descriptors in the codebook's own operating range: perturbations of
+    # its centers (pure noise at offset 100 sits in no component's support)
     rng = np.random.default_rng(0)
-    descs = jnp.asarray(
-        rng.normal(size=(500, 80)).astype(np.float32) * 50.0 + 100.0
-    )
-    fv = FisherVector(gmm=gmm).apply(descs)
+    comp = rng.choice(256, 500)  # one draw: center AND noise from the
+    descs = jnp.asarray(         # same component, so samples stay in-support
+        np.asarray(gmm.means)[comp]
+        + rng.normal(size=(500, 80)) * np.sqrt(np.asarray(gmm.variances)[comp])
+    ).astype(jnp.float32)
+
+    fv = np.asarray(FisherVector(gmm=gmm).apply(descs))
     assert fv.shape == (80, 512)
-    assert bool(jnp.isfinite(fv).all())
+    assert bool(np.isfinite(fv).all())
+
+    def mean_ll(means, variances):
+        g = GaussianMixtureModel(
+            means=means, variances=variances, weights=gmm.weights
+        )
+        ll = g.log_likelihoods(descs)
+        return jnp.mean(jax.scipy.special.logsumexp(ll, axis=1))
+
+    g_mu, g_var = jax.grad(mean_ll, argnums=(0, 1))(gmm.means, gmm.variances)
+    sigma = np.sqrt(np.asarray(gmm.variances))
+    w = np.asarray(gmm.weights)
+    expect_mu = (np.asarray(g_mu) * sigma / np.sqrt(w)[:, None]).T
+    expect_sig = (
+        2.0 * np.asarray(g_var) * np.asarray(gmm.variances)
+        / np.sqrt(2.0 * w)[:, None]
+    ).T
+    # scale-relative tolerance: the oracle differentiates the raw (not
+    # centered-affine) log-density, so agreement is to f32 conditioning
+    scale = max(np.abs(expect_mu).max(), np.abs(expect_sig).max())
+    np.testing.assert_allclose(fv[:, :256], expect_mu, atol=2e-4 * scale)
+    np.testing.assert_allclose(fv[:, 256:], expect_sig, atol=2e-4 * scale)
+
+
+def test_voc_codebook_posteriors_match_sklearn():
+    """Posterior responsibilities under the pretrained codebook cross-checked
+    against ``sklearn.mixture.GaussianMixture.predict_proba`` carrying the
+    SAME Gaussians — an implementation-independent E-step oracle."""
+    from sklearn.mixture import GaussianMixture
+
+    gmm = _load_voc_codebook()
+    rng = np.random.default_rng(1)
+    centers = np.asarray(gmm.means)[rng.choice(256, 300)]
+    descs = (centers + rng.normal(size=(300, 80)) * 3.0).astype(np.float32)
+
+    sk = GaussianMixture(256, covariance_type="diag")
+    sk.means_ = np.asarray(gmm.means, np.float64)
+    sk.covariances_ = np.asarray(gmm.variances, np.float64)
+    sk.weights_ = np.asarray(gmm.weights, np.float64)
+    from sklearn.mixture._gaussian_mixture import _compute_precision_cholesky
+
+    sk.precisions_cholesky_ = _compute_precision_cholesky(
+        sk.covariances_, "diag"
+    )
+    want = sk.predict_proba(descs)
+    got = np.asarray(gmm.apply_batch(jnp.asarray(descs)))
+    np.testing.assert_allclose(got, want, atol=1e-4)
 
 
 def _load_fixture_mats():
