@@ -123,6 +123,211 @@ def count_ngrams_device(
     return sum_by_key(keys, valid)
 
 
+# ---------------------------------------------------------------------------
+# Mesh-sharded keyed aggregation — the cluster-wide reduceByKey.
+#
+# The reference's counting is a two-phase shuffle: per-partition hash-map
+# combine, then ``reduceByKey`` routes each key to one reducer under a
+# locality-aware partitioner (``ngrams.scala:150-183``,
+# ``StupidBackoff.scala:25-57,156-159``). The TPU-native translation keeps
+# the two phases but swaps the data plane for dense static-shape collectives:
+#
+#   phase 1 (combine)  — per-shard sort + segment-reduce (:func:`sum_by_key`
+#                        on each device's rows), compacting n_local windows
+#                        to <= n_local distinct (key, total) pairs;
+#   phase 2 (exchange) — all-gather of the COMPACTED pair tables over ICI,
+#                        then one merge reduce of the P·C gathered pairs.
+#
+# Why all-gather instead of a key-range all-to-all: XLA collectives are
+# static-shape, so an exact all-to-all must provision every (src, dst)
+# chunk for its worst case — a source whose distinct keys all land in one
+# range — i.e. capacity n_local per chunk, P·n_local received: byte-for-byte
+# the all-gather, with splitter logic on top. The all-gather form rides the
+# ICI ring at full bandwidth, needs no splitters, and lands the merged table
+# REPLICATED — which is the placement scoring wants anyway (every device
+# binary-searches the full table; the reference re-broadcasts its reduced
+# map for the same reason). What phase 1 buys is the ``capacity`` knob: with
+# C < n_local (long documents repeat n-grams; distinct << windows) the
+# exchange shrinks by n_local/C while staying exact as long as every shard's
+# distinct count fits — overflow is REPORTED, never silent (``overflowed``).
+# ---------------------------------------------------------------------------
+
+
+def _compact_gather_merge(uniq_l, tot_l, nu_l, cap: int, axis: str):
+    """Phase 2 of the sharded reduce (module design note), shared by every
+    sharded entry point: truncate the per-shard compacted table to the
+    capacity budget, flag overflow (pmax'd so every device agrees),
+    all-gather the compacted (key, total) pairs over ``axis``, and merge
+    with one weighted :func:`sum_by_key`. Call from inside ``shard_map``."""
+    sentinel = sentinel_for(uniq_l.dtype)
+    over = jax.lax.pmax((nu_l > cap).astype(jnp.int32), axis)
+    gk = jax.lax.all_gather(uniq_l[:cap], axis, tiled=True)
+    gt = jax.lax.all_gather(tot_l[:cap], axis, tiled=True)
+    uniq, tot, nu = sum_by_key(gk, gk != sentinel, gt)
+    return uniq, tot, nu, over
+
+
+def pad_docs_to_mesh(ids, lengths, p: int):
+    """Pad the document axis to a multiple of the mesh axis size with empty
+    documents (ids -1, length 0 — no valid windows, no effect on counts).
+    The shared ingest recipe of every sharded counting entry point."""
+    pad = (-ids.shape[0]) % p
+    if pad:
+        ids = jnp.concatenate(
+            [ids, jnp.full((pad, ids.shape[1]), -1, ids.dtype)]
+        )
+        lengths = jnp.concatenate([lengths, jnp.zeros((pad,), lengths.dtype)])
+    return ids, lengths
+
+
+def check_shard_capacity(overflowed, capacity) -> None:
+    """Shared overflow contract: an undersized per-shard capacity RAISES
+    (counts would be silently low otherwise); ``capacity=None`` is provably
+    exact, so the host sync is skipped entirely."""
+    if capacity is not None and int(overflowed):
+        raise RuntimeError(
+            f"shard_capacity={capacity} undersizes some shard's "
+            "distinct-key count — refit with a larger capacity (None = "
+            "exact)"
+        )
+
+
+def sum_by_key_sharded(
+    keys: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    mesh,
+    axis: str = "data",
+    weights: jnp.ndarray = None,
+    capacity: int = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Group-by-key sum across a device mesh (module-level design note).
+
+    ``keys``/``valid``/``weights`` are global arrays row-sharded along
+    ``axis`` (length divisible by the axis size). Returns
+    ``(uniq_keys [P*C], totals [P*C], n_unique, overflowed)`` replicated on
+    every device: distinct keys ascending at the front, sentinel padding
+    behind — the same contract as :func:`sum_by_key`. ``capacity`` is the
+    per-shard compaction budget C (default n_local = exact for any input);
+    ``overflowed`` is nonzero iff some shard held more than C distinct keys,
+    in which case totals are incomplete and the caller must refit with a
+    larger capacity — checked, e.g., by
+    ``StupidBackoffEstimator.fit_device``'s host sync.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n = keys.shape[0]
+    p = mesh.shape[axis]
+    if n % p != 0:
+        raise ValueError(f"global length {n} not divisible by mesh axis {p}")
+    n_local = n // p
+    cap = n_local if capacity is None else min(int(capacity), n_local)
+
+    # weights=None keeps sum_by_key's cheaper single-array sort path (the
+    # per-shard sort is the dominant cost) — don't manufacture a ones array
+    if weights is None:
+        def shard_fn(k_l, v_l):
+            return _compact_gather_merge(*sum_by_key(k_l, v_l), cap, axis)
+
+        in_specs = (P(axis), P(axis))
+        args = (keys, valid)
+    else:
+        def shard_fn(k_l, v_l, w_l):
+            return _compact_gather_merge(
+                *sum_by_key(k_l, v_l, w_l), cap, axis
+            )
+
+        in_specs = (P(axis), P(axis), P(axis))
+        args = (keys, valid, weights.astype(jnp.float32))
+    rep = P()
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        check_vma=False,  # outputs are deterministic fns of all-gathered
+                          # (hence replicated) data; inference can't see it
+        in_specs=in_specs,
+        out_specs=(rep, rep, rep, rep),
+    )(*args)
+
+
+def count_ngrams_sharded(
+    ids: jnp.ndarray,
+    lengths: jnp.ndarray,
+    order: int,
+    word_bits: int,
+    *,
+    mesh,
+    axis: str = "data",
+    capacity: int = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:func:`count_ngrams_device` across a document-sharded mesh.
+
+    ``ids [D, L]`` / ``lengths [D]`` row-sharded along ``axis`` (windows
+    never cross documents, so sharding the document axis is exact). The
+    window extraction runs per shard inside the same program; returns the
+    replicated merged table (see :func:`sum_by_key_sharded`).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    d = ids.shape[0]
+    p = mesh.shape[axis]
+    if d % p != 0:
+        raise ValueError(f"doc count {d} not divisible by mesh axis {p}")
+    w = ids.shape[1] - order + 1
+    if w <= 0:
+        dt = jnp.int32 if order * word_bits <= 30 else jnp.int64
+        return (
+            jnp.zeros((0,), dt),
+            jnp.zeros((0,), jnp.float32),
+            jnp.int32(0),
+            jnp.int32(0),
+        )
+    n_local = (d // p) * w
+    cap = n_local if capacity is None else min(int(capacity), n_local)
+
+    def shard_fn(ids_l, len_l):
+        k_l, v_l = window_keys(ids_l, len_l, order, word_bits)
+        return _compact_gather_merge(*sum_by_key(k_l, v_l), cap, axis)
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        check_vma=False,  # outputs are deterministic fns of all-gathered
+                          # (hence replicated) data; inference can't see it
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P(), P(), P()),
+    )(ids, lengths)
+
+
+def unigram_table_sharded(
+    ids: jnp.ndarray,
+    vocab_size: int,
+    lengths: jnp.ndarray = None,
+    *,
+    mesh,
+    axis: str = "data",
+) -> jnp.ndarray:
+    """:func:`unigram_table_device` across a document-sharded mesh: per-shard
+    dense bincount + one psum (the vocab table is dense, so the merge is the
+    cheap psum case of the design note — no key exchange at all)."""
+    from jax.sharding import PartitionSpec as P
+
+    def shard_fn(ids_l, len_l):
+        local = unigram_table_device(ids_l, vocab_size, len_l)
+        return jax.lax.psum(local, axis)
+
+    if lengths is None:
+        lengths = jnp.full((ids.shape[0],), ids.shape[1], jnp.int32)
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        check_vma=False,  # outputs are deterministic fns of all-gathered
+                          # (hence replicated) data; inference can't see it
+        in_specs=(P(axis), P(axis)),
+        out_specs=P(),
+    )(ids, lengths)
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def unigram_table_device(
     ids: jnp.ndarray, vocab_size: int, lengths: jnp.ndarray = None

@@ -136,6 +136,44 @@ def _fit_totals(ids, lengths, orders: Tuple[int, ...], base: int, weight: str):
     return distinct, totals, key_new.sum().astype(jnp.int32)
 
 
+def _fit_totals_sharded(
+    ids, lengths, orders: Tuple[int, ...], base: int, weight: str,
+    mesh, axis: str, capacity: Optional[int] = None,
+):
+    """:func:`_fit_totals` across a document-sharded mesh: per-shard
+    distinct+totals (both weightings are doc-local — each document lives in
+    exactly one shard, so per-shard doc-frequencies sum to the global ones),
+    then compacted-table all-gather + merge reduce (the cluster-wide
+    ``reduceByKey``; design note in ``device_count.py``). Returns
+    ``(distinct, totals, n_keys, overflowed)`` replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.ops.nlp.device_count import (
+        _compact_gather_merge,
+        pad_docs_to_mesh,
+    )
+
+    p = mesh.shape[axis]
+    ids, lengths = pad_docs_to_mesh(ids, lengths, p)
+    d, max_len = ids.shape
+    n_local = (d // p) * sum(max(0, max_len - o + 1) for o in orders)
+    cap = n_local if capacity is None else min(int(capacity), n_local)
+
+    def shard_fn(ids_l, len_l):
+        return _compact_gather_merge(
+            *_fit_totals(ids_l, len_l, orders, base, weight), cap, axis
+        )
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        check_vma=False,  # outputs are deterministic fns of all-gathered
+                          # (hence replicated) data; inference can't see it
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P(), P(), P()),
+    )(ids, lengths)
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def _select_top_k(distinct, totals, k: int):
     """Top-``k`` keys by total weight; feature ids in descending-total order
@@ -280,6 +318,9 @@ class DeviceCommonSparseFeatures(Estimator):
         orders: Tuple[int, ...] = (1, 2),
         num_features: int = 100000,
         weight: str = "binary",
+        mesh=None,
+        mesh_axis: str = "data",
+        shard_capacity: Optional[int] = None,
     ):
         if weight not in _WEIGHTS:
             raise ValueError(f"weight must be one of {_WEIGHTS}, got {weight!r}")
@@ -291,14 +332,30 @@ class DeviceCommonSparseFeatures(Estimator):
         self.orders = orders
         self.num_features = int(num_features)
         self.weight = weight
+        # mesh with >1 device on mesh_axis -> document-sharded fit
+        # (_fit_totals_sharded); tables identical to the single-device fit
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self.shard_capacity = shard_capacity
 
     def fit(self, ids, lengths) -> DeviceNGramVectorizer:
         ids = jnp.asarray(ids)
         lengths = jnp.asarray(lengths)
         with _x64_if_needed(self.base, self.orders):
-            distinct, totals, n_keys = _fit_totals(
-                ids, lengths, self.orders, self.base, self.weight
-            )
+            if self.mesh is not None and self.mesh.shape[self.mesh_axis] > 1:
+                distinct, totals, n_keys, over = _fit_totals_sharded(
+                    ids, lengths, self.orders, self.base, self.weight,
+                    self.mesh, self.mesh_axis, self.shard_capacity,
+                )
+                from keystone_tpu.ops.nlp.device_count import (
+                    check_shard_capacity,
+                )
+
+                check_shard_capacity(over, self.shard_capacity)
+            else:
+                distinct, totals, n_keys = _fit_totals(
+                    ids, lengths, self.orders, self.base, self.weight
+                )
             k = min(self.num_features, int(n_keys))  # the fit's one host sync
             keys_sorted, feat_of_pos = _select_top_k(distinct, totals, max(k, 1))
         return DeviceNGramVectorizer(

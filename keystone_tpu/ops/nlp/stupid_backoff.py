@@ -83,6 +83,98 @@ def _fit_tables_device(
     return uni, tuple(table_keys), tuple(table_counts), jnp.stack(sizes)
 
 
+def _fit_tables_sharded(
+    ids: jnp.ndarray,
+    lengths: jnp.ndarray,
+    orders: Tuple[int, ...],
+    word_bits: int,
+    vocab_size: int,
+    uni: Optional[jnp.ndarray],
+    mesh,
+    axis: str,
+    capacity: Optional[int] = None,
+):
+    """:func:`_fit_tables_device` across a document-sharded mesh — the
+    cluster-wide ``reduceByKey`` (``StupidBackoff.scala:156-159``): per-shard
+    sort+segment combine, all-gather of the compacted per-shard tables over
+    ICI, one merge reduce (design note in ``device_count.py``). The doc axis
+    is padded to the mesh axis size with empty documents (length 0 — no
+    valid windows, no effect on any count). Returns the extra ``overflowed``
+    flag (nonzero only when ``capacity`` undersizes some shard's distinct
+    count; the caller raises)."""
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.ops.nlp.device_count import (
+        _compact_gather_merge,
+        pad_docs_to_mesh,
+        sum_by_key,
+        unigram_table_device,
+        window_keys,
+    )
+
+    p = mesh.shape[axis]
+    ids, lengths = pad_docs_to_mesh(
+        jnp.asarray(ids), jnp.asarray(lengths), p
+    )
+    d, max_len = ids.shape
+
+    def caps(order):
+        n_local = (d // p) * max(0, max_len - order + 1)
+        return n_local if capacity is None else min(int(capacity), n_local)
+
+    # ONE shard_map body — unigrams + every order's count + exchange in a
+    # single XLA program per the _fit_tables_device design (the padded ids
+    # are read once; XLA schedules the per-order ICI exchanges together).
+    # Encoder-provided unigram counts (uni) never enter the manual region —
+    # they are data about a possibly different corpus, passed through.
+    count_uni = uni is None
+
+    def shard_fn(ids_l, len_l):
+        keys_out, counts_out, sizes_out = [], [], []
+        overflowed = jnp.int32(0)
+        for order in range(2, max(orders) + 1):
+            if order in orders and max_len - order + 1 > 0:
+                k_l, v_l = window_keys(ids_l, len_l, order, word_bits)
+                uniq, tot, nu, over = _compact_gather_merge(
+                    *sum_by_key(k_l, v_l), caps(order), axis
+                )
+                overflowed = jnp.maximum(overflowed, over)
+            else:
+                uniq = jnp.zeros((0,), jnp.int64)
+                tot = jnp.zeros((0,), jnp.float32)
+                nu = jnp.int32(0)
+            keys_out.append(uniq)
+            counts_out.append(tot)
+            sizes_out.append(nu)
+        out = (
+            tuple(keys_out), tuple(counts_out),
+            jnp.stack(sizes_out), overflowed,
+        )
+        if count_uni:
+            uni_out = jax.lax.psum(
+                unigram_table_device(ids_l, vocab_size, len_l), axis
+            )
+            return (uni_out,) + out
+        return out
+
+    rep = P()
+    sharded = P(axis)
+    n_tables = max(orders) - 1
+    table_specs = ((rep,) * n_tables, (rep,) * n_tables, rep, rep)
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        check_vma=False,  # outputs are deterministic fns of all-gathered /
+                          # psum'd (hence replicated) data
+        in_specs=(sharded, sharded),
+        out_specs=((rep,) + table_specs) if count_uni else table_specs,
+    )
+    result = fn(ids, lengths)
+    if count_uni:
+        return result
+    return (uni,) + result
+
+
 def _table_lookup(model: "StupidBackoffModel", qk: jnp.ndarray, k: int) -> jnp.ndarray:
     """Count of each order-``k`` packed query key (0 where absent).
 
@@ -430,6 +522,9 @@ class StupidBackoffEstimator:
         orders: Sequence[int],
         vocab_size: Optional[int] = None,
         trim: bool = True,
+        mesh=None,
+        mesh_axis: str = "data",
+        shard_capacity: Optional[int] = None,
     ) -> StupidBackoffModel:
         """Fit entirely on device: counting is sort + segment-reduce on chip.
 
@@ -458,6 +553,15 @@ class StupidBackoffEstimator:
         pipeline path uses this to run fit-to-score with a SINGLE host round
         trip; serve-oriented callers should keep the default (smaller
         resident tables, per-fit static shapes).
+
+        ``mesh`` (with >1 device on ``mesh_axis``) runs the cluster-wide
+        counting path (``_fit_tables_sharded``): documents row-sharded over
+        the mesh, per-shard combine, compacted-table all-gather + merge —
+        the reference's ``reduceByKey`` shuffle as dense ICI collectives.
+        Tables come out identical to the single-device fit (pinned in
+        ``tests/test_sharded_count.py``). ``shard_capacity`` caps the
+        per-shard compacted table (traffic ∝ capacity); an undersized cap
+        raises rather than undercounting.
         """
         orders = tuple(sorted(o for o in set(orders) if o >= 2))
         if not orders:
@@ -483,14 +587,32 @@ class StupidBackoffEstimator:
                     uni_np[wid] = c
             uni_in = jnp.asarray(uni_np)
         with jax.enable_x64():
-            uni, keys, counts, sizes = _fit_tables_device(
-                jnp.asarray(ids),
-                jnp.asarray(lengths),
-                orders,
-                indexer.word_bits,
-                int(vocab_size),
-                uni_in,
-            )
+            if mesh is not None and mesh.shape[mesh_axis] > 1:
+                uni, keys, counts, sizes, over = _fit_tables_sharded(
+                    jnp.asarray(ids),
+                    jnp.asarray(lengths),
+                    orders,
+                    indexer.word_bits,
+                    int(vocab_size),
+                    uni_in,
+                    mesh,
+                    mesh_axis,
+                    shard_capacity,
+                )
+                from keystone_tpu.ops.nlp.device_count import (
+                    check_shard_capacity,
+                )
+
+                check_shard_capacity(over, shard_capacity)
+            else:
+                uni, keys, counts, sizes = _fit_tables_device(
+                    jnp.asarray(ids),
+                    jnp.asarray(lengths),
+                    orders,
+                    indexer.word_bits,
+                    int(vocab_size),
+                    uni_in,
+                )
             table_sizes = None
             sizes_dev = None if trim else sizes
             if trim:
