@@ -262,10 +262,33 @@ def _solve_group(bs: int, max_nc: int, woodbury: bool = False) -> int:
 @functools.partial(jax.jit, static_argnames=("precision",))
 def _base_inverse(pop_cov, lam, w, precision: str):
     """B⁻¹ for the shared Woodbury base B = (1-w)·pop_cov + λI — one bs×bs
-    SPD inversion per block, amortized over every class's solve."""
+    SPD inversion per block, amortized over every class's solve.
+
+    Also returns a conditioning estimate — the runtime signal for the
+    measured f32 envelope (explicit B⁻¹ loses ~cond(B)·eps of prediction
+    accuracy; drift is visible at cond ≳ 1e6, see the estimator docstring):
+    ‖B‖₂·‖B⁻¹‖₂ with each norm from a few power iterations (we hold both
+    matrices; ~16 bs² matvecs, noise next to the bs³ factorization). The
+    Cholesky-diagonal ratio would be free but measures ~10-15× under the
+    true condition number on low-rank-dominated covariances — too slack to
+    anchor a threshold to the measured drift onset.
+    """
     bs = pop_cov.shape[0]
     eye = jnp.eye(bs, dtype=pop_cov.dtype)
-    return spd_solve((1.0 - w) * pop_cov + lam * eye, eye)
+    B = (1.0 - w) * pop_cov + lam * eye
+    inv = spd_solve(B, eye)
+
+    def top_norm(M):
+        v0 = jnp.full((bs,), 1.0 / np.sqrt(bs), M.dtype)
+        v = jax.lax.fori_loop(
+            0, 8,
+            lambda _, v: (lambda u: u / jnp.maximum(
+                jnp.linalg.norm(u), 1e-30))(M @ v),
+            v0,
+        )
+        return jnp.linalg.norm(M @ v)
+
+    return inv, top_norm(B) * top_norm(inv)
 
 
 def _use_woodbury(max_nc: int, bs: int) -> bool:
@@ -356,7 +379,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
     def __init__(self, block_size: int, num_iter: int, lam: float,
                  mixture_weight: float, cache_stats: bool = True,
-                 woodbury: str = "auto"):
+                 woodbury: str = "auto",
+                 woodbury_cond_limit: float = 1e6):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
@@ -380,6 +404,15 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         if woodbury not in ("auto", "always", "never"):
             raise ValueError(f"woodbury must be auto|always|never: {woodbury}")
         self.woodbury = woodbury
+        # Runtime guard on that envelope: every Woodbury base inverse
+        # carries a power-iteration estimate of cond(B) (‖B‖·‖B⁻¹‖, ~16 bs²
+        # matvecs — see _base_inverse; the free Cholesky-diagonal ratio
+        # reads 10-15× low and can't anchor this threshold). If any block's
+        # estimate exceeds the limit, "auto" fits WARN and refit with dense
+        # solves (one extra pass — paid only at operating points where
+        # Woodbury predictions measurably drift); "always" warns and keeps
+        # the result. The limit is the measured drift onset (~1e6).
+        self.woodbury_cond_limit = float(woodbury_cond_limit)
 
     @property
     def _woodbury_policy(self):
@@ -389,7 +422,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         return lambda max_nc, bs: forced
 
     def _run(self, get_block, num_blocks: int, labels, mask, precision: str,
-             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0):
+             checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
+             _force_dense: bool = False):
         """Shared weighted-BCD loop. ``get_block(b)`` returns the
         (n, block_size) feature block in original row order — no global
         class sort exists anywhere (see ``_prepare``).
@@ -464,6 +498,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     "some controllers but not others — it must be on a "
                     "filesystem shared by every process"
                 )
+        binv_conds: list = []  # device scalars; synced ONCE after the loop
         if checkpoint_path and _os.path.exists(checkpoint_path):
             from keystone_tpu.core.checkpoint import load_node
 
@@ -474,6 +509,19 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     f"{state['num_blocks']} blocks x {state['num_iter']} iters, "
                     f"not {num_blocks} x {self.num_iter}"
                 )
+            if bool(state.get("force_dense", False)) and not _force_dense:
+                # the checkpoint came from a conditioning-guard dense refit
+                # (or an explicitly forced dense run): adopt its solve path —
+                # resuming it under the Woodbury policy would mix rank-update
+                # blocks on top of dense ones
+                return self._run(
+                    get_block, num_blocks, labels, mask, precision,
+                    checkpoint_path, checkpoint_every, _force_dense=True,
+                )
+            # restore the guard's evidence for already-completed blocks —
+            # without this a resumed fit under-reports max cond and the
+            # conditioning guard silently never fires
+            binv_conds = [jnp.asarray(c) for c in state.get("binv_conds", [])]
             # restore the checkpointed residual IN the live R's sharding —
             # load_node returns host numpy, and device_put straight from
             # host uploads only each process's addressable shards; a
@@ -527,13 +575,17 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     "pop_stats_cache": pop_stats_cache,
                     "iter": it, "block": next_b,
                     "num_blocks": num_blocks, "num_iter": self.num_iter,
+                    # solve-path marker + the conditioning evidence so far:
+                    # resume must neither mix solve paths nor lose the
+                    # guard's view of completed blocks
+                    "force_dense": _force_dense,
+                    "binv_conds": list(binv_conds),
                 },
                 checkpoint_path,
             )
 
-        need_binv = _needs_base_inverse(
-            buckets, self.block_size, self._woodbury_policy
-        )
+        policy = (lambda *_: False) if _force_dense else self._woodbury_policy
+        need_binv = _needs_base_inverse(buckets, self.block_size, policy)
         for it in range(self.num_iter):
             for b in range(num_blocks):
                 if (it, b) < (start_iter, start_block):
@@ -545,10 +597,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     )
                     # base inverse depends only on pop_cov/λ/w: once per
                     # block, cached with the pop stats across iterations
-                    base_inv = (
-                        _base_inverse(pop_cov, lam, w, precision)
-                        if need_binv else None
-                    )
+                    if need_binv:
+                        base_inv, cond_est = _base_inverse(
+                            pop_cov, lam, w, precision
+                        )
+                        binv_conds.append(cond_est)
+                    else:
+                        base_inv = None
                     # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)
                     class_sums = _class_sums(Xb, class_idx, num_classes)
                     class_means = class_sums / jnp.maximum(
@@ -569,7 +624,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     Xb, R, counts, pop_cov, pop_mean, pop_xtr,
                     joint_means_b, residual_mean, models[b], lam, w, buckets,
                     inv_perm, base_inv, precision=precision,
-                    policy=self._woodbury_policy,
+                    policy=policy,
                 )
                 models[b] = models[b] + dW
                 R = _apply_update(R, Xb, dW, valid, precision=precision)
@@ -592,6 +647,36 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # silently resume past every block and return stale state.
             # Process 0 owns the file (it alone writes, _save_checkpoint).
             _os.remove(checkpoint_path)
+
+        # Conditioning guard (one host sync, at the fit's natural end): any
+        # block whose Woodbury base exceeded the measured drift onset means
+        # the explicit f32 B⁻¹ may have cost prediction accuracy (estimator
+        # docstring). "auto" refits dense — correctness over the rare slow
+        # path; "always" keeps the result but says so.
+        if binv_conds and not _force_dense:
+            max_cond = float(jnp.max(jnp.stack(binv_conds)))
+            if max_cond > self.woodbury_cond_limit:
+                from keystone_tpu.utils import get_logger
+
+                log = get_logger("keystone_tpu.learning.block_weighted")
+                if self.woodbury == "always":
+                    log.warning(
+                        "Woodbury base conditioning est. %.2e exceeds %.0e; "
+                        "woodbury='always' keeps the rank-update result — "
+                        "predictions may drift ~cond*eps vs dense",
+                        max_cond, self.woodbury_cond_limit,
+                    )
+                else:
+                    log.warning(
+                        "Woodbury base conditioning est. %.2e exceeds %.0e; "
+                        "refitting with dense class solves "
+                        "(woodbury_cond_limit guard)",
+                        max_cond, self.woodbury_cond_limit,
+                    )
+                    return self._run(
+                        get_block, num_blocks, labels, mask, precision,
+                        checkpoint_path, checkpoint_every, _force_dense=True,
+                    )
 
         W = jnp.concatenate(models, axis=0)
         joint_means = jnp.concatenate(joint_means_blocks, axis=1)  # (C, d_pad)

@@ -42,7 +42,7 @@ def build_case(bs: int, nc: int, num_classes: int, seed: int = 0):
         bw._pop_stats, static_argnames=("precision",)
     )(X, R, valid, n_eff, precision=prec)
     w, lam = jnp.float32(0.25), jnp.float32(6e-5)
-    base_inv = bw._base_inverse(pop_cov, lam, w, prec)
+    base_inv = bw._base_inverse(pop_cov, lam, w, prec)[0]
     class_sums = bw._class_sums(X, class_idx, num_classes)
     class_means = class_sums / jnp.maximum(
         counts[:, None].astype(jnp.float32), 1.0

@@ -2,6 +2,8 @@
 independently-recomputed-solution checks
 (BlockWeightedLeastSquaresSuite.scala:18-97)."""
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -568,3 +570,136 @@ def test_woodbury_threshold_boundary_both_ways(rng, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(m_auto.w), np.asarray(m_dense.w), atol=2e-4
     )
+
+
+def _ill_conditioned_fixture(rng, n=512, d=128, c=32, rank=12, noise=1e-3):
+    """Low-rank-dominated features (cond(cov) >> 1e6 with the flagship
+    lambda) — the operating point where the explicit f32 Woodbury base
+    inverse measurably drifts (estimator docstring envelope)."""
+    loadings = rng.normal(size=(n, rank)).astype(np.float32)
+    factors = rng.normal(size=(rank, d)).astype(np.float32)
+    x = loadings @ factors + noise * rng.normal(size=(n, d)).astype(np.float32)
+    labels = (np.arange(n) % c).astype(np.int32)
+    rng.shuffle(labels)
+    ind = np.asarray(ClassLabelIndicatorsFromIntLabels(c)(jnp.asarray(labels)))
+    return x, ind
+
+
+def test_woodbury_cond_guard_refits_dense(rng, caplog):
+    """Runtime conditioning guard (VERDICT r3 weak #7): past the measured
+    drift onset an 'auto' fit must WARN and fall back to dense solves — the
+    result is bit-identical to woodbury='never' because the refit IS that
+    path."""
+    import logging
+
+    x, ind = _ill_conditioned_fixture(rng)
+    bs = x.shape[1]
+    with caplog.at_level(
+        logging.WARNING, logger="keystone_tpu.learning.block_weighted"
+    ):
+        m_auto = BlockWeightedLeastSquaresEstimator(bs, 1, 6e-5, 0.25).fit(
+            jnp.asarray(x), jnp.asarray(ind)
+        )
+    assert any("conditioning" in r.message for r in caplog.records)
+    m_dense = BlockWeightedLeastSquaresEstimator(
+        bs, 1, 6e-5, 0.25, woodbury="never"
+    ).fit(jnp.asarray(x), jnp.asarray(ind))
+    np.testing.assert_array_equal(np.asarray(m_auto.w), np.asarray(m_dense.w))
+
+    # woodbury='always' keeps the rank-update result but still warns
+    caplog.clear()
+    with caplog.at_level(
+        logging.WARNING, logger="keystone_tpu.learning.block_weighted"
+    ):
+        BlockWeightedLeastSquaresEstimator(
+            bs, 1, 6e-5, 0.25, woodbury="always"
+        ).fit(jnp.asarray(x), jnp.asarray(ind))
+    assert any("always" in r.message for r in caplog.records)
+
+
+def test_woodbury_cond_guard_quiet_when_well_conditioned(rng, caplog):
+    """The guard must not fire (and must not refit) at healthy conditioning
+    — the common case pays one scalar sync and nothing else."""
+    import logging
+
+    x, labels, ind = _toy(rng, n=240, d=64, balanced=True)
+    with caplog.at_level(
+        logging.WARNING, logger="keystone_tpu.learning.block_weighted"
+    ):
+        BlockWeightedLeastSquaresEstimator(64, 1, 0.05, 0.25).fit(
+            jnp.asarray(x), jnp.asarray(ind)
+        )
+    assert not any("conditioning" in r.message for r in caplog.records)
+
+
+def test_woodbury_cond_guard_survives_resume(rng, tmp_path, caplog):
+    """The guard's evidence rides the checkpoint: block 0 is the
+    ill-conditioned one; a crash AFTER block 0 and a resume that only runs
+    block 1 must still fire the guard (the restored cond estimate, not the
+    resumed blocks', carries the signal)."""
+    import logging
+
+    import keystone_tpu.learning.block_weighted as bw
+
+    bs, c = 128, 32
+    x_ill, ind = _ill_conditioned_fixture(rng, d=bs, c=c)
+    n = x_ill.shape[0]
+    x_ok = rng.normal(size=(n, bs)).astype(np.float32)  # healthy block 1
+    blocks = [jnp.asarray(x_ill), jnp.asarray(x_ok)]
+    est = BlockWeightedLeastSquaresEstimator(bs, 1, 6e-5, 0.25)
+    ck = str(tmp_path / "ck")
+
+    calls = {"n": 0}
+
+    def poisoned(b):
+        if b == 1 and calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("boom")
+        return blocks[b]
+
+    with pytest.raises(RuntimeError, match="boom"):
+        est._run(poisoned, 2, jnp.asarray(ind), None, "high",
+                 checkpoint_path=ck, checkpoint_every=1)
+    assert os.path.exists(ck)
+    with caplog.at_level(
+        logging.WARNING, logger="keystone_tpu.learning.block_weighted"
+    ):
+        est._run(lambda b: blocks[b], 2, jnp.asarray(ind), None, "high",
+                 checkpoint_path=ck, checkpoint_every=1)
+    assert any("conditioning" in r.message for r in caplog.records)
+
+
+def test_dense_refit_checkpoint_not_resumed_as_woodbury(rng, tmp_path):
+    """A crash inside the guard's dense refit leaves a force_dense-marked
+    checkpoint; a later plain run must adopt the dense path end to end
+    (bit-identical to an uninterrupted dense run), never mixing solve
+    paths."""
+    import keystone_tpu.learning.block_weighted as bw
+
+    bs, c = 128, 32
+    x, ind = _ill_conditioned_fixture(rng, d=2 * bs, c=c)
+    blocks = [jnp.asarray(x[:, :bs]), jnp.asarray(x[:, bs:])]
+    est = BlockWeightedLeastSquaresEstimator(bs, 1, 6e-5, 0.25)
+    ck = str(tmp_path / "ck")
+
+    calls = {"n": 0}
+
+    def poisoned(b):
+        if b == 1 and calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("boom")
+        return blocks[b]
+
+    with pytest.raises(RuntimeError, match="boom"):
+        est._run(poisoned, 2, jnp.asarray(ind), None, "high",
+                 checkpoint_path=ck, checkpoint_every=1, _force_dense=True)
+    assert os.path.exists(ck)
+    W_resumed, *_ = est._run(
+        lambda b: blocks[b], 2, jnp.asarray(ind), None, "high",
+        checkpoint_path=ck, checkpoint_every=1,
+    )
+    W_dense, *_ = est._run(
+        lambda b: blocks[b], 2, jnp.asarray(ind), None, "high",
+        _force_dense=True,
+    )
+    np.testing.assert_array_equal(np.asarray(W_resumed), np.asarray(W_dense))

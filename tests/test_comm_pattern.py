@@ -69,7 +69,7 @@ def test_weighted_block_step_all_reduces_never_gathers(mesh, rng):
             Xb, R, valid, n_eff, precision=prec
         )
         base_inv = (
-            bw._base_inverse(pop_cov, lam, w, prec)
+            bw._base_inverse(pop_cov, lam, w, prec)[0]
             if bw._needs_base_inverse(buckets, bs)
             else None
         )
