@@ -19,6 +19,7 @@ invocation, compile cached) as the headline value and the cold run
 separately.
 """
 
+import functools
 import json
 import os
 import sys
@@ -137,11 +138,21 @@ _EXTRA_PIPELINES = (
 
 WARM_REPS = int(os.environ.get("BENCH_WARM_REPS", "3"))
 
+# A warm distribution whose max strays this far above its median was
+# measurably contended (chip shared with another tenant): BASELINE.md's
+# observed swings are ~1.5-1.9x, quiet-chip spreads are <1.2x.
+_CONTENTION_RATIO = 1.3
+
 
 def _warm_stats(fn, reps: int = None):
-    """Run ``fn`` ``reps`` times and return (median, min, max) wall-clocks —
-    the tunneled chip is contended, so single-shot warm numbers drift ~1.5x
-    run to run (BASELINE.md); the JSON carries the spread, not prose."""
+    """Run ``fn`` ``reps`` times; return (median, min, max, contended).
+
+    The tunneled chip is contended, so single-shot warm numbers drift ~1.5x
+    run to run (BASELINE.md); the JSON carries the spread, not prose. When
+    max/median exceeds the contention ratio the sample auto-reruns ONCE
+    (the extra rep usually restores a clean median) and the final
+    ``contended`` bool is recorded per metric — no more silent 1.9x spreads
+    inside one artifact (VERDICT r3 weak #5)."""
     import statistics
 
     reps = WARM_REPS if reps is None else reps
@@ -150,10 +161,16 @@ def _warm_stats(fn, reps: int = None):
         t0 = time.perf_counter()
         fn()
         times.append(time.perf_counter() - t0)
+    if len(times) > 1 and max(times) / statistics.median(times) > _CONTENTION_RATIO:
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
     return (
-        round(statistics.median(times), 3),
+        round(med, 3),
         round(min(times), 3),
         round(max(times), 3),
+        bool(max(times) / med > _CONTENTION_RATIO),
     )
 
 
@@ -171,15 +188,164 @@ def _try_extras():
             mod = importlib.import_module(module)
             cfg = getattr(mod, config_name)(**kwargs)
             mod.run(cfg)  # cold (compile)
-            med, lo, hi = _warm_stats(lambda: mod.run(cfg))
+            med, lo, hi, contended = _warm_stats(lambda: mod.run(cfg))
             extras[key] = med
             extras[key + "_min"] = lo
             extras[key + "_max"] = hi
+            extras[key + "_contended"] = contended
         except Exception as e:
             print(f"extras[{key}] failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
             extras[key] = None
     return extras
+
+
+def _try_device_count_constants():
+    """Re-measure the two on-chip microbenchmarks the device-count design
+    rests on (``device_count.py``/``device_text.py`` docstrings: int32 keys
+    sort ~2x faster than int64; ``searchsorted method='sort'`` ~19x faster
+    than ``'scan'`` for int32): a jaxlib upgrade that inverted either would
+    otherwise silently strand the design on the slow side (VERDICT r3 weak
+    #6). Latency-cancelled timing — (K chained ops) − (1 op) — so the
+    ~100 ms tunnel round trip drops out. BENCH_CONSTANTS=0 skips."""
+    if os.environ.get("BENCH_CONSTANTS", "1") == "0":
+        return {}
+    try:
+        n = 1 << 20  # ~the 20k-doc StupidBackoff window-key count
+        k_reps = 8
+
+        def lat_cancelled(fn, sync):
+            def timed(k):
+                sync(fn(0))  # compile
+                t0 = time.perf_counter()
+                o = None
+                for i in range(k):
+                    o = fn(i + 1)
+                sync(o)
+                return time.perf_counter() - t0
+
+            return (timed(1 + k_reps) - timed(1)) / k_reps
+
+        out = {}
+        with jax.enable_x64():
+            keys32 = jax.random.randint(
+                jax.random.key(0), (n,), 0, 1 << 30, jnp.int32
+            )
+            keys64 = keys32.astype(jnp.int64) << 20
+
+            def sort_t(keys):
+                f = jax.jit(lambda s: jnp.sort(keys + s))
+                return lat_cancelled(f, lambda o: int(o[0]))
+
+            t32, t64 = sort_t(keys32), sort_t(keys64)
+            out["key_sort_int32_s"] = round(t32, 4)
+            out["key_sort_int64_s"] = round(t64, 4)
+            out["key_sort_int64_over_int32"] = round(t64 / t32, 2)
+
+            table = jnp.sort(jax.random.randint(
+                jax.random.key(1), (200_000,), 0, 1 << 30, jnp.int32
+            ))
+            q = jax.random.randint(jax.random.key(2), (n,), 0, 1 << 30,
+                                   jnp.int32)
+
+            def ss_t(method):
+                f = jax.jit(functools.partial(
+                    lambda s, m: jnp.searchsorted(table, q + s, method=m),
+                    m=method,
+                ))
+                return lat_cancelled(f, lambda o: int(o[0]))
+
+            ts, tc = ss_t("sort"), ss_t("scan")
+            out["searchsorted_sort_int32_s"] = round(ts, 4)
+            out["searchsorted_scan_int32_s"] = round(tc, 4)
+            out["searchsorted_scan_over_sort_int32"] = round(tc / ts, 1)
+        return out
+    except Exception as e:
+        print(f"device-count constants bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {}
+
+
+def _try_serving_latency():
+    """Single-item ``serve`` latency on fitted pipelines (VERDICT r3 missing
+    #4 — the dual bulk/single-item contract, ``Transformer.scala:16-30``,
+    had correctness tests but zero perf evidence). Median/p95 of 100 calls,
+    each synced to the host — over a tunneled runtime this INCLUDES the
+    transport round trip, i.e. what a caller would actually observe.
+    BENCH_SERVE=0 skips."""
+    if os.environ.get("BENCH_SERVE", "1") == "0":
+        return {}
+    import statistics
+
+    out = {}
+
+    def p50_p95(call):
+        call()  # compile
+        times = []
+        for _ in range(100):
+            t0 = time.perf_counter()
+            call()
+            times.append((time.perf_counter() - t0) * 1e3)
+        times.sort()
+        return round(statistics.median(times), 2), round(times[94], 2)
+
+    try:
+        from keystone_tpu.learning import BlockLeastSquaresEstimator
+        from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+        from keystone_tpu.pipelines.mnist_random_fft import (
+            MnistRandomFFTConfig,
+            build_featurizer,
+        )
+        from keystone_tpu.loaders.mnist import synthetic_mnist_device
+
+        cfg = MnistRandomFFTConfig(num_ffts=4, block_size=2048, lam=10.0)
+        feats = build_featurizer(cfg)
+        x, y = synthetic_mnist_device(4096, seed=7)
+        train_feats = jnp.concatenate([f(x) for f in feats], axis=1)
+        labels = ClassLabelIndicatorsFromIntLabels(10)(y)
+        model = BlockLeastSquaresEstimator(2048, num_iter=1, lam=10.0).fit(
+            train_feats, labels
+        )
+        item = x[0]
+
+        def serve_mnist():
+            f = jnp.concatenate([f_.serve(item) for f_ in feats])
+            return float(jnp.sum(model.serve(f)))
+
+        p50, p95 = p50_p95(serve_mnist)
+        out["mnist_serve_p50_ms"] = p50
+        out["mnist_serve_p95_ms"] = p95
+    except Exception as e:
+        print(f"mnist serve bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    try:
+        import numpy as np
+
+        from keystone_tpu.learning.naive_bayes import NaiveBayesEstimator
+        from keystone_tpu.ops.nlp.device_text import DeviceCommonSparseFeatures
+
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, 5000, (2000, 64)), jnp.int32)
+        lens = jnp.asarray(rng.integers(8, 65, 2000), jnp.int32)
+        lab = jnp.asarray(rng.integers(0, 20, 2000), jnp.int32)
+        vec = DeviceCommonSparseFeatures(
+            base=5001, orders=(1, 2), num_features=4096
+        ).fit(ids, lens)
+        nb = NaiveBayesEstimator(20).fit(vec.apply_encoded(ids, lens), lab)
+        one_ids, one_len = ids[:1], lens[:1]
+
+        def serve_news():
+            scores = nb.apply_batch(vec.apply_encoded(one_ids, one_len))
+            return float(jnp.sum(scores))
+
+        p50, p95 = p50_p95(serve_news)
+        out["newsgroups_serve_p50_ms"] = p50
+        out["newsgroups_serve_p95_ms"] = p95
+    except Exception as e:
+        print(f"newsgroups serve bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    return out
 
 
 def _try_moments_design_point():
@@ -261,7 +427,7 @@ def main():
     run(config)  # cold (compile)
     cold_s = time.perf_counter() - t0
     last: dict = {}
-    med, lo, hi = _warm_stats(lambda: last.update(run(config)))
+    med, lo, hi, contended = _warm_stats(lambda: last.update(run(config)))
     warm = last
 
     value = med
@@ -281,6 +447,7 @@ def main():
         },
         "value_min": lo,
         "value_max": hi,
+        "contended": contended,
         "warm_reps": WARM_REPS,
         "cold_wallclock_s": round(cold_s, 3),
         "xla_cache_prewarmed": _CACHE_PREWARMED,
@@ -293,6 +460,8 @@ def main():
         out["solver_gflops_per_chip_f32_highest"] = _try_solver_gflops("highest")
     out.update(_try_extras())
     out.update(_try_moments_design_point())
+    out.update(_try_device_count_constants())
+    out.update(_try_serving_latency())
     if os.environ.get("BENCH_FLAGSHIP", "1") == "1":
         # The reference-dim streaming ImageNet regime (BASELINE.md flagship
         # row) — with the persistent XLA cache prewarmed this is ~2-4 min
@@ -306,18 +475,57 @@ def main():
 
             fcfg = flagship_config()
             run_flagship(fcfg)  # cold / cache-deserialize
-            med, lo, hi = _warm_stats(lambda: run_flagship(fcfg))
+            flast: dict = {}
+            med, lo, hi, fcont = _warm_stats(
+                lambda: flast.update(run_flagship(fcfg))
+            )
             out["imagenet_refdim_streaming_warm_s"] = med
             out["imagenet_refdim_streaming_warm_s_min"] = lo
             out["imagenet_refdim_streaming_warm_s_max"] = hi
+            out["imagenet_refdim_streaming_warm_s_contended"] = fcont
+            try:
+                # quality rides the artifact: a draw from the measured band
+                # (BASELINE.md flagship row), floored in CI by
+                # tests/test_voc_imagenet_pipelines.py. Its own try: a
+                # missing key must not clobber valid timing rows.
+                out["imagenet_refdim_top5_error_pct"] = round(
+                    flast["test_top5_error"], 2
+                )
+            except Exception as e:
+                print(f"flagship quality readout failed: {e}", file=sys.stderr)
         except Exception as e:
             print(f"flagship bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
-            out["imagenet_refdim_streaming_warm_s"] = None
-    if os.environ.get("BENCH_TIMIT_FULL", "0") == "1":
-        # Opt-in: TIMIT at the FULL reference scale (2.2M frames, 50x4096,
-        # 5 epochs, row-chunked streaming) — ~4 min warm + compile, so not
-        # part of the default budget; BASELINE.md carries the measured row.
+            out.setdefault("imagenet_refdim_streaming_warm_s", None)
+    if os.environ.get("BENCH_VOC_REFDIM", "1") == "1":
+        # VOC at reference dims (BASELINE.md row: 5 120/4 096 synthetic 96²
+        # imgs, descDim 80, vocab 256 -> d=40 960, blockSize 4096) — every
+        # proven regime rides the round artifact (VERDICT r3 weak #3).
+        try:
+            from keystone_tpu.pipelines.voc_sift_fisher import (
+                VOCSIFTFisherConfig,
+                run as run_voc,
+            )
+
+            vcfg = VOCSIFTFisherConfig(
+                synthetic_train=5120, synthetic_test=4096, desc_dim=80,
+                vocab_size=256, block_size=4096, row_chunks=16,
+            )
+            run_voc(vcfg)  # cold / cache-deserialize
+            med, lo, hi, vcont = _warm_stats(lambda: run_voc(vcfg), reps=2)
+            out["voc_refdim_warm_s"] = med
+            out["voc_refdim_warm_s_min"] = lo
+            out["voc_refdim_warm_s_max"] = hi
+            out["voc_refdim_warm_s_contended"] = vcont
+        except Exception as e:
+            print(f"voc refdim bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            out["voc_refdim_warm_s"] = None
+    if os.environ.get("BENCH_TIMIT_FULL", "1") == "1":
+        # TIMIT at the FULL reference scale (2.2M frames, 50x4096, 5
+        # epochs, row-chunked streaming) — ~4 min per warm run; median of 2
+        # so the regime rides every round artifact (VERDICT r3 weak #3).
+        # BENCH_TIMIT_FULL=0 opts out on tight budgets.
         try:
             from keystone_tpu.pipelines.timit import TimitConfig, run as run_timit
 
@@ -326,9 +534,11 @@ def main():
                 num_epochs=5, row_chunk=131072,
             )
             run_timit(tcfg)  # cold
-            out["timit_full_2p2m_warm_s"] = round(
-                run_timit(tcfg)["wallclock_s"], 1
-            )
+            med, lo, hi, tcont = _warm_stats(lambda: run_timit(tcfg), reps=2)
+            out["timit_full_2p2m_warm_s"] = round(med, 1)
+            out["timit_full_2p2m_warm_s_min"] = round(lo, 1)
+            out["timit_full_2p2m_warm_s_max"] = round(hi, 1)
+            out["timit_full_2p2m_warm_s_contended"] = tcont
             timit_full_cpu = (anchor or {}).get("timit_cpu_warm_extrapolated_s")
             if timit_full_cpu:
                 # per-block-epoch costs scale linearly in rows (22x)

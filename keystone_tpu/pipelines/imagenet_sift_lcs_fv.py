@@ -103,6 +103,16 @@ class ImageNetSiftLcsFVConfig:
     # BASELINE.md reports the band, not a point (the knob remains for
     # density-model uses where likelihood IS the objective)
     gmm_n_init: int = 1
+    # >1: fit that many independently-seeded codebooks per branch and keep
+    # the one whose normalized FVs CLASSIFY a held-out probe of the sample
+    # images best (pipelines/_fisher.py::select_codebook_by_probe) — the
+    # lever likelihood restarts cannot provide, since likelihood does not
+    # predict FV discriminativeness (the measured 4.7-16.5% band).
+    # Streaming path only; probe cost ≈ candidates × (one small EM +
+    # probe-FV featurize + a proj_dim ridge).
+    gmm_probe_candidates: int = 1
+    gmm_probe_images: int = 4096
+    gmm_probe_proj_dim: int = 2048
 
     def validate(self):
         if self.buckets and not self.train_location:
@@ -411,7 +421,7 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
         # labels included) so reduce_split below never re-extracts — or even
         # re-generates/transfers — the sample images.
         desc_cache: dict = {}
-        s_parts, l_parts = [], []
+        s_parts, l_parts, lbl_parts = [], [], []
         for i0 in range(0, n_sample, chunk):
             i1 = min(i0 + chunk, train_src.n)
             imgs, lbls = train_src.chunk(i0, i1)
@@ -419,30 +429,59 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             desc_cache[(i0, i1)] = (sd, ld, lbls)
             s_parts.append(sd)
             l_parts.append(ld)
+            lbl_parts.append(lbls)
         sample_s = jnp.concatenate(s_parts) if len(s_parts) > 1 else s_parts[0]
         sample_l = jnp.concatenate(l_parts) if len(l_parts) > 1 else l_parts[0]
-        del s_parts, l_parts
+        sample_lbls = np.concatenate(lbl_parts)
+        del s_parts, l_parts, lbl_parts
 
         with Timer("streaming.fit_pca_gmm"):
-            pca_s = PCAEstimator(config.sift_pca_dim).fit_batch(
-                ColumnSampler(config.num_pca_samples, seed=config.seed)(sample_s)
-            )
-            gmm_s = GaussianMixtureModelEstimator(
-                config.vocab_size, n_init=config.gmm_n_init
-            ).fit(
-                ColumnSampler(config.num_gmm_samples, seed=config.seed + 1)(
-                    pca_s(sample_s)
+
+            def fit_branch(sample, pca_dim, seed_pca, seed_gmm, tag):
+                """PCA + codebook for one branch; with probe selection on
+                (gmm_probe_candidates > 1) the codebook is the probe-best of
+                independently-seeded candidates, each fitted on the SAME
+                sample feed (select_codebook_by_probe docstring)."""
+                pca = PCAEstimator(pca_dim).fit_batch(
+                    ColumnSampler(config.num_pca_samples, seed=seed_pca)(sample)
                 )
+                reduced = pca(sample)
+
+                def fit_candidate(em_seed):
+                    return GaussianMixtureModelEstimator(
+                        config.vocab_size, seed=em_seed,
+                        n_init=config.gmm_n_init,
+                    ).fit(
+                        ColumnSampler(
+                            config.num_gmm_samples, seed=seed_gmm
+                        )(reduced)
+                    )
+
+                if config.gmm_probe_candidates > 1:
+                    from keystone_tpu.pipelines._fisher import (
+                        select_codebook_by_probe,
+                    )
+
+                    gmm, scores = select_codebook_by_probe(
+                        fit_candidate, reduced, sample_lbls, num_classes,
+                        candidates=config.gmm_probe_candidates,
+                        seed=seed_gmm,
+                        probe_images=config.gmm_probe_images,
+                        proj_dim=config.gmm_probe_proj_dim,
+                        row_chunk=config.fv_row_chunk,
+                    )
+                    results[f"gmm_probe_scores_{tag}"] = scores
+                else:
+                    gmm = fit_candidate(42)  # the estimator's default seed
+                return pca, gmm
+
+            pca_s, gmm_s = fit_branch(
+                sample_s, config.sift_pca_dim, config.seed, config.seed + 1,
+                "sift",
             )
-            pca_l = PCAEstimator(config.lcs_pca_dim).fit_batch(
-                ColumnSampler(config.num_pca_samples, seed=config.seed + 7)(sample_l)
-            )
-            gmm_l = GaussianMixtureModelEstimator(
-                config.vocab_size, n_init=config.gmm_n_init
-            ).fit(
-                ColumnSampler(config.num_gmm_samples, seed=config.seed + 8)(
-                    pca_l(sample_l)
-                )
+            pca_l, gmm_l = fit_branch(
+                sample_l, config.lcs_pca_dim, config.seed + 7, config.seed + 8,
+                "lcs",
             )
         del sample_s, sample_l
 
