@@ -126,7 +126,11 @@ def _class_solves(
     num_classes = pop_xtr.shape[1]
     eye = jnp.eye(bs, dtype=Xb.dtype)
 
-    def one(c, rows):
+    def prep(c, rows):
+        """Per-class statistics shared by BOTH solve algorithms: the
+        low-rank factor V — with ``joint_xtx + λI = B + VᵀV`` for the
+        shared base ``B = (1-w)·popCov + λI`` — and the rhs. The Woodbury
+        paths use V directly; the dense path forms VᵀV explicitly."""
         n_c = counts[c].astype(jnp.float32)
         Xc = jnp.take(Xb, rows, axis=0)  # (max_nc, bs)
         # only column c of the residual is needed — a (max_nc,) gather, vs
@@ -135,11 +139,9 @@ def _class_solves(
         m = (jnp.arange(max_nc) < counts[c]).astype(Xb.dtype)
         nc = jnp.maximum(n_c, 1.0)
         res_local = res_local * m
-
         class_mean = jnp.sum(Xc * m[:, None], axis=0) / nc
         Xzm = (Xc - class_mean) * m[:, None]
         class_xtr = hdot((Xc * m[:, None]).T, res_local, precision) / nc
-
         mean_diff = class_mean - pop_mean
         mean_mix = (1.0 - w) * residual_mean[c] + w * jnp.sum(res_local) / nc
         joint_xtr = (
@@ -148,27 +150,49 @@ def _class_solves(
             - joint_means_b[c] * mean_mix
         )
         rhs = joint_xtr - lam * jnp.take(model_b, c, axis=1)
+        V = jnp.concatenate(
+            [
+                jnp.sqrt(w / nc) * Xzm,
+                jnp.sqrt((1.0 - w) * w) * mean_diff[None, :],
+            ]
+        )  # (max_nc + 1, bs)
+        return V, rhs
 
+    def one(c, rows):
+        V, rhs = prep(c, rows)
         if woodbury:
-            V = jnp.concatenate(
-                [
-                    jnp.sqrt(w / nc) * Xzm,
-                    jnp.sqrt((1.0 - w) * w) * mean_diff[None, :],
-                ]
-            )  # (max_nc + 1, bs); joint_xtx + λI = B + VᵀV
             t0 = hdot(base_inv, rhs, precision)
             T = hdot(V, base_inv, precision)  # (max_nc + 1, bs)
             S = jnp.eye(max_nc + 1, dtype=Xb.dtype) + hdot(T, V.T, precision)
             y = spd_solve(S, hdot(T, rhs, precision))
             return t0 - hdot(T.T, y, precision)
-
-        class_cov = hdot(Xzm.T, Xzm, precision) / nc
-        joint_xtx = (
-            (1.0 - w) * pop_cov
-            + w * class_cov
-            + (1.0 - w) * w * jnp.outer(mean_diff, mean_diff)
+        # dense: joint_xtx + λI = B + VᵀV (prep docstring)
+        joint_xtx_reg = (1.0 - w) * pop_cov + lam * eye + hdot(
+            V.T, V, precision
         )
-        return spd_solve(joint_xtx + lam * eye, rhs)
+        return spd_solve(joint_xtx_reg, rhs)
+
+    def group_woodbury(ids_g, rows_g):
+        """All of a group's base-inverse contractions as ONE (g·(nc+1), bs)
+        × (bs, bs) matmul instead of g batched M=(nc+1) matmuls — the
+        batched form under-fills the MXU's 128-row tiles at flagship
+        max_nc≈103+1 (measured ~24% of the bf16x3 ceiling; the flattened
+        gemm is the same FLOPs at full tile occupancy)."""
+        V_g, rhs_g = jax.vmap(prep)(ids_g, rows_g)  # (g, nc1, bs), (g, bs)
+        nc1 = max_nc + 1
+        gg = V_g.shape[0]
+        T_g = hdot(V_g.reshape(gg * nc1, bs), base_inv, precision).reshape(
+            gg, nc1, bs
+        )
+        t0_g = hdot(rhs_g, base_inv, precision)  # B⁻¹ symmetric: rhs @ B⁻¹
+        S_g = jnp.eye(nc1, dtype=Xb.dtype)[None] + hdot(
+            T_g, jnp.swapaxes(V_g, 1, 2), precision
+        )
+        Ty = hdot(T_g, rhs_g[:, :, None], precision)[..., 0]
+        y = spd_solve(S_g, Ty[..., None])[..., 0]  # batched over (g,)
+        return t0_g - hdot(jnp.swapaxes(T_g, 1, 2), y[:, :, None], precision)[
+            ..., 0
+        ]
 
     n_ids = class_ids.shape[0]
     if group <= 1 or n_ids <= 1:
@@ -182,8 +206,9 @@ def _class_solves(
     rows_p = jnp.concatenate(
         [class_rows, jnp.repeat(class_rows[-1:], pad, axis=0)]
     )
+    step = group_woodbury if woodbury else jax.vmap(one)
     _, dW = jax.lax.scan(
-        lambda _, cr: (None, jax.vmap(one)(*cr)),
+        lambda _, cr: (None, step(*cr)),
         None,
         (ids.reshape(-1, g), rows_p.reshape(-1, g, max_nc)),
     )
@@ -586,21 +611,39 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         policy = (lambda *_: False) if _force_dense else self._woodbury_policy
         need_binv = _needs_base_inverse(buckets, self.block_size, policy)
+        # Per-phase attribution, diag-mode only (KEYSTONE_SYNC_TIMERS=1):
+        # Timers inside the hot loop would flush dispatch every block and
+        # defeat the async single-sync design, so the production path gets
+        # a no-op context.
+        if _os.environ.get("KEYSTONE_SYNC_TIMERS", "0") == "1":
+            from keystone_tpu.utils import Timer as _PhaseTimer
+
+            def _phase(tag):
+                return _PhaseTimer(f"weighted_bcd.{tag}", log=False)
+        else:
+            import contextlib
+
+            def _phase(tag):
+                return contextlib.nullcontext()
+
         for it in range(self.num_iter):
             for b in range(num_blocks):
                 if (it, b) < (start_iter, start_block):
                     continue
-                Xb = get_block(b)
+                with _phase("featurize"):
+                    Xb = get_block(b)
                 if pop_stats_cache[b] is None:
-                    pop_mean, pop_cov, pop_xtr = _pop_stats(
-                        Xb, R, valid, n_eff, precision=precision
-                    )
+                    with _phase("pop_stats"):
+                        pop_mean, pop_cov, pop_xtr = _pop_stats(
+                            Xb, R, valid, n_eff, precision=precision
+                        )
                     # base inverse depends only on pop_cov/λ/w: once per
                     # block, cached with the pop stats across iterations
                     if need_binv:
-                        base_inv, cond_est = _base_inverse(
-                            pop_cov, lam, w, precision
-                        )
+                        with _phase("base_inverse"):
+                            base_inv, cond_est = _base_inverse(
+                                pop_cov, lam, w, precision
+                            )
                         binv_conds.append(cond_est)
                     else:
                         base_inv = None
@@ -620,15 +663,17 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         (Xb.astype(jnp.float32) * valid[:, None]).T, R, precision
                     ) / n_eff
 
-                dW = _bucketed_class_solves(
-                    Xb, R, counts, pop_cov, pop_mean, pop_xtr,
-                    joint_means_b, residual_mean, models[b], lam, w, buckets,
-                    inv_perm, base_inv, precision=precision,
-                    policy=policy,
-                )
+                with _phase("class_solves"):
+                    dW = _bucketed_class_solves(
+                        Xb, R, counts, pop_cov, pop_mean, pop_xtr,
+                        joint_means_b, residual_mean, models[b], lam, w,
+                        buckets, inv_perm, base_inv, precision=precision,
+                        policy=policy,
+                    )
                 models[b] = models[b] + dW
-                R = _apply_update(R, Xb, dW, valid, precision=precision)
-                _, residual_mean = _class_col_means(R, class_idx, counts)
+                with _phase("residual_update"):
+                    R = _apply_update(R, Xb, dW, valid, precision=precision)
+                    _, residual_mean = _class_col_means(R, class_idx, counts)
                 if (
                     checkpoint_path
                     and checkpoint_every > 0

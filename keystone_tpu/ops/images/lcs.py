@@ -14,6 +14,8 @@ descriptors so ordering is immaterial.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -37,32 +39,48 @@ class LCSExtractor(Transformer):
 
     def apply(self, img):
         """(H, W, C) -> (num_keypoints, C·16·2)."""
-        h, w, c = img.shape
-        chans = jnp.moveaxis(img, -1, 0)  # (C, H, W)
-        box = np.full(self.sub_patch_size, 1.0 / self.sub_patch_size, np.float32)
-        means = conv2d_same(chans, box, box)
-        sq = conv2d_same(chans * chans, box, box)
-        stds = jnp.sqrt(jnp.maximum(sq - means * means, 0.0))
+        return self.apply_batch(img[None])[0]
 
-        ys = jnp.arange(self.stride_start, h - self.stride_start, self.stride)
-        xs = jnp.arange(self.stride_start, w - self.stride_start, self.stride)
-        offs = jnp.asarray(self._neighbor_offsets())
-
-        # sample positions: keypoint grid + neighborhood offsets
-        py = (ys[:, None] + offs[None, :]).reshape(-1)  # (ny*4,)
-        px = (xs[:, None] + offs[None, :]).reshape(-1)  # (nx*4,)
-        m = means[:, py, :][:, :, px]  # (C, ny*4, nx*4)
-        s = stds[:, py, :][:, :, px]
-        ny, nx, k = ys.shape[0], xs.shape[0], offs.shape[0]
-        m = m.reshape(c, ny, k, nx, k)
-        s = s.reshape(c, ny, k, nx, k)
-        # per keypoint: descriptor ordered (c, ref-x offset, ref-y offset,
-        # [mean, std]) — ref-x is our axis 0 (Image.scala:139)
-        stacked = jnp.stack([m, s], axis=-1)  # (C, ny, oy, nx, ox, 2)
-        stacked = stacked.transpose(1, 3, 0, 2, 4, 5)  # (ny, nx, C, oy, ox, 2)
-        return stacked.reshape(ny * nx, c * k * k * 2)
+    def apply_batch(self, imgs):
+        """Natively batched (N, H, W, C) path, ONE compiled program — not a
+        vmap of per-image programs and not a chain of eager GB-scale ops
+        (both measured ~2-4x slower per flagship chunk on v5e)."""
+        return _lcs_batch_jit(
+            imgs, self.stride, self.stride_start, self.sub_patch_size
+        )
 
     def num_keypoints(self, h: int, w: int) -> int:
         ny = len(range(self.stride_start, h - self.stride_start, self.stride))
         nx = len(range(self.stride_start, w - self.stride_start, self.stride))
         return ny * nx
+
+
+@functools.partial(
+    jax.jit, static_argnames=("stride", "stride_start", "sub_patch_size")
+)
+def _lcs_batch_jit(imgs, stride: int, stride_start: int, sub_patch_size: int):
+    node = LCSExtractor(stride, stride_start, sub_patch_size)
+    n, h, w, c = imgs.shape
+    chans = jnp.moveaxis(imgs, -1, 1)  # (N, C, H, W)
+    box = np.full(sub_patch_size, 1.0 / sub_patch_size, np.float32)
+    means = conv2d_same(chans, box, box)
+    sq = conv2d_same(chans * chans, box, box)
+    stds = jnp.sqrt(jnp.maximum(sq - means * means, 0.0))
+
+    ys = jnp.arange(stride_start, h - stride_start, stride)
+    xs = jnp.arange(stride_start, w - stride_start, stride)
+    offs = jnp.asarray(node._neighbor_offsets())
+
+    # sample positions: keypoint grid + neighborhood offsets
+    py = (ys[:, None] + offs[None, :]).reshape(-1)  # (ny*4,)
+    px = (xs[:, None] + offs[None, :]).reshape(-1)  # (nx*4,)
+    m = means[:, :, py, :][:, :, :, px]  # (N, C, ny*4, nx*4)
+    s = stds[:, :, py, :][:, :, :, px]
+    ny, nx, k = ys.shape[0], xs.shape[0], offs.shape[0]
+    m = m.reshape(n, c, ny, k, nx, k)
+    s = s.reshape(n, c, ny, k, nx, k)
+    # per keypoint: descriptor ordered (c, ref-x offset, ref-y offset,
+    # [mean, std]) — ref-x is our axis 0 (Image.scala:139)
+    stacked = jnp.stack([m, s], axis=-1)  # (N, C, ny, oy, nx, ox, 2)
+    stacked = stacked.transpose(0, 2, 4, 1, 3, 5, 6)  # (N, ny, nx, C, ...)
+    return stacked.reshape(n, ny * nx, c * k * k * 2)

@@ -237,16 +237,26 @@ def select_codebook_by_probe(
     whose Fisher features CLASSIFY best on a held-out probe — not the one
     with the best likelihood.
 
-    Why: the flagship's measured quality band (top-5 4.7-16.5% across EM
-    numeric variants, BASELINE.md) is a lottery over EM local optima, and
-    codebook log-likelihood does NOT predict downstream FV classification
-    (best-of-n-likelihood landed mid-band) — so ``n_init`` restarts cannot
-    tighten it. This selector scores each candidate on the metric that
-    matters: normalized FVs of a probe subset of the sample images →
-    fixed-seed Gaussian projection to ``proj_dim`` (a 2·k·d ridge would be
-    a full solver; the projection preserves ranking at ~1/16 the width) →
-    ridge fit on 1−holdout_frac of the probe → top-5 error on the rest.
-    Ranking, not absolute accuracy, is what the probe must get right.
+    Why: the flagship's measured quality band (BASELINE.md) is a lottery
+    over EM local optima, and codebook log-likelihood does NOT predict
+    downstream FV classification (best-of-n-likelihood landed mid-band) —
+    so ``n_init`` restarts cannot tighten it. This selector scores each
+    candidate on a classification probe instead: normalized FVs of a probe
+    subset of the sample images → fixed-seed Gaussian projection to
+    ``proj_dim`` → ridge fit on 1−holdout_frac of the probe → top-5 error
+    on the rest.
+
+    **Measured verdict (round 4, flagship scale, 3 seeds × 2 probe sizes):
+    UNRELIABLE — left off by default.** The probe ranking does not
+    transfer consistently to the full-scale solver metric: with a 4096-img
+    probe, seeds {42, 7, 123} moved 29.7→11.5 / 6.8→6.5 / 21.7→**44.6**;
+    with the full 18432-img probe, 29.7→11.5 / 6.8→**30.4** / 21.7→14.2.
+    Selection helps some draws and badly hurts others — the same
+    conclusion as likelihood restarts, now for probe classification. The
+    knob remains for experimentation; the robust quality claims stay the
+    measured band + the shuffled-label control + the CI floor
+    (tests/test_voc_imagenet_pipelines.py) + the per-round bench quality
+    readout.
 
     ``fit_candidate(em_seed) -> GaussianMixtureModel`` is the CALLER's own
     codebook fit (its production sample feed and n_init), so the selected

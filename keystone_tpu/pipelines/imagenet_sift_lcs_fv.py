@@ -105,11 +105,11 @@ class ImageNetSiftLcsFVConfig:
     gmm_n_init: int = 1
     # >1: fit that many independently-seeded codebooks per branch and keep
     # the one whose normalized FVs CLASSIFY a held-out probe of the sample
-    # images best (pipelines/_fisher.py::select_codebook_by_probe) — the
-    # lever likelihood restarts cannot provide, since likelihood does not
-    # predict FV discriminativeness (the measured 4.7-16.5% band).
-    # Streaming path only; probe cost ≈ candidates × (one small EM +
-    # probe-FV featurize + a proj_dim ridge).
+    # images best (pipelines/_fisher.py::select_codebook_by_probe).
+    # MEASURED (round 4): probe ranking does NOT transfer reliably to the
+    # full-scale metric — helps some draws, badly hurts others (evidence in
+    # the selector's docstring) — so the default stays 1 (off), like the
+    # likelihood-restart knob and for the same reason. Streaming path only.
     gmm_probe_candidates: int = 1
     gmm_probe_images: int = 4096
     gmm_probe_proj_dim: int = 2048
@@ -157,7 +157,11 @@ class _SyntheticSource:
         if self._shuffle:
             rng = np.random.default_rng(self._seed * 7 + i0)
             labels = rng.integers(0, self._classes, size=i1 - i0)
-        return imgs, np.asarray(labels)
+        # labels STAY on device: an np.asarray here would block on the
+        # chunk's whole generation — 50 serialized host round trips inside
+        # the extraction loop (measured ~5 s of the flagship's wall-clock;
+        # consumers pull the concatenated labels once)
+        return imgs, jnp.asarray(labels)
 
 
 def _run_streaming_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
@@ -432,7 +436,14 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             lbl_parts.append(lbls)
         sample_s = jnp.concatenate(s_parts) if len(s_parts) > 1 else s_parts[0]
         sample_l = jnp.concatenate(l_parts) if len(l_parts) > 1 else l_parts[0]
-        sample_lbls = np.concatenate(lbl_parts)
+        if config.gmm_probe_candidates > 1:
+            # device concat + ONE host pull, and only when the probe
+            # selector (the sole consumer) is actually on
+            sample_lbls = np.asarray(
+                jnp.concatenate([jnp.asarray(l) for l in lbl_parts])
+            )
+        else:
+            sample_lbls = None
         del s_parts, l_parts, lbl_parts
 
         with Timer("streaming.fit_pca_gmm"):
@@ -496,33 +507,62 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             donate_argnums=(0,),
         )
 
+        # ONE compiled program per chunk: extract (both branches) + PCA +
+        # cast. Eagerly these are ~10 separate dispatches each paying a full
+        # HBM round trip over the (chunk, n_desc, 128) tensors; fused, the
+        # projections ride the extractor epilogues. PCA mats are ARGUMENTS
+        # (not closure constants) so a warm-run refit reuses the executable.
+        @jax.jit
+        def _reduce_chunk(imgs, mat_s, mat_l):
+            return (
+                (sift_descs(imgs) @ mat_s).astype(dtype),
+                (lcs_descs(imgs) @ mat_l).astype(dtype),
+            )
+
+        @jax.jit
+        def _reduce_cached(sd, ld, mat_s, mat_l):
+            return (
+                (sd @ mat_s).astype(dtype),
+                (ld @ mat_l).astype(dtype),
+            )
+
         def reduce_split(src, use_cache: bool = False):
             """One pass over ``src``: descriptors → PCA → ``dtype`` buffers;
             returns (raw pytree for the FV block nodes, int labels)."""
             red_s = red_l = None
             lbl_parts = []
-            for i0 in range(0, src.n, chunk):
-                i1 = min(i0 + chunk, src.n)
-                if use_cache and (i0, i1) in desc_cache:
-                    sd, ld, lbls = desc_cache.pop((i0, i1))
-                else:
-                    imgs, lbls = src.chunk(i0, i1)
-                    sd, ld = sift_descs(imgs), lcs_descs(imgs)
-                ps = pca_s(sd).astype(dtype)
-                pl = pca_l(ld).astype(dtype)
-                if red_s is None:
-                    red_s = jnp.zeros((src.n, *ps.shape[1:]), dtype)
-                    red_l = jnp.zeros((src.n, *pl.shape[1:]), dtype)
-                red_s = _upd(red_s, ps, i0)
-                red_l = _upd(red_l, pl, i0)
-                lbl_parts.append(lbls)
-            raw = {
-                "sift": red_s,
-                "l1_sift": fisher_l1_norms(red_s, gmm_s, config.fv_row_chunk),
-                "lcs": red_l,
-                "l1_lcs": fisher_l1_norms(red_l, gmm_l, config.fv_row_chunk),
-            }
-            return raw, np.concatenate(lbl_parts)
+            with Timer("streaming.reduce.extract_chunks", log=False):
+                for i0 in range(0, src.n, chunk):
+                    i1 = min(i0 + chunk, src.n)
+                    if use_cache and (i0, i1) in desc_cache:
+                        sd, ld, lbls = desc_cache.pop((i0, i1))
+                        ps, pl = _reduce_cached(
+                            sd, ld, pca_s.pca_mat, pca_l.pca_mat
+                        )
+                    else:
+                        imgs, lbls = src.chunk(i0, i1)
+                        ps, pl = _reduce_chunk(
+                            imgs, pca_s.pca_mat, pca_l.pca_mat
+                        )
+                    if red_s is None:
+                        red_s = jnp.zeros((src.n, *ps.shape[1:]), dtype)
+                        red_l = jnp.zeros((src.n, *pl.shape[1:]), dtype)
+                    red_s = _upd(red_s, ps, i0)
+                    red_l = _upd(red_l, pl, i0)
+                    lbl_parts.append(lbls)
+            with Timer("streaming.reduce.l1_norms", log=False):
+                raw = {
+                    "sift": red_s,
+                    "l1_sift": fisher_l1_norms(red_s, gmm_s, config.fv_row_chunk),
+                    "lcs": red_l,
+                    "l1_lcs": fisher_l1_norms(red_l, gmm_l, config.fv_row_chunk),
+                }
+            # ONE host pull for every chunk's labels (device concat first) —
+            # per-chunk np.asarray would serialize a round trip per chunk
+            labels_np = np.asarray(
+                jnp.concatenate([jnp.asarray(l) for l in lbl_parts])
+            )
+            return raw, labels_np
 
         with Timer("streaming.reduce_train"):
             raw_train, train_labels = reduce_split(train_src, use_cache=True)
