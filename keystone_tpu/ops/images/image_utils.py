@@ -53,15 +53,28 @@ def _conv_band_matrix(filt_bytes: bytes, k: int, L: int, mode: str) -> np.ndarra
     return K
 
 
-def _conv1d_same(x, filt: np.ndarray, axis: int, mode: str = "zero"):
+def _conv1d_same(x, filt: np.ndarray, axis: int, mode: str = "zero",
+                 impl: str = "auto"):
     """1-D "same" convolution along ``axis`` (true convolution, zero or
-    edge padding): banded matmul on the MXU for small axes, lax.conv
-    otherwise (see ``_MATMUL_CONV_MAX_LEN``)."""
+    edge padding): banded matmul for small axes ON TPU, lax.conv otherwise.
+
+    The matmul form pays L/k more MACs — free on the MXU (a rank-1
+    single-channel conv cannot use it at all), a genuine pessimization on
+    CPU — so ``auto`` picks by backend at trace time. That also keeps the
+    jax-CPU anchor (scripts/cpu_baseline.py) honest: the CPU side times
+    the CPU-best formulation, not a TPU-shaped one. ``impl``:
+    "auto" | "matmul" | "conv" (forced, for cross-path parity tests).
+    """
     filt = np.ascontiguousarray(np.asarray(filt, np.float32))
     k = len(filt)
     moved = jnp.moveaxis(x, axis, -1)
     L = moved.shape[-1]
-    if L <= _MATMUL_CONV_MAX_LEN:
+    use_matmul = impl == "matmul" or (
+        impl == "auto"
+        and L <= _MATMUL_CONV_MAX_LEN
+        and jax.default_backend() == "tpu"
+    )
+    if use_matmul:
         K = jnp.asarray(_conv_band_matrix(filt.tobytes(), k, L, mode))
         res = jnp.matmul(moved, K, preferred_element_type=jnp.float32)
         return jnp.moveaxis(res, -1, axis)

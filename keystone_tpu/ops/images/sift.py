@@ -93,9 +93,10 @@ def _orientation_energies(mag, angle):
 
 def _box_sums(energies, bin_size: int):
     """Box-filter sums of width bin_size (stride 1, VALID): output index j
-    covers pixels [j, j+bin_size). Kept as the reference formulation for
-    tests/oracles; the production scale path fuses this with the keypoint
-    gather into selection matmuls (``_bin_select_matrix``)."""
+    covers pixels [j, j+bin_size). The PRODUCTION bin-aggregation path on
+    non-TPU backends (``_dsift_single_scale`` impl="auto"/"window"); on TPU
+    it is fused with the keypoint gather into selection matmuls
+    (``_bin_select_matrix``) instead."""
     return jax.lax.reduce_window(
         energies,
         0.0,
@@ -156,26 +157,55 @@ def _bin_select_matrix(L: int, n_f: int, step: int, bin_size: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("step", "bin_size", "min_bound", "height", "width")
+    jax.jit,
+    static_argnames=("step", "bin_size", "min_bound", "height", "width", "impl"),
 )
-def _dsift_single_scale(img, step: int, bin_size: int, min_bound: int, height: int, width: int):
+def _dsift_single_scale(img, step: int, bin_size: int, min_bound: int,
+                        height: int, width: int, impl: str = "auto"):
     """One dsift scale over a batch: (..., H, W) -> (..., ny*nx, 128) plus
-    the pre-normalization gradient mass (..., ny*nx)."""
+    the pre-normalization gradient mass (..., ny*nx).
+
+    Two mathematically-identical bin-aggregation forms (fp summation order
+    differs; cross-path agreement pinned in ``tests/test_sift.py``):
+    selection matmuls on TPU (box sum + keypoint/bin gather fused onto the
+    MXU, no (..., T, Hb, Wb) box tensor), ``reduce_window`` + gathers
+    elsewhere (the matmul form's L/4 extra MACs are a real cost without an
+    MXU — and the jax-CPU anchor must time the CPU-best formulation).
+    ``impl``: "auto" | "matmul" | "window" (forced, for parity tests)."""
     mag, angle = _gradient_polar(img)
     energies = _orientation_energies(mag, angle)  # (..., T, H, W)
 
     ny, nx = dsift_geometry(width, height, step, bin_size, min_bound)
-    # box sum + keypoint/bin gather per axis = one 0/1 selection matmul
-    # (see _bin_select_matrix); XLA fuses the energies producer into the
-    # first matmul, so the (..., T, Hb, Wb) box tensor never exists
-    My = jnp.asarray(_bin_select_matrix(height, ny, step, bin_size, min_bound))
-    Mx = jnp.asarray(_bin_select_matrix(width, nx, step, bin_size, min_bound))
-    # (..., T, H, W) @ (W, nx*4) -> (..., T, H, nx*4); then contract H
-    gx = jnp.matmul(energies, Mx, preferred_element_type=jnp.float32)
-    g = jnp.einsum(
-        "...hq,hp->...pq", gx, My, preferred_element_type=jnp.float32
-    )  # (..., T, ny*4, nx*4)
-    g = g.reshape(*g.shape[:-2], ny, NUM_BIN_S, nx, NUM_BIN_S)
+    use_matmul = impl == "matmul" or (
+        impl == "auto" and jax.default_backend() == "tpu"
+    )
+    if use_matmul:
+        # box sum + keypoint/bin gather per axis = one 0/1 selection matmul
+        # (see _bin_select_matrix); XLA fuses the energies producer into the
+        # first matmul, so the (..., T, Hb, Wb) box tensor never exists
+        My = jnp.asarray(
+            _bin_select_matrix(height, ny, step, bin_size, min_bound)
+        )
+        Mx = jnp.asarray(
+            _bin_select_matrix(width, nx, step, bin_size, min_bound)
+        )
+        # (..., T, H, W) @ (W, nx*4) -> (..., T, H, nx*4); then contract H
+        gx = jnp.matmul(energies, Mx, preferred_element_type=jnp.float32)
+        g = jnp.einsum(
+            "...hq,hp->...pq", gx, My, preferred_element_type=jnp.float32
+        )  # (..., T, ny*4, nx*4)
+        g = g.reshape(*g.shape[:-2], ny, NUM_BIN_S, nx, NUM_BIN_S)
+    else:
+        box = _box_sums(energies, bin_size)  # (..., T, Hb, Wb)
+        # frame origin o = min_bound + f·step; spatial bin i is the box of
+        # width bin_size centered at o + i·bin, i.e. box index
+        # o + i·bin - bin//2
+        fy = min_bound + jnp.arange(ny) * step
+        fx = min_bound + jnp.arange(nx) * step
+        off = jnp.arange(NUM_BIN_S) * bin_size - bin_size // 2
+        iy = jnp.clip(fy[:, None] + off[None, :], 0, box.shape[-2] - 1)
+        ix = jnp.clip(fx[:, None] + off[None, :], 0, box.shape[-1] - 1)
+        g = box[..., :, iy, :][..., :, :, :, ix]  # (..., T, ny, 4, nx, 4)
     # vl element layout is t + T*(x_vl + 4*y_vl); the reference passes images
     # with vl-width = xDim = image height (Image.scala:139), so vl-x bins are
     # our axis-0 (by) bins and vl-y bins our axis-1 (bx) bins: element order

@@ -133,3 +133,34 @@ def test_sift_batch_matches_single(rng):
     batch = np.asarray(node(jnp.asarray(imgs)))
     single = np.asarray(node.serve(jnp.asarray(imgs[2])))
     np.testing.assert_allclose(batch[2], single, atol=1e-4)
+
+
+def test_bin_aggregation_paths_agree(rng):
+    """The TPU selection-matmul form and the reduce_window+gather form of
+    the per-scale bin aggregation are the same sum in different fp orders —
+    pin their agreement so the backend-gated dispatch can never hide a
+    divergence (impl='auto' picks by backend; both forced here)."""
+    from keystone_tpu.ops.images.sift import _dsift_single_scale
+
+    img = jnp.asarray(rng.random((3, 48, 40)).astype(np.float32))
+    a, _ = _dsift_single_scale(img, 3, 4, 9, 48, 40, impl="matmul")
+    b, _ = _dsift_single_scale(img, 3, 4, 9, 48, 40, impl="window")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_conv1d_same_impls_agree(rng):
+    """Banded-matmul vs lax.conv forms of the separable 'same' convolution
+    (zero AND edge padding) — forced-path parity for the backend-gated
+    dispatch in image_utils._conv1d_same."""
+    from keystone_tpu.ops.images.image_utils import _conv1d_same
+
+    x = jnp.asarray(rng.random((5, 31)).astype(np.float32))
+    for k in (3, 6, 9):
+        filt = rng.random(k).astype(np.float32)
+        for mode in ("zero", "edge"):
+            a = _conv1d_same(x, filt, -1, mode=mode, impl="matmul")
+            b = _conv1d_same(x, filt, -1, mode=mode, impl="conv")
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5,
+                err_msg=f"k={k} mode={mode}",
+            )
