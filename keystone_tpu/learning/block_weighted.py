@@ -644,7 +644,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                             base_inv, cond_est = _base_inverse(
                                 pop_cov, lam, w, precision
                             )
-                        binv_conds.append(cond_est)
+                        # one cond estimate per BLOCK: with cache_stats=False
+                        # and num_iter > 1 this branch re-runs every pass over
+                        # the same pop_cov/λ/w, and re-appending would grow
+                        # the checkpointed evidence list each iteration
+                        if it == 0:
+                            binv_conds.append(cond_est)
                     else:
                         base_inv = None
                     # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)

@@ -318,6 +318,12 @@ def unigram_table_sharded(
 
     if lengths is None:
         lengths = jnp.full((ids.shape[0],), ids.shape[1], jnp.int32)
+    # same ingest recipe as the sibling entry points: length-0 padding docs
+    # contribute nothing to the bincount, and a non-divisible doc count
+    # would otherwise fail with an opaque shard_map sharding error
+    ids, lengths = pad_docs_to_mesh(
+        jnp.asarray(ids), jnp.asarray(lengths), mesh.shape[axis]
+    )
     return jax.shard_map(
         shard_fn,
         mesh=mesh,

@@ -140,7 +140,16 @@ def _fit_tables_sharded(
                 )
                 overflowed = jnp.maximum(overflowed, over)
             else:
-                uniq = jnp.zeros((0,), jnp.int64)
+                # empty-table dtype matches the single-device fit exactly
+                # (dtype drives _table_lookup's method choice): a skipped
+                # order is int64 (_fit_tables_device), while a requested
+                # order with no valid windows (max_len < order) follows
+                # window_keys' packing rule
+                if order in orders:
+                    dt = jnp.int32 if order * word_bits <= 30 else jnp.int64
+                else:
+                    dt = jnp.int64
+                uniq = jnp.zeros((0,), dt)
                 tot = jnp.zeros((0,), jnp.float32)
                 nu = jnp.int32(0)
             keys_out.append(uniq)

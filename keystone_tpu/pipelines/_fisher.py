@@ -285,6 +285,16 @@ def select_codebook_by_probe(
     y = labels[perm]
     n_hold = max(1, int(n * holdout_frac))
     n_tr = n - n_hold
+    if n_tr < 8 or n_hold < 8:
+        # a degenerate split (tiny probe pool) would rank candidates on a
+        # meaningless ridge/top-5 score and silently drive selection — fall
+        # back to the caller's default (first) candidate instead
+        logger.warning(
+            "codebook probe: degenerate split (n=%d -> train %d / holdout "
+            "%d); selection skipped, using the default candidate",
+            n, n_tr, n_hold,
+        )
+        return fit_candidate(seed), []
     onehot = (jax.nn.one_hot(y[:n_tr], num_classes) * 2.0 - 1.0)
 
     d = probe.shape[-1]
