@@ -20,6 +20,13 @@ output and in BASELINE.md. Both numbers are the warm (second) invocation,
 matching how bench.py times the TPU.
 """
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
 import argparse
 import json
 import multiprocessing

@@ -10,6 +10,12 @@ Usage: ``python scripts/flagship_imagenet.py [--warm] [--train N]``.
 
 import argparse
 import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -27,6 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "drawn independently of images; top-5 error must "
                          "collapse to ~chance (1 - 5/classes)")
     ap.add_argument("--cache-dir", default="/tmp/keystone_xla_cache")
+    ap.add_argument("--cache-blocks", type=int, default=None,
+                    help="override fv_cache_blocks (posterior cache-group "
+                         "width; HBM experiment knob)")
     return ap
 
 
@@ -42,10 +51,14 @@ def main() -> None:
         run,
     )
 
+    overrides = {}
+    if args.cache_blocks is not None:
+        overrides["fv_cache_blocks"] = args.cache_blocks
     cfg = flagship_config(
         synthetic_train=args.train,
         synthetic_test=args.test,
         synthetic_noise=args.noise,
+        **overrides,
     )
     out = {"cold": run(cfg)}
     if args.warm:

@@ -17,6 +17,13 @@ integer statistics (counts, quantized histograms) are backend-exact while
 float moments carry tolerances in the test.
 """
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
 import json
 import os
 

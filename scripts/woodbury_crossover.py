@@ -12,6 +12,13 @@ Timing is latency-cancelled: each measurement chains K solves and subtracts
 a 1-solve run, so the tunnel round-trip (~100 ms) drops out.
 """
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
 import json
 import time
 

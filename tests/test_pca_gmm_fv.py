@@ -287,6 +287,35 @@ def test_fv_cols_batch_matches_per_image(rng):
             )
 
 
+def test_fv_cols_batch_mxu_matches_f32(rng, monkeypatch):
+    """The TPU MXU moment form (one [x|x²]@[A;B] posterior gemm + bf16
+    moment einsums, _fv_cols_batch_mxu) must agree with the exact f32 path
+    within bf16 rounding, across one-sided, straddling, coinciding and
+    full column ranges. On CPU the f32 path is the default; the mxu form
+    is what the flagship featurize runs on the chip, so this is the
+    cross-path pin (the _conv1d_same impl-forcing pattern)."""
+    from keystone_tpu.ops.images import fisher_vector as fv
+
+    k, d = 8, 16
+    gmm = GaussianMixtureModelEstimator(k=k, num_iter=15).fit(
+        jnp.asarray(rng.normal(size=(400, d)).astype(np.float32))
+    )
+    descs = jnp.asarray(rng.normal(size=(6, 30, d)).astype(np.float32))
+    for lo, hi in ((0, 2 * k), (0, 4), (6, 12), (k, 2 * k), (4, k + 4)):
+        monkeypatch.setenv("KEYSTONE_FV_IMPL", "f32")
+        ref = np.asarray(fv._fv_cols_batch(descs, gmm, lo, hi))
+        monkeypatch.setenv("KEYSTONE_FV_IMPL", "mxu")
+        got = np.asarray(fv._fv_cols_batch(descs, gmm, lo, hi))
+        # bf16 inputs to the moment einsums: ~8-bit mantissa on the
+        # contributions; f32 accumulation keeps the error at rounding
+        # scale, not growth scale
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(
+            got, ref, atol=2e-2 * scale, rtol=2e-2,
+            err_msg=f"cols=[{lo},{hi})",
+        )
+
+
 def test_gmm_n_init_picks_best_likelihood(rng):
     """Best-of-n restarts must return the candidate with the highest data
     log-likelihood — and on a well-separated planted mixture that candidate
