@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench cpu-baseline flagship clean
+.PHONY: all native test bench bench-cached cpu-baseline flagship clean
 
 all: native test
 
@@ -23,6 +23,14 @@ test:
 	$(PY) -m pytest tests/ -q
 
 bench:
+	$(PY) bench.py
+
+# Cache/prefetch evidence only: the primary metric plus the cached-vs-cold
+# and prefetch-on/off rows (core/cache.py, core/prefetch.py); every other
+# secondary block is switched off for a fast loop.
+bench-cached:
+	BENCH_EXTRAS=0 BENCH_FLAGSHIP=0 BENCH_VOC_REFDIM=0 BENCH_TIMIT_FULL=0 \
+	BENCH_MOMENTS=0 BENCH_CONSTANTS=0 BENCH_SERVE=0 BENCH_STAGES=0 \
 	$(PY) bench.py
 
 cpu-baseline:

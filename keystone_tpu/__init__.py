@@ -30,5 +30,13 @@ from keystone_tpu.core.pipeline import (
     chain,
 )
 from keystone_tpu.core.dataset import Dataset, LabeledData
+from keystone_tpu.core.cache import (
+    IntermediateCache,
+    fingerprint,
+    get_cache,
+    set_cache,
+    use_cache,
+)
+from keystone_tpu.core.prefetch import prefetch_map
 
 __version__ = "0.1.0"

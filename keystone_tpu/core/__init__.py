@@ -11,4 +11,12 @@ from keystone_tpu.core.pipeline import (
     chain,
 )
 from keystone_tpu.core.dataset import Dataset, LabeledData
+from keystone_tpu.core.cache import (
+    IntermediateCache,
+    fingerprint,
+    get_cache,
+    set_cache,
+    use_cache,
+)
+from keystone_tpu.core.prefetch import prefetch_map
 from keystone_tpu.core.checkpoint import save_node, load_node, load_or_fit

@@ -1,0 +1,596 @@
+"""Content-addressed intermediate cache with tiered (HBM / host / disk) storage.
+
+KeystoneML's whole-pipeline optimizer decides which intermediates to
+materialize (``.cache()`` via ``nodes/util/Cacher.scala:13-21``) so that an
+expensive featurization runs once, not once per downstream consumer. Here the
+analog is a content-addressed memo table over pipeline intermediates:
+
+- **Keys** are content fingerprints: blake2b over (treedef structure, every
+  leaf's dtype/shape/bytes). Re-fitting a node keeps its treedef but changes
+  its leaves, so a refit is a *miss* by construction — stale reuse cannot
+  happen. Large device arrays are fingerprinted with an on-device checksum
+  (two weighted mod-2³² sums over a uint8 bitcast) so multi-GB intermediates
+  never round-trip to the host just to be identified.
+
+- **Tiers**: device (HBM) → host (RAM, numpy) → disk (``cache_dir``). Each
+  tier has a byte budget; when a tier overflows, the entry with the lowest
+  *recompute-cost density* (measured compute seconds per byte — the
+  KeystoneML size × recompute-cost heuristic, ties broken LRU) is demoted to
+  the next tier, and past the disk budget it is evicted. Hits in a lower
+  tier promote the value back toward the device.
+
+- **Correctness**: a hit returns the exact stored value (bit-identical to the
+  original computation); placement only moves bytes between memories. On a
+  miss, :meth:`IntermediateCache.memoize` blocks on the computed value — a
+  cache point is a materialization boundary, exactly like the reference's
+  ``.cache()``.
+
+The cache is opt-in: nothing is memoized unless a cache is active, either via
+:func:`use_cache` / :func:`set_cache` or the environment (``KEYSTONE_CACHE=1``
+with ``KEYSTONE_CACHE_DIR`` / ``KEYSTONE_CACHE_DEVICE_MB`` /
+``KEYSTONE_CACHE_HOST_MB`` / ``KEYSTONE_CACHE_DISK_MB``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import hashlib
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.utils.logging import get_logger
+
+logger = get_logger("keystone_tpu.core.cache")
+
+# Leaves at or below this byte size are hashed on the host (strong hash of
+# the exact bytes); larger device arrays use the on-device checksum so
+# fingerprinting never forces a multi-GB device->host transfer.
+_HOST_HASH_MAX_BYTES = 1 << 20
+
+_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
+
+
+def _strip_addrs(s: str) -> str:
+    """Drop ``at 0x...`` object addresses from reprs: two processes (or two
+    constructions) of the same function/object must fingerprint alike."""
+    return _ADDR_RE.sub("", s)
+
+
+# Max bytes per checksum slice: the position iota is uint32 (64-bit ints are
+# unavailable without jax_enable_x64), so a single slice must stay well under
+# 4 GiB or positions 2³² apart would share weights. Larger arrays are
+# checksummed slice-by-slice with the slice index folded into the blake2b
+# stream, which restores positional distinction across slices.
+_CHECKSUM_SLICE_BYTES = 1 << 30
+
+
+@jax.jit
+def _u32_checksum_pair(x):
+    """Two weighted mod-2³² sums over the raw bytes of ``x`` — a 64-bit
+    content checksum computed where the data lives. Bitwise: any flipped bit
+    lands in a distinct weighted term, so distinct contents collide with
+    probability ~2⁻⁶⁴ (identification, not cryptography). Callers keep
+    ``x`` under ``_CHECKSUM_SLICE_BYTES`` so the uint32 iota never wraps."""
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    if x.ndim == 0:
+        x = x[None]
+    b = jax.lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32).ravel()
+    idx = jax.lax.iota(jnp.uint32, b.shape[0])
+    w1 = idx * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)
+    w2 = (idx ^ jnp.uint32(0x85EBCA6B)) * jnp.uint32(0xC2B2AE35) + jnp.uint32(1)
+    return jnp.sum(b * w1), jnp.sum(b * w2)
+
+
+def _update_with_leaf(h, leaf: Any) -> None:
+    if isinstance(leaf, jax.Array) and not isinstance(leaf, jax.core.Tracer):
+        h.update(f"jax:{leaf.dtype}:{leaf.shape}:".encode())
+        if leaf.nbytes <= _HOST_HASH_MAX_BYTES and leaf.is_fully_addressable:
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        else:
+            n0 = leaf.shape[0] if leaf.ndim else 1
+            row_bytes = max(1, leaf.nbytes // max(n0, 1))
+            rows = max(1, _CHECKSUM_SLICE_BYTES // row_bytes)
+            if leaf.ndim == 0 or n0 <= rows:
+                s1, s2 = _u32_checksum_pair(leaf)
+                h.update(f"{int(s1):08x}{int(s2):08x}".encode())
+            else:
+                for ci, i0 in enumerate(range(0, n0, rows)):
+                    s1, s2 = _u32_checksum_pair(leaf[i0 : i0 + rows])
+                    h.update(
+                        f"{ci}:{int(s1):08x}{int(s2):08x}".encode()
+                    )
+    elif isinstance(leaf, np.ndarray):
+        h.update(f"np:{leaf.dtype}:{leaf.shape}:".encode())
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    else:
+        h.update(_strip_addrs(repr(leaf)).encode())
+
+
+def fingerprint(tree: Any) -> str:
+    """Content fingerprint of a pytree: structure + every leaf's bytes.
+
+    Same treedef with different leaves (a re-fitted node) fingerprints
+    differently; identical content always fingerprints identically.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    h = hashlib.blake2b(digest_size=16)
+    h.update(_strip_addrs(str(treedef)).encode())
+    for leaf in leaves:
+        _update_with_leaf(h, leaf)
+    return h.hexdigest()
+
+
+_OPAQUE_MARKERS = ("<function", "<bound method", "<lambda>", " object>")
+
+
+def fingerprintable(tree: Any) -> bool:
+    """False when content fingerprinting cannot tell two distinct objects
+    apart: function/closure/default-``object`` reprs hash identically once
+    their ``at 0x...`` addresses are stripped (two different closures of the
+    same factory repr alike), so memoizing through them could alias one
+    node's cached output to another. Checks both the treedef string (static
+    aux data — e.g. a ``pytree_node=False`` callable field) and non-array
+    leaves."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    s = _strip_addrs(str(treedef))
+    if any(m in s for m in _OPAQUE_MARKERS):
+        return False
+    for leaf in leaves:
+        if not isinstance(leaf, (jax.Array, np.ndarray)):
+            r = _strip_addrs(repr(leaf))
+            if any(m in r for m in _OPAQUE_MARKERS):
+                return False
+    return True
+
+
+def has_tracers(tree: Any) -> bool:
+    """True when any leaf is a tracer — fingerprinting (and caching) must be
+    bypassed inside jit/vmap/scan traces."""
+    return any(
+        isinstance(l, jax.core.Tracer) for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def stage_key(stages, data_fp: str) -> str:
+    """Cache key for the output of running ``stages`` (a node sequence) over
+    an input whose content fingerprint is ``data_fp``. Keyed per stage so a
+    ``Chain((f, Cacher))`` called alone and the same prefix inside a longer
+    fitted chain produce the SAME key — fit-time featurization is reusable at
+    apply time through the shared ``Cacher`` boundary."""
+    h = hashlib.blake2b(digest_size=16)
+    for s in stages:
+        h.update(fingerprint(s).encode())
+    h.update(data_fp.encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Tiered store
+# ---------------------------------------------------------------------------
+
+_DEVICE, _HOST, _DISK = "device", "host", "disk"
+
+
+@dataclasses.dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    computes: int = 0
+    puts: int = 0
+    demotions: int = 0
+    promotions: int = 0
+    evictions: int = 0
+    device_hits: int = 0
+    host_hits: int = 0
+    disk_hits: int = 0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class _Entry:
+    key: str
+    tier: str
+    nbytes: int
+    cost_s: float
+    treedef: Any = None
+    leaves: Any = None  # device arrays (device tier) or numpy (host tier)
+    shardings: Any = None  # per-leaf shardings captured at put time
+    path: Optional[str] = None  # disk tier
+    last_used: int = 0
+
+    @property
+    def density(self) -> float:
+        """Recompute seconds saved per byte held — the placement score."""
+        return self.cost_s / max(self.nbytes, 1)
+
+
+def _leaf_nbytes(leaves) -> int:
+    return int(sum(getattr(l, "nbytes", 0) for l in leaves))
+
+
+class IntermediateCache:
+    """Content-addressed memo table over pipeline intermediates (see module
+    docstring). Thread-safe: concurrent memoize calls from multiple threads
+    are safe (each key computes at most the stored value)."""
+
+    def __init__(
+        self,
+        device_bytes: int = 1 << 30,
+        host_bytes: int = 4 << 30,
+        disk_bytes: int = 16 << 30,
+        cache_dir: Optional[str] = None,
+        sync_on_compute: bool = True,
+    ):
+        self.budgets = {_DEVICE: int(device_bytes), _HOST: int(host_bytes),
+                        _DISK: int(disk_bytes) if cache_dir else 0}
+        self.cache_dir = cache_dir
+        self.sync_on_compute = sync_on_compute
+        self.stats = CacheStats()
+        self._entries: Dict[str, _Entry] = {}
+        self._tier_bytes = {_DEVICE: 0, _HOST: 0, _DISK: 0}
+        self._clock = 0
+        self._lock = threading.RLock()
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+            self._index_disk()
+
+    # -- public API --------------------------------------------------------
+
+    def lookup(self, key: str) -> Tuple[bool, Any]:
+        """(hit?, value). A lower-tier hit promotes the entry toward HBM."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None and self.cache_dir:
+                e = self._adopt_disk_file(key)
+            if e is None:
+                self.stats.misses += 1
+                return False, None
+            self._clock += 1
+            e.last_used = self._clock
+            if e.tier == _DEVICE:
+                self.stats.hits += 1
+                self.stats.device_hits += 1
+                return True, jax.tree_util.tree_unflatten(e.treedef, e.leaves)
+            try:
+                value = self._load(e)
+            except Exception as exc:
+                # an unloadable entry (stale pickle after a code upgrade,
+                # corrupt file) is a MISS, never a crash: evict and recompute
+                logger.warning(
+                    "cache load of %s failed (%s: %s); treating as miss",
+                    e.key, type(exc).__name__, exc,
+                )
+                self._evict(e)
+                self.stats.misses += 1
+                return False, None
+            self.stats.hits += 1
+            if e.tier == _HOST:
+                self.stats.host_hits += 1
+            else:
+                self.stats.disk_hits += 1
+            self._promote(e, value)
+            return True, value
+
+    def put(self, key: str, value: Any, cost_s: float) -> None:
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        shardings = [getattr(l, "sharding", None) for l in leaves]
+        e = _Entry(
+            key=key, tier=_DEVICE, nbytes=_leaf_nbytes(leaves),
+            cost_s=float(cost_s), treedef=treedef, leaves=leaves,
+            shardings=shardings,
+        )
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._drop(old)
+            self._clock += 1
+            e.last_used = self._clock
+            self._entries[key] = e
+            self._tier_bytes[_DEVICE] += e.nbytes
+            self.stats.puts += 1
+            self._rebalance()
+
+    def memoize(self, key: str, compute: Callable[[], Any]) -> Any:
+        """Return the cached value for ``key``, or run ``compute`` (blocking
+        on its result — a cache point is a materialization boundary), store
+        it with the measured recompute cost, and return it."""
+        hit, value = self.lookup(key)
+        if hit:
+            return value
+        t0 = time.perf_counter()
+        value = compute()
+        if self.sync_on_compute:
+            try:
+                value = jax.block_until_ready(value)
+            except Exception:
+                pass
+        self.stats.computes += 1
+        self.put(key, value, time.perf_counter() - t0)
+        return value
+
+    def clear(self) -> None:
+        with self._lock:
+            for e in list(self._entries.values()):
+                self._drop(e)
+            self._entries.clear()
+            self._tier_bytes = {_DEVICE: 0, _HOST: 0, _DISK: 0}
+
+    # -- tier mechanics ----------------------------------------------------
+
+    def _disk_path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, f"{key}.kcache")
+
+    def _meta_path(self, key: str) -> str:
+        # recompute-cost sidecar: adoption must know the density WITHOUT
+        # loading the (possibly multi-GB) value — cost_s=0 would make every
+        # adopted entry the first eviction victim regardless of how
+        # expensive it was to compute
+        return os.path.join(self.cache_dir, f"{key}.kmeta")
+
+    def _unlink_disk(self, e: _Entry) -> None:
+        for path in (e.path, self._meta_path(e.key) if self.cache_dir else None):
+            if path:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        e.path = None
+
+    def _index_disk(self) -> None:
+        """Adopt pre-existing disk entries (cross-process reuse): metadata
+        only — values load lazily on first hit."""
+        for name in os.listdir(self.cache_dir):
+            if name.endswith(".kcache"):
+                self._adopt_disk_file(name[: -len(".kcache")])
+
+    def _adopt_disk_file(self, key: str) -> Optional[_Entry]:
+        path = self._disk_path(key)
+        if not os.path.exists(path) or key in self._entries:
+            return self._entries.get(key)
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            return None
+        cost_s = 0.0
+        try:
+            with open(self._meta_path(key)) as f:
+                cost_s = float(f.read())
+        except (OSError, ValueError):
+            pass  # pre-sidecar file or corrupt meta: density falls to 0
+        e = _Entry(key=key, tier=_DISK, nbytes=nbytes, cost_s=cost_s, path=path)
+        self._entries[key] = e
+        self._tier_bytes[_DISK] += e.nbytes
+        return e
+
+    def _load(self, e: _Entry) -> Any:
+        if e.tier == _DISK:
+            from keystone_tpu.core.checkpoint import load_node
+
+            payload = load_node(e.path)
+            e.cost_s = payload.get("cost_s", e.cost_s)
+            return payload["value"]
+        leaves = [
+            self._to_device(l, s) for l, s in zip(e.leaves, e.shardings or
+                                                  [None] * len(e.leaves))
+        ]
+        return jax.tree_util.tree_unflatten(e.treedef, leaves)
+
+    @staticmethod
+    def _to_device(leaf, sharding):
+        if not isinstance(leaf, np.ndarray):
+            return leaf
+        if sharding is not None:
+            try:
+                return jax.device_put(leaf, sharding)
+            except Exception:
+                pass  # mesh gone; fall through to default placement
+        return jnp.asarray(leaf)
+
+    def _promote(self, e: _Entry, value: Any) -> None:
+        """Move a lower-tier entry toward the device tier (it just proved
+        hot); the rebalance demotes whatever is now coldest. Skipped when
+        the value exceeds every higher tier's budget — promoting it would
+        only thrash (immediate re-demotion moving the full value back, and
+        for disk entries a pointless unlink + re-serialization)."""
+        if e.tier == _HOST:
+            if e.nbytes > self.budgets[_DEVICE]:
+                return
+            target = _DEVICE
+        else:  # _DISK
+            if e.nbytes <= self.budgets[_DEVICE]:
+                target = _DEVICE
+            elif e.nbytes <= self.budgets[_HOST]:
+                target = _HOST
+            else:
+                return
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        if target == _HOST:
+            leaves = [
+                np.asarray(l) if isinstance(l, jax.Array) else l
+                for l in leaves
+            ]
+        self._tier_bytes[e.tier] -= e.nbytes
+        if e.tier == _DISK and e.path:
+            # the bytes move to a memory tier; an orphaned .kcache file
+            # would sit outside every budget and grow the dir unboundedly
+            self._unlink_disk(e)
+        e.tier = target
+        e.treedef, e.leaves = treedef, leaves
+        e.shardings = [getattr(l, "sharding", None) for l in leaves]
+        e.nbytes = _leaf_nbytes(leaves)
+        self._tier_bytes[target] += e.nbytes
+        self.stats.promotions += 1
+        self._rebalance()
+
+    def _rebalance(self) -> None:
+        """Demote lowest-density entries until every tier fits its budget."""
+        for tier, nxt in ((_DEVICE, _HOST), (_HOST, _DISK)):
+            while self._tier_bytes[tier] > self.budgets[tier]:
+                victim = self._coldest(tier)
+                if victim is None:
+                    break
+                self._demote(victim, nxt)
+        while self._tier_bytes[_DISK] > self.budgets[_DISK]:
+            victim = self._coldest(_DISK)
+            if victim is None:
+                break
+            self._evict(victim)
+
+    def _coldest(self, tier: str) -> Optional[_Entry]:
+        pool = [e for e in self._entries.values() if e.tier == tier]
+        if not pool:
+            return None
+        return min(pool, key=lambda e: (e.density, e.last_used))
+
+    def _demote(self, e: _Entry, to_tier: str) -> None:
+        self._tier_bytes[e.tier] -= e.nbytes
+        if to_tier == _HOST and self.budgets[_HOST] > 0:
+            if e.tier == _DEVICE:
+                if any(
+                    isinstance(l, jax.Array) and not l.is_fully_addressable
+                    for l in e.leaves
+                ):
+                    # cross-process sharded value: np.asarray would raise
+                    # (this process cannot materialize the full array), so
+                    # dropping is the only safe demotion
+                    self._evict(e, already_detached=True)
+                    return
+                e.leaves = [
+                    np.asarray(l) if isinstance(l, jax.Array) else l
+                    for l in e.leaves
+                ]
+            e.tier = _HOST
+            self._tier_bytes[_HOST] += e.nbytes
+            self.stats.demotions += 1
+            return
+        if (to_tier in (_HOST, _DISK)) and self.budgets[_DISK] > 0:
+            self._write_disk(e)
+            return
+        self._evict(e, already_detached=True)
+
+    def _write_disk(self, e: _Entry) -> None:
+        from keystone_tpu.core.checkpoint import save_node
+
+        value = jax.tree_util.tree_unflatten(e.treedef, e.leaves)
+        path = self._disk_path(e.key)
+        try:
+            save_node({"value": value, "cost_s": e.cost_s}, path)
+        except Exception as exc:  # non-picklable statics etc: evict, not fail
+            logger.warning("cache disk demotion of %s failed: %s", e.key, exc)
+            self._evict(e, already_detached=True)
+            return
+        try:
+            with open(self._meta_path(e.key), "w") as f:
+                f.write(repr(e.cost_s))
+        except OSError:
+            pass  # adoption falls back to cost 0; the value is intact
+        e.tier = _DISK
+        e.path = path
+        e.leaves = e.treedef = e.shardings = None
+        e.nbytes = os.path.getsize(path)
+        self._tier_bytes[_DISK] += e.nbytes
+        self.stats.demotions += 1
+
+    def _drop(self, e: _Entry) -> None:
+        self._tier_bytes[e.tier] -= e.nbytes
+        if e.tier == _DISK:
+            self._unlink_disk(e)
+
+    def _evict(self, e: _Entry, already_detached: bool = False) -> None:
+        if not already_detached:
+            self._tier_bytes[e.tier] -= e.nbytes
+        if e.tier == _DISK:
+            self._unlink_disk(e)
+        self._entries.pop(e.key, None)
+        self.stats.evictions += 1
+
+
+# ---------------------------------------------------------------------------
+# Active-cache management
+# ---------------------------------------------------------------------------
+
+class _Unset:
+    """Sentinel: no explicit override installed — the env config governs."""
+
+
+_UNSET = _Unset()
+# Context-local (so per-thread/per-task): a use_cache(None) suppression
+# scope in one thread must not disable caching for concurrently running
+# fits in other threads, and interleaved scope exits must not restore each
+# other's state. The env cache below stays process-wide.
+_override: "contextvars.ContextVar[Any]" = contextvars.ContextVar(
+    "keystone_cache_override", default=_UNSET
+)
+_env_cache: Optional[IntermediateCache] = None
+_env_checked = False
+_lock = threading.Lock()
+
+
+def cache_from_env() -> Optional[IntermediateCache]:
+    """Build a cache from ``KEYSTONE_CACHE*`` env knobs; None when off."""
+    if os.environ.get("KEYSTONE_CACHE", "0") != "1":
+        return None
+
+    def mb(name: str, default: int) -> int:
+        return int(float(os.environ.get(name, default))) << 20
+
+    return IntermediateCache(
+        device_bytes=mb("KEYSTONE_CACHE_DEVICE_MB", 1024),
+        host_bytes=mb("KEYSTONE_CACHE_HOST_MB", 4096),
+        disk_bytes=mb("KEYSTONE_CACHE_DISK_MB", 16384),
+        cache_dir=os.environ.get("KEYSTONE_CACHE_DIR") or None,
+    )
+
+
+def get_cache() -> Optional[IntermediateCache]:
+    """The active cache, or None (caching disabled — the default).
+
+    An explicit :func:`set_cache`/:func:`use_cache` value (including None —
+    a suppression scope) wins; otherwise the ``KEYSTONE_CACHE*`` env config
+    governs. The env cache is resolved once and kept independent of
+    overrides, so a transient ``use_cache(None)`` scope never disables the
+    env-configured cache for the rest of the process."""
+    global _env_cache, _env_checked
+    override = _override.get()
+    if not isinstance(override, _Unset):
+        return override
+    if not _env_checked:
+        with _lock:
+            if not _env_checked:
+                _env_cache = cache_from_env()
+                _env_checked = True
+    return _env_cache
+
+
+def set_cache(cache):
+    """Install ``cache`` as the active cache for this context (None
+    disables caching); returns the previous setting, suitable only for
+    handing back to ``set_cache`` to restore (it may be the no-override
+    sentinel)."""
+    prev = _override.get()
+    _override.set(cache)
+    return prev
+
+
+@contextlib.contextmanager
+def use_cache(cache: Optional[IntermediateCache]):
+    """Scope an active cache: ``with use_cache(IntermediateCache(...)):``.
+    ``use_cache(None)`` is a suppression scope; on exit the previous
+    setting (explicit or env-driven) is restored."""
+    prev = set_cache(cache)
+    try:
+        yield cache
+    finally:
+        set_cache(prev)

@@ -65,6 +65,29 @@ def pad_rows(x: jax.Array, multiple: int) -> tuple[jax.Array, jax.Array]:
     return jnp.pad(x, pad), mask.astype(jnp.float32)
 
 
+def chunk_bounds(n: int, chunk: int) -> list[tuple[int, int]]:
+    """Row-range ladder ``[(0, c), (c, 2c), ..., (·, n)]`` covering n rows."""
+    return [(i0, min(i0 + chunk, n)) for i0 in range(0, n, chunk)]
+
+
+def iter_prefetched_chunks(fetch, n: int, chunk: int, depth: int = None):
+    """Yield ``((i0, i1), fetch(i0, i1))`` over the row chunks of an
+    ``n``-row source, with the NEXT chunk's fetch already dispatched
+    (``core.prefetch.prefetch_map``) while the caller consumes the current
+    one.
+
+    This is the ingest-side double buffer: ``fetch`` dispatches the
+    host→device transfer / on-device chunk generation for chunk t+1 before
+    the caller's chunk-t compute is consumed, so the async transfer rides
+    the DMA streams under the compute instead of serializing after it.
+    ``KEYSTONE_PREFETCH=0`` falls back to strictly sequential fetches."""
+    from keystone_tpu.core.prefetch import prefetch_map
+
+    bounds = chunk_bounds(n, chunk)
+    yield from zip(bounds, prefetch_map(lambda b: fetch(*b), bounds,
+                                        depth=depth))
+
+
 def pad_rows_np(x: np.ndarray, multiple: int) -> tuple[np.ndarray, np.ndarray]:
     """Host-side variant of :func:`pad_rows` (no device transfer)."""
     n = x.shape[0]

@@ -40,6 +40,7 @@ Design (idiomatic JAX, not a translation of the Spark design):
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, ClassVar, Optional, Sequence
 
 import jax
@@ -47,6 +48,26 @@ import jax.numpy as jnp
 import flax.struct as struct
 
 from keystone_tpu.core.dataset import Dataset
+
+
+def _active_cache(node: "Node", data: Any):
+    """The active intermediate cache, or None when this call must not be
+    memoized: no cache installed, tracers in flight (we are inside a jit/vmap
+    trace), or identity that fingerprinting cannot see — ``memoizable =
+    False`` stages, or any static callable / opaque-repr field anywhere in
+    the node (two distinct closures repr alike once addresses strip)."""
+    from keystone_tpu.core.cache import fingerprintable, get_cache, has_tracers
+
+    cache = get_cache()
+    if cache is None:
+        return None
+    if not node.memoizable:
+        return None
+    if has_tracers(data) or has_tracers(node):
+        return None
+    if not fingerprintable(node) or not fingerprintable(data):
+        return None
+    return cache
 
 
 @functools.partial(jax.jit, static_argnums=())
@@ -73,6 +94,11 @@ class Node(struct.PyTreeNode):
     # boundaries instead of tracing them.
     jittable: ClassVar[bool] = True
 
+    # Nodes whose identity content-fingerprinting cannot capture (state
+    # hidden in closures) set this False; the intermediate cache then never
+    # memoizes calls involving them.
+    memoizable: ClassVar[bool] = True
+
     def apply_batch(self, xs: Any) -> Any:
         """Bulk path: ``xs`` is a pytree of arrays with leading item axis."""
         raise NotImplementedError
@@ -82,9 +108,24 @@ class Node(struct.PyTreeNode):
 
         ``data`` may be a raw array/pytree (leading axis = items) or a
         :class:`Dataset`. Single-item serving goes through :meth:`apply`.
+        When an intermediate cache is active (``core.cache``), the call is
+        memoized by content: same node leaves + same input ⇒ the stored
+        output, no recompute.
         """
         if isinstance(data, Dataset):
             return data.replace(data=self(data.data))
+        # Cacher is a materialization marker, not a computation: memoizing its
+        # identity call would store a second copy of its input. Chain handles
+        # Cacher boundaries itself (prefix keys).
+        cache = None if isinstance(self, Cacher) else _active_cache(self, data)
+        if cache is not None:
+            from keystone_tpu.core.cache import fingerprint, stage_key
+
+            key = stage_key((self,), fingerprint(data))
+            return cache.memoize(key, lambda: self._call_uncached(data))
+        return self._call_uncached(data)
+
+    def _call_uncached(self, data: Any) -> Any:
         if self.jittable:
             return _jit_apply_batch(self, data)
         return self.apply_batch(data)
@@ -142,6 +183,10 @@ class Transformer(Node):
 class LambdaTransformer(Transformer):
     fn: Callable[[Any], Any] = struct.field(pytree_node=False)
     name: str = struct.field(pytree_node=False, default="fn")
+
+    # a closure's captured state is invisible to content fingerprinting, so
+    # two different from_fn nodes could collide on a cache key — never memoize
+    memoizable: ClassVar[bool] = False
 
     def apply(self, x):
         return self.fn(x)
@@ -224,18 +269,84 @@ class Chain(Transformer):
             xs = s.apply_batch(xs)
         return xs
 
+    @property
+    def memoizable(self) -> bool:  # type: ignore[override]
+        return all(s.memoizable for s in self.stages)
+
     def __call__(self, data: Any) -> Any:
+        if isinstance(data, Dataset):
+            return data.replace(data=self(data.data))
+        cache = _active_cache(self, data)
+        if cache is None:
+            return self._run_stages(data)
+        # Content-addressed memoization (core/cache.py). Keys are per-stage-
+        # prefix, so the whole-chain key and every ``Cacher`` boundary's
+        # prefix key are independently reusable: a fit-time featurization
+        # chained through ``featurizer >> Cacher()`` is a cache hit when the
+        # fitted pipeline later applies to the same data — the KeystoneML
+        # ``.cache()`` reuse, content-addressed instead of lineage-addressed.
+        from keystone_tpu.core.cache import fingerprint, stage_key
+
+        input_fp = fingerprint(data)
+        whole_key = stage_key(self.stages, input_fp)
+        hit, val = cache.lookup(whole_key)
+        if hit:
+            return val
+        # resume from the deepest Cacher boundary whose prefix is cached; a
+        # terminal Cacher's prefix key IS the whole-chain key that just
+        # missed, so it is excluded (re-looking it up would double-count
+        # the miss and re-fingerprint every stage for nothing)
+        start, cur = 0, data
+        cuts = [
+            i
+            for i, s in enumerate(self.stages)
+            if isinstance(s, Cacher) and i < len(self.stages) - 1
+        ]
+        for i in reversed(cuts):
+            hit, val = cache.lookup(stage_key(self.stages[: i + 1], input_fp))
+            if hit:
+                start, cur = i + 1, val
+                break
+        t0 = time.perf_counter()
+
+        def on_boundary(idx: int, value: Any) -> None:
+            value = jax.block_until_ready(value)
+            cache.put(
+                stage_key(self.stages[: idx + 1], input_fp),
+                value, time.perf_counter() - t0,
+            )
+
+        out = self._run_stages(cur, start=start, on_boundary=on_boundary)
+        if cache.sync_on_compute:
+            out = jax.block_until_ready(out)
+        cache.stats.computes += 1
+        cache.put(whole_key, out, time.perf_counter() - t0)
+        return out
+
+    def _run_stages(self, data: Any, start: int = 0, on_boundary=None) -> Any:
         # Split into maximal jittable segments; Cacher / host nodes run
         # between segments and act as materialization boundaries.
         segment: list = []
-        for s in self.stages:
+        for idx in range(start, len(self.stages)):
+            s = self.stages[idx]
             if s.jittable:
                 segment.append(s)
                 continue
             if segment:
                 data = _run_segment(segment, data)
                 segment = []
-            data = s(data)
+            # _call_uncached, not __call__: the chain's own whole/prefix keys
+            # already cover this output — a node-level memo here would store
+            # the same bytes twice under a second key
+            data = s._call_uncached(data)
+            # terminal Cacher excluded: its prefix key IS the whole-chain
+            # key, which the caller puts once after this returns
+            if (
+                on_boundary is not None
+                and isinstance(s, Cacher)
+                and idx < len(self.stages) - 1
+            ):
+                on_boundary(idx, data)
         if segment:
             data = _run_segment(segment, data)
         return data
@@ -246,7 +357,10 @@ class Chain(Transformer):
                 raise TypeError(
                     f"chain stage {type(s).__name__} has no single-item path"
                 )
-        if all(s.jittable for s in self.stages):
+        # Cacher is a bulk-path materialization marker; in the single-item
+        # serving program it is the identity, so it must not break the
+        # chain into eager per-stage dispatches
+        if all(s.jittable or isinstance(s, Cacher) for s in self.stages):
             return _jit_apply(self, x)
         return self.apply(x)
 

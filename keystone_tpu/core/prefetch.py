@@ -1,0 +1,98 @@
+"""Dispatch-ahead double buffering for block producers.
+
+The block solvers consume a sequence of expensive blocks — featurized column
+blocks, host→device chunk transfers — produced by calls that *dispatch* work
+(jitted featurization, ``jax.device_put`` onto the mesh) and return
+asynchronously. :func:`prefetch_map` runs the producer up to ``depth`` items
+ahead of consumption **on the calling thread**: block *t+1*'s featurization /
+transfer is already enqueued on the device streams while the consumer's ops
+for block *t* execute, so JAX's async dispatch overlaps the movement with the
+compute.
+
+Why no worker thread: JAX programs that span multiple devices (sharded
+featurization, mesh transfers) are enqueued per-device; two threads
+dispatching such programs concurrently can enqueue them in *different orders
+on different devices*, and the first collective then deadlocks — observed as
+a permanent hang in the solver's eager ops on multi-device CPU meshes, and
+the same inversion exists on real TPU streams. Single-threaded dispatch-ahead
+keeps one global enqueue order (deadlock-free by construction) while still
+getting the overlap, because dispatch returns before the work completes. The
+price is that *host-side* producer work (numpy slicing) is not overlapped —
+it runs ahead of need, but on this thread.
+
+Ordering and effects: ALL producer calls run in sequence order on the one
+calling thread, so producers with internal state (the one-slot group cache of
+``grouped_block_getter``) stay single-threaded and ordered. The optional
+``gate(prev_item, next_item)`` predicate blocks run-ahead across boundaries
+where it would violate a memory budget — e.g. featurizing the next *cache
+group* while the previous group's buffer is still live would hold two
+multi-GB group buffers at once, so the group-aware call sites gate on group
+equality.
+
+``KEYSTONE_PREFETCH`` (default ``1``) is the global kill switch / depth:
+``0`` disables (strictly sequential, bit-identical results either way),
+``N>1`` runs N blocks ahead.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+
+def prefetch_depth(default: int = 1) -> int:
+    """Effective prefetch depth from ``KEYSTONE_PREFETCH`` (see module doc)."""
+    try:
+        return max(0, int(os.environ.get("KEYSTONE_PREFETCH", default)))
+    except ValueError:
+        return default
+
+
+def prefetch_map(
+    fn: Callable[[Any], Any],
+    items: Iterable[Any],
+    depth: Optional[int] = None,
+    gate: Optional[Callable[[Any, Any], bool]] = None,
+) -> Iterator[Any]:
+    """Yield ``fn(item)`` for each item, producing up to ``depth`` items
+    ahead of consumption on the calling thread (results come back in order;
+    an exception in ``fn`` surfaces at the corresponding yield). ``gate(prev,
+    nxt)`` returning False defers ``fn(nxt)`` until ``prev``'s result has
+    been yielded."""
+    items = list(items)
+    if depth is None:
+        depth = prefetch_depth()
+    if depth <= 0 or len(items) <= 1:
+        for item in items:
+            yield fn(item)
+        return
+    # j -> ("ok", value) | ("err", exc): run-ahead production must not raise
+    # at the wrong sequence position, so errors are stored and re-raised at
+    # their own yield
+    produced: dict = {}
+
+    def produce(j: int) -> None:
+        if j not in produced:
+            try:
+                produced[j] = ("ok", fn(items[j]))
+            except BaseException as exc:  # re-raised at yield j
+                produced[j] = ("err", exc)
+
+    for i in range(len(items)):
+        produce(i)  # production order == sequence order, always
+        if produced[i][0] == "ok":
+            # run ahead, but never PAST an error: a failed producer call
+            # means the sequence is about to abort (or be retried from a
+            # checkpoint) — producing beyond it would waste exactly the
+            # work an elastic resume is trying to preserve
+            for j in range(i + 1, min(i + 1 + depth, len(items))):
+                if j not in produced:
+                    if gate is not None and not gate(items[j - 1], items[j]):
+                        break
+                    produce(j)
+                if produced[j][0] == "err":
+                    break
+        tag, val = produced.pop(i)
+        if tag == "err":
+            raise val
+        yield val

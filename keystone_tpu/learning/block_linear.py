@@ -86,7 +86,9 @@ def _block_contrib(xs, w, start, stop):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
+@functools.partial(
+    jax.jit, static_argnames=("precision",), donate_argnums=(2,)
+)
 def _streaming_block_step_first(feat_node, raw, R, lam, mask, precision: str):
     """First pass over a block: derive the (masked) feature mean from the same
     featurization used for the solve — no separate mean pass. Returns the
@@ -109,7 +111,9 @@ def _streaming_block_step_first(feat_node, raw, R, lam, mask, precision: str):
     return fmean, Wk, R, gram
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
+@functools.partial(
+    jax.jit, static_argnames=("precision",), donate_argnums=(2,)
+)
 def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean, precision: str):
     from keystone_tpu.linalg.solvers import hdot, spd_solve
 
@@ -124,7 +128,9 @@ def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean, precision: st
     return Wk_new, R
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
+@functools.partial(
+    jax.jit, static_argnames=("precision",), donate_argnums=(2,)
+)
 def _streaming_block_step_cached(feat_node, raw, R, Wk, lam, mask, fmean, gram, precision: str):
     """Later-pass block step with the pass-0 gram: only the n×b×c cross terms
     and the b³-class solve remain — ~4× cheaper than re-doing the 2·n·b² gram
@@ -235,9 +241,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
     def fit(self, data, labels, mask: Optional[jax.Array] = None) -> BlockLinearMapper:
         A, B, feature_scaler, label_scaler, mask = center_for_solve(data, labels, mask)
+        # A/B are centered temporaries this frame alone owns — donate them
+        # so the solver's residual/gram intermediates reuse their HBM
+        # instead of allocating a second (n, d) + (n, c) next to them
         w = block_coordinate_descent_l2(
             A, B, self.lam, self.block_size, self.num_iter, mask=mask,
-            cache_grams=self.cache_grams,
+            cache_grams=self.cache_grams, donate=True,
         )
         return BlockLinearMapper(
             w=w,
@@ -466,14 +475,31 @@ def streaming_apply_and_evaluate(
     contribution, hand the running prediction to ``evaluator``
     (``BlockLinearMapper.scala:104-137``). ``feature_means=None`` models
     (the weighted solver's) skip centering. Cache-grouped nodes (see
-    :func:`grouped_block_getter`) share their group featurization."""
+    :func:`grouped_block_getter`) share their group featurization.
+
+    Block featurizations are double-buffered (:func:`prefetch_map`): block
+    k+1's featurization dispatches while the device multiplies block k,
+    gated at cache-group boundaries so the one-slot group-buffer budget
+    holds. ``KEYSTONE_PREFETCH=0`` restores the strictly sequential path
+    (bit-identical output either way)."""
+    from keystone_tpu.core.prefetch import prefetch_map
+
     bs = model.block_size
     get_block, clear = grouped_block_getter(feature_nodes, raw, cache_dtype)
+
+    def gate(prev_k: int, next_k: int) -> bool:
+        gp = getattr(feature_nodes[prev_k], "cache_group", None)
+        gn = getattr(feature_nodes[next_k], "cache_group", None)
+        return gp is None or gn is None or gp == gn
+
+    if model.feature_means is None:
+        block_feed = prefetch_map(get_block, range(len(feature_nodes)),
+                                  gate=gate)
     partial = None
     for k, node in enumerate(feature_nodes):
         wk = model.w[k * bs : (k + 1) * bs]
         if model.feature_means is None:
-            contrib = jnp.asarray(get_block(k), jnp.float32) @ wk
+            contrib = jnp.asarray(next(block_feed), jnp.float32) @ wk
         else:
             fm = model.feature_means[k * bs : (k + 1) * bs]
             contrib = _streaming_contrib(node, raw, wk, fm)
@@ -490,11 +516,48 @@ def streaming_predict(
 ) -> jax.Array:
     """Final predictions via :func:`streaming_apply_and_evaluate` (one shared
     accumulation loop) — the out-of-core apply path for models whose feature
-    matrix exceeds HBM (``BlockLinearMapper.scala:47-74``)."""
-    out: list = []
+    matrix exceeds HBM (``BlockLinearMapper.scala:47-74``).
 
-    def capture(p):
-        out[:] = [p]
+    When an intermediate cache is active (``core.cache``), the whole predict
+    is memoized by content — (model, nodes, raw) fingerprints — so a warm
+    predict over the same inputs returns the stored scores with ZERO
+    re-featurization (the flagship's ``eval.predict`` re-featurizes the
+    test set from raw descriptors on every call otherwise)."""
+    from keystone_tpu.core.cache import (
+        fingerprint,
+        fingerprintable,
+        get_cache,
+        has_tracers,
+    )
 
-    streaming_apply_and_evaluate(model, feature_nodes, raw, capture, cache_dtype)
-    return out[0]
+    def compute():
+        out: list = []
+
+        def capture(p):
+            out[:] = [p]
+
+        streaming_apply_and_evaluate(
+            model, feature_nodes, raw, capture, cache_dtype
+        )
+        return out[0]
+
+    cache = get_cache()
+    if (
+        cache is None
+        or has_tracers((model, raw))
+        or any(has_tracers(n) for n in feature_nodes)
+        # closure-bearing nodes (memoizable=False) and non-Node objects
+        # fingerprint by repr with addresses stripped — two different
+        # closures/instances of the same class would collide on a key, so
+        # never memoize through them
+        or not all(getattr(n, "memoizable", False) for n in feature_nodes)
+        or not fingerprintable((model, feature_nodes, raw))
+    ):
+        return compute()
+    # one keying convention (cache.fingerprint) for the whole cache layer:
+    # the label string namespaces this memo away from chain/stage keys
+    key = fingerprint(
+        ("streaming_predict", model, tuple(feature_nodes), raw,
+         repr(cache_dtype))
+    )
+    return cache.memoize(key, compute)

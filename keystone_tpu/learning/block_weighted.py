@@ -360,8 +360,14 @@ def _bucketed_class_solves(
     return jnp.concatenate(parts, axis=1)[:, inv_perm]
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
+@functools.partial(
+    jax.jit, static_argnames=("precision",), donate_argnums=(0,)
+)
 def _apply_update(R, Xb, dW, valid, precision: str):
+    """Residual update, with ``R`` donated: the output aliases the input's
+    (n, C) buffer, so the async dispatch queue (now fed a block ahead by the
+    dispatch-ahead prefetch) never pins two copies of the flagship's ~1.3 GB
+    residual per in-flight update."""
     return R - hdot(Xb.astype(jnp.float32) * valid[:, None], dW, precision)
 
 
@@ -448,10 +454,22 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
     def _run(self, get_block, num_blocks: int, labels, mask, precision: str,
              checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
-             _force_dense: bool = False):
+             block_group=None, _force_dense: bool = False):
         """Shared weighted-BCD loop. ``get_block(b)`` returns the
         (n, block_size) feature block in original row order — no global
         class sort exists anywhere (see ``_prepare``).
+
+        Blocks are consumed through a double-buffered prefetch
+        (``core.prefetch.prefetch_map``): while the device chews on block
+        *t*'s pop stats / class solves, block *t+1*'s featurization is
+        already dispatched ahead of need (single-threaded dispatch-ahead —
+        a worker thread would race device enqueue order and deadlock
+        multi-device meshes; see ``core/prefetch.py``). ``block_group(b)``
+        (optional) names block *b*'s featurization cache group
+        (``grouped_block_getter``); prefetch never runs ahead across a
+        group boundary — that would hold two multi-GB group buffers at
+        once. ``KEYSTONE_PREFETCH=0`` disables (bit-identical results
+        either way — the producer only featurizes, order is preserved).
 
         ``checkpoint_path`` + ``checkpoint_every > 0``: every N completed
         blocks the loop state (residual, per-block models/joint-means, the
@@ -541,7 +559,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 # blocks on top of dense ones
                 return self._run(
                     get_block, num_blocks, labels, mask, precision,
-                    checkpoint_path, checkpoint_every, _force_dense=True,
+                    checkpoint_path, checkpoint_every,
+                    block_group=block_group, _force_dense=True,
                 )
             # restore the guard's evidence for already-completed blocks —
             # without this a resumed fit under-reports max cond and the
@@ -626,65 +645,85 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             def _phase(tag):
                 return contextlib.nullcontext()
 
-        for it in range(self.num_iter):
-            for b in range(num_blocks):
-                if (it, b) < (start_iter, start_block):
-                    continue
-                with _phase("featurize"):
-                    Xb = get_block(b)
-                if pop_stats_cache[b] is None:
-                    with _phase("pop_stats"):
-                        pop_mean, pop_cov, pop_xtr = _pop_stats(
-                            Xb, R, valid, n_eff, precision=precision
-                        )
-                    # base inverse depends only on pop_cov/λ/w: once per
-                    # block, cached with the pop stats across iterations
-                    if need_binv:
-                        with _phase("base_inverse"):
-                            base_inv, cond_est = _base_inverse(
-                                pop_cov, lam, w, precision
-                            )
-                        # one cond estimate per BLOCK: with cache_stats=False
-                        # and num_iter > 1 this branch re-runs every pass over
-                        # the same pop_cov/λ/w, and re-appending would grow
-                        # the checkpointed evidence list each iteration
-                        if it == 0:
-                            binv_conds.append(cond_est)
-                    else:
-                        base_inv = None
-                    # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)
-                    class_sums = _class_sums(Xb, class_idx, num_classes)
-                    class_means = class_sums / jnp.maximum(
-                        counts[:, None].astype(jnp.float32), 1.0
-                    )
-                    joint_means_b = w * class_means + (1.0 - w) * pop_mean
-                    joint_means_blocks[b] = joint_means_b
-                    if self.cache_stats and self.num_iter > 1:
-                        pop_stats_cache[b] = (pop_mean, pop_cov, base_inv)
-                else:
-                    pop_mean, pop_cov, base_inv = pop_stats_cache[b]
-                    joint_means_b = joint_means_blocks[b]
-                    pop_xtr = hdot(
-                        (Xb.astype(jnp.float32) * valid[:, None]).T, R, precision
-                    ) / n_eff
+        # Double-buffered block feed: the producer (featurize / slice) is
+        # dispatched one step ahead, gated so it never crosses a
+        # featurization cache-group boundary (two live group buffers would
+        # blow the one-slot HBM budget grouped_block_getter guarantees).
+        # With prefetch the "featurize" phase timer measures WAIT for the
+        # block, not its compute — attribution moves into the overlap.
+        from keystone_tpu.core.prefetch import prefetch_map
 
-                with _phase("class_solves"):
-                    dW = _bucketed_class_solves(
-                        Xb, R, counts, pop_cov, pop_mean, pop_xtr,
-                        joint_means_b, residual_mean, models[b], lam, w,
-                        buckets, inv_perm, base_inv, precision=precision,
-                        policy=policy,
+        schedule = [
+            (it, b)
+            for it in range(self.num_iter)
+            for b in range(num_blocks)
+            if (it, b) >= (start_iter, start_block)
+        ]
+        gate = None
+        if block_group is not None:
+            def gate(prev_ib, next_ib):
+                gp, gn = block_group(prev_ib[1]), block_group(next_ib[1])
+                return gp is None or gn is None or gp == gn
+
+        block_feed = prefetch_map(
+            lambda ib: get_block(ib[1]), schedule, gate=gate
+        )
+        for it, b in schedule:
+            with _phase("featurize"):
+                Xb = next(block_feed)
+            if pop_stats_cache[b] is None:
+                with _phase("pop_stats"):
+                    pop_mean, pop_cov, pop_xtr = _pop_stats(
+                        Xb, R, valid, n_eff, precision=precision
                     )
-                models[b] = models[b] + dW
-                with _phase("residual_update"):
-                    R = _apply_update(R, Xb, dW, valid, precision=precision)
-                    _, residual_mean = _class_col_means(R, class_idx, counts)
-                if (
-                    checkpoint_path
-                    and checkpoint_every > 0
-                    and (it * num_blocks + b + 1) % checkpoint_every == 0
-                ):
-                    _save_checkpoint(it, b + 1)
+                # base inverse depends only on pop_cov/λ/w: once per
+                # block, cached with the pop stats across iterations
+                if need_binv:
+                    with _phase("base_inverse"):
+                        base_inv, cond_est = _base_inverse(
+                            pop_cov, lam, w, precision
+                        )
+                    # one cond estimate per BLOCK: with cache_stats=False
+                    # and num_iter > 1 this branch re-runs every pass over
+                    # the same pop_cov/λ/w, and re-appending would grow
+                    # the checkpointed evidence list each iteration
+                    if it == 0:
+                        binv_conds.append(cond_est)
+                else:
+                    base_inv = None
+                # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)
+                class_sums = _class_sums(Xb, class_idx, num_classes)
+                class_means = class_sums / jnp.maximum(
+                    counts[:, None].astype(jnp.float32), 1.0
+                )
+                joint_means_b = w * class_means + (1.0 - w) * pop_mean
+                joint_means_blocks[b] = joint_means_b
+                if self.cache_stats and self.num_iter > 1:
+                    pop_stats_cache[b] = (pop_mean, pop_cov, base_inv)
+            else:
+                pop_mean, pop_cov, base_inv = pop_stats_cache[b]
+                joint_means_b = joint_means_blocks[b]
+                pop_xtr = hdot(
+                    (Xb.astype(jnp.float32) * valid[:, None]).T, R, precision
+                ) / n_eff
+
+            with _phase("class_solves"):
+                dW = _bucketed_class_solves(
+                    Xb, R, counts, pop_cov, pop_mean, pop_xtr,
+                    joint_means_b, residual_mean, models[b], lam, w,
+                    buckets, inv_perm, base_inv, precision=precision,
+                    policy=policy,
+                )
+            models[b] = models[b] + dW
+            with _phase("residual_update"):
+                R = _apply_update(R, Xb, dW, valid, precision=precision)
+                _, residual_mean = _class_col_means(R, class_idx, counts)
+            if (
+                checkpoint_path
+                and checkpoint_every > 0
+                and (it * num_blocks + b + 1) % checkpoint_every == 0
+            ):
+                _save_checkpoint(it, b + 1)
 
         if (
             checkpoint_path
@@ -725,7 +764,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     )
                     return self._run(
                         get_block, num_blocks, labels, mask, precision,
-                        checkpoint_path, checkpoint_every, _force_dense=True,
+                        checkpoint_path, checkpoint_every,
+                        block_group=block_group, _force_dense=True,
                     )
 
         W = jnp.concatenate(models, axis=0)
@@ -830,6 +870,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         W, joint_means, joint_label_mean = self._run(
             get_block, num_blocks, labels, mask, precision,
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+            # prefetch gate: running ahead across a cache-group boundary
+            # would featurize the next group while the previous group's
+            # buffer is still live (two multi-GB buffers in the one-slot
+            # budget) — _run's block feed stalls at group edges instead
+            block_group=lambda b: getattr(
+                feature_nodes[b], "cache_group", None
+            ),
         )
         clear_cache()
         final_b = joint_label_mean - jnp.einsum("cd,dc->c", joint_means, W)

@@ -44,24 +44,43 @@ def block_coordinate_descent_l2(
     mask: Optional[jax.Array] = None,
     cache_grams: bool = True,
     precision: Optional[str] = None,
+    donate: bool = False,
 ) -> jax.Array:
     """Public entry: resolves the solver precision once (a static jit arg,
-    so changing the global never serves a stale compile) and dispatches."""
+    so changing the global never serves a stale compile) and dispatches.
+
+    ``donate=True`` donates ``A`` and ``b`` to the solve: callers passing
+    temporaries they will never read again (the estimators' centered
+    copies) let XLA reuse those buffers for the scan's residual and
+    per-block intermediates instead of allocating fresh HBM next to them —
+    at TIMIT scale the centered (n, d) copy alone is multi-GB. A donated
+    array is DEAD after the call (jax raises on reuse); never set it for
+    arrays the caller still owns."""
     from keystone_tpu.linalg.solvers import validate_precision
 
     if precision is not None:
         validate_precision(precision)
+    precision = precision or get_solver_precision()
+    if donate:
+        # the outputs (d, c) can never alias the (n, ·) inputs, so jax warns
+        # that donation found no output alias — expected: the donation here
+        # transfers buffer ownership so the runtime frees A/b at their last
+        # read inside the scan instead of pinning them to the call boundary
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return _bcd_l2_donated(
+                A, b, lam, block_size, num_iter, mask, cache_grams, precision
+            )
     return _bcd_l2(
-        A, b, lam, block_size, num_iter, mask, cache_grams,
-        precision or get_solver_precision(),
+        A, b, lam, block_size, num_iter, mask, cache_grams, precision
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("block_size", "num_iter", "cache_grams", "precision"),
-)
-def _bcd_l2(
+def _bcd_l2_impl(
     A: jax.Array,
     b: jax.Array,
     lam: float,
@@ -128,3 +147,12 @@ def _bcd_l2(
     schedule = jnp.tile(jnp.arange(num_blocks), num_iter)
     (W, _), _ = jax.lax.scan(block_step, (W0, b), schedule)
     return W[:d]
+
+
+_BCD_STATICS = ("block_size", "num_iter", "cache_grams", "precision")
+_bcd_l2 = functools.partial(jax.jit, static_argnames=_BCD_STATICS)(_bcd_l2_impl)
+# Donated variant: b's buffer aliases the scanned residual, A's is freed for
+# the per-block gram/cross intermediates once consumed (entry docstring).
+_bcd_l2_donated = functools.partial(
+    jax.jit, static_argnames=_BCD_STATICS, donate_argnums=(0, 1)
+)(_bcd_l2_impl)

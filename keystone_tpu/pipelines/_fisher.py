@@ -70,6 +70,18 @@ def fit_fisher_branch(
     posteriors alone are ~6.6 GB). The returned featurizer chain carries the
     same chunking for the eval pass.
     """
+    from keystone_tpu.core.cache import fingerprintable, get_cache
+    from keystone_tpu.core.pipeline import Cacher
+
+    def _memoizes(*nodes) -> bool:
+        # mirror Chain.__call__'s own gate: a chain with a non-memoizable
+        # or unfingerprintable stage silently skips memoization, and the
+        # prefix path would then RE-RUN the earlier stages it was supposed
+        # to hit — strictly worse than the bare node calls
+        return all(
+            getattr(n, "memoizable", False) for n in nodes
+        ) and fingerprintable(nodes)
+
     stages = [extractor]
     if hellinger_first:
         stages.append(BatchSignedHellingerMapper())
@@ -77,8 +89,21 @@ def fit_fisher_branch(
     if row_chunks > 1:
         desc_node = ChunkedMap(node=desc_node, num_chunks=row_chunks)
 
+    # With an intermediate cache active, fit-time featurization runs through
+    # the growing ``... >> Cacher()`` chain prefixes instead of bare node
+    # calls: every prefix lands in the cache under the SAME keys the fitted
+    # featurizer chain looks up, so applying the fitted pipeline to the
+    # train images (or re-fitting on identical data) recomputes NOTHING —
+    # KeystoneML's ``.cache()`` reuse, content-addressed. Without a cache
+    # the chain prefixes would re-run earlier stages, so the bare node
+    # calls are kept (identical results either way).
+    cached_run = get_cache() is not None and _memoizes(desc_node)
+
     with Timer("fisher.extract_descriptors"):
-        descs = desc_node(train_images)  # (n, n_desc, d)
+        if cached_run:
+            descs = chain(desc_node, Cacher())(train_images)
+        else:
+            descs = desc_node(train_images)  # (n, n_desc, d)
 
     if pca_file:
         pca_mat = jnp.asarray(np.loadtxt(pca_file, delimiter=","), jnp.float32)
@@ -89,7 +114,11 @@ def fit_fisher_branch(
             pca = PCAEstimator(pca_dims).fit_batch(sample)
 
     with Timer("fisher.apply_pca"):
-        reduced = pca(descs)  # (n, n_desc, pca_dims)
+        if cached_run and _memoizes(desc_node, pca):
+            # prefix hit at the first Cacher -> only the PCA matmul runs
+            reduced = chain(desc_node, Cacher(), pca, Cacher())(train_images)
+        else:
+            reduced = pca(descs)  # (n, n_desc, pca_dims)
 
     if gmm_files:
         gmm = GaussianMixtureModel.load(*gmm_files)
@@ -103,10 +132,14 @@ def fit_fisher_branch(
     fisher: Transformer = fisher_featurizer(gmm)
     if row_chunks > 1:
         fisher = ChunkedMap(node=fisher, num_chunks=row_chunks)
+    featurizer = chain(desc_node, Cacher(), pca, Cacher(), fisher)
     with Timer("fisher.encode"):
-        features = fisher(reduced)  # (n, pca_dims * 2 * vocab_size)
-
-    featurizer = chain(desc_node, pca, fisher)
+        if cached_run and _memoizes(desc_node, pca, fisher):
+            # prefix hit at the second Cacher -> only the FV encode runs,
+            # and the fitted featurizer's whole-chain key is now stored
+            features = featurizer(train_images)
+        else:
+            features = fisher(reduced)  # (n, pca_dims * 2 * vocab_size)
     logger.info(
         "fisher branch: descriptors %s -> features %s", descs.shape, features.shape
     )

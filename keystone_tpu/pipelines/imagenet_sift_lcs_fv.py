@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -300,11 +301,24 @@ def _run_streaming_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
                 sd = jnp.zeros((0, nd_s, d_s), jnp.float32)
                 ld = jnp.zeros((0, nd_l, d_l), jnp.float32)
             else:
+                from keystone_tpu.core.cache import use_cache as _use_cache
+                from keystone_tpu.core.dataset import iter_prefetched_chunks
+
                 sd_parts, ld_parts = [], []
-                for i0 in range(0, imgs.shape[0], config.extract_chunk):
-                    part = jnp.asarray(imgs[i0 : i0 + config.extract_chunk])
-                    sd_parts.append(hellinger(sift(GrayScaler()(part)[..., 0])))
-                    ld_parts.append(lcs(part))
+                # chunk t+1's host->device transfer is dispatched ahead
+                # while chunk t extracts; the intermediate cache is
+                # suppressed per chunk — the descriptors stay resident in
+                # this function's own tensors, a cache copy would double
+                # them
+                for _, part in iter_prefetched_chunks(
+                    lambda a, b: jnp.asarray(imgs[a:b]),
+                    imgs.shape[0], config.extract_chunk,
+                ):
+                    with _use_cache(None):
+                        sd_parts.append(
+                            hellinger(sift(GrayScaler()(part)[..., 0]))
+                        )
+                        ld_parts.append(lcs(part))
                 sd = jnp.concatenate(sd_parts) if len(sd_parts) > 1 else sd_parts[0]
                 ld = jnp.concatenate(ld_parts) if len(ld_parts) > 1 else ld_parts[0]
             out.append((hw, sd, ld, labels))
@@ -486,10 +500,25 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
         # re-generates/transfers — the sample images.
         desc_cache: dict = {}
         s_parts, l_parts, lbl_parts = [], [], []
-        for i0 in range(0, n_sample, chunk):
-            i1 = min(i0 + chunk, train_src.n)
-            imgs, lbls = train_src.chunk(i0, i1)
-            sd, ld = sift_descs(imgs), lcs_descs(imgs)
+        from keystone_tpu.core.prefetch import prefetch_map
+
+        sample_bounds = [
+            (i0, min(i0 + chunk, train_src.n))
+            for i0 in range(0, n_sample, chunk)
+        ]
+        # chunk t+1's host→device transfer / generation dispatch overlaps
+        # chunk t's extraction (the same double buffer as reduce_split)
+        chunk_feed = prefetch_map(
+            lambda b: train_src.chunk(*b), sample_bounds
+        )
+        from keystone_tpu.core.cache import use_cache as _use_cache
+
+        for (i0, i1), (imgs, lbls) in zip(sample_bounds, chunk_feed):
+            # desc_cache below is the pipeline's own memo for these chunks;
+            # letting the intermediate cache store them TOO would hold a
+            # second multi-GB copy of every sample chunk
+            with _use_cache(None):
+                sd, ld = sift_descs(imgs), lcs_descs(imgs)
             desc_cache[(i0, i1)] = (sd, ld, lbls)
             s_parts.append(sd)
             l_parts.append(ld)
@@ -616,19 +645,37 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
 
         def reduce_split(src, use_cache: bool = False):
             """One pass over ``src``: descriptors → PCA → ``dtype`` buffers;
-            returns (raw pytree for the FV block nodes, int labels)."""
+            returns (raw pytree for the FV block nodes, int labels).
+
+            Chunk acquisition is double-buffered (``iter_prefetched_chunks``):
+            chunk t+1's host slice / host→device transfer / on-device
+            generation is dispatched ahead of need while the device
+            extracts chunk t. The producer only FETCHES — desc_cache pops
+            stay in the consuming loop, so the pass-A memo is read during
+            run-ahead and popped at consumption without a race."""
+            from keystone_tpu.core.dataset import iter_prefetched_chunks
+
+            def fetch(i0, i1):
+                # cached chunks skip the fetch entirely (None marker);
+                # run-ahead must not pop — membership of FUTURE keys is
+                # stable because pops happen at consumption, in order
+                if use_cache and (i0, i1) in desc_cache:
+                    return None
+                return src.chunk(i0, i1)
+
             red_s = red_l = None
             lbl_parts = []
             with Timer("streaming.reduce.extract_chunks", log=False):
-                for i0 in range(0, src.n, chunk):
-                    i1 = min(i0 + chunk, src.n)
-                    if use_cache and (i0, i1) in desc_cache:
+                for (i0, i1), fetched in iter_prefetched_chunks(
+                    fetch, src.n, chunk
+                ):
+                    if fetched is None:
                         sd, ld, lbls = desc_cache.pop((i0, i1))
                         ps, pl = _reduce_cached(
                             sd, ld, pca_s.pca_mat, pca_l.pca_mat
                         )
                     else:
-                        imgs, lbls = src.chunk(i0, i1)
+                        imgs, lbls = fetched
                         ps, pl = _reduce_chunk(
                             imgs, pca_s.pca_mat, pca_l.pca_mat
                         )
@@ -716,10 +763,42 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
                 eval_nodes = make_nodes(
                     eval_cache(blocks_s), eval_cache(blocks_l)
                 )
+            from keystone_tpu.core.cache import get_cache as _get_cache
+
             with Timer("eval.predict"):
-                scores = streaming_predict(
-                    model, eval_nodes, raw_test, cache_dtype
-                )
+                if (
+                    _get_cache() is not None
+                    and os.environ.get("KEYSTONE_EVAL_CACHED_TIMING") == "1"
+                ):
+                    # cached-vs-cold predict evidence (bench rows ONLY —
+                    # the env flag keeps ordinary cache-enabled runs from
+                    # paying a second predict): the first call computes +
+                    # memoizes the whole predict, the second returns the
+                    # stored scores with zero re-featurization. Explicit
+                    # syncs bound each number to its own work (the async
+                    # headline row never takes this branch — no cache is
+                    # active there).
+                    import time as _time
+
+                    model = jax.block_until_ready(model)
+                    t0 = _time.perf_counter()
+                    scores = jax.block_until_ready(streaming_predict(
+                        model, eval_nodes, raw_test, cache_dtype
+                    ))
+                    results["predict_cold_s"] = round(
+                        _time.perf_counter() - t0, 3
+                    )
+                    t0 = _time.perf_counter()
+                    scores = jax.block_until_ready(streaming_predict(
+                        model, eval_nodes, raw_test, cache_dtype
+                    ))
+                    results["predict_cached_s"] = round(
+                        _time.perf_counter() - t0, 3
+                    )
+                else:
+                    scores = streaming_predict(
+                        model, eval_nodes, raw_test, cache_dtype
+                    )
             top5 = TopKClassifier(k=min(5, num_classes))(scores)
             results["test_top5_error"] = get_err_percent(top5, test_labels)
             top1 = TopKClassifier(k=1)(scores)
@@ -881,8 +960,11 @@ def _run_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
 
 
 def run(config: ImageNetSiftLcsFVConfig) -> dict:
+    # unconditional: gmm_backend/gmm_ensemble misconfigurations must fail
+    # loudly on EVERY path — the in-core and plain-streaming paths used to
+    # silently ignore them (ADVICE.md round 5)
+    config.validate()
     if config.buckets:
-        config.validate()  # bucketed ingest is the real-archive path only
         if config.streaming:
             return _run_streaming_bucketed(config)
         return _run_bucketed(config)

@@ -50,7 +50,65 @@ def _flagship() -> dict:
         )
     except Exception as e:
         print(f"flagship quality readout failed: {e}", file=sys.stderr)
-    # stage attribution AFTER the headline rows (extra barriered runs must
+    # cached-vs-cold predict: one extra run under a content-addressed
+    # intermediate cache (core/cache.py). Inside it the eval section times
+    # the first (computing, memoizing) and second (stored-scores, zero
+    # re-featurization) predict with explicit syncs — the flagship's
+    # "eval.predict is test-side re-featurization" cost, measured against
+    # its elimination. AFTER the headline rows: the cache run must not
+    # perturb the async warm measurement. BENCH_CACHED=0 skips.
+    if os.environ.get("BENCH_CACHED", "1") == "1":
+        prev_flag = os.environ.get("KEYSTONE_EVAL_CACHED_TIMING")
+        # bench-only: the pipelines gate the cold/cached eval double-predict
+        # on this flag so ordinary cache-enabled runs never pay for it
+        os.environ["KEYSTONE_EVAL_CACHED_TIMING"] = "1"
+        try:
+            from keystone_tpu.core.cache import IntermediateCache, use_cache
+
+            with use_cache(IntermediateCache(
+                device_bytes=2 << 30, host_bytes=8 << 30
+            )):
+                r = run(cfg)
+            out["imagenet_refdim_predict_cold_s"] = r.get("predict_cold_s")
+            out["imagenet_refdim_predict_cached_s"] = r.get(
+                "predict_cached_s"
+            )
+        except Exception as e:
+            print(f"flagship cached-predict row failed: {e}",
+                  file=sys.stderr)
+        finally:
+            if prev_flag is None:
+                os.environ.pop("KEYSTONE_EVAL_CACHED_TIMING", None)
+            else:
+                os.environ["KEYSTONE_EVAL_CACHED_TIMING"] = prev_flag
+    # prefetch-off control for the double-buffered block feed
+    # (core/prefetch.py): the headline warm row above runs with prefetch ON
+    # (the default); this one warm run with KEYSTONE_PREFETCH=0 is the
+    # overlap's measured value. BENCH_PREFETCH=0 skips.
+    if os.environ.get("BENCH_PREFETCH", "1") == "1":
+        prev = os.environ.get("KEYSTONE_PREFETCH")
+        os.environ["KEYSTONE_PREFETCH"] = "0"
+        try:
+            import time as _time
+
+            from keystone_tpu.core.cache import use_cache
+
+            t0 = _time.perf_counter()
+            # ambient-env-cache suppressed: the row must measure the lost
+            # overlap, not memoized featurization hits
+            with use_cache(None):
+                run(cfg)
+            out["imagenet_refdim_streaming_prefetch_off_s"] = round(
+                _time.perf_counter() - t0, 3
+            )
+        except Exception as e:
+            print(f"flagship prefetch-off row failed: {e}", file=sys.stderr)
+        finally:
+            if prev is None:
+                os.environ.pop("KEYSTONE_PREFETCH", None)
+            else:
+                os.environ["KEYSTONE_PREFETCH"] = prev
+    # stage attribution AFTER the extra rows (extra barriered runs must
     # not precede — and so perturb — the async warm measurement)
     out.update(bench._try_flagship_stage_breakdown())
     return out

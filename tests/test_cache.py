@@ -1,0 +1,542 @@
+"""Intermediate cache (core/cache.py) + prefetch (core/prefetch.py) tests:
+fingerprint semantics, tier mechanics (hit/miss/demotion/eviction/disk
+round-trip), chain-level memoization with zero-recompute proof, golden
+bit-identical cached-vs-uncached pipelines, and prefetch ordering/gating.
+"""
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import flax.struct as struct
+
+from keystone_tpu.core.cache import (
+    IntermediateCache,
+    cache_from_env,
+    fingerprint,
+    get_cache,
+    set_cache,
+    stage_key,
+    use_cache,
+)
+from keystone_tpu.core.pipeline import Cacher, Transformer, chain
+from keystone_tpu.core.prefetch import prefetch_map
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_cache():
+    """Tests own the active cache; nothing may leak between them."""
+    prev = set_cache(None)
+    yield
+    set_cache(prev)
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+
+class ScaleNode(Transformer):
+    w: jax.Array
+
+    def apply_batch(self, xs):
+        return xs * self.w
+
+    apply = apply_batch
+
+
+class _CountingFeaturizer(Transformer):
+    """Eager (non-jittable) featurizer that counts its bulk invocations —
+    the recompute counter hook for the zero-recompute pipeline tests."""
+
+    scale: float = struct.field(pytree_node=False, default=2.0)
+
+    jittable = False
+    calls = []  # class-level (unannotated: not a dataclass field)
+
+    def apply_batch(self, xs):
+        _CountingFeaturizer.calls.append(1)
+        return xs * self.scale
+
+    apply = apply_batch
+
+
+def test_fingerprint_identical_content_matches():
+    a = jnp.arange(12.0).reshape(3, 4)
+    b = jnp.arange(12.0).reshape(3, 4)
+    assert fingerprint(a) == fingerprint(b)
+    assert fingerprint({"x": a, "y": 1}) == fingerprint({"x": b, "y": 1})
+
+
+def test_fingerprint_content_and_structure_sensitivity():
+    a = jnp.arange(12.0).reshape(3, 4)
+    assert fingerprint(a) != fingerprint(a + 1)  # content
+    assert fingerprint(a) != fingerprint(a.reshape(4, 3))  # shape
+    assert fingerprint(a) != fingerprint(a.astype(jnp.bfloat16))  # dtype
+    assert fingerprint([a]) != fingerprint((a,))  # treedef
+
+
+def test_fingerprint_refit_same_treedef_new_leaves_is_miss():
+    """A re-fitted node keeps its structure but changes its leaves — the
+    content key MUST change (stale reuse would be silent corruption)."""
+    n1 = ScaleNode(w=jnp.float32(2.0))
+    n2 = ScaleNode(w=jnp.float32(3.0))  # same treedef, new leaves
+    assert fingerprint(n1) != fingerprint(n2)
+    x_fp = fingerprint(jnp.ones((4,)))
+    assert stage_key((n1,), x_fp) != stage_key((n2,), x_fp)
+    # identical refit -> identical key (bitwise reuse is safe)
+    assert stage_key((n1,), x_fp) == stage_key(
+        (ScaleNode(w=jnp.float32(2.0)),), x_fp
+    )
+
+
+def test_fingerprintable_refuses_opaque_callables():
+    """Two distinct closures repr identically once addresses strip. A node
+    carrying a static callable field (memoizable left True — the Pooler /
+    TermFrequency shape, NOT a LambdaTransformer) must be refused by the
+    memoization gate, or the second node would be served the first's cached
+    output."""
+    from keystone_tpu.core.cache import fingerprint, fingerprintable
+
+    class ThresholdNode(Transformer):
+        fn: object = struct.field(pytree_node=False, default=None)
+
+        def apply_batch(self, xs):
+            return self.fn(xs)
+
+        apply = apply_batch
+
+    def make(t):
+        return ThresholdNode(fn=lambda x: (x > t).astype(jnp.float32))
+
+    a, b = make(0.0), make(99.0)
+    # the hazard this guard exists for: different closures, same fingerprint
+    assert fingerprint(a) == fingerprint(b)
+    assert not fingerprintable(a)
+    assert fingerprintable(ScaleNode(w=jnp.ones(3)))
+    x = jnp.ones((4, 4))
+    with use_cache(IntermediateCache()) as c:
+        ra = a(x)
+        rb = b(x)  # must NOT be served a's cached output
+        assert c.stats.puts == 0  # nothing memoized through opaque nodes
+        np.testing.assert_array_equal(np.asarray(ra), np.ones((4, 4)))
+        np.testing.assert_array_equal(np.asarray(rb), np.zeros((4, 4)))
+
+
+def test_fingerprint_large_array_uses_device_checksum():
+    """Arrays past the host-hash bound still fingerprint by content."""
+    from keystone_tpu.core import cache as cache_mod
+
+    big = jnp.ones((cache_mod._HOST_HASH_MAX_BYTES // 4 + 16,), jnp.float32)
+    assert fingerprint(big) == fingerprint(big + 0.0)
+    bumped = big.at[17].set(2.0)
+    assert fingerprint(big) != fingerprint(bumped)
+
+
+# ---------------------------------------------------------------------------
+# tier mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_memoize_hit_miss_and_bit_identical_values():
+    cache = IntermediateCache()
+    x = jnp.arange(8.0)
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return jnp.sin(x)
+
+    v1 = cache.memoize("k1", compute)
+    v2 = cache.memoize("k1", compute)
+    assert len(calls) == 1
+    assert cache.stats.hits == 1 and cache.stats.misses == 1
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    cache.memoize("k2", compute)
+    assert len(calls) == 2  # different key -> recompute
+
+
+def test_demotion_to_host_and_promotion_back():
+    """Over-budget device tier demotes the lowest recompute-density entry
+    to host numpy; a later hit promotes it back to device."""
+    cache = IntermediateCache(device_bytes=1 << 12, host_bytes=1 << 20)
+    a = jnp.ones((256,), jnp.float32)  # 1 KiB
+    b = jnp.ones((512,), jnp.float32)  # 2 KiB
+    c = jnp.ones((768,), jnp.float32)  # 3 KiB
+    cache.put("a", a, cost_s=10.0)  # high density: stays on device
+    cache.put("b", b, cost_s=0.001)  # low density: first demotion victim
+    cache.put("c", c, cost_s=5.0)
+    assert cache.stats.demotions >= 1
+    tiers = {e.key: e.tier for e in cache._entries.values()}
+    assert tiers["b"] == "host"
+    # host-tier value is exact, and the hit promotes it deviceward
+    hit, vb = cache.lookup("b")
+    assert hit
+    np.testing.assert_array_equal(np.asarray(vb), np.asarray(b))
+    assert isinstance(vb, jax.Array)
+    assert cache.stats.promotions == 1
+    assert cache.stats.host_hits == 1
+
+
+def test_eviction_when_no_lower_tier():
+    """host-budget 0 and no disk dir: device overflow evicts outright."""
+    cache = IntermediateCache(device_bytes=1 << 11, host_bytes=0)
+    for i in range(8):
+        cache.put(f"k{i}", jnp.ones((256,), jnp.float32), cost_s=float(i))
+    assert cache.stats.evictions >= 1
+    total = sum(e.nbytes for e in cache._entries.values())
+    assert total <= 1 << 11
+
+
+def test_disk_tier_round_trip(tmp_path):
+    """Demotion through host to disk, then a disk hit restores the exact
+    value and promotes; clear() removes the files."""
+    d = str(tmp_path / "kcache")
+    cache = IntermediateCache(
+        device_bytes=1 << 10, host_bytes=0, disk_bytes=1 << 20, cache_dir=d
+    )
+    val = {"w": jnp.arange(512.0), "meta": jnp.int32(7)}
+    cache.put("deep", val, cost_s=3.0)
+    # force overflow so "deep" demotes to disk
+    cache.put("hot", jnp.ones((200,), jnp.float32), cost_s=100.0)
+    cache.put("hot2", jnp.ones((200,), jnp.float32), cost_s=90.0)
+    tiers = {e.key: e.tier for e in cache._entries.values()}
+    assert "disk" in tiers.values(), tiers
+    disk_key = next(k for k, t in tiers.items() if t == "disk")
+    files = os.listdir(d)
+    assert any(f.startswith(disk_key) for f in files)
+    hit, got = cache.lookup(disk_key)
+    assert hit and cache.stats.disk_hits == 1
+    if disk_key == "deep":
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.arange(512.0, dtype=np.float32))
+    cache.clear()
+    assert not [f for f in os.listdir(d) if f.endswith(".kcache")]
+
+
+def test_disk_tier_cross_process_adoption(tmp_path):
+    """A fresh cache over an existing cache_dir serves the files written by
+    a previous cache (process) — lazy metadata adoption."""
+    d = str(tmp_path / "kcache")
+    c1 = IntermediateCache(
+        device_bytes=1 << 8, host_bytes=0, disk_bytes=1 << 20, cache_dir=d
+    )
+    c1.put("x", jnp.arange(256.0), cost_s=1.0)
+    c1.put("y", jnp.arange(256.0) * 2, cost_s=2.0)  # overflows device -> disk
+    assert any(f.endswith(".kcache") for f in os.listdir(d))
+    disk_keys = [e.key for e in c1._entries.values() if e.tier == "disk"]
+
+    c2 = IntermediateCache(
+        device_bytes=1 << 20, host_bytes=1 << 20, disk_bytes=1 << 20,
+        cache_dir=d,
+    )
+    for k in disk_keys:
+        hit, v = c2.lookup(k)
+        assert hit, f"adopted disk entry {k} missed"
+
+
+def test_put_same_key_replaces():
+    cache = IntermediateCache()
+    cache.put("k", jnp.ones((4,)), cost_s=1.0)
+    cache.put("k", jnp.zeros((4,)), cost_s=1.0)
+    hit, v = cache.lookup("k")
+    assert hit
+    np.testing.assert_array_equal(np.asarray(v), np.zeros(4, np.float32))
+    assert len(cache._entries) == 1
+
+
+def test_cache_from_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("KEYSTONE_CACHE", raising=False)
+    assert cache_from_env() is None
+    monkeypatch.setenv("KEYSTONE_CACHE", "1")
+    monkeypatch.setenv("KEYSTONE_CACHE_DEVICE_MB", "1")
+    monkeypatch.setenv("KEYSTONE_CACHE_DIR", str(tmp_path / "c"))
+    c = cache_from_env()
+    assert c is not None
+    assert c.budgets["device"] == 1 << 20
+    assert c.cache_dir == str(tmp_path / "c")
+
+
+def test_env_cache_survives_suppression_scope(monkeypatch):
+    """A transient ``use_cache(None)`` scope (pipelines suppress the cache
+    around self-managed buffers) must not disable the KEYSTONE_CACHE=1
+    env-configured cache for the rest of the process."""
+    import keystone_tpu.core.cache as cache_mod
+
+    monkeypatch.setenv("KEYSTONE_CACHE", "1")
+    monkeypatch.setattr(
+        cache_mod, "_override",
+        cache_mod.contextvars.ContextVar("t", default=cache_mod._UNSET),
+    )
+    monkeypatch.setattr(cache_mod, "_env_cache", None)
+    monkeypatch.setattr(cache_mod, "_env_checked", False)
+    # the suppression scope is the FIRST cache-API touch (the streaming
+    # pipelines hit exactly this ordering)
+    with use_cache(None):
+        assert get_cache() is None
+    env_cache = get_cache()
+    assert isinstance(env_cache, IntermediateCache)
+    assert get_cache() is env_cache  # resolved once, stable thereafter
+    with use_cache(None):
+        assert get_cache() is None
+    assert get_cache() is env_cache
+
+
+def test_thread_safety_under_concurrent_memoize():
+    cache = IntermediateCache(device_bytes=1 << 16, host_bytes=1 << 20)
+    errs = []
+
+    def worker(tid):
+        try:
+            for i in range(30):
+                k = f"k{(tid + i) % 10}"
+                v = cache.memoize(k, lambda: jnp.full((64,), float(tid)))
+                assert v.shape == (64,)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+
+
+# ---------------------------------------------------------------------------
+# pipeline-level memoization
+# ---------------------------------------------------------------------------
+
+
+def test_second_apply_batch_zero_featurization_recomputes():
+    """THE KeystoneML ``.cache()`` contract: a second bulk apply over
+    identical features re-runs NO featurization (counter hook on an eager
+    featurizer node)."""
+    _CountingFeaturizer.calls = []
+    p = chain(_CountingFeaturizer(), Cacher(), ScaleNode(w=jnp.float32(3.0)))
+    x = jnp.arange(16.0).reshape(4, 4)
+    with use_cache(IntermediateCache()):
+        out1 = p(x)
+        n_after_first = len(_CountingFeaturizer.calls)
+        out2 = p(x)
+        assert len(_CountingFeaturizer.calls) == n_after_first, (
+            "second apply_batch re-featurized"
+        )
+        assert n_after_first == 1
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_cacher_prefix_reused_across_chain_suffixes():
+    """Fit-time featurization through ``f >> Cacher()`` must be a prefix
+    hit when the same features flow through a LONGER fitted chain — the
+    cross-chain reuse stage_key guarantees."""
+    _CountingFeaturizer.calls = []
+    feat = _CountingFeaturizer()
+    x = jnp.arange(16.0).reshape(4, 4)
+    with use_cache(IntermediateCache()):
+        descs = chain(feat, Cacher())(x)  # "fit-time" featurization
+        assert len(_CountingFeaturizer.calls) == 1
+        fitted = chain(feat, Cacher(), ScaleNode(w=jnp.float32(2.0)))
+        out = fitted(x)  # prefix hit -> only the scale stage runs
+        assert len(_CountingFeaturizer.calls) == 1
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(descs) * 2.0)
+
+
+def test_refit_chain_is_cache_miss_not_stale_hit():
+    """Same chain structure with a re-fitted (different-leaves) stage must
+    recompute — and produce the re-fitted answer, not the stale one."""
+    _CountingFeaturizer.calls = []
+    x = jnp.arange(8.0).reshape(2, 4)
+    with use_cache(IntermediateCache()):
+        p2 = chain(_CountingFeaturizer(), Cacher(), ScaleNode(w=jnp.float32(2.0)))
+        p3 = chain(_CountingFeaturizer(), Cacher(), ScaleNode(w=jnp.float32(3.0)))
+        out2 = p2(x)
+        out3 = p3(x)
+    np.testing.assert_array_equal(np.asarray(out3), np.asarray(out2) * 1.5)
+    # the shared featurizer prefix hit; only the scale suffix recomputed
+    assert len(_CountingFeaturizer.calls) == 1
+
+
+def test_cached_pipeline_bit_identical_to_uncached():
+    """Golden comparison: cached run == uncached run, bit for bit, and a
+    second cached run returns the stored bits."""
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(6, 8)).astype(np.float32)
+    )
+    w = jnp.asarray(
+        np.random.default_rng(1).normal(size=(8,)).astype(np.float32)
+    )
+    p = chain(ScaleNode(w=w), Cacher(), ScaleNode(w=w * 0.5))
+    baseline = np.asarray(p(x))  # no cache active
+    with use_cache(IntermediateCache()) as cache:
+        first = np.asarray(p(x))
+        second = np.asarray(p(x))
+        assert cache.stats.hits >= 1
+    assert baseline.tobytes() == first.tobytes()
+    assert baseline.tobytes() == second.tobytes()
+
+
+def test_lambda_transformer_never_memoized():
+    """Closure state is invisible to content fingerprinting: two from_fn
+    nodes built from the SAME source location with different captured
+    values would collide on an address-stripped fingerprint — so they must
+    bypass the cache entirely."""
+
+    def make(k):
+        return Transformer.from_fn(lambda x: x * k, name="closure")
+
+    n2, n3 = make(2.0), make(3.0)
+    assert not n2.memoizable
+    x = jnp.arange(4.0)
+    with use_cache(IntermediateCache()) as cache:
+        out2 = np.asarray(n2(x))
+        out3 = np.asarray(n3(x))
+        assert cache.stats.puts == 0  # nothing stored, nothing to collide
+        # chains containing one inherit the bypass
+        assert not chain(ScaleNode(w=jnp.float32(1.0)), n2).memoizable
+    np.testing.assert_array_equal(out3, out2 * 1.5)
+
+
+def test_cache_bypassed_inside_jit_traces():
+    """Tracers must never be fingerprinted or stored."""
+    n = ScaleNode(w=jnp.float32(2.0))
+    with use_cache(IntermediateCache()) as cache:
+        out = jax.jit(lambda v: n(v) + 1.0)(jnp.arange(4.0))
+        assert cache.stats.puts == 0
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.arange(4.0, dtype=np.float32) * 2 + 1)
+
+
+def test_streaming_predict_memoized_zero_refeaturize():
+    """Warm out-of-core predict returns stored scores without touching the
+    feature nodes (the flagship eval.predict elimination)."""
+    from keystone_tpu.learning.block_linear import (
+        BlockLinearMapper,
+        streaming_predict,
+    )
+
+    _CountingFeaturizer.calls = []
+    nodes = [_CountingFeaturizer(scale=1.0), _CountingFeaturizer(scale=2.0)]
+    raw = jnp.asarray(
+        np.random.default_rng(2).normal(size=(5, 4)).astype(np.float32)
+    )
+    model = BlockLinearMapper(
+        w=jnp.asarray(
+            np.random.default_rng(3).normal(size=(8, 3)).astype(np.float32)
+        ),
+        b=None, feature_means=None, block_size=4,
+    )
+    cold = np.asarray(streaming_predict(model, nodes, raw))  # uncached
+    calls_uncached = len(_CountingFeaturizer.calls)
+    with use_cache(IntermediateCache()):
+        first = np.asarray(streaming_predict(model, nodes, raw))
+        calls_after_first = len(_CountingFeaturizer.calls)
+        warm = np.asarray(streaming_predict(model, nodes, raw))
+        assert len(_CountingFeaturizer.calls) == calls_after_first, (
+            "warm streaming_predict re-featurized"
+        )
+    assert cold.tobytes() == first.tobytes() == warm.tobytes()
+    assert calls_uncached == 2  # sanity: both nodes actually run per predict
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_map_order_and_results():
+    items = list(range(20))
+    out = list(prefetch_map(lambda i: i * i, items, depth=3))
+    assert out == [i * i for i in items]
+
+
+def test_prefetch_map_runs_producer_single_threaded_in_order():
+    seen = []
+
+    def produce(i):
+        seen.append(i)
+        return i
+
+    assert list(prefetch_map(produce, range(10), depth=4)) == list(range(10))
+    assert seen == list(range(10))
+
+
+def test_prefetch_map_gate_blocks_lookahead():
+    """gate(prev, nxt) False defers the next group's production until the
+    boundary item has been YIELDED (the two-group-buffers guard)."""
+    produced = []
+    yielded = []
+    items = [("a", 0), ("a", 1), ("b", 2), ("b", 3)]
+
+    def produce(it):
+        produced.append(it)
+        return it
+
+    gen = prefetch_map(
+        produce, items, depth=2, gate=lambda p, n: p[0] == n[0]
+    )
+    first = next(gen)
+    yielded.append(first)
+    # group b must not have been produced while only ("a", ...) was yielded
+    assert all(g == "a" for g, _ in produced)
+    assert [x for x in gen] == items[1:]
+
+
+def test_prefetch_map_depth_zero_is_sequential():
+    calls = []
+    out = list(prefetch_map(lambda i: calls.append(i) or i, range(5), depth=0))
+    assert out == list(range(5)) and calls == list(range(5))
+
+
+def test_prefetch_map_exception_surfaces_at_right_item():
+    def produce(i):
+        if i == 3:
+            raise ValueError("boom")
+        return i
+
+    gen = prefetch_map(produce, range(6), depth=2)
+    got = []
+    with pytest.raises(ValueError, match="boom"):
+        for v in gen:
+            got.append(v)
+    assert got == [0, 1, 2]
+
+
+def test_prefetch_env_kill_switch(monkeypatch):
+    from keystone_tpu.core.prefetch import prefetch_depth
+
+    monkeypatch.setenv("KEYSTONE_PREFETCH", "0")
+    assert prefetch_depth() == 0
+    monkeypatch.setenv("KEYSTONE_PREFETCH", "4")
+    assert prefetch_depth() == 4
+    monkeypatch.setenv("KEYSTONE_PREFETCH", "junk")
+    assert prefetch_depth(2) == 2
+
+
+def test_weighted_fit_prefetch_on_off_bit_identical(monkeypatch):
+    """The solver's double-buffered block feed must be a pure overlap: the
+    fitted model with KEYSTONE_PREFETCH=2 equals =0 bitwise."""
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+
+    rng = np.random.default_rng(4)
+    X = jnp.asarray(rng.normal(size=(40, 12)).astype(np.float32))
+    y = ClassLabelIndicatorsFromIntLabels(3)(
+        jnp.asarray(rng.integers(0, 3, 40))
+    )
+
+    def fit():
+        return BlockWeightedLeastSquaresEstimator(4, 2, 0.1, 0.25).fit(X, y)
+
+    monkeypatch.setenv("KEYSTONE_PREFETCH", "2")
+    m_on = fit()
+    monkeypatch.setenv("KEYSTONE_PREFETCH", "0")
+    m_off = fit()
+    assert np.asarray(m_on.w).tobytes() == np.asarray(m_off.w).tobytes()
+    assert np.asarray(m_on.b).tobytes() == np.asarray(m_off.b).tobytes()

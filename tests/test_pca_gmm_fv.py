@@ -156,10 +156,14 @@ def test_fisher_vector_batch(rng):
     np.testing.assert_allclose(out[1], one, atol=1e-5)
 
 
-def test_fisher_slice_normalized_matches_dense_chain(rng):
+def test_fisher_slice_normalized_matches_dense_chain(rng, monkeypatch):
     """Concatenated FisherVectorSliceNormalized blocks must equal the dense
     FV → vectorize → L2 → Hellinger → L2 chain (the two L2 norms cancel into
     one per-image L1 scalar — see ops/images/fisher_vector.py)."""
+    # pin the exact-f32 FV path: on TPU hosts the auto dispatch takes the
+    # bf16 MXU form, whose rounding breaks this test's atol=1e-5 pin (the
+    # cross-path agreement has its own test with bf16-sized tolerances)
+    monkeypatch.setenv("KEYSTONE_FV_IMPL", "f32")
     from keystone_tpu.ops.images.fisher_vector import (
         fisher_l1_norms,
         make_fisher_block_nodes,
@@ -262,10 +266,13 @@ def test_grouped_getter_caches_once_per_group(rng):
     clear()
 
 
-def test_fv_cols_batch_matches_per_image(rng):
+def test_fv_cols_batch_matches_per_image(rng, monkeypatch):
     """The flat-gemm batched FV (_fv_cols_batch, global affine params) must
     agree with the per-image centered path (_fv_cols) — same math, different
     schedule — across column ranges and descriptor scales."""
+    # pin the exact-f32 FV path: the rtol=4e-4 below is an f32-schedule
+    # bound; the TPU auto dispatch would take the bf16 MXU form and fail it
+    monkeypatch.setenv("KEYSTONE_FV_IMPL", "f32")
     from keystone_tpu.ops.images.fisher_vector import _fv_cols, _fv_cols_batch
 
     k, d = 8, 16
