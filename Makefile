@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-cached cpu-baseline flagship clean
+.PHONY: all native test bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -32,6 +32,13 @@ bench-cached:
 	BENCH_EXTRAS=0 BENCH_FLAGSHIP=0 BENCH_VOC_REFDIM=0 BENCH_TIMIT_FULL=0 \
 	BENCH_MOMENTS=0 BENCH_CONSTANTS=0 BENCH_SERVE=0 BENCH_STAGES=0 \
 	$(PY) bench.py
+
+# Tiny-shape end-to-end smoke of the bench contract itself: every shape
+# shrunk to CPU scale (BENCH_SMOKE=1), heavy sections off, 120 s budget —
+# exercises the incremental-flush / budget-skip / compact-line machinery in
+# seconds. The bench-contract tier-1 test runs exactly this.
+bench-smoke:
+	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=120 $(PY) bench.py
 
 cpu-baseline:
 	JAX_PLATFORMS=cpu $(PY) scripts/cpu_baseline.py

@@ -17,6 +17,8 @@ pipeline system) on JAX/XLA over TPU meshes:
 - Loaders, evaluators, and runnable end-to-end example pipelines.
 """
 
+import keystone_tpu._compat  # noqa: F401  (jax version shims; must run first)
+
 from keystone_tpu.core.pipeline import (
     Node,
     Transformer,
@@ -38,5 +40,6 @@ from keystone_tpu.core.cache import (
     use_cache,
 )
 from keystone_tpu.core.prefetch import prefetch_map
+from keystone_tpu.parallel.overlap import overlap_enabled, use_overlap
 
 __version__ = "0.1.0"

@@ -1,0 +1,63 @@
+"""Compatibility shims for the installed jax version.
+
+The framework is written against the current jax surface (``jax.shard_map``
+with ``check_vma``, ``jax.lax.pcast``, ``jax.enable_x64``). Older jaxlibs
+(0.4.x) expose the same functionality under pre-stabilization names:
+
+- ``jax.shard_map``        -> ``jax.experimental.shard_map.shard_map``, whose
+  replication checker (``check_rep``) predates the vma type system — programs
+  that annotate replication with ``pcast``/``check_vma`` cannot express their
+  hints to it, so the shim disables the (advisory, numerics-neutral) check.
+- ``jax.lax.pcast``        -> identity. ``pcast`` only adjusts the vma *type*
+  of a value (replicated vs device-varying); with the old checker off there
+  is no type to adjust and the values are unchanged.
+- ``jax.enable_x64``       -> ``jax.experimental.enable_x64``.
+
+On a current jax none of these attributes are missing and this module is a
+no-op, so the shims never shadow the real implementations. Imported for its
+side effects from ``keystone_tpu/__init__`` (and therefore active before any
+framework module touches the shimmed names).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                          check_vma=True, **kwargs):
+        # check_rep=False always: the old checker cannot see pcast hints and
+        # rejects valid programs (e.g. loop-carried ppermute state). It is a
+        # static well-formedness check only — disabling it never changes
+        # numerics.
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False, **kwargs,
+        )
+
+    jax.shard_map = _compat_shard_map
+
+if not hasattr(jax, "enable_x64"):
+    from jax.experimental import enable_x64 as _enable_x64
+
+    jax.enable_x64 = _enable_x64
+
+if not hasattr(jax.lax, "axis_size"):
+
+    def _compat_axis_size(axis_name):
+        # psum of a Python scalar constant-folds to the (static) axis size
+        # on 0.4.x — the documented trick before lax.axis_size existed.
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _compat_axis_size
+
+if not hasattr(jax.lax, "pcast"):
+
+    def _compat_pcast(x, axis_name, *, to=None):
+        del axis_name, to  # typing-only on current jax; identity here
+        return x
+
+    jax.lax.pcast = _compat_pcast

@@ -87,15 +87,19 @@ def _block_contrib(xs, w, start, stop):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("precision",), donate_argnums=(2,)
+    jax.jit, static_argnames=("precision", "omesh"), donate_argnums=(2,)
 )
-def _streaming_block_step_first(feat_node, raw, R, lam, mask, precision: str):
+def _streaming_block_step_first(feat_node, raw, R, lam, mask, precision: str,
+                                omesh=None):
     """First pass over a block: derive the (masked) feature mean from the same
     featurization used for the solve — no separate mean pass. Returns the
     unregularized gram XᵀX so later passes can skip the 2·n·b² gram gemm
     (the reference likewise computes XᵀX only on pass 0 and reuses it,
-    ``BlockWeightedLeastSquares.scala:214-221``)."""
+    ``BlockWeightedLeastSquares.scala:214-221``). ``omesh`` (static) routes
+    the gram/cross reductions through the tiled reduce-scatter collective
+    matmul (``parallel/overlap.py``)."""
     from keystone_tpu.linalg.solvers import hdot, spd_solve
+    from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
 
     feats = feat_node.apply_batch(raw)
     if mask is None:
@@ -104,24 +108,29 @@ def _streaming_block_step_first(feat_node, raw, R, lam, mask, precision: str):
     else:
         fmean = jnp.sum(feats * mask[:, None], axis=0) / jnp.sum(mask)
         feats = (feats - fmean) * mask[:, None]
-    gram = hdot(feats.T, feats, precision)
+    gram = maybe_tiled_transpose_matmul(feats, None, omesh, precision=precision)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
-    Wk = spd_solve(gram + lam * eye, hdot(feats.T, R, precision))
+    cross = maybe_tiled_transpose_matmul(feats, R, omesh, precision=precision)
+    Wk = spd_solve(gram + lam * eye, cross)
     R = R - hdot(feats, Wk, precision)
     return fmean, Wk, R, gram
 
 
 @functools.partial(
-    jax.jit, static_argnames=("precision",), donate_argnums=(2,)
+    jax.jit, static_argnames=("precision", "omesh"), donate_argnums=(2,)
 )
-def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean, precision: str):
+def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean,
+                          precision: str, omesh=None):
     from keystone_tpu.linalg.solvers import hdot, spd_solve
+    from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
 
     feats = feat_node.apply_batch(raw) - fmean
     if mask is not None:
         feats = feats * mask[:, None]
-    gram = hdot(feats.T, feats, precision)
-    rhs = hdot(feats.T, R, precision) + hdot(gram, Wk, precision)
+    gram = maybe_tiled_transpose_matmul(feats, None, omesh, precision=precision)
+    rhs = maybe_tiled_transpose_matmul(
+        feats, R, omesh, precision=precision
+    ) + hdot(gram, Wk, precision)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
     Wk_new = spd_solve(gram + lam * eye, rhs)
     R = R - hdot(feats, Wk_new - Wk, precision)
@@ -129,18 +138,22 @@ def _streaming_block_step(feat_node, raw, R, Wk, lam, mask, fmean, precision: st
 
 
 @functools.partial(
-    jax.jit, static_argnames=("precision",), donate_argnums=(2,)
+    jax.jit, static_argnames=("precision", "omesh"), donate_argnums=(2,)
 )
-def _streaming_block_step_cached(feat_node, raw, R, Wk, lam, mask, fmean, gram, precision: str):
+def _streaming_block_step_cached(feat_node, raw, R, Wk, lam, mask, fmean, gram,
+                                 precision: str, omesh=None):
     """Later-pass block step with the pass-0 gram: only the n×b×c cross terms
     and the b³-class solve remain — ~4× cheaper than re-doing the 2·n·b² gram
     when b ≫ c."""
     from keystone_tpu.linalg.solvers import hdot, spd_solve
+    from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
 
     feats = feat_node.apply_batch(raw) - fmean
     if mask is not None:
         feats = feats * mask[:, None]
-    rhs = hdot(feats.T, R, precision) + hdot(gram, Wk, precision)
+    rhs = maybe_tiled_transpose_matmul(
+        feats, R, omesh, precision=precision
+    ) + hdot(gram, Wk, precision)
     eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
     Wk_new = spd_solve(gram + lam * eye, rhs)
     R = R - hdot(feats, Wk_new - Wk, precision)
@@ -230,7 +243,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     """
 
     def __init__(self, block_size: int, num_iter: int = 1, lam: float = 0.0,
-                 cache_grams: bool = True):
+                 cache_grams: bool = True, overlap: Optional[bool] = None):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
@@ -238,6 +251,11 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         # blockStats cache, ``BlockWeightedLeastSquares.scala:214-221``).
         # Costs num_blocks·b² f32 of HBM; disable for huge block counts.
         self.cache_grams = cache_grams
+        # Tiled reduce-scatter gram/cross reductions (latency-hiding
+        # collectives, ``parallel/overlap.py``). None = the KEYSTONE_OVERLAP
+        # knob, resolved at fit time; streamed block passes then compose
+        # overlap with the dispatch-ahead prefetch.
+        self.overlap = overlap
 
     def fit(self, data, labels, mask: Optional[jax.Array] = None) -> BlockLinearMapper:
         A, B, feature_scaler, label_scaler, mask = center_for_solve(data, labels, mask)
@@ -246,7 +264,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         # instead of allocating a second (n, d) + (n, c) next to them
         w = block_coordinate_descent_l2(
             A, B, self.lam, self.block_size, self.num_iter, mask=mask,
-            cache_grams=self.cache_grams, donate=True,
+            cache_grams=self.cache_grams, donate=True, overlap=self.overlap,
         )
         return BlockLinearMapper(
             w=w,
@@ -298,10 +316,17 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             B = B * mask[:, None]
         lam = jnp.float32(self.lam)
         from keystone_tpu.linalg.solvers import get_solver_precision
+        from keystone_tpu.parallel.overlap import overlap_mesh
 
         precision = get_solver_precision()
+        # resolved once per fit: the overlap mesh is a static argument of
+        # the per-block programs (it selects the collective structure)
+        omesh = overlap_mesh(self.overlap)
 
         if row_chunk > 0:
+            # row-chunking is the SINGLE-CHIP out-of-core lever (docstring):
+            # its slices cut across the row-sharded axis, so the chunked
+            # accumulation keeps the monolithic reductions
             return self._fit_streaming_chunked(
                 feature_nodes, raw, B.astype(jnp.float32), mask, lam,
                 label_scaler, row_chunk, precision,
@@ -313,7 +338,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         R = B.astype(jnp.float32)
         for k, node in enumerate(feature_nodes):
             fmeans[k], Ws[k], R, gram = _streaming_block_step_first(
-                node, raw, R, lam, mask, precision=precision
+                node, raw, R, lam, mask, precision=precision, omesh=omesh
             )
             if self.cache_grams and self.num_iter > 1:
                 grams[k] = gram
@@ -322,12 +347,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 if grams[k] is not None:
                     Ws[k], R = _streaming_block_step_cached(
                         node, raw, R, Ws[k], lam, mask, fmeans[k], grams[k],
-                        precision=precision,
+                        precision=precision, omesh=omesh,
                     )
                 else:
                     Ws[k], R = _streaming_block_step(
                         node, raw, R, Ws[k], lam, mask, fmeans[k],
-                        precision=precision,
+                        precision=precision, omesh=omesh,
                     )
         return BlockLinearMapper(
             w=jnp.concatenate(Ws, axis=0),

@@ -64,16 +64,24 @@ def _class_col_means(R, class_idx, counts):
     return per_class, jnp.sum(per_class, axis=0) / c
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
-def _pop_stats(Xb, R, valid, n_eff, precision: str):
+@functools.partial(jax.jit, static_argnames=("precision", "omesh"))
+def _pop_stats(Xb, R, valid, n_eff, precision: str, omesh=None):
     """Population mean / covariance / XᵀR for one block (pass 0,
-    ``:190-212``). Row-sharded matmuls -> ICI all-reduce. ``Xb`` may arrive
-    bf16 (the streaming group cache); the f32 upcast lives only inside this
-    program."""
+    ``:190-212``). Row-sharded matmuls -> ICI all-reduce; with the overlap
+    knob (``omesh`` set, static) both reductions run as tiled reduce-scatter
+    collective matmuls whose per-tile psums hide behind the next tile's MXU
+    work (``parallel/overlap.py``). ``Xb`` may arrive bf16 (the streaming
+    group cache); the f32 upcast lives only inside this program."""
+    from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
+
     Xv = Xb.astype(jnp.float32) * valid[:, None]
     pop_mean = jnp.sum(Xv, axis=0) / n_eff
-    pop_cov = hdot(Xv.T, Xv, precision) / n_eff - jnp.outer(pop_mean, pop_mean)
-    pop_xtr = hdot(Xv.T, R, precision) / n_eff
+    pop_cov = maybe_tiled_transpose_matmul(
+        Xv, None, omesh, precision=precision
+    ) / n_eff - jnp.outer(pop_mean, pop_mean)
+    pop_xtr = maybe_tiled_transpose_matmul(
+        Xv, R, omesh, precision=precision
+    ) / n_eff
     return pop_mean, pop_cov, pop_xtr
 
 
@@ -411,11 +419,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     def __init__(self, block_size: int, num_iter: int, lam: float,
                  mixture_weight: float, cache_stats: bool = True,
                  woodbury: str = "auto",
-                 woodbury_cond_limit: float = 1e6):
+                 woodbury_cond_limit: float = 1e6,
+                 overlap: Optional[bool] = None):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
         self.mixture_weight = mixture_weight
+        # Latency-hiding collectives for the per-block pop-cov/XᵀR
+        # reductions (tiled reduce-scatter instead of a trailing all-reduce;
+        # ``parallel/overlap.py``). None resolves the KEYSTONE_OVERLAP knob
+        # at fit time, so streamed block passes compose overlap with the
+        # dispatch-ahead prefetch without touching call sites.
+        self.overlap = overlap
         # Reuse pass-0 per-block pop stats on later passes (the reference's
         # blockStats cache, ``BlockWeightedLeastSquares.scala:214-221``).
         # Costs num_blocks·bs² HBM; disable for memory-tight huge-d solves.
@@ -630,6 +645,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         policy = (lambda *_: False) if _force_dense else self._woodbury_policy
         need_binv = _needs_base_inverse(buckets, self.block_size, policy)
+        # Overlap knob resolved ONCE per fit (it selects program structure —
+        # a static jit argument of the pop-stats programs below).
+        from keystone_tpu.parallel.overlap import overlap_mesh
+
+        omesh = overlap_mesh(self.overlap)
         # Per-phase attribution, diag-mode only (KEYSTONE_SYNC_TIMERS=1):
         # Timers inside the hot loop would flush dispatch every block and
         # defeat the async single-sync design, so the production path gets
@@ -674,7 +694,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             if pop_stats_cache[b] is None:
                 with _phase("pop_stats"):
                     pop_mean, pop_cov, pop_xtr = _pop_stats(
-                        Xb, R, valid, n_eff, precision=precision
+                        Xb, R, valid, n_eff, precision=precision, omesh=omesh
                     )
                 # base inverse depends only on pop_cov/λ/w: once per
                 # block, cached with the pop stats across iterations
@@ -703,8 +723,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             else:
                 pop_mean, pop_cov, base_inv = pop_stats_cache[b]
                 joint_means_b = joint_means_blocks[b]
-                pop_xtr = hdot(
-                    (Xb.astype(jnp.float32) * valid[:, None]).T, R, precision
+                from keystone_tpu.parallel.overlap import (
+                    maybe_tiled_transpose_matmul,
+                )
+
+                pop_xtr = maybe_tiled_transpose_matmul(
+                    Xb.astype(jnp.float32) * valid[:, None], R, omesh,
+                    precision=precision,
                 ) / n_eff
 
             with _phase("class_solves"):

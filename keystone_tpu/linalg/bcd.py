@@ -45,9 +45,17 @@ def block_coordinate_descent_l2(
     cache_grams: bool = True,
     precision: Optional[str] = None,
     donate: bool = False,
+    overlap: Optional[bool] = None,
 ) -> jax.Array:
     """Public entry: resolves the solver precision once (a static jit arg,
     so changing the global never serves a stale compile) and dispatches.
+
+    ``overlap`` (None = the ``KEYSTONE_OVERLAP`` knob) routes each block's
+    gram/cross-term reductions through the tiled reduce-scatter collective
+    matmul (``parallel/overlap.py``) so tile *t*'s ICI reduction hides
+    behind tile *t+1*'s MXU matmul, instead of one trailing all-reduce per
+    block. Requires row-sharded ``A`` with rows divisible by the mesh's
+    ``data`` axis; anything else falls back per-shape at trace time.
 
     ``donate=True`` donates ``A`` and ``b`` to the solve: callers passing
     temporaries they will never read again (the estimators' centered
@@ -57,10 +65,12 @@ def block_coordinate_descent_l2(
     array is DEAD after the call (jax raises on reuse); never set it for
     arrays the caller still owns."""
     from keystone_tpu.linalg.solvers import validate_precision
+    from keystone_tpu.parallel.overlap import overlap_mesh
 
     if precision is not None:
         validate_precision(precision)
     precision = precision or get_solver_precision()
+    omesh = overlap_mesh(overlap)
     if donate:
         # the outputs (d, c) can never alias the (n, ·) inputs, so jax warns
         # that donation found no output alias — expected: the donation here
@@ -73,10 +83,11 @@ def block_coordinate_descent_l2(
                 "ignore", message="Some donated buffers were not usable"
             )
             return _bcd_l2_donated(
-                A, b, lam, block_size, num_iter, mask, cache_grams, precision
+                A, b, lam, block_size, num_iter, mask, cache_grams, precision,
+                omesh,
             )
     return _bcd_l2(
-        A, b, lam, block_size, num_iter, mask, cache_grams, precision
+        A, b, lam, block_size, num_iter, mask, cache_grams, precision, omesh
     )
 
 
@@ -89,6 +100,7 @@ def _bcd_l2_impl(
     mask: Optional[jax.Array] = None,
     cache_grams: bool = True,
     precision: str = "high",
+    omesh=None,
 ) -> jax.Array:
     """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
 
@@ -120,11 +132,23 @@ def _bcd_l2_impl(
     # and caches them (``BlockWeightedLeastSquares.scala:214-221``). Costs
     # num_blocks·b² HBM (cache_grams=False opts out for memory-tight huge-d
     # solves); the single-pass (common) case keeps zero extra state.
+    # Per-block gram/cross reductions: with the overlap knob (omesh set)
+    # each becomes a tiled reduce-scatter collective matmul — per-tile
+    # psum_scatter hidden behind the next tile's matmul — instead of the
+    # monolithic hdot whose row contraction XLA all-reduces AFTER the gemm.
+    from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
+
+    def _gram(Ak):
+        return maybe_tiled_transpose_matmul(Ak, None, omesh, precision=precision)
+
+    def _cross(Ak, R):
+        return maybe_tiled_transpose_matmul(Ak, R, omesh, precision=precision)
+
     use_cache = num_iter > 1 and cache_grams
     if use_cache:
         def gram_k(_, k):
             Ak = jax.lax.dynamic_slice(A, (0, k * block_size), (n, block_size))
-            return None, hdot(Ak.T, Ak, precision)
+            return None, _gram(Ak)
 
         _, grams = jax.lax.scan(gram_k, None, jnp.arange(num_blocks))
 
@@ -137,8 +161,8 @@ def _bcd_l2_impl(
         if use_cache:
             gram = grams[k]
         else:
-            gram = hdot(Ak.T, Ak, precision)  # sharded matmul -> ICI all-reduce
-        rhs = hdot(Ak.T, R, precision) + hdot(gram, Wk, precision)  # A_kᵀ(R + A_k W_k)
+            gram = _gram(Ak)  # sharded matmul -> ICI reduction
+        rhs = _cross(Ak, R) + hdot(gram, Wk, precision)  # A_kᵀ(R + A_k W_k)
         Wk_new = spd_solve(gram + lam * eye + jnp.diag(regk), rhs)
         R = R - hdot(Ak, Wk_new - Wk, precision)
         W = jax.lax.dynamic_update_slice(W, Wk_new, (start, 0))
@@ -149,7 +173,7 @@ def _bcd_l2_impl(
     return W[:d]
 
 
-_BCD_STATICS = ("block_size", "num_iter", "cache_grams", "precision")
+_BCD_STATICS = ("block_size", "num_iter", "cache_grams", "precision", "omesh")
 _bcd_l2 = functools.partial(jax.jit, static_argnames=_BCD_STATICS)(_bcd_l2_impl)
 # Donated variant: b's buffer aliases the scanned residual, A's is freed for
 # the per-block gram/cross intermediates once consumed (entry docstring).

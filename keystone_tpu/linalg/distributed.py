@@ -104,17 +104,38 @@ class RowShardedMatrix(struct.PyTreeNode):
         return self.data * self.mask[:, None]
 
     # -- linear algebra ----------------------------------------------------
-    def gram(self) -> jax.Array:
+    def gram(self, overlap: Optional[bool] = None) -> jax.Array:
         """Replicated XᵀX. The reference's ``treeReduce`` of per-partition
         grams (``BlockWeightedLeastSquares.scala:203-216``) as one sharded
-        matmul whose row contraction XLA all-reduces over ICI."""
-        X = self._masked()
-        return hdot(X.T, X)
+        matmul whose row contraction XLA all-reduces over ICI — or, with
+        ``overlap`` (None = the ``KEYSTONE_OVERLAP`` knob), as the tiled
+        reduce-scatter collective matmul whose per-tile reductions hide
+        behind the next tile's MXU work (``parallel/overlap.py``)."""
+        from keystone_tpu.parallel.overlap import (
+            maybe_tiled_transpose_matmul,
+            overlap_mesh,
+        )
 
-    def t_times(self, other: Union["RowShardedMatrix", jax.Array]) -> jax.Array:
-        """Replicated XᵀY for a co-sharded Y (the ``Aᵀb`` reduction)."""
+        X = self._masked()
+        # mesh=None (knob off) degrades to exactly hdot(X.T, X) inside
+        return maybe_tiled_transpose_matmul(X, None, overlap_mesh(overlap))
+
+    def t_times(
+        self,
+        other: Union["RowShardedMatrix", jax.Array],
+        overlap: Optional[bool] = None,
+    ) -> jax.Array:
+        """Replicated XᵀY for a co-sharded Y (the ``Aᵀb`` reduction);
+        ``overlap`` as in :meth:`gram`."""
+        from keystone_tpu.parallel.overlap import (
+            maybe_tiled_transpose_matmul,
+            overlap_mesh,
+        )
+
         Y = other._masked() if isinstance(other, RowShardedMatrix) else other
-        return hdot(self._masked().T, Y)
+        return maybe_tiled_transpose_matmul(
+            self._masked(), Y, overlap_mesh(overlap)
+        )
 
     def times(self, w: jax.Array) -> "RowShardedMatrix":
         """Row-sharded X @ w (w replicated — the broadcast-model gemm,

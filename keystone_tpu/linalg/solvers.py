@@ -90,20 +90,31 @@ def _apply_mask(A, b, mask):
     return A, b
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
-def _normal_equations(A, b, lam, mask, precision: str):
+def _gram_and_cross(A, b, precision: str, omesh):
+    """Gram + cross term for the normal-equations system: the tiled
+    reduce-scatter collective matmul when ``omesh`` is set (the overlap
+    knob, ``parallel/overlap.py``), else the monolithic ``hdot`` whose row
+    contraction XLA all-reduces. The choice is static (shapes + mesh), made
+    once per compiled program."""
+    from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
+
+    gram = maybe_tiled_transpose_matmul(A, None, omesh, precision=precision)
+    atb = maybe_tiled_transpose_matmul(A, b, omesh, precision=precision)
+    return gram, atb
+
+
+@functools.partial(jax.jit, static_argnames=("precision", "omesh"))
+def _normal_equations(A, b, lam, mask, precision: str, omesh=None):
     A, b = _apply_mask(A, b, mask)
-    gram = hdot(A.T, A, precision)
-    atb = hdot(A.T, b, precision)
+    gram, atb = _gram_and_cross(A, b, precision, omesh)
     d = A.shape[1]
     return spd_solve(gram + lam * jnp.eye(d, dtype=A.dtype), atb)
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
-def _normal_equations_lstsq(A, b, mask, precision: str):
+@functools.partial(jax.jit, static_argnames=("precision", "omesh"))
+def _normal_equations_lstsq(A, b, mask, precision: str, omesh=None):
     A, b = _apply_mask(A, b, mask)
-    gram = hdot(A.T, A, precision)
-    atb = hdot(A.T, b, precision)
+    gram, atb = _gram_and_cross(A, b, precision, omesh)
     return jnp.linalg.lstsq(gram, atb)[0]
 
 
@@ -112,19 +123,25 @@ def normal_equations_solve(
     b: jax.Array,
     lam: Optional[float] = None,
     mask: Optional[jax.Array] = None,
+    overlap: Optional[bool] = None,
 ) -> jax.Array:
     """Solve ``min ||AW - b||² (+ lam·||W||²)`` via the normal equations.
 
     ``A``: (n, d) row-sharded; ``b``: (n, c); returns replicated ``W`` (d, c).
     With ``lam=None`` uses an SVD min-norm solve of the gram system (robust to
     rank deficiency, like the unregularized ``solveLeastSquares``).
+    ``overlap`` opts the gram/cross reductions into the tiled reduce-scatter
+    collective matmul (None = the ``KEYSTONE_OVERLAP`` knob).
     """
+    from keystone_tpu.parallel.overlap import overlap_mesh
+
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
     precision = get_solver_precision()
+    omesh = overlap_mesh(overlap)
     if lam is None or lam == 0.0:
-        return _normal_equations_lstsq(A, b, mask, precision)
-    return _normal_equations(A, b, jnp.float32(lam), mask, precision)
+        return _normal_equations_lstsq(A, b, mask, precision, omesh)
+    return _normal_equations(A, b, jnp.float32(lam), mask, precision, omesh)
 
 
 def tsqr_r(A: jax.Array, mesh: Mesh) -> jax.Array:
@@ -153,8 +170,13 @@ def tsqr_r(A: jax.Array, mesh: Mesh) -> jax.Array:
     return f(A)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "ridge", "precision"))
-def _tsqr_solve(A, b, lam, mask, mesh: Mesh, ridge: bool, precision: str = "highest"):
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "ridge", "precision", "overlap")
+)
+def _tsqr_solve(
+    A, b, lam, mask, mesh: Mesh, ridge: bool, precision: str = "highest",
+    overlap: bool = False,
+):
     A, b = _apply_mask(A, b, mask)
     d = A.shape[1]
 
@@ -165,7 +187,15 @@ def _tsqr_solve(A, b, lam, mask, mesh: Mesh, ridge: bool, precision: str = "high
         Q2, R2 = jnp.linalg.qr(Rs.reshape(-1, d), mode="reduced")
         i = jax.lax.axis_index("data")
         Q2i = jax.lax.dynamic_slice_in_dim(Q2, i * d, d, 0)
-        qtb = jax.lax.psum(hdot(Q2i.T, Zi, precision), "data")
+        if overlap:
+            # tiled reduce-scatter Qᵀb: per-tile psum_scatter overlapping the
+            # next tile's matmul instead of one trailing psum (falls back to
+            # psum itself when d cannot be tiled — parallel/overlap.py)
+            from keystone_tpu.parallel.overlap import tiled_psum_dot
+
+            qtb = tiled_psum_dot(Q2i.T, Zi, "data", precision=precision)
+        else:
+            qtb = jax.lax.psum(hdot(Q2i.T, Zi, precision), "data")
         return R2, qtb
 
     # Replicated by construction (identical second-level QR everywhere);
@@ -194,17 +224,22 @@ def tsqr_solve(
     lam: float = 0.0,
     mask: Optional[jax.Array] = None,
     mesh: Optional[Mesh] = None,
+    overlap: Optional[bool] = None,
 ) -> jax.Array:
     """Least squares via TSQR, applying Qᵀ to b through the reduction tree —
     the backward-stable O(κ(A)) path, unlike the normal equations' O(κ²).
 
     Requires each data shard to hold at least ``d`` rows (tall-skinny).
+    ``overlap`` tiles the tree's Qᵀb psum into per-tile reduce-scatters
+    (None = the ``KEYSTONE_OVERLAP`` knob).
     """
     from keystone_tpu.parallel.mesh import get_mesh
+    from keystone_tpu.parallel.overlap import overlap_mesh
 
     mesh = mesh or get_mesh()
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
     return _tsqr_solve(
-        A, b, jnp.float32(lam), mask, mesh, lam > 0.0, get_solver_precision()
+        A, b, jnp.float32(lam), mask, mesh, lam > 0.0, get_solver_precision(),
+        overlap=overlap_mesh(overlap, mesh) is not None,
     )

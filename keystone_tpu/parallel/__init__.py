@@ -8,6 +8,15 @@ from keystone_tpu.parallel.mesh import (
     replicate,
     distribute,
 )
+from keystone_tpu.parallel.overlap import (
+    bidirectional_ring_gram,
+    maybe_tiled_transpose_matmul,
+    overlap_enabled,
+    overlap_mesh,
+    tiled_psum_dot,
+    tiled_transpose_matmul,
+    use_overlap,
+)
 from keystone_tpu.parallel.ring import (
     ring_attention,
     ring_gram,

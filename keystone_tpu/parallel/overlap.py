@@ -1,0 +1,284 @@
+"""Latency-hiding collectives for the block solvers.
+
+The solver stack's reductions — per-block gram matrices and cross terms over
+row-sharded data — lower by default to one bulk ICI all-reduce *after* the
+MXU matmul finishes: none of the collective time hides behind compute, the
+exact serialization "Large Scale Distributed Linear Algebra With Tensor
+Processing Units" (PAPERS.md) shows must be pipelined to reach roofline, and
+the treeReduce bottleneck KeystoneML inherited from Spark. This module is
+the pipelined alternative, opt-in via one knob:
+
+- :func:`tiled_transpose_matmul` — the **collective matmul**: ``XᵀY`` with
+  rows sharded, the output's feature axis chunked into tiles. Tile *t*'s
+  partial product is reduced with ``lax.psum_scatter`` while the MXU is
+  already multiplying tile *t+1* — k per-tile reduce-scatters the scheduler
+  can overlap, instead of a single terminal all-reduce it cannot. One
+  trailing ``all_gather`` re-assembles the replicated result (the same total
+  wire bytes as the all-reduce, but the reduce half rides under compute).
+
+- :func:`tiled_psum_dot` — the same tiling for use *inside* an existing
+  ``shard_map`` body (the TSQR tree's ``Qᵀb`` reduction).
+
+- :func:`bidirectional_ring_gram` — the feature-sharded ring gram
+  (``parallel/ring.py::ring_gram``) rotating blocks in BOTH ring directions
+  via paired ``ppermute``s: ⌈(k-1)/2⌉ rounds instead of k-1, both ICI links
+  busy every step, each block travelling at most half the ring. Tiles are
+  computed by the same matmul on the same operands as the unidirectional
+  schedule, so the result is bit-identical.
+
+The knob mirrors the cache layer (``core/cache.py``): ``KEYSTONE_OVERLAP=1``
+in the environment, ``use_overlap(True)`` as a context, or ``overlap=`` on
+any solver entry point — per-call beats context beats env. Everything
+degrades gracefully: with no mesh, a trivial mesh axis, or shapes the tiling
+cannot divide, callers fall back to the monolithic ``hdot`` path
+(:func:`maybe_tiled_transpose_matmul`), so the knob is always safe to set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from keystone_tpu.linalg.solvers import hdot
+
+_OVERLAP_STACK: list = []
+
+
+def overlap_enabled(override: Optional[bool] = None) -> bool:
+    """Resolve the overlap knob: per-call ``override`` beats the innermost
+    :func:`use_overlap` context beats the ``KEYSTONE_OVERLAP`` env var
+    (default off — the pipelined path is opt-in, like the cache)."""
+    if override is not None:
+        return bool(override)
+    if _OVERLAP_STACK:
+        return _OVERLAP_STACK[-1]
+    return os.environ.get("KEYSTONE_OVERLAP", "0") == "1"
+
+
+@contextlib.contextmanager
+def use_overlap(flag: bool):
+    """Scope the overlap knob (the ``use_cache`` pattern)."""
+    _OVERLAP_STACK.append(bool(flag))
+    try:
+        yield
+    finally:
+        _OVERLAP_STACK.pop()
+
+
+def overlap_mesh(
+    override: Optional[bool] = None,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+) -> Optional[Mesh]:
+    """The mesh to pipeline over, or None when overlap should not run:
+    knob off, no usable mesh, or a trivial (size-1) axis — a single chip has
+    no collective to hide. The returned mesh is hashable, so solvers thread
+    it through ``jax.jit`` as a static argument (the overlap decision changes
+    program structure and must never be a traced value)."""
+    if not overlap_enabled(override):
+        return None
+    if mesh is None:
+        from keystone_tpu.parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+    if axis not in mesh.shape or mesh.shape[axis] <= 1:
+        return None
+    return mesh
+
+
+def _pick_tiles(dim: int, k: int, target: Optional[int] = None) -> int:
+    """Largest tile count ≤ ``target`` (default: the axis size, so the
+    pipelined program carries ≥ k per-tile collectives when shapes allow)
+    such that ``dim`` splits into equal tiles each divisible by ``k``
+    (``psum_scatter`` scatters tile rows over the k shards). 0 = no valid
+    tiling (callers fall back to the monolithic reduction)."""
+    if dim % k:
+        return 0
+    target = target or max(k, 1)
+    for t in range(min(target, dim // k), 0, -1):
+        if dim % (t * k) == 0:
+            return t
+    return 0
+
+
+def tiled_transpose_matmul(
+    x: jax.Array,
+    y: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+    tiles: Optional[int] = None,
+    precision: Optional[str] = None,
+) -> jax.Array:
+    """Replicated ``XᵀY`` (``y=None`` → the gram ``XᵀX``) for row-sharded
+    operands, as a tiled reduce-scatter collective matmul.
+
+    ``x``: (n, dx), ``y``: (n, dy), rows sharded over ``axis``. The output's
+    dx rows are chunked into ``tiles`` tiles; per tile, the local partial
+    ``x_tileᵀ y`` is ``psum_scatter``-reduced (scattering the tile's rows
+    over the k shards) so the reduction of tile *t* overlaps the matmul of
+    tile *t+1*; one trailing ``all_gather`` + reorder replicates the result.
+    Raises ``ValueError`` when n or dx cannot be divided — use
+    :func:`maybe_tiled_transpose_matmul` for the silently-falling-back form.
+    """
+    from keystone_tpu.parallel.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    k = mesh.shape[axis]
+    y = x if y is None else y
+    n, dx = x.shape
+    if y.shape[0] != n:
+        raise ValueError(f"row mismatch: x has {n} rows, y has {y.shape[0]}")
+    if n % k:
+        raise ValueError(
+            f"row count {n} must be divisible by the '{axis}' axis size {k}"
+        )
+    T = tiles or _pick_tiles(dx, k)
+    if T == 0 or dx % (T * k):
+        raise ValueError(
+            f"feature dim {dx} cannot be tiled {tiles or '(auto)'}-way over "
+            f"the '{axis}' axis size {k}: need dim % (tiles*k) == 0"
+        )
+
+    def local(xi, yi):
+        # one shared tiling implementation (tiled_psum_dot): rows of xi.T
+        # are xi's feature columns, so this is exactly the per-tile
+        # psum_scatter + trailing all_gather schedule; divisibility was
+        # validated above, so the monolithic-psum fallback cannot trigger.
+        return tiled_psum_dot(xi.T, yi, axis, tiles=T, precision=precision)
+
+    spec = P(axis, None)
+    # check_vma=False: the all_gather + identical reorder makes the output
+    # replicated by construction; the static checker can't see that.
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec), out_specs=P(), check_vma=False
+    )(x, y)
+
+
+def maybe_tiled_transpose_matmul(
+    x: jax.Array,
+    y: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+    tiles: Optional[int] = None,
+    precision: Optional[str] = None,
+) -> jax.Array:
+    """:func:`tiled_transpose_matmul` when the mesh/shapes allow it, else the
+    monolithic ``hdot`` (whose row contraction XLA all-reduces). All checks
+    run at trace time — shapes are static — so inside a jitted solver body
+    this picks ONE path per compiled program, never a runtime branch."""
+    yy = x if y is None else y
+    if (
+        mesh is None
+        or axis not in mesh.shape
+        or mesh.shape[axis] <= 1
+        or x.ndim != 2
+        or yy.ndim != 2
+        or x.shape[0] % mesh.shape[axis]
+        or _pick_tiles(x.shape[1], mesh.shape[axis], tiles) == 0
+    ):
+        return hdot(x.T, yy, precision)
+    return tiled_transpose_matmul(
+        x, yy, mesh=mesh, axis=axis, tiles=tiles, precision=precision
+    )
+
+
+def tiled_psum_dot(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str,
+    tiles: Optional[int] = None,
+    precision: Optional[str] = None,
+) -> jax.Array:
+    """``psum(a @ b)`` over ``axis`` for use INSIDE a ``shard_map`` body,
+    tiled so each tile's reduce-scatter overlaps the next tile's matmul
+    (the TSQR tree's ``Qᵀb`` reduction). ``a``: (m, p) per-shard partial
+    factor, ``b``: (p, c); returns the replicated-by-construction (m, c)
+    sum. Falls back to the monolithic ``psum`` when m cannot be tiled."""
+    k = jax.lax.axis_size(axis)
+    m = a.shape[0]
+    T = tiles or _pick_tiles(m, k)
+    if k <= 1 or T == 0 or m % (T * k):
+        return jax.lax.psum(hdot(a, b, precision), axis)
+    tb = m // T
+    pb = tb // k
+    c = b.shape[1]
+    pieces = [
+        jax.lax.psum_scatter(
+            hdot(a[t * tb : (t + 1) * tb], b, precision),
+            axis,
+            scatter_dimension=0,
+            tiled=True,
+        )
+        for t in range(T)
+    ]
+    full = jax.lax.all_gather(jnp.concatenate(pieces, 0), axis)
+    return full.reshape(k, T, pb, c).transpose(1, 0, 2, 3).reshape(m, c)
+
+
+def bidirectional_ring_gram(
+    x: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis: str = "model",
+    precision: str = "highest",
+) -> jax.Array:
+    """``XᵀX`` with the feature axis sharded over ``axis`` — the
+    bidirectional schedule of ``ring.ring_gram``.
+
+    Two copies of the resident column block circulate the ring in opposite
+    directions via PAIRED ``ppermute``s: after round t, the forward copy on
+    device j holds block j-t and the backward copy block j+t, so each round
+    fills TWO gram tiles and the ring completes in ⌈(k-1)/2⌉ rounds instead
+    of k-1 — both ICI links carry traffic every step and each block travels
+    at most half the ring (half the per-link wire time of the unidirectional
+    rotation). Every tile is the same ``hdot`` on the same operands as the
+    unidirectional schedule, so the output is bit-identical to
+    ``ring_gram(..., bidirectional=False)``.
+
+    The rounds are unrolled (k is static and small): the compiled HLO shows
+    the paired collective-permutes per round — the structure the comm-pattern
+    tests pin — and gives the scheduler independent permute/matmul chains to
+    overlap. Odd k needs no special case; even k has one unpaired middle
+    block (distance k/2, reachable equally from either direction) folded via
+    a single final forward hop.
+    """
+    from keystone_tpu.parallel.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    k = mesh.shape[axis]
+    d = x.shape[1]
+    if d % k:
+        raise ValueError(
+            f"feature dim {d} must be divisible by the '{axis}' axis size {k}"
+        )
+    db = d // k
+    fwd_perm = [(i, (i + 1) % k) for i in range(k)]  # j receives from j-1
+    bwd_perm = [(i, (i - 1) % k) for i in range(k)]  # j receives from j+1
+
+    def local(xj):
+        j = jax.lax.axis_index(axis)
+
+        def fold(src, visiting, out):
+            tile = hdot(visiting.T, xj, precision)  # (db, db): X_srcᵀ X_j
+            return jax.lax.dynamic_update_slice(out, tile, (src * db, 0))
+
+        out = jax.lax.pcast(jnp.zeros((d, db), xj.dtype), axis, to="varying")
+        out = fold(j, xj, out)  # own tile, no hop
+        fwd = bwd = xj
+        for t in range(1, (k - 1) // 2 + 1):
+            fwd = jax.lax.ppermute(fwd, axis, fwd_perm)
+            bwd = jax.lax.ppermute(bwd, axis, bwd_perm)
+            out = fold((j - t) % k, fwd, out)
+            out = fold((j + t) % k, bwd, out)
+        if k % 2 == 0 and k > 1:
+            # unpaired middle block at distance k/2: one more forward hop
+            fwd = jax.lax.ppermute(fwd, axis, fwd_perm)
+            out = fold((j - k // 2) % k, fwd, out)
+        return out
+
+    spec = P(None, axis)
+    return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)(x)

@@ -50,7 +50,12 @@ def _ring_perm(axis_name: str):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
-def ring_gram(x: jax.Array, mesh: Optional[Mesh] = None, axis: str = "model") -> jax.Array:
+def ring_gram(
+    x: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis: str = "model",
+    bidirectional: Optional[bool] = None,
+) -> jax.Array:
     """XᵀX for ``x`` (n, d) with the feature axis sharded over ``axis``.
 
     Returns the gram column-sharded the same way: device j ends with the
@@ -58,10 +63,20 @@ def ring_gram(x: jax.Array, mesh: Optional[Mesh] = None, axis: str = "model") ->
     each device multiplies the visiting block's transpose against its own,
     filling one (d/k, d/k) tile per step — k steps, each overlapping a
     ppermute with a matmul.
+
+    ``bidirectional`` rotates blocks in BOTH ring directions via paired
+    ppermutes — ⌈(k-1)/2⌉ rounds instead of k-1, both ICI links busy, bit-
+    identical tiles (``parallel/overlap.py::bidirectional_ring_gram``).
+    ``None`` resolves the overlap knob (``KEYSTONE_OVERLAP`` /
+    ``use_overlap``), so existing call sites pick up the pipelined schedule
+    when the knob is on.
     """
     from keystone_tpu.parallel.mesh import get_mesh
+    from keystone_tpu.parallel.overlap import bidirectional_ring_gram, overlap_enabled
 
     mesh = mesh or get_mesh()
+    if overlap_enabled(bidirectional):
+        return bidirectional_ring_gram(x, mesh, axis=axis)
     k = mesh.shape[axis]
     d = x.shape[1]
     if d % k:

@@ -108,6 +108,35 @@ def _flagship() -> dict:
                 os.environ.pop("KEYSTONE_PREFETCH", None)
             else:
                 os.environ["KEYSTONE_PREFETCH"] = prev
+    # overlap-on control for the latency-hiding collectives
+    # (parallel/overlap.py): the headline warm row runs with the knob OFF
+    # (the default); this one warm run under KEYSTONE_OVERLAP=1 measures
+    # the tiled reduce-scatter solver path — on a single chip it falls
+    # back to the monolithic programs, so on/off only separates on a mesh
+    # (the row still documents that). One compile-warm run first: the
+    # pipelined programs are new compilations. BENCH_OVERLAP=0 skips.
+    if os.environ.get("BENCH_OVERLAP", "1") == "1":
+        prev = os.environ.get("KEYSTONE_OVERLAP")
+        os.environ["KEYSTONE_OVERLAP"] = "1"
+        try:
+            import time as _time
+
+            from keystone_tpu.core.cache import use_cache
+
+            with use_cache(None):  # measure overlap, not memoization hits
+                run(cfg)  # compile-warm under the flag
+                t0 = _time.perf_counter()
+                run(cfg)
+            out["imagenet_refdim_streaming_overlap_on_s"] = round(
+                _time.perf_counter() - t0, 3
+            )
+        except Exception as e:
+            print(f"flagship overlap-on row failed: {e}", file=sys.stderr)
+        finally:
+            if prev is None:
+                os.environ.pop("KEYSTONE_OVERLAP", None)
+            else:
+                os.environ["KEYSTONE_OVERLAP"] = prev
     # stage attribution AFTER the extra rows (extra barriered runs must
     # not precede — and so perturb — the async warm measurement)
     out.update(bench._try_flagship_stage_breakdown())

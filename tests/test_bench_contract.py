@@ -1,0 +1,105 @@
+"""The bench artifact contract (VERDICT r05 headline: the blind ratchet).
+
+``bench.py`` must leave a parseable record no matter how it dies: the full
+dict goes to ``bench_full.json`` and a compact JSON line is re-printed after
+EVERY section, so a driver SIGKILL/timeout at any point after the first
+section still yields a last stdout line that parses (< 1500 chars) and a
+current artifact — rc=124 can never again produce ``parsed: null``.
+
+Both tests run the real ``bench.py`` in a subprocess under ``BENCH_SMOKE=1``
+(tiny CPU shapes, heavy sections defaulted off — exactly what
+``make bench-smoke`` runs); the kill test uses the BENCH_KILL_AFTER_SECTION
+hook, which SIGKILLs the process immediately after the named section's
+flush — the driver's kill, simulated at a deterministic point.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(tmp_path, extra_env):
+    env = os.environ.copy()
+    # a clean CPU environment for the child: the bench must not inherit this
+    # test process's 8-device simulation flags (it sets up its own world)
+    env.pop("XLA_FLAGS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_SMOKE="1",
+        KEYSTONE_BENCH_BUDGET_S="120",
+        BENCH_FULL_PATH=str(tmp_path / "bench_full.json"),
+        BENCH_XLA_CACHE=str(tmp_path / "xla_cache"),
+    )
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, timeout=540, env=env, cwd=_REPO,
+    )
+
+
+def _last_line(stdout: str) -> str:
+    lines = [l for l in stdout.strip().splitlines() if l.strip()]
+    assert lines, f"bench produced no stdout: {stdout!r}"
+    return lines[-1]
+
+
+def test_bench_smoke_compact_line_contract(tmp_path):
+    """Clean smoke run: rc 0, last stdout line is the final (non-partial)
+    compact summary, parseable and under the 1500-char tail-capture bound,
+    and bench_full.json holds the full dict including the solver ladder."""
+    proc = _run_bench(tmp_path, {})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = _last_line(proc.stdout)
+    assert len(line) < 1500, len(line)
+    compact = json.loads(line)
+    assert compact["metric"] == "mnist_random_fft_fit_eval_wallclock"
+    assert isinstance(compact["value"], (int, float))
+    assert "partial" not in compact  # the FINAL line is not a partial flush
+    full = json.loads((tmp_path / "bench_full.json").read_text())
+    assert full["smoke"] is True
+    # the parameterized precision/overlap ladder emitted its base cells
+    assert "solver_gflops_per_chip" in full
+    assert "solver_gflops_per_chip_overlap" in full
+    # every line printed along the way parses too (the incremental flushes)
+    for l in proc.stdout.strip().splitlines():
+        json.loads(l)
+
+
+def test_bench_survives_sigkill_after_first_section(tmp_path):
+    """SIGKILL right after the first section's flush (the simulated driver
+    timeout): the process dies hard, but the LAST stdout line still parses
+    as a compact summary (marked partial) and bench_full.json is current."""
+    proc = _run_bench(tmp_path, {"BENCH_KILL_AFTER_SECTION": "primary"})
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stderr[-2000:]
+    )
+    line = _last_line(proc.stdout)
+    assert len(line) < 1500
+    compact = json.loads(line)
+    assert compact.get("partial") is True
+    assert compact["metric"] == "mnist_random_fft_fit_eval_wallclock"
+    full = json.loads((tmp_path / "bench_full.json").read_text())
+    assert full["metric"] == "mnist_random_fft_fit_eval_wallclock"
+
+
+def test_bench_budget_skips_big_regimes(tmp_path):
+    """A zero budget must not kill the run: every budget-gated section is
+    skipped with an explicit marker and the final line still prints."""
+    proc = _run_bench(
+        tmp_path,
+        {
+            "KEYSTONE_BENCH_BUDGET_S": "0",
+            # force one subprocess regime ON so the derate path (not just
+            # the env gate) is what skips it
+            "BENCH_FLAGSHIP": "1",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    compact = json.loads(_last_line(proc.stdout))
+    assert "partial" not in compact
+    full = json.loads((tmp_path / "bench_full.json").read_text())
+    assert full.get("imagenet_refdim_streaming_warm_s_skipped") == "budget"

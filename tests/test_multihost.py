@@ -33,7 +33,19 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # older jaxlib: pre-init XLA flag instead
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+try:  # cross-process CPU collectives ride Gloo; older jaxlib needs the
+    jax.config.update("jax_cpu_enable_gloo_collectives", True)  # explicit opt-in
+except AttributeError:
+    pass
 pid = int(sys.argv[1])
 port = sys.argv[2]
 jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
@@ -156,7 +168,7 @@ print(f"MULTIHOST_OK proc={pid}", flush=True)
 """
 
 
-def test_two_process_distributed_tsqr(tmp_path):
+def _spawn_workers(tmp_path):
     import socket
 
     worker = tmp_path / "worker.py"
@@ -190,6 +202,26 @@ def test_two_process_distributed_tsqr(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return procs, outs
+
+
+def test_two_process_distributed_tsqr(tmp_path):
+    # Older jaxlib's Gloo TCP transport has a rare startup race
+    # ("op.preamble.length <= op.nbytes", SIGABRT) whose probability spikes
+    # under host load: the failure is in the transport layer, not the
+    # framework code under test, so retry ONLY on that exact signature —
+    # any other failure asserts immediately. Backoff between attempts lets
+    # a transient load burst pass.
+    import time
+
+    for attempt in range(5):
+        procs, outs = _spawn_workers(tmp_path)
+        if not any(
+            p.returncode != 0 and "gloo::EnforceNotMet" in out
+            for p, out in zip(procs, outs)
+        ):
+            break
+        time.sleep(1 + attempt)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc{i} failed:\n{out[-3000:]}"
         assert f"MULTIHOST_OK proc={i}" in out, out[-3000:]

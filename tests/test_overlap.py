@@ -1,0 +1,335 @@
+"""Latency-hiding collectives (``parallel/overlap.py``) on the 8-device CPU
+mesh: the pipelined programs must (a) match the dense oracle / monolithic
+path to f32 tolerance, and (b) PROVE their pipelined structure in the
+compiled HLO — ≥ k per-tile reduce-scatters and NO terminal all-reduce on
+the overlap path, paired collective-permutes on the bidirectional ring.
+Numeric equivalence alone cannot catch a silent fall-back to the serialized
+collective (correct numbers, unhidden latency), so every overlap feature
+here carries both pins.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from keystone_tpu.learning import BlockLeastSquaresEstimator
+from keystone_tpu.learning.block_weighted import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.linalg import (
+    RowShardedMatrix,
+    block_coordinate_descent_l2,
+    normal_equations_solve,
+    tsqr_solve,
+)
+from keystone_tpu.linalg.solvers import hdot
+from keystone_tpu.parallel import make_mesh, use_mesh
+from keystone_tpu.parallel.overlap import (
+    _pick_tiles,
+    bidirectional_ring_gram,
+    maybe_tiled_transpose_matmul,
+    overlap_enabled,
+    overlap_mesh,
+    tiled_psum_dot,
+    tiled_transpose_matmul,
+    use_overlap,
+)
+
+
+def _collectives(hlo_text: str):
+    return {
+        name: len(re.findall(name + r"\(|" + name + r"-start\(", hlo_text))
+        for name in (
+            "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+        )
+    }
+
+
+@pytest.fixture()
+def mesh(devices):
+    m = make_mesh(data=8, model=1, devices=devices)
+    with use_mesh(m):
+        yield m
+
+
+# -- knob resolution --------------------------------------------------------
+
+
+def test_overlap_knob_resolution(monkeypatch, devices):
+    monkeypatch.delenv("KEYSTONE_OVERLAP", raising=False)
+    assert not overlap_enabled()
+    monkeypatch.setenv("KEYSTONE_OVERLAP", "1")
+    assert overlap_enabled()
+    with use_overlap(False):  # context beats env
+        assert not overlap_enabled()
+        assert overlap_enabled(True)  # per-call beats context
+    monkeypatch.setenv("KEYSTONE_OVERLAP", "0")
+    assert overlap_enabled(True)  # per-call beats env
+
+
+def test_overlap_mesh_trivial_axis_disables(devices):
+    # a single-device axis has no collective to hide: knob on, mesh None
+    m1 = make_mesh(data=1, model=1, devices=devices[:1])
+    with use_mesh(m1):
+        assert overlap_mesh(True) is None
+    m8 = make_mesh(data=8, model=1, devices=devices)
+    with use_mesh(m8):
+        assert overlap_mesh(True) is m8
+        assert overlap_mesh(False) is None  # per-call off wins
+
+
+# -- tiled reduce-scatter collective matmul ---------------------------------
+
+
+def test_tiled_gram_matches_dense(mesh, rng):
+    x = rng.normal(size=(128, 64)).astype(np.float32)
+    g = tiled_transpose_matmul(jnp.asarray(x), mesh=mesh)
+    np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-4, atol=1e-4)
+
+
+def test_tiled_cross_term_matches_dense(mesh, rng):
+    x = rng.normal(size=(128, 64)).astype(np.float32)
+    y = rng.normal(size=(128, 10)).astype(np.float32)
+    c = tiled_transpose_matmul(jnp.asarray(x), jnp.asarray(y), mesh=mesh)
+    np.testing.assert_allclose(np.asarray(c), x.T @ y, rtol=1e-4, atol=1e-4)
+
+
+def test_tiled_gram_hlo_is_pipelined(mesh, rng):
+    """THE structure pin: k per-tile reduce-scatters (one per feature tile,
+    overlappable with the next tile's matmul), ONE trailing all-gather, and
+    NO all-reduce — the monolithic program's terminal collective must not
+    exist on the overlap path."""
+    k = mesh.shape["data"]
+    x = jnp.asarray(rng.normal(size=(128, 16 * k)).astype(np.float32))
+    f = jax.jit(lambda a: tiled_transpose_matmul(a, mesh=mesh))
+    cols = _collectives(f.lower(x).compile().as_text())
+    assert cols["reduce-scatter"] >= k, cols
+    assert cols["all-reduce"] == 0, (
+        f"overlap path still carries a bulk all-reduce: {cols}"
+    )
+    assert cols["all-gather"] == 1, cols
+
+
+def test_monolithic_gram_hlo_has_terminal_all_reduce(mesh, rng):
+    """The contrast pin documenting what overlap removes: the plain sharded
+    gram lowers to matmul + ONE bulk all-reduce and no reduce-scatter."""
+    x = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    rows = NamedSharding(mesh, P("data", None))
+    f = jax.jit(lambda a: hdot(a.T, a), in_shardings=rows,
+                out_shardings=NamedSharding(mesh, P()))
+    cols = _collectives(f.lower(x).compile().as_text())
+    assert cols["all-reduce"] >= 1, cols
+    assert cols["reduce-scatter"] == 0, cols
+
+
+def test_tiled_errors_on_indivisible_shapes(mesh, rng):
+    x = jnp.asarray(rng.normal(size=(130, 64)).astype(np.float32))
+    with pytest.raises(ValueError, match="row count"):
+        tiled_transpose_matmul(x, mesh=mesh)  # 130 % 8 != 0
+    x = jnp.asarray(rng.normal(size=(128, 60)).astype(np.float32))
+    with pytest.raises(ValueError, match="tiled"):
+        tiled_transpose_matmul(x, mesh=mesh)  # 60 % 8 != 0
+    y = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    with pytest.raises(ValueError, match="row mismatch"):
+        tiled_transpose_matmul(x, y, mesh=mesh)
+
+
+def test_maybe_tiled_falls_back_on_indivisible_shapes(mesh, rng):
+    # 60 features cannot tile over 8 shards -> silently the monolithic hdot
+    x = rng.normal(size=(128, 60)).astype(np.float32)
+    g = maybe_tiled_transpose_matmul(jnp.asarray(x), None, mesh)
+    np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-4, atol=1e-4)
+    # and with no mesh at all
+    g = maybe_tiled_transpose_matmul(jnp.asarray(x), None, None)
+    np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-4, atol=1e-4)
+
+
+def test_pick_tiles():
+    assert _pick_tiles(64, 8) == 8       # 64 = 8 tiles x 8 rows
+    assert _pick_tiles(16, 8) == 2       # at most dim/k tiles
+    assert _pick_tiles(8, 8) == 1        # degenerate single tile
+    assert _pick_tiles(60, 8) == 0       # not divisible by k
+    assert _pick_tiles(64, 8, target=4) == 4
+
+
+def test_tiled_psum_dot_matches_psum(mesh, rng):
+    """The in-shard_map tiling (the TSQR Qᵀb reduction): tiled vs monolithic
+    psum of per-shard partial products."""
+    a = rng.normal(size=(8, 64, 32)).astype(np.float32)  # per-shard factors
+    b = rng.normal(size=(8, 32, 5)).astype(np.float32)
+
+    def tiled(ai, bi):
+        return tiled_psum_dot(ai[0], bi[0], "data")[None]
+
+    def mono(ai, bi):
+        return jax.lax.psum(hdot(ai[0], bi[0]), "data")[None]
+
+    spec = P("data", None, None)
+    outs = []
+    for fn in (tiled, mono):
+        f = jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        outs.append(np.asarray(f(jnp.asarray(a), jnp.asarray(b)))[0])
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        outs[0], np.einsum("kij,kjc->ic", a, b), rtol=1e-4, atol=1e-4
+    )
+
+
+# -- bidirectional ring gram ------------------------------------------------
+
+
+def test_bidirectional_ring_hlo_paired_permutes(devices, rng):
+    """Structure pin: the unrolled bidirectional schedule carries paired
+    collective-permutes — 2 per round plus the even-k middle hop (7 for
+    k=8) — and no other collective."""
+    m = make_mesh(data=1, model=8, devices=devices)
+    x = jnp.asarray(rng.normal(size=(40, 32)).astype(np.float32))
+    with use_mesh(m):
+        f = jax.jit(lambda a: bidirectional_ring_gram(a, m, axis="model"))
+        cols = _collectives(f.lower(x).compile().as_text())
+    k = 8
+    assert cols["collective-permute"] == 2 * ((k - 1) // 2) + 1, cols
+    assert cols["all-reduce"] == 0 and cols["all-gather"] == 0, cols
+
+
+# -- solver entry points: overlap on == overlap off -------------------------
+
+
+def test_normal_equations_overlap_matches(mesh, rng):
+    A = rng.normal(size=(256, 64)).astype(np.float32)
+    b = rng.normal(size=(256, 8)).astype(np.float32)
+    w0 = np.asarray(normal_equations_solve(A, b, lam=1.0))
+    w1 = np.asarray(normal_equations_solve(A, b, lam=1.0, overlap=True))
+    np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+    # unregularized (lstsq) path too
+    w0 = np.asarray(normal_equations_solve(A, b))
+    w1 = np.asarray(normal_equations_solve(A, b, overlap=True))
+    np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+
+
+def test_normal_equations_overlap_hlo_is_pipelined(mesh, rng):
+    """Acceptance pin on a REAL solver program: the jitted overlap-path
+    normal equations carry ≥ k per-tile reduce-scatters (gram + cross
+    term) and no single terminal all-reduce."""
+    from keystone_tpu.linalg.solvers import _normal_equations
+
+    k = mesh.shape["data"]
+    A = jnp.asarray(rng.normal(size=(256, 8 * k)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(256, 8)).astype(np.float32))
+    lowered = _normal_equations.lower(
+        A, b, jnp.float32(1.0), None, precision="high", omesh=mesh
+    )
+    cols = _collectives(lowered.compile().as_text())
+    assert cols["reduce-scatter"] >= k, cols
+    assert cols["all-reduce"] == 0, cols
+
+
+def test_tsqr_overlap_matches(mesh, rng):
+    A = rng.normal(size=(256, 16)).astype(np.float32)
+    b = rng.normal(size=(256, 3)).astype(np.float32)
+    w0 = np.asarray(tsqr_solve(A, b, lam=0.5, mesh=mesh))
+    w1 = np.asarray(tsqr_solve(A, b, lam=0.5, mesh=mesh, overlap=True))
+    np.testing.assert_allclose(w1, w0, rtol=1e-5, atol=1e-6)
+
+
+def test_bcd_overlap_matches(mesh, rng):
+    A = rng.normal(size=(256, 64)).astype(np.float32)
+    b = rng.normal(size=(256, 8)).astype(np.float32)
+    for num_iter in (1, 3):  # pass-0 grams AND the cached-gram scan path
+        w0 = np.asarray(
+            block_coordinate_descent_l2(A, b, 1.0, 16, num_iter=num_iter)
+        )
+        w1 = np.asarray(
+            block_coordinate_descent_l2(
+                A, b, 1.0, 16, num_iter=num_iter, overlap=True
+            )
+        )
+        np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+
+
+def test_row_sharded_matrix_overlap_matches(mesh, rng):
+    x = rng.normal(size=(250, 64)).astype(np.float32)  # padded rows masked
+    y = rng.normal(size=(250, 8)).astype(np.float32)
+    M = RowShardedMatrix.from_array(x, mesh)
+    np.testing.assert_allclose(
+        np.asarray(M.gram(overlap=True)), np.asarray(M.gram()),
+        rtol=1e-4, atol=1e-4,
+    )
+    Y = RowShardedMatrix.from_array(y, mesh)
+    np.testing.assert_allclose(
+        np.asarray(M.t_times(Y, overlap=True)), np.asarray(M.t_times(Y)),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(M.gram(overlap=True)), x.T @ x, rtol=1e-3, atol=1e-3
+    )
+
+
+# -- learning-layer plumbing (composes with the streamed block passes) ------
+
+
+def _feature_nodes(rng, d=12, b=16, nblocks=2):
+    from keystone_tpu.core.pipeline import chain
+    from keystone_tpu.ops.stats import CosineRandomFeatures
+
+    keys = jax.random.split(jax.random.key(3), nblocks)
+    return [
+        chain(CosineRandomFeatures.create(d, b, 0.1, keys[i]))
+        for i in range(nblocks)
+    ]
+
+
+def test_block_ls_streaming_overlap_matches(mesh, rng):
+    nodes = _feature_nodes(rng)
+    x = jnp.asarray(rng.normal(size=(128, 12)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(128, 5)).astype(np.float32))
+    ref = BlockLeastSquaresEstimator(16, num_iter=2, lam=0.5).fit_streaming(
+        nodes, x, y
+    )
+    got = BlockLeastSquaresEstimator(
+        16, num_iter=2, lam=0.5, overlap=True
+    ).fit_streaming(nodes, x, y)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_block_weighted_streaming_overlap_matches(mesh, rng):
+    n, ds, cs = 128, 16, 4
+    raw = jnp.asarray(rng.normal(size=(n, 2 * ds)).astype(np.float32))
+    # real pytree nodes: one cosine-RF block per column half
+    nodes = _feature_nodes(rng, d=2 * ds, b=ds, nblocks=2)
+    labels = jnp.asarray(
+        (np.eye(cs)[np.arange(n) % cs] * 2.0 - 1.0).astype(np.float32)
+    )
+    ref = BlockWeightedLeastSquaresEstimator(ds, 1, 0.1, 0.25).fit_streaming(
+        nodes, raw, labels
+    )
+    got = BlockWeightedLeastSquaresEstimator(
+        ds, 1, 0.1, 0.25, overlap=True
+    ).fit_streaming(nodes, raw, labels)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_env_knob_routes_solvers(mesh, rng, monkeypatch):
+    """KEYSTONE_OVERLAP=1 with no per-call arg must route through the tiled
+    path (pin: the env-resolved program contains reduce-scatters)."""
+    from keystone_tpu.linalg.solvers import _normal_equations
+
+    monkeypatch.setenv("KEYSTONE_OVERLAP", "1")
+    A = rng.normal(size=(256, 64)).astype(np.float32)
+    b = rng.normal(size=(256, 8)).astype(np.float32)
+    omesh = overlap_mesh()
+    assert omesh is mesh
+    w0 = np.asarray(normal_equations_solve(A, b, lam=1.0))
+    monkeypatch.setenv("KEYSTONE_OVERLAP", "0")
+    w1 = np.asarray(normal_equations_solve(A, b, lam=1.0))
+    np.testing.assert_allclose(w0, w1, rtol=1e-4, atol=1e-5)

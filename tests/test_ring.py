@@ -36,6 +36,47 @@ def test_ring_gram_matches_dense(devices, rng):
     np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("k", [2, 5, 7, 8])
+def test_ring_gram_bidirectional_matches_unidirectional(devices, rng, k):
+    """Bidirectional-vs-unidirectional parity across odd and even ring
+    sizes: every tile is the same matmul on the same operands, so the
+    results must be IDENTICAL (not merely close), and both must match the
+    dense oracle."""
+    m = make_mesh(data=1, model=k, devices=devices[:k])
+    x = rng.normal(size=(24, 8 * k)).astype(np.float32)
+    with use_mesh(m):
+        uni = np.asarray(ring_gram(jnp.asarray(x), m, axis="model",
+                                   bidirectional=False))
+        bi = np.asarray(ring_gram(jnp.asarray(x), m, axis="model",
+                                  bidirectional=True))
+    np.testing.assert_array_equal(bi, uni)
+    np.testing.assert_allclose(bi, x.T @ x, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_gram_overlap_knob_routes_bidirectional(devices, rng):
+    from keystone_tpu.parallel.overlap import use_overlap
+
+    m = make_mesh(data=1, model=8, devices=devices)
+    x = rng.normal(size=(24, 32)).astype(np.float32)
+    with use_mesh(m):
+        explicit = np.asarray(
+            ring_gram(jnp.asarray(x), m, axis="model", bidirectional=True)
+        )
+        with use_overlap(True):  # bidirectional=None resolves the knob
+            via_knob = np.asarray(ring_gram(jnp.asarray(x), m, axis="model"))
+    np.testing.assert_array_equal(via_knob, explicit)
+
+
+def test_ring_gram_rejects_indivisible_feature_axis(devices, rng):
+    m = make_mesh(data=1, model=8, devices=devices)
+    x = jnp.asarray(rng.normal(size=(24, 30)).astype(np.float32))
+    with use_mesh(m):
+        with pytest.raises(ValueError, match="divisible"):
+            ring_gram(x, m, axis="model", bidirectional=False)
+        with pytest.raises(ValueError, match="divisible"):
+            ring_gram(x, m, axis="model", bidirectional=True)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_reference(mesh, causal):
     q, k, v = _qkv()
@@ -50,6 +91,18 @@ def test_ulysses_attention_matches_reference(mesh, causal):
     out = ulysses_attention(q, k, v, mesh, causal=causal)
     ref = attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_rejects_indivisible_sequence_axis(mesh):
+    q, k, v = _qkv((2, 30, 8, 4))  # 30 % 8 != 0
+    with pytest.raises(ValueError, match="sequence length"):
+        ring_attention(q, k, v, mesh)
+
+
+def test_ulysses_rejects_indivisible_head_axis(mesh):
+    q, k, v = _qkv((2, 32, 6, 4))  # 6 heads % 8 devices != 0
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, mesh)
 
 
 def test_ring_attention_long_sequence_streams(mesh):
