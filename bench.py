@@ -68,7 +68,7 @@ if _SMOKE:
     for _gate in ("BENCH_EXTRAS", "BENCH_FLAGSHIP", "BENCH_VOC_REFDIM",
                   "BENCH_TIMIT_FULL", "BENCH_CACHED", "BENCH_PREFETCH",
                   "BENCH_MOMENTS", "BENCH_CONSTANTS", "BENCH_SERVE",
-                  "BENCH_STAGES"):
+                  "BENCH_STAGES", "BENCH_SOLVER_OVERLAP"):
         os.environ.setdefault(_gate, "0")
 
 # Total wall-clock budget for the whole bench run. The driver kills at
@@ -922,6 +922,18 @@ def main():
     else:
         out.update(_try_solver_gflops_ladder())
     _flush(out, "solver_gflops")
+    # Topology-aware overlap ladder (scripts/bench_regime.py solver_overlap):
+    # tsqr_overlap_{on,off}_gflops + bcd_model_overlap_{on,off}_gflops in a
+    # fresh process, timeout derated from the remaining budget like every
+    # other regime. On the single driver chip the knobs fall back (parity
+    # documents it); a >=4-chip run ratchets the measured delta.
+    if os.environ.get("BENCH_SOLVER_OVERLAP", "1") == "1":
+        out.update(
+            _run_regime_subprocess(
+                "solver_overlap", fail_key="tsqr_overlap_on_gflops"
+            )
+        )
+        _flush(out, "solver_overlap")
     # Big regimes (flagship / VOC-refdim / full-TIMIT) each run in a FRESH
     # OS process (scripts/bench_regime.py): round 4 measured the in-bench
     # flagship ~1.4x slower than the same code in a fresh process (20.1 s
@@ -1045,6 +1057,11 @@ _COMPACT_KEYS = (
     # flagship stage attribution (GFLOPs where a formula exists, else s)
     ("g_solver", "solver_gflops_per_chip"),
     ("g_solver_ov", "solver_gflops_per_chip_overlap"),
+    # topology-aware overlap ladder (scripts/bench_regime.py solver_overlap)
+    ("g_tsqr", "tsqr_overlap_off_gflops"),
+    ("g_tsqr_ov", "tsqr_overlap_on_gflops"),
+    ("g_bcdm", "bcd_model_overlap_off_gflops"),
+    ("g_bcdm_ov", "bcd_model_overlap_on_gflops"),
     ("s_feat", "stage_solve.featurize_s"),
     ("g_feat", "stage_solve.featurize_gflops"),
     ("g_pop", "stage_solve.pop_stats_gflops"),

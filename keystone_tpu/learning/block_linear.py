@@ -259,6 +259,23 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
     def fit(self, data, labels, mask: Optional[jax.Array] = None) -> BlockLinearMapper:
         A, B, feature_scaler, label_scaler, mask = center_for_solve(data, labels, mask)
+        # Re-pin the caller's sharding onto the centered copy: the
+        # column-sharded (P('data','model')) overlap regime in
+        # linalg/bcd.py is gated on A's CONCRETE NamedSharding, and eager
+        # centering is not guaranteed to preserve it — without this a
+        # column-sharded fit would silently take the resharding path.
+        from jax.sharding import NamedSharding as _NS
+
+        from keystone_tpu.core.dataset import Dataset as _DS
+
+        src = data.data if isinstance(data, _DS) else data
+        sh = getattr(src, "sharding", None)
+        if (
+            isinstance(sh, _NS)
+            and getattr(A, "shape", None) == getattr(src, "shape", None)
+            and getattr(A, "sharding", None) != sh
+        ):
+            A = jax.device_put(A, sh)
         # A/B are centered temporaries this frame alone owns — donate them
         # so the solver's residual/gram intermediates reuse their HBM
         # instead of allocating a second (n, d) + (n, c) next to them

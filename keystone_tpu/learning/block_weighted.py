@@ -64,24 +64,41 @@ def _class_col_means(R, class_idx, counts):
     return per_class, jnp.sum(per_class, axis=0) / c
 
 
-@functools.partial(jax.jit, static_argnames=("precision", "omesh"))
-def _pop_stats(Xb, R, valid, n_eff, precision: str, omesh=None):
+@functools.partial(
+    jax.jit, static_argnames=("precision", "omesh", "model_overlap")
+)
+def _pop_stats(Xb, R, valid, n_eff, precision: str, omesh=None,
+               model_overlap: bool = False):
     """Population mean / covariance / XᵀR for one block (pass 0,
     ``:190-212``). Row-sharded matmuls -> ICI all-reduce; with the overlap
     knob (``omesh`` set, static) both reductions run as tiled reduce-scatter
     collective matmuls whose per-tile psums hide behind the next tile's MXU
-    work (``parallel/overlap.py``). ``Xb`` may arrive bf16 (the streaming
-    group cache); the f32 upcast lives only inside this program."""
-    from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
+    work (``parallel/overlap.py``). ``model_overlap`` (static; the
+    column-sharded ``P('data','model')`` in-core regime) composes the
+    model-axis block rotation with the data-axis tile loop instead, so the
+    block's columns are reduced in place on their owning ranks. ``Xb`` may
+    arrive bf16 (the streaming group cache); the f32 upcast lives only
+    inside this program."""
+    from keystone_tpu.parallel.overlap import (
+        maybe_tiled_transpose_matmul,
+        model_tiled_transpose_matmul,
+    )
+
+    if model_overlap:
+        def _reduce(X, Y):
+            return model_tiled_transpose_matmul(
+                X, Y, omesh, precision=precision
+            )
+    else:
+        def _reduce(X, Y):
+            return maybe_tiled_transpose_matmul(
+                X, Y, omesh, precision=precision
+            )
 
     Xv = Xb.astype(jnp.float32) * valid[:, None]
     pop_mean = jnp.sum(Xv, axis=0) / n_eff
-    pop_cov = maybe_tiled_transpose_matmul(
-        Xv, None, omesh, precision=precision
-    ) / n_eff - jnp.outer(pop_mean, pop_mean)
-    pop_xtr = maybe_tiled_transpose_matmul(
-        Xv, R, omesh, precision=precision
-    ) / n_eff
+    pop_cov = _reduce(Xv, None) / n_eff - jnp.outer(pop_mean, pop_mean)
+    pop_xtr = _reduce(Xv, R) / n_eff
     return pop_mean, pop_cov, pop_xtr
 
 
@@ -469,7 +486,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
     def _run(self, get_block, num_blocks: int, labels, mask, precision: str,
              checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
-             block_group=None, _force_dense: bool = False):
+             block_group=None, _force_dense: bool = False,
+             model_overlap: bool = False):
         """Shared weighted-BCD loop. ``get_block(b)`` returns the
         (n, block_size) feature block in original row order — no global
         class sort exists anywhere (see ``_prepare``).
@@ -576,6 +594,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     get_block, num_blocks, labels, mask, precision,
                     checkpoint_path, checkpoint_every,
                     block_group=block_group, _force_dense=True,
+                    model_overlap=model_overlap,
                 )
             # restore the guard's evidence for already-completed blocks —
             # without this a resumed fit under-reports max cond and the
@@ -694,7 +713,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             if pop_stats_cache[b] is None:
                 with _phase("pop_stats"):
                     pop_mean, pop_cov, pop_xtr = _pop_stats(
-                        Xb, R, valid, n_eff, precision=precision, omesh=omesh
+                        Xb, R, valid, n_eff, precision=precision, omesh=omesh,
+                        model_overlap=model_overlap,
                     )
                 # base inverse depends only on pop_cov/λ/w: once per
                 # block, cached with the pop stats across iterations
@@ -725,9 +745,14 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 joint_means_b = joint_means_blocks[b]
                 from keystone_tpu.parallel.overlap import (
                     maybe_tiled_transpose_matmul,
+                    model_tiled_transpose_matmul,
                 )
 
-                pop_xtr = maybe_tiled_transpose_matmul(
+                _xtr = (
+                    model_tiled_transpose_matmul
+                    if model_overlap else maybe_tiled_transpose_matmul
+                )
+                pop_xtr = _xtr(
                     Xb.astype(jnp.float32) * valid[:, None], R, omesh,
                     precision=precision,
                 ) / n_eff
@@ -791,6 +816,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         get_block, num_blocks, labels, mask, precision,
                         checkpoint_path, checkpoint_every,
                         block_group=block_group, _force_dense=True,
+                        model_overlap=model_overlap,
                     )
 
         W = jnp.concatenate(models, axis=0)
@@ -810,6 +836,20 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         from keystone_tpu.linalg.solvers import get_solver_precision
 
         precision = get_solver_precision()
+        # Column-sharded in-core data (P('data','model') — the beyond-HBM
+        # feature regime): per-block pop-cov/XᵀR reductions compose the
+        # model-axis block rotation with the data-axis tile loop
+        # (parallel/overlap.py::model_tiled_transpose_matmul). Decided once
+        # per fit from the concrete sharding, BEFORE the column pad (which
+        # may reshard); False falls back per shape.
+        from keystone_tpu.parallel.overlap import (
+            model_overlap_spec,
+            overlap_mesh,
+        )
+
+        model_overlap = model_overlap_spec(
+            data, overlap_mesh(self.overlap), self.block_size
+        )
         d_pad = -(-d // self.block_size) * self.block_size
         if d_pad != d:
             data = jnp.pad(data, ((0, 0), (0, d_pad - d)))
@@ -821,7 +861,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             )
 
         W, joint_means, joint_label_mean = self._run(
-            get_block, num_blocks, labels, mask, precision
+            get_block, num_blocks, labels, mask, precision,
+            model_overlap=model_overlap,
         )
         W = W[:d]
         joint_means = joint_means[:, :d]

@@ -57,6 +57,14 @@ def block_coordinate_descent_l2(
     block. Requires row-sharded ``A`` with rows divisible by the mesh's
     ``data`` axis; anything else falls back per-shape at trace time.
 
+    With a column-sharded ``A`` (``P('data','model')`` — the 256k-dim FV
+    regime) and the knob on, each block's gram/cross reductions run as the
+    two-axis collective matmul (``model_tiled_transpose_matmul``): the
+    model-axis block rotation composed with the tiled data-axis
+    reduce-scatter, decided statically per compiled program via
+    ``model_overlap_spec`` (anything that does not divide falls back to the
+    row-sharded tiling, logged once).
+
     ``donate=True`` donates ``A`` and ``b`` to the solve: callers passing
     temporaries they will never read again (the estimators' centered
     copies) let XLA reuse those buffers for the scan's residual and
@@ -65,12 +73,13 @@ def block_coordinate_descent_l2(
     array is DEAD after the call (jax raises on reuse); never set it for
     arrays the caller still owns."""
     from keystone_tpu.linalg.solvers import validate_precision
-    from keystone_tpu.parallel.overlap import overlap_mesh
+    from keystone_tpu.parallel.overlap import model_overlap_spec, overlap_mesh
 
     if precision is not None:
         validate_precision(precision)
     precision = precision or get_solver_precision()
     omesh = overlap_mesh(overlap)
+    model_overlap = model_overlap_spec(A, omesh, block_size)
     if donate:
         # the outputs (d, c) can never alias the (n, ·) inputs, so jax warns
         # that donation found no output alias — expected: the donation here
@@ -84,10 +93,11 @@ def block_coordinate_descent_l2(
             )
             return _bcd_l2_donated(
                 A, b, lam, block_size, num_iter, mask, cache_grams, precision,
-                omesh,
+                omesh, model_overlap,
             )
     return _bcd_l2(
-        A, b, lam, block_size, num_iter, mask, cache_grams, precision, omesh
+        A, b, lam, block_size, num_iter, mask, cache_grams, precision, omesh,
+        model_overlap,
     )
 
 
@@ -101,6 +111,7 @@ def _bcd_l2_impl(
     cache_grams: bool = True,
     precision: str = "high",
     omesh=None,
+    model_overlap: bool = False,
 ) -> jax.Array:
     """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
 
@@ -136,12 +147,27 @@ def _bcd_l2_impl(
     # each becomes a tiled reduce-scatter collective matmul — per-tile
     # psum_scatter hidden behind the next tile's matmul — instead of the
     # monolithic hdot whose row contraction XLA all-reduces AFTER the gemm.
-    from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
+    # model_overlap (static; the column-sharded P('data','model') regime)
+    # further composes the model-axis block rotation with the data-axis
+    # tile loop (model_tiled_transpose_matmul) so the active block is never
+    # resharded: each model rank reduces its resident columns in place.
+    from keystone_tpu.parallel.overlap import (
+        maybe_tiled_transpose_matmul,
+        model_tiled_transpose_matmul,
+    )
 
     def _gram(Ak):
+        if model_overlap:
+            return model_tiled_transpose_matmul(
+                Ak, None, omesh, precision=precision
+            )
         return maybe_tiled_transpose_matmul(Ak, None, omesh, precision=precision)
 
     def _cross(Ak, R):
+        if model_overlap:
+            return model_tiled_transpose_matmul(
+                Ak, R, omesh, precision=precision
+            )
         return maybe_tiled_transpose_matmul(Ak, R, omesh, precision=precision)
 
     use_cache = num_iter > 1 and cache_grams
@@ -173,7 +199,10 @@ def _bcd_l2_impl(
     return W[:d]
 
 
-_BCD_STATICS = ("block_size", "num_iter", "cache_grams", "precision", "omesh")
+_BCD_STATICS = (
+    "block_size", "num_iter", "cache_grams", "precision", "omesh",
+    "model_overlap",
+)
 _bcd_l2 = functools.partial(jax.jit, static_argnames=_BCD_STATICS)(_bcd_l2_impl)
 # Donated variant: b's buffer aliases the scanned residual, A's is freed for
 # the per-block gram/cross intermediates once consumed (entry docstring).

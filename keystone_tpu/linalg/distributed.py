@@ -152,11 +152,15 @@ class RowShardedMatrix(struct.PyTreeNode):
         n = X.shape[0] if self.mask is None else jnp.sum(self.mask)
         return jnp.sum(X, axis=0) / n
 
-    def qr_r(self, mesh: Optional[Mesh] = None) -> jax.Array:
-        """R factor via two-level TSQR over ICI (``linalg/solvers.py``)."""
+    def qr_r(
+        self, mesh: Optional[Mesh] = None, overlap: Optional[bool] = None
+    ) -> jax.Array:
+        """R factor via two-level TSQR over ICI (``linalg/solvers.py``);
+        ``overlap`` (None = the ``KEYSTONE_OVERLAP`` knob) folds the R tree
+        through the bidirectional ring instead of one bulk all-gather."""
         from keystone_tpu.parallel.mesh import get_mesh
 
-        return tsqr_r(self._masked(), mesh or get_mesh())
+        return tsqr_r(self._masked(), mesh or get_mesh(), overlap=overlap)
 
     def collect(self) -> np.ndarray:
         """Valid rows as one host array (the reference's ``collect()``;
@@ -217,9 +221,11 @@ class TSQR:
     """The upstream ml-matrix TSQR solver (BASELINE.json north star): QR tree
     over the ``data`` axis, O(κ(A)) where normal equations are O(κ²)."""
 
-    def solve_least_squares(self, A, b, lam: float = 0.0) -> jax.Array:
+    def solve_least_squares(
+        self, A, b, lam: float = 0.0, overlap: Optional[bool] = None
+    ) -> jax.Array:
         A, b, mask = _solver_args(A, b)
-        return tsqr_solve(A, b, lam=lam, mask=mask)
+        return tsqr_solve(A, b, lam=lam, mask=mask, overlap=overlap)
 
 
 class BlockCoordinateDescent:
@@ -240,13 +246,18 @@ class BlockCoordinateDescent:
         lams: Union[float, Sequence[float]],
         num_iter: int = 1,
         block_size: int = 2048,
+        overlap: Optional[bool] = None,
     ) -> Union[jax.Array, list[jax.Array]]:
         A, b, mask = _solver_args(A, b)
         if np.ndim(lams) == 0:
             return block_coordinate_descent_l2(
-                A, b, float(lams), block_size, num_iter, mask=mask
+                A, b, float(lams), block_size, num_iter, mask=mask,
+                overlap=overlap,
             )
         return [
-            block_coordinate_descent_l2(A, b, float(l), block_size, num_iter, mask=mask)
+            block_coordinate_descent_l2(
+                A, b, float(l), block_size, num_iter, mask=mask,
+                overlap=overlap,
+            )
             for l in lams
         ]

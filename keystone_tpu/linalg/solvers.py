@@ -144,7 +144,9 @@ def normal_equations_solve(
     return _normal_equations(A, b, jnp.float32(lam), mask, precision, omesh)
 
 
-def tsqr_r(A: jax.Array, mesh: Mesh) -> jax.Array:
+def tsqr_r(
+    A: jax.Array, mesh: Mesh, overlap: Optional[bool] = None
+) -> jax.Array:
     """R factor of ``A`` via two-level TSQR over the ``data`` mesh axis.
 
     Per-shard QR, all-gather the R_i factors over ICI, QR the stack:
@@ -153,11 +155,31 @@ def tsqr_r(A: jax.Array, mesh: Mesh) -> jax.Array:
     TPUs"). Returns a replicated (d, d) upper-triangular R with
     ``RᵀR = AᵀA`` — computed without ever forming the gram, so the
     conditioning is κ(A), not κ(A)².
+
+    ``overlap`` (None = the ``KEYSTONE_OVERLAP`` knob) replaces the bulk
+    R-stack ``all_gather`` + monolithic second-level QR with the
+    bidirectional ring fold (``parallel/overlap.py::ring_tsqr_fold``):
+    paired per-round ``ppermute``s hidden behind incremental panel QRs,
+    zero bulk collectives. Same ``RᵀR`` (row signs may differ — QR's sign
+    freedom; both conventions satisfy the contract).
     """
+    from keystone_tpu.parallel.overlap import overlap_mesh, ring_tsqr_fold
+
     d = A.shape[1]
+    use_ring = overlap_mesh(overlap, mesh) is not None
 
     def local(Ai):
         Ri = jnp.linalg.qr(Ai, mode="r")
+        if use_ring:
+            R, _ = ring_tsqr_fold(Ri, None, "data")
+            # Canonicalize row signs (diag >= 0): devices fold the same
+            # factors in different ring orders, so without this each shard
+            # of the 'replicated' output could carry its own QR sign
+            # convention — O(1) divergence for any consumer that reads R
+            # shard-locally. Fixed signs leave only rounding-level
+            # (~eps·κ) cross-device differences, inside f32 tolerance.
+            s = jnp.where(jnp.diagonal(R) < 0, -1.0, 1.0).astype(R.dtype)
+            return R * s[:, None]
         Rs = jax.lax.all_gather(Ri, "data")
         return jnp.linalg.qr(Rs.reshape(-1, d), mode="r")
 
@@ -183,19 +205,19 @@ def _tsqr_solve(
     def local(Ai, bi):
         Qi, Ri = jnp.linalg.qr(Ai, mode="reduced")
         Zi = hdot(Qi.T, bi, precision)  # this shard's Qᵀb contribution, rotated
+        if overlap:
+            # overlapped R-tree (parallel/overlap.py::ring_tsqr_fold): the
+            # (R_i, Z_i) pairs circulate via paired ppermutes and fold into
+            # an incremental second-level panel QR — Qᵀb rides through the
+            # fold, so the bulk all_gather AND the trailing psum both vanish
+            from keystone_tpu.parallel.overlap import ring_tsqr_fold
+
+            return ring_tsqr_fold(Ri, Zi, "data", precision)
         Rs = jax.lax.all_gather(Ri, "data")  # (k, d, d) over ICI
         Q2, R2 = jnp.linalg.qr(Rs.reshape(-1, d), mode="reduced")
         i = jax.lax.axis_index("data")
         Q2i = jax.lax.dynamic_slice_in_dim(Q2, i * d, d, 0)
-        if overlap:
-            # tiled reduce-scatter Qᵀb: per-tile psum_scatter overlapping the
-            # next tile's matmul instead of one trailing psum (falls back to
-            # psum itself when d cannot be tiled — parallel/overlap.py)
-            from keystone_tpu.parallel.overlap import tiled_psum_dot
-
-            qtb = tiled_psum_dot(Q2i.T, Zi, "data", precision=precision)
-        else:
-            qtb = jax.lax.psum(hdot(Q2i.T, Zi, precision), "data")
+        qtb = jax.lax.psum(hdot(Q2i.T, Zi, precision), "data")
         return R2, qtb
 
     # Replicated by construction (identical second-level QR everywhere);
@@ -230,8 +252,10 @@ def tsqr_solve(
     the backward-stable O(κ(A)) path, unlike the normal equations' O(κ²).
 
     Requires each data shard to hold at least ``d`` rows (tall-skinny).
-    ``overlap`` tiles the tree's Qᵀb psum into per-tile reduce-scatters
-    (None = the ``KEYSTONE_OVERLAP`` knob).
+    ``overlap`` (None = the ``KEYSTONE_OVERLAP`` knob) runs the R-factor
+    tree as the bidirectional ring fold — paired ``ppermute``s hidden
+    behind incremental second-level panel QRs, with ``Qᵀb`` carried through
+    the fold — instead of one bulk ``all_gather`` + monolithic QR + psum.
     """
     from keystone_tpu.parallel.mesh import get_mesh
     from keystone_tpu.parallel.overlap import overlap_mesh

@@ -26,27 +26,78 @@ the pipelined alternative, opt-in via one knob:
   computed by the same matmul on the same operands as the unidirectional
   schedule, so the result is bit-identical.
 
+Topology-aware extensions (the second layer on top of the tiling):
+
+- **Two-tier ICI/DCN reduce-scatter** — on multi-slice meshes the sharded
+  axis is not uniform: within-slice hops ride ICI, cross-slice hops ride
+  DCN (an order of magnitude less bandwidth). :func:`mesh_tiers` probes the
+  slice structure from ``jax.devices()`` (``KEYSTONE_MESH_TIERS`` overrides)
+  and :func:`tiled_psum_dot` splits each tile's reduction into an inner
+  within-slice ``psum_scatter`` (ICI) plus an outer cross-slice exchange
+  that ships only the already-reduced slice partials (1/inner of the bytes)
+  over DCN — batched over several inner tiles (per-tier tile sizes) so each
+  slow DCN exchange hides behind more MXU work than one ICI tile buys.
+
+- :func:`ring_tsqr_fold` — the overlapped TSQR R-tree: instead of one bulk
+  ``all_gather`` of the per-shard R factors followed by one monolithic
+  second-level QR, the (R_i, Qᵢᵀb_i) pairs circulate the ring in both
+  directions via paired ``ppermute``s and each arrival is folded into a
+  running QR panel factorization — the per-round permute hides behind the
+  previous round's panel QR, and the Qᵀb rotation rides through the same
+  fold (no separate psum at all).
+
+- :func:`model_tiled_transpose_matmul` — the column-sharded
+  (``P('data','model')``) regime: the model-axis block rotation of
+  :func:`bidirectional_ring_gram` composed with the data-axis tile loop, so
+  the 256k-dim BCD blocks' gram/cross reductions overlap on BOTH axes.
+
 The knob mirrors the cache layer (``core/cache.py``): ``KEYSTONE_OVERLAP=1``
 in the environment, ``use_overlap(True)`` as a context, or ``overlap=`` on
-any solver entry point — per-call beats context beats env. Everything
-degrades gracefully: with no mesh, a trivial mesh axis, or shapes the tiling
-cannot divide, callers fall back to the monolithic ``hdot`` path
-(:func:`maybe_tiled_transpose_matmul`), so the knob is always safe to set.
+any solver entry point — per-call beats context beats env. Tile counts come
+from :func:`_pick_tiles` (``KEYSTONE_OVERLAP_TILES`` overrides per-topology).
+Everything degrades gracefully: with no mesh, a trivial mesh axis, or shapes
+the tiling cannot divide, callers fall back to the monolithic ``hdot`` path
+(:func:`maybe_tiled_transpose_matmul`) — and since a silently-fallen-back
+flagship run is indistinguishable from an overlapped one in bench output,
+every such fallback is logged once per call-site/shape via ``logging``.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.linalg.solvers import hdot
+from keystone_tpu.parallel.ring import bidirectional_rounds, paired_ring_perms
 
 _OVERLAP_STACK: list = []
+
+# One warning per (site, detail) for the life of the process: the fallback
+# is a trace-time decision that re-fires on every solver call with the same
+# shapes, and a log line per block×iteration would drown the run.
+_FALLBACK_LOGGED: set = set()
+
+
+def _log_fallback(site: str, detail: str) -> None:
+    """Rate-limited (once per site+shape) warning that an overlap-requested
+    reduction fell back to the monolithic collective — without this a
+    mis-tiled flagship run looks identical to an overlapped one in the
+    bench output."""
+    key = (site, detail)
+    if key in _FALLBACK_LOGGED:
+        return
+    _FALLBACK_LOGGED.add(key)
+    from keystone_tpu.utils import get_logger
+
+    get_logger("keystone_tpu.parallel.overlap").warning(
+        "overlap fallback at %s: %s — using the monolithic collective "
+        "(logged once per shape)", site, detail,
+    )
 
 
 def overlap_enabled(override: Optional[bool] = None) -> bool:
@@ -87,23 +138,125 @@ def overlap_mesh(
 
         mesh = get_mesh()
     if axis not in mesh.shape or mesh.shape[axis] <= 1:
+        _log_fallback(
+            "overlap_mesh",
+            f"knob on but '{axis}' axis is trivial "
+            f"(mesh {dict(mesh.shape)}) — nothing to hide",
+        )
         return None
     return mesh
 
 
+def _env_tiles() -> Tuple[Optional[int], Optional[int]]:
+    """Parse ``KEYSTONE_OVERLAP_TILES``: ``"T"`` (inner tile-count target)
+    or ``"T,To"`` (inner target, outer/DCN exchange count) — the
+    per-topology tuning knob for :func:`_pick_tiles`, so tile counts can be
+    tuned without code edits. Returns (None, None) when unset; raises
+    ``ValueError`` on anything that is not one or two positive integers."""
+    raw = os.environ.get("KEYSTONE_OVERLAP_TILES", "").strip()
+    if not raw:
+        return None, None
+    parts = [p.strip() for p in raw.split(",")]
+    try:
+        vals = [int(p) for p in parts]
+    except ValueError:
+        vals = []
+    if len(vals) not in (1, 2) or any(v < 1 for v in vals):
+        raise ValueError(
+            f"KEYSTONE_OVERLAP_TILES={raw!r} is invalid: expected one or two "
+            "positive integers ('<inner_tiles>' or '<inner_tiles>,"
+            "<outer_exchanges>'), e.g. KEYSTONE_OVERLAP_TILES=8 or "
+            "KEYSTONE_OVERLAP_TILES=8,2"
+        )
+    return vals[0], (vals[1] if len(vals) == 2 else None)
+
+
 def _pick_tiles(dim: int, k: int, target: Optional[int] = None) -> int:
-    """Largest tile count ≤ ``target`` (default: the axis size, so the
-    pipelined program carries ≥ k per-tile collectives when shapes allow)
-    such that ``dim`` splits into equal tiles each divisible by ``k``
-    (``psum_scatter`` scatters tile rows over the k shards). 0 = no valid
-    tiling (callers fall back to the monolithic reduction)."""
+    """Largest tile count ≤ ``target`` (default: the ``KEYSTONE_OVERLAP_TILES``
+    env override when set, else the axis size — so the pipelined program
+    carries ≥ k per-tile collectives when shapes allow) such that ``dim``
+    splits into equal tiles each divisible by ``k`` (``psum_scatter``
+    scatters tile rows over the k shards). 0 = no valid tiling (callers
+    fall back to the monolithic reduction)."""
     if dim % k:
         return 0
+    if target is None:
+        target = _env_tiles()[0]
     target = target or max(k, 1)
     for t in range(min(target, dim // k), 0, -1):
         if dim % (t * k) == 0:
             return t
     return 0
+
+
+def mesh_tiers(mesh: Mesh, axis: str = "data") -> Tuple[int, int]:
+    """(outer, inner) factorization of the ``axis`` size into communication
+    tiers: ``inner`` devices per slice (ICI-connected) × ``outer`` slices
+    (connected over DCN). Single-tier meshes return ``(1, k)``.
+
+    Resolution order: ``KEYSTONE_MESH_TIERS=<num_slices>`` (validated:
+    must be a positive integer dividing the axis size) beats the probe.
+    The probe walks the mesh's devices along ``axis`` and groups them by
+    slice identity (``slice_index`` where the platform exposes it, else
+    ``process_index`` — one host per slice on multi-host CPU/TPU pods);
+    only a clean tiering — equal-length contiguous runs per slice — is
+    accepted, anything irregular degrades to single-tier (logged once)."""
+    k = mesh.shape[axis]
+    raw = os.environ.get("KEYSTONE_MESH_TIERS", "").strip()
+    if raw:
+        try:
+            outer = int(raw)
+        except ValueError:
+            outer = -1
+        if outer < 1 or k % outer:
+            raise ValueError(
+                f"KEYSTONE_MESH_TIERS={raw!r} is invalid for the '{axis}' "
+                f"axis of size {k}: expected a positive integer number of "
+                f"slices dividing {k} (e.g. KEYSTONE_MESH_TIERS=2)"
+            )
+        return outer, k // outer
+    # probe: devices along the axis (first coordinate of every other axis —
+    # mesh construction tiles slices identically across the other axes)
+    import numpy as np
+
+    idx = list(mesh.axis_names).index(axis)
+    devs = np.moveaxis(mesh.devices, idx, 0).reshape(k, -1)[:, 0]
+    ids = [getattr(d, "slice_index", None) for d in devs]
+    if any(i is None for i in ids):
+        ids = [getattr(d, "process_index", 0) for d in devs]
+    uniq = []
+    for i in ids:  # contiguous-run compression, order-preserving
+        if not uniq or uniq[-1] != i:
+            uniq.append(i)
+    outer = len(uniq)
+    if outer <= 1 or len(set(uniq)) != outer or k % outer:
+        if outer > 1:
+            _log_fallback(
+                "mesh_tiers", f"irregular slice layout {ids} on '{axis}'"
+            )
+        return 1, k
+    inner = k // outer
+    if any(ids[s * inner] != ids[s * inner + j]
+           for s in range(outer) for j in range(inner)):
+        _log_fallback(
+            "mesh_tiers", f"unequal slice runs {ids} on '{axis}'"
+        )
+        return 1, k
+    return outer, inner
+
+
+def _tier_groups(outer: int, inner: int):
+    """``axis_index_groups`` for the two tiers of a (outer × inner)-tiered
+    axis, device axis index i = slice*inner + local: inner groups reduce
+    within a slice (ICI), outer groups exchange one-member-per-slice
+    partials (DCN)."""
+    inner_groups = [
+        [s * inner + j for j in range(inner)] for s in range(outer)
+    ]
+    outer_groups = [
+        [s * inner + j for s in range(outer)] for j in range(inner)
+    ]
+    return inner_groups, outer_groups
 
 
 def tiled_transpose_matmul(
@@ -113,6 +266,7 @@ def tiled_transpose_matmul(
     axis: str = "data",
     tiles: Optional[int] = None,
     precision: Optional[str] = None,
+    tiers: Optional[Tuple[int, int]] = None,
 ) -> jax.Array:
     """Replicated ``XᵀY`` (``y=None`` → the gram ``XᵀX``) for row-sharded
     operands, as a tiled reduce-scatter collective matmul.
@@ -122,6 +276,8 @@ def tiled_transpose_matmul(
     ``x_tileᵀ y`` is ``psum_scatter``-reduced (scattering the tile's rows
     over the k shards) so the reduction of tile *t* overlaps the matmul of
     tile *t+1*; one trailing ``all_gather`` + reorder replicates the result.
+    ``tiers`` (default: :func:`mesh_tiers` — the probe / ``KEYSTONE_MESH_TIERS``)
+    engages the two-tier ICI/DCN schedule on multi-slice meshes.
     Raises ``ValueError`` when n or dx cannot be divided — use
     :func:`maybe_tiled_transpose_matmul` for the silently-falling-back form.
     """
@@ -143,13 +299,16 @@ def tiled_transpose_matmul(
             f"feature dim {dx} cannot be tiled {tiles or '(auto)'}-way over "
             f"the '{axis}' axis size {k}: need dim % (tiles*k) == 0"
         )
+    tiers = tiers or mesh_tiers(mesh, axis)
 
     def local(xi, yi):
         # one shared tiling implementation (tiled_psum_dot): rows of xi.T
         # are xi's feature columns, so this is exactly the per-tile
         # psum_scatter + trailing all_gather schedule; divisibility was
         # validated above, so the monolithic-psum fallback cannot trigger.
-        return tiled_psum_dot(xi.T, yi, axis, tiles=T, precision=precision)
+        return tiled_psum_dot(
+            xi.T, yi, axis, tiles=T, precision=precision, tiers=tiers
+        )
 
     spec = P(axis, None)
     # check_vma=False: the all_gather + identical reorder makes the output
@@ -170,7 +329,9 @@ def maybe_tiled_transpose_matmul(
     """:func:`tiled_transpose_matmul` when the mesh/shapes allow it, else the
     monolithic ``hdot`` (whose row contraction XLA all-reduces). All checks
     run at trace time — shapes are static — so inside a jitted solver body
-    this picks ONE path per compiled program, never a runtime branch."""
+    this picks ONE path per compiled program, never a runtime branch.
+    A shape-driven fallback on a live overlap mesh is logged once per shape
+    (:func:`_log_fallback`) so a mis-tiled run is visible in the log."""
     yy = x if y is None else y
     if (
         mesh is None
@@ -178,9 +339,21 @@ def maybe_tiled_transpose_matmul(
         or mesh.shape[axis] <= 1
         or x.ndim != 2
         or yy.ndim != 2
-        or x.shape[0] % mesh.shape[axis]
-        or _pick_tiles(x.shape[1], mesh.shape[axis], tiles) == 0
     ):
+        return hdot(x.T, yy, precision)
+    k = mesh.shape[axis]
+    if x.shape[0] % k:
+        _log_fallback(
+            "maybe_tiled_transpose_matmul",
+            f"rows {x.shape[0]} % '{axis}' size {k} != 0",
+        )
+        return hdot(x.T, yy, precision)
+    if _pick_tiles(x.shape[1], k, tiles) == 0:
+        _log_fallback(
+            "maybe_tiled_transpose_matmul",
+            f"feature dim {x.shape[1]} has no tiling over '{axis}' size {k}"
+            + (f" with tiles={tiles}" if tiles else ""),
+        )
         return hdot(x.T, yy, precision)
     return tiled_transpose_matmul(
         x, yy, mesh=mesh, axis=axis, tiles=tiles, precision=precision
@@ -193,31 +366,84 @@ def tiled_psum_dot(
     axis: str,
     tiles: Optional[int] = None,
     precision: Optional[str] = None,
+    tiers: Optional[Tuple[int, int]] = None,
+    outer_tiles: Optional[int] = None,
 ) -> jax.Array:
     """``psum(a @ b)`` over ``axis`` for use INSIDE a ``shard_map`` body,
     tiled so each tile's reduce-scatter overlaps the next tile's matmul
     (the TSQR tree's ``Qᵀb`` reduction). ``a``: (m, p) per-shard partial
     factor, ``b``: (p, c); returns the replicated-by-construction (m, c)
-    sum. Falls back to the monolithic ``psum`` when m cannot be tiled."""
+    sum. Falls back to the monolithic ``psum`` when m cannot be tiled.
+
+    ``tiers=(outer, inner)`` (from :func:`mesh_tiers`; must factor the axis
+    size) splits every tile's reduction in two: an inner within-slice
+    ``psum_scatter`` over ICI, then a cross-slice exchange that ships only
+    the slice partials — 1/inner of the bytes — over DCN. The DCN exchanges
+    are batched ``outer_tiles``-wise (default: one per slice, i.e. each DCN
+    exchange hides behind ~T/outer inner tiles' MXU work; the second field
+    of ``KEYSTONE_OVERLAP_TILES=T,To`` overrides): per-tier tile sizes, so
+    the slow tier always has more compute to hide behind."""
     k = jax.lax.axis_size(axis)
     m = a.shape[0]
     T = tiles or _pick_tiles(m, k)
     if k <= 1 or T == 0 or m % (T * k):
         return jax.lax.psum(hdot(a, b, precision), axis)
+    outer, inner = tiers or (1, k)
+    if outer > 1 and outer * inner != k:
+        # a tier map probed from a different axis (or hand-tuned wrong)
+        # must not silently run single-tier — the operator would believe
+        # the DCN schedule is active
+        _log_fallback(
+            "tiled_psum_dot",
+            f"tiers {tiers} do not factor the '{axis}' axis size {k}",
+        )
+        outer, inner = 1, k
+    if outer <= 1:
+        outer, inner = 1, k
     tb = m // T
     pb = tb // k
     c = b.shape[1]
-    pieces = [
-        jax.lax.psum_scatter(
-            hdot(a[t * tb : (t + 1) * tb], b, precision),
-            axis,
-            scatter_dimension=0,
-            tiled=True,
-        )
-        for t in range(T)
+    partials = [
+        hdot(a[t * tb : (t + 1) * tb], b, precision) for t in range(T)
     ]
+    if outer == 1:
+        pieces = [
+            jax.lax.psum_scatter(p, axis, scatter_dimension=0, tiled=True)
+            for p in partials
+        ]
+        full = jax.lax.all_gather(jnp.concatenate(pieces, 0), axis)
+        return full.reshape(k, T, pb, c).transpose(1, 0, 2, 3).reshape(m, c)
+    inner_groups, outer_groups = _tier_groups(outer, inner)
+    # inner tier (ICI): one within-slice reduce-scatter per tile — device
+    # (s, j) ends with rows [j·pb·outer, (j+1)·pb·outer) of the tile,
+    # summed over its slice s.
+    inner_pieces = [
+        jax.lax.psum_scatter(
+            p, axis, scatter_dimension=0, tiled=True,
+            axis_index_groups=inner_groups,
+        )
+        for p in partials
+    ]
+    # outer tier (DCN): cross-slice exchanges of the slice partials,
+    # batched r inner tiles per exchange (per-tier tile sizes).
+    To = outer_tiles or _env_tiles()[1] or min(T, outer)
+    r = -(-T // max(To, 1))
+    pieces = []
+    for g0 in range(0, T, r):
+        stack = jnp.stack(inner_pieces[g0 : g0 + r])  # (r', pb·outer, c)
+        red = jax.lax.psum_scatter(
+            stack, axis, scatter_dimension=1, tiled=True,
+            axis_index_groups=outer_groups,
+        )  # (r', pb, c): device (s, j) holds sub-chunk s of its chunk j
+        pieces.append(red.reshape(-1, c))
     full = jax.lax.all_gather(jnp.concatenate(pieces, 0), axis)
-    return full.reshape(k, T, pb, c).transpose(1, 0, 2, 3).reshape(m, c)
+    # device i = s·inner + j holds, per tile, chunk q = j·outer + s — the
+    # reorder below walks (tile, j, s) so chunks land in ascending order.
+    return (
+        full.reshape(outer, inner, T, pb, c)
+        .transpose(2, 1, 0, 3, 4)
+        .reshape(m, c)
+    )
 
 
 def bidirectional_ring_gram(
@@ -256,29 +482,233 @@ def bidirectional_ring_gram(
             f"feature dim {d} must be divisible by the '{axis}' axis size {k}"
         )
     db = d // k
-    fwd_perm = [(i, (i + 1) % k) for i in range(k)]  # j receives from j-1
-    bwd_perm = [(i, (i - 1) % k) for i in range(k)]  # j receives from j+1
 
     def local(xj):
-        j = jax.lax.axis_index(axis)
-
         def fold(src, visiting, out):
             tile = hdot(visiting.T, xj, precision)  # (db, db): X_srcᵀ X_j
             return jax.lax.dynamic_update_slice(out, tile, (src * db, 0))
 
         out = jax.lax.pcast(jnp.zeros((d, db), xj.dtype), axis, to="varying")
-        out = fold(j, xj, out)  # own tile, no hop
-        fwd = bwd = xj
-        for t in range(1, (k - 1) // 2 + 1):
-            fwd = jax.lax.ppermute(fwd, axis, fwd_perm)
-            bwd = jax.lax.ppermute(bwd, axis, bwd_perm)
-            out = fold((j - t) % k, fwd, out)
-            out = fold((j + t) % k, bwd, out)
-        if k % 2 == 0 and k > 1:
-            # unpaired middle block at distance k/2: one more forward hop
-            fwd = jax.lax.ppermute(fwd, axis, fwd_perm)
-            out = fold((j - k // 2) % k, fwd, out)
-        return out
+        return _ring_rotate_fold(xj, axis, k, fold, out)
 
     spec = P(None, axis)
     return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)(x)
+
+
+def _ring_rotate_fold(x0, axis: str, k: int, fold, out):
+    """The one bidirectional rotation schedule, shared by every block-ring
+    consumer (feature-sharded gram above, the model-axis gram below): fold
+    the resident block, then ⌈(k-1)/2⌉ paired fwd/bwd ``ppermute`` rounds
+    folding both arrivals, then the even-k unpaired middle hop.
+    ``fold(src, visiting, out)`` folds the block that originated on device
+    ``src``. Keeping the schedule in one place means a fix to the rotation
+    (and the permute counts the comm-pattern tests pin) cannot silently
+    apply to one consumer and not the other."""
+    j = jax.lax.axis_index(axis)
+    fwd_perm, bwd_perm = paired_ring_perms(k)  # j receives from j∓1
+    out = fold(j, x0, out)  # own block, no hop
+    fwd = bwd = x0
+    for t in range(1, bidirectional_rounds(k) + 1):
+        fwd = jax.lax.ppermute(fwd, axis, fwd_perm)
+        bwd = jax.lax.ppermute(bwd, axis, bwd_perm)
+        out = fold((j - t) % k, fwd, out)
+        out = fold((j + t) % k, bwd, out)
+    if k % 2 == 0 and k > 1:
+        # unpaired middle block at distance k/2: one more forward hop
+        fwd = jax.lax.ppermute(fwd, axis, fwd_perm)
+        out = fold((j - k // 2) % k, fwd, out)
+    return out
+
+
+def ring_tsqr_fold(
+    Ri: jax.Array,
+    Zi: Optional[jax.Array],
+    axis: str,
+    precision: Optional[str] = None,
+):
+    """The overlapped TSQR R-tree, for use INSIDE a ``shard_map`` body.
+
+    ``Ri``: this shard's R factor from its local QR; ``Zi``: this shard's
+    rotated rhs contribution ``Qᵢᵀbᵢ`` (None when only R is wanted, e.g.
+    ``tsqr_r``). Instead of one bulk ``all_gather`` of the R_i stack
+    followed by one monolithic second-level QR, the original (R_i, Z_i)
+    pairs circulate the ring in BOTH directions via paired ``ppermute``s
+    (the :func:`bidirectional_ring_gram` machinery) and every arrival is
+    folded into a running panel factorization:
+
+        Q, R_acc ← qr([R_acc; R_fwd; R_bwd]),  Z_acc ← Qᵀ[Z_acc; Z_fwd; Z_bwd]
+
+    so round t's permute is in flight while round t-1's panel QR runs on
+    the compute units, and the ``Qᵀb`` reduction rides through the same
+    fold — no separate psum, no bulk collective at all. ⌈(k-1)/2⌉ paired
+    rounds (+ one forward hop for even k); works for ANY shard count and
+    any d (no tiling divisibility requirement).
+
+    Returns (R, Z): replicated by construction up to fold order — every
+    device folds the same set of factors, so RᵀR (and the least-squares
+    solution R⁻¹Z) agree to rounding; row signs of R may differ between
+    devices, but each device's (R, Z) pair is internally consistent, which
+    is all the triangular solve consumes."""
+    k = jax.lax.axis_size(axis)
+    if k <= 1:
+        return Ri, Zi
+    fwd_perm, bwd_perm = paired_ring_perms(k)
+
+    def fold(R_acc, Z_acc, Rs, Zs):
+        stack = jnp.concatenate([R_acc] + Rs, axis=0)
+        if Z_acc is None:
+            return jnp.linalg.qr(stack, mode="r"), None
+        Q, R = jnp.linalg.qr(stack, mode="reduced")
+        return R, hdot(Q.T, jnp.concatenate([Z_acc] + Zs, axis=0), precision)
+
+    R_acc, Z_acc = Ri, Zi
+    fR = bR = Ri
+    fZ = bZ = Zi
+    for _ in range(bidirectional_rounds(k)):
+        if Zi is None:
+            fR = jax.lax.ppermute(fR, axis, fwd_perm)
+            bR = jax.lax.ppermute(bR, axis, bwd_perm)
+        else:
+            fR, fZ = jax.lax.ppermute((fR, fZ), axis, fwd_perm)
+            bR, bZ = jax.lax.ppermute((bR, bZ), axis, bwd_perm)
+        R_acc, Z_acc = fold(R_acc, Z_acc, [fR, bR], [fZ, bZ])
+    if k % 2 == 0:
+        # unpaired middle factor at distance k/2: one more forward hop
+        if Zi is None:
+            fR = jax.lax.ppermute(fR, axis, fwd_perm)
+        else:
+            fR, fZ = jax.lax.ppermute((fR, fZ), axis, fwd_perm)
+        R_acc, Z_acc = fold(R_acc, Z_acc, [fR], [fZ])
+    return R_acc, Z_acc
+
+
+def model_tiled_transpose_matmul(
+    x: jax.Array,
+    y: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
+    model_axis: str = "model",
+    tiles: Optional[int] = None,
+    precision: Optional[str] = None,
+) -> jax.Array:
+    """Replicated ``XᵀY`` (``y=None`` → the gram ``XᵀX``) for a
+    column-sharded ``x``: (n, dx) with ``P(data_axis, model_axis)`` — the
+    256k-dim BCD regime where one chip cannot hold a block's columns.
+
+    The gram composes BOTH overlap schedules: the resident column block of
+    every model rank rotates the model-axis ring bidirectionally (paired
+    ``ppermute``s, the :func:`bidirectional_ring_gram` schedule) while each
+    visiting×resident tile's row reduction runs as the tiled data-axis
+    reduce-scatter (:func:`tiled_psum_dot`, two-tier aware) — so the model
+    hop of rotation t overlaps the data-axis reduction of rotation t-1,
+    which itself overlaps the next tile's matmul. The cross term (``y``:
+    (n, c) sharded ``P(data_axis, None)``) needs no rotation: each rank
+    reduces its resident columns against y and one model-axis ``all_gather``
+    assembles the (dx, c) result.
+
+    Raises ``ValueError`` on shapes the two-axis tiling cannot divide —
+    callers (``linalg/bcd.py``) gate on :func:`model_overlap_spec` at trace
+    time instead of calling blindly."""
+    from keystone_tpu.parallel.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    kd = mesh.shape[data_axis]
+    km = mesh.shape[model_axis]
+    n, dx = x.shape
+    if n % kd:
+        raise ValueError(
+            f"row count {n} must be divisible by the '{data_axis}' axis "
+            f"size {kd}"
+        )
+    if dx % km:
+        raise ValueError(
+            f"feature dim {dx} must be divisible by the '{model_axis}' "
+            f"axis size {km}"
+        )
+    dl = dx // km
+    tiers = mesh_tiers(mesh, data_axis)
+
+    if y is not None:
+        if y.shape[0] != n:
+            raise ValueError(
+                f"row mismatch: x has {n} rows, y has {y.shape[0]}"
+            )
+        c = y.shape[1]
+
+        def local_cross(xij, yi):
+            cj = tiled_psum_dot(
+                xij.T, yi, data_axis, tiles=tiles, precision=precision,
+                tiers=tiers,
+            )  # (dl, c), replicated over data by construction
+            full = jax.lax.all_gather(cj, model_axis)  # (km, dl, c)
+            return full.reshape(dx, c)
+
+        return jax.shard_map(
+            local_cross,
+            mesh=mesh,
+            in_specs=(P(data_axis, model_axis), P(data_axis, None)),
+            out_specs=P(),
+            check_vma=False,
+        )(x, y)
+
+    def local_gram(xij):
+        def fold(src, visiting, out):
+            # (dl, dl) tile X_srcᵀ X_j, globally row-reduced via the tiled
+            # data-axis reduce-scatter (two-tier aware)
+            tile = tiled_psum_dot(
+                visiting.T, xij, data_axis, tiles=tiles,
+                precision=precision, tiers=tiers,
+            )
+            return jax.lax.dynamic_update_slice(out, tile, (src * dl, 0))
+
+        out = jax.lax.pcast(
+            jnp.zeros((dx, dl), xij.dtype), model_axis, to="varying"
+        )
+        out = _ring_rotate_fold(xij, model_axis, km, fold, out)
+        # out: (dx, dl) column block, replicated over data; assemble the
+        # replicated (dx, dx) gram with one model-axis all_gather
+        full = jax.lax.all_gather(out, model_axis)  # (km, dx, dl)
+        return full.transpose(1, 0, 2).reshape(dx, dx)
+
+    return jax.shard_map(
+        local_gram,
+        mesh=mesh,
+        in_specs=P(data_axis, model_axis),
+        out_specs=P(),
+        check_vma=False,
+    )(x)
+
+
+def model_overlap_spec(
+    A,
+    omesh: Optional[Mesh],
+    block_size: int,
+    data_axis: str = "data",
+    model_axis: str = "model",
+) -> bool:
+    """Trace-time gate for the column-sharded overlap path: True when the
+    overlap mesh has a non-trivial model axis, ``A`` is concretely sharded
+    ``P(data_axis, model_axis)``, and the per-block shapes divide both axes.
+    A column-sharded ``A`` that narrowly misses (e.g. block_size not
+    divisible by the model axis) logs the fallback once — the regime the
+    knob was set for would otherwise silently reshard every block."""
+    if omesh is None or omesh.shape.get(model_axis, 1) <= 1:
+        return False
+    sh = getattr(A, "sharding", None)
+    if not (
+        isinstance(sh, NamedSharding)
+        and getattr(A, "ndim", 0) == 2
+        and len(sh.spec) >= 2
+        and sh.spec[1] == model_axis
+    ):
+        return False
+    km = omesh.shape[model_axis]
+    kd = omesh.shape[data_axis]
+    if A.shape[0] % kd or block_size % km:
+        _log_fallback(
+            "model_overlap",
+            f"column-sharded A {A.shape} with block {block_size} does not "
+            f"divide mesh ({data_axis}={kd}, {model_axis}={km})",
+        )
+        return False
+    return True

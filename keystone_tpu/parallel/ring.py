@@ -50,6 +50,23 @@ def _ring_perm(axis_name: str):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+def paired_ring_perms(k: int):
+    """(fwd, bwd) ``ppermute`` tables for the bidirectional ring schedules:
+    fwd rotates so device j receives from j-1, bwd so j receives from j+1.
+    Shared by every bidirectional consumer (``overlap.bidirectional_ring_gram``,
+    the overlapped TSQR R-tree, the model-axis block rotation) so the paired
+    structure the comm-pattern tests pin is built in exactly one place."""
+    fwd = [(i, (i + 1) % k) for i in range(k)]
+    bwd = [(i, (i - 1) % k) for i in range(k)]
+    return fwd, bwd
+
+
+def bidirectional_rounds(k: int) -> int:
+    """Paired rounds of the bidirectional ring: ⌈(k-1)/2⌉ with one extra
+    unpaired forward hop when k is even (the distance-k/2 middle block)."""
+    return (k - 1) // 2
+
+
 def ring_gram(
     x: jax.Array,
     mesh: Optional[Mesh] = None,

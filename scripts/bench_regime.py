@@ -10,9 +10,12 @@ makes each row ordering-independent by construction; the persistent XLA
 compile cache (configured on ``import bench``) keeps the fresh-process
 cold run cheap. VERDICT r4 weak #6 / next #7.
 
-Usage: ``python scripts/bench_regime.py {flagship|voc_refdim|timit_full}``
-— the LAST stdout line is the regime's result dict (full-dict key names,
-exactly what bench.py's in-process blocks used to produce).
+Usage: ``python scripts/bench_regime.py
+{flagship|voc_refdim|timit_full|solver_overlap}`` — the LAST stdout line is
+the regime's result dict (full-dict key names, exactly what bench.py's
+in-process blocks used to produce). ``solver_overlap`` emits the
+topology-aware overlap ladder (``tsqr_overlap_{on,off}_gflops`` +
+``bcd_model_overlap_{on,off}_gflops``) for the ≥4-chip on/off ratchet.
 """
 
 import json
@@ -182,10 +185,139 @@ def _timit_full() -> dict:
     }
 
 
+def _latency_cancelled_gflops(solve, flops: float, iters: int) -> float:
+    """(time of 1+iters chained solves) − (time of 1), like
+    ``bench.solver_gflops``: device dispatches execute serially, so the
+    difference is pure device time and the host↔device round-trip cancels."""
+    import time
+
+    def timed(k: int) -> float:
+        ws = [solve(i) for i in range(k)]
+        last = float(ws[-1].ravel()[0])  # warm compile + drain the chain
+        t0 = time.perf_counter()
+        ws = [solve(100 + i) for i in range(k)]
+        last = float(ws[-1].ravel()[0])
+        if last != last:
+            raise FloatingPointError("solver produced NaN")
+        return time.perf_counter() - t0
+
+    dt = (timed(1 + iters) - timed(1)) / iters
+    if dt <= 0:
+        raise RuntimeError(f"non-positive timing difference: {dt}")
+    return flops / dt / 1e9
+
+
+def _try_gflops(key_name: str, solve, flops: float, iters: int):
+    """One retry absorbs transient timing noise (dt<=0 on a contended
+    chip), mirroring ``bench._try_solver_gflops``; genuine failures are
+    logged to stderr and the row stays None (visible, never blocking)."""
+    for attempt in range(2):
+        try:
+            return round(_latency_cancelled_gflops(solve, flops, iters), 1)
+        except Exception as e:
+            print(
+                f"{key_name} attempt {attempt + 1} failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+    return None
+
+
+def _solver_overlap() -> dict:
+    """The topology-aware overlap ladder: ``tsqr_overlap_{on,off}_gflops``
+    (the bidirectional ring R-tree vs the bulk all-gather tree) and
+    ``bcd_model_overlap_{on,off}_gflops`` (the column-sharded
+    ``P('data','model')`` block solve with the model-axis rotation composed
+    with the tiled data reductions, vs the monolithic path).
+
+    On the single driver chip every overlap knob falls back to the
+    monolithic program (no collective to hide / no model axis), so on/off
+    parity here documents the fallback; the rows exist so the next ≥4-chip
+    run can ratchet the measured delta (ROADMAP "measured on/off deltas on
+    a real pod"). Budget derating rides the subprocess timeout bench.py
+    hands this regime."""
+    import bench  # configures the XLA compile cache; holds _SMOKE
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+    from keystone_tpu.linalg.solvers import tsqr_solve
+    from keystone_tpu.parallel import make_mesh, use_mesh
+
+    smoke = bench._SMOKE
+    ndev = len(jax.devices())
+    out: dict = {}
+
+    # --- overlapped TSQR tree ------------------------------------------
+    # d=512 keeps the per-solve Householder QR (not MXU-shaped — measured
+    # ~0.5 s warm at 65536x512 even on the CPU host) small enough that the
+    # whole ladder fits the derated subprocess timeout on any backend.
+    n = (2048 if smoke else 65536) // ndev * ndev
+    d, c = 128 if smoke else 512, 10
+    iters = 2 if smoke else 4
+    mesh = make_mesh(data=ndev, model=1)
+    with use_mesh(mesh):
+        key = jax.random.key(0)
+        A = jax.device_put(
+            jax.random.normal(key, (n, d), jnp.float32),
+            NamedSharding(mesh, P("data", None)),
+        )
+        b = jax.device_put(
+            jax.random.normal(jax.random.key(1), (n, c), jnp.float32),
+            NamedSharding(mesh, P("data", None)),
+        )
+        flops = 2.0 * n * d * d + 2.0 * n * d * c
+        for on in (False, True):
+            key_name = f"tsqr_overlap_{'on' if on else 'off'}_gflops"
+            out[key_name] = _try_gflops(
+                key_name,
+                lambda i: tsqr_solve(A, b, lam=1.0 + i, mesh=mesh, overlap=on),
+                flops, iters,
+            )
+
+    # --- model-axis (column-sharded) BCD -------------------------------
+    model_ax = 2 if ndev % 2 == 0 and ndev >= 2 else 1
+    mesh2 = make_mesh(data=max(ndev // model_ax, 1), model=model_ax)
+    n2 = (4096 if smoke else 60000) // mesh2.shape["data"] * mesh2.shape["data"]
+    d2 = 512 if smoke else 2048
+    block = 256 if smoke else 2048
+    iters2 = 2 if smoke else 4
+    with use_mesh(mesh2):
+        A2 = jax.device_put(
+            jax.random.normal(jax.random.key(2), (n2, d2), jnp.float32),
+            NamedSharding(mesh2, P("data", "model")),
+        )
+        b2 = jax.device_put(
+            jax.random.normal(jax.random.key(3), (n2, c), jnp.float32),
+            NamedSharding(mesh2, P("data", None)),
+        )
+        nblocks = -(-d2 // block)
+        flops2 = nblocks * (
+            2.0 * n2 * block * block + 4.0 * n2 * block * c
+            + 2.0 * block * block * c
+        ) + (2.0 / 3.0) * nblocks * block ** 3
+        for on in (False, True):
+            key_name = f"bcd_model_overlap_{'on' if on else 'off'}_gflops"
+            out[key_name] = _try_gflops(
+                key_name,
+                lambda i: block_coordinate_descent_l2(
+                    A2, b2, 1.0 + i, block, overlap=on
+                ),
+                flops2, iters2,
+            )
+    out["solver_overlap_mesh"] = (
+        f"tsqr data={ndev}; bcd data={mesh2.shape['data']}"
+        f" model={mesh2.shape['model']}"
+    )
+    return out
+
+
 _REGIMES = {
     "flagship": _flagship,
     "voc_refdim": _voc_refdim,
     "timit_full": _timit_full,
+    "solver_overlap": _solver_overlap,
 }
 
 

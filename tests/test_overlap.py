@@ -24,12 +24,15 @@ from keystone_tpu.linalg import (
     normal_equations_solve,
     tsqr_solve,
 )
-from keystone_tpu.linalg.solvers import hdot
+from keystone_tpu.linalg.solvers import hdot, tsqr_r
 from keystone_tpu.parallel import make_mesh, use_mesh
 from keystone_tpu.parallel.overlap import (
     _pick_tiles,
     bidirectional_ring_gram,
     maybe_tiled_transpose_matmul,
+    mesh_tiers,
+    model_overlap_spec,
+    model_tiled_transpose_matmul,
     overlap_enabled,
     overlap_mesh,
     tiled_psum_dot,
@@ -333,3 +336,317 @@ def test_env_knob_routes_solvers(mesh, rng, monkeypatch):
     monkeypatch.setenv("KEYSTONE_OVERLAP", "0")
     w1 = np.asarray(normal_equations_solve(A, b, lam=1.0))
     np.testing.assert_allclose(w0, w1, rtol=1e-4, atol=1e-5)
+
+
+# -- KEYSTONE_OVERLAP_TILES (per-topology tile override) --------------------
+
+
+def test_overlap_tiles_env_override(monkeypatch):
+    monkeypatch.delenv("KEYSTONE_OVERLAP_TILES", raising=False)
+    assert _pick_tiles(64, 8) == 8  # default target: the axis size
+    monkeypatch.setenv("KEYSTONE_OVERLAP_TILES", "4")
+    assert _pick_tiles(64, 8) == 4
+    monkeypatch.setenv("KEYSTONE_OVERLAP_TILES", "2,1")  # inner,outer form
+    assert _pick_tiles(64, 8) == 2
+    # explicit target still beats the env (per-call beats env, as always)
+    assert _pick_tiles(64, 8, target=8) == 8
+
+
+def test_overlap_tiles_env_rejects_nonsense(monkeypatch):
+    for bad in ("0", "-3", "banana", "2,0", "1,2,3", "2.5", ","):
+        monkeypatch.setenv("KEYSTONE_OVERLAP_TILES", bad)
+        with pytest.raises(ValueError, match="KEYSTONE_OVERLAP_TILES"):
+            _pick_tiles(64, 8)
+
+
+# -- two-tier ICI/DCN reduce-scatter ----------------------------------------
+
+
+def test_mesh_tiers_probe_and_env(mesh, monkeypatch):
+    monkeypatch.delenv("KEYSTONE_MESH_TIERS", raising=False)
+    # CPU sim: every device shares one process -> single tier
+    assert mesh_tiers(mesh) == (1, 8)
+    monkeypatch.setenv("KEYSTONE_MESH_TIERS", "2")
+    assert mesh_tiers(mesh) == (2, 4)
+    monkeypatch.setenv("KEYSTONE_MESH_TIERS", "8")
+    assert mesh_tiers(mesh) == (8, 1)
+    for bad in ("3", "0", "-2", "x", "2x4"):
+        monkeypatch.setenv("KEYSTONE_MESH_TIERS", bad)
+        with pytest.raises(ValueError, match="KEYSTONE_MESH_TIERS"):
+            mesh_tiers(mesh)
+
+
+def test_two_tier_matches_single_tier(mesh, rng, monkeypatch):
+    """The fake two-slice tier map over the CPU mesh must reproduce the
+    single-tier result. Not bit-identical by construction — the two-tier
+    schedule sums slice partials before crossing slices, a different f32
+    addition order — so the pin is dense-oracle equivalence at the tiling
+    tests' tolerance plus exact agreement between the env-declared and
+    explicitly-passed tier maps (identical schedules -> identical bits)."""
+    monkeypatch.delenv("KEYSTONE_MESH_TIERS", raising=False)
+    x = rng.normal(size=(128, 64)).astype(np.float32)
+    g1 = np.asarray(tiled_transpose_matmul(jnp.asarray(x), mesh=mesh))
+    g_exp = np.asarray(
+        tiled_transpose_matmul(jnp.asarray(x), mesh=mesh, tiers=(2, 4))
+    )
+    monkeypatch.setenv("KEYSTONE_MESH_TIERS", "2")
+    g_env = np.asarray(tiled_transpose_matmul(jnp.asarray(x), mesh=mesh))
+    np.testing.assert_array_equal(g_env, g_exp)
+    np.testing.assert_allclose(g_exp, g1, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(g_exp, x.T @ x, rtol=1e-4, atol=1e-4)
+    # cross term through the same two-tier schedule
+    y = rng.normal(size=(128, 8)).astype(np.float32)
+    c = np.asarray(
+        tiled_transpose_matmul(jnp.asarray(x), jnp.asarray(y), mesh=mesh)
+    )
+    np.testing.assert_allclose(c, x.T @ y, rtol=1e-4, atol=1e-4)
+
+
+def test_two_tier_inner_never_crosses_slice_boundary(mesh, rng):
+    """HLO pin for the tier map: with 2 declared slices over the 8-device
+    axis, EVERY reduce-scatter is either within one slice ({0-3} / {4-7},
+    the inner ICI tier) or one-member-per-slice ({j, 4+j}, the outer
+    exchange shipping only slice partials) — no monolithic 8-wide
+    reduction, no all-reduce, and >= T within-slice scatters (one per
+    tile)."""
+    k = mesh.shape["data"]
+    x = jnp.asarray(rng.normal(size=(128, 16 * k)).astype(np.float32))
+    f = jax.jit(lambda a: tiled_transpose_matmul(a, mesh=mesh, tiers=(2, 4)))
+    hlo = f.lower(x).compile().as_text()
+    group_strs = re.findall(
+        r"reduce-scatter[^\n]*replica_groups=\{(\{[^=]*?\})\},", hlo
+    )
+    assert group_strs, "no reduce-scatter with replica_groups in the HLO"
+    slices = [set(range(0, 4)), set(range(4, 8))]
+    inner = outer = 0
+    for gs in group_strs:
+        parsed = [
+            set(int(v) for v in grp.split(","))
+            for grp in re.findall(r"\{([^{}]*)\}", gs)
+        ]
+        if all(any(p <= s for s in slices) for p in parsed):
+            inner += 1  # ICI tier: inside a declared slice
+        elif all(len(p & s) == 1 for p in parsed for s in slices):
+            outer += 1  # DCN tier: exactly one member per slice
+        else:
+            raise AssertionError(
+                f"reduce-scatter crosses the declared slice boundary: {parsed}"
+            )
+    T = _pick_tiles(x.shape[1], k)
+    assert inner >= T, (inner, T)
+    assert outer >= 1, group_strs
+    cols = _collectives(hlo)
+    assert cols["all-reduce"] == 0, cols
+
+
+def test_two_tier_tiled_psum_dot_matches(mesh, rng):
+    """The in-shard_map form with an explicit tier map (the TSQR/gram inner
+    loop) against the monolithic psum."""
+    a = rng.normal(size=(8, 64, 32)).astype(np.float32)
+    b = rng.normal(size=(8, 32, 5)).astype(np.float32)
+
+    def tiered(ai, bi):
+        return tiled_psum_dot(ai[0], bi[0], "data", tiers=(2, 4))[None]
+
+    spec = P("data", None, None)
+    f = jax.shard_map(
+        tiered, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    out = np.asarray(f(jnp.asarray(a), jnp.asarray(b)))[0]
+    np.testing.assert_allclose(
+        out, np.einsum("kij,kjc->ic", a, b), rtol=1e-4, atol=1e-4
+    )
+
+
+# -- overlapped TSQR tree ---------------------------------------------------
+
+
+def test_tsqr_ring_fold_matches_dense_oracle(devices, rng):
+    """Dense-oracle equivalence for the ring R-tree at odd shard counts and
+    non-tile-divisible d (d=10 has no tiling over either axis size): the
+    regimes the tiled paths cannot touch, which the fold handles because it
+    has no divisibility requirement at all."""
+    for nk in (5, 8):
+        mesh = make_mesh(data=nk, model=1, devices=devices[:nk])
+        d, c = 10, 3
+        n = 24 * nk
+        A = rng.normal(size=(n, d)).astype(np.float32)
+        b = rng.normal(size=(n, c)).astype(np.float32)
+        with use_mesh(mesh):
+            w_off = np.asarray(tsqr_solve(A, b, lam=0.5, mesh=mesh))
+            w_on = np.asarray(
+                tsqr_solve(A, b, lam=0.5, mesh=mesh, overlap=True)
+            )
+            w_on0 = np.asarray(
+                tsqr_solve(A, b, lam=0.0, mesh=mesh, overlap=True)
+            )
+            R = np.asarray(tsqr_r(jnp.asarray(A), mesh, overlap=True))
+        np.testing.assert_allclose(w_on, w_off, rtol=1e-4, atol=1e-5)
+        # unregularized path: the exact least-squares oracle
+        w_ref = np.linalg.lstsq(A, b, rcond=None)[0]
+        np.testing.assert_allclose(w_on0, w_ref, rtol=1e-4, atol=1e-4)
+        # tsqr_r contract: RtR = AtA (row signs are QR's freedom)
+        np.testing.assert_allclose(
+            R.T @ R, A.T @ A, rtol=1e-4,
+            atol=1e-3 * np.abs(A.T @ A).max(),
+        )
+
+
+def test_tsqr_overlap_hlo_ring_tree(mesh, rng):
+    """THE structure pin for the overlapped TSQR tree: paired
+    collective-permutes (2 per bidirectional round) and ZERO bulk
+    all-gather / all-reduce — the monolithic R-stack gather and the
+    trailing Qtb psum must both be gone from the overlap path."""
+    from keystone_tpu.linalg.solvers import _tsqr_solve
+
+    k = mesh.shape["data"]
+    A = jnp.asarray(rng.normal(size=(256, 16)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(256, 3)).astype(np.float32))
+    lowered = _tsqr_solve.lower(
+        A, b, jnp.float32(0.5), None, mesh, True, "highest", True
+    )
+    cols = _collectives(lowered.compile().as_text())
+    assert cols["collective-permute"] >= 2 * ((k - 1) // 2), cols
+    assert cols["all-gather"] == 0, (
+        f"overlap TSQR still carries a bulk all-gather: {cols}"
+    )
+    assert cols["all-reduce"] == 0, cols
+    # contrast: the monolithic tree keeps the bulk gather
+    lowered = _tsqr_solve.lower(
+        A, b, jnp.float32(0.5), None, mesh, True, "highest", False
+    )
+    cols = _collectives(lowered.compile().as_text())
+    assert cols["all-gather"] >= 1, cols
+
+
+# -- model-axis (column-sharded) BCD overlap --------------------------------
+
+
+@pytest.fixture()
+def mesh2d(devices):
+    m = make_mesh(data=4, model=2, devices=devices)
+    with use_mesh(m):
+        yield m
+
+
+def test_model_tiled_matmul_matches_dense(mesh2d, rng):
+    x = rng.normal(size=(64, 32)).astype(np.float32)
+    y = rng.normal(size=(64, 5)).astype(np.float32)
+    xs = jax.device_put(
+        jnp.asarray(x), NamedSharding(mesh2d, P("data", "model"))
+    )
+    ys = jax.device_put(jnp.asarray(y), NamedSharding(mesh2d, P("data", None)))
+    g = np.asarray(model_tiled_transpose_matmul(xs, None, mesh2d))
+    c = np.asarray(model_tiled_transpose_matmul(xs, ys, mesh2d))
+    np.testing.assert_allclose(g, x.T @ x, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(c, x.T @ y, rtol=1e-4, atol=1e-4)
+
+
+def test_model_tiled_gram_hlo_composes_rotation_and_tiles(mesh2d, rng):
+    """Structure pin: the column-sharded gram carries the model-axis block
+    rotation (collective-permutes) AND per-rotation tiled data-axis
+    reduce-scatters, with no all-reduce anywhere."""
+    x = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    f = jax.jit(
+        lambda a: model_tiled_transpose_matmul(a, None, mesh2d),
+        in_shardings=NamedSharding(mesh2d, P("data", "model")),
+    )
+    cols = _collectives(f.lower(x).compile().as_text())
+    km, kd = mesh2d.shape["model"], mesh2d.shape["data"]
+    T = _pick_tiles(x.shape[1] // km, kd)
+    assert cols["collective-permute"] >= 1, cols  # the block rotation
+    assert cols["reduce-scatter"] >= km * T, cols  # tiles x rotations
+    assert cols["all-reduce"] == 0, cols
+
+
+def test_model_overlap_spec_gate(mesh2d, rng):
+    x = jax.device_put(
+        jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32)),
+        NamedSharding(mesh2d, P("data", "model")),
+    )
+    assert model_overlap_spec(x, mesh2d, 16)
+    assert not model_overlap_spec(x, mesh2d, 15)  # block % model != 0
+    assert not model_overlap_spec(x, None, 16)  # knob off
+    x_rows = jax.device_put(
+        jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32)),
+        NamedSharding(mesh2d, P("data", None)),
+    )
+    assert not model_overlap_spec(x_rows, mesh2d, 16)  # not column-sharded
+
+
+def test_bcd_model_axis_overlap_matches(mesh2d, rng):
+    """The column-sharded P('data','model') regime: overlap on == off, for
+    single-pass and cached-gram multi-pass solves."""
+    A = rng.normal(size=(64, 64)).astype(np.float32)
+    b = rng.normal(size=(64, 5)).astype(np.float32)
+    Acs = jax.device_put(
+        jnp.asarray(A), NamedSharding(mesh2d, P("data", "model"))
+    )
+    bs = jax.device_put(jnp.asarray(b), NamedSharding(mesh2d, P("data", None)))
+    for num_iter in (1, 3):
+        w0 = np.asarray(
+            block_coordinate_descent_l2(Acs, bs, 1.0, 16, num_iter=num_iter)
+        )
+        w1 = np.asarray(
+            block_coordinate_descent_l2(
+                Acs, bs, 1.0, 16, num_iter=num_iter, overlap=True
+            )
+        )
+        np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+
+
+def test_weighted_model_axis_overlap_matches(mesh2d, rng):
+    """In-core weighted BCD (the flagship FV solver) over column-sharded
+    data: the per-block pop-cov/XtR reductions take the model-axis path."""
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    n, d, cs = 64, 32, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    lbl = (np.eye(cs)[np.arange(n) % cs] * 2.0 - 1.0).astype(np.float32)
+    Xcs = jax.device_put(
+        jnp.asarray(X), NamedSharding(mesh2d, P("data", "model"))
+    )
+    lblr = jax.device_put(
+        jnp.asarray(lbl), NamedSharding(mesh2d, P("data", None))
+    )
+    ref = BlockWeightedLeastSquaresEstimator(16, 2, 0.1, 0.25).fit(Xcs, lblr)
+    got = BlockWeightedLeastSquaresEstimator(
+        16, 2, 0.1, 0.25, overlap=True
+    ).fit(Xcs, lblr)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=1e-4, atol=1e-5
+    )
+
+
+# -- fallback logging (a silent fallback must not look like overlap) --------
+
+
+def test_overlap_fallback_logs_once(mesh, rng, caplog):
+    import logging
+
+    from keystone_tpu.parallel import overlap as _ov
+
+    _ov._FALLBACK_LOGGED.clear()
+    x = jnp.asarray(rng.normal(size=(128, 60)).astype(np.float32))  # 60 % 8
+    with caplog.at_level(
+        logging.WARNING, logger="keystone_tpu.parallel.overlap"
+    ):
+        maybe_tiled_transpose_matmul(x, None, mesh)
+        maybe_tiled_transpose_matmul(x, None, mesh)  # same shape: no re-log
+    recs = [
+        r for r in caplog.records if "overlap fallback" in r.getMessage()
+    ]
+    assert len(recs) == 1, [r.getMessage() for r in recs]
+    # a DIFFERENT failing shape logs its own line
+    y = jnp.asarray(rng.normal(size=(130, 64)).astype(np.float32))  # rows % 8
+    with caplog.at_level(
+        logging.WARNING, logger="keystone_tpu.parallel.overlap"
+    ):
+        maybe_tiled_transpose_matmul(y, None, mesh)
+    recs = [
+        r for r in caplog.records if "overlap fallback" in r.getMessage()
+    ]
+    assert len(recs) == 2
