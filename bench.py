@@ -615,9 +615,9 @@ def _try_flagship_stage_breakdown():
 
             cfg = flagship_config()
             run_flagship(cfg)  # warm the caches under this process
-            Timer.registry.clear()
+            Timer.reset()
             run_flagship(cfg)
-            reg = {k: sum(v) for k, v in Timer.registry.items()}
+            reg = {k: s["total"] for k, s in Timer.summary().items()}
         finally:
             if prev is None:
                 os.environ.pop("KEYSTONE_SYNC_TIMERS", None)
@@ -796,6 +796,71 @@ def _try_prefetch_rows():
             os.environ["KEYSTONE_PREFETCH"] = prev
 
 
+def _try_telemetry_rows(config) -> dict:
+    """Structured-telemetry evidence (``keystone_tpu/telemetry``): ONE extra
+    primary-pipeline run under the span tracer, then the full registry +
+    span dump + Chrome trace goes to ``bench_telemetry.json``
+    (``BENCH_TELEMETRY_PATH`` overrides; ``keystone-tpu telemetry-report``
+    renders it) and the compact line carries ``telemetry_*`` headcounts —
+    so a bench artifact now SHOWS which overlap paths engaged vs fell back,
+    per-tier cache traffic, prefetch stalls, and per-stage spans, instead
+    of implying them. Traced runs sync per span, so this row is diagnostics,
+    never the headline timing. BENCH_TELEMETRY=0 skips."""
+    if os.environ.get("BENCH_TELEMETRY", "1") == "0":
+        return {}
+    try:
+        from keystone_tpu import telemetry
+        from keystone_tpu.pipelines.mnist_random_fft import run
+
+        telemetry.reset()
+        # The overlap/schedule counters fire at TRACE time (inside
+        # shard_map/jit bodies); the primary section already compiled every
+        # program, so without dropping the in-memory jit cache the traced
+        # rerun would be a cache hit and the artifact would report zero
+        # engagement for schedules that really ran. The persistent XLA
+        # cache (BENCH_XLA_CACHE) keeps the re-lowering cheap.
+        jax.clear_caches()
+        with telemetry.use_tracing(True):
+            run(config)
+        reg = telemetry.get_registry()
+        metrics = reg.as_dict()
+        spans = telemetry.get_tracer().spans_as_dicts()
+        artifact = {
+            "metrics": metrics,
+            "spans": spans,
+            "chrome_trace": telemetry.get_tracer().chrome_trace(),
+        }
+        path = os.environ.get("BENCH_TELEMETRY_PATH") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_telemetry.json"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return {
+            "telemetry_file": os.path.basename(path),
+            "telemetry_spans": len(spans),
+            "telemetry_counters": len(metrics["counters"]),
+            "telemetry_timer_stages": sum(
+                1 for k in metrics["histograms"] if k.startswith("timer.")
+            ),
+            "telemetry_overlap_engaged": int(
+                reg.sum_counters("overlap.engaged")
+            ),
+            "telemetry_overlap_fallbacks": int(
+                reg.sum_counters("overlap.fallback")
+            ),
+            "telemetry_prefetch_stall_s": round(
+                reg.get_counter("prefetch.stall_s"), 3
+            ),
+        }
+    except Exception as e:
+        print(f"telemetry rows failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {}
+
+
 def _run_regime_subprocess(regime: str, fail_key: str,
                            timeout_s: int = None) -> dict:
     """One big-regime row via ``scripts/bench_regime.py`` in a fresh OS
@@ -912,6 +977,17 @@ def main():
         "device": str(jax.devices()[0]),
     }
     _flush(out, "primary")
+    # Telemetry evidence rides directly after the primary (one more run of
+    # the SAME config under the span tracer): it must land even on runs
+    # whose budget dies before the heavy regimes, so it gets a reduced
+    # floor (a traced primary rerun, not a flagship section).
+    if _budget_remaining() - _FINALIZE_RESERVE_S < 20.0:
+        out["telemetry_skipped"] = "budget"
+        print("bench section telemetry skipped: budget exhausted",
+              file=sys.stderr)
+    else:
+        out.update(_try_telemetry_rows(config))
+    _flush(out, "telemetry")
     if _budget_remaining() - _FINALIZE_RESERVE_S < _SECTION_FLOOR_S:
         # a cache-cold primary compile can eat most of the budget; the
         # ladder times dozens of flagship-shape solves and gets the same
@@ -1029,6 +1105,10 @@ _COMPACT_KEYS = (
     ("metric", "metric"), ("value", "value"), ("unit", "unit"),
     ("vs_baseline", "vs_baseline"),
     ("contended", "contended"),
+    # structured-telemetry headcounts (full dump: bench_telemetry.json)
+    ("telemetry_spans", "telemetry_spans"),
+    ("telemetry_counters", "telemetry_counters"),
+    ("telemetry_fallbacks", "telemetry_overlap_fallbacks"),
     # flagship regime
     ("fs", "imagenet_refdim_streaming_warm_s"),
     ("fs_cont", "imagenet_refdim_streaming_warm_s_contended"),

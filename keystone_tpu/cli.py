@@ -103,12 +103,21 @@ def emit_host_commands(hosts, rest, devices_per_host: int = 4,
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "telemetry-report":
+        # ``keystone-tpu telemetry-report [path]``: pretty-print a telemetry
+        # artifact (bench_telemetry.json / telemetry_metrics.json) — the
+        # human half of keystone_tpu/telemetry; no jax import needed.
+        from keystone_tpu.telemetry.report import main as report_main
+
+        return report_main(argv[1:])
     if not argv or argv[0] in ("-h", "--help", "help"):
         names = "\n  ".join(sorted(PIPELINES))
         print(
             "usage: run-pipeline [--coordinator HOST:PORT --num-processes N "
             "--process-id I | --distributed] [--mesh-model M] "
-            f"<Pipeline> [flags]\n\npipelines:\n  {names}"
+            f"<Pipeline> [flags]\n"
+            "       run-pipeline telemetry-report [path] [--top N]\n\n"
+            f"pipelines:\n  {names}"
         )
         return 0 if argv else 2
     launch, argv = _parse_launch_flags(argv)

@@ -51,6 +51,16 @@ from keystone_tpu.utils.logging import get_logger
 
 logger = get_logger("keystone_tpu.core.cache")
 
+
+def _tele(event: str, **labels) -> None:
+    """Mirror a cache event into the telemetry registry (per-tier
+    ``cache.hit``/``cache.miss``/``cache.evict``/... counters): the
+    :class:`CacheStats` dataclass stays the cheap per-instance view, the
+    registry is the process-wide queryable one (bench/report/tests)."""
+    from keystone_tpu.telemetry import get_registry
+
+    get_registry().inc(f"cache.{event}", **labels)
+
 # Leaves at or below this byte size are hashed on the host (strong hash of
 # the exact bytes); larger device arrays use the on-device checksum so
 # fingerprinting never forces a multi-GB device->host transfer.
@@ -256,12 +266,14 @@ class IntermediateCache:
                 e = self._adopt_disk_file(key)
             if e is None:
                 self.stats.misses += 1
+                _tele("miss")
                 return False, None
             self._clock += 1
             e.last_used = self._clock
             if e.tier == _DEVICE:
                 self.stats.hits += 1
                 self.stats.device_hits += 1
+                _tele("hit", tier=_DEVICE)
                 return True, jax.tree_util.tree_unflatten(e.treedef, e.leaves)
             try:
                 value = self._load(e)
@@ -274,8 +286,10 @@ class IntermediateCache:
                 )
                 self._evict(e)
                 self.stats.misses += 1
+                _tele("miss")
                 return False, None
             self.stats.hits += 1
+            _tele("hit", tier=e.tier)
             if e.tier == _HOST:
                 self.stats.host_hits += 1
             else:
@@ -300,6 +314,7 @@ class IntermediateCache:
             self._entries[key] = e
             self._tier_bytes[_DEVICE] += e.nbytes
             self.stats.puts += 1
+            _tele("put")
             self._rebalance()
 
     def memoize(self, key: str, compute: Callable[[], Any]) -> Any:
@@ -317,6 +332,7 @@ class IntermediateCache:
             except Exception:
                 pass
         self.stats.computes += 1
+        _tele("compute")
         self.put(key, value, time.perf_counter() - t0)
         return value
 
@@ -432,6 +448,7 @@ class IntermediateCache:
         e.nbytes = _leaf_nbytes(leaves)
         self._tier_bytes[target] += e.nbytes
         self.stats.promotions += 1
+        _tele("promote", to=target)
         self._rebalance()
 
     def _rebalance(self) -> None:
@@ -474,6 +491,7 @@ class IntermediateCache:
             e.tier = _HOST
             self._tier_bytes[_HOST] += e.nbytes
             self.stats.demotions += 1
+            _tele("demote", to=_HOST)
             return
         if (to_tier in (_HOST, _DISK)) and self.budgets[_DISK] > 0:
             self._write_disk(e)
@@ -515,6 +533,7 @@ class IntermediateCache:
             self._unlink_disk(e)
         self._entries.pop(e.key, None)
         self.stats.evictions += 1
+        _tele("evict", tier=e.tier)
 
 
 # ---------------------------------------------------------------------------

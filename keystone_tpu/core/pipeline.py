@@ -81,6 +81,39 @@ def _jit_apply_batch(node: "Node", xs: Any) -> Any:
     return node.apply_batch(xs)
 
 
+def _stage_name(node: "Node") -> str:
+    if isinstance(node, Chain):
+        return ">".join(type(s).__name__ for s in node.stages)
+    return type(node).__name__
+
+
+def _traced_stage(node: "Node", data: Any, jitted: bool) -> Any:
+    """Run one stage/segment inside a telemetry span (``telemetry/spans.py``)
+    — only reached when tracing is enabled. The span carries the stage's
+    structural fingerprint (stable across refits: treedef + leaf shapes,
+    no weight bytes), input/output shapes+bytes, and for jitted stages the
+    compiled program's ``cost_analysis()`` flops — so achieved GFLOPs per
+    stage falls out of the trace with no extra measurement. The span syncs
+    on the stage output: a traced run measures honest per-stage device
+    time, at the cost of serializing the async dispatch (the same trade as
+    ``KEYSTONE_SYNC_TIMERS``)."""
+    from keystone_tpu import telemetry
+
+    fp = telemetry.stage_fingerprint(node)
+    with telemetry.get_tracer().span(f"stage:{_stage_name(node)}") as sp:
+        sp.set(
+            fingerprint=fp,
+            in_shapes=telemetry.tree_shapes(data),
+            in_bytes=telemetry.tree_nbytes(data),
+        )
+        if jitted:
+            cost = telemetry.jit_cost(_jit_apply_batch, fp, node, data)
+            if cost:
+                sp.set(**cost)
+            return sp.track(_jit_apply_batch(node, data))
+        return sp.track(node.apply_batch(data))
+
+
 @functools.partial(jax.jit, static_argnums=())
 def _jit_apply(node: "Node", x: Any) -> Any:
     return node.apply(x)
@@ -126,6 +159,10 @@ class Node(struct.PyTreeNode):
         return self._call_uncached(data)
 
     def _call_uncached(self, data: Any) -> Any:
+        from keystone_tpu.telemetry import tracing_enabled
+
+        if tracing_enabled():
+            return _traced_stage(self, data, jitted=self.jittable)
         if self.jittable:
             return _jit_apply_batch(self, data)
         return self.apply_batch(data)
@@ -320,36 +357,47 @@ class Chain(Transformer):
         if cache.sync_on_compute:
             out = jax.block_until_ready(out)
         cache.stats.computes += 1
+        from keystone_tpu.telemetry import get_registry
+
+        get_registry().inc("cache.compute")
         cache.put(whole_key, out, time.perf_counter() - t0)
         return out
 
     def _run_stages(self, data: Any, start: int = 0, on_boundary=None) -> Any:
         # Split into maximal jittable segments; Cacher / host nodes run
-        # between segments and act as materialization boundaries.
-        segment: list = []
-        for idx in range(start, len(self.stages)):
-            s = self.stages[idx]
-            if s.jittable:
-                segment.append(s)
-                continue
+        # between segments and act as materialization boundaries. Under
+        # tracing the whole chain gets an enclosing span (sync=False — the
+        # per-segment child spans already sync) so segment spans nest under
+        # it in the Chrome trace.
+        from keystone_tpu import telemetry
+
+        with telemetry.get_tracer().span(
+            f"chain:{_stage_name(self)}", sync=False
+        ):
+            segment: list = []
+            for idx in range(start, len(self.stages)):
+                s = self.stages[idx]
+                if s.jittable:
+                    segment.append(s)
+                    continue
+                if segment:
+                    data = _run_segment(segment, data)
+                    segment = []
+                # _call_uncached, not __call__: the chain's own whole/prefix
+                # keys already cover this output — a node-level memo here
+                # would store the same bytes twice under a second key
+                data = s._call_uncached(data)
+                # terminal Cacher excluded: its prefix key IS the whole-chain
+                # key, which the caller puts once after this returns
+                if (
+                    on_boundary is not None
+                    and isinstance(s, Cacher)
+                    and idx < len(self.stages) - 1
+                ):
+                    on_boundary(idx, data)
             if segment:
                 data = _run_segment(segment, data)
-                segment = []
-            # _call_uncached, not __call__: the chain's own whole/prefix keys
-            # already cover this output — a node-level memo here would store
-            # the same bytes twice under a second key
-            data = s._call_uncached(data)
-            # terminal Cacher excluded: its prefix key IS the whole-chain
-            # key, which the caller puts once after this returns
-            if (
-                on_boundary is not None
-                and isinstance(s, Cacher)
-                and idx < len(self.stages) - 1
-            ):
-                on_boundary(idx, data)
-        if segment:
-            data = _run_segment(segment, data)
-        return data
+            return data
 
     def serve(self, x: Any) -> Any:
         for s in self.stages:
@@ -366,9 +414,13 @@ class Chain(Transformer):
 
 
 def _run_segment(segment: Sequence[Node], data: Any) -> Any:
-    node = segment[0] if len(segment) == 1 else Chain(stages=tuple(segment))
     if isinstance(data, Dataset):
-        return data.replace(data=_jit_apply_batch(node, data.data))
+        return data.replace(data=_run_segment(segment, data.data))
+    node = segment[0] if len(segment) == 1 else Chain(stages=tuple(segment))
+    from keystone_tpu.telemetry import tracing_enabled
+
+    if tracing_enabled():
+        return _traced_stage(node, data, jitted=True)
     return _jit_apply_batch(node, data)
 
 

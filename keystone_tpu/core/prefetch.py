@@ -59,12 +59,22 @@ def prefetch_map(
     an exception in ``fn`` surfaces at the corresponding yield). ``gate(prev,
     nxt)`` returning False defers ``fn(nxt)`` until ``prev``'s result has
     been yielded."""
+    import time
+
+    from keystone_tpu.telemetry import get_registry
+
+    reg = get_registry()
     items = list(items)
     if depth is None:
         depth = prefetch_depth()
+    reg.set_gauge("prefetch.depth", depth)
     if depth <= 0 or len(items) <= 1:
         for item in items:
-            yield fn(item)
+            t0 = time.perf_counter()
+            value = fn(item)
+            reg.inc("prefetch.stall")
+            reg.inc("prefetch.stall_s", time.perf_counter() - t0)
+            yield value
         return
     # j -> ("ok", value) | ("err", exc): run-ahead production must not raise
     # at the wrong sequence position, so errors are stored and re-raised at
@@ -79,7 +89,18 @@ def prefetch_map(
                 produced[j] = ("err", exc)
 
     for i in range(len(items)):
-        produce(i)  # production order == sequence order, always
+        # Stall accounting: the consumer is about to block on fn(items[i])
+        # because run-ahead did NOT already produce it (first item, a gate
+        # boundary, or depth exhausted). ``prefetch.stall_s`` is therefore
+        # the producer time the double buffer failed to hide; items already
+        # produced ahead count as ``prefetch.ready``.
+        if i in produced:
+            reg.inc("prefetch.ready")
+        else:
+            t0 = time.perf_counter()
+            produce(i)  # production order == sequence order, always
+            reg.inc("prefetch.stall")
+            reg.inc("prefetch.stall_s", time.perf_counter() - t0)
         if produced[i][0] == "ok":
             # run ahead, but never PAST an error: a failed producer call
             # means the sequence is about to abort (or be retried from a
@@ -88,8 +109,10 @@ def prefetch_map(
             for j in range(i + 1, min(i + 1 + depth, len(items))):
                 if j not in produced:
                     if gate is not None and not gate(items[j - 1], items[j]):
+                        reg.inc("prefetch.gate_blocked")
                         break
                     produce(j)
+                    reg.inc("prefetch.produced_ahead")
                 if produced[j][0] == "err":
                     break
         tag, val = produced.pop(i)

@@ -669,20 +669,35 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         from keystone_tpu.parallel.overlap import overlap_mesh
 
         omesh = overlap_mesh(self.overlap)
-        # Per-phase attribution, diag-mode only (KEYSTONE_SYNC_TIMERS=1):
-        # Timers inside the hot loop would flush dispatch every block and
-        # defeat the async single-sync design, so the production path gets
-        # a no-op context.
-        if _os.environ.get("KEYSTONE_SYNC_TIMERS", "0") == "1":
-            from keystone_tpu.utils import Timer as _PhaseTimer
+        # Per-phase attribution: diag-mode Timer (KEYSTONE_SYNC_TIMERS=1 —
+        # hard device barriers) and/or a telemetry span. Timers/barriers
+        # inside the hot loop would flush dispatch every block and defeat
+        # the async single-sync design, so spans here are dispatch-only
+        # (sync=False) and the production default is a no-op context.
+        import contextlib
 
-            def _phase(tag):
-                return _PhaseTimer(f"weighted_bcd.{tag}", log=False)
-        else:
-            import contextlib
+        from keystone_tpu import telemetry as _telemetry
 
-            def _phase(tag):
-                return contextlib.nullcontext()
+        _reg = _telemetry.get_registry()
+        _reg.inc("solver.calls", solver="weighted_bcd")
+        _trace_on = _telemetry.tracing_enabled()
+        _sync_timers = _os.environ.get("KEYSTONE_SYNC_TIMERS", "0") == "1"
+
+        @contextlib.contextmanager
+        def _phase(tag):
+            timer = contextlib.nullcontext()
+            if _sync_timers:
+                from keystone_tpu.utils import Timer as _PhaseTimer
+
+                timer = _PhaseTimer(f"weighted_bcd.{tag}", log=False)
+            span = (
+                _telemetry.get_tracer().span(
+                    f"weighted_bcd.{tag}", sync=False
+                )
+                if _trace_on else contextlib.nullcontext()
+            )
+            with span, timer:
+                yield
 
         # Double-buffered block feed: the producer (featurize / slice) is
         # dispatched one step ahead, gated so it never crosses a
@@ -707,6 +722,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         block_feed = prefetch_map(
             lambda ib: get_block(ib[1]), schedule, gate=gate
         )
+        _n_rows = R.shape[0]
+        _res_norms: list = []  # device scalars; synced ONCE after the loop
         for it, b in schedule:
             with _phase("featurize"):
                 Xb = next(block_feed)
@@ -716,6 +733,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         Xb, R, valid, n_eff, precision=precision, omesh=omesh,
                         model_overlap=model_overlap,
                     )
+                # analytic pop-cov + XᵀR FLOPs for this block (the bench's
+                # stage-attribution formulas, counted where they happen)
+                _reg.inc(
+                    "solver.weighted_bcd.pop_stats_flops",
+                    2.0 * _n_rows * self.block_size * self.block_size
+                    + 2.0 * _n_rows * self.block_size * num_classes,
+                )
                 # base inverse depends only on pop_cov/λ/w: once per
                 # block, cached with the pop stats across iterations
                 if need_binv:
@@ -756,6 +780,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     Xb.astype(jnp.float32) * valid[:, None], R, omesh,
                     precision=precision,
                 ) / n_eff
+                _reg.inc(
+                    "solver.weighted_bcd.cross_flops",
+                    2.0 * _n_rows * self.block_size * num_classes,
+                )
 
             with _phase("class_solves"):
                 dW = _bucketed_class_solves(
@@ -768,12 +796,26 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             with _phase("residual_update"):
                 R = _apply_update(R, Xb, dW, valid, precision=precision)
                 _, residual_mean = _class_col_means(R, class_idx, counts)
+            if _trace_on:
+                # per-(iteration, block) residual trajectory — a replicated
+                # scalar per step, synced once after the loop (no per-block
+                # host round-trip in the hot path)
+                _res_norms.append(jnp.linalg.norm(R))
             if (
                 checkpoint_path
                 and checkpoint_every > 0
                 and (it * num_blocks + b + 1) % checkpoint_every == 0
             ):
                 _save_checkpoint(it, b + 1)
+
+        if _res_norms:
+            # one host sync for the whole trajectory (traced runs only)
+            for v in np.asarray(jnp.stack(_res_norms), dtype=np.float64):
+                _reg.observe("solver.weighted_bcd.residual_fro", float(v))
+            _reg.set_gauge(
+                "solver.weighted_bcd.final_residual_fro",
+                float(np.asarray(_res_norms[-1])),
+            )
 
         if (
             checkpoint_path

@@ -46,9 +46,17 @@ def block_coordinate_descent_l2(
     precision: Optional[str] = None,
     donate: bool = False,
     overlap: Optional[bool] = None,
+    telemetry: Optional[bool] = None,
 ) -> jax.Array:
     """Public entry: resolves the solver precision once (a static jit arg,
     so changing the global never serves a stale compile) and dispatches.
+
+    ``telemetry`` (None = the ``KEYSTONE_TELEMETRY`` tracing knob) compiles
+    the per-block residual Frobenius norm into the scan as an extra output
+    (a static program change, so the production program carries zero extra
+    work when off) and records the per-iteration residual trajectory plus a
+    ``solver.bcd`` span — with analytic gram/cross FLOPs, so achieved
+    GFLOPs lands in the trace — into ``keystone_tpu.telemetry``.
 
     ``overlap`` (None = the ``KEYSTONE_OVERLAP`` knob) routes each block's
     gram/cross-term reductions through the tiled reduce-scatter collective
@@ -72,6 +80,7 @@ def block_coordinate_descent_l2(
     at TIMIT scale the centered (n, d) copy alone is multi-GB. A donated
     array is DEAD after the call (jax raises on reuse); never set it for
     arrays the caller still owns."""
+    from keystone_tpu import telemetry as _telemetry
     from keystone_tpu.linalg.solvers import validate_precision
     from keystone_tpu.parallel.overlap import model_overlap_spec, overlap_mesh
 
@@ -80,25 +89,60 @@ def block_coordinate_descent_l2(
     precision = precision or get_solver_precision()
     omesh = overlap_mesh(overlap)
     model_overlap = model_overlap_spec(A, omesh, block_size)
-    if donate:
-        # the outputs (d, c) can never alias the (n, ·) inputs, so jax warns
-        # that donation found no output alias — expected: the donation here
-        # transfers buffer ownership so the runtime frees A/b at their last
-        # read inside the scan instead of pinning them to the call boundary
+    trace_on = _telemetry.tracing_enabled(telemetry)
+
+    n, d = A.shape
+    c = b.shape[1] if b.ndim == 2 else 1
+    nblocks = -(-d // block_size)
+    # grams are computed once and reused across passes when cached
+    gram_passes = 1 if (num_iter > 1 and cache_grams) else num_iter
+    gram_flops = gram_passes * nblocks * 2.0 * n * block_size * block_size
+    cross_flops = num_iter * nblocks * 2.0 * n * block_size * c
+    reg = _telemetry.get_registry()
+    reg.inc("solver.calls", solver="bcd")
+    reg.inc("solver.bcd.gram_flops", gram_flops)
+    reg.inc("solver.bcd.cross_flops", cross_flops)
+
+    def run():
+        import contextlib
         import warnings
 
-        with warnings.catch_warnings():
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable"
+        fn = _bcd_l2_donated if donate else _bcd_l2
+        # Donated calls: the outputs (d, c) can never alias the (n, ·)
+        # inputs, so jax warns that donation found no output alias —
+        # expected: the donation here transfers buffer ownership so the
+        # runtime frees A/b at their last read inside the scan instead of
+        # pinning them to the call boundary.
+        ctx = warnings.catch_warnings() if donate else contextlib.nullcontext()
+        with ctx:
+            if donate:
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable"
+                )
+            return fn(
+                A, b, lam, block_size, num_iter, mask, cache_grams,
+                precision, omesh, model_overlap, with_residuals=trace_on,
             )
-            return _bcd_l2_donated(
-                A, b, lam, block_size, num_iter, mask, cache_grams, precision,
-                omesh, model_overlap,
-            )
-    return _bcd_l2(
-        A, b, lam, block_size, num_iter, mask, cache_grams, precision, omesh,
-        model_overlap,
-    )
+
+    if not trace_on:
+        return run()
+    import numpy as np
+
+    with _telemetry.get_tracer().span("solver.bcd") as sp:
+        sp.set(
+            flops=gram_flops + cross_flops, n=n, d=d, c=c,
+            blocks=nblocks, iters=num_iter, overlap=omesh is not None,
+        )
+        W, res = run()
+        W = sp.track(W)
+        # per-(iteration, block) residual ‖R‖_F after each block update —
+        # one host sync of a (num_iter·nblocks,) vector, traced runs only
+        res_host = np.asarray(res, dtype=np.float64)
+        for v in res_host:
+            reg.observe("solver.bcd.residual_fro", float(v))
+        reg.set_gauge("solver.bcd.final_residual_fro", float(res_host[-1]))
+        sp.set(final_residual_fro=float(res_host[-1]))
+        return W
 
 
 def _bcd_l2_impl(
@@ -112,6 +156,7 @@ def _bcd_l2_impl(
     precision: str = "high",
     omesh=None,
     model_overlap: bool = False,
+    with_residuals: bool = False,
 ) -> jax.Array:
     """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
 
@@ -119,6 +164,11 @@ def _bcd_l2_impl(
     padded internally to a multiple of ``block_size`` (padded columns get a
     unit diagonal in the regularized solve so the system stays nonsingular,
     and their weights come back exactly zero).
+
+    ``with_residuals`` (static — a different compiled program) additionally
+    returns the per-step residual Frobenius norms ``(num_iter·num_blocks,)``
+    for the telemetry trajectory; the production program (False) carries no
+    extra reduction.
     """
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
@@ -192,16 +242,19 @@ def _bcd_l2_impl(
         Wk_new = spd_solve(gram + lam * eye + jnp.diag(regk), rhs)
         R = R - hdot(Ak, Wk_new - Wk, precision)
         W = jax.lax.dynamic_update_slice(W, Wk_new, (start, 0))
-        return (W, R), None
+        out = jnp.linalg.norm(R) if with_residuals else None
+        return (W, R), out
 
     schedule = jnp.tile(jnp.arange(num_blocks), num_iter)
-    (W, _), _ = jax.lax.scan(block_step, (W0, b), schedule)
+    (W, _), res = jax.lax.scan(block_step, (W0, b), schedule)
+    if with_residuals:
+        return W[:d], res
     return W[:d]
 
 
 _BCD_STATICS = (
     "block_size", "num_iter", "cache_grams", "precision", "omesh",
-    "model_overlap",
+    "model_overlap", "with_residuals",
 )
 _bcd_l2 = functools.partial(jax.jit, static_argnames=_BCD_STATICS)(_bcd_l2_impl)
 # Donated variant: b's buffer aliases the scanned residual, A's is freed for

@@ -133,15 +133,34 @@ def normal_equations_solve(
     ``overlap`` opts the gram/cross reductions into the tiled reduce-scatter
     collective matmul (None = the ``KEYSTONE_OVERLAP`` knob).
     """
+    from keystone_tpu import telemetry
     from keystone_tpu.parallel.overlap import overlap_mesh
 
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
     precision = get_solver_precision()
     omesh = overlap_mesh(overlap)
-    if lam is None or lam == 0.0:
-        return _normal_equations_lstsq(A, b, mask, precision, omesh)
-    return _normal_equations(A, b, jnp.float32(lam), mask, precision, omesh)
+    n, d = A.shape
+    c = b.shape[1] if b.ndim == 2 else 1
+    # Leading-order analytic FLOPs (the bench's formula style): gram +
+    # cross term + the d×d solve. Counters always; the span (opt-in
+    # tracing) turns them into achieved GFLOPs at export.
+    reg = telemetry.get_registry()
+    reg.inc("solver.calls", solver="normal_equations")
+    reg.inc("solver.normal_equations.gram_flops", 2.0 * n * d * d)
+    reg.inc("solver.normal_equations.cross_flops", 2.0 * n * d * c)
+    with telemetry.get_tracer().span("solver.normal_equations") as sp:
+        sp.set(
+            flops=2.0 * n * d * d + 2.0 * n * d * c + (2.0 / 3.0) * d**3,
+            n=n, d=d, c=c, overlap=omesh is not None,
+        )
+        if lam is None or lam == 0.0:
+            return sp.track(
+                _normal_equations_lstsq(A, b, mask, precision, omesh)
+            )
+        return sp.track(
+            _normal_equations(A, b, jnp.float32(lam), mask, precision, omesh)
+        )
 
 
 def tsqr_r(
@@ -257,13 +276,27 @@ def tsqr_solve(
     behind incremental second-level panel QRs, with ``Qᵀb`` carried through
     the fold — instead of one bulk ``all_gather`` + monolithic QR + psum.
     """
+    from keystone_tpu import telemetry
     from keystone_tpu.parallel.mesh import get_mesh
     from keystone_tpu.parallel.overlap import overlap_mesh
 
     mesh = mesh or get_mesh()
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
-    return _tsqr_solve(
-        A, b, jnp.float32(lam), mask, mesh, lam > 0.0, get_solver_precision(),
-        overlap=overlap_mesh(overlap, mesh) is not None,
-    )
+    use_ring = overlap_mesh(overlap, mesh) is not None
+    n, d = A.shape
+    c = b.shape[1] if b.ndim == 2 else 1
+    reg = telemetry.get_registry()
+    reg.inc("solver.calls", solver="tsqr")
+    with telemetry.get_tracer().span("solver.tsqr") as sp:
+        # leading-order: per-shard Householder QR (~2nd²) + Qᵀb (~2ndc)
+        sp.set(
+            flops=2.0 * n * d * d + 2.0 * n * d * c,
+            n=n, d=d, c=c, overlap=use_ring,
+        )
+        return sp.track(
+            _tsqr_solve(
+                A, b, jnp.float32(lam), mask, mesh, lam > 0.0,
+                get_solver_precision(), overlap=use_ring,
+            )
+        )

@@ -83,11 +83,25 @@ _OVERLAP_STACK: list = []
 _FALLBACK_LOGGED: set = set()
 
 
+def _count(event: str, value: float = 1, **labels) -> None:
+    """Record an overlap scheduling decision in the telemetry registry
+    (``telemetry/registry.py``). Counters fire where the DECISION is made:
+    once per outer call for the eager entry points, once per trace for the
+    in-``shard_map`` sites — they count chosen schedules, not device
+    executions. Tests and the bench assert engagement/fallback directly
+    from these series instead of scraping the rate-limited log."""
+    from keystone_tpu.telemetry import get_registry
+
+    get_registry().inc(f"overlap.{event}", value, **labels)
+
+
 def _log_fallback(site: str, detail: str) -> None:
     """Rate-limited (once per site+shape) warning that an overlap-requested
     reduction fell back to the monolithic collective — without this a
     mis-tiled flagship run looks identical to an overlapped one in the
-    bench output."""
+    bench output. The telemetry counter is NOT rate-limited: every fallback
+    decision increments ``overlap.fallback{site=...}``."""
+    _count("fallback", site=site)
     key = (site, detail)
     if key in _FALLBACK_LOGGED:
         return
@@ -300,6 +314,10 @@ def tiled_transpose_matmul(
             f"the '{axis}' axis size {k}: need dim % (tiles*k) == 0"
         )
     tiers = tiers or mesh_tiers(mesh, axis)
+    _count(
+        "engaged", site="tiled_transpose_matmul",
+        schedule="two_tier" if tiers[0] > 1 else "single_tier",
+    )
 
     def local(xi, yi):
         # one shared tiling implementation (tiled_psum_dot): rows of xi.T
@@ -387,6 +405,13 @@ def tiled_psum_dot(
     m = a.shape[0]
     T = tiles or _pick_tiles(m, k)
     if k <= 1 or T == 0 or m % (T * k):
+        # per-trace monolithic-psum decision (no log: the eager wrappers
+        # already log their own shape fallbacks; the counter keeps the
+        # in-shard_map sites — e.g. the TSQR Qᵀb reduction — visible)
+        _count(
+            "fallback", site="tiled_psum_dot",
+            reason="trivial_axis" if k <= 1 else "no_tiling",
+        )
         return jax.lax.psum(hdot(a, b, precision), axis)
     outer, inner = tiers or (1, k)
     if outer > 1 and outer * inner != k:
@@ -406,7 +431,16 @@ def tiled_psum_dot(
     partials = [
         hdot(a[t * tb : (t + 1) * tb], b, precision) for t in range(T)
     ]
+    from keystone_tpu.telemetry import get_registry as _reg
+
+    _count(
+        "engaged", site="tiled_psum_dot",
+        schedule="two_tier" if outer > 1 else "single_tier",
+    )
+    _reg().observe("overlap.tiles", T, site="tiled_psum_dot")
+    _reg().inc("overlap.tier_schedule", schedule=f"{outer}x{inner}")
     if outer == 1:
+        _count("reduce_scatter_rounds", T, tier="single")
         pieces = [
             jax.lax.psum_scatter(p, axis, scatter_dimension=0, tiled=True)
             for p in partials
@@ -428,6 +462,8 @@ def tiled_psum_dot(
     # batched r inner tiles per exchange (per-tier tile sizes).
     To = outer_tiles or _env_tiles()[1] or min(T, outer)
     r = -(-T // max(To, 1))
+    _count("reduce_scatter_rounds", T, tier="inner")
+    _count("reduce_scatter_rounds", -(-T // r), tier="outer")
     pieces = []
     for g0 in range(0, T, r):
         stack = jnp.stack(inner_pieces[g0 : g0 + r])  # (r', pb·outer, c)
@@ -482,6 +518,12 @@ def bidirectional_ring_gram(
             f"feature dim {d} must be divisible by the '{axis}' axis size {k}"
         )
     db = d // k
+    _count("engaged", site="bidirectional_ring_gram")
+    _count(
+        "ppermute_rounds",
+        2 * bidirectional_rounds(k) + (1 if k % 2 == 0 and k > 1 else 0),
+        site="bidirectional_ring_gram",
+    )
 
     def local(xj):
         def fold(src, visiting, out):
@@ -551,7 +593,14 @@ def ring_tsqr_fold(
     is all the triangular solve consumes."""
     k = jax.lax.axis_size(axis)
     if k <= 1:
+        _count("fallback", site="ring_tsqr_fold", reason="trivial_axis")
         return Ri, Zi
+    _count("engaged", site="ring_tsqr_fold")
+    _count(
+        "ppermute_rounds",
+        2 * bidirectional_rounds(k) + (1 if k % 2 == 0 else 0),
+        site="ring_tsqr_fold",
+    )
     fwd_perm, bwd_perm = paired_ring_perms(k)
 
     def fold(R_acc, Z_acc, Rs, Zs):
@@ -627,6 +676,11 @@ def model_tiled_transpose_matmul(
         )
     dl = dx // km
     tiers = mesh_tiers(mesh, data_axis)
+    _count(
+        "engaged", site="model_tiled_transpose_matmul",
+        kind="cross" if y is not None else "gram",
+        schedule="two_tier" if tiers[0] > 1 else "single_tier",
+    )
 
     if y is not None:
         if y.shape[0] != n:

@@ -1,0 +1,46 @@
+"""Structured runtime telemetry: metrics registry + span tracer + report.
+
+- ``registry``: process-wide thread-safe counters/gauges/histograms —
+  always on, resettable, exportable (dict / JSONL / Prometheus text).
+- ``spans``: opt-in nested stage spans (dispatch-vs-synced wall-clock,
+  shapes/bytes, per-jit ``cost_analysis()`` flops) exporting
+  Chrome-trace/Perfetto JSON.
+- ``report``: the ``telemetry-report`` CLI renderer.
+
+Knobs: ``KEYSTONE_TELEMETRY=1`` enables span tracing;
+``KEYSTONE_TELEMETRY_DIR=<dir>`` additionally auto-exports the trace +
+metrics there at process exit; ``KEYSTONE_TELEMETRY_COST=0`` disables the
+compile-time flop extraction; ``use_tracing(True)`` scopes tracing in code.
+"""
+
+from keystone_tpu.telemetry.registry import MetricsRegistry, get_registry
+from keystone_tpu.telemetry.spans import (
+    SpanTracer,
+    export_dir,
+    get_tracer,
+    jit_cost,
+    reset,
+    stage_fingerprint,
+    tracing_enabled,
+    tree_nbytes,
+    tree_shapes,
+    use_tracing,
+)
+from keystone_tpu.telemetry.report import render_live, render_report
+
+__all__ = [
+    "MetricsRegistry",
+    "SpanTracer",
+    "export_dir",
+    "get_registry",
+    "get_tracer",
+    "jit_cost",
+    "render_live",
+    "render_report",
+    "reset",
+    "stage_fingerprint",
+    "tracing_enabled",
+    "tree_nbytes",
+    "tree_shapes",
+    "use_tracing",
+]

@@ -1,0 +1,264 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The reference KeystoneML's only runtime evidence was ``System.nanoTime`` log
+lines and the Spark UI; "Matrix Computations and Optimization in Apache
+Spark" (PAPERS.md) attributes most of its tuning wins to per-stage metrics
+that could be *queried*, not grepped. This registry is the machine-readable
+side of that: every layer that makes a silent scheduling decision (overlap
+path vs fallback, cache tier hit, prefetch run-ahead, solver residuals)
+records it here, and tests/the bench assert on the counters directly instead
+of scraping log text.
+
+Design constraints, in order:
+
+- **Always on and cheap.** Counters are a dict update under one lock — no
+  env knob gates them, so a test can assert ``overlap.fallback`` counts
+  without arranging a tracing context first. (Span *tracing* is the opt-in
+  half; see ``telemetry/spans.py``.)
+- **Thread-safe.** The prefetch feed, concurrent fits, and the Timer
+  registry all record from multiple threads; every mutation and every
+  export takes the registry lock.
+- **Resettable.** Bench sections and tests scope their assertions with
+  ``reset()`` — the registry is process state, not run state.
+- **Exportable.** ``as_dict()`` (the bench artifact), ``to_jsonl()`` (one
+  metric per line, stream-appendable), ``to_prometheus()`` (text exposition
+  format, so a pod run can be scraped without new infrastructure).
+
+Metric identity is ``name`` plus an optional label mapping; flattened keys
+render as ``name{k=v,k2=v2}`` with labels sorted, so two call sites that
+disagree only on label order still hit the same series.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+# Decade buckets spanning microseconds-to-hours when observing seconds (the
+# common case: Timer routes through here); values outside land in +Inf.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, 100.0, 1000.0,
+    float("inf"),
+)
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _series_key(name: str, labels: Mapping[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def _split_series_key(key: str) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    """Inverse of :func:`_series_key` (for the Prometheus export)."""
+    if not key.endswith("}") or "{" not in key:
+        return key, ()
+    name, inner = key[:-1].split("{", 1)
+    labels = tuple(
+        tuple(part.split("=", 1)) for part in inner.split(",") if "=" in part
+    )
+    return name, labels
+
+
+class _Histogram:
+    __slots__ = ("count", "total", "min", "max", "bounds", "bucket_counts")
+
+    def __init__(self, bounds: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.bounds = bounds
+        self.bucket_counts = [0] * len(bounds)
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        for i, bound in enumerate(self.bounds):
+            if value <= bound:
+                self.bucket_counts[i] += 1
+                break
+
+    def as_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.min,
+            "max": self.max,
+            "mean": (self.total / self.count) if self.count else None,
+            "buckets": {
+                ("+Inf" if b == float("inf") else repr(b)): c
+                for b, c in zip(self.bounds, self.bucket_counts)
+                if c
+            },
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe counters / gauges / histograms (module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, _Histogram] = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1, **labels) -> float:
+        """Add ``value`` to the counter; returns the new total."""
+        key = _series_key(name, labels)
+        with self._lock:
+            total = self._counters.get(key, 0) + value
+            self._counters[key] = total
+            return total
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[_series_key(name, labels)] = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = _series_key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Histogram()
+            h.observe(value)
+
+    # -- queries (the no-log-scraping contract for tests) ------------------
+
+    def get_counter(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get(_series_key(name, labels), 0)
+
+    def get_gauge(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(_series_key(name, labels))
+
+    def get_histogram(self, name: str, **labels) -> Optional[dict]:
+        with self._lock:
+            h = self._hists.get(_series_key(name, labels))
+            return None if h is None else h.as_dict()
+
+    def counters(self, prefix: str = "") -> Dict[str, float]:
+        """Flattened counter series, optionally filtered by name prefix —
+        ``counters("overlap.fallback")`` sums are what the overlap tests
+        assert instead of scraping the fallback log lines."""
+        with self._lock:
+            return {
+                k: v for k, v in self._counters.items() if k.startswith(prefix)
+            }
+
+    def sum_counters(self, prefix: str) -> float:
+        return sum(self.counters(prefix).values())
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    # -- export ------------------------------------------------------------
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.as_dict() for k, h in self._hists.items()},
+            }
+
+    def to_jsonl(self) -> str:
+        """One JSON object per line per series (stream-appendable)."""
+        d = self.as_dict()
+        lines = []
+        for kind in ("counters", "gauges"):
+            for key, value in sorted(d[kind].items()):
+                name, labels = _split_series_key(key)
+                lines.append(json.dumps({
+                    "type": kind[:-1], "name": name,
+                    "labels": dict(labels), "value": value,
+                }))
+        for key, h in sorted(d["histograms"].items()):
+            name, labels = _split_series_key(key)
+            lines.append(json.dumps({
+                "type": "histogram", "name": name, "labels": dict(labels),
+                **h,
+            }))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def dump_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_jsonl())
+
+    def to_prometheus(self, namespace: str = "keystone") -> str:
+        """Prometheus text exposition format. Dotted metric names sanitize
+        to underscores; histograms export the cumulative ``_bucket`` /
+        ``_sum`` / ``_count`` triplet the format requires."""
+        d = self.as_dict()
+        out = []
+
+        def prom_name(name: str) -> str:
+            return _PROM_BAD.sub("_", f"{namespace}_{name}")
+
+        def labels_str(labels, extra=()):
+            items = list(labels) + list(extra)
+            if not items:
+                return ""
+            return "{" + ",".join(
+                f'{_PROM_BAD.sub("_", k)}="{v}"' for k, v in items
+            ) + "}"
+
+        for kind, prom_kind in (("counters", "counter"), ("gauges", "gauge")):
+            seen = set()
+            for key, value in sorted(d[kind].items()):
+                name, labels = _split_series_key(key)
+                p = prom_name(name)
+                if p not in seen:
+                    seen.add(p)
+                    out.append(f"# TYPE {p} {prom_kind}")
+                out.append(f"{p}{labels_str(labels)} {value}")
+        seen = set()
+        for key, h in sorted(d["histograms"].items()):
+            name, labels = _split_series_key(key)
+            p = prom_name(name)
+            if p not in seen:
+                seen.add(p)
+                out.append(f"# TYPE {p} histogram")
+            cum = 0
+            for bound, count in h["buckets"].items():
+                cum += count
+                out.append(
+                    f"{p}_bucket{labels_str(labels, (('le', bound),))} {cum}"
+                )
+            # the +Inf bucket must equal _count even when no value landed
+            # in it explicitly
+            if "+Inf" not in h["buckets"]:
+                out.append(
+                    f"{p}_bucket{labels_str(labels, (('le', '+Inf'),))} "
+                    f"{h['count']}"
+                )
+            out.append(f"{p}_sum{labels_str(labels)} {h['sum']}")
+            out.append(f"{p}_count{labels_str(labels)} {h['count']}")
+        return "\n".join(out) + ("\n" if out else "")
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default registry
+# ---------------------------------------------------------------------------
+
+_GLOBAL = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every instrumented layer records into."""
+    return _GLOBAL

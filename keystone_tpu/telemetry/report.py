@@ -1,0 +1,137 @@
+"""Human-readable run summary from exported telemetry.
+
+``run-pipeline telemetry-report [path]`` (also installed as
+``keystone-tpu telemetry-report``) pretty-prints the artifact the bench
+writes (``bench_telemetry.json``: ``{"metrics": ..., "spans": ...}``), a
+bare registry export (``telemetry_metrics.json``), or the live in-process
+state when called with no path from Python. The report answers the
+ROADMAP's pod-ratchet question directly: which overlap paths actually
+engaged, what fell back per shape, how the cache tiers behaved, and where
+the stage time went (with achieved GFLOPs wherever a span carried flops).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, float):
+        return f"{v:,.3f}" if abs(v) < 1000 else f"{v:,.0f}"
+    return f"{v:,}" if isinstance(v, int) else str(v)
+
+
+def _section(title: str) -> List[str]:
+    return [title, "-" * len(title)]
+
+
+def render_report(artifact: dict, top: int = 15) -> str:
+    """Render ``{"metrics": registry-dict, "spans": [span-dicts]}`` (either
+    half optional) as aligned text."""
+    metrics = artifact.get("metrics") or {}
+    if not metrics and "counters" in artifact:
+        metrics = artifact  # a bare registry export
+    spans = artifact.get("spans") or []
+    lines: List[str] = []
+
+    counters = metrics.get("counters") or {}
+    if counters:
+        lines += _section(f"Counters ({len(counters)} series)")
+        width = max(len(k) for k in counters)
+        for key in sorted(counters):
+            lines.append(f"  {key:<{width}}  {_fmt_val(counters[key])}")
+        lines.append("")
+
+    gauges = metrics.get("gauges") or {}
+    if gauges:
+        lines += _section(f"Gauges ({len(gauges)} series)")
+        width = max(len(k) for k in gauges)
+        for key in sorted(gauges):
+            lines.append(f"  {key:<{width}}  {_fmt_val(gauges[key])}")
+        lines.append("")
+
+    hists = metrics.get("histograms") or {}
+    if hists:
+        lines += _section(f"Histograms ({len(hists)} series)")
+        width = max(max(len(k) for k in hists), len("series"))
+        lines.append(
+            f"  {'series':<{width}}  {'count':>7} {'sum':>12} {'mean':>10} "
+            f"{'max':>10}"
+        )
+        for key in sorted(hists):
+            h = hists[key]
+            mean, hmax = h.get("mean"), h.get("max")
+            lines.append(
+                f"  {key:<{width}}  {h.get('count', 0):>7} "
+                f"{h.get('sum', 0):>12.4f} "
+                f"{(f'{mean:.4f}' if mean is not None else '-'):>10} "
+                f"{(f'{hmax:.4f}' if hmax is not None else '-'):>10}"
+            )
+        lines.append("")
+
+    if spans:
+        lines += _section(f"Top spans by duration ({len(spans)} total)")
+        ranked = sorted(spans, key=lambda s: -s.get("dur_us", 0))[:top]
+        width = max(
+            max(len(s["name"]) + 2 * s.get("depth", 0) for s in ranked),
+            len("span"),
+        )
+        lines.append(
+            f"  {'span':<{width}}  {'dur_ms':>10} {'dispatch_ms':>12} "
+            f"{'GFLOP/s':>9}"
+        )
+        for s in ranked:
+            name = "  " * s.get("depth", 0) + s["name"]
+            gf = (s.get("args") or {}).get("achieved_gflops")
+            lines.append(
+                f"  {name:<{width}}  {s.get('dur_us', 0) / 1e3:>10.3f} "
+                f"{s.get('dispatch_us', 0) / 1e3:>12.3f} "
+                f"{(f'{gf:.1f}' if gf is not None else '-'):>9}"
+            )
+        lines.append("")
+
+    if not lines:
+        lines = ["(no telemetry recorded)"]
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def render_live(top: int = 15) -> str:
+    """Report on the live in-process registry + tracer."""
+    from keystone_tpu.telemetry.registry import get_registry
+    from keystone_tpu.telemetry.spans import get_tracer
+
+    return render_report(
+        {
+            "metrics": get_registry().as_dict(),
+            "spans": get_tracer().spans_as_dicts(),
+        },
+        top=top,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="keystone-tpu telemetry-report",
+        description="Pretty-print a telemetry artifact "
+        "(bench_telemetry.json / telemetry_metrics.json).",
+    )
+    ap.add_argument(
+        "path", nargs="?", default="bench_telemetry.json",
+        help="artifact path (default: ./bench_telemetry.json)",
+    )
+    ap.add_argument(
+        "--top", type=int, default=15, help="span rows to show (default 15)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot load telemetry artifact {args.path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    sys.stdout.write(render_report(artifact, top=args.top))
+    return 0

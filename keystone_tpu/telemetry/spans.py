@@ -1,0 +1,439 @@
+"""Span tracer: nested stage spans with dispatch-vs-synced time and FLOP
+attribution, exportable as Chrome-trace/Perfetto JSON.
+
+The jax profiler trace (``utils/profiling.py``) shows *device* timelines; it
+answers "what did the chip do" but not "which pipeline stage asked for it,
+how long did the host wait, and how close to peak did that stage run". A
+span is the host-side record of one stage execution:
+
+- ``name`` + a cheap structural **fingerprint** of the node (treedef +
+  leaf shapes, no data bytes — stable across refits, distinct across
+  configs), so two runs of the same pipeline line up span-for-span;
+- **dispatch vs synced** time: ``dispatch_us`` is when the body returned
+  (enqueue + backpressure under the pipelines' async single-sync design);
+  ``dur_us`` is after the span's sync point (``jax.block_until_ready`` on a
+  tracked output, else ``jax.effects_barrier``) — the honest device-side
+  duration, the same distinction ``utils/logging.Timer`` documents;
+- input/output **shapes + bytes** (pytree summaries);
+- optional **flops / bytes accessed** from ``compiled.cost_analysis()``
+  (the static HLO cost extraction "Memory Safe Computations with XLA
+  Compiler" leans on — cheap at compile time), so achieved-vs-peak GFLOPs
+  falls out of ``flops / dur`` at export with no extra measurement.
+
+Tracing is opt-in (``KEYSTONE_TELEMETRY=1`` / ``KEYSTONE_TELEMETRY_DIR`` /
+:func:`use_tracing` — per-call beats context beats env, the overlap-knob
+pattern) because span exits synchronize: a traced run measures honestly but
+serializes the async pipeline, exactly like ``KEYSTONE_SYNC_TIMERS``.
+Counters (``telemetry/registry.py``) stay on regardless — they are
+dispatch-side dict updates.
+
+Export: :meth:`SpanTracer.chrome_trace` emits the Chrome trace-event format
+(``ph: "X"`` complete events, microsecond ``ts``/``dur``) that
+``chrome://tracing`` and https://ui.perfetto.dev load directly;
+``KEYSTONE_TELEMETRY_DIR`` auto-writes ``telemetry_trace.json`` +
+``telemetry_metrics.json`` there at process exit so CLI runs need no code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from keystone_tpu.telemetry.registry import get_registry
+
+_ENV_ENABLE = "KEYSTONE_TELEMETRY"
+_ENV_DIR = "KEYSTONE_TELEMETRY_DIR"
+_ENV_COST = "KEYSTONE_TELEMETRY_COST"
+
+_TRACING_STACK: list = []
+
+# Runaway guard: a span per pipeline stage is thousands per run, not
+# millions; past the cap new spans are counted (telemetry.spans_dropped)
+# but not stored.
+_MAX_SPANS = int(os.environ.get("KEYSTONE_TELEMETRY_MAX_SPANS", "200000"))
+
+_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
+
+
+def tracing_enabled(override: Optional[bool] = None) -> bool:
+    """Resolve the tracing knob: per-call ``override`` beats the innermost
+    :func:`use_tracing` scope beats ``KEYSTONE_TELEMETRY``/
+    ``KEYSTONE_TELEMETRY_DIR`` (a trace dir implies tracing on)."""
+    if override is not None:
+        return bool(override)
+    if _TRACING_STACK:
+        return _TRACING_STACK[-1]
+    return (
+        os.environ.get(_ENV_ENABLE, "0") == "1"
+        or bool(os.environ.get(_ENV_DIR))
+    )
+
+
+@contextlib.contextmanager
+def use_tracing(flag: bool):
+    """Scope the tracing knob (the ``use_overlap``/``use_cache`` pattern)."""
+    _TRACING_STACK.append(bool(flag))
+    try:
+        yield
+    finally:
+        _TRACING_STACK.pop()
+
+
+# ---------------------------------------------------------------------------
+# Pytree summaries (span attributes)
+# ---------------------------------------------------------------------------
+
+def tree_shapes(tree: Any, limit: int = 8) -> List[str]:
+    """Compact per-leaf ``dtype(shape)`` summary of a pytree (capped)."""
+    import jax
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            out.append(type(leaf).__name__)
+        else:
+            out.append(f"{getattr(leaf, 'dtype', '?')}{tuple(shape)}")
+        if len(out) >= limit:
+            out.append("...")
+            break
+    return out
+
+
+def tree_nbytes(tree: Any) -> int:
+    import jax
+
+    return int(sum(
+        getattr(l, "nbytes", 0) for l in jax.tree_util.tree_leaves(tree)
+    ))
+
+
+_OPAQUE_MARKERS = ("<function", "<bound method", "<lambda>", " object>")
+
+
+def stage_fingerprint(tree: Any) -> str:
+    """Cheap structural fingerprint of a node/pytree: treedef (addresses
+    stripped) + every leaf's dtype/shape — NO data bytes, so it is O(leaf
+    count) even for multi-GB weights, stable across refits of the same
+    config, and distinct across configs. This keys pipeline stage spans;
+    the *content* fingerprint (``core/cache.py``) stays the cache's.
+
+    Nodes whose identity lives in closures (``LambdaTransformer`` etc.)
+    repr identically once addresses strip — the same blindness that makes
+    them non-``memoizable`` for the cache. Two such stages must not share a
+    fingerprint (``jit_cost`` memoizes flops by it, so a collision
+    attributes one stage's cost to the other), so when the treedef carries
+    an opaque callable the UN-stripped repr (address included) is folded
+    in: per-object distinction, at the cost of fingerprint stability for
+    exactly the nodes that never had a stable identity."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    h = hashlib.blake2b(digest_size=8)
+    td = str(treedef)
+    h.update(_ADDR_RE.sub("", td).encode())
+    if any(m in td for m in _OPAQUE_MARKERS):
+        h.update(td.encode())
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            r = repr(leaf)
+            h.update(_ADDR_RE.sub("", r).encode())
+            if any(m in r for m in _OPAQUE_MARKERS):
+                h.update(r.encode())
+        else:
+            h.update(f"{getattr(leaf, 'dtype', '?')}:{shape}".encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+class _NullSpan:
+    """Shared no-op span when tracing is off: ``set`` drops, ``track`` is
+    the identity — call sites stay branch-free."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args) -> "_NullSpan":
+        return self
+
+    def track(self, value):
+        return value
+
+
+_NULL_SPAN = _NullSpan()
+
+_TLS = threading.local()
+
+
+class _Span:
+    __slots__ = (
+        "_tracer", "name", "sync", "args", "_t0", "_tracked", "_depth",
+    )
+
+    def __init__(self, tracer: "SpanTracer", name: str, sync: bool):
+        self._tracer = tracer
+        self.name = name
+        self.sync = sync
+        self.args: Dict[str, Any] = {}
+        self._tracked = None
+
+    def set(self, **args) -> "_Span":
+        """Attach attributes (shapes, flops, anything JSON-serializable)."""
+        self.args.update(args)
+        return self
+
+    def track(self, value):
+        """Record ``value`` as this span's output: its shapes/bytes are
+        attached and the span's sync point becomes ``block_until_ready`` on
+        it (the honest end of the stage, not just the dispatch flush)."""
+        self._tracked = value
+        self.args.setdefault("out_shapes", tree_shapes(value))
+        self.args.setdefault("out_bytes", tree_nbytes(value))
+        return value
+
+    def __enter__(self):
+        stack = getattr(_TLS, "stack", None)
+        if stack is None:
+            stack = _TLS.stack = []
+        self._depth = len(stack)
+        stack.append(self)
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t_dispatch = time.perf_counter_ns()
+        if self.sync and exc[0] is None:
+            try:
+                import jax
+
+                if self._tracked is not None:
+                    jax.block_until_ready(self._tracked)
+                else:
+                    jax.effects_barrier()
+            except Exception:
+                pass
+        t_end = time.perf_counter_ns()
+        self._tracked = None
+        stack = getattr(_TLS, "stack", [])
+        if stack and stack[-1] is self:
+            stack.pop()
+        self._tracer._record(
+            name=self.name,
+            t0_ns=self._t0,
+            dispatch_ns=t_dispatch - self._t0,
+            dur_ns=t_end - self._t0,
+            depth=self._depth,
+            tid=threading.get_ident(),
+            args=self.args,
+            error=exc[0] is not None,
+        )
+        return False
+
+
+class SpanTracer:
+    """Thread-safe recorder of completed spans (module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: List[dict] = []
+
+    def span(
+        self,
+        name: str,
+        sync: bool = True,
+        enabled: Optional[bool] = None,
+        **args,
+    ):
+        """Open a span context. ``sync=False`` records dispatch time only
+        (for spans inside async hot loops where a barrier would defeat the
+        single-sync design). No-op (shared null span) when tracing is off.
+        """
+        if not tracing_enabled(enabled):
+            return _NULL_SPAN
+        s = _Span(self, name, sync)
+        if args:
+            s.set(**args)
+        return s
+
+    def _record(self, **span) -> None:
+        with self._lock:
+            if len(self._spans) >= _MAX_SPANS:
+                get_registry().inc("telemetry.spans_dropped")
+                return
+            self._spans.append(span)
+
+    # -- queries / export --------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def spans_as_dicts(self) -> List[dict]:
+        """Span records with µs timing and derived achieved GFLOPs."""
+        with self._lock:
+            spans = [dict(s) for s in self._spans]
+        out = []
+        for s in spans:
+            d = {
+                "name": s["name"],
+                "ts_us": s["t0_ns"] / 1e3,
+                "dispatch_us": round(s["dispatch_ns"] / 1e3, 1),
+                "dur_us": round(s["dur_ns"] / 1e3, 1),
+                "depth": s["depth"],
+                "tid": s["tid"],
+                "args": dict(s["args"]),
+            }
+            if s.get("error"):
+                d["error"] = True
+            flops = d["args"].get("flops")
+            if flops and s["dur_ns"] > 0:
+                d["args"]["achieved_gflops"] = round(
+                    float(flops) / s["dur_ns"], 2
+                )  # flops/ns == GFLOP/s
+            out.append(d)
+        return out
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON dict (Perfetto-loadable): one
+        ``ph: "X"`` complete event per span, µs timestamps on the
+        process-local monotonic clock, host threads as trace threads."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans_as_dicts():
+            args = dict(s["args"])
+            args["dispatch_ms"] = round(s["dispatch_us"] / 1e3, 3)
+            events.append({
+                "name": s["name"],
+                "cat": "keystone_tpu",
+                "ph": "X",
+                "ts": s["ts_us"],
+                "dur": max(s["dur_us"], 0.001),
+                "pid": pid,
+                "tid": s["tid"],
+                "args": args,
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+
+_TRACER = SpanTracer()
+
+
+def get_tracer() -> SpanTracer:
+    return _TRACER
+
+
+# ---------------------------------------------------------------------------
+# Compile-time cost extraction
+# ---------------------------------------------------------------------------
+
+# (fingerprint, input-shape summary) -> {"flops": .., "hlo_bytes": ..} | None.
+# Memoized because jit .lower() re-traces: at most one lowering per unique
+# stage/shape pair, and a failure is remembered as None rather than retried.
+_COST_MEMO: Dict[tuple, Optional[dict]] = {}
+_COST_LOCK = threading.Lock()
+
+
+def jit_cost(jit_fn, key: str, *args) -> Optional[dict]:
+    """Static flops / bytes-accessed of ``jit_fn(*args)`` from the compiled
+    executable's ``cost_analysis()`` — the per-program numbers that turn a
+    span's wall-clock into achieved-vs-peak GFLOPs. ``key`` scopes the memo
+    (use the stage fingerprint). Never raises; ``KEYSTONE_TELEMETRY_COST=0``
+    disables (lowering re-traces, so first-hit cost is nonzero)."""
+    if os.environ.get(_ENV_COST, "1") == "0":
+        return None
+    # full structural hash of the args, NOT the display-capped tree_shapes:
+    # two inputs differing past a summary cap must not share a memo slot
+    memo_key = (key, tuple(stage_fingerprint(a) for a in args))
+    with _COST_LOCK:
+        if memo_key in _COST_MEMO:
+            return _COST_MEMO[memo_key]
+    result: Optional[dict] = None
+    try:
+        compiled = jit_fn.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            result = {}
+            if ca.get("flops"):
+                result["flops"] = float(ca["flops"])
+            if ca.get("bytes accessed"):
+                result["hlo_bytes"] = float(ca["bytes accessed"])
+            result = result or None
+    except Exception:
+        result = None
+    with _COST_LOCK:
+        _COST_MEMO[memo_key] = result
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Whole-process convenience: reset + auto-export
+# ---------------------------------------------------------------------------
+
+def reset() -> None:
+    """Clear the process registry AND recorded spans (scope a bench section
+    or a test)."""
+    get_registry().reset()
+    get_tracer().reset()
+
+
+def export_dir(dir_path: str) -> dict:
+    """Write ``telemetry_metrics.{json,jsonl,prom}`` and the
+    Perfetto-loadable ``telemetry_trace.json`` into ``dir_path``; returns
+    ``{name: path}``."""
+    os.makedirs(dir_path, exist_ok=True)
+    reg = get_registry()
+    paths = {}
+    metrics_path = os.path.join(dir_path, "telemetry_metrics.json")
+    with open(metrics_path, "w") as f:
+        json.dump(reg.as_dict(), f, indent=1, sort_keys=True)
+    paths["metrics"] = metrics_path
+    jsonl_path = os.path.join(dir_path, "telemetry_metrics.jsonl")
+    reg.dump_jsonl(jsonl_path)
+    paths["jsonl"] = jsonl_path
+    prom_path = os.path.join(dir_path, "telemetry_metrics.prom")
+    with open(prom_path, "w") as f:
+        f.write(reg.to_prometheus())
+    paths["prometheus"] = prom_path
+    trace_path = os.path.join(dir_path, "telemetry_trace.json")
+    get_tracer().export_chrome_trace(trace_path)
+    paths["trace"] = trace_path
+    return paths
+
+
+if os.environ.get(_ENV_DIR):
+    import atexit
+
+    @atexit.register
+    def _autoexport():  # pragma: no cover - exercised via subprocess tests
+        try:
+            export_dir(os.environ[_ENV_DIR])
+        except Exception as exc:
+            # last-gasp path: stderr, not a raise, at interpreter exit
+            import sys
+
+            print(f"telemetry auto-export failed: {exc}", file=sys.stderr)

@@ -3,17 +3,20 @@
 Reference: ``pipelines/Logging.scala:8-67`` (slf4j wrapper) and the ad-hoc
 ``System.nanoTime`` wall-clock logs (``MnistRandomFFT.scala:34,86-87``).
 Here timers are a small registry that pipelines use for per-stage wall-clock;
-``jax.profiler`` traces can be layered on via ``Timer(trace=...)``.
+``jax.profiler`` traces can be layered on via ``Timer(trace=...)``. Every
+recording is also routed into the structured telemetry registry
+(``telemetry/registry.py``) as a ``timer.<name>`` histogram, so bench
+sections and tests can query stage timings without touching the class dict.
 """
 
 from __future__ import annotations
 
-import contextlib
 import functools
 import logging
 import os
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import ClassVar, Dict, List, Optional
 
 import jax
 
@@ -39,15 +42,49 @@ class Timer:
     result) are device time. Set ``KEYSTONE_SYNC_TIMERS=1`` to hard-barrier
     every local device at each Timer exit for honest per-stage device
     timings (diagnostics only: each barrier costs a host round-trip).
+
+    ``Timer.registry`` is mutated from multiple threads (the prefetch feed's
+    producer path, concurrent fits), so every access goes through
+    ``Timer._lock``; read it via :meth:`summary` rather than directly.
     """
 
-    registry: Dict[str, List[float]] = {}
+    registry: ClassVar[Dict[str, List[float]]] = {}
+    _lock: ClassVar[threading.Lock] = threading.Lock()
+    # One warning for the life of the process: the sync-marker barrier is
+    # best-effort diagnostics, but silently losing it would let an operator
+    # read dispatch times as device times (the knob's whole point).
+    _sync_marker_warned: ClassVar[bool] = False
 
     def __init__(self, name: str, log: bool = True, block: bool = True):
         self.name = name
         self.log = log
         self.block = block
         self.elapsed: Optional[float] = None
+
+    @classmethod
+    def reset(cls) -> None:
+        """Clear all recorded timings (scope a bench section or test)."""
+        with cls._lock:
+            cls.registry.clear()
+
+    @classmethod
+    def summary(cls) -> Dict[str, Dict[str, float]]:
+        """Per-name aggregate of the recordings so far:
+        ``{name: {count, total, mean, min, max}}`` — a consistent snapshot
+        taken under the lock."""
+        with cls._lock:
+            snap = {name: list(vals) for name, vals in cls.registry.items()}
+        return {
+            name: {
+                "count": len(vals),
+                "total": sum(vals),
+                "mean": sum(vals) / len(vals),
+                "min": min(vals),
+                "max": max(vals),
+            }
+            for name, vals in snap.items()
+            if vals
+        }
 
     def __enter__(self):
         self._t0 = time.perf_counter()
@@ -83,10 +120,26 @@ class Timer:
                     for _d in jax.local_devices()
                 ]
                 jax.block_until_ready(markers)
-            except Exception:
-                pass
+            except Exception as sync_exc:
+                # A failed marker means this (and likely every later) timing
+                # silently degrades to dispatch-flush semantics — say so
+                # once instead of letting the knob lie for the whole run.
+                if not Timer._sync_marker_warned:
+                    Timer._sync_marker_warned = True
+                    get_logger("keystone_tpu.timing").warning(
+                        "KEYSTONE_SYNC_TIMERS=1 marker barrier failed "
+                        "(%s: %s); timings fall back to dispatch-flush "
+                        "semantics (logged once)",
+                        type(sync_exc).__name__, sync_exc,
+                    )
         self.elapsed = time.perf_counter() - self._t0
-        Timer.registry.setdefault(self.name, []).append(self.elapsed)
+        with Timer._lock:
+            Timer.registry.setdefault(self.name, []).append(self.elapsed)
+        # Route into the structured registry too (one histogram per stage
+        # name) — the queryable form the bench/report consume.
+        from keystone_tpu.telemetry.registry import get_registry
+
+        get_registry().observe(f"timer.{self.name}", self.elapsed)
         if self.log:
             get_logger("keystone_tpu.timing").info(
                 "%s took %.3f s", self.name, self.elapsed

@@ -41,7 +41,9 @@ def trace(log_dir: Optional[str] = None) -> Iterator[None]:
 
 def annotate(name: str):
     """Label a region so it shows up on the device timeline *and* the host
-    log: combines ``jax.profiler.TraceAnnotation`` with a wall-clock Timer."""
+    log: combines ``jax.profiler.TraceAnnotation`` with a wall-clock Timer
+    and (when tracing is enabled) a structured telemetry span, so the same
+    region lines up across the XLA trace, the log, and the Chrome trace."""
     return _Annotated(name)
 
 
@@ -50,8 +52,14 @@ class _Annotated(contextlib.AbstractContextManager):
         self.name = name
         self._timer = Timer(name)
         self._ann = jax.profiler.TraceAnnotation(name)
+        from keystone_tpu.telemetry import get_tracer
+
+        # sync=False: the Timer's own effects_barrier already flushes
+        # dispatch at exit; a second hard sync here would double the cost
+        self._span = get_tracer().span(name, sync=False)
 
     def __enter__(self):
+        self._span.__enter__()
         self._timer.__enter__()
         self._ann.__enter__()
         return self
@@ -59,4 +67,5 @@ class _Annotated(contextlib.AbstractContextManager):
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
         self._timer.__exit__(*exc)
+        self._span.__exit__(*exc)
         return False

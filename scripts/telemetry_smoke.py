@@ -1,0 +1,82 @@
+"""Telemetry smoke: tiny pipeline under the tracer -> counters non-zero,
+Chrome trace well-formed, report renders. The ``make telemetry-smoke``
+target (folded into ``make verify-fast``) — the end-to-end contract in one
+command, CPU-runnable in seconds.
+
+Exit 0 on success; prints the failing check and exits 1 otherwise.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> int:
+    print(f"TELEMETRY SMOKE FAILED: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    from keystone_tpu import telemetry
+    from keystone_tpu.pipelines.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        run,
+    )
+
+    telemetry.reset()
+    cfg = MnistRandomFFTConfig(
+        num_ffts=2, block_size=256, lam=10.0,
+        synthetic_train=512, synthetic_test=128,
+    )
+    with telemetry.use_tracing(True):
+        run(cfg)
+
+    reg = telemetry.get_registry()
+    metrics = reg.as_dict()
+    spans = telemetry.get_tracer().spans_as_dicts()
+
+    if not spans:
+        return fail("no spans recorded under use_tracing(True)")
+    if not metrics["counters"]:
+        return fail("no counters recorded")
+    if reg.get_counter("solver.calls", solver="bcd") < 1:
+        return fail("solver.calls{solver=bcd} counter is zero")
+    timer_hists = [k for k in metrics["histograms"] if k.startswith("timer.")]
+    if not timer_hists:
+        return fail("no timer.* histograms (Timer -> registry routing)")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = telemetry.export_dir(tmp)
+        with open(paths["trace"]) as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents")
+        if not events:
+            return fail("exported Chrome trace has no traceEvents")
+        for ev in events:
+            for field in ("name", "ph", "ts", "dur", "pid", "tid"):
+                if field not in ev:
+                    return fail(f"trace event missing {field!r}: {ev}")
+        # the report must render from the bench-artifact schema too
+        artifact_path = os.path.join(tmp, "bench_telemetry.json")
+        with open(artifact_path, "w") as f:
+            json.dump({"metrics": metrics, "spans": spans}, f)
+        from keystone_tpu.cli import main as cli_main
+
+        rc = cli_main(["telemetry-report", artifact_path])
+        if rc != 0:
+            return fail(f"telemetry-report exited {rc}")
+
+    print(
+        f"telemetry smoke OK: {len(spans)} spans, "
+        f"{len(metrics['counters'])} counter series, "
+        f"{len(timer_hists)} timer stages"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,7 @@ def _run_bench(tmp_path, extra_env):
         BENCH_SMOKE="1",
         KEYSTONE_BENCH_BUDGET_S="120",
         BENCH_FULL_PATH=str(tmp_path / "bench_full.json"),
+        BENCH_TELEMETRY_PATH=str(tmp_path / "bench_telemetry.json"),
         BENCH_XLA_CACHE=str(tmp_path / "xla_cache"),
     )
     env.update(extra_env)
@@ -64,6 +65,18 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     # the parameterized precision/overlap ladder emitted its base cells
     assert "solver_gflops_per_chip" in full
     assert "solver_gflops_per_chip_overlap" in full
+    # structured-telemetry contract: telemetry_* keys in the COMPACT line,
+    # non-zero span/counter headcounts, and a loadable artifact whose
+    # Chrome trace is Perfetto-shaped
+    assert compact["telemetry_spans"] > 0
+    assert compact["telemetry_counters"] > 0
+    assert full["telemetry_timer_stages"] > 0
+    bt = json.loads((tmp_path / "bench_telemetry.json").read_text())
+    assert bt["metrics"]["counters"]
+    events = bt["chrome_trace"]["traceEvents"]
+    assert events and all(
+        f in ev for ev in events for f in ("name", "ph", "ts", "dur")
+    )
     # every line printed along the way parses too (the incremental flushes)
     for l in proc.stdout.strip().splitlines():
         json.loads(l)

@@ -627,9 +627,12 @@ def test_weighted_model_axis_overlap_matches(mesh2d, rng):
 def test_overlap_fallback_logs_once(mesh, rng, caplog):
     import logging
 
+    from keystone_tpu import telemetry
     from keystone_tpu.parallel import overlap as _ov
 
     _ov._FALLBACK_LOGGED.clear()
+    telemetry.reset()
+    reg = telemetry.get_registry()
     x = jnp.asarray(rng.normal(size=(128, 60)).astype(np.float32))  # 60 % 8
     with caplog.at_level(
         logging.WARNING, logger="keystone_tpu.parallel.overlap"
@@ -640,6 +643,11 @@ def test_overlap_fallback_logs_once(mesh, rng, caplog):
         r for r in caplog.records if "overlap fallback" in r.getMessage()
     ]
     assert len(recs) == 1, [r.getMessage() for r in recs]
+    # ...but the telemetry counter is NOT rate-limited: both fallback
+    # decisions are countable straight off the registry (no log scraping)
+    assert reg.get_counter(
+        "overlap.fallback", site="maybe_tiled_transpose_matmul"
+    ) == 2
     # a DIFFERENT failing shape logs its own line
     y = jnp.asarray(rng.normal(size=(130, 64)).astype(np.float32))  # rows % 8
     with caplog.at_level(
@@ -650,3 +658,15 @@ def test_overlap_fallback_logs_once(mesh, rng, caplog):
         r for r in caplog.records if "overlap fallback" in r.getMessage()
     ]
     assert len(recs) == 2
+    assert reg.get_counter(
+        "overlap.fallback", site="maybe_tiled_transpose_matmul"
+    ) == 3
+    # and an ENGAGED shape increments the engagement series, zero fallbacks
+    telemetry.reset()
+    z = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    maybe_tiled_transpose_matmul(z, None, mesh)
+    assert reg.get_counter(
+        "overlap.engaged", site="tiled_transpose_matmul",
+        schedule="single_tier",
+    ) == 1
+    assert reg.sum_counters("overlap.fallback") == 0

@@ -1,0 +1,474 @@
+"""Structured telemetry: registry semantics (concurrent, resettable,
+exportable), Chrome-trace span schema, the instrumented layers' counters
+(overlap engagement/fallback asserted from the REGISTRY, not log text),
+Timer thread-safety + registry routing, the ``KEYSTONE_SYNC_TIMERS``
+failure-visibility satellite, and the ``telemetry-report`` CLI."""
+
+import json
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu import telemetry
+from keystone_tpu.telemetry.registry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_roundtrip():
+    reg = MetricsRegistry()
+    reg.inc("requests", 2, site="a")
+    reg.inc("requests", site="a")
+    reg.inc("requests", site="b")
+    reg.set_gauge("depth", 3)
+    for v in (0.5, 1.5, 2.5):
+        reg.observe("latency", v)
+
+    assert reg.get_counter("requests", site="a") == 3
+    assert reg.get_counter("requests", site="b") == 1
+    assert reg.get_counter("requests", site="missing") == 0
+    assert reg.get_gauge("depth") == 3
+    h = reg.get_histogram("latency")
+    assert h["count"] == 3 and h["min"] == 0.5 and h["max"] == 2.5
+    assert h["sum"] == pytest.approx(4.5)
+
+    d = reg.as_dict()
+    assert d["counters"]["requests{site=a}"] == 3
+    assert d["gauges"]["depth"] == 3
+    assert d["histograms"]["latency"]["count"] == 3
+    # label-order independence: same series either way
+    reg.inc("multi", x="1", y="2")
+    reg2 = MetricsRegistry()
+    reg2.inc("multi", y="2", x="1")
+    assert (
+        list(reg.counters("multi")) == list(reg2.counters("multi"))
+    )
+
+
+def test_registry_prefix_sums_and_reset():
+    reg = MetricsRegistry()
+    reg.inc("overlap.fallback", 2, site="x")
+    reg.inc("overlap.fallback", 1, site="y")
+    reg.inc("overlap.engaged", site="x")
+    assert reg.sum_counters("overlap.fallback") == 3
+    assert set(reg.counters("overlap.")) == {
+        "overlap.fallback{site=x}", "overlap.fallback{site=y}",
+        "overlap.engaged{site=x}",
+    }
+    reg.reset()
+    assert reg.as_dict() == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_registry_concurrent_writers_exact_totals():
+    """8 writer threads × 500 ops each, with a reader exporting mid-flight:
+    no op may be lost or double-counted, and exports must never crash."""
+    reg = MetricsRegistry()
+    threads, errors = [], []
+
+    def writer(tid: int):
+        try:
+            for i in range(500):
+                reg.inc("work", thread=tid % 2)
+                reg.observe("obs", float(i))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(50):
+                reg.as_dict()
+                reg.to_jsonl()
+                reg.to_prometheus()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    for t in range(8):
+        threads.append(threading.Thread(target=writer, args=(t,)))
+    threads.append(threading.Thread(target=reader))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert reg.get_counter("work", thread=0) + reg.get_counter(
+        "work", thread=1
+    ) == 8 * 500
+    assert reg.get_histogram("obs")["count"] == 8 * 500
+
+
+def test_registry_jsonl_and_prometheus_export():
+    reg = MetricsRegistry()
+    reg.inc("cache.hit", 4, tier="device")
+    reg.set_gauge("prefetch.depth", 2)
+    reg.observe("timer.fit", 0.25)
+
+    lines = [json.loads(l) for l in reg.to_jsonl().strip().splitlines()]
+    by_name = {(l["type"], l["name"]): l for l in lines}
+    assert by_name[("counter", "cache.hit")]["value"] == 4
+    assert by_name[("counter", "cache.hit")]["labels"] == {"tier": "device"}
+    assert by_name[("gauge", "prefetch.depth")]["value"] == 2
+    assert by_name[("histogram", "timer.fit")]["count"] == 1
+
+    prom = reg.to_prometheus()
+    assert "# TYPE keystone_cache_hit counter" in prom
+    assert 'keystone_cache_hit{tier="device"} 4' in prom
+    assert "# TYPE keystone_timer_fit histogram" in prom
+    assert "keystone_timer_fit_count 1" in prom
+    assert 'le="+Inf"' in prom
+
+
+# ---------------------------------------------------------------------------
+# spans / Chrome trace schema
+# ---------------------------------------------------------------------------
+
+def test_span_noop_when_tracing_off():
+    tracer = telemetry.get_tracer()
+    before = len(tracer)
+    with tracer.span("invisible") as sp:
+        assert sp.track("value") == "value"
+        sp.set(anything=1)
+    assert len(tracer) == before
+    assert not telemetry.tracing_enabled()
+
+
+def test_chrome_trace_schema_and_nesting(tmp_path):
+    tracer = telemetry.get_tracer()
+    with telemetry.use_tracing(True):
+        with tracer.span("outer", sync=False) as sp:
+            sp.set(flops=2e9)
+            with tracer.span("child_a", sync=False):
+                pass
+            with tracer.span("child_b", sync=False):
+                pass
+
+    path = tmp_path / "trace.json"
+    tracer.export_chrome_trace(str(path))
+    trace = json.loads(path.read_text())  # valid JSON
+    events = trace["traceEvents"]
+    assert len(events) == 3
+    for ev in events:
+        for field in ("name", "ph", "ts", "dur", "pid", "tid", "args"):
+            assert field in ev, (field, ev)
+        assert ev["ph"] == "X"
+        assert ev["dur"] > 0
+    by_name = {e["name"]: e for e in events}
+    outer, a, b = by_name["outer"], by_name["child_a"], by_name["child_b"]
+    # children nest strictly inside the parent interval, siblings disjoint
+    for child in (a, b):
+        assert child["ts"] >= outer["ts"]
+        assert child["ts"] + child["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert a["ts"] + a["dur"] <= b["ts"] + 1e-3
+    # flops -> achieved GFLOPs derived at export
+    assert outer["args"]["achieved_gflops"] > 0
+    # dispatch-vs-synced: both recorded, dispatch <= total
+    spans = tracer.spans_as_dicts()
+    for s in spans:
+        assert s["dispatch_us"] <= s["dur_us"] + 1e-3
+    depths = {s["name"]: s["depth"] for s in spans}
+    assert depths == {"outer": 0, "child_a": 1, "child_b": 1}
+
+
+def test_chain_run_produces_perfetto_loadable_trace(tmp_path):
+    """Acceptance: a Chain run under the tracer yields per-stage spans
+    (keyed by structural fingerprint) and a loadable Chrome trace."""
+    from keystone_tpu.core.pipeline import Cacher, Transformer, chain
+
+    class Add(Transformer):
+        def apply(self, x):
+            return x + 1.0
+
+    class Scale(Transformer):
+        def apply(self, x):
+            return x * 2.0
+
+    c = chain(Add(), Cacher(), Scale())
+    with telemetry.use_tracing(True):
+        out = c(jnp.ones((16, 4)))
+    assert float(out[0, 0]) == 4.0
+
+    spans = telemetry.get_tracer().spans_as_dicts()
+    stage_spans = [s for s in spans if s["name"].startswith("stage:")]
+    assert {s["name"] for s in stage_spans} == {
+        "stage:Add", "stage:Cacher", "stage:Scale"
+    }
+    for s in stage_spans:
+        assert s["args"]["fingerprint"]
+        assert s["args"]["in_shapes"] and s["args"]["out_shapes"]
+        assert s["args"]["in_bytes"] > 0
+    # chain-level parent span encloses the stages
+    assert any(s["name"].startswith("chain:") for s in spans)
+
+    path = tmp_path / "chain_trace.json"
+    telemetry.get_tracer().export_chrome_trace(str(path))
+    trace = json.loads(path.read_text())
+    assert len(trace["traceEvents"]) == len(spans)
+    # same fingerprint on a refit-equivalent node, different on a new shape
+    from keystone_tpu.telemetry import stage_fingerprint
+
+    assert stage_fingerprint(Add()) == stage_fingerprint(Add())
+    assert stage_fingerprint(jnp.ones((4,))) != stage_fingerprint(
+        jnp.ones((5,))
+    )
+
+
+# ---------------------------------------------------------------------------
+# instrumented layers
+# ---------------------------------------------------------------------------
+
+def test_overlap_counters_from_registry_no_log_scraping(devices):
+    """Engagement and fallback asserted straight off the registry — the
+    bench/test contract the once-per-shape log cannot provide."""
+    from keystone_tpu.parallel import overlap as ov
+    from keystone_tpu.parallel.mesh import make_mesh
+
+    reg = telemetry.get_registry()
+    mesh = make_mesh()
+    x = np.asarray(
+        np.random.default_rng(0).normal(size=(64, 16)), np.float32
+    )
+
+    ov.maybe_tiled_transpose_matmul(jnp.asarray(x), None, mesh)
+    assert reg.get_counter(
+        "overlap.engaged", site="tiled_transpose_matmul",
+        schedule="single_tier",
+    ) == 1
+    assert reg.sum_counters("overlap.fallback") == 0
+    h = reg.get_histogram("overlap.tiles", site="tiled_psum_dot")
+    assert h is not None and h["count"] >= 1
+    assert reg.sum_counters("overlap.reduce_scatter_rounds") >= 1
+
+    # shape-driven fallback: counted per decision, with the site label
+    ov._FALLBACK_LOGGED.clear()
+    ov.maybe_tiled_transpose_matmul(jnp.asarray(x[:63]), None, mesh)
+    ov.maybe_tiled_transpose_matmul(jnp.asarray(x[:63]), None, mesh)
+    assert reg.get_counter(
+        "overlap.fallback", site="maybe_tiled_transpose_matmul"
+    ) == 2  # NOT rate-limited like the log
+
+    # ring TSQR engagement + ppermute round count
+    telemetry.reset()
+    from keystone_tpu.linalg.solvers import tsqr_solve
+
+    b = np.asarray(np.random.default_rng(1).normal(size=(64, 3)), np.float32)
+    tsqr_solve(jnp.asarray(x), jnp.asarray(b), lam=0.1, mesh=mesh,
+               overlap=True)
+    assert reg.get_counter("overlap.engaged", site="ring_tsqr_fold") >= 1
+    assert reg.get_counter(
+        "overlap.ppermute_rounds", site="ring_tsqr_fold"
+    ) >= 7  # k=8: 2*ceil(7/2) paired + 1 middle hop
+    assert reg.get_counter("solver.calls", solver="tsqr") == 1
+
+
+def test_cache_counters_per_tier():
+    from keystone_tpu.core.cache import IntermediateCache
+
+    reg = telemetry.get_registry()
+    cache = IntermediateCache(device_bytes=1 << 20, host_bytes=1 << 20)
+    calls = []
+    value = jnp.arange(8.0)
+
+    def compute():
+        calls.append(1)
+        return value
+
+    cache.memoize("k1", compute)  # miss -> compute -> put
+    cache.memoize("k1", compute)  # device hit
+    assert len(calls) == 1
+    assert reg.get_counter("cache.miss") == 1
+    assert reg.get_counter("cache.compute") == 1
+    assert reg.get_counter("cache.put") == 1
+    assert reg.get_counter("cache.hit", tier="device") == 1
+    # mirror of the CacheStats view
+    assert cache.stats.hits == 1 and cache.stats.misses == 1
+
+
+def test_prefetch_counters():
+    from keystone_tpu.core.prefetch import prefetch_map
+
+    reg = telemetry.get_registry()
+    out = list(prefetch_map(lambda x: x * 2, range(5), depth=2))
+    assert out == [0, 2, 4, 6, 8]
+    assert reg.get_gauge("prefetch.depth") == 2
+    # item 0 stalls (nothing produced yet), the rest were run ahead
+    assert reg.get_counter("prefetch.stall") == 1
+    assert reg.get_counter("prefetch.ready") == 4
+    assert reg.get_counter("prefetch.produced_ahead") == 4
+    assert reg.get_counter("prefetch.stall_s") >= 0
+
+    telemetry.reset()
+    # a gate that forbids crossing parity boundaries blocks run-ahead
+    list(prefetch_map(
+        lambda x: x, [0, 0, 1, 1], depth=3,
+        gate=lambda a, b: a == b,
+    ))
+    assert reg.get_counter("prefetch.gate_blocked") >= 1
+
+
+def test_bcd_residual_trajectory_and_unchanged_result():
+    from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(64, 3)), jnp.float32)
+
+    W_plain = block_coordinate_descent_l2(A, b, 1.0, 8, num_iter=2)
+    reg = telemetry.get_registry()
+    assert reg.get_counter("solver.calls", solver="bcd") == 1
+    assert reg.get_counter("solver.bcd.gram_flops") > 0
+    assert reg.get_histogram("solver.bcd.residual_fro") is None  # off: none
+
+    with telemetry.use_tracing(True):
+        W_traced = block_coordinate_descent_l2(A, b, 1.0, 8, num_iter=2)
+    h = reg.get_histogram("solver.bcd.residual_fro")
+    assert h["count"] == 4  # 2 blocks x 2 iterations
+    # BCD monotonically non-increases the residual; final <= first step
+    assert h["min"] <= h["max"]
+    assert reg.get_gauge("solver.bcd.final_residual_fro") == pytest.approx(
+        h["min"], rel=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(W_plain), np.asarray(W_traced), rtol=1e-6
+    )
+    span_names = [s["name"] for s in telemetry.get_tracer().spans_as_dicts()]
+    assert "solver.bcd" in span_names
+
+
+# ---------------------------------------------------------------------------
+# Timer satellites
+# ---------------------------------------------------------------------------
+
+def test_timer_thread_safety_reset_summary_and_registry_routing():
+    from keystone_tpu.utils import Timer
+
+    Timer.reset()
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                with Timer("tele.test.concurrent", log=False, block=False):
+                    pass
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(Timer.registry["tele.test.concurrent"]) == 400
+    s = Timer.summary()["tele.test.concurrent"]
+    assert s["count"] == 400 and s["total"] >= 0 and s["min"] <= s["max"]
+    # routed into the structured registry as a histogram
+    h = telemetry.get_registry().get_histogram("timer.tele.test.concurrent")
+    assert h["count"] == 400
+    Timer.reset()
+    assert "tele.test.concurrent" not in Timer.registry
+
+
+def test_sync_timers_marker_failure_logged_once(monkeypatch, caplog):
+    """The KEYSTONE_SYNC_TIMERS marker path must not swallow failures
+    silently: one warning for the process, and the timing still records."""
+    from keystone_tpu.utils import Timer
+    from keystone_tpu.utils import logging as klog
+
+    monkeypatch.setenv("KEYSTONE_SYNC_TIMERS", "1")
+    monkeypatch.setattr(
+        klog.jax, "local_devices",
+        lambda: (_ for _ in ()).throw(RuntimeError("devices gone")),
+    )
+    monkeypatch.setattr(Timer, "_sync_marker_warned", False)
+    Timer.reset()
+    with caplog.at_level(logging.WARNING, logger="keystone_tpu.timing"):
+        with Timer("tele.test.sync_fail", log=False, block=False) as t1:
+            pass
+        with Timer("tele.test.sync_fail", log=False, block=False):
+            pass
+    warnings = [
+        r for r in caplog.records
+        if "KEYSTONE_SYNC_TIMERS" in r.getMessage()
+    ]
+    assert len(warnings) == 1  # once per process, not per Timer
+    assert "devices gone" in warnings[0].getMessage()
+    assert t1.elapsed is not None  # timing survived the failed barrier
+    assert len(Timer.registry["tele.test.sync_fail"]) == 2
+    Timer.reset()
+
+
+def test_sync_timers_marker_path_works(monkeypatch):
+    """Knob coverage: with the env set and healthy devices the marker
+    barrier runs and the timer records normally."""
+    from keystone_tpu.utils import Timer
+
+    monkeypatch.setenv("KEYSTONE_SYNC_TIMERS", "1")
+    monkeypatch.setattr(Timer, "_sync_marker_warned", False)
+    Timer.reset()
+    with Timer("tele.test.sync_ok", log=False) as t:
+        jnp.ones((8,)).sum()
+    assert t.elapsed is not None and t.elapsed >= 0
+    assert Timer._sync_marker_warned is False  # no failure, no warning
+    Timer.reset()
+
+
+# ---------------------------------------------------------------------------
+# report CLI
+# ---------------------------------------------------------------------------
+
+def test_telemetry_report_cli(tmp_path, capsys):
+    from keystone_tpu.cli import main as cli_main
+
+    reg = MetricsRegistry()
+    reg.inc("overlap.engaged", 3, site="tiled_psum_dot")
+    reg.observe("timer.fit", 1.25)
+    artifact = {
+        "metrics": reg.as_dict(),
+        "spans": [{
+            "name": "solver.bcd", "ts_us": 0.0, "dispatch_us": 10.0,
+            "dur_us": 1000.0, "depth": 0, "tid": 1,
+            "args": {"achieved_gflops": 42.0},
+        }],
+    }
+    path = tmp_path / "bench_telemetry.json"
+    path.write_text(json.dumps(artifact))
+
+    assert cli_main(["telemetry-report", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "overlap.engaged{site=tiled_psum_dot}" in out
+    assert "timer.fit" in out
+    assert "solver.bcd" in out and "42.0" in out
+
+    assert cli_main(["telemetry-report", str(tmp_path / "missing.json")]) == 2
+
+
+def test_export_dir_writes_all_artifacts(tmp_path):
+    reg = telemetry.get_registry()
+    reg.inc("x")
+    with telemetry.use_tracing(True):
+        with telemetry.get_tracer().span("s", sync=False):
+            pass
+    paths = telemetry.export_dir(str(tmp_path))
+    metrics = json.loads((tmp_path / "telemetry_metrics.json").read_text())
+    assert metrics["counters"]["x"] == 1
+    trace = json.loads((tmp_path / "telemetry_trace.json").read_text())
+    assert trace["traceEvents"][0]["name"] == "s"
+    assert "keystone_x" in (tmp_path / "telemetry_metrics.prom").read_text()
+    jsonl = [
+        json.loads(l)
+        for l in (tmp_path / "telemetry_metrics.jsonl").read_text().splitlines()
+    ]
+    assert any(l["name"] == "x" and l["value"] == 1 for l in jsonl)
+    assert set(paths) == {"metrics", "jsonl", "prometheus", "trace"}
