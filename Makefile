@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test verify-fast telemetry-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint verify-fast telemetry-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -22,13 +22,21 @@ keystone_tpu/native/_ngram.so: keystone_tpu/native/ngram.cpp
 test:
 	$(PY) -m pytest tests/ -q
 
-# Tier-1 plus the BENCH_SMOKE bench contract plus the telemetry smoke in
-# ONE command — the pre-merge loop: the full (non-slow) test suite on the
-# 8-device CPU mesh, a tiny-shape end-to-end bench pass that exercises the
+# Static analysis (keystone_tpu/analysis): rules R1-R5 over the package +
+# bench + scripts. Exit is non-zero ONLY for findings not in the ratcheted
+# lint_baseline.json — pre-existing debt can't grow, fixed debt is
+# reported as stale. Seconds, no backend init.
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m keystone_tpu.analysis
+
+# Lint + tier-1 + the BENCH_SMOKE bench contract + the telemetry smoke in
+# ONE command — the pre-merge loop: the static pass first (it is the
+# cheapest failure), then the full (non-slow) test suite on the 8-device
+# CPU mesh, a tiny-shape end-to-end bench pass that exercises the
 # compact-line / budget-skip / incremental-flush machinery (exactly what
 # tests/test_bench_contract.py pins, but visible in your terminal), and a
 # tiny traced pipeline run asserting the telemetry contract end to end.
-verify-fast:
+verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=120 $(PY) bench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py

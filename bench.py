@@ -44,6 +44,15 @@ import time
 import jax
 import jax.numpy as jnp
 
+from keystone_tpu.utils import knobs
+
+# Fail fast on a typo'd knob: every section gate now reads through the
+# strict registry, and a ValueError surfacing mid-run at whichever section
+# reads the bad value first would forfeit the partial-results contract.
+# Validating everything up front moves that failure to t=0, before any
+# result exists to lose.
+knobs.validate_environment()
+
 # Persistent XLA compilation cache: the extras cover seven pipelines whose
 # first-compile cost (~10 min total) would otherwise recur on every bench
 # invocation; with the cache only the first run on a machine pays it. The
@@ -51,7 +60,7 @@ import jax.numpy as jnp
 # pre-populated cache is mostly cache-deserialize time — the JSON states
 # the cache state (``xla_cache_prewarmed``) so cold numbers can't be
 # misread across runs.
-_CACHE_DIR = os.environ.get("BENCH_XLA_CACHE", "/tmp/keystone_xla_cache")
+_CACHE_DIR = knobs.get("BENCH_XLA_CACHE")
 _CACHE_PREWARMED = os.path.isdir(_CACHE_DIR) and bool(os.listdir(_CACHE_DIR))
 try:
     jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
@@ -63,7 +72,7 @@ except Exception as e:  # never let cache config block the benchmark
 # still exercises the emit/budget/section machinery (make bench-smoke, the
 # bench-contract tier-1 test). Heavy sections default OFF — but only
 # default: an explicit BENCH_<X>=1 in the environment still runs them.
-_SMOKE = os.environ.get("BENCH_SMOKE", "0") == "1"
+_SMOKE = knobs.get("BENCH_SMOKE")
 if _SMOKE:
     for _gate in ("BENCH_EXTRAS", "BENCH_FLAGSHIP", "BENCH_VOC_REFDIM",
                   "BENCH_TIMIT_FULL", "BENCH_CACHED", "BENCH_PREFETCH",
@@ -75,11 +84,11 @@ if _SMOKE:
 # ~900 s (rc=124); finishing under the budget means the FINAL compact line
 # is printed before that. Sections checked against the remaining budget are
 # skipped (with explicit *_skipped entries) rather than started.
-_BUDGET_S = float(os.environ.get("KEYSTONE_BENCH_BUDGET_S", "840"))
+_BUDGET_S = knobs.get("KEYSTONE_BENCH_BUDGET_S")
 _BUDGET_T0 = time.monotonic()  # re-anchored at main() entry
 # Minimum seconds a big section must have left to start, and the reserve
 # kept for the final flush + ratio bookkeeping.
-_SECTION_FLOOR_S = float(os.environ.get("KEYSTONE_BENCH_SECTION_FLOOR_S", "60"))
+_SECTION_FLOOR_S = knobs.get("KEYSTONE_BENCH_SECTION_FLOOR_S")
 _FINALIZE_RESERVE_S = 15.0
 
 
@@ -94,7 +103,7 @@ def _flush(out: dict, section: str) -> None:
     artifact. BENCH_KILL_AFTER_SECTION is the test hook that simulates the
     driver's SIGKILL right after a named section's flush."""
     _emit(out, partial=True)
-    if os.environ.get("BENCH_KILL_AFTER_SECTION") == section:
+    if knobs.get_raw("BENCH_KILL_AFTER_SECTION") == section:
         import signal
 
         sys.stdout.flush()
@@ -200,7 +209,7 @@ def _try_solver_gflops_ladder() -> dict:
             "high", overlap=True
         ),
     }
-    if os.environ.get("BENCH_EXTRAS", "1") != "0":
+    if knobs.get("BENCH_EXTRAS"):
         rows["solver_gflops_per_chip_f32_highest"] = _try_solver_gflops(
             "highest"
         )
@@ -234,7 +243,7 @@ _EXTRA_PIPELINES = (
 )
 
 
-WARM_REPS = int(os.environ.get("BENCH_WARM_REPS", "3"))
+WARM_REPS = knobs.get("BENCH_WARM_REPS")
 
 # A warm distribution whose max strays this far above its median was
 # measurably contended (chip shared with another tenant): BASELINE.md's
@@ -276,7 +285,7 @@ def _try_extras():
     """Secondary whole-pipeline wall-clocks (warm median of WARM_REPS, with
     min/max spread), never fatal. Disable with BENCH_EXTRAS=0 to keep the
     run to the primary metric only."""
-    if os.environ.get("BENCH_EXTRAS", "1") == "0":
+    if not knobs.get("BENCH_EXTRAS"):
         return {}
     import importlib
 
@@ -306,7 +315,7 @@ def _try_device_count_constants():
     otherwise silently strand the design on the slow side (VERDICT r3 weak
     #6). Latency-cancelled timing — (K chained ops) − (1 op) — so the
     ~100 ms tunnel round trip drops out. BENCH_CONSTANTS=0 skips."""
-    if os.environ.get("BENCH_CONSTANTS", "1") == "0":
+    if not knobs.get("BENCH_CONSTANTS"):
         return {}
     try:
         n = 1 << 20  # ~the 20k-doc StupidBackoff window-key count
@@ -385,7 +394,7 @@ def _try_serving_latency():
       the single sync cancel in the difference.
 
     BENCH_SERVE=0 skips."""
-    if os.environ.get("BENCH_SERVE", "1") == "0":
+    if not knobs.get("BENCH_SERVE"):
         return {}
     import statistics
 
@@ -531,7 +540,7 @@ def _try_moments_design_point():
     chunked-XLA path, single-sync timings (VERDICT r2 weak #6: demonstrate
     the regime or stop maintaining two paths — demonstrated; the auto path
     picks the measured winner). Never fatal; BENCH_MOMENTS=0 skips."""
-    if os.environ.get("BENCH_MOMENTS", "1") == "0":
+    if not knobs.get("BENCH_MOMENTS"):
         return {}
     try:
         from keystone_tpu.ops.pallas.moments import (
@@ -601,10 +610,10 @@ def _try_flagship_stage_breakdown():
     'achieved' = formula / barriered seconds, so cross-stage overlap that
     the async run enjoys is deliberately absent here. BENCH_STAGES=0 skips.
     """
-    if os.environ.get("BENCH_STAGES", "1") == "0":
+    if not knobs.get("BENCH_STAGES"):
         return {}
     try:
-        prev = os.environ.get("KEYSTONE_SYNC_TIMERS")
+        prev = knobs.get_raw("KEYSTONE_SYNC_TIMERS")
         os.environ["KEYSTONE_SYNC_TIMERS"] = "1"
         try:
             from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
@@ -691,9 +700,9 @@ def _try_cache_rows():
     the delta IS the re-featurization the cache eliminates. Compile warmth
     is established by an uncached run first, so the cold row measures
     compute, not XLA. Never fatal; BENCH_CACHED=0 skips."""
-    if os.environ.get("BENCH_CACHED", "1") == "0":
+    if not knobs.get("BENCH_CACHED"):
         return {}
-    prev_flag = os.environ.get("KEYSTONE_EVAL_CACHED_TIMING")
+    prev_flag = knobs.get_raw("KEYSTONE_EVAL_CACHED_TIMING")
     try:
         from keystone_tpu.core.cache import IntermediateCache, use_cache
         from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
@@ -753,9 +762,9 @@ def _try_prefetch_rows():
     ``prefetch_map``) warm-timed with KEYSTONE_PREFETCH=1 vs 0. Results
     are bit-identical by construction; only the overlap differs. Never
     fatal; BENCH_PREFETCH=0 skips."""
-    if os.environ.get("BENCH_PREFETCH", "1") == "0":
+    if not knobs.get("BENCH_PREFETCH"):
         return {}
-    prev = os.environ.get("KEYSTONE_PREFETCH")
+    prev = knobs.get_raw("KEYSTONE_PREFETCH")
     try:
         from keystone_tpu.core.cache import use_cache
         from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
@@ -806,7 +815,7 @@ def _try_telemetry_rows(config) -> dict:
     per-tier cache traffic, prefetch stalls, and per-stage spans, instead
     of implying them. Traced runs sync per span, so this row is diagnostics,
     never the headline timing. BENCH_TELEMETRY=0 skips."""
-    if os.environ.get("BENCH_TELEMETRY", "1") == "0":
+    if not knobs.get("BENCH_TELEMETRY"):
         return {}
     try:
         from keystone_tpu import telemetry
@@ -830,7 +839,7 @@ def _try_telemetry_rows(config) -> dict:
             "spans": spans,
             "chrome_trace": telemetry.get_tracer().chrome_trace(),
         }
-        path = os.environ.get("BENCH_TELEMETRY_PATH") or os.path.join(
+        path = knobs.get_raw("BENCH_TELEMETRY_PATH") or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "bench_telemetry.json"
         )
         tmp = path + ".tmp"
@@ -858,6 +867,36 @@ def _try_telemetry_rows(config) -> dict:
     except Exception as e:
         print(f"telemetry rows failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+        return {}
+
+
+def _try_lint_rows() -> dict:
+    """Static-analysis hygiene row (``keystone_tpu/analysis``): run the
+    R1-R5 pass over the package + bench + scripts and record the finding
+    counts, so the bench trail shows hygiene over time next to the perf
+    numbers. ``lint_findings_total`` counts everything surfaced (new +
+    baselined — the debt), ``lint_new`` what would fail ``make lint``.
+    Pure-AST, no device work: milliseconds. BENCH_LINT=0 skips."""
+    if not knobs.get("BENCH_LINT"):
+        return {}
+    try:
+        from keystone_tpu.analysis import run_lint
+        from keystone_tpu.analysis.cli import DEFAULT_BASELINE, default_paths
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        baseline = os.path.join(root, DEFAULT_BASELINE)
+        result = run_lint(
+            root, default_paths(root),
+            baseline_path=baseline if os.path.exists(baseline) else None,
+        )
+        return {
+            "lint_findings_total": result.total,
+            "lint_new": len(result.findings),
+            "lint_suppressed": result.suppressed,
+            "lint_files": result.files,
+        }
+    except Exception as e:
+        print(f"lint rows failed: {type(e).__name__}: {e}", file=sys.stderr)
         return {}
 
 
@@ -988,6 +1027,11 @@ def main():
     else:
         out.update(_try_telemetry_rows(config))
     _flush(out, "telemetry")
+    # Static-analysis hygiene (milliseconds, no budget gate): the compact
+    # line records lint_findings_total so a hygiene regression is visible
+    # in the same trail as a perf regression.
+    out.update(_try_lint_rows())
+    _flush(out, "lint")
     if _budget_remaining() - _FINALIZE_RESERVE_S < _SECTION_FLOOR_S:
         # a cache-cold primary compile can eat most of the budget; the
         # ladder times dozens of flagship-shape solves and gets the same
@@ -1003,7 +1047,7 @@ def main():
     # fresh process, timeout derated from the remaining budget like every
     # other regime. On the single driver chip the knobs fall back (parity
     # documents it); a >=4-chip run ratchets the measured delta.
-    if os.environ.get("BENCH_SOLVER_OVERLAP", "1") == "1":
+    if knobs.get("BENCH_SOLVER_OVERLAP"):
         out.update(
             _run_regime_subprocess(
                 "solver_overlap", fail_key="tsqr_overlap_on_gflops"
@@ -1021,14 +1065,14 @@ def main():
     # out on cache-cold machines where the first-ever compile is ~6 min).
     # Timeouts are derated from the remaining bench budget; a regime that
     # no longer fits is recorded as <key>_skipped instead of started.
-    if os.environ.get("BENCH_FLAGSHIP", "1") == "1":
+    if knobs.get("BENCH_FLAGSHIP"):
         out.update(
             _run_regime_subprocess(
                 "flagship", fail_key="imagenet_refdim_streaming_warm_s"
             )
         )
         _flush(out, "flagship")
-    if os.environ.get("BENCH_VOC_REFDIM", "1") == "1":
+    if knobs.get("BENCH_VOC_REFDIM"):
         out.update(
             _run_regime_subprocess("voc_refdim", fail_key="voc_refdim_warm_s")
         )
@@ -1052,7 +1096,7 @@ def main():
             continue
         out.update(fn())
         _flush(out, name)
-    if os.environ.get("BENCH_TIMIT_FULL", "1") == "1":
+    if knobs.get("BENCH_TIMIT_FULL"):
         out.update(
             _run_regime_subprocess(
                 "timit_full", fail_key="timit_full_2p2m_warm_s"
@@ -1109,6 +1153,9 @@ _COMPACT_KEYS = (
     ("telemetry_spans", "telemetry_spans"),
     ("telemetry_counters", "telemetry_counters"),
     ("telemetry_fallbacks", "telemetry_overlap_fallbacks"),
+    # static-analysis hygiene (keystone_tpu/analysis; full counts in
+    # bench_full.json)
+    ("lint", "lint_findings_total"),
     # flagship regime
     ("fs", "imagenet_refdim_streaming_warm_s"),
     ("fs_cont", "imagenet_refdim_streaming_warm_s_contended"),
@@ -1182,7 +1229,7 @@ def _emit(out: dict, partial: bool = False) -> None:
     killed before the final emit the LAST stdout line remains parseable
     (rc=124 can no longer produce ``parsed: null``). ``BENCH_FULL_PATH``
     overrides the artifact location (tests point it at a tmp dir)."""
-    full_path = os.environ.get("BENCH_FULL_PATH") or os.path.join(
+    full_path = knobs.get_raw("BENCH_FULL_PATH") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_full.json"
     )
     compact = {}

@@ -1,0 +1,36 @@
+"""keystone-lint: JAX/TPU-aware static analysis + runtime guard.
+
+- ``engine`` — AST rule engine: findings, pragma suppression, the
+  ratcheted ``lint_baseline.json`` workflow.
+- ``rules`` — the five rule families (R1 host-sync-in-hot-path, R2
+  recompile-hazard, R3 collective-safety, R4 knob-hygiene, R5
+  shared-state-lock).
+- ``reporters`` — text (clickable ``file:line``) / JSON renderers.
+- ``guard`` — the runtime cross-check: ``jax.transfer_guard`` + a
+  recompilation sentinel feeding ``guard.transfer`` / ``guard.recompile``
+  counters into the telemetry registry (``KEYSTONE_GUARD=1``).
+- ``cli`` — the ``keystone-tpu lint`` subcommand.
+
+Import note: everything except ``guard`` is jax-free, so the lint pass
+runs in milliseconds with no backend initialization.
+"""
+
+from keystone_tpu.analysis.engine import (
+    Finding,
+    LintEngine,
+    LintResult,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "LintEngine",
+    "LintResult",
+    "apply_baseline",
+    "load_baseline",
+    "run_lint",
+    "save_baseline",
+]

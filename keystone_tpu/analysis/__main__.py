@@ -1,0 +1,7 @@
+"""``python -m keystone_tpu.analysis`` == ``keystone-tpu lint``."""
+
+import sys
+
+from keystone_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,93 @@
+"""``keystone-tpu lint`` — run the static-analysis pass from the shell.
+
+Exit code contract (the CI ratchet): **0** when no *new* findings (clean,
+or everything is baselined/pragma'd), **1** when new findings exist, **2**
+on usage errors.  Output is ``path:line:col: RULE message`` — the triple
+terminals make clickable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from keystone_tpu.analysis.engine import run_lint, save_baseline, LintEngine
+from keystone_tpu.analysis.reporters import render_json, render_text
+
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def default_paths(root: str) -> List[str]:
+    out = [
+        p for p in ("keystone_tpu", "bench.py", "scripts")
+        if os.path.exists(os.path.join(root, p))
+    ]
+    return out or ["."]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="keystone-tpu lint",
+        description="JAX/TPU-aware static analysis (rules R1-R5); "
+                    "fails only on findings not in the ratcheted baseline.",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: keystone_tpu, "
+                         "bench.py, scripts)")
+    ap.add_argument("--root", default=".",
+                    help="repo root for relative paths + baseline")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/"
+                         f"{DEFAULT_BASELINE} when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report and fail on every "
+                         "finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "and exit 0 (the ratchet reset)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also list baselined (non-failing) findings")
+    ap.add_argument("--no-hints", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    root = os.path.abspath(args.root)
+    paths = args.paths or default_paths(root)
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    use_baseline = not args.no_baseline and (
+        args.baseline is not None or os.path.exists(baseline_path)
+    )
+
+    if args.update_baseline:
+        result = LintEngine(root, paths).run()
+        save_baseline(baseline_path, result.findings)
+        print(
+            f"keystone-lint: baselined {len(result.findings)} findings "
+            f"({result.suppressed} pragma-suppressed) -> {baseline_path}"
+        )
+        return 0
+
+    result = run_lint(
+        root, paths,
+        baseline_path=baseline_path if use_baseline else None,
+    )
+    if args.format == "json":
+        sys.stdout.write(render_json(result))
+    else:
+        print(render_text(
+            result,
+            show_baselined=args.show_baselined,
+            hints=not args.no_hints,
+        ))
+    if result.errors:
+        return 2
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,442 @@
+"""keystone-lint: AST rule engine for the package's TPU invariants.
+
+Four PRs of this codebase accumulated invariants that were policed only by
+review: no host syncs inside jit/shard_map hot paths, jits constructed once
+(not per call or per loop iteration), collective axis names bound by the
+enclosing ``shard_map`` spec, paired ppermute send/recv in the ring folds,
+every ``KEYSTONE_*``/``BENCH_*`` knob going through ``utils/knobs.py``, and
+lock-guarded mutation of shared telemetry/cache/prefetch state.  "Memory
+Safe Computations with XLA Compiler" (PAPERS.md) makes the case for
+analyzing the program *before* it runs; this engine applies that one level
+up, at the Python/JAX source layer, so a regression in the overlap/solver
+hot paths fails CI instead of a pod run.
+
+Architecture:
+
+- :class:`ModuleInfo` — one parsed file: AST with parent links, source
+  lines, ``# lint: disable=`` pragma map, import map.
+- :class:`LintContext` — all modules plus cross-file helpers (the
+  approximate package call graph the R1 rule walks, declared-knob
+  extraction for R4).
+- :class:`Rule` subclasses (``rules.py``) — one visitor per hazard class,
+  returning :class:`Finding` objects with file:line, rule id, and a fix
+  hint.
+- Baseline ratchet — ``lint_baseline.json`` maps finding fingerprints to
+  counts; only findings *beyond* the baselined count fail, so pre-existing
+  debt can't grow and fixing debt never breaks the build.  Fingerprints
+  deliberately exclude line numbers (pure line drift must not churn the
+  baseline).
+
+Pragmas: ``# lint: disable=R1,R5 (reason)`` on the offending line — or on
+its own line immediately above — suppresses those rules there; a bare
+``# lint: disable`` suppresses every rule.  Suppressions are counted and
+reported, never silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+PRAGMA_RE = re.compile(r"lint:\s*disable(?:=([A-Za-z0-9_,\- ]+))?")
+
+#: rule ids a bare ``lint: disable`` expands to
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to ``path:line:col``.
+
+    ``symbol`` is the stable identity component (function name, knob name,
+    container name): the baseline fingerprint is built from (path, rule,
+    symbol-or-message) so findings survive pure line drift.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    symbol: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.rule}::{self.symbol or self.message}"
+
+    def format(self, hints: bool = True) -> str:
+        # path:line: leading triple is what terminals make clickable.
+        s = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        if hints and self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)     # new (fail)
+    baselined: List[Finding] = field(default_factory=list)    # known debt
+    stale: Dict[str, int] = field(default_factory=dict)       # fixed debt
+    suppressed: int = 0                                        # via pragma
+    files: int = 0
+    errors: List[str] = field(default_factory=list)           # unparsable
+
+    @property
+    def total(self) -> int:
+        """Everything the pass surfaced (new + baselined) — the bench's
+        ``lint_findings_total`` hygiene series."""
+        return len(self.findings) + len(self.baselined)
+
+
+# ---------------------------------------------------------------------------
+# Parsed modules
+# ---------------------------------------------------------------------------
+
+def _collect_pragmas(source: str) -> Dict[int, Set[str]]:
+    """line -> set of disabled rule ids (``{"*"}`` = all).  A pragma in a
+    comment-only line covers the rest of its comment block plus the first
+    code line after it (the natural "justification paragraph" shape); a
+    trailing pragma covers its own line."""
+    pragmas: Dict[int, Set[str]] = {}
+    lines = source.splitlines()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = (
+                {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+                if m.group(1) else {"*"}
+            )
+            line = tok.start[0]
+            pragmas.setdefault(line, set()).update(rules)
+            standalone = tok.line[: tok.start[1]].strip() == ""
+            if standalone:
+                nxt = line + 1
+                while nxt <= len(lines) and (
+                    not lines[nxt - 1].strip()
+                    or lines[nxt - 1].lstrip().startswith("#")
+                ):
+                    pragmas.setdefault(nxt, set()).update(rules)
+                    nxt += 1
+                pragmas.setdefault(nxt, set()).update(rules)
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return pragmas
+
+
+class ModuleInfo:
+    """One parsed source file plus the per-file indexes rules share."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.pragmas = _collect_pragmas(source)
+        # Parent links: rules walk *up* for loop/with/function context.
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._lint_parent = node  # type: ignore[attr-defined]
+        # name -> imported dotted module/symbol (module-level AND local
+        # imports pooled: this repo imports lazily inside functions).
+        self.imports: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    def suppressed_rules(self, line: int) -> Set[str]:
+        return self.pragmas.get(line, set())
+
+
+# -- AST helpers shared by the rules ----------------------------------------
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_lint_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return a
+    return None
+
+
+def in_loop(node: ast.AST, stop_at: Optional[ast.AST] = None) -> bool:
+    """Whether ``node`` sits lexically inside a for/while (not crossing out
+    of ``stop_at`` when given — loop-ness doesn't cross function scopes)."""
+    for a in ancestors(node):
+        if a is stop_at or isinstance(
+            a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return False
+        if isinstance(a, (ast.For, ast.While)):
+            return True
+    return False
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for Attribute/Name chains; None for anything else."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def expr_contains_lockish(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and "lock" in name.lower():
+            return True
+    return False
+
+
+def under_lock(node: ast.AST) -> bool:
+    """Whether any lexical ancestor is a ``with`` whose context expression
+    mentions a lock-ish name (``with self._lock:``, ``with Timer._lock:``)."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(a, ast.With):
+            for item in a.items:
+                if expr_contains_lockish(item.context_expr):
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Context: the cross-file view
+# ---------------------------------------------------------------------------
+
+class LintContext:
+    def __init__(self, root: str, modules: Dict[str, ModuleInfo]):
+        self.root = root
+        self.modules = modules
+
+    def readme_text(self) -> str:
+        path = os.path.join(self.root, "README.md")
+        try:
+            with open(path, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def declared_knobs(self) -> Dict[str, int]:
+        """Knob name -> declaration line, extracted from the AST of
+        ``utils/knobs.py`` (no package import: lint stays jax-free)."""
+        out: Dict[str, int] = {}
+        for rel, mod in self.modules.items():
+            if not rel.replace(os.sep, "/").endswith("utils/knobs.py"):
+                continue
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and call_name(node) in ("declare", "knobs.declare")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    out[node.args[0].value] = node.lineno
+        if not out:
+            # Engine run on a tree without knobs.py (fixture dirs): fall
+            # back to the installed package's own declaration file.
+            here = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "utils", "knobs.py",
+            )
+            try:
+                with open(here, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+                for node in ast.walk(tree):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "declare"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                    ):
+                        out[node.args[0].value] = node.lineno
+            except OSError:
+                pass
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Baseline ratchet
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, int]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return {}
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    payload = {
+        "comment": (
+            "keystone-lint ratchet: pre-existing findings by fingerprint. "
+            "New findings (beyond these counts) fail `make lint`; prefer "
+            "fixing or an inline `# lint: disable=<rule> (<reason>)` pragma "
+            "over baselining. Regenerate with `keystone-tpu lint "
+            "--update-baseline`."
+        ),
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], List[Finding], Dict[str, int]]:
+    """(new, baselined, stale): findings beyond a fingerprint's baselined
+    count are new; baseline entries with no surviving finding are stale
+    (debt that got fixed — tighten with ``--update-baseline``)."""
+    groups: Dict[str, List[Finding]] = {}
+    for f in findings:
+        groups.setdefault(f.fingerprint, []).append(f)
+    new: List[Finding] = []
+    known: List[Finding] = []
+    for fp, group in groups.items():
+        allowed = baseline.get(fp, 0)
+        group = sorted(group, key=lambda f: (f.line, f.col))
+        known.extend(group[:allowed])
+        new.extend(group[allowed:])
+    stale = {
+        fp: count - len(groups.get(fp, []))
+        for fp, count in baseline.items()
+        if count > len(groups.get(fp, ()))
+    }
+    return new, known, stale
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+def discover_files(root: str, paths: Sequence[str]) -> List[str]:
+    """Resolve files/dirs (relative to ``root``) to a sorted .py list."""
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(full)
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                ]
+                out.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames if f.endswith(".py")
+                )
+    return sorted(set(out))
+
+
+class LintEngine:
+    def __init__(
+        self,
+        root: str,
+        paths: Optional[Sequence[str]] = None,
+        rules: Optional[Sequence[Any]] = None,
+    ):
+        self.root = os.path.abspath(root)
+        self.paths = list(paths) if paths else ["keystone_tpu"]
+        self._rules = rules
+
+    def run(self) -> LintResult:
+        from keystone_tpu.analysis.rules import default_rules
+
+        rules = self._rules if self._rules is not None else default_rules()
+        result = LintResult()
+        modules: Dict[str, ModuleInfo] = {}
+        for path in discover_files(self.root, self.paths):
+            rel = os.path.relpath(path, self.root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                modules[rel] = ModuleInfo(path, rel, source)
+            except (OSError, SyntaxError, ValueError) as e:
+                result.errors.append(f"{rel}: {type(e).__name__}: {e}")
+        result.files = len(modules)
+        ctx = LintContext(self.root, modules)
+
+        raw: List[Finding] = []
+        for rule in rules:
+            raw.extend(rule.run(ctx))
+
+        kept: List[Finding] = []
+        for f in raw:
+            mod = modules.get(f.path)
+            disabled = mod.suppressed_rules(f.line) if mod else set()
+            if "*" in disabled or f.rule in disabled:
+                result.suppressed += 1
+            else:
+                kept.append(f)
+        result.findings = sorted(
+            kept, key=lambda f: (f.path, f.line, f.col, f.rule)
+        )
+        return result
+
+
+def run_lint(
+    root: str,
+    paths: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+    rules: Optional[Sequence[Any]] = None,
+) -> LintResult:
+    """One-call entry point: run the engine and fold in the baseline."""
+    result = LintEngine(root, paths, rules).run()
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+        new, known, stale = apply_baseline(result.findings, baseline)
+        result.findings = new
+        result.baselined = known
+        result.stale = stale
+    return result

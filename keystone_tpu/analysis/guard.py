@@ -1,0 +1,231 @@
+"""Runtime transfer/recompile sentinel: the dynamic half of keystone-lint.
+
+The static rules (R1/R2) reason about *source*; this module observes the
+*process*: arm it around a pipeline or solver run and every implicit
+host<->device transfer and every repeat XLA compilation is counted into the
+PR-4 telemetry registry as ``guard.transfer`` / ``guard.recompile``, so a
+static finding in the overlap/solver paths can be cross-checked against
+actual runtime behavior (and a clean static pass can be *verified* clean at
+runtime — the acceptance test asserts both counters stay zero through a
+Chain + solver smoke run).
+
+Two sensors:
+
+- **Transfers** — ``jax.transfer_guard``.  In ``"log"`` mode (default)
+  jaxlib reports implicit transfers from C++ directly onto the stderr file
+  descriptor, not Python logging, so :class:`_StderrTransferCounter`
+  fd-redirects stderr through a pipe, counts guard lines (forwarding all
+  bytes through untouched), and restores the fd on exit.  In ``"disallow"``
+  mode the violation raises at the offending call site; the guard context
+  classifies the escaping exception, counts it, and re-raises.
+
+- **Recompiles** — ``jax_log_compiles`` emits one WARNING per XLA
+  compilation on the ``jax._src.interpreters.pxla`` logger, keyed by
+  function name *and* abstract argument signature.  The first compile of a
+  (name, signature) is expected; a repeat means the executable cache was
+  missed — exactly the R2 hazard (fresh jit objects, unhashable statics) —
+  and increments ``guard.recompile``.  Totals land in ``guard.compile``.
+
+Opt-in: ``KEYSTONE_GUARD=1`` (see :func:`maybe_guard`); tests use the
+:func:`guard` context directly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import re
+import sys
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+from keystone_tpu.telemetry.registry import MetricsRegistry, get_registry
+from keystone_tpu.utils import knobs
+
+_COMPILE_RE = re.compile(
+    r"Compiling\s+(\S+)\s+with global shapes and types\s+(.*?)\.?\s*"
+    r"(?:Argument|$)", re.S,
+)
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+
+#: markers jaxlib's guard_lib.cc writes per violation in "log" mode
+_TRANSFER_MARKERS = (
+    b"host-to-device transfer",
+    b"device-to-host transfer",
+    b"device-to-device transfer",
+)
+
+
+class _CompileCounter(logging.Handler):
+    """Counts ``jax_log_compiles`` records; repeats of one (name,
+    signature) are recompiles."""
+
+    def __init__(self, registry: MetricsRegistry):
+        super().__init__(level=logging.DEBUG)
+        self._registry = registry
+        self._seen: Dict[Tuple[str, str], int] = {}
+        self._seen_lock = threading.Lock()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILE_RE.search(record.getMessage())
+        except Exception:
+            return
+        if not m:
+            return
+        key = (m.group(1), " ".join(m.group(2).split()))
+        with self._seen_lock:
+            n = self._seen[key] = self._seen.get(key, 0) + 1
+        self._registry.inc("guard.compile")
+        if n > 1:
+            self._registry.inc("guard.recompile", fn=key[0])
+
+
+class _StderrTransferCounter:
+    """fd-level stderr tee counting transfer-guard lines from jaxlib."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self._registry = registry
+        self._saved_fd: Optional[int] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> bool:
+        # jaxlib's guard_lib writes to the OS-level stderr (fd 2), not the
+        # python sys.stderr object — which test harnesses routinely swap
+        # out — so the tee goes on fd 2 itself.
+        stderr_fd = 2
+        try:
+            sys.stderr.flush()
+        except (ValueError, OSError, AttributeError):
+            pass
+        try:
+            self._saved_fd = os.dup(stderr_fd)
+        except OSError:
+            return False  # no usable fd 2 (embedded interpreter)
+        self._stderr_fd = stderr_fd
+        read_fd, write_fd = os.pipe()
+        os.dup2(write_fd, stderr_fd)
+        os.close(write_fd)
+
+        def pump() -> None:
+            def scan(line: bytes) -> None:
+                for marker in _TRANSFER_MARKERS:
+                    if marker in line:
+                        kind = marker.split(b" ")[0].decode()
+                        self._registry.inc("guard.transfer", kind=kind)
+                        return
+
+            buf = b""
+            while True:
+                try:
+                    chunk = os.read(read_fd, 65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                os.write(self._saved_fd, chunk)
+                buf += chunk
+                *lines, buf = buf.split(b"\n")
+                for line in lines:
+                    scan(line)
+            # a guard line cut off mid-write when the fd swaps back must
+            # still count: scan the unterminated tail after EOF
+            if buf:
+                scan(buf)
+            os.close(read_fd)
+
+        self._thread = threading.Thread(
+            target=pump, name="keystone-guard-stderr", daemon=True
+        )
+        self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        if self._saved_fd is None:
+            return
+        sys.stderr.flush()
+        # restoring the fd closes the pipe's only write end -> EOF -> the
+        # pump thread drains and exits; only close the saved fd AFTER the
+        # join (the pump forwards its final bytes to it)
+        os.dup2(self._saved_fd, self._stderr_fd)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        os.close(self._saved_fd)
+        self._saved_fd = None
+
+
+def _looks_like_transfer_guard_error(exc: BaseException) -> bool:
+    text = str(exc).lower()
+    return "transfer" in text and ("disallow" in text or "guard" in text)
+
+
+@contextlib.contextmanager
+def guard(
+    transfer: bool = True,
+    recompile: bool = True,
+    transfer_mode: str = "log",
+    registry: Optional[MetricsRegistry] = None,
+) -> Iterator[MetricsRegistry]:
+    """Arm the runtime sentinel for the enclosed block.
+
+    ``transfer_mode="log"`` counts violations without altering program
+    behavior; ``"disallow"`` makes the first violation raise (counted on
+    the way out).  Yields the registry the counters land in.
+    """
+    import jax
+
+    reg = registry or get_registry()
+    compile_handler: Optional[_CompileCounter] = None
+    stderr_counter: Optional[_StderrTransferCounter] = None
+    prev_log_compiles = None
+    logger = logging.getLogger(_PXLA_LOGGER)
+    prev_level = logger.level
+    try:
+        if recompile:
+            compile_handler = _CompileCounter(reg)
+            prev_log_compiles = jax.config.jax_log_compiles
+            jax.config.update("jax_log_compiles", True)
+            logger.addHandler(compile_handler)
+            if logger.getEffectiveLevel() > logging.WARNING:
+                logger.setLevel(logging.WARNING)
+        with contextlib.ExitStack() as stack:
+            if transfer:
+                if transfer_mode == "log":
+                    stderr_counter = _StderrTransferCounter(reg)
+                    if not stderr_counter.start():
+                        stderr_counter = None
+                stack.enter_context(jax.transfer_guard(transfer_mode))
+            try:
+                yield reg
+            except BaseException as exc:
+                if transfer and _looks_like_transfer_guard_error(exc):
+                    reg.inc("guard.transfer", kind="disallowed")
+                raise
+    finally:
+        if stderr_counter is not None:
+            stderr_counter.stop()
+        if compile_handler is not None:
+            logger.removeHandler(compile_handler)
+            logger.setLevel(prev_level)
+            jax.config.update("jax_log_compiles", bool(prev_log_compiles))
+
+
+def maybe_guard(**kwargs):
+    """:func:`guard` when ``KEYSTONE_GUARD=1``, else a no-op context —
+    the opt-in hook pipelines/benches wrap their runs in."""
+    if knobs.get("KEYSTONE_GUARD"):
+        return guard(**kwargs)
+    return contextlib.nullcontext(get_registry())
+
+
+def violations(registry: Optional[MetricsRegistry] = None) -> Dict[str, float]:
+    """Current guard counters (summed over labels) — what the acceptance
+    fixture asserts stays zero."""
+    reg = registry or get_registry()
+    return {
+        "guard.transfer": reg.sum_counters("guard.transfer"),
+        "guard.recompile": reg.sum_counters("guard.recompile"),
+        "guard.compile": reg.sum_counters("guard.compile"),
+    }

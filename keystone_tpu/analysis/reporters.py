@@ -1,0 +1,62 @@
+"""Finding renderers: terminal text (clickable ``file:line``) and JSON."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from keystone_tpu.analysis.engine import Finding, LintResult
+
+
+def render_text(
+    result: LintResult,
+    show_baselined: bool = False,
+    hints: bool = True,
+) -> str:
+    """New findings as ``path:line:col: RULE message`` lines — the triple
+    terminals hyperlink — plus a one-line summary the CI log greps."""
+    lines: List[str] = []
+    for f in result.findings:
+        lines.append(f.format(hints=hints))
+    if show_baselined and result.baselined:
+        lines.append("")
+        lines.append(f"baselined (known debt, not failing): "
+                     f"{len(result.baselined)}")
+        for f in result.baselined:
+            lines.append("  " + f.format(hints=False))
+    if result.stale:
+        lines.append("")
+        lines.append(
+            f"stale baseline entries (debt that got fixed — run "
+            f"`keystone-tpu lint --update-baseline` to ratchet down):"
+        )
+        for fp, n in sorted(result.stale.items()):
+            lines.append(f"  {fp} (-{n})")
+    for err in result.errors:
+        lines.append(f"parse error: {err}")
+    summary = (
+        f"keystone-lint: {len(result.findings)} new, "
+        f"{len(result.baselined)} baselined, {result.suppressed} "
+        f"pragma-suppressed across {result.files} files"
+    )
+    lines.append(("" if not lines else "\n") + summary)
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    def enc(f: Finding) -> dict:
+        return {
+            "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+            "message": f.message, "hint": f.hint,
+            "fingerprint": f.fingerprint,
+        }
+
+    return json.dumps({
+        "new": [enc(f) for f in result.findings],
+        "baselined": [enc(f) for f in result.baselined],
+        "stale": result.stale,
+        "suppressed": result.suppressed,
+        "files": result.files,
+        "errors": result.errors,
+        "total": result.total,
+    }, indent=2) + "\n"

@@ -1,0 +1,920 @@
+"""The five keystone-lint rule families (R1–R5).
+
+Every rule is deliberately *approximate* in the direction of silence: when
+static resolution fails (an axis name that never resolves to a literal, a
+call target outside the package) the rule skips rather than guesses, so a
+finding is worth reading.  The runtime guard (``analysis/guard.py``) is the
+complementary over-approximation: it observes actual transfers/recompiles.
+
+R1  host-sync-in-hot-path   — ``.item()``, ``float()/int()`` on subscripted
+                              arrays, ``np.asarray``, ``block_until_ready``,
+                              ``time.time()`` reachable inside jit/shard_map
+                              functions (approximate package call graph).
+R2  recompile-hazard        — ``jax.jit``/``partial(jax.jit, ...)``
+                              constructed inside loops or wrapped-and-called
+                              per invocation; unhashable defaults on static
+                              args.
+R3  collective-safety       — collective axis names not bound by the
+                              enclosing ``shard_map`` spec; one-directional
+                              use of a ``paired_ring_perms`` pair.
+R4  knob-hygiene            — raw ``os.environ``/``getenv`` reads of
+                              ``KEYSTONE_*``/``BENCH_*`` outside
+                              ``utils/knobs.py``; knobs.get of undeclared
+                              names; declared knobs missing from the README.
+R5  shared-state-lock       — mutation of module/class-level containers in
+                              the telemetry/cache/prefetch/overlap modules
+                              outside a ``with <lock>`` block.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from keystone_tpu.analysis.engine import (
+    Finding,
+    LintContext,
+    ModuleInfo,
+    ancestors,
+    call_name,
+    dotted,
+    enclosing_function,
+    in_loop,
+    parent,
+    under_lock,
+)
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_jit_name(name: Optional[str]) -> bool:
+    return bool(name) and name.split(".")[-1] in ("jit", "pjit")
+
+
+def _is_shard_map_name(name: Optional[str]) -> bool:
+    return bool(name) and name.split(".")[-1] == "shard_map"
+
+
+def _is_partial_of_jit(call: ast.Call) -> bool:
+    if call_name(call) not in ("partial", "functools.partial"):
+        return False
+    return bool(call.args) and _is_jit_name(dotted(call.args[0]))
+
+
+def _jit_like_expr(node: ast.AST) -> bool:
+    """Decorator / callee expressions that make the target a traced hot
+    path: ``jit``, ``jax.jit``, ``shard_map``, ``jit(...)-with-kwargs``,
+    ``partial(jax.jit, ...)``."""
+    name = dotted(node)
+    if _is_jit_name(name) or _is_shard_map_name(name):
+        return True
+    if isinstance(node, ast.Call):
+        inner = call_name(node)
+        if _is_jit_name(inner) or _is_shard_map_name(inner):
+            return True
+        return _is_partial_of_jit(node)
+    return False
+
+
+def _scope_defs(scope: ast.AST) -> Dict[str, ast.AST]:
+    """Immediate child function defs of a module/function/class scope."""
+    out: Dict[str, ast.AST] = {}
+    body = getattr(scope, "body", [])
+    for stmt in body:
+        if isinstance(stmt, FunctionNode):
+            out[stmt.name] = stmt
+    return out
+
+
+def _resolve_local_function(
+    name: str, at: ast.AST, mod: ModuleInfo
+) -> Optional[ast.AST]:
+    """Resolve a bare name to a function def visible from ``at`` (lexical
+    scope chain: enclosing functions, enclosing class, module)."""
+    chain: List[ast.AST] = [at] + list(ancestors(at))
+    for scope in chain:
+        if isinstance(scope, FunctionNode + (ast.Module, ast.ClassDef)):
+            defs = _scope_defs(scope)
+            if name in defs:
+                return defs[name]
+    return None
+
+
+def _resolve_str_literal(
+    expr: ast.AST, at: ast.AST, depth: int = 3
+) -> Optional[str]:
+    """Best-effort: resolve an expression to a string literal, following
+    local assignments and enclosing-function parameter *defaults* (the
+    ``axis: str = "data"`` idiom the collectives use)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if depth <= 0 or not isinstance(expr, ast.Name):
+        return None
+    name = expr.id
+    for scope in [at] + list(ancestors(at)):
+        if isinstance(scope, FunctionNode):
+            args = scope.args
+            params = args.posonlyargs + args.args
+            defaults = args.defaults
+            offset = len(params) - len(defaults)
+            for i, p in enumerate(params):
+                if p.arg == name and i >= offset:
+                    return _resolve_str_literal(
+                        defaults[i - offset], scope, depth - 1
+                    )
+            for kw, default in zip(args.kwonlyargs, args.kw_defaults):
+                if kw.arg == name and default is not None:
+                    return _resolve_str_literal(default, scope, depth - 1)
+        for stmt in getattr(scope, "body", []):
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        return _resolve_str_literal(
+                            stmt.value, scope, depth - 1
+                        )
+    return None
+
+
+def _collect_axis_literals(
+    expr: ast.AST, at: ast.AST, out: Set[str], depth: int = 3
+) -> None:
+    """All string literals reachable from ``expr``, following Name
+    assignments/defaults one hop — the axis universe of a shard_map call."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.add(sub.value)
+        elif isinstance(sub, ast.Name) and depth > 0:
+            resolved = _resolve_str_literal(sub, at, depth)
+            if resolved is not None:
+                out.add(resolved)
+            else:
+                # spec variables: follow one assignment hop and scan it
+                for scope in [at] + list(ancestors(at)):
+                    for stmt in getattr(scope, "body", []):
+                        if isinstance(stmt, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == sub.id
+                            for t in stmt.targets
+                        ):
+                            _collect_axis_literals(
+                                stmt.value, scope, out, depth - 1
+                            )
+
+
+class Rule:
+    id = "R0"
+    title = ""
+
+    def run(self, ctx: LintContext) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# R1: host syncs reachable inside jit/shard_map hot paths
+# ---------------------------------------------------------------------------
+
+class HostSyncInHotPath(Rule):
+    id = "R1"
+    title = "host-sync-in-hot-path"
+
+    SYNC_ATTRS = ("item", "tolist", "block_until_ready")
+    TIME_CALLS = ("time.time", "time.perf_counter", "time.monotonic")
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        funcs: Dict[str, Tuple[ModuleInfo, ast.AST]] = {}
+        hot: Set[str] = set()
+        qualname: Dict[int, str] = {}
+
+        # Pass 1: index every function with a module-qualified name.
+        for rel, mod in ctx.modules.items():
+            stack: List[str] = []
+
+            def walk(node: ast.AST):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, FunctionNode):
+                        stack.append(child.name)
+                        qn = f"{rel}::{'.'.join(stack)}"
+                        qualname[id(child)] = qn
+                        funcs[qn] = (mod, child)
+                        walk(child)
+                        stack.pop()
+                    elif isinstance(child, ast.ClassDef):
+                        stack.append(child.name)
+                        walk(child)
+                        stack.pop()
+                    else:
+                        walk(child)
+
+            walk(mod.tree)
+
+        # Pass 2: hot roots — jit/shard_map decorators and wrap calls.
+        hot_lambdas: List[Tuple[ModuleInfo, ast.Lambda]] = []
+        for qn, (mod, fn) in funcs.items():
+            if any(_jit_like_expr(d) for d in fn.decorator_list):
+                hot.add(qn)
+        for rel, mod in ctx.modules.items():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if not (_is_jit_name(name) or _is_shard_map_name(name)
+                        or _is_partial_of_jit(node)):
+                    continue
+                target = node.args[1] if _is_partial_of_jit(node) and \
+                    len(node.args) > 1 else (node.args[0] if node.args else None)
+                if isinstance(target, ast.Name):
+                    resolved = _resolve_local_function(target.id, node, mod)
+                    if resolved is not None and id(resolved) in qualname:
+                        hot.add(qualname[id(resolved)])
+                elif isinstance(target, ast.Lambda):
+                    hot_lambdas.append((mod, target))
+
+        # Pass 3: propagate hotness over the approximate call graph.
+        edges: Dict[str, Set[str]] = {qn: set() for qn in funcs}
+        for qn, (mod, fn) in funcs.items():
+            for node in self._own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee: Optional[ast.AST] = None
+                name = call_name(node)
+                if isinstance(node.func, ast.Name):
+                    callee = _resolve_local_function(node.func.id, node, mod)
+                    if callee is None:
+                        imported = mod.imports.get(node.func.id)
+                        if imported:
+                            callee = self._resolve_import(
+                                imported, ctx, funcs, qualname
+                            )
+                elif name and name.startswith(("self.", "cls.")):
+                    callee = self._resolve_method(
+                        name.split(".")[-1], fn, mod
+                    )
+                elif name and "." in name:
+                    root, attr = name.split(".")[0], name.split(".")[-1]
+                    imported = mod.imports.get(root)
+                    if imported:
+                        callee = self._resolve_import(
+                            f"{imported}.{attr}", ctx, funcs, qualname
+                        )
+                if callee is not None and id(callee) in qualname:
+                    edges[qn].add(qualname[id(callee)])
+        work = list(hot)
+        while work:
+            cur = work.pop()
+            for nxt in edges.get(cur, ()):
+                if nxt not in hot:
+                    hot.add(nxt)
+                    work.append(nxt)
+
+        # Pass 4: scan hot bodies for host syncs.
+        out: List[Finding] = []
+        for qn in sorted(hot):
+            if qn not in funcs:
+                continue
+            mod, fn = funcs[qn]
+            self._scan_body(mod, fn, qn.split("::")[-1], out)
+        for mod, lam in hot_lambdas:
+            self._scan_body(mod, lam, "<lambda>", out)
+        return out
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _resolve_import(dotted_name, ctx, funcs, qualname):
+        """'keystone_tpu.linalg.solvers.hdot' -> that module's def."""
+        parts = dotted_name.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            rel = os.path.join(*parts[:split]) + ".py"
+            rel_init = os.path.join(*parts[:split], "__init__.py")
+            for candidate in (rel, rel_init):
+                mod = ctx.modules.get(candidate)
+                if mod is None:
+                    continue
+                name = parts[split] if split < len(parts) else None
+                if name:
+                    qn = f"{candidate}::{name}"
+                    if qn in funcs:
+                        return funcs[qn][1]
+        return None
+
+    @staticmethod
+    def _resolve_method(name, fn, mod):
+        for a in ancestors(fn):
+            if isinstance(a, ast.ClassDef):
+                return _scope_defs(a).get(name)
+        return None
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+        """Nodes lexically in ``fn`` excluding nested function bodies (a
+        nested def is only hot if something actually calls/wraps it)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, FunctionNode + (ast.Lambda,)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _numpy_aliases(self, mod: ModuleInfo) -> Set[str]:
+        out = set()
+        for local, target in mod.imports.items():
+            if target == "numpy" or target.startswith("numpy."):
+                out.add(local)
+        out.update({"np", "numpy", "onp", "_np"} & set(mod.imports))
+        return out
+
+    def _scan_body(self, mod, fn, fname, out, hot_name=None):
+        np_alias = self._numpy_aliases(mod)
+        for node in self._own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            f = None
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in self.SYNC_ATTRS
+            ):
+                f = (
+                    f"`.{node.func.attr}()` forces a host round-trip",
+                    node.func.attr,
+                    "return the array and read it outside the traced "
+                    "region (or gate with a pragma if this is a "
+                    "deliberate sync point)",
+                )
+            elif name in self.TIME_CALLS or (
+                name and name.endswith(".device_get")
+            ):
+                f = (
+                    f"`{name}()` inside a traced hot path (traces bake the "
+                    "value in; eager paths sync the stream)",
+                    name,
+                    "hoist the clock/transfer outside the jit/shard_map "
+                    "region",
+                )
+            elif (
+                name
+                and "." in name
+                and name.split(".")[0] in np_alias
+                and name.split(".")[-1] in ("asarray", "array")
+            ):
+                f = (
+                    f"`{name}(...)` materializes the operand on host",
+                    name,
+                    "use jnp inside traced code; convert on the host side "
+                    "of the boundary",
+                )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.args
+                and self._arrayish(node.args[0], mod)
+            ):
+                f = (
+                    f"`{node.func.id}(...)` on an array value blocks on "
+                    "the device",
+                    node.func.id,
+                    "keep it as a jnp scalar, or read it outside the hot "
+                    "path",
+                )
+            if f is None:
+                continue
+            msg, sym, hint = f
+            out.append(Finding(
+                rule=self.id, path=mod.relpath, line=node.lineno,
+                col=node.col_offset,
+                message=f"{msg} (inside hot path `{fname or hot_name}`)",
+                hint=hint, symbol=f"{fname}:{sym}",
+            ))
+
+    @staticmethod
+    def _arrayish(arg: ast.AST, mod: ModuleInfo) -> bool:
+        """float()/int() args that plausibly hold device arrays: a
+        subscript (``x[0]``) or a jnp/jax call — NOT names/shape
+        attributes (python scalars at trace time are fine and common)."""
+        if isinstance(arg, ast.Subscript):
+            base = dotted(arg.value) or ""
+            return not any(
+                base.endswith(s) for s in (".shape", ".strides")
+            )
+        if isinstance(arg, ast.Call):
+            name = call_name(arg) or ""
+            root = name.split(".")[0]
+            return root in ("jnp", "jax", "lax") and not name.endswith("len")
+        return False
+
+
+# ---------------------------------------------------------------------------
+# R2: recompile hazards
+# ---------------------------------------------------------------------------
+
+class RecompileHazard(Rule):
+    id = "R2"
+    title = "recompile-hazard"
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, mod in ctx.modules.items():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and (
+                    _is_jit_name(call_name(node)) or _is_partial_of_jit(node)
+                ):
+                    # skip decorator positions: @partial(jax.jit, ...) is
+                    # the construct-once idiom
+                    par = parent(node)
+                    fn = enclosing_function(node)
+                    is_decorator = (
+                        isinstance(par, FunctionNode)
+                        and node in par.decorator_list
+                    )
+                    if is_decorator:
+                        continue
+                    if in_loop(node):
+                        out.append(Finding(
+                            rule=self.id, path=rel, line=node.lineno,
+                            col=node.col_offset,
+                            message="jit constructed inside a loop — a "
+                                    "fresh jit wrapper (and compile cache "
+                                    "entry) per iteration",
+                            hint="hoist the jit above the loop or to "
+                                 "module scope",
+                            symbol="jit-in-loop",
+                        ))
+                    elif (
+                        isinstance(par, ast.Call)
+                        and par.func is node
+                        and fn is not None
+                    ):
+                        out.append(Finding(
+                            rule=self.id, path=rel, line=node.lineno,
+                            col=node.col_offset,
+                            message="jit-wrapped and immediately called — "
+                                    "a fresh jit object (and compile) on "
+                                    "every call of the enclosing function",
+                            hint="construct the jit once (module scope, "
+                                 "functools.cache, or __init__) and call "
+                                 "the cached wrapper",
+                            symbol="jit-immediate-call",
+                        ))
+                # unhashable defaults on static args
+                if isinstance(node, FunctionNode):
+                    out.extend(self._static_arg_defaults(rel, node))
+        return out
+
+    def _static_arg_defaults(self, rel, fn) -> List[Finding]:
+        static_idx: Set[int] = set()
+        static_names: Set[str] = set()
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            if not (_is_jit_name(call_name(dec)) or _is_partial_of_jit(dec)):
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "static_argnums":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, int
+                        ):
+                            static_idx.add(sub.value)
+                elif kw.arg == "static_argnames":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str
+                        ):
+                            static_names.add(sub.value)
+        if not static_idx and not static_names:
+            return []
+        out = []
+        params = fn.args.posonlyargs + fn.args.args
+        defaults = fn.args.defaults
+        offset = len(params) - len(defaults)
+        for i, p in enumerate(params):
+            if i < offset:
+                continue
+            if i not in static_idx and p.arg not in static_names:
+                continue
+            d = defaults[i - offset]
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and call_name(d) in ("list", "dict", "set")
+            ):
+                out.append(Finding(
+                    rule=self.id, path=rel, line=d.lineno, col=d.col_offset,
+                    message=f"static argument `{p.arg}` has an unhashable "
+                            f"default — jit's static-arg cache requires "
+                            f"hashable values",
+                    hint="use a tuple/frozenset/None sentinel",
+                    symbol=f"{fn.name}:{p.arg}",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R3: collective safety inside shard_map
+# ---------------------------------------------------------------------------
+
+class CollectiveSafety(Rule):
+    id = "R3"
+    title = "collective-safety"
+
+    COLLECTIVES = (
+        "psum", "psum_scatter", "ppermute", "all_gather", "all_to_all",
+        "pmean", "pmax", "pmin", "axis_index", "pcast",
+    )
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, mod in ctx.modules.items():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and _is_shard_map_name(
+                    call_name(node)
+                ):
+                    out.extend(self._check_binding(rel, mod, node))
+            out.extend(self._check_pairing(rel, mod))
+        return out
+
+    # -- axis binding ------------------------------------------------------
+
+    def _check_binding(self, rel, mod, call) -> List[Finding]:
+        target = call.args[0] if call.args else None
+        body: Optional[ast.AST] = None
+        if isinstance(target, ast.Name):
+            body = _resolve_local_function(target.id, call, mod)
+        elif isinstance(target, ast.Lambda):
+            body = target
+        if body is None:
+            return []
+        bound: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg in ("in_specs", "out_specs", "axis_names", "mesh"):
+                _collect_axis_literals(kw.value, call, bound)
+        for arg in call.args[1:]:
+            _collect_axis_literals(arg, call, bound)
+        if not bound:
+            return []  # specs never resolved to literals: stay silent
+        out = []
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name or name.split(".")[-1] not in self.COLLECTIVES:
+                continue
+            axis_expr = None
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis"):
+                    axis_expr = kw.value
+            if axis_expr is None and len(node.args) >= 2:
+                axis_expr = node.args[1]
+            if axis_expr is None:
+                continue
+            axes: Set[str] = set()
+            if isinstance(axis_expr, (ast.Tuple, ast.List)):
+                for el in axis_expr.elts:
+                    r = _resolve_str_literal(el, node)
+                    if r:
+                        axes.add(r)
+            else:
+                r = _resolve_str_literal(axis_expr, node)
+                if r:
+                    axes.add(r)
+            for ax in sorted(axes):
+                if ax not in bound:
+                    out.append(Finding(
+                        rule=self.id, path=rel, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"collective `{name}` uses axis '{ax}' "
+                                f"not bound by the enclosing shard_map "
+                                f"specs ({sorted(bound)})",
+                        hint="bind the axis in in_specs/out_specs or fix "
+                             "the axis_name",
+                        symbol=f"{name}:{ax}",
+                    ))
+        return out
+
+    # -- ppermute pairing --------------------------------------------------
+
+    def _check_pairing(self, rel, mod) -> List[Finding]:
+        out = []
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, FunctionNode):
+                continue
+            pair: Optional[Tuple[str, str, int]] = None
+            for stmt in ast.walk(fn):
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    and (call_name(stmt.value) or "").split(".")[-1]
+                    == "paired_ring_perms"
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Tuple)
+                    and len(stmt.targets[0].elts) == 2
+                    and all(isinstance(e, ast.Name)
+                            for e in stmt.targets[0].elts)
+                ):
+                    pair = (
+                        stmt.targets[0].elts[0].id,
+                        stmt.targets[0].elts[1].id,
+                        stmt.lineno,
+                    )
+            if pair is None:
+                continue
+            fwd, bwd, line = pair
+            used: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and (
+                    call_name(node) or ""
+                ).split(".")[-1] == "ppermute":
+                    perm_expr = None
+                    for kw in node.keywords:
+                        if kw.arg == "perm":
+                            perm_expr = kw.value
+                    if perm_expr is None and len(node.args) >= 3:
+                        perm_expr = node.args[2]
+                    if perm_expr is None:
+                        continue
+                    for sub in ast.walk(perm_expr):
+                        if isinstance(sub, ast.Name) and sub.id in (fwd, bwd):
+                            used.add(sub.id)
+            if len(used) == 1:
+                missing = bwd if used == {fwd} else fwd
+                out.append(Finding(
+                    rule=self.id, path=rel, line=line, col=0,
+                    message=f"paired_ring_perms result used "
+                            f"one-directionally in `{fn.name}` (only "
+                            f"`{used.pop()}` reaches a ppermute; "
+                            f"`{missing}` never does) — unpaired "
+                            f"send/recv deadlocks the bidirectional fold",
+                    hint="issue both ppermutes each round (the paired "
+                         "schedule), or drop to the unidirectional ring "
+                         "helper",
+                    symbol=f"{fn.name}:unpaired",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R4: knob hygiene
+# ---------------------------------------------------------------------------
+
+class KnobHygiene(Rule):
+    id = "R4"
+    title = "knob-hygiene"
+
+    PREFIXES = ("KEYSTONE_", "BENCH_")
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        declared = ctx.declared_knobs()
+        for rel, mod in ctx.modules.items():
+            if rel.replace(os.sep, "/").endswith("utils/knobs.py"):
+                continue
+            consts = self._module_str_constants(mod)
+            for node in ast.walk(mod.tree):
+                out.extend(self._check_env_read(rel, node, consts))
+                out.extend(self._check_undeclared_get(rel, node, declared))
+        out.extend(self._check_readme(ctx, declared))
+        return out
+
+    @staticmethod
+    def _module_str_constants(mod: ModuleInfo) -> Dict[str, str]:
+        """Module-level ``_ENV_FOO = "KEYSTONE_FOO"`` style constants, so
+        env keys named via a variable don't evade the rule."""
+        out: Dict[str, str] = {}
+        for stmt in mod.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)
+            ):
+                out[stmt.targets[0].id] = stmt.value.value
+        return out
+
+    def _knobbish(
+        self, expr: ast.AST, consts: Dict[str, str] = {}
+    ) -> Optional[str]:
+        value = None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            value = expr.value
+        elif isinstance(expr, ast.Name):
+            value = consts.get(expr.id)
+        if value is not None and value.startswith(self.PREFIXES):
+            return value
+        return None
+
+    def _check_env_read(self, rel, node, consts) -> List[Finding]:
+        knob = None
+        line = col = 0
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            is_environ_get = (
+                name.endswith(".environ.get")
+                or name == "getenv"
+                or name.endswith(".getenv")
+            )
+            if is_environ_get and node.args:
+                knob = self._knobbish(node.args[0], consts)
+                line, col = node.lineno, node.col_offset
+        elif isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Load
+        ):
+            base = dotted(node.value) or ""
+            if base.endswith("environ"):
+                knob = self._knobbish(node.slice, consts)
+                line, col = node.lineno, node.col_offset
+        if knob is None:
+            return []
+        return [Finding(
+            rule=self.id, path=rel, line=line, col=col,
+            message=f"raw environment read of `{knob}` outside the knob "
+                    f"registry",
+            hint="declare it in keystone_tpu/utils/knobs.py and read via "
+                 "knobs.get()/knobs.get_raw()",
+            symbol=knob,
+        )]
+
+    def _check_undeclared_get(self, rel, node, declared) -> List[Finding]:
+        if not isinstance(node, ast.Call):
+            return []
+        name = call_name(node) or ""
+        if name.split(".")[-1] not in ("get", "get_raw", "is_set"):
+            return []
+        root = name.split(".")[0]
+        if root not in ("knobs", "_knobs"):
+            return []
+        if not node.args:
+            return []
+        knob = self._knobbish(node.args[0])
+        if knob is None or knob in declared:
+            return []
+        return [Finding(
+            rule=self.id, path=rel, line=node.lineno, col=node.col_offset,
+            message=f"knobs.{name.split('.')[-1]}(\"{knob}\") reads an "
+                    f"undeclared knob (KeyError at runtime)",
+            hint="declare it in keystone_tpu/utils/knobs.py",
+            symbol=f"undeclared:{knob}",
+        )]
+
+    def _check_readme(self, ctx, declared) -> List[Finding]:
+        readme = ctx.readme_text()
+        if not readme or not declared:
+            return []
+        knobs_rel = next(
+            (rel for rel in ctx.modules
+             if rel.replace(os.sep, "/").endswith("utils/knobs.py")), None
+        )
+        if knobs_rel is None:
+            return []  # fixture runs without the registry in scope
+        out = []
+        for knob, line in sorted(declared.items()):
+            if knob not in readme:
+                out.append(Finding(
+                    rule=self.id, path=knobs_rel, line=line, col=0,
+                    message=f"declared knob `{knob}` missing from the "
+                            f"README knob table",
+                    hint="regenerate the table: python -m "
+                         "keystone_tpu.utils.knobs",
+                    symbol=f"readme:{knob}",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R5: shared-state mutation outside locks
+# ---------------------------------------------------------------------------
+
+class SharedStateLock(Rule):
+    id = "R5"
+    title = "shared-state-lock"
+
+    #: modules whose module/class-level containers are mutated from
+    #: multiple threads (prefetch feed, concurrent fits, telemetry)
+    SCOPE = (
+        "telemetry/",
+        "core/cache.py",
+        "core/prefetch.py",
+        "parallel/overlap.py",
+        "utils/logging.py",
+    )
+
+    MUTATORS = (
+        "append", "add", "update", "pop", "clear", "extend", "remove",
+        "discard", "setdefault", "insert", "popitem", "appendleft",
+    )
+
+    def _in_scope(self, rel: str) -> bool:
+        rel = rel.replace(os.sep, "/")
+        return any(
+            rel.endswith(s) or f"/{s}" in rel or rel.startswith(s)
+            for s in self.SCOPE
+        )
+
+    @staticmethod
+    def _containerish(value: ast.AST) -> bool:
+        if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            name = (call_name(value) or "").split(".")[-1]
+            return name in (
+                "dict", "list", "set", "defaultdict", "deque",
+                "OrderedDict", "Counter",
+            )
+        return False
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, mod in ctx.modules.items():
+            if not self._in_scope(rel):
+                continue
+            module_containers: Set[str] = set()
+            class_containers: Dict[str, Set[str]] = {}
+            for stmt in mod.tree.body:
+                tgt = None
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    tgt, val = stmt.targets[0], stmt.value
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    tgt, val = stmt.target, stmt.value
+                else:
+                    continue
+                if isinstance(tgt, ast.Name) and self._containerish(val):
+                    module_containers.add(tgt.id)
+            for stmt in mod.tree.body:
+                if not isinstance(stmt, ast.ClassDef):
+                    continue
+                attrs: Set[str] = set()
+                for sub in stmt.body:
+                    tgt = val = None
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        tgt, val = sub.targets[0], sub.value
+                    elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                        tgt, val = sub.target, sub.value
+                    if tgt is not None and isinstance(tgt, ast.Name) \
+                            and self._containerish(val):
+                        attrs.add(tgt.id)
+                if attrs:
+                    class_containers[stmt.name] = attrs
+
+            def tracked(base_expr: ast.AST) -> Optional[str]:
+                name = dotted(base_expr)
+                if name is None:
+                    return None
+                if name in module_containers:
+                    return name
+                parts = name.split(".")
+                if len(parts) == 2:
+                    owner, attr = parts
+                    if owner in class_containers and \
+                            attr in class_containers[owner]:
+                        return name
+                    if owner in ("cls", "self"):
+                        for attrs_owner, attrs in class_containers.items():
+                            if attr in attrs:
+                                return f"{attrs_owner}.{attr}"
+                return None
+
+            for node in ast.walk(mod.tree):
+                if enclosing_function(node) is None:
+                    continue  # module import time is single-threaded
+                target_name = None
+                where = node
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ) and node.func.attr in self.MUTATORS:
+                    target_name = tracked(node.func.value)
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tgts = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in tgts:
+                        if isinstance(t, ast.Subscript):
+                            target_name = tracked(t.value)
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            target_name = tracked(t.value)
+                if target_name is None:
+                    continue
+                if under_lock(where):
+                    continue
+                out.append(Finding(
+                    rule=self.id, path=rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"shared container `{target_name}` mutated "
+                            f"outside a lock (module is on the "
+                            f"multi-threaded hot list)",
+                    hint="wrap the mutation in `with <lock>:`, or pragma "
+                         "it with the single-thread justification",
+                    symbol=target_name,
+                ))
+        return out
+
+
+def default_rules() -> List[Rule]:
+    return [
+        HostSyncInHotPath(),
+        RecompileHazard(),
+        CollectiveSafety(),
+        KnobHygiene(),
+        SharedStateLock(),
+    ]

@@ -110,13 +110,21 @@ def main(argv=None) -> int:
         from keystone_tpu.telemetry.report import main as report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # ``keystone-tpu lint [paths]``: the static-analysis pass
+        # (keystone_tpu/analysis) — exits non-zero only for findings not
+        # in the ratcheted lint_baseline.json.
+        from keystone_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     if not argv or argv[0] in ("-h", "--help", "help"):
         names = "\n  ".join(sorted(PIPELINES))
         print(
             "usage: run-pipeline [--coordinator HOST:PORT --num-processes N "
             "--process-id I | --distributed] [--mesh-model M] "
             f"<Pipeline> [flags]\n"
-            "       run-pipeline telemetry-report [path] [--top N]\n\n"
+            "       run-pipeline telemetry-report [path] [--top N]\n"
+            "       run-pipeline lint [paths] [--update-baseline]\n\n"
             f"pipelines:\n  {names}"
         )
         return 0 if argv else 2

@@ -559,17 +559,19 @@ _lock = threading.Lock()
 
 def cache_from_env() -> Optional[IntermediateCache]:
     """Build a cache from ``KEYSTONE_CACHE*`` env knobs; None when off."""
-    if os.environ.get("KEYSTONE_CACHE", "0") != "1":
+    from keystone_tpu.utils import knobs
+
+    if not knobs.get("KEYSTONE_CACHE"):
         return None
 
-    def mb(name: str, default: int) -> int:
-        return int(float(os.environ.get(name, default))) << 20
+    def mb(name: str) -> int:
+        return int(knobs.get(name)) << 20
 
     return IntermediateCache(
-        device_bytes=mb("KEYSTONE_CACHE_DEVICE_MB", 1024),
-        host_bytes=mb("KEYSTONE_CACHE_HOST_MB", 4096),
-        disk_bytes=mb("KEYSTONE_CACHE_DISK_MB", 16384),
-        cache_dir=os.environ.get("KEYSTONE_CACHE_DIR") or None,
+        device_bytes=mb("KEYSTONE_CACHE_DEVICE_MB"),
+        host_bytes=mb("KEYSTONE_CACHE_HOST_MB"),
+        disk_bytes=mb("KEYSTONE_CACHE_DISK_MB"),
+        cache_dir=knobs.get("KEYSTONE_CACHE_DIR") or None,
     )
 
 

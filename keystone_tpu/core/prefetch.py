@@ -36,16 +36,15 @@ equality.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Iterable, Iterator, Optional
+
+from keystone_tpu.utils import knobs
 
 
 def prefetch_depth(default: int = 1) -> int:
-    """Effective prefetch depth from ``KEYSTONE_PREFETCH`` (see module doc)."""
-    try:
-        return max(0, int(os.environ.get("KEYSTONE_PREFETCH", default)))
-    except ValueError:
-        return default
+    """Effective prefetch depth from ``KEYSTONE_PREFETCH`` (see module doc;
+    the knob is declared lenient — junk values fall back to ``default``)."""
+    return knobs.get("KEYSTONE_PREFETCH", default=default)
 
 
 def prefetch_map(

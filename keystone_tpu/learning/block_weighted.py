@@ -33,7 +33,12 @@ import numpy as np
 from keystone_tpu.core.dataset import Dataset
 from keystone_tpu.core.pipeline import LabelEstimator
 from keystone_tpu.learning.block_linear import BlockLinearMapper
-from keystone_tpu.linalg.solvers import hdot, spd_solve
+from keystone_tpu.linalg.solvers import (
+    device_scalar,
+    dzeros,
+    hdot,
+    spd_solve,
+)
 
 
 @functools.partial(jax.jit, static_argnames=("num_classes",))
@@ -51,6 +56,42 @@ def _prepare(labels_pm1, mask, num_classes: int):
     counts = jnp.bincount(class_idx, length=num_classes)  # sentinel dropped
     valid = (class_idx < num_classes).astype(jnp.float32)
     return class_idx, counts, valid
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def _slice_block(data, start, size):
+    """Jitted feature-block fetch. ``start`` arrives as a committed device
+    int (see the ``get_block`` call sites): an eager ``dynamic_slice`` with
+    a python start index implicitly uploads that int32 on every block of
+    the num_iter×num_blocks loop — the densest guard.transfer source the
+    runtime sentinel found in this file."""
+    return jax.lax.dynamic_slice_in_dim(data, start, size, 1)
+
+
+@jax.jit
+def _joint_block_means(class_sums, counts, w, pop_mean):
+    """jointMeans_c = w·classMean_c + (1−w)·popMean (``:196-200``), jitted
+    so the scalar literals stay trace-time constants (no per-block implicit
+    uploads)."""
+    class_means = class_sums / jnp.maximum(
+        counts[:, None].astype(jnp.float32), 1.0
+    )
+    return w * class_means + (1.0 - w) * pop_mean
+
+
+@jax.jit
+def _joint_residual_init(labels_pm1, w, counts, valid):
+    """Initial residual against the joint label mean —
+    jointLabelMean[c] = 2w + 2(1-w)·n_c/n − 1 (``:148-150``). Jitted so
+    the scalar literals are trace-time constants: the same arithmetic
+    eager would implicitly h2d-transfer each python scalar per fit
+    (KEYSTONE_GUARD's ``guard.transfer`` counter catches exactly this)."""
+    n_eff = jnp.sum(counts).astype(jnp.float32)
+    joint_label_mean = (
+        2.0 * w + 2.0 * (1.0 - w) * counts.astype(jnp.float32) / n_eff - 1.0
+    )
+    R = (labels_pm1 - joint_label_mean) * valid[:, None]
+    return n_eff, joint_label_mean, R
 
 
 @jax.jit
@@ -286,11 +327,16 @@ def _class_buckets(counts_np: np.ndarray, class_idx_np: np.ndarray) -> list:
         for i, c in enumerate(ids):
             r = sorted_rows[offsets[c] : offsets[c] + counts_np[c]]
             rows[i, : len(r)] = r
+        # device_put, not jnp.asarray: these are deliberate once-per-fit
+        # uploads of the bucket tables — explicit transfers stay silent
+        # under the KEYSTONE_GUARD transfer sentinel
         buckets.append(
-            (ch, jnp.asarray(ids, jnp.int32), jnp.asarray(rows, jnp.int32))
+            (ch,
+             jax.device_put(np.asarray(ids, np.int32)),
+             jax.device_put(np.asarray(rows, np.int32)))
         )
     perm = np.concatenate([ids for _, ids in ordered])
-    inv_perm = jnp.asarray(np.argsort(perm), jnp.int32)
+    inv_perm = jax.device_put(np.argsort(perm).astype(np.int32))
     return buckets, inv_perm
 
 
@@ -382,6 +428,15 @@ def _bucketed_class_solves(
         )
         for max_nc, ids, rows in buckets
     ]
+    return _concat_permute(parts, inv_perm)
+
+
+@jax.jit
+def _concat_permute(parts, inv_perm):
+    """Bucket re-assembly under jit: the eager form's advanced-indexing
+    gather implicitly uploads its index-clip constant every block
+    (guard.transfer); traced, it is a fused concat+gather with constants
+    baked in."""
     return jnp.concatenate(parts, axis=1)[:, inv_perm]
 
 
@@ -525,17 +580,16 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         labels = jnp.asarray(labels, jnp.float32)
         num_classes = labels.shape[1]
-        w = jnp.float32(self.mixture_weight)
-        lam = jnp.float32(self.lam)
+        # explicit device_put: raw python floats (or jnp.float32 casts)
+        # would transfer implicitly on every fit — the guard sentinel's R1
+        # runtime analog (see linalg.solvers.device_scalar)
+        w = device_scalar(self.mixture_weight)
+        lam = device_scalar(self.lam)
 
         class_idx, counts, valid = _prepare(labels, mask, num_classes)
-        n_eff = jnp.sum(counts).astype(jnp.float32)
-
-        # jointLabelMean[c] = 2w + 2(1-w)·n_c/n − 1  (``:148-150``)
-        joint_label_mean = (
-            2.0 * w + 2.0 * (1.0 - w) * counts.astype(jnp.float32) / n_eff - 1.0
+        n_eff, joint_label_mean, R = _joint_residual_init(
+            labels, w, counts, valid
         )
-        R = (labels - joint_label_mean) * valid[:, None]
         _, residual_mean = _class_col_means(R, class_idx, counts)
 
         # One host sync of the class counts + row ids; buckets give static
@@ -548,10 +602,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             _host_global(counts), _host_global(class_idx)
         )
 
-        models = [
-            jnp.zeros((self.block_size, num_classes), jnp.float32)
-            for _ in range(num_blocks)
-        ]
+        # dzeros, not eager jnp.zeros: eager creation implicitly uploads
+        # the fill scalar per call (guard.transfer counts it). One shared
+        # immutable buffer: every entry is overwritten during the loop, so
+        # num_blocks distinct zero arrays would be pure HBM+dispatch waste.
+        _z0 = dzeros((self.block_size, num_classes))
+        models = [_z0] * num_blocks
         pop_stats_cache: list = [None] * num_blocks
         joint_means_blocks: list = [None] * num_blocks
 
@@ -681,7 +737,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         _reg = _telemetry.get_registry()
         _reg.inc("solver.calls", solver="weighted_bcd")
         _trace_on = _telemetry.tracing_enabled()
-        _sync_timers = _os.environ.get("KEYSTONE_SYNC_TIMERS", "0") == "1"
+        from keystone_tpu.utils import knobs as _knobs
+
+        _sync_timers = _knobs.get("KEYSTONE_SYNC_TIMERS")
 
         @contextlib.contextmanager
         def _phase(tag):
@@ -757,10 +815,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     base_inv = None
                 # jointMeans_c = w·classMean_c + (1-w)·popMean (``:196-200``)
                 class_sums = _class_sums(Xb, class_idx, num_classes)
-                class_means = class_sums / jnp.maximum(
-                    counts[:, None].astype(jnp.float32), 1.0
+                joint_means_b = _joint_block_means(
+                    class_sums, counts, w, pop_mean
                 )
-                joint_means_b = w * class_means + (1.0 - w) * pop_mean
                 joint_means_blocks[b] = joint_means_b
                 if self.cache_stats and self.num_iter > 1:
                     pop_stats_cache[b] = (pop_mean, pop_cov, base_inv)
@@ -898,9 +955,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         num_blocks = d_pad // self.block_size
 
         def get_block(b):
-            return jax.lax.dynamic_slice_in_dim(
-                data, b * self.block_size, self.block_size, 1
-            )
+            # explicit device upload of the block start (guard-clean) +
+            # jitted slice — see _slice_block
+            start = device_scalar(b * self.block_size, np.int32)
+            return _slice_block(data, start, self.block_size)
 
         W, joint_means, joint_label_mean = self._run(
             get_block, num_blocks, labels, mask, precision,

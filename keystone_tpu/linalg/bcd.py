@@ -87,6 +87,12 @@ def block_coordinate_descent_l2(
     if precision is not None:
         validate_precision(precision)
     precision = precision or get_solver_precision()
+    # lam rides into the jitted solve as a traced scalar; a raw python
+    # float would be an *implicit* h2d transfer on every fit call (the
+    # KEYSTONE_GUARD sentinel flags it — see linalg.solvers.device_scalar).
+    from keystone_tpu.linalg.solvers import device_scalar
+
+    lam = device_scalar(lam)
     omesh = overlap_mesh(overlap)
     model_overlap = model_overlap_spec(A, omesh, block_size)
     trace_on = _telemetry.tracing_enabled(telemetry)

@@ -62,6 +62,36 @@ def get_solver_precision() -> str:
     return _solver_precision
 
 
+@functools.partial(jax.jit, static_argnames=("shape", "dtype"))
+def dzeros(shape, dtype=jnp.float32):
+    """Device zeros without the implicit scalar upload.
+
+    Eager ``jnp.zeros`` transfers its fill scalar host→device implicitly
+    on every call (the KEYSTONE_GUARD sentinel counts one ``guard.transfer``
+    per eager creation in the solver loops); under jit the zero is a
+    trace-time constant. Shapes are static, so each distinct shape compiles
+    once and is cached."""
+    return jnp.zeros(shape, dtype)
+
+
+def device_scalar(value, dtype=None):
+    """Explicitly committed device scalar for python numbers crossing into
+    jitted solver code.
+
+    A raw python float/int passed as a traced argument is an *implicit*
+    host-to-device transfer on every call — flagged by the
+    ``KEYSTONE_GUARD`` runtime sentinel (``analysis/guard.py``) and the
+    transfer-guard-clean contract. ``jnp.float32(x)`` is no better: the
+    conversion itself transfers implicitly. ``jax.device_put`` of the host
+    scalar is the explicit, guard-sanctioned form. jax arrays pass through
+    untouched."""
+    if isinstance(value, jax.Array):
+        return value
+    import numpy as np
+
+    return jax.device_put(np.asarray(value, dtype or np.float32))
+
+
 def hdot(a: jax.Array, b: jax.Array, precision: Optional[str] = None) -> jax.Array:
     """Matmul at the solver precision — use for all gram/solve matmuls.
 
@@ -159,7 +189,7 @@ def normal_equations_solve(
                 _normal_equations_lstsq(A, b, mask, precision, omesh)
             )
         return sp.track(
-            _normal_equations(A, b, jnp.float32(lam), mask, precision, omesh)
+            _normal_equations(A, b, device_scalar(lam), mask, precision, omesh)
         )
 
 

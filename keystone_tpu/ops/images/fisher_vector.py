@@ -136,9 +136,9 @@ def _fv_moment_impl() -> str:
     formulation and the autodiff-oracle tests keep their exact path
     (the ``_conv1d_same`` precedent). ``KEYSTONE_FV_IMPL=mxu|f32``
     forces either for cross-path parity tests."""
-    import os
+    from keystone_tpu.utils import knobs
 
-    forced = os.environ.get("KEYSTONE_FV_IMPL", "auto")
+    forced = knobs.get("KEYSTONE_FV_IMPL")
     if forced in ("mxu", "f32"):
         return forced
     return "mxu" if jax.default_backend() == "tpu" else "f32"

@@ -65,6 +65,8 @@ def _conv1d_same(x, filt: np.ndarray, axis: int, mode: str = "zero",
     the CPU-best formulation, not a TPU-shaped one. ``impl``:
     "auto" | "matmul" | "conv" (forced, for cross-path parity tests).
     """
+    # lint: disable=R1 (filt is a static host-side numpy filter; it folds
+    # into the band matrix at trace time by design, never a device sync)
     filt = np.ascontiguousarray(np.asarray(filt, np.float32))
     k = len(filt)
     moved = jnp.moveaxis(x, axis, -1)

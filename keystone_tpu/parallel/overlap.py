@@ -65,7 +65,7 @@ every such fallback is logged once per call-site/shape via ``logging``.
 from __future__ import annotations
 
 import contextlib
-import os
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -74,13 +74,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.linalg.solvers import hdot
 from keystone_tpu.parallel.ring import bidirectional_rounds, paired_ring_perms
+from keystone_tpu.utils import knobs
 
 _OVERLAP_STACK: list = []
 
 # One warning per (site, detail) for the life of the process: the fallback
 # is a trace-time decision that re-fires on every solver call with the same
 # shapes, and a log line per block×iteration would drown the run.
+# Concurrent fits (the prefetch feed traces from its own thread) hit this
+# set simultaneously, hence the lock.
 _FALLBACK_LOGGED: set = set()
+_fallback_lock = threading.Lock()
 
 
 def _count(event: str, value: float = 1, **labels) -> None:
@@ -103,9 +107,10 @@ def _log_fallback(site: str, detail: str) -> None:
     decision increments ``overlap.fallback{site=...}``."""
     _count("fallback", site=site)
     key = (site, detail)
-    if key in _FALLBACK_LOGGED:
-        return
-    _FALLBACK_LOGGED.add(key)
+    with _fallback_lock:
+        if key in _FALLBACK_LOGGED:
+            return
+        _FALLBACK_LOGGED.add(key)
     from keystone_tpu.utils import get_logger
 
     get_logger("keystone_tpu.parallel.overlap").warning(
@@ -122,16 +127,22 @@ def overlap_enabled(override: Optional[bool] = None) -> bool:
         return bool(override)
     if _OVERLAP_STACK:
         return _OVERLAP_STACK[-1]
-    return os.environ.get("KEYSTONE_OVERLAP", "0") == "1"
+    return knobs.get("KEYSTONE_OVERLAP")
 
 
 @contextlib.contextmanager
 def use_overlap(flag: bool):
-    """Scope the overlap knob (the ``use_cache`` pattern)."""
+    """Scope the overlap knob (the ``use_cache`` pattern).
+
+    The stack is push/pop strictly nested within one thread's with-block;
+    cross-thread scoping is not a supported use, so the mutations carry an
+    R5 pragma instead of a lock."""
+    # lint: disable=R5 (strictly nested per-thread context stack)
     _OVERLAP_STACK.append(bool(flag))
     try:
         yield
     finally:
+        # lint: disable=R5 (paired with the push above)
         _OVERLAP_STACK.pop()
 
 
@@ -166,23 +177,12 @@ def _env_tiles() -> Tuple[Optional[int], Optional[int]]:
     or ``"T,To"`` (inner target, outer/DCN exchange count) — the
     per-topology tuning knob for :func:`_pick_tiles`, so tile counts can be
     tuned without code edits. Returns (None, None) when unset; raises
-    ``ValueError`` on anything that is not one or two positive integers."""
-    raw = os.environ.get("KEYSTONE_OVERLAP_TILES", "").strip()
-    if not raw:
+    ``ValueError`` (from the knob registry's normalizing validator — the
+    single place the format is parsed) otherwise."""
+    parsed = knobs.get("KEYSTONE_OVERLAP_TILES")
+    if parsed is None:
         return None, None
-    parts = [p.strip() for p in raw.split(",")]
-    try:
-        vals = [int(p) for p in parts]
-    except ValueError:
-        vals = []
-    if len(vals) not in (1, 2) or any(v < 1 for v in vals):
-        raise ValueError(
-            f"KEYSTONE_OVERLAP_TILES={raw!r} is invalid: expected one or two "
-            "positive integers ('<inner_tiles>' or '<inner_tiles>,"
-            "<outer_exchanges>'), e.g. KEYSTONE_OVERLAP_TILES=8 or "
-            "KEYSTONE_OVERLAP_TILES=8,2"
-        )
-    return vals[0], (vals[1] if len(vals) == 2 else None)
+    return parsed
 
 
 def _pick_tiles(dim: int, k: int, target: Optional[int] = None) -> int:
@@ -216,7 +216,7 @@ def mesh_tiers(mesh: Mesh, axis: str = "data") -> Tuple[int, int]:
     only a clean tiering — equal-length contiguous runs per slice — is
     accepted, anything irregular degrades to single-tier (logged once)."""
     k = mesh.shape[axis]
-    raw = os.environ.get("KEYSTONE_MESH_TIERS", "").strip()
+    raw = (knobs.get_raw("KEYSTONE_MESH_TIERS") or "").strip()
     if raw:
         try:
             outer = int(raw)

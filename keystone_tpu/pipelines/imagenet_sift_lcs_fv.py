@@ -766,9 +766,11 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             from keystone_tpu.core.cache import get_cache as _get_cache
 
             with Timer("eval.predict"):
+                from keystone_tpu.utils import knobs as _knobs
+
                 if (
                     _get_cache() is not None
-                    and os.environ.get("KEYSTONE_EVAL_CACHED_TIMING") == "1"
+                    and _knobs.get("KEYSTONE_EVAL_CACHED_TIMING")
                 ):
                     # cached-vs-cold predict evidence (bench rows ONLY —
                     # the env flag keeps ordinary cache-enabled runs from

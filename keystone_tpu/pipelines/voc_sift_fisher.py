@@ -225,11 +225,11 @@ def run(config: VOCSIFTFisherConfig) -> dict:
             test_feats = featurizer(test_gray)
             from keystone_tpu.core.cache import get_cache as _get_cache
 
-            import os as _os
+            from keystone_tpu.utils import knobs as _knobs
 
             if (
                 _get_cache() is not None
-                and _os.environ.get("KEYSTONE_EVAL_CACHED_TIMING") == "1"
+                and _knobs.get("KEYSTONE_EVAL_CACHED_TIMING")
             ):
                 # cached-vs-cold eval featurization evidence (bench rows
                 # ONLY — the env flag keeps ordinary cache-enabled runs

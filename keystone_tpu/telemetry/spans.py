@@ -46,6 +46,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from keystone_tpu.telemetry.registry import get_registry
+from keystone_tpu.utils import knobs
 
 _ENV_ENABLE = "KEYSTONE_TELEMETRY"
 _ENV_DIR = "KEYSTONE_TELEMETRY_DIR"
@@ -56,7 +57,7 @@ _TRACING_STACK: list = []
 # Runaway guard: a span per pipeline stage is thousands per run, not
 # millions; past the cap new spans are counted (telemetry.spans_dropped)
 # but not stored.
-_MAX_SPANS = int(os.environ.get("KEYSTONE_TELEMETRY_MAX_SPANS", "200000"))
+_MAX_SPANS = knobs.get("KEYSTONE_TELEMETRY_MAX_SPANS")
 
 _ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
 
@@ -69,19 +70,21 @@ def tracing_enabled(override: Optional[bool] = None) -> bool:
         return bool(override)
     if _TRACING_STACK:
         return _TRACING_STACK[-1]
-    return (
-        os.environ.get(_ENV_ENABLE, "0") == "1"
-        or bool(os.environ.get(_ENV_DIR))
-    )
+    return knobs.get(_ENV_ENABLE) or knobs.is_set(_ENV_DIR)
 
 
 @contextlib.contextmanager
 def use_tracing(flag: bool):
-    """Scope the tracing knob (the ``use_overlap``/``use_cache`` pattern)."""
+    """Scope the tracing knob (the ``use_overlap``/``use_cache`` pattern).
+
+    Push/pop is strictly nested within one thread's with-block (cross-
+    thread scoping unsupported), hence R5 pragmas instead of a lock."""
+    # lint: disable=R5 (strictly nested per-thread context stack)
     _TRACING_STACK.append(bool(flag))
     try:
         yield
     finally:
+        # lint: disable=R5 (paired with the push above)
         _TRACING_STACK.pop()
 
 
@@ -362,7 +365,7 @@ def jit_cost(jit_fn, key: str, *args) -> Optional[dict]:
     span's wall-clock into achieved-vs-peak GFLOPs. ``key`` scopes the memo
     (use the stage fingerprint). Never raises; ``KEYSTONE_TELEMETRY_COST=0``
     disables (lowering re-traces, so first-hit cost is nonzero)."""
-    if os.environ.get(_ENV_COST, "1") == "0":
+    if not knobs.get(_ENV_COST):
         return None
     # full structural hash of the args, NOT the display-capped tree_shapes:
     # two inputs differing past a summary cap must not share a memo slot
@@ -425,13 +428,13 @@ def export_dir(dir_path: str) -> dict:
     return paths
 
 
-if os.environ.get(_ENV_DIR):
+if knobs.is_set(_ENV_DIR):
     import atexit
 
     @atexit.register
     def _autoexport():  # pragma: no cover - exercised via subprocess tests
         try:
-            export_dir(os.environ[_ENV_DIR])
+            export_dir(knobs.get(_ENV_DIR))
         except Exception as exc:
             # last-gasp path: stderr, not a raise, at interpreter exit
             import sys

@@ -1,0 +1,362 @@
+"""Central registry for every ``KEYSTONE_*`` / ``BENCH_*`` environment knob.
+
+Four PRs grew ~30 env knobs, each parsed ad hoc at its call site — a typo'd
+name silently read the default, an invalid value failed (or didn't) in a
+site-specific way, and the README table was maintained by hand.  This module
+is the single choke point the R4 lint rule (``keystone_tpu/analysis``)
+enforces: every knob is *declared* here with a name, type, default,
+validator, and doc string, and every read goes through :func:`get` /
+:func:`get_raw`.  Raw ``os.environ.get("KEYSTONE_...")`` reads anywhere else
+in the package are lint findings.
+
+Semantics:
+
+- Reads are **live**: every :func:`get` re-reads the environment (tests
+  monkeypatch knobs mid-process; nothing here caches values).
+- Unset (or empty) means the declared default, already parsed.
+- Bool knobs accept exactly ``"1"`` / ``"0"`` — anything else is a
+  :class:`ValueError` naming the knob (knob validation is the point).
+- A ``validator`` may normalize (return a value) and/or raise ``ValueError``;
+  its message is prefixed with the knob name when it doesn't already
+  contain it.
+- ``lenient=True`` knobs fall back to the default on a bad value instead of
+  raising (grandfathered behavior some tests pin, e.g.
+  ``KEYSTONE_PREFETCH=junk`` -> default).
+
+Writes are out of scope: the bench toggles knobs for subprocess control via
+plain ``os.environ[...] = ...`` — that is knob *production*, not
+consumption, and R4 only polices reads.
+
+``python -m keystone_tpu.utils.knobs`` prints the README reference table
+(see :func:`readme_table`); the README section between the
+``<!-- knob-table:begin -->`` / ``<!-- knob-table:end -->`` markers is
+generated from it, and the R4 rule cross-checks that every declared knob
+appears in the README.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "Knob",
+    "declare",
+    "get",
+    "get_raw",
+    "is_set",
+    "all_knobs",
+    "readme_table",
+]
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str  # "bool" | "int" | "float" | "str"
+    default: Any
+    doc: str
+    validator: Optional[Callable[[Any], Any]] = None
+    choices: Optional[Tuple[str, ...]] = None
+    lenient: bool = False
+
+    def describe_default(self) -> str:
+        if self.type == "bool":
+            return "1" if self.default else "0"
+        if self.default in (None, ""):
+            return "(unset)"
+        return str(self.default)
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def declare(
+    name: str,
+    type: str,
+    default: Any,
+    doc: str,
+    validator: Optional[Callable[[Any], Any]] = None,
+    choices: Optional[Tuple[str, ...]] = None,
+    lenient: bool = False,
+) -> Knob:
+    if type not in ("bool", "int", "float", "str"):
+        raise ValueError(f"knob {name}: unknown type {type!r}")
+    if name in _REGISTRY:
+        raise ValueError(f"knob {name} declared twice")
+    knob = Knob(name, type, default, doc, validator, choices, lenient)
+    _REGISTRY[name] = knob
+    return knob
+
+
+def _knob(name: str) -> Knob:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not a declared knob; declare it in "
+            "keystone_tpu/utils/knobs.py (name, type, default, doc)"
+        ) from None
+
+
+def _parse(knob: Knob, raw: str) -> Any:
+    if knob.type == "bool":
+        if raw == "1":
+            return True
+        if raw == "0":
+            return False
+        raise ValueError(f"expected '0' or '1', got {raw!r}")
+    if knob.type == "int":
+        try:
+            return int(raw)
+        except ValueError:
+            return int(float(raw))  # "1024.0" style values
+    if knob.type == "float":
+        return float(raw)
+    return raw
+
+
+def get(name: str, default: Any = None) -> Any:
+    """Parsed + validated value of the declared knob ``name``.
+
+    ``default`` (when not None) overrides the declared default for this
+    read — call sites like ``prefetch_depth(default)`` thread their own.
+    """
+    knob = _knob(name)
+    fallback = knob.default if default is None else default
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return fallback
+    try:
+        value = _parse(knob, raw)
+        if knob.choices is not None and value not in knob.choices:
+            raise ValueError(
+                f"expected one of {', '.join(knob.choices)}, got {raw!r}"
+            )
+        if knob.validator is not None:
+            out = knob.validator(value)
+            value = value if out is None else out
+    except ValueError as e:
+        if knob.lenient:
+            return fallback
+        msg = str(e)
+        if name not in msg:
+            msg = f"{name}={raw!r} is invalid: {msg}"
+        raise ValueError(msg) from None
+    return value
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw env string of a declared knob (None when unset) — for
+    call sites with their own context-dependent parsing (e.g.
+    ``KEYSTONE_MESH_TIERS`` divisibility against a mesh axis)."""
+    _knob(name)  # undeclared reads are a bug even through get_raw
+    return os.environ.get(name)
+
+
+def is_set(name: str) -> bool:
+    _knob(name)
+    return bool(os.environ.get(name))
+
+
+def all_knobs() -> Dict[str, Knob]:
+    return dict(_REGISTRY)
+
+
+def validate_environment() -> None:
+    """Parse + validate every declared knob that is currently set.
+
+    Long-running entry points (bench.py) call this at startup so a typo'd
+    knob fails immediately with the knob-named error, instead of killing
+    the run mid-flight at whichever section first reads it — scattered
+    strict reads would otherwise forfeit the bench's partial-results
+    contract. Lenient knobs keep their fall-back-to-default behavior."""
+    for name in _REGISTRY:
+        get(name)
+
+
+# ---------------------------------------------------------------------------
+# Validators
+# ---------------------------------------------------------------------------
+
+def _non_negative(v):
+    if v < 0:
+        raise ValueError(f"must be >= 0, got {v}")
+    return v
+
+
+def _positive(v):
+    if v <= 0:
+        raise ValueError(f"must be > 0, got {v}")
+    return v
+
+
+def _tiles_format(raw: str) -> Tuple[int, Optional[int]]:
+    """Normalizing validator: the ONE place the tiles format is parsed.
+    Returns ``(inner, outer_or_None)`` — consumers get the tuple, never a
+    raw string to re-parse (parse drift was a reviewed hazard)."""
+    parts = [p.strip() for p in raw.strip().split(",")]
+    try:
+        vals = [int(p) for p in parts]
+    except ValueError:
+        vals = []
+    if len(vals) not in (1, 2) or any(v < 1 for v in vals):
+        raise ValueError(
+            f"KEYSTONE_OVERLAP_TILES={raw!r} is invalid: expected one or two "
+            "positive integers ('<inner_tiles>' or '<inner_tiles>,"
+            "<outer_exchanges>'), e.g. KEYSTONE_OVERLAP_TILES=8 or "
+            "KEYSTONE_OVERLAP_TILES=8,2"
+        )
+    return vals[0], (vals[1] if len(vals) == 2 else None)
+
+
+# ---------------------------------------------------------------------------
+# KEYSTONE_* declarations (runtime behavior)
+# ---------------------------------------------------------------------------
+
+declare("KEYSTONE_OVERLAP", "bool", False,
+        "Master switch for the latency-hiding collective schedules "
+        "(tiled reduce-scatter matmuls, bidirectional ring gram, overlapped "
+        "TSQR fold); per-call overlap= beats use_overlap() beats this.")
+declare("KEYSTONE_OVERLAP_TILES", "str", None,
+        "Tile-count target for the overlap schedules: 'T' (inner/ICI tile "
+        "target) or 'T,To' (inner target, outer/DCN exchange count); "
+        "invalid values raise; reads yield the parsed (inner, outer) "
+        "tuple.", validator=_tiles_format)
+declare("KEYSTONE_MESH_TIERS", "str", "",
+        "Declared slice count on the sharded axis (overrides the "
+        "jax.devices() slice probe); must be a positive integer dividing "
+        "the axis size — validated against the mesh at use.")
+declare("KEYSTONE_CACHE", "bool", False,
+        "Enable the 3-tier (HBM/host/disk) intermediate cache from the "
+        "environment.")
+declare("KEYSTONE_CACHE_DIR", "str", "",
+        "Disk-tier directory for the intermediate cache (absent -> no "
+        "disk tier).")
+declare("KEYSTONE_CACHE_DEVICE_MB", "int", 1024,
+        "HBM-tier budget of the intermediate cache, in MiB.",
+        validator=_non_negative)
+declare("KEYSTONE_CACHE_HOST_MB", "int", 4096,
+        "Host-RAM-tier budget of the intermediate cache, in MiB.",
+        validator=_non_negative)
+declare("KEYSTONE_CACHE_DISK_MB", "int", 16384,
+        "Disk-tier budget of the intermediate cache, in MiB.",
+        validator=_non_negative)
+declare("KEYSTONE_PREFETCH", "int", 1,
+        "Block-feed dispatch-ahead depth: 0 disables (strictly "
+        "sequential), N>1 runs N blocks ahead; bad values fall back to "
+        "the default.", validator=lambda v: max(0, v), lenient=True)
+declare("KEYSTONE_SYNC_TIMERS", "bool", False,
+        "Hard device barrier at every Timer exit, so per-stage timings are "
+        "device time instead of dispatch time (diagnostics only; costs a "
+        "host round-trip per timer).")
+declare("KEYSTONE_TELEMETRY", "bool", False,
+        "Enable span tracing (spans sync at exit — honest per-stage "
+        "timings, serialized dispatch).")
+declare("KEYSTONE_TELEMETRY_DIR", "str", "",
+        "Implies tracing on; auto-exports telemetry_trace.json + "
+        "telemetry_metrics.{json,prom} there at process exit.")
+declare("KEYSTONE_TELEMETRY_COST", "bool", True,
+        "Compile-time cost_analysis() flop extraction for traced jitted "
+        "stages; set 0 to disable (it re-lowers once per unique "
+        "stage/shape).")
+declare("KEYSTONE_TELEMETRY_MAX_SPANS", "int", 200000,
+        "Runaway guard: spans beyond this cap are counted "
+        "(telemetry.spans_dropped) but not stored.", validator=_positive)
+declare("KEYSTONE_TPU_TRACE_DIR", "str", "",
+        "Capture a jax.profiler device trace (TensorBoard/Perfetto) for "
+        "blocks under utils.profiling.trace().")
+declare("KEYSTONE_FV_IMPL", "str", "auto",
+        "Force the Fisher-vector moment kernel: mxu (bf16-in/f32-acc "
+        "packed gemms) or f32; auto picks mxu on TPU.",
+        choices=("auto", "mxu", "f32"), lenient=True)
+declare("KEYSTONE_EVAL_CACHED_TIMING", "bool", False,
+        "Record the cached-featurization eval timing rows "
+        "(featurize_cached_s / predict_cached_s) during pipeline eval.")
+declare("KEYSTONE_BENCH_BUDGET_S", "float", 840.0,
+        "Wall-clock budget for bench.py; sections that would start past "
+        "it are skipped with <key>_skipped entries.",
+        validator=_non_negative)
+declare("KEYSTONE_BENCH_SECTION_FLOOR_S", "float", 60.0,
+        "Minimum per-section budget the bench derates subprocess regimes "
+        "to.", validator=_non_negative)
+declare("KEYSTONE_GUARD", "bool", False,
+        "Arm the runtime guard: jax transfer_guard plus a recompilation "
+        "sentinel, feeding guard.transfer / guard.recompile counters into "
+        "the telemetry registry (the runtime cross-check for the static "
+        "lint findings).")
+
+# ---------------------------------------------------------------------------
+# BENCH_* declarations (bench.py / scripts/bench_regime.py sections)
+# ---------------------------------------------------------------------------
+
+declare("BENCH_SMOKE", "bool", False,
+        "Shrink every bench shape to CPU scale and default heavy "
+        "sections off — the seconds-long bench-contract smoke.")
+declare("BENCH_EXTRAS", "bool", True,
+        "Secondary micro-benchmarks beyond the primary metric.")
+declare("BENCH_CONSTANTS", "bool", True,
+        "Machine-constants section (matmul roofline probes).")
+declare("BENCH_SERVE", "bool", True,
+        "Serving-latency section.")
+declare("BENCH_MOMENTS", "bool", True,
+        "Pallas moments-kernel section.")
+declare("BENCH_STAGES", "bool", True,
+        "Per-stage breakdown section (runs under KEYSTONE_SYNC_TIMERS=1).")
+declare("BENCH_CACHED", "bool", True,
+        "Cached-vs-cold pipeline rows (core/cache.py evidence).")
+declare("BENCH_PREFETCH", "bool", True,
+        "Prefetch on/off solver rows (core/prefetch.py evidence).")
+declare("BENCH_TELEMETRY", "bool", True,
+        "Telemetry section: traced pipeline run exporting "
+        "bench_telemetry.json.")
+declare("BENCH_TELEMETRY_PATH", "str", "",
+        "Override path for bench_telemetry.json.")
+declare("BENCH_SOLVER_OVERLAP", "bool", True,
+        "Overlap on/off solver GFLOPs ladder (subprocess regime).")
+declare("BENCH_FLAGSHIP", "bool", True,
+        "Flagship ImageNet-scale streaming row.")
+declare("BENCH_VOC_REFDIM", "bool", True,
+        "VOC reference-dimension row.")
+declare("BENCH_TIMIT_FULL", "bool", True,
+        "Full TIMIT pipeline row.")
+declare("BENCH_LINT", "bool", True,
+        "Static-analysis section: run keystone_tpu/analysis over the "
+        "package and record lint_findings_total.")
+declare("BENCH_OVERLAP", "bool", True,
+        "bench_regime.py: run the solver ladder with the overlap knob "
+        "on.")
+declare("BENCH_WARM_REPS", "int", 3,
+        "Warm repetitions per timed section.", validator=_positive)
+declare("BENCH_XLA_CACHE", "str", "/tmp/keystone_xla_cache",
+        "Persistent XLA compilation-cache directory for bench runs.")
+declare("BENCH_FULL_PATH", "str", "",
+        "Override path for the incremental bench_full.json artifact.")
+declare("BENCH_KILL_AFTER_SECTION", "str", "",
+        "Test hook: SIGKILL the bench right after the named section "
+        "(pins incremental-flush survival).")
+
+
+# ---------------------------------------------------------------------------
+# README table generation
+# ---------------------------------------------------------------------------
+
+def readme_table() -> str:
+    """Markdown reference table of every declared knob, grouped
+    KEYSTONE_* first — the generated body of the README's knob section."""
+    def rows(prefix: str):
+        return [k for n, k in sorted(_REGISTRY.items()) if n.startswith(prefix)]
+
+    out = ["| knob | type | default | effect |", "|---|---|---|---|"]
+    for knob in rows("KEYSTONE_") + rows("BENCH_"):
+        doc = " ".join(knob.doc.split())
+        out.append(
+            f"| `{knob.name}` | {knob.type} | `{knob.describe_default()}` "
+            f"| {doc} |"
+        )
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    print(readme_table())

@@ -13,12 +13,13 @@ from __future__ import annotations
 
 import functools
 import logging
-import os
 import threading
 import time
 from typing import ClassVar, Dict, List, Optional
 
 import jax
+
+from keystone_tpu.utils import knobs
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 _configured = False
@@ -97,7 +98,7 @@ class Timer:
                 jax.effects_barrier()
             except Exception:
                 pass
-        if os.environ.get("KEYSTONE_SYNC_TIMERS", "0") == "1":
+        if knobs.get("KEYSTONE_SYNC_TIMERS"):
             # Diagnostics mode: hard-barrier EVERY local device. Each device
             # executes its queued programs in order, so a fresh marker put on
             # it completes only after everything enqueued before — per-stage

@@ -30,7 +30,9 @@ def trace(log_dir: Optional[str] = None) -> Iterator[None]:
     argument, tracing is enabled only when ``KEYSTONE_TPU_TRACE_DIR`` is set
     (so pipelines can leave the hook permanently in place at zero cost).
     """
-    log_dir = log_dir or os.environ.get(_TRACE_ENV)
+    from keystone_tpu.utils import knobs
+
+    log_dir = log_dir or knobs.get(_TRACE_ENV) or None
     if not log_dir:
         yield
         return

@@ -26,6 +26,8 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+from keystone_tpu.utils import knobs  # noqa: E402
+
 
 def _flagship() -> dict:
     import bench
@@ -60,8 +62,8 @@ def _flagship() -> dict:
     # "eval.predict is test-side re-featurization" cost, measured against
     # its elimination. AFTER the headline rows: the cache run must not
     # perturb the async warm measurement. BENCH_CACHED=0 skips.
-    if os.environ.get("BENCH_CACHED", "1") == "1":
-        prev_flag = os.environ.get("KEYSTONE_EVAL_CACHED_TIMING")
+    if knobs.get("BENCH_CACHED"):
+        prev_flag = knobs.get_raw("KEYSTONE_EVAL_CACHED_TIMING")
         # bench-only: the pipelines gate the cold/cached eval double-predict
         # on this flag so ordinary cache-enabled runs never pay for it
         os.environ["KEYSTONE_EVAL_CACHED_TIMING"] = "1"
@@ -88,8 +90,8 @@ def _flagship() -> dict:
     # (core/prefetch.py): the headline warm row above runs with prefetch ON
     # (the default); this one warm run with KEYSTONE_PREFETCH=0 is the
     # overlap's measured value. BENCH_PREFETCH=0 skips.
-    if os.environ.get("BENCH_PREFETCH", "1") == "1":
-        prev = os.environ.get("KEYSTONE_PREFETCH")
+    if knobs.get("BENCH_PREFETCH"):
+        prev = knobs.get_raw("KEYSTONE_PREFETCH")
         os.environ["KEYSTONE_PREFETCH"] = "0"
         try:
             import time as _time
@@ -118,8 +120,8 @@ def _flagship() -> dict:
     # back to the monolithic programs, so on/off only separates on a mesh
     # (the row still documents that). One compile-warm run first: the
     # pipelined programs are new compilations. BENCH_OVERLAP=0 skips.
-    if os.environ.get("BENCH_OVERLAP", "1") == "1":
-        prev = os.environ.get("KEYSTONE_OVERLAP")
+    if knobs.get("BENCH_OVERLAP"):
+        prev = knobs.get_raw("KEYSTONE_OVERLAP")
         os.environ["KEYSTONE_OVERLAP"] = "1"
         try:
             import time as _time

@@ -32,8 +32,14 @@ def main() -> int:
         num_ffts=2, block_size=256, lam=10.0,
         synthetic_train=512, synthetic_test=128,
     )
-    with telemetry.use_tracing(True):
-        run(cfg)
+    # KEYSTONE_GUARD=1 additionally arms the transfer/recompile sentinel
+    # (keystone_tpu/analysis/guard.py) around the traced run; violations
+    # land as guard.* counters in the same registry this smoke asserts on.
+    from keystone_tpu.analysis.guard import maybe_guard
+
+    with maybe_guard():
+        with telemetry.use_tracing(True):
+            run(cfg)
 
     reg = telemetry.get_registry()
     metrics = reg.as_dict()

@@ -28,6 +28,10 @@ import numpy as np
 
 import keystone_tpu.learning.block_weighted as bw
 
+# Constructed once at module scope: wrapping inside build_case would mint a
+# fresh jit object (and XLA compile) per case (lint R2).
+_pop_stats_jit = jax.jit(bw._pop_stats, static_argnames=("precision",))
+
 
 def build_case(bs: int, nc: int, num_classes: int, seed: int = 0):
     n = nc * num_classes
@@ -45,9 +49,9 @@ def build_case(bs: int, nc: int, num_classes: int, seed: int = 0):
         np.asarray(counts), np.asarray(class_idx)
     )
     prec = "high"
-    pop_mean, pop_cov, pop_xtr = jax.jit(
-        bw._pop_stats, static_argnames=("precision",)
-    )(X, R, valid, n_eff, precision=prec)
+    pop_mean, pop_cov, pop_xtr = _pop_stats_jit(
+        X, R, valid, n_eff, precision=prec
+    )
     w, lam = jnp.float32(0.25), jnp.float32(6e-5)
     base_inv = bw._base_inverse(pop_cov, lam, w, prec)[0]
     class_sums = bw._class_sums(X, class_idx, num_classes)

@@ -1,0 +1,699 @@
+"""keystone-lint (keystone_tpu/analysis): rule fixtures R1-R5, the
+baseline ratchet, pragma handling, the knob registry, the lint CLI, and
+the KEYSTONE_GUARD runtime sentinel.
+
+Rule tests run the real engine over tiny fixture trees written to
+``tmp_path`` — one positive (must flag) and one negative (must stay
+silent) per rule family, plus the repo-wide invariant that the shipped
+tree itself lints clean against its committed baseline.
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis.engine import (
+    LintEngine,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_tree(tmp_path, files):
+    """Write {relpath: source} under tmp_path and run the engine on it."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return LintEngine(str(tmp_path), sorted(files)).run()
+
+
+def rules_of(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ---------------------------------------------------------------------------
+# R1: host syncs in jit/shard_map hot paths
+# ---------------------------------------------------------------------------
+
+R1_POSITIVE = """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    @jax.jit
+    def hot(x):
+        v = float(x[0])
+        y = np.asarray(x)
+        x.block_until_ready()
+        t = time.time()
+        return x * v + t
+
+
+    def helper(x):
+        return x.item()
+
+
+    @jax.jit
+    def hot_via_call(x):
+        return helper(x)
+"""
+
+
+def test_r1_flags_host_syncs_in_hot_paths(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": R1_POSITIVE})
+    r1 = [f for f in res.findings if f.rule == "R1"]
+    msgs = " | ".join(f.message for f in r1)
+    assert "float" in msgs
+    assert "asarray" in msgs
+    assert "block_until_ready" in msgs
+    assert "time.time" in msgs
+    # call-graph propagation: helper() is hot because hot_via_call jits it
+    assert any("helper" in f.message for f in r1), msgs
+    # findings carry the clickable anchor + a hint
+    assert all(f.line > 0 and f.hint for f in r1)
+
+
+def test_r1_silent_outside_hot_paths_and_on_static_args(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import time
+
+        import jax
+        import numpy as np
+
+
+        def cold(x):
+            # identical syncs, but nothing jits this function
+            v = float(x[0])
+            x.block_until_ready()
+            return np.asarray(x) * v
+
+
+        @jax.jit
+        def hot(x):
+            # shape reads are trace-time python ints: not syncs
+            scale = float(x.shape[0])
+            return x * scale
+    """})
+    assert [f for f in res.findings if f.rule == "R1"] == []
+
+
+def test_r1_wrap_call_marks_function_hot(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import jax
+
+
+        def body(x):
+            return x.item()
+
+
+        fast = jax.jit(body)
+    """})
+    assert any(f.rule == "R1" and "item" in f.message for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# R2: recompile hazards
+# ---------------------------------------------------------------------------
+
+def test_r2_jit_in_loop_and_immediate_call(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import functools
+
+        import jax
+
+
+        def per_batch(batches):
+            out = []
+            for b in batches:
+                f = jax.jit(lambda a: a + 1)
+                out.append(f(b))
+            return out
+
+
+        def per_call(x):
+            return jax.jit(lambda a: a * 2)(x)
+    """})
+    syms = [f.symbol for f in res.findings if f.rule == "R2"]
+    assert "jit-in-loop" in syms
+    assert "jit-immediate-call" in syms
+
+
+def test_r2_static_arg_unhashable_default(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import functools
+
+        import jax
+
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def solve(x, opts=[]):
+            return x
+    """})
+    assert any(
+        f.rule == "R2" and "unhashable" in f.message for f in res.findings
+    )
+
+
+def test_r2_silent_on_construct_once_idioms(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import functools
+
+        import jax
+
+
+        @jax.jit
+        def decorated(x):
+            return x + 1
+
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def decorated_static(x, n=3):
+            return x * n
+
+
+        _cached = jax.jit(lambda a: a - 1)
+
+
+        def user(x):
+            return _cached(x)
+    """})
+    assert [f for f in res.findings if f.rule == "R2"] == []
+
+
+# ---------------------------------------------------------------------------
+# R3: collective safety
+# ---------------------------------------------------------------------------
+
+def test_r3_axis_not_bound_by_shard_map_spec(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+
+        def outer(x, mesh):
+            def local(xj):
+                return jax.lax.psum(xj, "model")
+
+            spec = P("data")
+            return jax.shard_map(
+                local, mesh=mesh, in_specs=spec, out_specs=spec
+            )(x)
+    """})
+    r3 = [f for f in res.findings if f.rule == "R3"]
+    assert any("'model'" in f.message and "not bound" in f.message
+               for f in r3), [f.message for f in r3]
+
+
+def test_r3_bound_axis_and_param_default_resolution(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+
+        def outer(x, mesh, axis="data"):
+            def local(xj):
+                return jax.lax.psum(xj, axis)
+
+            spec = P(None, axis)
+            return jax.shard_map(
+                local, mesh=mesh, in_specs=spec, out_specs=spec
+            )(x)
+    """})
+    assert [f for f in res.findings if f.rule == "R3"] == []
+
+
+def test_r3_unpaired_ppermute(tmp_path):
+    pos = lint_tree(tmp_path, {"pkg/fold.py": """
+        import jax
+
+        from keystone_tpu.parallel.ring import paired_ring_perms
+
+
+        def one_directional_fold(x, axis, k):
+            fwd, bwd = paired_ring_perms(k)
+            for _ in range(k - 1):
+                x = jax.lax.ppermute(x, axis, fwd)
+            return x
+    """})
+    assert any(f.rule == "R3" and "one-directionally" in f.message
+               for f in pos.findings)
+
+    neg = lint_tree(tmp_path / "neg", {"pkg/fold.py": """
+        import jax
+
+        from keystone_tpu.parallel.ring import paired_ring_perms
+
+
+        def paired_fold(x, y, axis, k):
+            fwd, bwd = paired_ring_perms(k)
+            for _ in range((k - 1) // 2):
+                x = jax.lax.ppermute(x, axis, fwd)
+                y = jax.lax.ppermute(y, axis, bwd)
+            return x, y
+    """})
+    assert [f for f in neg.findings if f.rule == "R3"] == []
+
+
+# ---------------------------------------------------------------------------
+# R4: knob hygiene
+# ---------------------------------------------------------------------------
+
+def test_r4_raw_env_reads_flagged(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import os
+
+        _ENV = "KEYSTONE_INDIRECT"
+
+
+        def reads():
+            a = os.environ.get("KEYSTONE_FOO", "0")
+            b = os.environ["BENCH_BAR"]
+            c = os.getenv("BENCH_BAZ")
+            d = os.environ.get(_ENV)
+            return a, b, c, d
+    """})
+    syms = {f.symbol for f in res.findings if f.rule == "R4"}
+    assert {"KEYSTONE_FOO", "BENCH_BAR", "BENCH_BAZ",
+            "KEYSTONE_INDIRECT"} <= syms
+
+
+def test_r4_writes_and_foreign_vars_allowed(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import os
+
+
+        def writes():
+            os.environ["KEYSTONE_FOO"] = "1"        # knob production
+            os.environ.pop("KEYSTONE_FOO", None)
+            return os.environ.get("XLA_FLAGS", "")  # not a keystone knob
+    """})
+    assert [f for f in res.findings if f.rule == "R4"] == []
+
+
+def test_r4_undeclared_knobs_get(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        from keystone_tpu.utils import knobs
+
+
+        def read():
+            ok = knobs.get("KEYSTONE_OVERLAP")       # declared
+            bad = knobs.get("KEYSTONE_NOT_A_KNOB")   # undeclared
+            return ok, bad
+    """})
+    r4 = [f for f in res.findings if f.rule == "R4"]
+    assert any("KEYSTONE_NOT_A_KNOB" in f.message for f in r4)
+    assert not any("KEYSTONE_OVERLAP" in f.message for f in r4)
+
+
+# ---------------------------------------------------------------------------
+# R5: shared-state locks
+# ---------------------------------------------------------------------------
+
+R5_SRC = """
+    import threading
+
+    _STATE = {}
+    _ORDER = []
+    _lock = threading.Lock()
+
+
+    def unlocked(k, v):
+        _STATE[k] = v
+        _ORDER.append(k)
+
+
+    def locked(k, v):
+        with _lock:
+            _STATE[k] = v
+            _ORDER.append(k)
+
+
+    class Registry:
+        table = {}
+
+        @classmethod
+        def bad(cls, k):
+            Registry.table.pop(k, None)
+
+        @classmethod
+        def good(cls, k):
+            with _lock:
+                Registry.table.pop(k, None)
+"""
+
+
+def test_r5_unlocked_mutations_in_scope_modules(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/core/cache.py": R5_SRC})
+    r5 = [f for f in res.findings if f.rule == "R5"]
+    syms = sorted(f.symbol for f in r5)
+    assert "_STATE" in syms and "_ORDER" in syms
+    assert any("Registry.table" in s for s in syms)
+    # exactly the three unlocked mutations — the with-lock ones pass
+    assert len(r5) == 3, [(f.line, f.symbol) for f in r5]
+
+
+def test_r5_out_of_scope_module_silent(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/ops/stuff.py": R5_SRC})
+    assert [f for f in res.findings if f.rule == "R5"] == []
+
+
+# ---------------------------------------------------------------------------
+# Pragmas
+# ---------------------------------------------------------------------------
+
+def test_pragma_trailing_and_block(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/core/cache.py": """
+        _STATE = {}
+
+
+        def f(k, v):
+            _STATE[k] = v  # lint: disable=R5 (single-threaded by contract)
+
+
+        def g(k, v):
+            # lint: disable=R5 (the justification paragraph form:
+            # the pragma covers this whole comment block plus the
+            # mutation line below)
+            _STATE[k] = v
+
+
+        def h(k, v):
+            _STATE[k] = v  # lint: disable=R1 (wrong rule: must NOT suppress)
+    """})
+    r5 = [f for f in res.findings if f.rule == "R5"]
+    assert len(r5) == 1 and "def h" not in ""  # only h's mutation survives
+    assert res.suppressed == 2
+
+
+def test_pragma_bare_disable_suppresses_all(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import os
+
+        x = os.environ.get("KEYSTONE_FOO")  # lint: disable
+    """})
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# Baseline ratchet
+# ---------------------------------------------------------------------------
+
+def test_baseline_ratchet(tmp_path):
+    src_one = """
+        import os
+
+        a = os.environ.get("KEYSTONE_FOO")
+    """
+    src_two = src_one + "    b = os.environ.get(\"KEYSTONE_FOO\")\n"
+    baseline_path = str(tmp_path / "baseline.json")
+
+    # 1. baseline the single pre-existing finding
+    res = lint_tree(tmp_path, {"pkg/mod.py": src_one})
+    assert len(res.findings) == 1
+    save_baseline(baseline_path, res.findings)
+
+    # 2. unchanged tree: baselined finding passes
+    res = run_lint(str(tmp_path), ["pkg/mod.py"], baseline_path=baseline_path)
+    assert res.findings == [] and len(res.baselined) == 1
+
+    # 3. line drift must not churn the ratchet (fingerprints have no lines)
+    (tmp_path / "pkg" / "mod.py").write_text(
+        "# a new leading comment\n" + textwrap.dedent(src_one)
+    )
+    res = run_lint(str(tmp_path), ["pkg/mod.py"], baseline_path=baseline_path)
+    assert res.findings == [] and len(res.baselined) == 1
+
+    # 4. a second occurrence of the same fingerprint IS new -> fails
+    (tmp_path / "pkg" / "mod.py").write_text(textwrap.dedent(src_two))
+    res = run_lint(str(tmp_path), ["pkg/mod.py"], baseline_path=baseline_path)
+    assert len(res.findings) == 1 and len(res.baselined) == 1
+
+    # 5. fixing everything surfaces the stale entry (ratchet down)
+    (tmp_path / "pkg" / "mod.py").write_text("x = 1\n")
+    res = run_lint(str(tmp_path), ["pkg/mod.py"], baseline_path=baseline_path)
+    assert res.findings == [] and res.stale
+
+
+def test_baseline_roundtrip_format(tmp_path):
+    path = str(tmp_path / "b.json")
+    res = lint_tree(tmp_path, {"pkg/mod.py": """
+        import os
+
+        a = os.environ.get("KEYSTONE_FOO")
+    """})
+    save_baseline(path, res.findings)
+    data = json.load(open(path))
+    assert "findings" in data and all(
+        isinstance(v, int) for v in data["findings"].values()
+    )
+    assert load_baseline(path) == data["findings"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes_and_clickable_triple(tmp_path, capsys):
+    from keystone_tpu.analysis.cli import main as lint_main
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'import os\na = os.environ.get("KEYSTONE_FOO")\n'
+    )
+    # new finding -> exit 1, path:line:col: RULE message triple on stdout
+    rc = lint_main(["pkg", "--root", str(tmp_path), "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert any(
+        line.startswith(f"pkg{os.sep}mod.py:2:4: R4")
+        for line in out.splitlines()
+    ), out
+
+    # --update-baseline ratchets -> exit 0 afterwards
+    rc = lint_main(["pkg", "--root", str(tmp_path), "--update-baseline"])
+    assert rc == 0
+    rc = lint_main(["pkg", "--root", str(tmp_path)])
+    assert rc == 0
+    capsys.readouterr()
+
+    # json format carries the same data machine-readably
+    rc = lint_main(["pkg", "--root", str(tmp_path), "--no-baseline",
+                    "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and payload["total"] == 1
+
+
+def test_repo_lints_clean_against_committed_baseline():
+    """The acceptance invariant: the shipped tree has no findings beyond
+    its committed (empty-or-justified) baseline."""
+    res = run_lint(
+        REPO_ROOT, ["keystone_tpu", "bench.py", "scripts"],
+        baseline_path=os.path.join(REPO_ROOT, "lint_baseline.json"),
+    )
+    assert res.errors == []
+    assert res.findings == [], "\n".join(f.format() for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# Knob registry
+# ---------------------------------------------------------------------------
+
+def test_knobs_defaults_and_parsing(monkeypatch):
+    from keystone_tpu.utils import knobs
+
+    monkeypatch.delenv("KEYSTONE_OVERLAP", raising=False)
+    assert knobs.get("KEYSTONE_OVERLAP") is False
+    monkeypatch.setenv("KEYSTONE_OVERLAP", "1")
+    assert knobs.get("KEYSTONE_OVERLAP") is True
+    monkeypatch.setenv("KEYSTONE_OVERLAP", "maybe")
+    with pytest.raises(ValueError, match="KEYSTONE_OVERLAP"):
+        knobs.get("KEYSTONE_OVERLAP")
+
+    monkeypatch.setenv("KEYSTONE_CACHE_DEVICE_MB", "2048.0")
+    assert knobs.get("KEYSTONE_CACHE_DEVICE_MB") == 2048
+
+    # lenient knobs fall back instead of raising (pinned elsewhere too)
+    monkeypatch.setenv("KEYSTONE_PREFETCH", "junk")
+    assert knobs.get("KEYSTONE_PREFETCH", default=2) == 2
+
+    with pytest.raises(KeyError, match="not a declared knob"):
+        knobs.get("KEYSTONE_NOPE")
+
+
+def test_knobs_validators(monkeypatch):
+    from keystone_tpu.utils import knobs
+
+    monkeypatch.setenv("KEYSTONE_OVERLAP_TILES", "0,9")
+    with pytest.raises(ValueError, match="KEYSTONE_OVERLAP_TILES"):
+        knobs.get("KEYSTONE_OVERLAP_TILES")
+    # normalizing validator: reads yield the parsed tuple (one parse site)
+    monkeypatch.setenv("KEYSTONE_OVERLAP_TILES", "8,2")
+    assert knobs.get("KEYSTONE_OVERLAP_TILES") == (8, 2)
+    monkeypatch.setenv("KEYSTONE_OVERLAP_TILES", "4")
+    assert knobs.get("KEYSTONE_OVERLAP_TILES") == (4, None)
+
+    monkeypatch.setenv("KEYSTONE_FV_IMPL", "weird")  # lenient choice knob
+    assert knobs.get("KEYSTONE_FV_IMPL") == "auto"
+
+
+def test_knobs_validate_environment(monkeypatch):
+    from keystone_tpu.utils import knobs
+
+    knobs.validate_environment()  # clean env passes
+    monkeypatch.setenv("BENCH_MOMENTS", "yes")
+    with pytest.raises(ValueError, match="BENCH_MOMENTS"):
+        knobs.validate_environment()
+
+
+def test_knobs_readme_table_lists_every_knob():
+    from keystone_tpu.utils import knobs
+
+    table = knobs.readme_table()
+    for name in knobs.all_knobs():
+        assert f"`{name}`" in table
+    readme = open(os.path.join(REPO_ROOT, "README.md")).read()
+    for name in knobs.all_knobs():
+        assert name in readme, f"knob {name} missing from README"
+
+
+# ---------------------------------------------------------------------------
+# Runtime guard (KEYSTONE_GUARD)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def guard_registry():
+    from keystone_tpu.telemetry.registry import MetricsRegistry
+
+    return MetricsRegistry()
+
+
+def test_guard_chain_solver_smoke_zero_violations(guard_registry):
+    """Acceptance fixture: a warmed Chain + block-solver run under the
+    armed guard reports ZERO transfer and ZERO recompile violations —
+    the runtime verification of the R1/R2 static pass over the solver
+    hot paths."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.analysis.guard import guard, violations
+    from keystone_tpu.core.pipeline import Transformer
+    from keystone_tpu.learning import BlockLeastSquaresEstimator
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+    Y = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    pipe = Transformer.from_fn(lambda x: jnp.tanh(x)).then(
+        BlockLeastSquaresEstimator(block_size=8, num_iter=2, lam=0.5)
+    )
+
+    def run_once():
+        model = pipe.fit(X, Y)
+        preds = model(X)
+        jax.block_until_ready(preds)
+
+    run_once()  # warm: compile everything outside the guard
+    with guard(registry=guard_registry):
+        run_once()
+    v = violations(guard_registry)
+    assert v["guard.transfer"] == 0, guard_registry.as_dict()["counters"]
+    assert v["guard.recompile"] == 0, guard_registry.as_dict()["counters"]
+
+
+def test_guard_weighted_bcd_zero_transfers(guard_registry):
+    """The flagship weighted solver's fit loop is transfer-clean (this PR
+    removed 31 implicit per-fit uploads: lam/w scalars, eager zeros,
+    per-block slice starts, bucket tables, the eager bucket gather)."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.analysis.guard import guard, violations
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+    lab = ClassLabelIndicatorsFromIntLabels(3)(
+        jnp.asarray(rng.integers(0, 3, 64))
+    )
+    est = BlockWeightedLeastSquaresEstimator(8, 2, 0.1, 0.25)
+
+    def fit():
+        jax.block_until_ready(est.fit(X, lab).w)
+
+    fit()
+    with guard(registry=guard_registry):
+        fit()
+    assert violations(guard_registry)["guard.transfer"] == 0, \
+        guard_registry.as_dict()["counters"]
+
+
+def test_guard_counts_transfer_violation(guard_registry):
+    import jax.numpy as jnp
+
+    from keystone_tpu.analysis.guard import guard
+
+    x = jnp.arange(8.0)
+    with guard(registry=guard_registry):
+        # a numpy operand in an eager op is an implicit h2d upload every
+        # call (small-int constants can be cached; arrays are not)
+        jnp.add(x, np.arange(8.0, dtype=np.float32))
+    assert guard_registry.sum_counters("guard.transfer") >= 1
+
+
+def test_guard_counts_recompile(guard_registry):
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.analysis.guard import guard
+
+    x = jnp.arange(4.0)
+    with guard(registry=guard_registry):
+        # the R2 hazard shape: a fresh function object (and jit wrapper)
+        # per iteration defeats the executable cache — same name, same
+        # signature, compiled twice
+        for _ in range(2):
+            def body(a):
+                return a * 3.0
+
+            jax.jit(body)(x)
+    assert guard_registry.sum_counters("guard.recompile") >= 1
+
+
+def test_guard_disallow_mode_counts_and_raises(guard_registry):
+    import jax.numpy as jnp
+
+    from keystone_tpu.analysis.guard import guard
+
+    x = jnp.arange(8.0)
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with guard(registry=guard_registry, transfer_mode="disallow"):
+            float(x[3])
+    assert guard_registry.sum_counters("guard.transfer") >= 1
+
+
+def test_maybe_guard_is_opt_in(monkeypatch, guard_registry):
+    import contextlib
+
+    from keystone_tpu.analysis import guard as guard_mod
+
+    monkeypatch.delenv("KEYSTONE_GUARD", raising=False)
+    ctx = guard_mod.maybe_guard()
+    assert isinstance(ctx, contextlib.nullcontext)
+    monkeypatch.setenv("KEYSTONE_GUARD", "1")
+    ctx = guard_mod.maybe_guard(registry=guard_registry)
+    with ctx:
+        pass  # arms and disarms cleanly
