@@ -176,37 +176,100 @@ def solver_gflops(n: int = None, d: int = None, c: int = 10, block: int = None,
     return flops / dt / 1e9
 
 
-def _try_solver_gflops(precision=None, overlap: bool = False):
-    """Secondary metric; never let it block the primary JSON line. One retry
-    absorbs transient timing noise (dt<=0 on a contended chip); genuine
-    failures (e.g. the NaN guard) are logged to stderr before retrying so
-    they are distinguishable from noise in the driver log."""
+def sketch_gflops(n: int = None, d: int = None, c: int = 10,
+                  overlap: bool = False) -> float:
+    """Sketch-and-precondition solver GFLOPs/chip — the randomized rung of
+    the ladder (``linalg/sketch.py``) at the same flagship shape as the
+    exact BCD rung, so the two rows compare directly. ``tol=0`` pins the
+    CG to exactly ``cg_iters`` iterations (fixed, countable work); FLOPs
+    are the solver's analytic phase formulas (sketch pass + m·d² QR +
+    per-iteration matvec pair). Same latency-cancelled timing scheme as
+    :func:`solver_gflops`."""
+    from keystone_tpu.linalg.sketch import sketch_rows, sketched_lstsq_solve
+
+    n = n or (4096 if _SMOKE else 60000)
+    d = d or (512 if _SMOKE else 2048)
+    cg_iters = 2 if _SMOKE else 8
+    iters = 2 if _SMOKE else 8
+
+    key = jax.random.key(0)
+    A = jax.random.normal(key, (n, d), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (n, c), jnp.float32)
+    float(A[0, 0])  # materialize inputs
+
+    def timed(k: int) -> float:
+        ws = [sketched_lstsq_solve(A, b, lam=1.0 + i, tol=0.0,
+                                   max_iters=cg_iters, overlap=overlap)
+              for i in range(k)]
+        float(ws[-1][0, 0])  # warm compile + drain the whole warm-up chain
+        t0 = time.perf_counter()
+        ws = [sketched_lstsq_solve(A, b, lam=2.0 + i, tol=0.0,
+                                   max_iters=cg_iters, overlap=overlap)
+              for i in range(k)]
+        w_last = float(ws[-1][0, 0])  # one transfer after the chain
+        if w_last != w_last:
+            raise FloatingPointError("sketched solver produced NaN")
+        return time.perf_counter() - t0
+
+    dt = (timed(1 + iters) - timed(1)) / iters
+    if dt <= 0:
+        raise RuntimeError(f"non-positive sketch timing difference: {dt}")
+    m = sketch_rows(n, d)
+    flops = (n * (d + c) + 2.0 * (m + d) * d * d
+             + cg_iters * (4.0 * n * d * c + 2.0 * d * d * c))
+    return flops / dt / 1e9
+
+
+def _try_metric(name: str, fn):
+    """Retry-once wrapper shared by the ladder cells; never let a secondary
+    metric block the primary JSON line. One retry absorbs transient timing
+    noise (dt<=0 on a contended chip); genuine failures (e.g. the NaN
+    guard) are logged to stderr before retrying so they are distinguishable
+    from noise in the driver log."""
     for attempt in range(2):
         try:
-            return round(solver_gflops(precision=precision, overlap=overlap), 1)
+            return round(fn(), 1)
         except Exception as e:
             print(
-                f"solver_gflops(precision={precision}, overlap={overlap}) "
-                f"attempt {attempt + 1} "
+                f"{name} attempt {attempt + 1} "
                 f"failed: {type(e).__name__}: {e}",
                 file=sys.stderr,
             )
     return None
 
 
+def _try_solver_gflops(precision=None, overlap: bool = False):
+    return _try_metric(
+        f"solver_gflops(precision={precision}, overlap={overlap})",
+        lambda: solver_gflops(precision=precision, overlap=overlap),
+    )
+
+
 def _try_solver_gflops_ladder() -> dict:
-    """The solver-precision ladder in ONE place: GFLOPs/chip for the
-    ``"high"`` (bf16x3, the framework default) and ``"highest"`` (6-pass
-    ≈ f32) MXU modes, each with the overlap knob off and on — four cells
-    from one parameterized helper instead of duplicated call sites. The
-    ``"highest"`` column rides the BENCH_EXTRAS gate (it doubles the
-    ladder's device time); the overlap column is cheap on a single chip
-    (same program after fallback) and documents the on/off pair whenever a
-    mesh is present."""
+    """The solver ladder in ONE place: GFLOPs/chip for the ``"high"``
+    (bf16x3, the framework default) and ``"highest"`` (6-pass ≈ f32) MXU
+    modes of the exact BCD rung, plus the randomized sketch rung — each
+    with the overlap knob off and on. The ``"highest"`` column rides the
+    BENCH_EXTRAS gate (it doubles the ladder's device time); the overlap
+    columns are cheap on a single chip (same program after fallback) and
+    document the on/off pairs whenever a mesh is present.
+
+    Since the sketch rung landed this runs as a budget-derated SUBPROCESS
+    regime (``scripts/bench_regime.py solver_ladder``): in-process it was
+    the one heavy section with no enforceable timeout — the rc=124 hole
+    run 5 fell into."""
     rows = {
         "solver_gflops_per_chip": _try_solver_gflops("high"),
         "solver_gflops_per_chip_overlap": _try_solver_gflops(
             "high", overlap=True
+        ),
+        # the randomized rung (linalg/sketch.py): same shape, sub-quadratic
+        # work — the d≳65536 regime's escape from the exact grams
+        "sketch_gflops_per_chip": _try_metric(
+            "sketch_gflops", lambda: sketch_gflops()
+        ),
+        "sketch_gflops_per_chip_overlap": _try_metric(
+            "sketch_gflops(overlap)", lambda: sketch_gflops(overlap=True)
         ),
     }
     if knobs.get("BENCH_EXTRAS"):
@@ -284,13 +347,24 @@ def _warm_stats(fn, reps: int = None):
 def _try_extras():
     """Secondary whole-pipeline wall-clocks (warm median of WARM_REPS, with
     min/max spread), never fatal. Disable with BENCH_EXTRAS=0 to keep the
-    run to the primary metric only."""
+    run to the primary metric only.
+
+    Budget-enforced per PIPELINE, not just at section entry: six pipelines
+    run here back to back, so a single entry gate could admit the section
+    with 61 s left and then run for minutes past the driver's kill — the
+    same hole class as the old in-process ladder. Each pipeline re-checks
+    the remaining budget and the rest skip with explicit markers."""
     if not knobs.get("BENCH_EXTRAS"):
         return {}
     import importlib
 
     extras = {}
     for key, module, config_name, kwargs in _EXTRA_PIPELINES:
+        if _budget_remaining() - _FINALIZE_RESERVE_S < _SECTION_FLOOR_S:
+            extras[key] = None
+            extras[key + "_skipped"] = "budget"
+            print(f"extras[{key}] skipped: budget exhausted", file=sys.stderr)
+            continue
         try:
             mod = importlib.import_module(module)
             cfg = getattr(mod, config_name)(**kwargs)
@@ -1032,16 +1106,31 @@ def main():
     # in the same trail as a perf regression.
     out.update(_try_lint_rows())
     _flush(out, "lint")
-    if _budget_remaining() - _FINALIZE_RESERVE_S < _SECTION_FLOOR_S:
-        # a cache-cold primary compile can eat most of the budget; the
-        # ladder times dozens of flagship-shape solves and gets the same
-        # skip-with-marker treatment as every other post-primary section
-        out["solver_gflops_skipped"] = "budget"
-        print("bench section solver_gflops skipped: budget exhausted",
-              file=sys.stderr)
-    else:
-        out.update(_try_solver_gflops_ladder())
+    # Solver GFLOPs ladder (exact BCD + randomized sketch rungs, overlap
+    # on/off): a budget-derated SUBPROCESS regime since the sketch rung
+    # landed. In-process it was the one heavy section whose runtime the
+    # budget could not bound — the gate only checked the entry floor, so a
+    # ladder that outran the remaining budget ate the driver's timeout
+    # (run 5's rc=124). As a subprocess it inherits the same derated
+    # timeout/skip treatment as every other big regime.
+    out.update(
+        _run_regime_subprocess(
+            "solver_ladder", fail_key="solver_gflops_per_chip"
+        )
+    )
     _flush(out, "solver_gflops")
+    # Sketch-vs-exact equal-test-error comparison (the acceptance row for
+    # the randomized rung): configured at d=65536, derated to what the
+    # backend's memory can actually hold (the artifact records the actual
+    # d); subprocess + derated timeout like every big regime.
+    if knobs.get("BENCH_SKETCH"):
+        out.update(
+            _run_regime_subprocess(
+                "sketch_compare",
+                fail_key="sketch_vs_exact_error_delta_d65536",
+            )
+        )
+        _flush(out, "sketch_compare")
     # Topology-aware overlap ladder (scripts/bench_regime.py solver_overlap):
     # tsqr_overlap_{on,off}_gflops + bcd_model_overlap_{on,off}_gflops in a
     # fresh process, timeout derated from the remaining budget like every
@@ -1184,6 +1273,11 @@ _COMPACT_KEYS = (
     # flagship stage attribution (GFLOPs where a formula exists, else s)
     ("g_solver", "solver_gflops_per_chip"),
     ("g_solver_ov", "solver_gflops_per_chip_overlap"),
+    # randomized sketch rung (linalg/sketch.py) + equal-test-error delta
+    # vs the exact rung (configured d=65536; actual d in bench_full.json)
+    ("g_sketch", "sketch_gflops_per_chip"),
+    ("g_sketch_ov", "sketch_gflops_per_chip_overlap"),
+    ("sk_err_d", "sketch_vs_exact_error_delta_d65536"),
     # topology-aware overlap ladder (scripts/bench_regime.py solver_overlap)
     ("g_tsqr", "tsqr_overlap_off_gflops"),
     ("g_tsqr_ov", "tsqr_overlap_on_gflops"),

@@ -126,7 +126,10 @@ def _resolve_str_literal(
             for kw, default in zip(args.kwonlyargs, args.kw_defaults):
                 if kw.arg == name and default is not None:
                     return _resolve_str_literal(default, scope, depth - 1)
-        for stmt in getattr(scope, "body", []):
+        # `body` is a statement LIST only on def/module/block nodes; on
+        # Lambda/IfExp it is a single expression — iterating that raises
+        body = getattr(scope, "body", None)
+        for stmt in (body if isinstance(body, list) else ()):
             if isinstance(stmt, ast.Assign):
                 for tgt in stmt.targets:
                     if isinstance(tgt, ast.Name) and tgt.id == name:
@@ -151,7 +154,8 @@ def _collect_axis_literals(
             else:
                 # spec variables: follow one assignment hop and scan it
                 for scope in [at] + list(ancestors(at)):
-                    for stmt in getattr(scope, "body", []):
+                    body = getattr(scope, "body", None)
+                    for stmt in (body if isinstance(body, list) else ()):
                         if isinstance(stmt, ast.Assign) and any(
                             isinstance(t, ast.Name) and t.id == sub.id
                             for t in stmt.targets

@@ -542,10 +542,19 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     def _run(self, get_block, num_blocks: int, labels, mask, precision: str,
              checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
              block_group=None, _force_dense: bool = False,
-             model_overlap: bool = False):
+             model_overlap: bool = False, block_order=None):
         """Shared weighted-BCD loop. ``get_block(b)`` returns the
         (n, block_size) feature block in original row order — no global
         class sort exists anywhere (see ``_prepare``).
+
+        ``block_order`` (optional list of block ids) is the per-pass visit
+        order — the sketch tier's leverage schedule (``linalg/sketch.py``;
+        see :meth:`fit`). The checkpoint cursor is a linear schedule
+        POSITION (not the (iter, block) tuple compare, which only orders
+        correctly for the sequential schedule); the order itself rides in
+        the checkpoint and a resume under a different order fails loudly —
+        silently interleaving two visit orders would corrupt the
+        Gauss–Seidel pass.
 
         Blocks are consumed through a double-buffered prefetch
         (``core.prefetch.prefetch_map``): while the device chews on block
@@ -611,7 +620,16 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         pop_stats_cache: list = [None] * num_blocks
         joint_means_blocks: list = [None] * num_blocks
 
-        start_iter = start_block = 0
+        order = (
+            [int(x) for x in block_order] if block_order is not None
+            else list(range(num_blocks))
+        )
+        if sorted(order) != list(range(num_blocks)):
+            raise ValueError(
+                f"block_order must be a permutation of range({num_blocks}): "
+                f"{order}"
+            )
+        start_pos = 0
         if checkpoint_path and jax.process_count() > 1:
             # fail loudly on a non-shared path: if controllers disagree on
             # whether the checkpoint exists, some would resume mid-cursor
@@ -650,7 +668,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     get_block, num_blocks, labels, mask, precision,
                     checkpoint_path, checkpoint_every,
                     block_group=block_group, _force_dense=True,
-                    model_overlap=model_overlap,
+                    model_overlap=model_overlap, block_order=block_order,
                 )
             # restore the guard's evidence for already-completed blocks —
             # without this a resumed fit under-reports max cond and the
@@ -679,9 +697,25 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 )
                 for e in state["pop_stats_cache"]
             ]
-            start_iter, start_block = state["iter"], state["block"]
+            saved_order = state.get("block_order")
+            if saved_order is None:
+                # legacy (pre-schedule) checkpoint: written sequentially
+                saved_order = list(range(num_blocks))
+            if [int(x) for x in saved_order] != order:
+                raise ValueError(
+                    f"checkpoint {checkpoint_path} was written under block "
+                    f"order {list(saved_order)}, not {order} — resuming a "
+                    "fit under a different visit schedule would corrupt "
+                    "the pass (re-fit, or restore the original "
+                    "KEYSTONE_SOLVER / block-order setting)"
+                )
+            if "pos" in state:
+                start_pos = int(state["pos"])
+            else:
+                # legacy cursor: (iter, next_block) under sequential order
+                start_pos = state["iter"] * num_blocks + state["block"]
 
-        def _save_checkpoint(it: int, next_b: int) -> None:
+        def _save_checkpoint(it: int, b: int, next_pos: int) -> None:
             from keystone_tpu.core.checkpoint import save_node
 
             # R is row-sharded: under a process group each controller
@@ -707,7 +741,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     "models": models,
                     "joint_means_blocks": joint_means_blocks,
                     "pop_stats_cache": pop_stats_cache,
-                    "iter": it, "block": next_b,
+                    "iter": it, "block": b, "pos": next_pos,
+                    "block_order": list(order),
                     "num_blocks": num_blocks, "num_iter": self.num_iter,
                     # solve-path marker + the conditioning evidence so far:
                     # resume must neither mix solve paths nor lose the
@@ -765,12 +800,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         # block, not its compute — attribution moves into the overlap.
         from keystone_tpu.core.prefetch import prefetch_map
 
-        schedule = [
-            (it, b)
-            for it in range(self.num_iter)
-            for b in range(num_blocks)
-            if (it, b) >= (start_iter, start_block)
+        pairs = [
+            (it, b) for it in range(self.num_iter) for b in order
         ]
+        schedule = pairs[start_pos:]
         gate = None
         if block_group is not None:
             def gate(prev_ib, next_ib):
@@ -782,7 +815,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
         _n_rows = R.shape[0]
         _res_norms: list = []  # device scalars; synced ONCE after the loop
-        for it, b in schedule:
+        for pos, (it, b) in enumerate(schedule, start=start_pos):
             with _phase("featurize"):
                 Xb = next(block_feed)
             if pop_stats_cache[b] is None:
@@ -861,9 +894,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             if (
                 checkpoint_path
                 and checkpoint_every > 0
-                and (it * num_blocks + b + 1) % checkpoint_every == 0
+                and (pos + 1) % checkpoint_every == 0
             ):
-                _save_checkpoint(it, b + 1)
+                _save_checkpoint(it, b, pos + 1)
 
         if _res_norms:
             # one host sync for the whole trajectory (traced runs only)
@@ -915,7 +948,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                         get_block, num_blocks, labels, mask, precision,
                         checkpoint_path, checkpoint_every,
                         block_group=block_group, _force_dense=True,
-                        model_overlap=model_overlap,
+                        model_overlap=model_overlap, block_order=block_order,
                     )
 
         W = jnp.concatenate(models, axis=0)
@@ -949,6 +982,26 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         model_overlap = model_overlap_spec(
             data, overlap_mesh(self.overlap), self.block_size
         )
+        # Sketch tier (KEYSTONE_SOLVER=sketch): visit blocks in descending
+        # sketched column energy (linalg/sketch.py — one CountSketch + small
+        # QR over the ORIGINAL columns, before padding) so early passes land
+        # on the blocks carrying the spectrum. One once-per-fit host sync of
+        # the (num_blocks,) order — the _class_buckets class of setup cost.
+        # Streaming fits stay sequential: leverage needs a full pass over
+        # the features, which the out-of-core path exists to avoid.
+        from keystone_tpu.linalg.sketch import (
+            leverage_block_order,
+            resolve_solver_tier,
+        )
+
+        block_order = None
+        num_blocks_pre = -(-d // self.block_size)
+        if resolve_solver_tier() == "sketch" and num_blocks_pre > 1:
+            block_order = [
+                int(x) for x in np.asarray(
+                    leverage_block_order(data, self.block_size, mask=mask)
+                )
+            ]
         d_pad = -(-d // self.block_size) * self.block_size
         if d_pad != d:
             data = jnp.pad(data, ((0, 0), (0, d_pad - d)))
@@ -962,7 +1015,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         W, joint_means, joint_label_mean = self._run(
             get_block, num_blocks, labels, mask, precision,
-            model_overlap=model_overlap,
+            model_overlap=model_overlap, block_order=block_order,
         )
         W = W[:d]
         joint_means = joint_means[:, :d]

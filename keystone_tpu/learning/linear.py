@@ -46,20 +46,38 @@ class LinearMapper(Transformer):
 
 
 class LinearMapEstimator(LabelEstimator):
-    """OLS (optionally ridge) via normal equations or TSQR.
+    """OLS (optionally ridge) via normal equations, TSQR, or the randomized
+    sketch tier.
 
     Reference: ``LinearMapper.scala:63-99``. ``solver="tsqr"`` uses the
     communication-optimal TSQR path for better conditioning (the upstream
-    ml-matrix TSQR solver named in BASELINE.md's north star).
+    ml-matrix TSQR solver named in BASELINE.md's north star);
+    ``solver="sketch"`` the sketch-and-precondition rung
+    (``linalg/sketch.py`` — sub-quadratic in d, iterated to
+    ``KEYSTONE_SKETCH_TOL``). The exact solvers additionally honor the
+    ``KEYSTONE_SOLVER=sketch`` tier knob, so a whole pipeline can be moved
+    onto the randomized rung without touching call sites.
     """
 
     def __init__(self, lam: Optional[float] = None, solver: str = "normal"):
+        if solver not in ("normal", "tsqr", "sketch"):
+            raise ValueError(f"solver must be normal|tsqr|sketch: {solver!r}")
         self.lam = lam
         self.solver = solver
 
     def fit(self, data, labels, mask: Optional[jax.Array] = None) -> LinearMapper:
+        from keystone_tpu.linalg.sketch import (
+            resolve_solver_tier,
+            sketched_lstsq_solve,
+        )
+
         A, B, feature_scaler, label_scaler, mask = center_for_solve(data, labels, mask)
-        if self.solver == "tsqr":
+        solver = self.solver
+        if solver != "sketch" and resolve_solver_tier() == "sketch":
+            solver = "sketch"
+        if solver == "sketch":
+            w = sketched_lstsq_solve(A, B, self.lam or 0.0, mask=mask)
+        elif solver == "tsqr":
             w = tsqr_solve(A, B, self.lam or 0.0, mask=mask)
         else:
             w = normal_equations_solve(A, B, self.lam, mask=mask)

@@ -8,9 +8,16 @@ from keystone_tpu.linalg.solvers import (
     tsqr_solve,
 )
 from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+from keystone_tpu.linalg.sketch import (
+    leverage_block_order,
+    sketch_matrix,
+    sketch_rows,
+    sketched_lstsq_solve,
+)
 from keystone_tpu.linalg.distributed import (
     BlockCoordinateDescent,
     NormalEquations,
     RowShardedMatrix,
+    SketchedLeastSquares,
     TSQR,
 )

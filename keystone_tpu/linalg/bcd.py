@@ -35,6 +35,24 @@ import jax.numpy as jnp
 from keystone_tpu.linalg.solvers import get_solver_precision, hdot, spd_solve
 
 
+def resolve_block_schedule(block_schedule: Optional[str] = None) -> str:
+    """The block visit schedule to run: per-call value beats the
+    ``KEYSTONE_SKETCH_BCD`` knob (default sequential). One resolver shared
+    with the solver classes so a lambda sweep can decide ONCE whether a
+    leverage order is needed."""
+    from keystone_tpu.utils import knobs
+
+    if block_schedule is None:
+        block_schedule = (
+            "leverage" if knobs.get("KEYSTONE_SKETCH_BCD") else "sequential"
+        )
+    if block_schedule not in ("sequential", "leverage"):
+        raise ValueError(
+            f"block_schedule must be sequential|leverage: {block_schedule!r}"
+        )
+    return block_schedule
+
+
 def block_coordinate_descent_l2(
     A: jax.Array,
     b: jax.Array,
@@ -47,9 +65,25 @@ def block_coordinate_descent_l2(
     donate: bool = False,
     overlap: Optional[bool] = None,
     telemetry: Optional[bool] = None,
+    block_schedule: Optional[str] = None,
+    block_order: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Public entry: resolves the solver precision once (a static jit arg,
     so changing the global never serves a stale compile) and dispatches.
+
+    ``block_schedule`` (None = the ``KEYSTONE_SKETCH_BCD`` knob):
+    ``"sequential"`` visits feature blocks in index order (the reference's
+    Gauss–Seidel pass); ``"leverage"`` visits them in descending sketched
+    column energy (``linalg/sketch.py::leverage_block_order`` — one
+    CountSketch + small QR, stays on device), so early updates land on the
+    blocks carrying the spectrum. At convergence both schedules reach the
+    same ridge solution; single-pass results differ by the usual
+    Gauss–Seidel order dependence, which is why sequential stays the
+    default. The visit order is a traced operand — a data-dependent order
+    never triggers a recompile. ``block_order`` (a precomputed (num_blocks,)
+    int32 device array) bypasses the per-call sketch entirely — the lambda
+    sweep in ``linalg/distributed.py`` computes the order ONCE and shares
+    it, instead of re-sketching identical data per lambda.
 
     ``telemetry`` (None = the ``KEYSTONE_TELEMETRY`` tracing knob) compiles
     the per-block residual Frobenius norm into the scan as an extra output
@@ -96,6 +130,11 @@ def block_coordinate_descent_l2(
     omesh = overlap_mesh(overlap)
     model_overlap = model_overlap_spec(A, omesh, block_size)
     trace_on = _telemetry.tracing_enabled(telemetry)
+    block_schedule = resolve_block_schedule(block_schedule)
+    if block_order is None and block_schedule == "leverage":
+        from keystone_tpu.linalg.sketch import leverage_block_order
+
+        block_order = leverage_block_order(A, block_size, mask=mask)
 
     n, d = A.shape
     c = b.shape[1] if b.ndim == 2 else 1
@@ -128,6 +167,7 @@ def block_coordinate_descent_l2(
             return fn(
                 A, b, lam, block_size, num_iter, mask, cache_grams,
                 precision, omesh, model_overlap, with_residuals=trace_on,
+                block_order=block_order,
             )
 
     if not trace_on:
@@ -163,8 +203,13 @@ def _bcd_l2_impl(
     omesh=None,
     model_overlap: bool = False,
     with_residuals: bool = False,
+    block_order: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
+
+    ``block_order`` (traced (num_blocks,) int32, or None for sequential) is
+    the per-pass block visit order — the leverage schedule's permutation
+    rides into the scan as data, so a new order never recompiles.
 
     Masked (padding) rows must be zeroed via ``mask``; the feature dim is
     padded internally to a multiple of ``block_size`` (padded columns get a
@@ -251,7 +296,9 @@ def _bcd_l2_impl(
         out = jnp.linalg.norm(R) if with_residuals else None
         return (W, R), out
 
-    schedule = jnp.tile(jnp.arange(num_blocks), num_iter)
+    if block_order is None:
+        block_order = jnp.arange(num_blocks)
+    schedule = jnp.tile(block_order, num_iter)
     (W, _), res = jax.lax.scan(block_step, (W0, b), schedule)
     if with_residuals:
         return W[:d], res

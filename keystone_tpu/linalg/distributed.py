@@ -38,6 +38,12 @@ import flax.struct as struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+from keystone_tpu.linalg.sketch import (
+    resolve_solver_tier,
+    sketch_matrix,
+    sketch_rows,
+    sketched_lstsq_solve,
+)
 from keystone_tpu.linalg.solvers import hdot, normal_equations_solve, tsqr_r, tsqr_solve
 
 
@@ -162,6 +168,35 @@ class RowShardedMatrix(struct.PyTreeNode):
 
         return tsqr_r(self._masked(), mesh or get_mesh(), overlap=overlap)
 
+    def sketch(
+        self,
+        rows: Optional[int] = None,
+        seed: int = 0,
+        kind: Optional[str] = None,
+        mesh: Optional[Mesh] = None,
+        overlap: Optional[bool] = None,
+    ) -> jax.Array:
+        """Replicated randomized sketch ``S·X`` (rows ≈ c·d by default —
+        ``KEYSTONE_SKETCH_FACTOR``): the row-compressed stand-in for X that
+        the randomized solver tier QRs (``linalg/sketch.py``). ``overlap``
+        (None = the ``KEYSTONE_OVERLAP`` knob) rides the CountSketch
+        reduction on the tiled reduce-scatter schedule."""
+        from keystone_tpu.linalg.sketch import resolve_sketch_kind
+        from keystone_tpu.parallel.mesh import get_mesh
+        from keystone_tpu.parallel.overlap import mesh_tiers, overlap_mesh
+
+        mesh = mesh or get_mesh()
+        X = self._masked()
+        k = mesh.shape.get("data", 1)
+        m = rows or sketch_rows(X.shape[0], X.shape[1], k=max(k, 1))
+        omesh = overlap_mesh(overlap, mesh)
+        tiers = mesh_tiers(mesh, "data") if omesh is not None else None
+        SA, _ = sketch_matrix(
+            X, m, seed, kind=resolve_sketch_kind(kind), mesh=mesh,
+            omesh=omesh, tiers=tiers,
+        )
+        return SA
+
     def collect(self) -> np.ndarray:
         """Valid rows as one host array (the reference's ``collect()``;
         use sparingly — everything above runs without leaving the mesh)."""
@@ -219,13 +254,50 @@ class NormalEquations:
 
 class TSQR:
     """The upstream ml-matrix TSQR solver (BASELINE.json north star): QR tree
-    over the ``data`` axis, O(κ(A)) where normal equations are O(κ²)."""
+    over the ``data`` axis, O(κ(A)) where normal equations are O(κ²).
+
+    ``solver`` (None = the ``KEYSTONE_SOLVER`` knob) picks the tier:
+    ``"sketch"`` replaces the exact QR tree with the sketch-and-precondition
+    solve (``linalg/sketch.py``) — same (d, c) replicated contract, iterated
+    to ``KEYSTONE_SKETCH_TOL`` instead of exact, sub-quadratic in d."""
+
+    def solve_least_squares(
+        self, A, b, lam: float = 0.0, overlap: Optional[bool] = None,
+        solver: Optional[str] = None,
+    ) -> jax.Array:
+        A, b, mask = _solver_args(A, b)
+        if resolve_solver_tier(solver) == "sketch":
+            return sketched_lstsq_solve(A, b, lam=lam, mask=mask, overlap=overlap)
+        return tsqr_solve(A, b, lam=lam, mask=mask, overlap=overlap)
+
+
+class SketchedLeastSquares:
+    """The randomized rung of the solver ladder as a first-class solver
+    class (the ``NormalEquations``/``TSQR`` shape): CountSketch/SRHT row
+    compression → one small replicated QR → R-preconditioned CG on the full
+    row-sharded system (``linalg/sketch.py``; "Panther", PAPERS.md). Same
+    call-site contract as the exact classes."""
+
+    def __init__(self, kind: Optional[str] = None,
+                 factor: Optional[float] = None,
+                 tol: Optional[float] = None,
+                 max_iters: Optional[int] = None):
+        self.kind = kind
+        self.factor = factor
+        self.tol = tol
+        self.max_iters = max_iters
 
     def solve_least_squares(
         self, A, b, lam: float = 0.0, overlap: Optional[bool] = None
     ) -> jax.Array:
         A, b, mask = _solver_args(A, b)
-        return tsqr_solve(A, b, lam=lam, mask=mask, overlap=overlap)
+        return sketched_lstsq_solve(
+            A, b, lam=lam, mask=mask, overlap=overlap, kind=self.kind,
+            factor=self.factor, tol=self.tol, max_iters=self.max_iters,
+        )
+
+    def solve_least_squares_with_l2(self, A, b, lam: float) -> jax.Array:
+        return self.solve_least_squares(A, b, lam=lam)
 
 
 class BlockCoordinateDescent:
@@ -237,6 +309,14 @@ class BlockCoordinateDescent:
     lives in one (optionally column-sharded) array and the block loop is a
     ``lax.scan`` (``linalg/bcd.py``); multiple lambdas map over the same
     compiled program.
+
+    ``solver`` (None = the ``KEYSTONE_SOLVER`` knob): the ``"sketch"`` tier
+    solves the SAME ridge problem the block passes converge to, via
+    sketch-and-precondition (``linalg/sketch.py``) — ``num_iter`` and
+    ``block_size`` become irrelevant there (no block loop exists; the
+    iteration count is the CG's, governed by ``KEYSTONE_SKETCH_TOL``).
+    On the exact tier, ``block_schedule`` forwards to the leverage-ordered
+    visit sequence (``linalg/bcd.py``).
     """
 
     def solve_least_squares_with_l2(
@@ -247,17 +327,31 @@ class BlockCoordinateDescent:
         num_iter: int = 1,
         block_size: int = 2048,
         overlap: Optional[bool] = None,
+        solver: Optional[str] = None,
+        block_schedule: Optional[str] = None,
     ) -> Union[jax.Array, list[jax.Array]]:
+        from keystone_tpu.linalg.bcd import resolve_block_schedule
+        from keystone_tpu.linalg.sketch import leverage_block_order
+
         A, b, mask = _solver_args(A, b)
+        if resolve_solver_tier(solver) == "sketch":
+            def solve(l):
+                return sketched_lstsq_solve(
+                    A, b, lam=float(l), mask=mask, overlap=overlap
+                )
+        else:
+            # leverage order depends only on (A, mask): computed ONCE and
+            # shared across a lambda sweep instead of re-sketching per l
+            order = None
+            if resolve_block_schedule(block_schedule) == "leverage":
+                order = leverage_block_order(A, block_size, mask=mask)
+
+            def solve(l):
+                return block_coordinate_descent_l2(
+                    A, b, float(l), block_size, num_iter, mask=mask,
+                    overlap=overlap, block_schedule=block_schedule,
+                    block_order=order,
+                )
         if np.ndim(lams) == 0:
-            return block_coordinate_descent_l2(
-                A, b, float(lams), block_size, num_iter, mask=mask,
-                overlap=overlap,
-            )
-        return [
-            block_coordinate_descent_l2(
-                A, b, float(l), block_size, num_iter, mask=mask,
-                overlap=overlap,
-            )
-            for l in lams
-        ]
+            return solve(lams)
+        return [solve(l) for l in lams]

@@ -212,15 +212,22 @@ def tsqr_r(
     zero bulk collectives. Same ``RᵀR`` (row signs may differ — QR's sign
     freedom; both conventions satisfy the contract).
     """
-    from keystone_tpu.parallel.overlap import overlap_mesh, ring_tsqr_fold
+    from keystone_tpu.parallel.overlap import (
+        mesh_tiers,
+        overlap_mesh,
+        ring_tsqr_fold,
+    )
 
     d = A.shape[1]
     use_ring = overlap_mesh(overlap, mesh) is not None
+    # tier-aware fold order on multi-slice meshes: within-slice factors
+    # fold over ICI first, only the per-slice results ring over DCN
+    tiers = mesh_tiers(mesh, "data") if use_ring else None
 
     def local(Ai):
         Ri = jnp.linalg.qr(Ai, mode="r")
         if use_ring:
-            R, _ = ring_tsqr_fold(Ri, None, "data")
+            R, _ = ring_tsqr_fold(Ri, None, "data", tiers=tiers)
             # Canonicalize row signs (diag >= 0): devices fold the same
             # factors in different ring orders, so without this each shard
             # of the 'replicated' output could carry its own QR sign
@@ -242,11 +249,12 @@ def tsqr_r(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "ridge", "precision", "overlap")
+    jax.jit,
+    static_argnames=("mesh", "ridge", "precision", "overlap", "tiers"),
 )
 def _tsqr_solve(
     A, b, lam, mask, mesh: Mesh, ridge: bool, precision: str = "highest",
-    overlap: bool = False,
+    overlap: bool = False, tiers=None,
 ):
     A, b = _apply_mask(A, b, mask)
     d = A.shape[1]
@@ -259,9 +267,10 @@ def _tsqr_solve(
             # (R_i, Z_i) pairs circulate via paired ppermutes and fold into
             # an incremental second-level panel QR — Qᵀb rides through the
             # fold, so the bulk all_gather AND the trailing psum both vanish
+            # (tier-aware on multi-slice meshes: slice results only on DCN)
             from keystone_tpu.parallel.overlap import ring_tsqr_fold
 
-            return ring_tsqr_fold(Ri, Zi, "data", precision)
+            return ring_tsqr_fold(Ri, Zi, "data", precision, tiers=tiers)
         Rs = jax.lax.all_gather(Ri, "data")  # (k, d, d) over ICI
         Q2, R2 = jnp.linalg.qr(Rs.reshape(-1, d), mode="reduced")
         i = jax.lax.axis_index("data")
@@ -314,6 +323,16 @@ def tsqr_solve(
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
     use_ring = overlap_mesh(overlap, mesh) is not None
+    # tier map resolved HERE (eager, per call) and threaded through jit as
+    # a static argument — read inside the jit body it would bake the first
+    # call's KEYSTONE_MESH_TIERS into the cached program (the precision-
+    # knob staleness class this module's docstring bans)
+    if use_ring:
+        from keystone_tpu.parallel.overlap import mesh_tiers
+
+        tiers = mesh_tiers(mesh, "data")
+    else:
+        tiers = None
     n, d = A.shape
     c = b.shape[1] if b.ndim == 2 else 1
     reg = telemetry.get_registry()
@@ -327,6 +346,6 @@ def tsqr_solve(
         return sp.track(
             _tsqr_solve(
                 A, b, jnp.float32(lam), mask, mesh, lam > 0.0,
-                get_solver_precision(), overlap=use_ring,
+                get_solver_precision(), overlap=use_ring, tiers=tiers,
             )
         )

@@ -413,21 +413,10 @@ def tiled_psum_dot(
             reason="trivial_axis" if k <= 1 else "no_tiling",
         )
         return jax.lax.psum(hdot(a, b, precision), axis)
-    outer, inner = tiers or (1, k)
-    if outer > 1 and outer * inner != k:
-        # a tier map probed from a different axis (or hand-tuned wrong)
-        # must not silently run single-tier — the operator would believe
-        # the DCN schedule is active
-        _log_fallback(
-            "tiled_psum_dot",
-            f"tiers {tiers} do not factor the '{axis}' axis size {k}",
-        )
-        outer, inner = 1, k
-    if outer <= 1:
-        outer, inner = 1, k
+    # a tier map probed from a different axis (or hand-tuned wrong) must
+    # not silently run single-tier — _resolve_tiers logs the degradation
+    outer, inner = _resolve_tiers(tiers, k, "tiled_psum_dot")
     tb = m // T
-    pb = tb // k
-    c = b.shape[1]
     partials = [
         hdot(a[t * tb : (t + 1) * tb], b, precision) for t in range(T)
     ]
@@ -438,6 +427,77 @@ def tiled_psum_dot(
         schedule="two_tier" if outer > 1 else "single_tier",
     )
     _reg().observe("overlap.tiles", T, site="tiled_psum_dot")
+    return _reduce_tiled_partials(partials, axis, k, outer, inner, outer_tiles)
+
+
+def tiled_psum(
+    x: jax.Array,
+    axis: str,
+    tiles: Optional[int] = None,
+    tiers: Optional[Tuple[int, int]] = None,
+    outer_tiles: Optional[int] = None,
+) -> jax.Array:
+    """``psum(x)`` over ``axis`` for use INSIDE a ``shard_map`` body, with
+    x's rows chunked into tiles so each tile's reduce-scatter can overlap
+    neighboring compute — the reduction half of :func:`tiled_psum_dot`, for
+    callers whose per-shard partials are not themselves a matmul (the
+    CountSketch segment-sum partials, ``linalg/sketch.py``). ``x``: (m, c)
+    per-shard partial; returns the replicated-by-construction sum. Two-tier
+    aware exactly like :func:`tiled_psum_dot`; falls back to the monolithic
+    ``psum`` when m cannot be tiled."""
+    k = jax.lax.axis_size(axis)
+    m = x.shape[0]
+    T = tiles or _pick_tiles(m, k)
+    if k <= 1 or T == 0 or m % (T * k):
+        _count(
+            "fallback", site="tiled_psum",
+            reason="trivial_axis" if k <= 1 else "no_tiling",
+        )
+        return jax.lax.psum(x, axis)
+    outer, inner = _resolve_tiers(tiers, k, "tiled_psum")
+    tb = m // T
+    partials = [x[t * tb : (t + 1) * tb] for t in range(T)]
+    from keystone_tpu.telemetry import get_registry as _reg
+
+    _count(
+        "engaged", site="tiled_psum",
+        schedule="two_tier" if outer > 1 else "single_tier",
+    )
+    _reg().observe("overlap.tiles", T, site="tiled_psum")
+    return _reduce_tiled_partials(partials, axis, k, outer, inner, outer_tiles)
+
+
+def _resolve_tiers(
+    tiers: Optional[Tuple[int, int]], k: int, site: str
+) -> Tuple[int, int]:
+    """Validate a (outer, inner) tier map against the axis size; anything
+    that does not factor ``k`` degrades to single-tier WITH a log — the
+    operator who set a tier map must not silently lose the DCN schedule."""
+    outer, inner = tiers or (1, k)
+    if outer > 1 and outer * inner != k:
+        _log_fallback(
+            site, f"tiers {tiers} do not factor the axis size {k}",
+        )
+        outer, inner = 1, k
+    if outer <= 1:
+        outer, inner = 1, k
+    return outer, inner
+
+
+def _reduce_tiled_partials(
+    partials, axis: str, k: int, outer: int, inner: int,
+    outer_tiles: Optional[int] = None,
+) -> jax.Array:
+    """Shared reduction tail of the tiled schedules: per-tile
+    ``psum_scatter`` (single- or two-tier ICI/DCN) + ONE trailing
+    ``all_gather`` + the device-order unscramble. ``partials``: T equal
+    (tb, c) row-tiles of the (m, c) array to sum over ``axis``."""
+    from keystone_tpu.telemetry import get_registry as _reg
+
+    T = len(partials)
+    tb, c = partials[0].shape
+    pb = tb // k
+    m = T * tb
     _reg().inc("overlap.tier_schedule", schedule=f"{outer}x{inner}")
     if outer == 1:
         _count("reduce_scatter_rounds", T, tier="single")
@@ -562,11 +622,29 @@ def _ring_rotate_fold(x0, axis: str, k: int, fold, out):
     return out
 
 
+def _tier_ring_perm_tables(outer: int, inner: int):
+    """``ppermute`` tables for the two-stage tiered fold (flat device index
+    i = slice·inner + lane): within-slice rings — each slice its own cycle
+    over its ``inner`` devices (ICI hops only) — and cross-slice rings —
+    each lane its own cycle over the ``outer`` slices (the only DCN
+    hops)."""
+    win_fwd = [(s * inner + j, s * inner + (j + 1) % inner)
+               for s in range(outer) for j in range(inner)]
+    win_bwd = [(s * inner + j, s * inner + (j - 1) % inner)
+               for s in range(outer) for j in range(inner)]
+    cross_fwd = [(s * inner + j, ((s + 1) % outer) * inner + j)
+                 for s in range(outer) for j in range(inner)]
+    cross_bwd = [(s * inner + j, ((s - 1) % outer) * inner + j)
+                 for s in range(outer) for j in range(inner)]
+    return win_fwd, win_bwd, cross_fwd, cross_bwd
+
+
 def ring_tsqr_fold(
     Ri: jax.Array,
     Zi: Optional[jax.Array],
     axis: str,
     precision: Optional[str] = None,
+    tiers: Optional[Tuple[int, int]] = None,
 ):
     """The overlapped TSQR R-tree, for use INSIDE a ``shard_map`` body.
 
@@ -586,6 +664,15 @@ def ring_tsqr_fold(
     rounds (+ one forward hop for even k); works for ANY shard count and
     any d (no tiling divisibility requirement).
 
+    ``tiers=(outer, inner)`` (from :func:`mesh_tiers`) engages the
+    tier-aware fold order on multi-slice meshes: the within-slice factors
+    fold FIRST over each slice's own bidirectional ICI ring, and only the
+    ``outer`` already-folded per-slice results circulate across slices —
+    every cross-slice (DCN) payload is one (d, d) R (+ rhs) per slice
+    instead of every round's raw factor, and the slow tier's hop count
+    drops from ~k-1 ring steps to the outer-1 slice-result hops. Same
+    folded set either way, so the (R, Z) contract is unchanged.
+
     Returns (R, Z): replicated by construction up to fold order — every
     device folds the same set of factors, so RᵀR (and the least-squares
     solution R⁻¹Z) agree to rounding; row signs of R may differ between
@@ -595,13 +682,8 @@ def ring_tsqr_fold(
     if k <= 1:
         _count("fallback", site="ring_tsqr_fold", reason="trivial_axis")
         return Ri, Zi
+    outer, inner = _resolve_tiers(tiers, k, "ring_tsqr_fold")
     _count("engaged", site="ring_tsqr_fold")
-    _count(
-        "ppermute_rounds",
-        2 * bidirectional_rounds(k) + (1 if k % 2 == 0 else 0),
-        site="ring_tsqr_fold",
-    )
-    fwd_perm, bwd_perm = paired_ring_perms(k)
 
     def fold(R_acc, Z_acc, Rs, Zs):
         stack = jnp.concatenate([R_acc] + Rs, axis=0)
@@ -610,25 +692,63 @@ def ring_tsqr_fold(
         Q, R = jnp.linalg.qr(stack, mode="reduced")
         return R, hdot(Q.T, jnp.concatenate([Z_acc] + Zs, axis=0), precision)
 
-    R_acc, Z_acc = Ri, Zi
-    fR = bR = Ri
-    fZ = bZ = Zi
-    for _ in range(bidirectional_rounds(k)):
-        if Zi is None:
-            fR = jax.lax.ppermute(fR, axis, fwd_perm)
-            bR = jax.lax.ppermute(bR, axis, bwd_perm)
-        else:
-            fR, fZ = jax.lax.ppermute((fR, fZ), axis, fwd_perm)
-            bR, bZ = jax.lax.ppermute((bR, bZ), axis, bwd_perm)
-        R_acc, Z_acc = fold(R_acc, Z_acc, [fR, bR], [fZ, bZ])
-    if k % 2 == 0:
-        # unpaired middle factor at distance k/2: one more forward hop
-        if Zi is None:
-            fR = jax.lax.ppermute(fR, axis, fwd_perm)
-        else:
-            fR, fZ = jax.lax.ppermute((fR, fZ), axis, fwd_perm)
-        R_acc, Z_acc = fold(R_acc, Z_acc, [fR], [fZ])
-    return R_acc, Z_acc
+    def circulate(R_acc, Z_acc, R0, Z0, fwd_perm, bwd_perm, ksub):
+        """One bidirectional fold stage over a ``ksub``-cycle of the perm
+        tables: circulate (R0, Z0) both ways, folding every arrival into
+        the accumulators — the single-ring schedule, reused per tier."""
+        fR = bR = R0
+        fZ = bZ = Z0
+        for _ in range(bidirectional_rounds(ksub)):
+            if Z0 is None:
+                fR = jax.lax.ppermute(fR, axis, fwd_perm)
+                bR = jax.lax.ppermute(bR, axis, bwd_perm)
+            else:
+                fR, fZ = jax.lax.ppermute((fR, fZ), axis, fwd_perm)
+                bR, bZ = jax.lax.ppermute((bR, bZ), axis, bwd_perm)
+            R_acc, Z_acc = fold(R_acc, Z_acc, [fR, bR], [fZ, bZ])
+        if ksub % 2 == 0 and ksub > 1:
+            # unpaired middle factor at distance ksub/2: one forward hop
+            if Z0 is None:
+                fR = jax.lax.ppermute(fR, axis, fwd_perm)
+            else:
+                fR, fZ = jax.lax.ppermute((fR, fZ), axis, fwd_perm)
+            R_acc, Z_acc = fold(R_acc, Z_acc, [fR], [fZ])
+        return R_acc, Z_acc
+
+    def stage_rounds(ksub):
+        return 2 * bidirectional_rounds(ksub) + (
+            1 if ksub % 2 == 0 and ksub > 1 else 0
+        )
+
+    if outer <= 1:
+        _count(
+            "ppermute_rounds", stage_rounds(k), site="ring_tsqr_fold",
+        )
+        fwd_perm, bwd_perm = paired_ring_perms(k)
+        return circulate(Ri, Zi, Ri, Zi, fwd_perm, bwd_perm, k)
+    # ONE engaged count per fold (fired above, untagged — the series the
+    # telemetry tests read); the two-tier schedule is recorded on the
+    # tier_schedule series, the tiled paths' convention
+    from keystone_tpu.telemetry import get_registry as _reg
+
+    _reg().inc("overlap.tier_schedule", schedule=f"{outer}x{inner}")
+    _count(
+        "ppermute_rounds", stage_rounds(inner), site="ring_tsqr_fold",
+        tier="inner",
+    )
+    _count(
+        "ppermute_rounds", stage_rounds(outer), site="ring_tsqr_fold",
+        tier="outer",
+    )
+    win_fwd, win_bwd, cross_fwd, cross_bwd = _tier_ring_perm_tables(
+        outer, inner
+    )
+    # stage 1 (ICI): fold this slice's factors over its own ring — after
+    # this every device holds its slice's (R_s, Z_s)
+    R_acc, Z_acc = circulate(Ri, Zi, Ri, Zi, win_fwd, win_bwd, inner)
+    # stage 2 (DCN): circulate ONLY the per-slice results across slices —
+    # each lane runs an independent outer-ring of the slice R factors
+    return circulate(R_acc, Z_acc, R_acc, Z_acc, cross_fwd, cross_bwd, outer)
 
 
 def model_tiled_transpose_matmul(

@@ -192,6 +192,12 @@ def _positive(v):
     return v
 
 
+def _greater_than_one(v):
+    if v <= 1:
+        raise ValueError(f"must be > 1, got {v}")
+    return v
+
+
 def _tiles_format(raw: str) -> Tuple[int, Optional[int]]:
     """Normalizing validator: the ONE place the tiles format is parsed.
     Returns ``(inner, outer_or_None)`` — consumers get the tuple, never a
@@ -286,6 +292,32 @@ declare("KEYSTONE_GUARD", "bool", False,
         "sentinel, feeding guard.transfer / guard.recompile counters into "
         "the telemetry registry (the runtime cross-check for the static "
         "lint findings).")
+declare("KEYSTONE_SOLVER", "str", "exact",
+        "Least-squares solver tier: 'exact' keeps the gram/TSQR/BCD "
+        "paths; 'sketch' routes the TSQR/BlockCoordinateDescent/"
+        "LinearMapEstimator entry points through the sketch-and-"
+        "precondition solver (linalg/sketch.py) and orders weighted-BCD "
+        "blocks by sketched leverage.", choices=("exact", "sketch"))
+declare("KEYSTONE_SKETCH_KIND", "str", "countsketch",
+        "Sketch operator for the randomized solver tier: 'countsketch' "
+        "(O(nnz) signed segment-sum) or 'srht' (block-diagonal Rademacher "
+        "signs + orthonormal FFT mix + row sample).",
+        choices=("countsketch", "srht"))
+declare("KEYSTONE_SKETCH_FACTOR", "float", 4.0,
+        "Sketch size as a multiple of the feature dim (S·A has "
+        "~factor*d rows); must exceed 1 for a full-rank preconditioner.",
+        validator=_greater_than_one)
+declare("KEYSTONE_SKETCH_TOL", "float", 1e-5,
+        "Relative preconditioned-residual tolerance the sketched solver's "
+        "CG iteration stops at (per-call tol=0 runs max_iters exactly — "
+        "the bench's fixed-work form).", validator=_positive)
+declare("KEYSTONE_SKETCH_MAX_ITERS", "int", 100,
+        "Iteration cap for the sketch-preconditioned CG.",
+        validator=_positive)
+declare("KEYSTONE_SKETCH_BCD", "bool", False,
+        "Leverage-score block scheduling for block coordinate descent: "
+        "visit feature blocks in descending sketched-energy order instead "
+        "of sequentially (linalg/sketch.py::leverage_block_order).")
 
 # ---------------------------------------------------------------------------
 # BENCH_* declarations (bench.py / scripts/bench_regime.py sections)
@@ -313,6 +345,9 @@ declare("BENCH_TELEMETRY", "bool", True,
         "bench_telemetry.json.")
 declare("BENCH_TELEMETRY_PATH", "str", "",
         "Override path for bench_telemetry.json.")
+declare("BENCH_SKETCH", "bool", True,
+        "Sketch-vs-exact equal-test-error comparison regime (subprocess; "
+        "configured at d=65536, derated to the backend's memory).")
 declare("BENCH_SOLVER_OVERLAP", "bool", True,
         "Overlap on/off solver GFLOPs ladder (subprocess regime).")
 declare("BENCH_FLAGSHIP", "bool", True,

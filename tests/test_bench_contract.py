@@ -63,8 +63,15 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     full = json.loads((tmp_path / "bench_full.json").read_text())
     assert full["smoke"] is True
     # the parameterized precision/overlap ladder emitted its base cells
+    # (now via the budget-derated solver_ladder subprocess regime)
     assert "solver_gflops_per_chip" in full
     assert "solver_gflops_per_chip_overlap" in full
+    # ...including the randomized sketch rung and the equal-test-error
+    # comparison vs the exact rung (linalg/sketch.py acceptance keys)
+    assert "sketch_gflops_per_chip" in full
+    assert "sketch_gflops_per_chip_overlap" in full
+    assert "sketch_vs_exact_error_delta_d65536" in full
+    assert "sketch_vs_exact_d" in full
     # structured-telemetry contract: telemetry_* keys in the COMPACT line,
     # non-zero span/counter headcounts, and a loadable artifact whose
     # Chrome trace is Perfetto-shaped
@@ -116,3 +123,32 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     assert "partial" not in compact
     full = json.loads((tmp_path / "bench_full.json").read_text())
     assert full.get("imagenet_refdim_streaming_warm_s_skipped") == "budget"
+
+
+def test_bench_section_floor_exhaustion_is_graceful(tmp_path):
+    """The run-5 rc=124 class: budget exhaustion mid-run must yield
+    explicit ``<key>_skipped`` markers and rc=0, never the harness timeout.
+    A section floor no regime can meet forces the before-entry enforcement
+    on EVERY derated subprocess section — including the solver ladder, the
+    heavy section that used to run in-process with no enforceable bound —
+    and the final compact line must still be the clean (non-partial) one."""
+    proc = _run_bench(
+        tmp_path,
+        {
+            "KEYSTONE_BENCH_SECTION_FLOOR_S": "999999",
+            # force one big regime ON so the derate path (not the env
+            # gate) is what skips it
+            "BENCH_FLAGSHIP": "1",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    compact = json.loads(_last_line(proc.stdout))
+    assert "partial" not in compact
+    full = json.loads((tmp_path / "bench_full.json").read_text())
+    assert full.get("solver_gflops_per_chip_skipped") == "budget"
+    assert (
+        full.get("sketch_vs_exact_error_delta_d65536_skipped") == "budget"
+    )
+    assert full.get("imagenet_refdim_streaming_warm_s_skipped") == "budget"
+    # the primary metric itself still landed
+    assert compact["metric"] == "mnist_random_fft_fit_eval_wallclock"

@@ -670,3 +670,142 @@ def test_overlap_fallback_logs_once(mesh, rng, caplog):
         schedule="single_tier",
     ) == 1
     assert reg.sum_counters("overlap.fallback") == 0
+
+
+# -- tier-aware TSQR fold order ---------------------------------------------
+
+
+def test_tsqr_ring_fold_two_tier_matches(mesh, rng, monkeypatch):
+    """Tiered fold-order equivalence on a simulated 2-slice mesh
+    (KEYSTONE_MESH_TIERS=2 over the 8-device axis): within-slice factors
+    fold first, only per-slice results cross the 'DCN' boundary — and the
+    solution still matches the untiered tree and the dense oracle."""
+    from keystone_tpu import telemetry
+
+    monkeypatch.setenv("KEYSTONE_MESH_TIERS", "2")
+    telemetry.reset()
+    A = rng.normal(size=(192, 12)).astype(np.float32)
+    b = rng.normal(size=(192, 3)).astype(np.float32)
+    w_off = np.asarray(tsqr_solve(A, b, lam=0.5, mesh=mesh))
+    w_on = np.asarray(tsqr_solve(A, b, lam=0.5, mesh=mesh, overlap=True))
+    np.testing.assert_allclose(w_on, w_off, rtol=1e-4, atol=1e-5)
+    w_on0 = np.asarray(tsqr_solve(A, b, lam=0.0, mesh=mesh, overlap=True))
+    w_ref = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(w_on0, w_ref, rtol=1e-4, atol=1e-4)
+    R = np.asarray(tsqr_r(jnp.asarray(A), mesh, overlap=True))
+    np.testing.assert_allclose(
+        R.T @ R, A.T @ A, rtol=1e-4, atol=1e-3 * np.abs(A.T @ A).max()
+    )
+    # the two-tier schedule engaged — ONE engaged count per fold (the
+    # untagged series), the schedule on tier_schedule, per-tier hop
+    # counters: the inner stage folds 4-device slices, the outer stage
+    # rings 2 slice results
+    reg = telemetry.get_registry()
+    assert reg.get_counter("overlap.engaged", site="ring_tsqr_fold") >= 1
+    assert reg.get_counter(
+        "overlap.tier_schedule", schedule="2x4"
+    ) >= 1, reg.as_dict()["counters"]
+    assert reg.get_counter(
+        "overlap.ppermute_rounds", site="ring_tsqr_fold", tier="inner"
+    ) >= 1
+    assert reg.get_counter(
+        "overlap.ppermute_rounds", site="ring_tsqr_fold", tier="outer"
+    ) >= 1
+    telemetry.reset()
+
+
+def test_tsqr_two_tier_hlo_fewer_permutes_no_bulk(mesh, rng, monkeypatch):
+    """THE structure pin for the tiered fold: the two-stage schedule keeps
+    ZERO bulk all-gather/all-reduce AND lowers to FEWER collective-permutes
+    than the flat 8-ring (4 hop-slots — 3 within-slice + 1 cross-slice —
+    vs the flat ring's 7), i.e. the cross-slice traffic really dropped to
+    the outer-1 slice-result hops."""
+    from keystone_tpu.linalg.solvers import _tsqr_solve
+
+    A = jnp.asarray(rng.normal(size=(256, 16)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(256, 3)).astype(np.float32))
+
+    def permute_count(tiered: bool):
+        # tiers rides through the jit as a STATIC argument (resolved from
+        # KEYSTONE_MESH_TIERS eagerly in tsqr_solve) — passed explicitly
+        # here so the two lowerings are distinct compiled programs
+        lowered = _tsqr_solve.lower(
+            A, b, jnp.float32(0.5), None, mesh, True, "highest", True,
+            (2, 4) if tiered else None,
+        )
+        return _collectives(lowered.compile().as_text())
+
+    flat = permute_count(False)
+    tiered = permute_count(True)
+    assert tiered["all-gather"] == 0 and tiered["all-reduce"] == 0, tiered
+    assert tiered["collective-permute"] >= 1
+    assert tiered["collective-permute"] < flat["collective-permute"], (
+        tiered, flat,
+    )
+
+
+def test_ring_fold_bad_tiers_degrade_single_tier(mesh, rng):
+    """A tier map that does not factor the axis must degrade to the flat
+    fold (logged), not silently half-run: results stay correct."""
+    from keystone_tpu.parallel import overlap as _ov
+    from keystone_tpu.parallel.overlap import ring_tsqr_fold
+
+    _ov._FALLBACK_LOGGED.clear()
+    A = rng.normal(size=(128, 8)).astype(np.float32)
+
+    def local(Ai):
+        Ri = jnp.linalg.qr(Ai, mode="r")
+        R, _ = ring_tsqr_fold(Ri, None, "data", tiers=(3, 2))  # 3*2 != 8
+        s = jnp.where(jnp.diagonal(R) < 0, -1.0, 1.0).astype(R.dtype)
+        return R * s[:, None]
+
+    f = jax.shard_map(
+        local, mesh=mesh, in_specs=P("data", None), out_specs=P(),
+        check_vma=False,
+    )
+    R = np.asarray(f(jnp.asarray(A)))
+    np.testing.assert_allclose(
+        R.T @ R, A.T @ A, rtol=1e-4, atol=1e-3 * np.abs(A.T @ A).max()
+    )
+
+
+# -- tiled_psum (the sketch reduction's schedule) ---------------------------
+
+
+def test_tiled_psum_matches_psum(mesh, rng):
+    """The standalone tiled reduction (used by the CountSketch partials,
+    linalg/sketch.py): equivalence with the monolithic psum plus the
+    reduce-scatter/no-all-reduce HLO pin."""
+    from keystone_tpu.parallel.overlap import tiled_psum
+
+    k = mesh.shape["data"]
+    x = rng.normal(size=(8, 16 * k, 5)).astype(np.float32)
+
+    def tiled(xi):
+        return tiled_psum(xi[0], "data")[None]
+
+    spec = P("data", None, None)
+    f = jax.shard_map(
+        tiled, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
+    out = np.asarray(f(jnp.asarray(x)))[0]
+    np.testing.assert_allclose(out, x.sum(0), rtol=1e-4, atol=1e-4)
+    jf = jax.jit(f)
+    cols = _collectives(jf.lower(jnp.asarray(x)).compile().as_text())
+    assert cols["reduce-scatter"] >= k, cols
+    assert cols["all-reduce"] == 0, cols
+
+
+def test_tiled_psum_falls_back_on_indivisible_rows(mesh, rng):
+    from keystone_tpu.parallel.overlap import tiled_psum
+
+    x = rng.normal(size=(8, 10, 3)).astype(np.float32)  # 10 % 8 != 0
+
+    def tiled(xi):
+        return tiled_psum(xi[0], "data")[None]
+
+    spec = P("data", None, None)
+    out = np.asarray(jax.shard_map(
+        tiled, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )(jnp.asarray(x)))[0]
+    np.testing.assert_allclose(out, x.sum(0), rtol=1e-4, atol=1e-4)
