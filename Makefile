@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint verify-fast telemetry-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint verify-fast telemetry-smoke autotune-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -40,11 +40,18 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=120 $(PY) bench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/autotune_smoke.py
 
 # Tiny traced pipeline -> counters non-zero, Chrome trace well-formed,
 # telemetry-report renders (scripts/telemetry_smoke.py); CPU, seconds.
 telemetry-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
+
+# Tile-autotuner contract end to end: tiny interpret-mode sweep -> persisted
+# device-keyed cache -> reload with zero re-sweeps -> _pick_tiles consumes
+# the winner (scripts/autotune_smoke.py); CPU, seconds.
+autotune-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/autotune_smoke.py
 
 bench:
 	$(PY) bench.py

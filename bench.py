@@ -77,7 +77,8 @@ if _SMOKE:
     for _gate in ("BENCH_EXTRAS", "BENCH_FLAGSHIP", "BENCH_VOC_REFDIM",
                   "BENCH_TIMIT_FULL", "BENCH_CACHED", "BENCH_PREFETCH",
                   "BENCH_MOMENTS", "BENCH_CONSTANTS", "BENCH_SERVE",
-                  "BENCH_STAGES", "BENCH_SOLVER_OVERLAP"):
+                  "BENCH_STAGES", "BENCH_SOLVER_OVERLAP",
+                  "BENCH_EXTRACTION"):
         os.environ.setdefault(_gate, "0")
 
 # Total wall-clock budget for the whole bench run. The driver kills at
@@ -1143,6 +1144,17 @@ def main():
             )
         )
         _flush(out, "solver_overlap")
+    # Extraction-kernel family (ops/pallas/extraction.py): Pallas-vs-XLA
+    # GFLOPs for the fused SIFT binning and FV encode kernels, latency-
+    # cancelled in a fresh process with the same derated-timeout/skip
+    # treatment (PR-6 contract: exhaustion -> <key>_skipped, rc stays 0).
+    if knobs.get("BENCH_EXTRACTION"):
+        out.update(
+            _run_regime_subprocess(
+                "extraction_kernels", fail_key="sift_pallas_on_gflops"
+            )
+        )
+        _flush(out, "extraction_kernels")
     # Big regimes (flagship / VOC-refdim / full-TIMIT) each run in a FRESH
     # OS process (scripts/bench_regime.py): round 4 measured the in-bench
     # flagship ~1.4x slower than the same code in a fresh process (20.1 s
@@ -1283,6 +1295,12 @@ _COMPACT_KEYS = (
     ("g_tsqr_ov", "tsqr_overlap_on_gflops"),
     ("g_bcdm", "bcd_model_overlap_off_gflops"),
     ("g_bcdm_ov", "bcd_model_overlap_on_gflops"),
+    # extraction-kernel family: fused Pallas vs XLA twin
+    # (scripts/bench_regime.py extraction_kernels)
+    ("g_sift_pl", "sift_pallas_on_gflops"),
+    ("g_sift_xla", "sift_pallas_off_gflops"),
+    ("g_fv_pl", "fv_encode_pallas_on_gflops"),
+    ("g_fv_xla", "fv_encode_pallas_off_gflops"),
     ("s_feat", "stage_solve.featurize_s"),
     ("g_feat", "stage_solve.featurize_gflops"),
     ("g_pop", "stage_solve.pop_stats_gflops"),

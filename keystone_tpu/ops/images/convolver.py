@@ -49,6 +49,59 @@ class Convolver(Transformer):
         return self.apply_batch(img[None])[0]
 
     def apply_batch(self, imgs):
+        tile = self._pallas_tile(imgs)
+        if tile is not None:
+            return self._apply_batch_pallas(imgs, tile)
+        return self._apply_batch_xla(imgs)
+
+    def _pallas_tile(self, imgs):
+        """Autotuned filter-tile width when the fused Pallas kernel should
+        run, else None (the XLA twin). The kernel is explicit-grade
+        (``KEYSTONE_PALLAS=1`` only — see ``ops/pallas/extraction.py``) and
+        additionally requires a tile whose per-image working set fits
+        VMEM."""
+        from keystone_tpu.core.cache import has_tracers
+        from keystone_tpu.ops.pallas.extraction import (
+            conv_norm_tile,
+            pallas_enabled,
+        )
+
+        if not pallas_enabled(auto_ok=False):
+            return None
+        if imgs.dtype != jnp.float32:
+            # the kernel computes in f32; other dtypes keep the twin's
+            # exact semantics (same gate as the Pallas pooler)
+            return None
+        k, c = self.conv_size, self.num_channels
+        h, w = int(imgs.shape[1]), int(imgs.shape[2])
+        if h < k or w < k:
+            return None
+        return conv_norm_tile(
+            h, w, c, k, int(self.filters.shape[0]),
+            allow_sweep=not has_tracers(imgs),
+        )
+
+    def _apply_batch_pallas(self, imgs, tile_f: int):
+        """Fused kernel path: one HBM read of each image, im2col matmul +
+        patch statistics + normalization + whitener shift all in VMEM
+        (``ops/pallas/extraction.py::conv_norm``) — no raw/s1/s2
+        intermediates. Parity with the XLA twin is pinned in
+        ``tests/test_pallas_extraction.py``."""
+        from keystone_tpu.ops.pallas.extraction import conv_norm
+
+        return conv_norm(
+            imgs,
+            self.filters,
+            num_channels=self.num_channels,
+            normalize=self.normalize_patches,
+            var_constant=self.var_constant,
+            whitener_means=(
+                None if self.whitener is None else self.whitener.means
+            ),
+            tile_f=tile_f,
+        )
+
+    def _apply_batch_xla(self, imgs):
         k, c = self.conv_size, self.num_channels
         nf = self.filters.shape[0]
         kernel = self.filters.reshape(nf, k, k, c).transpose(1, 2, 3, 0)  # HWIO

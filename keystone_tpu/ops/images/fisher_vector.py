@@ -125,22 +125,30 @@ def _fv_cols(descriptors, gmm: GaussianMixtureModel, lo: int, hi: int):
 
 
 def _fv_moment_impl() -> str:
-    """Moment-path implementation: ``"mxu"`` on TPU, ``"f32"`` elsewhere.
+    """Moment-path implementation: ``"pallas"`` when the Pallas extraction
+    family is engaged, else ``"mxu"`` on TPU, ``"f32"`` elsewhere.
 
-    The mxu form packs the posterior's two gemms into ONE ``[x | x²] @
-    [A; B]`` contraction (K = 2d instead of two half-empty K = d passes)
-    and runs the moment einsums on bf16 inputs with f32 accumulation —
-    measured 22% per-group-pass at the flagship shape (v5e, chain
-    protocol), within bf16 rounding of the f32 path. The f32 form stays
-    the default off-TPU so the jax-CPU anchor times the CPU-best
-    formulation and the autodiff-oracle tests keep their exact path
-    (the ``_conv1d_same`` precedent). ``KEYSTONE_FV_IMPL=mxu|f32``
-    forces either for cross-path parity tests."""
+    The pallas form (``ops/pallas/extraction.py::fv_moments``) fuses the
+    posterior softmax with the moment accumulation per descriptor tile in
+    VMEM, so the (n_img, n_desc, k) posterior tensor never reaches HBM —
+    the enceval-C++ fusion the XLA twins cannot express. The mxu form packs
+    the posterior's two gemms into ONE ``[x | x²] @ [A; B]`` contraction
+    (K = 2d instead of two half-empty K = d passes) and runs the moment
+    einsums on bf16 inputs with f32 accumulation — measured 22% per-group-
+    pass at the flagship shape (v5e, chain protocol), within bf16 rounding
+    of the f32 path. The f32 form stays the default off-TPU so the jax-CPU
+    anchor times the CPU-best formulation and the autodiff-oracle tests
+    keep their exact path (the ``_conv1d_same`` precedent).
+    ``KEYSTONE_FV_IMPL=pallas|mxu|f32`` forces a path for cross-path
+    parity tests and beats the ``KEYSTONE_PALLAS`` selection."""
+    from keystone_tpu.ops.pallas.extraction import pallas_enabled
     from keystone_tpu.utils import knobs
 
     forced = knobs.get("KEYSTONE_FV_IMPL")
-    if forced in ("mxu", "f32"):
+    if forced in ("pallas", "mxu", "f32"):
         return forced
+    if pallas_enabled():
+        return "pallas"
     return "mxu" if jax.default_backend() == "tpu" else "f32"
 
 
@@ -210,6 +218,55 @@ def _fv_cols_batch_mxu(x, gmm: GaussianMixtureModel, lo: int, hi: int):
     return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
 
 
+def _fv_cols_batch_pallas(x, gmm: GaussianMixtureModel, lo: int, hi: int):
+    """Pallas-kernel :func:`_fv_cols_batch` (see :func:`_fv_moment_impl`).
+
+    One fused kernel pass (``ops/pallas/extraction.py::fv_moments``)
+    produces every image's uncentered ``(qsum, qx, qx2)`` without an HBM
+    posterior tensor; the gradient formulas below are the same arithmetic
+    as the f32 twin on the same uncentered moments, so the two paths agree
+    to f32 rounding (pinned in ``tests/test_pallas_extraction.py``). The
+    kernel always accumulates full-k moments — they ride the posterior
+    matmuls already in VMEM, so a narrow [lo, hi) block costs the same
+    kernel pass as a full-range call."""
+    from keystone_tpu.ops.pallas.extraction import fv_encode_tile, fv_moments
+
+    n_img, nd, d = x.shape
+    k = gmm.means.shape[0]
+    if n_img == 0:
+        return jnp.zeros((0, (hi - lo) * d), jnp.float32)
+    from keystone_tpu.core.cache import has_tracers
+
+    tile_nd = fv_encode_tile(nd, d, k, allow_sweep=not has_tracers(x))
+    qsum_full, qx_full, qx2_full = fv_moments(
+        x, gmm.means, gmm.variances, gmm.weights, tile_nd=tile_nd
+    )
+    inv_n = 1.0 / nd
+    m_rng = (lo, min(hi, k)) if lo < k else None
+    v_rng = (max(lo, k) - k, hi - k) if hi > k else None
+    parts = []
+    if m_rng is not None:
+        a, b = m_rng
+        qx = qx_full[:, a:b]
+        qsum = qsum_full[:, a:b, None]
+        mu, w = gmm.means[a:b], gmm.weights[a:b]
+        grad = (qx - qsum * mu[None]) / jnp.sqrt(gmm.variances[a:b])[None]
+        parts.append(
+            (grad * (inv_n / jnp.sqrt(w))[None, :, None]).reshape(n_img, -1)
+        )
+    if v_rng is not None:
+        a, b = v_rng
+        qx = qx_full[:, a:b]
+        qx2 = qx2_full[:, a:b]
+        qsum = qsum_full[:, a:b, None]
+        mu, var, w = gmm.means[a:b], gmm.variances[a:b], gmm.weights[a:b]
+        grad = (qx2 - 2.0 * mu[None] * qx + qsum * (mu**2)[None]) / var[None] - qsum
+        parts.append(
+            (grad * (inv_n / jnp.sqrt(2.0 * w))[None, :, None]).reshape(n_img, -1)
+        )
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
 def _fv_cols_batch(x, gmm: GaussianMixtureModel, lo: int, hi: int):
     """Batched :func:`_fv_cols`: columns [lo, hi) of every image's FV,
     shape (n, (hi-lo)·d).
@@ -222,10 +279,22 @@ def _fv_cols_batch(x, gmm: GaussianMixtureModel, lo: int, hi: int):
     cancellation headroom is unnecessary here: descriptors reaching FV are
     PCA projections with O(1) magnitudes, so the affine expansion is
     f32-stable uncentered; ``tests/test_pca_gmm_fv.py`` pins batch≡per-image
-    agreement. On TPU the MXU-shaped bf16 form is used instead
-    (:func:`_fv_cols_batch_mxu` via :func:`_fv_moment_impl`)."""
-    if _fv_moment_impl() == "mxu":
+    agreement. On TPU the MXU-shaped bf16 form is used instead, and under
+    ``KEYSTONE_PALLAS`` the fused Pallas kernel
+    (:func:`_fv_cols_batch_pallas` / :func:`_fv_cols_batch_mxu` via
+    :func:`_fv_moment_impl`)."""
+    impl = _fv_moment_impl()
+    if impl == "pallas":
+        return _fv_cols_batch_pallas(x, gmm, lo, hi)
+    if impl == "mxu":
         return _fv_cols_batch_mxu(x, gmm, lo, hi)
+    return _fv_cols_batch_f32(x, gmm, lo, hi)
+
+
+def _fv_cols_batch_f32(x, gmm: GaussianMixtureModel, lo: int, hi: int):
+    """The exact-f32 form of :func:`_fv_cols_batch` (its original body) —
+    directly addressable so parity tests and the bench's kernel/twin rows
+    name their reference without touching the env."""
     n_img, nd, d = x.shape
     k = gmm.means.shape[0]
     if n_img == 0:

@@ -33,7 +33,83 @@ class Pooler(Transformer):
     pixel_function: Optional[Callable] = struct.field(pytree_node=False, default=None)
     pool: str = struct.field(pytree_node=False, default="sum")  # sum | max
 
+    def _pallas_ok(self, img) -> bool:
+        """Fused Pallas sum-pool eligibility: explicit-grade knob
+        (``KEYSTONE_PALLAS=1``), sum pooling only (max is not a selection
+        matmul — it stays on the ``reduce_window`` twin), float32 input
+        (the kernel computes in f32; any other dtype — uint8 wrap-around
+        sums, f64 — must keep the twin's exact semantics), and a pixel
+        function that is shape/dtype-preserving (``eval_shape`` probe; the
+        kernel hands such a function the full untiled channel block, so
+        channel-mixing functions stay correct — which also means the FULL
+        (H, W, C) block must fit the VMEM budget, since the channel axis
+        cannot be tiled under it)."""
+        from keystone_tpu.ops.pallas.extraction import (
+            pallas_enabled,
+            pool_block_fits,
+        )
+
+        if self.pool != "sum" or not pallas_enabled(auto_ok=False):
+            return False
+        if img.dtype != jnp.float32:
+            return False
+        if self.pixel_function is not None:
+            h, w, c = int(img.shape[0]), int(img.shape[1]), int(img.shape[2])
+            if not pool_block_fits(h, w, c):
+                return False
+            try:
+                spec = jax.eval_shape(
+                    self.pixel_function,
+                    jax.ShapeDtypeStruct(img.shape, jnp.float32),
+                )
+            except Exception:
+                return False
+            if spec.shape != tuple(img.shape) or spec.dtype != jnp.float32:
+                return False
+        return True
+
+    def _pallas_tile_for(self, imgs):
+        """Channel-tile width when the fused kernel should run on this
+        (N, H, W, C) batch, else None (the XLA twin). The single decision
+        point for both ``apply`` and ``apply_batch`` — ``apply`` must not
+        route through ``apply_batch``'s fallback (the inherited twin is
+        vmap-of-apply; a shared fallback would recurse)."""
+        if imgs.ndim != 4 or not self._pallas_ok(imgs[0]):
+            return None
+        from keystone_tpu.ops.pallas.extraction import pool_sum_tile
+
+        h, w, c = int(imgs.shape[1]), int(imgs.shape[2]), int(imgs.shape[3])
+        if self.pixel_function is not None:
+            # untiled full channel block (budget-checked in _pallas_ok) —
+            # resolving a channel tile here would be a wasted lookup
+            return c
+        return pool_sum_tile(h, w, c)  # None when no tile fits VMEM
+
+    def _pallas_batch(self, imgs, tile_c: int):
+        from keystone_tpu.ops.pallas.extraction import pool_sum
+
+        return pool_sum(
+            imgs, self.stride, self.pool_size, self.pixel_function,
+            tile_c=tile_c,
+        )
+
     def apply(self, img):
+        tile_c = self._pallas_tile_for(img[None]) if img.ndim == 3 else None
+        if tile_c is not None:
+            return self._pallas_batch(img[None], tile_c)[0]
+        return self._apply_xla(img)
+
+    def apply_batch(self, imgs):
+        """Batch path: the fused Pallas kernel when eligible
+        (pixel-function + both selection matmuls in VMEM, see
+        ``ops/pallas/extraction.py::pool_sum``), else the inherited
+        vmap-of-apply twin — byte-identical to the pre-kernel behavior."""
+        tile_c = self._pallas_tile_for(imgs)
+        if tile_c is not None:
+            return self._pallas_batch(imgs, tile_c)
+        return Transformer.apply_batch(self, imgs)
+
+    def _apply_xla(self, img):
         h, w, c = img.shape
         if self.pixel_function is not None:
             img = self.pixel_function(img)

@@ -158,44 +158,66 @@ def _bin_select_matrix(L: int, n_f: int, step: int, bin_size: int,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("step", "bin_size", "min_bound", "height", "width", "impl"),
+    static_argnames=(
+        "step", "bin_size", "min_bound", "height", "width", "impl",
+        "pallas_tile",
+    ),
 )
 def _dsift_single_scale(img, step: int, bin_size: int, min_bound: int,
-                        height: int, width: int, impl: str = "auto"):
+                        height: int, width: int, impl: str = "auto",
+                        pallas_tile: int = 0):
     """One dsift scale over a batch: (..., H, W) -> (..., ny*nx, 128) plus
     the pre-normalization gradient mass (..., ny*nx).
 
-    Two mathematically-identical bin-aggregation forms (fp summation order
-    differs; cross-path agreement pinned in ``tests/test_sift.py``):
-    selection matmuls on TPU (box sum + keypoint/bin gather fused onto the
-    MXU, no (..., T, Hb, Wb) box tensor), ``reduce_window`` + gathers
-    elsewhere (the matmul form's L/4 extra MACs are a real cost without an
-    MXU — and the jax-CPU anchor must time the CPU-best formulation).
-    ``impl``: "auto" | "matmul" | "window" (forced, for parity tests)."""
+    Three mathematically-identical bin-aggregation forms (fp summation
+    order differs; cross-path agreement pinned in ``tests/test_sift.py``
+    and ``tests/test_pallas_extraction.py``): selection matmuls on TPU
+    (box sum + keypoint/bin gather fused onto the MXU, no (..., T, Hb, Wb)
+    box tensor), ``reduce_window`` + gathers elsewhere (the matmul form's
+    L/4 extra MACs are a real cost without an MXU — and the jax-CPU anchor
+    must time the CPU-best formulation), and the fused Pallas kernel
+    (``ops/pallas/extraction.py::sift_oriented_bins`` — binning × column
+    matmul in VMEM, so the (..., T, H, W) energy tensor never reaches HBM;
+    selected by ``KEYSTONE_PALLAS`` via the eager wrapper).
+    ``impl``: "auto" | "matmul" | "window" | "pallas" (forced, for parity
+    tests); ``pallas_tile`` is the autotuned row-tile height (0 = the
+    kernel default), resolved EAGERLY by the caller."""
     mag, angle = _gradient_polar(img)
-    energies = _orientation_energies(mag, angle)  # (..., T, H, W)
 
     ny, nx = dsift_geometry(width, height, step, bin_size, min_bound)
+    use_pallas = impl == "pallas"
     use_matmul = impl == "matmul" or (
         impl == "auto" and jax.default_backend() == "tpu"
     )
-    if use_matmul:
+    if use_pallas or use_matmul:
         # box sum + keypoint/bin gather per axis = one 0/1 selection matmul
         # (see _bin_select_matrix); XLA fuses the energies producer into the
         # first matmul, so the (..., T, Hb, Wb) box tensor never exists
         My = jnp.asarray(
             _bin_select_matrix(height, ny, step, bin_size, min_bound)
         )
-        Mx = jnp.asarray(
-            _bin_select_matrix(width, nx, step, bin_size, min_bound)
-        )
-        # (..., T, H, W) @ (W, nx*4) -> (..., T, H, nx*4); then contract H
-        gx = jnp.matmul(energies, Mx, preferred_element_type=jnp.float32)
+        Mx_np = _bin_select_matrix(width, nx, step, bin_size, min_bound)
+        if use_pallas:
+            from keystone_tpu.ops.pallas.extraction import sift_oriented_bins
+
+            # fused binning × selection: (..., T, H, nx*4) with no
+            # (..., T, H, W) energy tensor in HBM
+            gx = sift_oriented_bins(
+                mag, angle, Mx_np, tile_r=pallas_tile or 256
+            )
+        else:
+            energies = _orientation_energies(mag, angle)  # (..., T, H, W)
+            # (..., T, H, W) @ (W, nx*4) -> (..., T, H, nx*4)
+            gx = jnp.matmul(
+                energies, jnp.asarray(Mx_np),
+                preferred_element_type=jnp.float32,
+            )
         g = jnp.einsum(
             "...hq,hp->...pq", gx, My, preferred_element_type=jnp.float32
         )  # (..., T, ny*4, nx*4)
         g = g.reshape(*g.shape[:-2], ny, NUM_BIN_S, nx, NUM_BIN_S)
     else:
+        energies = _orientation_energies(mag, angle)  # (..., T, H, W)
         box = _box_sums(energies, bin_size)  # (..., T, Hb, Wb)
         # frame origin o = min_bound + f·step; spatial bin i is the box of
         # width bin_size centered at o + i·bin, i.e. box index
@@ -269,18 +291,56 @@ class SIFTExtractor(Transformer):
         # eagerly, the tail ops (concat/perm/quantize over the (N, kp, 128)
         # tensor — GBs at flagship chunks) each pay a full HBM round trip
         # and dispatch; fused they ride the per-scale epilogues (measured
-        # ~5x on a 2048-image 64² chunk, v5e)
+        # ~5x on a 2048-image 64² chunk, v5e).
+        # Kernel/twin selection + tile resolution happen HERE, eagerly:
+        # the decision and the autotuned tile are jit-static below, so
+        # KEYSTONE_PALLAS=0 reproduces the exact prior program.
+        impl, tile = _resolve_impl_and_tile(self, img)
         return _extract_jit(
-            img, self.step_size, self.bin_size, self.scales, self.scale_step
+            img, self.step_size, self.bin_size, self.scales,
+            self.scale_step, impl, tile,
         )
+
+
+def _resolve_impl_and_tile(node: "SIFTExtractor", img) -> Tuple[str, int]:
+    """``KEYSTONE_PALLAS`` + autotuner resolution for one extract call
+    (``"auto"`` keeps the pre-kernel selection verbatim). The tile is
+    resolved at scale-0 geometry — the dominant scale — and shared by all
+    scales (buckets are power-of-two anyway). Sweeps are suppressed when
+    the image is a tracer (extract under an outer jit): lookup/default
+    only."""
+    from keystone_tpu.core.cache import has_tracers
+    from keystone_tpu.ops.pallas.extraction import (
+        pallas_enabled,
+        sift_bins_tile,
+    )
+
+    if not pallas_enabled():
+        return "auto", 0
+    shape = img.shape
+    height, width = shape[-2], shape[-1]
+    lead = 1
+    for s in shape[:-2]:
+        lead *= int(s)
+    _, nx = dsift_geometry(
+        width, height, node.step_size, node.bin_size, 1 + 2 * node.scales
+    )
+    tile = sift_bins_tile(
+        lead * height, width, max(nx, 1) * NUM_BIN_S,
+        allow_sweep=not has_tracers(img),
+    )
+    return "pallas", int(tile)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("step_size", "bin_size", "scales", "scale_step"),
+    static_argnames=(
+        "step_size", "bin_size", "scales", "scale_step", "impl",
+        "pallas_tile",
+    ),
 )
 def _extract_jit(img, step_size: int, bin_size: int, scales: int,
-                 scale_step: int):
+                 scale_step: int, impl: str = "auto", pallas_tile: int = 0):
     height, width = img.shape[-2], img.shape[-1]
     per_scale = []
     for s in range(scales):
@@ -289,7 +349,8 @@ def _extract_jit(img, step_size: int, bin_size: int, scales: int,
         min_bound = (1 + 2 * scales) - 3 * s
         smoothed = _gaussian_blur(img, bin_s / 6.0)
         desc, mass = _dsift_single_scale(
-            smoothed, step_s, bin_s, min_bound, height, width
+            smoothed, step_s, bin_s, min_bound, height, width, impl,
+            pallas_tile,
         )
         desc = jnp.where((mass > CONTRAST_THRESHOLD)[..., None], desc, 0.0)
         per_scale.append(desc)

@@ -5,6 +5,15 @@ under ``vmap``); the Pallas paths are the HBM-bandwidth-bound inner loops
 where XLA's fusion leaves traffic on the table (SURVEY.md §2.8 TPU mapping).
 """
 
+from keystone_tpu.ops.pallas import autotune
+from keystone_tpu.ops.pallas.extraction import (
+    conv_norm,
+    default_interpret,
+    fv_moments,
+    pallas_enabled,
+    pool_sum,
+    sift_oriented_bins,
+)
 from keystone_tpu.ops.pallas.moments import (
     gmm_moments,
     gmm_moments_auto,
@@ -12,4 +21,16 @@ from keystone_tpu.ops.pallas.moments import (
     gmm_moments_xla,
 )
 
-__all__ = ["gmm_moments", "gmm_moments_auto", "gmm_moments_sep", "gmm_moments_xla"]
+__all__ = [
+    "autotune",
+    "conv_norm",
+    "default_interpret",
+    "fv_moments",
+    "gmm_moments",
+    "gmm_moments_auto",
+    "gmm_moments_sep",
+    "gmm_moments_xla",
+    "pallas_enabled",
+    "pool_sum",
+    "sift_oriented_bins",
+]

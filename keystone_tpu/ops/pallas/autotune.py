@@ -1,0 +1,428 @@
+"""Device-keyed empirical tile autotuner for the Pallas kernel family.
+
+The TVM matmul-generator result (PAPERS.md, "Automatic Generators for a
+Family of Matrix Multiplication Routines with Apache TVM") and the tile-shape
+sensitivity documented for TPU matmuls in "Large Scale Distributed Linear
+Algebra With Tensor Processing Units" both say the same thing: the right
+tile shape is an *empirical* property of (kernel, device generation, problem
+shape), not something a heuristic gets right across generations. This module
+is the single tile-resolution path for every Pallas kernel in the package
+(``ops/pallas/moments.py``, ``ops/pallas/extraction.py``) and for the
+overlap schedulers' tile-count default
+(``parallel/overlap.py::_pick_tiles``).
+
+Model:
+
+- Every tunable site is identified by a ``(kernel, device_key, bucket)``
+  triple. ``device_key`` is backend + device generation
+  (``"tpu:tpu_v5_lite"``, ``"cpu:cpu"``); ``bucket`` is the shape rounded
+  up per-dimension to a power of two (:func:`shape_bucket`) so nearby
+  shapes share one entry instead of re-sweeping per exact shape.
+- :func:`resolve` is the one lookup path: a persisted winner is served
+  immediately (``autotune.cache_hit``); on a miss the *declared default* is
+  served (``autotune.default``) unless ``KEYSTONE_AUTOTUNE=1`` **and** the
+  caller supplied a ``measure`` callback, in which case a bounded sweep
+  runs (``autotune.sweep``), the winner is persisted, and subsequent
+  resolutions — in this process or any later one on the same device
+  generation — hit the cache with zero re-sweeps (pinned by
+  ``tests/test_autotune.py`` via these counters).
+- Sweeps are timed latency-cancelled exactly like
+  ``scripts/bench_regime.py``: per candidate, (time of 1+R chained runs)
+  − (time of 1), so the host↔device round-trip cancels and the difference
+  is device time. The grid is bounded by ``KEYSTONE_AUTOTUNE_GRID``
+  candidates and ``KEYSTONE_AUTOTUNE_BUDGET_S`` wall-clock seconds —
+  exhaustion keeps the best-so-far, never blocks the caller.
+- Winners persist in a device-keyed JSON cache
+  (``autotune_cache.json`` at the repo root, next to
+  ``lint_baseline.json``; ``KEYSTONE_AUTOTUNE_CACHE`` overrides the path).
+  A corrupt or unwritable cache degrades to defaults with a warning —
+  tuning is an optimization, never a correctness dependency.
+
+The cache file format (``version`` guards future migrations)::
+
+    {"version": 1,
+     "devices": {
+       "tpu:tpu_v5_lite": {
+         "moments.tile_n": {"any":        {"value": 512, "us": 265.0, "swept": 3}},
+         "sift.bins":      {"16384x256":  {"value": 256, "us": 81.2,  "swept": 4}},
+         "overlap.tiles":  {"4096x8":     {"value": 8,   "us": 50.1,  "swept": 3}}}}}
+
+``value`` is whatever the kernel tunes — a tile height for the row-tiled
+kernels, a tile-count target for the overlap schedulers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from keystone_tpu.utils import knobs
+
+_VERSION = 1
+# RLock: record() calls _warn_once() (which takes the lock for the
+# warned-set) while already holding it for the cache mutation.
+_LOCK = threading.RLock()
+# In-memory mirror of the cache file, keyed by the path it was loaded from
+# so tests that repoint KEYSTONE_AUTOTUNE_CACHE get a fresh load.
+_MEM: Optional[Dict[str, Any]] = None
+_MEM_PATH: Optional[str] = None
+_WARNED: set = set()
+
+
+def _registry():
+    from keystone_tpu.telemetry import get_registry
+
+    return get_registry()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    with _LOCK:
+        # lint: disable=R5 (guarded by _LOCK on the line above)
+        if key in _WARNED:
+            return
+        _WARNED.add(key)
+    print(f"autotune: {msg}", file=sys.stderr)
+
+
+def device_key() -> str:
+    """``backend:device_generation`` — the cache partition key. Tile winners
+    transfer across chips of one generation but not across generations
+    (v4 vs v5e have different VMEM/MXU balances), and never across
+    backends."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", None) or dev.platform
+    slug = re.sub(r"[^a-z0-9]+", "_", str(kind).lower()).strip("_")
+    return f"{jax.default_backend()}:{slug}"
+
+
+def shape_bucket(*dims: int) -> str:
+    """Power-of-two bucket per dimension (``"16384x256"``): shapes within a
+    2x band share one tuned entry, so ragged batch tails don't each trigger
+    their own sweep."""
+    parts = []
+    for d in dims:
+        d = int(d)
+        parts.append(str(1 << max(0, (d - 1).bit_length()) if d > 0 else 0))
+    return "x".join(parts)
+
+
+def cache_path() -> str:
+    """``KEYSTONE_AUTOTUNE_CACHE`` when set, else ``autotune_cache.json`` at
+    the repo root (next to ``lint_baseline.json`` — same ratchet-artifact
+    neighborhood)."""
+    override = knobs.get("KEYSTONE_AUTOTUNE_CACHE")
+    if override:
+        return override
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    )
+    return os.path.join(root, "autotune_cache.json")
+
+
+def _sanitize(raw: Any) -> Optional[Dict[str, Any]]:
+    """Deep-validate a parsed cache file into the canonical shape, pruning
+    malformed branches (hand edits, foreign versions). Returns None when
+    the top level itself is unusable. Every read goes through this one
+    choke point, so downstream code can assume the nesting — tuning must
+    never become a correctness dependency via a crash on a bad file."""
+    if (
+        not isinstance(raw, dict)
+        or raw.get("version") != _VERSION
+        or not isinstance(raw.get("devices"), dict)
+    ):
+        return None
+    devices: Dict[str, Any] = {}
+    pruned = False
+    for dev, kernels in raw["devices"].items():
+        if not isinstance(kernels, dict):
+            pruned = True
+            continue
+        dev_out: Dict[str, Any] = {}
+        for kname, buckets in kernels.items():
+            if not isinstance(buckets, dict):
+                pruned = True
+                continue
+            good = {
+                b: e for b, e in buckets.items()
+                if isinstance(e, dict) and "value" in e
+            }
+            pruned = pruned or len(good) != len(buckets)
+            if good:
+                dev_out[str(kname)] = good
+        if dev_out:
+            devices[str(dev)] = dev_out
+    if pruned:
+        _warn_once(
+            "sanitize", "cache held malformed entries; they were ignored"
+        )
+    return {"version": _VERSION, "devices": devices}
+
+
+def _load_locked(path: str) -> Dict[str, Any]:
+    """Load (or re-load) the cache file into the in-memory mirror. Caller
+    holds ``_LOCK``."""
+    global _MEM, _MEM_PATH
+    if _MEM is not None and _MEM_PATH == path:
+        return _MEM
+    data: Optional[Dict[str, Any]] = None
+    try:
+        with open(path) as f:
+            data = _sanitize(json.load(f))
+        if data is None:
+            _warn_once(
+                f"schema:{path}",
+                f"ignoring {path}: unrecognized schema "
+                f"(expected version={_VERSION}) — starting fresh",
+            )
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError) as e:
+        _warn_once(
+            f"load:{path}", f"ignoring unreadable cache {path}: {e}"
+        )
+    if data is None:
+        data = {"version": _VERSION, "devices": {}}
+    _MEM, _MEM_PATH = data, path
+    return data
+
+
+def clear_memory_cache() -> None:
+    """Drop the in-memory mirror so the next lookup re-reads the file —
+    test hook for pinning the persisted (not in-process) round trip."""
+    global _MEM, _MEM_PATH
+    with _LOCK:
+        # lint: disable=R5 (guarded by the with _LOCK above)
+        _MEM = None
+        _MEM_PATH = None
+
+
+def _peek(kernel: str, bucket: str) -> Optional[Any]:
+    """The persisted winner, without touching any counter — the internal
+    read :func:`lookup` and :func:`resolve` both build on, so each can
+    report exactly ONE outcome for a resolution."""
+    path = cache_path()
+    with _LOCK:
+        data = _load_locked(path)
+        entry = (
+            data["devices"].get(device_key(), {}).get(kernel, {}).get(bucket)
+        )
+    return None if entry is None else entry.get("value")
+
+
+def lookup(kernel: str, bucket: str) -> Optional[Any]:
+    """The persisted winner for ``(kernel, device_key(), bucket)``, or None.
+
+    Pure lookup — never sweeps, never writes; safe to call from eager
+    wrappers on every invocation (the mirror is one dict access) and from
+    non-Pallas consumers like ``overlap._pick_tiles``. Counts
+    ``autotune.cache_hit`` / ``autotune.cache_miss`` per call."""
+    value = _peek(kernel, bucket)
+    if value is None:
+        _registry().inc("autotune.cache_miss", kernel=kernel)
+        return None
+    _registry().inc("autotune.cache_hit", kernel=kernel)
+    return value
+
+
+def record(
+    kernel: str,
+    bucket: str,
+    value: Any,
+    micros: Optional[float] = None,
+    swept: int = 0,
+) -> None:
+    """Persist a winner (atomic tmp+rename). An unwritable cache directory
+    degrades to in-memory-only with a warning — the winner still serves
+    this process.
+
+    The write merges against a FRESH read of the file under an exclusive
+    ``flock`` on a sidecar lockfile, not this process's mirror: two
+    PROCESSES sweeping different kernels concurrently (bench subprocesses,
+    multi-host pod runs sharing a filesystem) must not clobber each
+    other's entries — an entry lost to a stale rewrite would be re-swept
+    on the next run, breaking the zero-re-sweeps contract. (The in-process
+    ``_LOCK`` only serializes threads; the flock covers the
+    read→merge→replace window across processes. Filesystems without flock
+    degrade to best-effort.)"""
+    global _MEM, _MEM_PATH
+    path = cache_path()
+    lockf = None
+    try:
+        import fcntl
+
+        lockf = open(f"{path}.lock", "w")
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+    except Exception:
+        if lockf is not None:
+            lockf.close()
+            lockf = None
+    with _LOCK:
+        mem = _load_locked(path)
+        _MEM = None  # force a fresh disk read under the lock
+        _MEM_PATH = None
+        data = _load_locked(path)
+        # keep this process's in-memory-only winners (e.g. earlier writes
+        # that failed on an unwritable dir) where the disk has no entry
+        for dev, kernels in mem["devices"].items():
+            for kname, buckets in kernels.items():
+                for b, e in buckets.items():
+                    data["devices"].setdefault(dev, {}).setdefault(
+                        kname, {}
+                    ).setdefault(b, e)
+        entry: Dict[str, Any] = {"value": value, "swept": int(swept)}
+        if micros is not None:
+            entry["us"] = round(float(micros), 2)
+        data["devices"].setdefault(device_key(), {}).setdefault(kernel, {})[
+            bucket
+        ] = entry
+        _MEM, _MEM_PATH = data, path
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError as e:
+            _warn_once(
+                f"write:{path}",
+                f"cache not persisted to {path} ({e}); winners serve "
+                "this process only",
+            )
+        finally:
+            if lockf is not None:
+                lockf.close()  # drops the flock
+
+
+def chained_measure(
+    build: Callable[[Any], Callable[[int], Any]],
+) -> Callable[[Any, int], float]:
+    """The one timing protocol every kernel's sweep uses (finding of the
+    review pass: four call sites had hand-copied it). ``build(candidate)``
+    returns ``run(i)`` — one dispatch of the kernel at that candidate,
+    varied by ``i`` so chained dispatches cannot collapse into a cached
+    value. The returned ``measure(candidate, reps)`` warms the compile
+    with one synced run, then times ``reps`` chained dispatches ending in
+    a single sync — the form :func:`sweep`'s latency cancellation
+    expects."""
+    import time
+
+    import jax
+
+    def measure(candidate, reps: int) -> float:
+        run = build(candidate)
+        jax.block_until_ready(run(-1))  # warm compile outside the timing
+        t0 = time.perf_counter()
+        out = None
+        for i in range(reps):
+            out = run(i)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    return measure
+
+
+def sweep(
+    kernel: str,
+    bucket: str,
+    candidates: Sequence[Any],
+    measure: Callable[[Any, int], float],
+    reps: int = 3,
+) -> Any:
+    """Bounded empirical sweep; returns the winner and persists it.
+
+    ``measure(candidate, k)`` runs k chained executions of the kernel at
+    ``candidate`` and returns elapsed seconds (including the final sync);
+    per candidate the score is ``(measure(1+reps) - measure(1)) / reps`` —
+    the latency-cancelled device time of one run
+    (``bench_regime._latency_cancelled_gflops``'s form). A candidate that
+    raises (e.g. a tile the shape cannot support) is skipped, not fatal.
+    The grid is truncated to ``KEYSTONE_AUTOTUNE_GRID`` entries and the
+    sweep stops early once ``KEYSTONE_AUTOTUNE_BUDGET_S`` wall-clock
+    seconds are spent — best-so-far still wins and is persisted."""
+    grid = list(candidates)[: max(1, knobs.get("KEYSTONE_AUTOTUNE_GRID"))]
+    budget_s = knobs.get("KEYSTONE_AUTOTUNE_BUDGET_S")
+    # lint: disable=R1 (this IS the timing harness: sweeps run eagerly by
+    # contract — resolve() refuses to sweep without a measure callback, and
+    # callers only pass one from eager wrappers)
+    t0 = time.monotonic()
+    best, best_dt, tried = None, None, 0
+    for cand in grid:
+        # lint: disable=R1 (budget clock of the eager sweep harness)
+        if tried and time.monotonic() - t0 > budget_s:
+            _warn_once(
+                f"budget:{kernel}:{bucket}",
+                f"{kernel}[{bucket}]: sweep budget {budget_s}s exhausted "
+                f"after {tried}/{len(grid)} candidates",
+            )
+            break
+        try:
+            t1 = measure(cand, 1)
+            tn = measure(cand, 1 + reps)
+            dt = (tn - t1) / reps
+            if dt <= 0:  # timing noise: fall back to the mean-per-run form
+                dt = tn / (1 + reps)
+        except Exception as e:
+            _warn_once(
+                f"cand:{kernel}:{bucket}:{cand}",
+                f"{kernel}[{bucket}]: candidate {cand!r} failed "
+                f"({type(e).__name__}: {e}); skipped",
+            )
+            continue
+        tried += 1
+        if best_dt is None or dt < best_dt:
+            best, best_dt = cand, dt
+    if best is None:
+        # no counter here: resolve() falls through to the default path,
+        # which fires the single outcome counter for this resolution
+        _warn_once(
+            f"empty:{kernel}:{bucket}",
+            f"{kernel}[{bucket}]: every candidate failed; keeping default",
+        )
+        return None
+    _registry().inc("autotune.sweep", kernel=kernel)
+    record(
+        kernel, bucket, best,
+        micros=best_dt * 1e6 if best_dt else None, swept=tried,
+    )
+    return best
+
+
+def resolve(
+    kernel: str,
+    bucket: str,
+    candidates: Sequence[Any],
+    default: Any,
+    measure: Optional[Callable[[Any, int], float]] = None,
+) -> Any:
+    """The one tile-resolution path every Pallas kernel uses.
+
+    Persisted winner → served (``autotune.cache_hit``), but only when it
+    is still in this call's ``candidates``: callers constrain candidates
+    by the ACTUAL shape (VMEM fit bounds), and shapes within one pow2
+    bucket differ up to 2x per dim — a winner swept at the small end of a
+    bucket may overflow VMEM at the large end, so an out-of-grid hit is
+    treated as a miss rather than served. Miss with ``KEYSTONE_AUTOTUNE=1``
+    and a ``measure`` callback → sweep once, persist, serve. Miss
+    otherwise → the declared ``default`` (``autotune.default``). Must be
+    called from EAGER wrappers only — the result feeds jit-static block
+    shapes, and a sweep times real executions. Exactly ONE outcome counter
+    fires per resolution: ``cache_hit``, ``sweep`` (inside :func:`sweep`),
+    or ``default`` — a rejected out-of-grid winner counts as whatever path
+    actually served."""
+    hit = _peek(kernel, bucket)
+    if hit is not None and (not candidates or hit in candidates):
+        _registry().inc("autotune.cache_hit", kernel=kernel)
+        return hit
+    if measure is not None and knobs.get("KEYSTONE_AUTOTUNE"):
+        won = sweep(kernel, bucket, candidates, measure)
+        if won is not None:
+            return won
+    _registry().inc("autotune.default", kernel=kernel)
+    return default

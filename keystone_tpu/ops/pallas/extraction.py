@@ -1,0 +1,633 @@
+"""Fused Pallas kernels for the per-item extraction hot paths.
+
+KeystoneML ran SIFT, Fisher-vector encoding, convolution and pooling in its
+native C++/JNI layer (PAPER.md layer map) because generic execution was too
+slow; our port composes XLA ops, which is correct but leaves HBM traffic on
+the table in exactly the same places. This module is the kernel family that
+closes that gap, following the ``ops/pallas/moments.py`` pattern: VMEM
+BlockSpecs, padded tiles with mask poison, ``interpret=`` fallback so the
+same kernels run (and are parity-tested) on CPU, and jit-static gating so
+``KEYSTONE_PALLAS=0`` restores the exact prior XLA program.
+
+Kernels and their XLA twins (the twin is always the pre-existing path):
+
+====================  =============================================  ========
+kernel                fuses                                          default
+====================  =============================================  ========
+``sift.bins``         orientation binning × column-selection matmul  auto
+                      (kills the (..., 8, H, W) energy tensor)
+``fv.encode``         posterior softmax × moment accumulation per    auto
+                      image (kills the (n, n_desc, k) posteriors)
+``conv.norm``         im2col matmul + per-patch mean/sd              explicit
+                      normalization + whitener shift (kills raw/
+                      s1/s2 intermediates)
+``pool.sum``          pixel-function + separable sum-pool selection  explicit
+                      matmuls (max pooling stays on the XLA twin)
+====================  =============================================  ========
+
+"auto" kernels engage on TPU under the default ``KEYSTONE_PALLAS=auto``;
+"explicit" kernels (rank-3 in-VMEM contractions the moments kernel never
+exercised on real silicon) engage only under ``KEYSTONE_PALLAS=1`` until a
+pod run validates their lowering — the same measured-promotion discipline
+``gmm_moments_auto`` applied. Tile heights come from the device-keyed
+autotuner (``ops/pallas/autotune.py``); every tile argument is jit-static.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from keystone_tpu.ops.pallas import autotune
+from keystone_tpu.utils import knobs
+
+_LANE = 128
+NUM_BIN_T = 8  # SIFT orientation bins (mirrors ops/images/sift.py)
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def pallas_enabled(auto_ok: bool = True) -> bool:
+    """Knob-resolved kernel/twin selection (``KEYSTONE_PALLAS``).
+
+    ``"1"`` forces every kernel on (interpret mode off-TPU — the parity-test
+    configuration); ``"0"`` forces every kernel off (the HLO-level-no-op
+    contract: twins are the untouched prior code paths); ``"auto"`` (the
+    default) engages only the auto-grade kernels (``auto_ok=True``) and only
+    on TPU. Read this EAGERLY and thread the decision through jit as a
+    static argument — an env read inside a traced body bakes stale state
+    (the PR-6 tiers lesson)."""
+    v = knobs.get("KEYSTONE_PALLAS")
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    return auto_ok and jax.default_backend() == "tpu"
+
+
+def default_interpret() -> bool:
+    """Pallas interpret mode everywhere but real TPU (the moments-kernel
+    convention): the same kernel code path is exercised by the CPU test
+    mesh."""
+    return jax.default_backend() != "tpu"
+
+
+def _count(event: str, **labels) -> None:
+    """``pallas.engaged{kernel}`` / ``pallas.fallback{kernel,reason}`` —
+    the overlap-layer convention: tests and the bench can see which
+    kernels actually ran without scraping logs. Entry wrappers count once
+    per trace (they run at trace time under jit), so the counters report
+    engagement decisions, not per-dispatch volume."""
+    from keystone_tpu.telemetry import get_registry
+
+    get_registry().inc(f"pallas.{event}", **labels)
+
+
+# ---------------------------------------------------------------------------
+# SIFT: fused orientation binning × column-selection matmul
+# ---------------------------------------------------------------------------
+#
+# The XLA matmul path materializes the orientation-energy tensor
+# (..., 8, H, W) in HBM — an 8x blowup of the (smoothed) image — before the
+# first selection matmul consumes it. The kernel streams (mag, angle) row
+# tiles HBM→VMEM once, expands the 8 orientation maps in VMEM, and
+# immediately contracts each against the column-selection matrix, so only
+# the (..., 8, H, nx*4)-shaped result (typically ~Q/W the size) ever leaves
+# the chip.
+
+
+def _sift_bins_kernel(mag_ref, ang_ref, sel_ref, out_ref, *, q_pad: int):
+    mag = mag_ref[:]  # (TR, W)
+    ang = ang_ref[:]
+    ft = jnp.mod(ang * (NUM_BIN_T / (2.0 * jnp.pi)), NUM_BIN_T)
+    sel = sel_ref[:]  # (W, Qp); padded columns are zero -> poison-free
+    for t in range(NUM_BIN_T):
+        d = jnp.mod(ft - float(t), NUM_BIN_T)
+        w = jnp.maximum(0.0, 1.0 - d) + jnp.maximum(
+            0.0, d - (NUM_BIN_T - 1.0)
+        )
+        out_ref[:, t * q_pad : (t + 1) * q_pad] = jnp.dot(
+            mag * w, sel, preferred_element_type=jnp.float32
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("tile_r", "interpret"))
+def _sift_bins_pallas(mag2, ang2, sel_p, *, tile_r: int, interpret: bool):
+    rows, w = mag2.shape
+    q_pad = sel_p.shape[1]
+    grid = (pl.cdiv(rows, tile_r),)
+    rows_pad = _round_up(rows, tile_r)
+    # Ragged final tile: input reads past ``rows`` return garbage lanes
+    # (the proven moments-sep pattern) whose computation is row-local and
+    # lands in output rows >= ``rows`` — trimmed by the caller. The padded
+    # ``sel`` columns are zero, so lane padding in Q is poison-free too.
+    return pl.pallas_call(
+        functools.partial(_sift_bins_kernel, q_pad=q_pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_r, w), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_r, w), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((w, q_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_r, NUM_BIN_T * q_pad), lambda i: (i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (rows_pad, NUM_BIN_T * q_pad), jnp.float32
+        ),
+        interpret=interpret,
+    )(mag2, ang2, sel_p)
+
+
+def sift_bins_tile(rows: int, width: int, q: int,
+                   allow_sweep: bool = True) -> int:
+    """Autotuned row-tile height for ``sift.bins`` at this shape bucket.
+    ``allow_sweep=False`` is lookup-only — pass it when resolving from
+    inside a trace (a sweep times real executions)."""
+    bucket = autotune.shape_bucket(rows, width)
+    q_pad = _round_up(max(q, 1), _LANE)
+
+    def build(tile):
+        key = jax.random.key(0)
+        mag = jax.random.uniform(key, (rows, width), jnp.float32)
+        ang = jax.random.uniform(
+            key, (rows, width), jnp.float32, -jnp.pi, jnp.pi
+        )
+        sel = jnp.zeros((width, q_pad), jnp.float32).at[:, :q].set(1.0)
+        interp = default_interpret()
+        return lambda i: _sift_bins_pallas(
+            mag + float(i), ang, sel, tile_r=tile, interpret=interp
+        )
+
+    candidates = [t for t in (128, 256, 512, 1024) if t <= max(rows, 128)]
+    return autotune.resolve(
+        "sift.bins", bucket, candidates or [128], 256,
+        measure=autotune.chained_measure(build) if allow_sweep else None,
+    )
+
+
+def sift_oriented_bins(mag, angle, sel: np.ndarray, *, tile_r: int = 256,
+                       interpret: Optional[bool] = None):
+    """Fused ``energies @ sel`` without materializing the energies:
+    (..., H, W) magnitude/orientation + (W, Q) 0/1 selection matrix ->
+    (..., NUM_BIN_T, H, Q). Traceable (called inside the SIFT extractor's
+    jit); ``tile_r`` must already be resolved (jit-static)."""
+    lead = mag.shape[:-2]
+    h, w = mag.shape[-2], mag.shape[-1]
+    q = sel.shape[1]
+    q_pad = _round_up(max(q, 1), _LANE)
+    sel_p = jnp.zeros((w, q_pad), jnp.float32).at[:, :q].set(
+        jnp.asarray(sel, jnp.float32)
+    )
+    rows = int(np.prod(lead, dtype=np.int64)) * h if lead else h
+    mag2 = mag.reshape(rows, w).astype(jnp.float32)
+    ang2 = angle.reshape(rows, w).astype(jnp.float32)
+    if interpret is None:
+        interpret = default_interpret()
+    _count("engaged", kernel="sift.bins")
+    out = _sift_bins_pallas(
+        mag2, ang2, sel_p, tile_r=int(tile_r), interpret=bool(interpret)
+    )
+    out = out[:rows].reshape(*lead, h, NUM_BIN_T, q_pad)[..., :q]
+    return jnp.moveaxis(out, -2, -3)  # (..., T, H, Q)
+
+
+# ---------------------------------------------------------------------------
+# Fisher vector: fused posterior softmax × per-image moment accumulation
+# ---------------------------------------------------------------------------
+#
+# The XLA batch encoder materializes the (n_img, n_desc, k) posterior tensor
+# between the log-density gemm and the moment einsums. Per grid step this
+# kernel holds one (tile_nd, d) descriptor tile in VMEM, computes its
+# posterior rows, and folds them straight into the per-image (k, d)
+# accumulators — posteriors never reach HBM. Gradient formulas (the actual
+# Fisher encode) are a cheap XLA epilogue over the (n_img, k, d) moments.
+
+
+def _fv_moments_kernel(
+    x_ref, a_ref, b_ref, c_ref, qsum_ref, qx_ref, qx2_ref, *, n_desc: int
+):
+    j = pl.program_id(1)  # descriptor tile (fastest grid axis)
+
+    @pl.when(j == 0)
+    def _():
+        qsum_ref[:] = jnp.zeros_like(qsum_ref)
+        qx_ref[:] = jnp.zeros_like(qx_ref)
+        qx2_ref[:] = jnp.zeros_like(qx2_ref)
+
+    x = x_ref[0]  # (TND, d)
+    tile_nd = x.shape[0]
+    row_ids = j * tile_nd + jax.lax.broadcasted_iota(
+        jnp.int32, (tile_nd, 1), 0
+    )
+    valid = row_ids < n_desc  # False in the ragged final tile
+    x = jnp.where(valid, x, 0.0)  # poison OOB garbage before it hits x**2
+    x2 = x * x
+    ll = (
+        jnp.dot(x, a_ref[:], preferred_element_type=jnp.float32)
+        + jnp.dot(x2, b_ref[:], preferred_element_type=jnp.float32)
+        + c_ref[:]
+    )  # (TND, Kp); padded centers carry c = -1e30 -> softmax ~ 0
+    m = jnp.max(ll, axis=1, keepdims=True)
+    e = jnp.exp(ll - m)
+    q = e / jnp.sum(e, axis=1, keepdims=True)
+    q = jnp.where(valid, q, 0.0)  # padded descriptor rows contribute nothing
+
+    qsum_ref[:] += jnp.sum(q, axis=0, keepdims=True)
+    qt = q.T  # (Kp, TND)
+    qx_ref[0] += jnp.dot(qt, x, preferred_element_type=jnp.float32)
+    qx2_ref[0] += jnp.dot(qt, x2, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_nd", "interpret"))
+def _fv_moments_pallas(x, A, B, c, *, tile_nd: int, interpret: bool):
+    n_img, nd, d = x.shape
+    k_pad = A.shape[1]
+    grid = (n_img, pl.cdiv(nd, tile_nd))
+    return pl.pallas_call(
+        functools.partial(_fv_moments_kernel, n_desc=nd),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, tile_nd, d), lambda i, j: (i, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((d, k_pad), lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, k_pad), lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k_pad), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, k_pad, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, k_pad, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_img, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((n_img, k_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((n_img, k_pad, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, A, B, c)
+
+
+def fv_encode_tile(nd: int, d: int, k: int,
+                   allow_sweep: bool = True) -> int:
+    """Autotuned descriptor-tile height for ``fv.encode``.
+    ``allow_sweep=False`` is lookup-only (resolution from inside a
+    trace)."""
+    bucket = autotune.shape_bucket(nd, d, k)
+    k_pad = _round_up(max(k, 1), _LANE)
+
+    def build(tile):
+        key = jax.random.key(1)
+        x = jax.random.normal(key, (2, nd, d), jnp.float32)
+        A = jax.random.normal(key, (d, k_pad), jnp.float32) * 0.1
+        B = -jnp.abs(jax.random.normal(key, (d, k_pad), jnp.float32)) * 0.1
+        c = jnp.zeros((1, k_pad), jnp.float32)
+        interp = default_interpret()
+        return lambda i: _fv_moments_pallas(
+            x + float(i) * 1e-3, A, B, c, tile_nd=tile, interpret=interp
+        )
+
+    candidates = [t for t in (64, 128, 256, 512) if t <= _round_up(nd, 64)]
+    return autotune.resolve(
+        "fv.encode", bucket, candidates or [64], 256,
+        measure=autotune.chained_measure(build) if allow_sweep else None,
+    )
+
+
+def fv_moments(x, means, variances, weights, *, tile_nd: int = 256,
+               interpret: Optional[bool] = None):
+    """Per-image uncentered GMM moments without HBM posteriors:
+    (n_img, nd, d) descriptors -> ``(qsum (n,k), qx (n,k,d), qx2 (n,k,d))``.
+    Traceable; the caller resolves ``tile_nd`` eagerly (jit-static). Same
+    affine log-density as every other moments path (``_affine_params`` —
+    the single source of truth the parity tests pin)."""
+    from keystone_tpu.ops.pallas.moments import _prep_params
+
+    x = jnp.asarray(x, jnp.float32)
+    d = x.shape[2]
+    k = means.shape[0]
+    k_pad = _round_up(k, _LANE)
+    A, B, c = _prep_params(
+        jnp.asarray(means, jnp.float32),
+        jnp.asarray(variances, jnp.float32),
+        jnp.asarray(weights, jnp.float32),
+        d, k_pad,
+    )
+    if interpret is None:
+        interpret = default_interpret()
+    _count("engaged", kernel="fv.encode")
+    qsum, qx, qx2 = _fv_moments_pallas(
+        x, A, B, c, tile_nd=int(tile_nd), interpret=bool(interpret)
+    )
+    return qsum[:, :k], qx[:, :k], qx2[:, :k]
+
+
+# ---------------------------------------------------------------------------
+# Convolver: fused im2col matmul + per-patch normalization
+# ---------------------------------------------------------------------------
+#
+# The XLA twin runs three convolutions (raw, patch-sum, patch-sum-of-
+# squares) over the batch and fuses the normalization arithmetic; each conv
+# re-reads the image from HBM and the raw result round-trips before the
+# epilogue. The kernel holds ONE image in VMEM per grid step, accumulates
+# the k² shifted matmuls and the patch statistics in-register, applies the
+# normalization and whitener shift, and writes only the finished output
+# tile. Filter columns are tiled (``tile_f``) so the accumulator fits VMEM.
+
+
+def _conv_norm_kernel(
+    x_ref, f_ref, fsum_ref, mf_ref, out_ref,
+    *, ksz: int, chans: int, res_h: int, res_w: int,
+    normalize: bool, var_constant: float,
+):
+    x = x_ref[0]  # (H, W, C)
+    tile_f = f_ref.shape[3]
+    p = res_h * res_w
+    acc = jnp.zeros((p, tile_f), jnp.float32)
+    s1 = jnp.zeros((p, 1), jnp.float32)
+    s2 = jnp.zeros((p, 1), jnp.float32)
+    for dy in range(ksz):
+        for dx in range(ksz):
+            xs = x[dy : dy + res_h, dx : dx + res_w, :].reshape(p, chans)
+            acc += jnp.dot(
+                xs, f_ref[dy, dx], preferred_element_type=jnp.float32
+            )
+            if normalize:
+                s1 += jnp.sum(xs, axis=1, keepdims=True)
+                s2 += jnp.sum(xs * xs, axis=1, keepdims=True)
+    out = acc
+    if normalize:
+        n = float(ksz * ksz * chans)
+        mean = s1 / n
+        var = (s2 - s1 * mean) / (n - 1.0)
+        sd = jnp.sqrt(var + var_constant)
+        out = (acc - mean * fsum_ref[:]) / sd
+    out_ref[0] = out - mf_ref[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "ksz", "chans", "res_h", "res_w", "normalize", "var_constant",
+        "tile_f", "interpret",
+    ),
+)
+def _conv_norm_pallas(
+    imgs, filt, fsum, mf, *, ksz: int, chans: int, res_h: int, res_w: int,
+    normalize: bool, var_constant: float, tile_f: int, interpret: bool,
+):
+    n, h, w, _ = imgs.shape
+    nf_pad = filt.shape[3]
+    grid = (n, nf_pad // tile_f)
+    p = res_h * res_w
+    out = pl.pallas_call(
+        functools.partial(
+            _conv_norm_kernel, ksz=ksz, chans=chans, res_h=res_h,
+            res_w=res_w, normalize=normalize, var_constant=var_constant,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, h, w, chans), lambda i, f: (i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (ksz, ksz, chans, tile_f), lambda i, f: (0, 0, 0, f),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, tile_f), lambda i, f: (0, f), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_f), lambda i, f: (0, f), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, p, tile_f), lambda i, f: (i, 0, f), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, p, nf_pad), jnp.float32),
+        interpret=interpret,
+    )(imgs, filt, fsum, mf)
+    return out
+
+
+_CONV_VMEM_BUDGET = 12 << 20  # conservative f32 working-set bound per step
+
+
+def conv_norm_tile(h: int, w: int, chans: int, ksz: int, nf: int,
+                   allow_sweep: bool = True):
+    """Autotuned filter-tile width for ``conv.norm``, constrained to tiles
+    whose per-step working set fits the VMEM budget. Returns None when no
+    candidate fits (caller falls back to the XLA twin).
+    ``allow_sweep=False`` is lookup-only."""
+    res_h, res_w = h - ksz + 1, w - ksz + 1
+    p = res_h * res_w
+
+    def fits(tf: int) -> bool:
+        est = 4 * (
+            h * w * chans            # resident image
+            + ksz * ksz * chans * tf  # filter tile
+            + 3 * p * tf              # acc + epilogue temporaries
+            + 2 * p                   # s1 / s2
+        )
+        return est < _CONV_VMEM_BUDGET
+
+    candidates = [t for t in (64, 128, 256, 512) if fits(t)]
+    if not candidates:
+        _count("fallback", kernel="conv.norm", reason="vmem")
+        return None
+    bucket = autotune.shape_bucket(h, w, nf)
+
+    def build(tile):
+        key = jax.random.key(2)
+        xi = jax.random.uniform(key, (2, h, w, chans), jnp.float32)
+        nf_pad = _round_up(nf, tile)
+        fi = jax.random.normal(key, (ksz, ksz, chans, nf_pad), jnp.float32)
+        fs = jnp.sum(fi.reshape(-1, nf_pad), axis=0, keepdims=True)
+        mfz = jnp.zeros((1, nf_pad), jnp.float32)
+        args = dict(
+            ksz=ksz, chans=chans, res_h=res_h, res_w=res_w, normalize=True,
+            var_constant=10.0, tile_f=tile, interpret=default_interpret(),
+        )
+        return lambda i: _conv_norm_pallas(
+            xi + float(i) * 1e-3, fi, fs, mfz, **args
+        )
+
+    return autotune.resolve(
+        "conv.norm", bucket, candidates, candidates[0],
+        measure=autotune.chained_measure(build) if allow_sweep else None,
+    )
+
+
+def conv_norm(imgs, filters, *, num_channels: int, normalize: bool,
+              var_constant: float, whitener_means=None, tile_f: int = 128,
+              interpret: Optional[bool] = None):
+    """Fused Convolver forward: (N, H, W, C) images + (nF, k·k·C) filters
+    (reference patch layout) -> (N, resH, resW, nF). Traceable; ``tile_f``
+    pre-resolved via :func:`conv_norm_tile`."""
+    imgs = jnp.asarray(imgs, jnp.float32)
+    n, h, w, c = imgs.shape
+    nf = filters.shape[0]
+    k2 = filters.shape[1] // num_channels
+    ksz = int(round(k2**0.5))
+    res_h, res_w = h - ksz + 1, w - ksz + 1
+    tile_f = int(tile_f)
+    nf_pad = _round_up(nf, tile_f)
+    filt = jnp.zeros((nf_pad, ksz * ksz * c), jnp.float32).at[:nf].set(
+        jnp.asarray(filters, jnp.float32)
+    )
+    # padded filters are all-zero -> their output columns are exactly
+    # -mf_pad = 0 after the normalization arithmetic; trimmed below anyway
+    filt = filt.reshape(nf_pad, ksz, ksz, c).transpose(1, 2, 3, 0)
+    fsum = jnp.sum(filt.reshape(-1, nf_pad), axis=0, keepdims=True)
+    mf = jnp.zeros((1, nf_pad), jnp.float32)
+    if whitener_means is not None:
+        mf = mf.at[:, :nf].set(
+            (jnp.asarray(whitener_means, jnp.float32) @ filters.T)[None]
+        )
+    if interpret is None:
+        interpret = default_interpret()
+    _count("engaged", kernel="conv.norm")
+    out = _conv_norm_pallas(
+        imgs, filt, fsum, mf, ksz=ksz, chans=c, res_h=res_h, res_w=res_w,
+        normalize=bool(normalize), var_constant=float(var_constant),
+        tile_f=tile_f, interpret=bool(interpret),
+    )
+    return out.reshape(n, res_h, res_w, nf_pad)[..., :nf]
+
+
+# ---------------------------------------------------------------------------
+# Pooler: fused pixel-function + separable sum-pool selection matmuls
+# ---------------------------------------------------------------------------
+#
+# Sum pooling over clamped windows is separable into two 0/1 selection
+# matmuls (the ``_bin_select_matrix`` trick): out = Myᵀ · f(img) · Mx per
+# channel. The kernel applies the elementwise pixel function and both
+# contractions in VMEM, so the f(img) intermediate never reaches HBM.
+# Max pooling is not a matmul; it stays on the XLA reduce_window twin.
+
+
+def pool_select_matrix(dim: int, stride: int, pool_size: int) -> np.ndarray:
+    """(dim, num_pools) 0/1 matrix: column p sums pixels
+    [p·stride, p·stride + pool_size) ∩ [0, dim) — the clamped windows of
+    ``Pooler`` (``_pool_geometry``), exactly (clamping = missing rows)."""
+    stride_start = pool_size // 2
+    num_pools = -(-(dim - stride_start) // stride)
+    m = np.zeros((dim, num_pools), np.float32)
+    for pi in range(num_pools):
+        lo = pi * stride
+        hi = min(lo + pool_size, dim)
+        m[lo:hi, pi] = 1.0
+    return m
+
+
+def _pool_sum_kernel(x_ref, my_ref, mx_ref, out_ref, *, pixel_fn):
+    y = x_ref[0]  # (H, W, TC)
+    if pixel_fn is not None:
+        y = pixel_fn(y)
+    h, w, tc = y.shape
+    p = my_ref.shape[1]
+    q = mx_ref.shape[1]
+    # contract H: (P, H) @ (H, W·TC) — one clean 2D matmul
+    t1 = jnp.dot(
+        my_ref[:].T, y.reshape(h, w * tc), preferred_element_type=jnp.float32
+    ).reshape(p, w, tc)
+    # contract W: regroup channels-major so the second contraction is 2D too
+    t2 = jnp.dot(
+        jnp.transpose(t1, (0, 2, 1)).reshape(p * tc, w),
+        mx_ref[:],
+        preferred_element_type=jnp.float32,
+    ).reshape(p, tc, q)
+    out_ref[0] = jnp.transpose(t2, (0, 2, 1))  # (P, Q, TC)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("pixel_fn", "tile_c", "interpret")
+)
+def _pool_sum_pallas(imgs, my, mx, *, pixel_fn, tile_c: int, interpret: bool):
+    n, h, w, c_pad = imgs.shape
+    p, q = my.shape[1], mx.shape[1]
+    grid = (n, c_pad // tile_c)
+    return pl.pallas_call(
+        functools.partial(_pool_sum_kernel, pixel_fn=pixel_fn),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, h, w, tile_c), lambda i, cc: (i, 0, 0, cc),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((h, p), lambda i, cc: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((w, q), lambda i, cc: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, p, q, tile_c), lambda i, cc: (i, 0, 0, cc),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, p, q, c_pad), jnp.float32),
+        interpret=interpret,
+    )(imgs, my, mx)
+
+
+_POOL_VMEM_BUDGET = 8 << 20  # f32 bound on the per-step input block
+
+
+def pool_block_fits(h: int, w: int, c: int) -> bool:
+    """Whether one (H, W, c) f32 block fits the pool kernel's VMEM budget
+    — the eligibility bound for the untiled (pixel-function) form."""
+    return 4 * h * w * c < _POOL_VMEM_BUDGET
+
+
+def pool_sum_tile(h: int, w: int, c: int):
+    """Autotuned channel-tile width for ``pool.sum``, or None when no
+    candidate fits the VMEM budget (caller falls back to the XLA twin —
+    the same contract as :func:`conv_norm_tile`). EAGER-only."""
+    candidates = [
+        t for t in (64, 128, 256, 512) if pool_block_fits(h, w, t)
+    ]
+    if not candidates:
+        _count("fallback", kernel="pool.sum", reason="vmem")
+        return None
+    return autotune.resolve(
+        "pool.sum", autotune.shape_bucket(h, w, c), candidates,
+        candidates[0], measure=None,
+    )
+
+
+def pool_sum(imgs, stride: int, pool_size: int,
+             pixel_fn: Optional[Callable] = None, *, tile_c: int = 128,
+             interpret: Optional[bool] = None):
+    """Fused sum-Pooler forward over a batch: (N, H, W, C) -> (N, P, Q, C).
+    ``pixel_fn`` must be shape/dtype-preserving (checked by the caller via
+    ``eval_shape``); when one is present the kernel never tiles or pads
+    the channel axis — each grid step hands the function the FULL
+    (H, W, C) block, so even a channel-mixing function stays correct."""
+    imgs = jnp.asarray(imgs, jnp.float32)
+    n, h, w, c = imgs.shape
+    if pixel_fn is not None:
+        tile_c = c_pad = c
+    else:
+        tile_c = int(min(tile_c, _round_up(c, 8)))
+        c_pad = _round_up(c, tile_c)
+    if c_pad != c:
+        imgs = jnp.pad(imgs, ((0, 0), (0, 0), (0, 0), (0, c_pad - c)))
+    my = jnp.asarray(pool_select_matrix(h, stride, pool_size))
+    mx = jnp.asarray(pool_select_matrix(w, stride, pool_size))
+    if interpret is None:
+        interpret = default_interpret()
+    _count("engaged", kernel="pool.sum")
+    out = _pool_sum_pallas(
+        imgs, my, mx, pixel_fn=pixel_fn, tile_c=tile_c,
+        interpret=bool(interpret),
+    )
+    return out[..., :c]

@@ -51,15 +51,45 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Row-tile height: multiple of the f32 sublane (8); 512 amortizes the matmul
-# well while keeping the q tile (512×k_pad) comfortably in VMEM.
-_TILE_N = 512
+# Row-tile height default: multiple of the f32 sublane (8); 512 amortizes
+# the matmul well while keeping the q tile (512×k_pad) comfortably in VMEM.
+# The ACTUAL tile is resolved through the shared device-keyed autotuner
+# (:func:`_tile_n` -> ``ops/pallas/autotune.py``) so a swept winner for this
+# device generation beats the hard-coded default.
+_TILE_N_DEFAULT = 512
+_TILE_N_CANDIDATES = (256, 512, 1024)
 _LANE = 128
 _SUBLANE = 8
 
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _tile_n(measure=None) -> int:
+    """Row-tile height via the shared tile-resolution path. Lookup-only by
+    default (``moments_from_aug`` runs inside the jitted EM loop — a sweep
+    there would time kernels at trace time); the eager one-shot entry
+    (:func:`gmm_moments_sep`) passes a ``measure`` so ``KEYSTONE_AUTOTUNE=1``
+    sweeps once and persists. Bucket is ``"any"``: the winning row tile is a
+    device-generation property (VMEM/MXU balance), not a shape property —
+    and a single value keeps :func:`augment_rows` padding and the kernel
+    grid consistent by construction."""
+    from keystone_tpu.ops.pallas import autotune
+
+    return int(autotune.resolve(
+        "moments.tile_n", "any", _TILE_N_CANDIDATES, _TILE_N_DEFAULT,
+        measure=measure,
+    ))
+
+
+def _fit_tile(n_pad: int, tile: int) -> int:
+    """Largest power-of-two halving of ``tile`` dividing ``n_pad`` — guards
+    the augmented kernel's exact grid when the sample was padded under a
+    different (older/smaller) persisted tile than the current resolution."""
+    while tile > _SUBLANE and n_pad % tile:
+        tile //= 2
+    return max(tile, _SUBLANE)
 
 
 def _moments_kernel(x_ref, a_ref, b_ref, c_ref, qx_ref, qx2_ref):
@@ -89,16 +119,16 @@ def _moments_kernel(x_ref, a_ref, b_ref, c_ref, qx_ref, qx2_ref):
     qx2_ref[:] += jnp.dot(qt, x2, preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _moments_pallas(x_aug, A, B, c, *, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+def _moments_pallas(x_aug, A, B, c, *, tile_n: int, interpret: bool):
     n_pad, d_pad = x_aug.shape
     k_pad = A.shape[1]
-    grid = (n_pad // _TILE_N,)
+    grid = (n_pad // tile_n,)
     qx, qx2 = pl.pallas_call(
         _moments_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TILE_N, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((d_pad, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((d_pad, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -164,17 +194,17 @@ def _moments_kernel_sep(
     qx2_ref[:] += jnp.dot(qt, x2, preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _moments_pallas_sep(x, w, center, A, B, c, *, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+def _moments_pallas_sep(x, w, center, A, B, c, *, tile_n: int, interpret: bool):
     n, d_pad = x.shape
     k_pad = A.shape[1]
-    grid = (pl.cdiv(n, _TILE_N),)
+    grid = (pl.cdiv(n, tile_n),)
     qsum, qx, qx2 = pl.pallas_call(
         functools.partial(_moments_kernel_sep, n_rows=n),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TILE_N, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_TILE_N, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((d_pad, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((d_pad, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -223,7 +253,7 @@ def gmm_moments_sep(
         center = jnp.mean(x, axis=0)
     k = means.shape[0]
     k_pad = _round_up(k, _LANE)
-    if n < _TILE_N:
+    if n < min(_TILE_N_CANDIDATES):
         # A single sub-tile call gains nothing from Pallas; one small XLA
         # program is cheaper than a one-tile kernel launch.
         return gmm_moments_xla(x, means, variances, weights, row_weights,
@@ -237,8 +267,23 @@ def gmm_moments_sep(
         d,
         k_pad,
     )
+    ctr = center.reshape(1, d)
+
+    def _build(tile):
+        # the sweep times THIS call's actual operands — the sweep is the
+        # workload (only reached eagerly, on KEYSTONE_AUTOTUNE=1 + miss)
+        return lambda i: _moments_pallas_sep(
+            x, w, ctr, A, B, c, tile_n=int(tile), interpret=bool(interpret)
+        )
+
+    from keystone_tpu.ops.pallas import autotune as _autotune
+
+    tile_n = _tile_n(measure=_autotune.chained_measure(_build))
+    if n < tile_n:
+        return gmm_moments_xla(x, means, variances, weights, row_weights,
+                               center)
     qsum_p, qxc, qxc2 = _moments_pallas_sep(
-        x, w, center.reshape(1, d), A, B, c, interpret=bool(interpret)
+        x, w, ctr, A, B, c, tile_n=tile_n, interpret=bool(interpret)
     )
     return _uncenter(qsum_p[0, :k], qxc[:k], qxc2[:k], center)
 
@@ -291,10 +336,14 @@ def augment_rows(
     rows to the tile height; the last two columns are the per-row weight
     (scales q in-kernel; 0 for padding rows) and a constant 1 (yields
     qsum). Build this ONCE outside any EM loop — it is loop-invariant.
+    Rows are padded to the autotuned tile height (lookup-only; see
+    :func:`_tile_n` — :func:`moments_from_aug` re-fits its grid tile to the
+    padded row count, so a tile change between the two calls stays exact).
     """
     n, d = xc.shape
     d_tot = _round_up(d + 2, _LANE)
-    n_pad = _round_up(max(n, _TILE_N), _TILE_N)
+    tile = _tile_n()
+    n_pad = _round_up(max(n, tile), tile)
     w = jnp.ones((n,), jnp.float32) if row_weights is None else row_weights
     x_aug = jnp.zeros((n_pad, d_tot), jnp.float32)
     x_aug = x_aug.at[:n, :d].set(xc)
@@ -327,7 +376,10 @@ def moments_from_aug(
         d_tot,
         k_pad,
     )
-    qx_full, qx2_full = _moments_pallas(x_aug, A, B, c, interpret=bool(interpret))
+    tile_n = _fit_tile(x_aug.shape[0], _tile_n())
+    qx_full, qx2_full = _moments_pallas(
+        x_aug, A, B, c, tile_n=tile_n, interpret=bool(interpret)
+    )
     qsum = qx_full[:k, d_tot - 1]  # the ones column of q^T x_aug
     return qsum, qx_full[:k, :d], qx2_full[:k, :d]
 

@@ -185,17 +185,41 @@ def _env_tiles() -> Tuple[Optional[int], Optional[int]]:
     return parsed
 
 
+def _autotuned_tiles(dim: int, k: int) -> Optional[int]:
+    """Device-keyed autotuner default for the tile-count target
+    (``ops/pallas/autotune.py``, kernel id ``overlap.tiles``): a swept
+    winner for this (dim, k) shape bucket on this device generation, or
+    None. Lookup-only — the scheduler itself never times; winners are
+    recorded by the ``solver_overlap`` bench regime's gram sweep
+    (``scripts/bench_regime.py``, multi-device runs) or by pod tooling
+    via ``autotune.sweep``/``record``. The resolution order stays:
+    explicit ``tiles=`` arg beats the ``KEYSTONE_OVERLAP_TILES`` env
+    override beats this default beats the axis-size heuristic."""
+    try:
+        from keystone_tpu.ops.pallas import autotune
+
+        val = autotune.lookup(
+            "overlap.tiles", autotune.shape_bucket(dim, k)
+        )
+        return int(val) if val else None
+    except Exception:  # tuning must never break a solver schedule
+        return None
+
+
 def _pick_tiles(dim: int, k: int, target: Optional[int] = None) -> int:
     """Largest tile count ≤ ``target`` (default: the ``KEYSTONE_OVERLAP_TILES``
-    env override when set, else the axis size — so the pipelined program
-    carries ≥ k per-tile collectives when shapes allow) such that ``dim``
-    splits into equal tiles each divisible by ``k`` (``psum_scatter``
-    scatters tile rows over the k shards). 0 = no valid tiling (callers
-    fall back to the monolithic reduction)."""
+    env override when set, else the autotuner's device-keyed winner when
+    persisted (:func:`_autotuned_tiles`), else the axis size — so the
+    pipelined program carries ≥ k per-tile collectives when shapes allow)
+    such that ``dim`` splits into equal tiles each divisible by ``k``
+    (``psum_scatter`` scatters tile rows over the k shards). 0 = no valid
+    tiling (callers fall back to the monolithic reduction)."""
     if dim % k:
         return 0
     if target is None:
         target = _env_tiles()[0]
+    if target is None:
+        target = _autotuned_tiles(dim, k)
     target = target or max(k, 1)
     for t in range(min(target, dim // k), 0, -1):
         if dim % (t * k) == 0:

@@ -274,9 +274,31 @@ declare("KEYSTONE_TPU_TRACE_DIR", "str", "",
         "Capture a jax.profiler device trace (TensorBoard/Perfetto) for "
         "blocks under utils.profiling.trace().")
 declare("KEYSTONE_FV_IMPL", "str", "auto",
-        "Force the Fisher-vector moment kernel: mxu (bf16-in/f32-acc "
-        "packed gemms) or f32; auto picks mxu on TPU.",
-        choices=("auto", "mxu", "f32"), lenient=True)
+        "Force the Fisher-vector moment kernel: pallas (fused posterior+"
+        "moment kernel), mxu (bf16-in/f32-acc packed gemms) or f32; auto "
+        "defers to KEYSTONE_PALLAS, then picks mxu on TPU.",
+        choices=("auto", "pallas", "mxu", "f32"), lenient=True)
+declare("KEYSTONE_PALLAS", "str", "auto",
+        "Extraction kernel family (ops/pallas/extraction.py): 1 forces "
+        "every fused Pallas kernel on (interpret mode off-TPU — the "
+        "parity-test form), 0 forces the exact prior XLA paths "
+        "(HLO-level no-op), auto engages the validated kernels (SIFT "
+        "binning, FV encode) on TPU only.", choices=("auto", "0", "1"))
+declare("KEYSTONE_AUTOTUNE", "bool", False,
+        "Empirical tile sweeps on autotuner cache miss "
+        "(ops/pallas/autotune.py): time a bounded tile grid, persist the "
+        "winner per (kernel, device generation, shape bucket). Off = "
+        "lookup-only (persisted winners still serve).")
+declare("KEYSTONE_AUTOTUNE_CACHE", "str", "",
+        "Path of the device-keyed tile cache (default: "
+        "autotune_cache.json at the repo root, next to "
+        "lint_baseline.json).")
+declare("KEYSTONE_AUTOTUNE_BUDGET_S", "float", 30.0,
+        "Wall-clock budget per autotune sweep; exhaustion keeps the "
+        "best-so-far winner.", validator=_non_negative)
+declare("KEYSTONE_AUTOTUNE_GRID", "int", 8,
+        "Maximum candidates per autotune sweep (the bounded grid).",
+        validator=_positive)
 declare("KEYSTONE_EVAL_CACHED_TIMING", "bool", False,
         "Record the cached-featurization eval timing rows "
         "(featurize_cached_s / predict_cached_s) during pipeline eval.")
@@ -350,6 +372,9 @@ declare("BENCH_SKETCH", "bool", True,
         "configured at d=65536, derated to the backend's memory).")
 declare("BENCH_SOLVER_OVERLAP", "bool", True,
         "Overlap on/off solver GFLOPs ladder (subprocess regime).")
+declare("BENCH_EXTRACTION", "bool", True,
+        "Extraction-kernel Pallas on/off GFLOPs regime (subprocess; "
+        "sift_pallas_{on,off}_gflops + fv_encode_pallas_{on,off}_gflops).")
 declare("BENCH_FLAGSHIP", "bool", True,
         "Flagship ImageNet-scale streaming row.")
 declare("BENCH_VOC_REFDIM", "bool", True,

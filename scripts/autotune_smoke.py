@@ -1,0 +1,94 @@
+"""End-to-end smoke of the Pallas tile autotuner (seconds, CPU).
+
+Exercises the full sweep → persist → reload → zero-re-sweep contract on a
+tiny interpret-mode grid — exactly what ``tests/test_autotune.py`` pins,
+but visible in the terminal and runnable on its own
+(``make autotune-smoke``; folded into ``verify-fast``):
+
+1. With ``KEYSTONE_AUTOTUNE=1`` and a temp cache, resolving the sift/fv
+   kernel tiles sweeps once per (kernel, bucket) and persists winners.
+2. The in-memory mirror is dropped; re-resolution must reload the
+   persisted file and perform ZERO new sweeps (pure ``autotune.cache_hit``).
+3. An ``overlap.tiles`` winner recorded through the public API must be
+   consumed by ``parallel/overlap.py::_pick_tiles`` — and an explicit
+   ``KEYSTONE_OVERLAP_TILES`` override must still beat it.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_TMP = tempfile.mkdtemp(prefix="keystone_autotune_smoke_")
+_CACHE = os.path.join(_TMP, "autotune_cache.json")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["KEYSTONE_AUTOTUNE"] = "1"
+os.environ["KEYSTONE_AUTOTUNE_CACHE"] = _CACHE
+os.environ["KEYSTONE_AUTOTUNE_GRID"] = "2"  # tiny grid: 2 candidates/kernel
+
+import keystone_tpu  # noqa: E402  (compat shims first)
+from keystone_tpu.ops.pallas import autotune  # noqa: E402
+from keystone_tpu.ops.pallas.extraction import (  # noqa: E402
+    fv_encode_tile,
+    sift_bins_tile,
+)
+from keystone_tpu.telemetry import get_registry  # noqa: E402
+
+
+def _counts():
+    reg = get_registry()
+    return (
+        sum(reg.counters("autotune.sweep").values()),
+        sum(reg.counters("autotune.cache_hit").values()),
+    )
+
+
+def main() -> int:
+    reg = get_registry()
+    reg.reset()
+
+    t_sift = sift_bins_tile(96, 48, 52)
+    t_fv = fv_encode_tile(64, 16, 8)
+    sweeps, hits = _counts()
+    assert sweeps == 2, f"expected 2 sweeps (one per kernel), got {sweeps}"
+    assert os.path.exists(_CACHE), "winners were not persisted"
+    print(f"autotune-smoke: swept sift.bins->{t_sift} fv.encode->{t_fv} "
+          f"({sweeps} sweeps), cache at {_CACHE}")
+
+    # Fresh-process simulation: drop the mirror, re-resolve — the persisted
+    # file must serve both winners with zero new sweeps.
+    autotune.clear_memory_cache()
+    assert sift_bins_tile(96, 48, 52) == t_sift
+    assert fv_encode_tile(64, 16, 8) == t_fv
+    sweeps2, hits2 = _counts()
+    assert sweeps2 == sweeps, (
+        f"repeat resolution re-swept: {sweeps2} != {sweeps}"
+    )
+    assert hits2 >= hits + 2, "repeat resolution did not hit the cache"
+    print(f"autotune-smoke: reload hit the persisted cache "
+          f"({hits2 - hits} hits, 0 re-sweeps)")
+
+    # Overlap consumption: a recorded winner becomes _pick_tiles' default,
+    # and the env override still beats it.
+    from keystone_tpu.parallel.overlap import _pick_tiles
+
+    dim, k = 96, 4
+    autotune.record(
+        "overlap.tiles", autotune.shape_bucket(dim, k), 3, swept=1
+    )
+    assert _pick_tiles(dim, k) == 3, "_pick_tiles ignored the tuned winner"
+    os.environ["KEYSTONE_OVERLAP_TILES"] = "2"
+    try:
+        assert _pick_tiles(dim, k) == 2, "env override lost to the tuner"
+    finally:
+        del os.environ["KEYSTONE_OVERLAP_TILES"]
+    print("autotune-smoke: _pick_tiles consumes tuned default, "
+          "KEYSTONE_OVERLAP_TILES still wins — ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -312,6 +312,42 @@ def _solver_overlap() -> dict:
         f"tsqr data={ndev}; bcd data={mesh2.shape['data']}"
         f" model={mesh2.shape['model']}"
     )
+    # ``overlap.tiles`` recorder: sweep the tile-count target of the tiled
+    # reduce-scatter gram at the ladder's feature width and persist the
+    # winner in the device-keyed autotune cache — this is the production
+    # path that feeds ``_pick_tiles``' autotuned default. Honors the
+    # KEYSTONE_AUTOTUNE opt-in like every other sweep (off = lookup-only,
+    # and the bench must not mutate the checkout as a side effect); a
+    # single chip has no collective to tile, so it also needs a mesh.
+    if ndev > 1 and knobs.get("KEYSTONE_AUTOTUNE"):
+        try:
+            from keystone_tpu.ops.pallas import autotune
+            from keystone_tpu.parallel.overlap import (
+                _pick_tiles,
+                tiled_transpose_matmul,
+            )
+
+            cands = sorted({
+                t for target in (2, 4, 8, 16, ndev)
+                for t in (_pick_tiles(d, ndev, target),) if t > 0
+            })
+            if cands:
+                bucket = autotune.shape_bucket(d, ndev)
+
+                def build(tile):
+                    return lambda i: tiled_transpose_matmul(
+                        A, mesh=mesh, tiles=tile
+                    )
+
+                won = autotune.sweep(
+                    "overlap.tiles", bucket, cands,
+                    autotune.chained_measure(build),
+                    reps=2 if smoke else 3,
+                )
+                out["overlap_tiles_swept"] = won
+        except Exception as e:
+            print(f"overlap.tiles sweep failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     return out
 
 
@@ -407,6 +443,87 @@ def _sketch_compare() -> dict:
     return out
 
 
+def _extraction_kernels() -> dict:
+    """Pallas-vs-XLA GFLOPs for the extraction kernel family
+    (``ops/pallas/extraction.py``): ``sift_pallas_{on,off}_gflops`` (the
+    fused orientation-binning × selection matmul vs the backend-best XLA
+    form) and ``fv_encode_pallas_{on,off}_gflops`` (the fused posterior ×
+    moment kernel vs the XLA batch encoder). Latency-cancelled like the
+    solver ladder; each arm forces its implementation explicitly
+    (``impl=`` / tile args), so the rows measure the kernels, not the knob
+    plumbing. Off-TPU the Pallas arm runs in interpret mode — orders of
+    magnitude slow, so shapes shrink to keep the row seconds-scale and the
+    artifact records the backend next to the numbers (a CPU on/off pair
+    documents interpret overhead, not a kernel regression). Budget
+    derating rides the subprocess timeout bench.py hands this regime."""
+    import bench  # configures the XLA compile cache; holds _SMOKE
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.images.sift import (
+        NUM_BIN_S,
+        _dsift_single_scale,
+        dsift_geometry,
+    )
+    from keystone_tpu.ops.images import fisher_vector as FV
+    from keystone_tpu.ops.images.fisher_vector import _fv_cols_batch_pallas
+    from keystone_tpu.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.pallas.extraction import (
+        fv_encode_tile,
+        sift_bins_tile,
+    )
+
+    smoke = bench._SMOKE
+    tpu = jax.default_backend() == "tpu"
+    small = smoke or not tpu
+    out: dict = {"extraction_backend": jax.default_backend()}
+    key = jax.random.key(0)
+
+    # --- SIFT binning: fused kernel vs backend-best XLA form -----------
+    b, hw = (2, 48) if small else (256, 96)
+    step, bin_size, min_bound = 3, 4, 9
+    imgs = jax.random.uniform(key, (b, hw, hw), jnp.float32)
+    ny, nx = dsift_geometry(hw, hw, step, bin_size, min_bound)
+    q = nx * NUM_BIN_S
+    # both arms share the selection-matmul flop model: binned energies @
+    # Mx then the H-axis contraction with My
+    flops = 2.0 * b * 8 * hw * hw * q + 2.0 * b * 8 * q * hw * ny * NUM_BIN_S
+    tile = sift_bins_tile(b * hw, hw, q)
+    iters = 2 if small else 4
+    for arm, impl in (("on", "pallas"), ("off", "auto")):
+        key_name = f"sift_pallas_{arm}_gflops"
+        out[key_name] = _try_gflops(
+            key_name,
+            lambda i, impl=impl: _dsift_single_scale(
+                imgs + (i * 1e-4), step, bin_size, min_bound, hw, hw,
+                impl, tile,
+            )[0],
+            flops, iters,
+        )
+
+    # --- FV encode: fused kernel vs the XLA batch encoder --------------
+    n_img, nd, d, k = (8, 64, 16, 8) if small else (256, 512, 64, 256)
+    kk = jax.random.split(key, 4)
+    x = jax.random.normal(kk[0], (n_img, nd, d), jnp.float32)
+    gmm = GaussianMixtureModel(
+        means=jax.random.normal(kk[1], (k, d), jnp.float32),
+        variances=1.0 + jax.random.uniform(kk[2], (k, d), jnp.float32),
+        weights=jnp.full((k,), 1.0 / k, jnp.float32),
+    )
+    # posterior gemms (2d-wide affine form) + the two moment contractions
+    fv_flops = n_img * nd * (2.0 * 2 * d * k + 2.0 * 2 * k * 2 * d)
+    fv_encode_tile(nd, d, k)  # resolve (and possibly sweep) OUTSIDE timing
+    xla_twin = FV._fv_cols_batch_mxu if tpu else FV._fv_cols_batch_f32
+    for arm, fn in (("on", _fv_cols_batch_pallas), ("off", xla_twin)):
+        key_name = f"fv_encode_pallas_{arm}_gflops"
+        out[key_name] = _try_gflops(
+            key_name,
+            lambda i, fn=fn: fn(x + (i * 1e-4), gmm, 0, 2 * k),
+            fv_flops, iters,
+        )
+    return out
+
+
 _REGIMES = {
     "flagship": _flagship,
     "voc_refdim": _voc_refdim,
@@ -414,6 +531,7 @@ _REGIMES = {
     "solver_overlap": _solver_overlap,
     "solver_ladder": _solver_ladder,
     "sketch_compare": _sketch_compare,
+    "extraction_kernels": _extraction_kernels,
 }
 
 

@@ -1,0 +1,225 @@
+"""Device-keyed tile autotuner (``ops/pallas/autotune.py``).
+
+Counters (``autotune.{sweep,cache_hit,cache_miss,default}``) are asserted
+via the telemetry registry as DELTAS — never absolute totals and never by
+resetting the process-global registry (other tests share it). The headline
+contract: a sweep happens at most once per (kernel, device, bucket); a
+repeat resolution — including after dropping the in-memory mirror, i.e. a
+fresh process against the persisted file — performs ZERO re-sweeps.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.pallas import autotune
+from keystone_tpu.telemetry import get_registry
+
+
+def _count(name: str) -> float:
+    return sum(get_registry().counters(name).values())
+
+
+@pytest.fixture()
+def tuner_cache(tmp_path, monkeypatch):
+    """Repoint the cache at a tmp file and drop the in-memory mirror so
+    every test starts from an empty, isolated cache."""
+    path = tmp_path / "autotune_cache.json"
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE_CACHE", str(path))
+    autotune.clear_memory_cache()
+    yield path
+    autotune.clear_memory_cache()
+
+
+def test_shape_bucket_pow2_bands():
+    assert autotune.shape_bucket(1000, 128) == "1024x128"
+    assert autotune.shape_bucket(1024) == "1024"
+    assert autotune.shape_bucket(1025) == "2048"
+    assert autotune.shape_bucket(1) == "1"
+    assert autotune.shape_bucket(0) == "0"
+    # shapes within one band share an entry; across bands they don't
+    assert autotune.shape_bucket(700, 37) == autotune.shape_bucket(513, 64) == "1024x64"
+    assert autotune.shape_bucket(700) == autotune.shape_bucket(1024)
+    assert autotune.shape_bucket(700) != autotune.shape_bucket(1025)
+
+
+def test_device_key_names_backend_and_generation():
+    key = autotune.device_key()
+    backend, _, kind = key.partition(":")
+    assert backend == jax.default_backend()
+    assert kind and all(c.islower() or c.isdigit() or c == "_" for c in kind)
+
+
+def test_resolve_sweeps_once_then_hits_persisted_cache(tuner_cache, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE", "1")
+    calls = []
+
+    def measure(cand, reps):
+        calls.append(cand)
+        return {8: 0.05, 16: 0.01, 32: 0.09}[cand] * reps
+
+    s0, h0 = _count("autotune.sweep"), _count("autotune.cache_hit")
+    won = autotune.resolve("test.kernel", "64x64", (8, 16, 32), 8,
+                           measure=measure)
+    assert won == 16  # fastest latency-cancelled candidate
+    assert calls, "sweep never measured"
+    assert _count("autotune.sweep") == s0 + 1
+    # persisted, device-keyed
+    data = json.loads(tuner_cache.read_text())
+    entry = data["devices"][autotune.device_key()]["test.kernel"]["64x64"]
+    assert entry["value"] == 16 and entry["swept"] == 3
+
+    # repeat resolution: zero re-sweeps, pure cache hit — including after
+    # dropping the in-memory mirror (the fresh-process case)
+    calls.clear()
+    assert autotune.resolve("test.kernel", "64x64", (8, 16, 32), 8,
+                            measure=measure) == 16
+    autotune.clear_memory_cache()
+    assert autotune.resolve("test.kernel", "64x64", (8, 16, 32), 8,
+                            measure=measure) == 16
+    assert not calls, "a persisted winner was re-swept"
+    assert _count("autotune.sweep") == s0 + 1
+    assert _count("autotune.cache_hit") >= h0 + 2
+
+
+def test_resolve_without_knob_serves_default_and_never_sweeps(
+    tuner_cache, monkeypatch
+):
+    monkeypatch.delenv("KEYSTONE_AUTOTUNE", raising=False)
+    d0 = _count("autotune.default")
+
+    def boom(cand, reps):
+        raise AssertionError("swept with KEYSTONE_AUTOTUNE unset")
+
+    assert autotune.resolve("test.off", "any", (8, 16), 12, measure=boom) == 12
+    assert _count("autotune.default") == d0 + 1
+    assert not tuner_cache.exists()
+
+
+def test_sweep_skips_failing_candidates_and_bounds_grid(
+    tuner_cache, monkeypatch
+):
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE", "1")
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE_GRID", "2")
+    seen = []
+
+    def measure(cand, reps):
+        seen.append(cand)
+        if cand == 8:
+            raise ValueError("shape cannot support this tile")
+        return 0.01 * reps
+
+    won = autotune.resolve("test.bounded", "b", (8, 16, 32), 8,
+                           measure=measure)
+    assert won == 16  # 8 failed, 32 fell past the bounded grid
+    assert 32 not in seen
+
+
+def test_corrupt_cache_degrades_to_default(tuner_cache, monkeypatch):
+    tuner_cache.write_text("{not json")
+    assert autotune.lookup("test.kernel", "64x64") is None
+    # and recording over it repairs the file
+    autotune.record("test.kernel", "64x64", 4, swept=1)
+    autotune.clear_memory_cache()
+    assert autotune.lookup("test.kernel", "64x64") == 4
+
+
+def test_malformed_nesting_is_pruned_not_fatal(tuner_cache):
+    """A schema-passing file with malformed NESTING (hand edit, foreign
+    writer) must degrade branch-by-branch, never crash a lookup or a
+    record — tuning is not a correctness dependency."""
+    tuner_cache.write_text(json.dumps({
+        "version": 1,
+        "devices": {
+            autotune.device_key(): {
+                "bad.kernel": 5,                      # not a bucket dict
+                "half.kernel": {"b": 7, "ok": {"value": 3}},
+                "good.kernel": {"64x64": {"value": 9}},
+            },
+            "other:dev": "junk",
+        },
+    }))
+    assert autotune.lookup("bad.kernel", "any") is None
+    assert autotune.lookup("half.kernel", "b") is None
+    assert autotune.lookup("half.kernel", "ok") == 3
+    assert autotune.lookup("good.kernel", "64x64") == 9
+    # record() survives merging over the pruned structure
+    autotune.record("bad.kernel", "any", 1, swept=1)
+    autotune.clear_memory_cache()
+    assert autotune.lookup("bad.kernel", "any") == 1
+    assert autotune.lookup("good.kernel", "64x64") == 9
+
+
+def test_all_candidates_failing_counts_default_only(tuner_cache, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE", "1")
+    s0, d0 = _count("autotune.sweep"), _count("autotune.default")
+
+    def boom(cand, reps):
+        raise ValueError("no tile fits")
+
+    assert autotune.resolve("test.allfail", "b", (8, 16), 12,
+                            measure=boom) == 12
+    # exactly ONE outcome counter fired: default (the sweep yielded nothing)
+    assert _count("autotune.sweep") == s0
+    assert _count("autotune.default") == d0 + 1
+
+
+def test_pick_tiles_consumes_tuned_default_env_still_wins(
+    tuner_cache, monkeypatch
+):
+    from keystone_tpu.parallel.overlap import _pick_tiles
+
+    dim, k = 96, 4
+    # no entry: heuristic target (axis size) — 96/(4*4)=6 tiles at target 4
+    assert _pick_tiles(dim, k) == 4
+    autotune.record("overlap.tiles", autotune.shape_bucket(dim, k), 3,
+                    swept=1)
+    assert _pick_tiles(dim, k) == 3
+    # explicit target argument and env override both beat the tuner
+    assert _pick_tiles(dim, k, target=6) == 6
+    monkeypatch.setenv("KEYSTONE_OVERLAP_TILES", "2")
+    assert _pick_tiles(dim, k) == 2
+    monkeypatch.delenv("KEYSTONE_OVERLAP_TILES")
+    # a tuned value the shape cannot honor degrades like any target
+    autotune.record("overlap.tiles", autotune.shape_bucket(dim, k), 5,
+                    swept=1)
+    assert _pick_tiles(dim, k) == 4  # largest valid count <= 5
+
+
+def test_moments_tile_resolves_through_autotuner(tuner_cache):
+    """The satellite: ``moments._TILE_N`` is gone — the kernel resolves its
+    row tile through the shared path, and a persisted winner changes the
+    padding/grid while keeping results exact."""
+    from keystone_tpu.ops.pallas import moments as M
+
+    assert M._tile_n() == M._TILE_N_DEFAULT
+    autotune.record("moments.tile_n", "any", 256, swept=1)
+    assert M._tile_n() == 256
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(700, 24)).astype(np.float32)
+    means = rng.normal(size=(6, 24)).astype(np.float32)
+    variances = rng.uniform(0.5, 2.0, (6, 24)).astype(np.float32)
+    weights = rng.dirichlet(np.ones(6)).astype(np.float32)
+    ref = M.gmm_moments_xla(x, means, variances, weights)
+    out = M.gmm_moments(x, means, variances, weights)  # tile 256 padding
+    for a, b in zip(out, ref):
+        denom = float(np.max(np.abs(np.asarray(b)))) + 1e-9
+        np.testing.assert_allclose(
+            np.asarray(a) / denom, np.asarray(b) / denom, atol=2e-3
+        )
+    # a stale larger tile against a sample padded at 256 re-fits the grid
+    assert M._fit_tile(768, 1024) == 256
+
+
+def test_unwritable_cache_dir_serves_in_memory(tmp_path, monkeypatch):
+    target = tmp_path / "no_such_dir" / "autotune_cache.json"
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE_CACHE", str(target))
+    autotune.clear_memory_cache()
+    autotune.record("test.mem", "b", 7, swept=1)
+    assert autotune.lookup("test.mem", "b") == 7  # mirror still serves
+    assert not target.exists()
+    autotune.clear_memory_cache()

@@ -136,9 +136,10 @@ def test_bench_section_floor_exhaustion_is_graceful(tmp_path):
         tmp_path,
         {
             "KEYSTONE_BENCH_SECTION_FLOOR_S": "999999",
-            # force one big regime ON so the derate path (not the env
-            # gate) is what skips it
+            # force big regimes ON so the derate path (not the env
+            # gate) is what skips them
             "BENCH_FLAGSHIP": "1",
+            "BENCH_EXTRACTION": "1",
         },
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
@@ -150,5 +151,7 @@ def test_bench_section_floor_exhaustion_is_graceful(tmp_path):
         full.get("sketch_vs_exact_error_delta_d65536_skipped") == "budget"
     )
     assert full.get("imagenet_refdim_streaming_warm_s_skipped") == "budget"
+    # the PR-7 extraction-kernel regime honors the same contract
+    assert full.get("sift_pallas_on_gflops_skipped") == "budget"
     # the primary metric itself still landed
     assert compact["metric"] == "mnist_random_fft_fit_eval_wallclock"

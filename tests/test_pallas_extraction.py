@@ -1,0 +1,297 @@
+"""Pallas extraction-kernel family vs the XLA twins
+(``ops/pallas/extraction.py``; interpreter mode on the CPU test mesh).
+
+Every kernel is pinned against the UNTOUCHED prior XLA path on odd /
+indivisible shapes (ragged tiles + lane padding + mask poison all engage),
+at f32 tolerances. Knob semantics are pinned too: ``KEYSTONE_PALLAS=0``
+must reproduce the exact prior program (selection resolves identically to
+the knob-unset default on CPU), and ``=1`` must force every kernel on.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.learning.gmm import GaussianMixtureModel
+from keystone_tpu.ops.images import fisher_vector as FV
+from keystone_tpu.ops.images.convolver import Convolver
+from keystone_tpu.ops.images.pooler import Pooler
+from keystone_tpu.ops.images.sift import (
+    SIFTExtractor,
+    _dsift_single_scale,
+    _resolve_impl_and_tile,
+)
+from keystone_tpu.ops.pallas import extraction as E
+
+
+def _rel_close(a, b, tol=2e-5):
+    a, b = np.asarray(a), np.asarray(b)
+    denom = np.max(np.abs(b)) + 1e-9
+    np.testing.assert_allclose(a / denom, b / denom, atol=tol)
+
+
+def _gmm(rng, k, d):
+    return GaussianMixtureModel(
+        means=jnp.asarray(rng.normal(size=(k, d)).astype(np.float32)),
+        variances=jnp.asarray(
+            rng.uniform(0.5, 2.0, (k, d)).astype(np.float32)
+        ),
+        weights=jnp.asarray(rng.dirichlet(np.ones(k)).astype(np.float32)),
+    )
+
+
+# --------------------------------------------------------------------------
+# knob semantics
+# --------------------------------------------------------------------------
+
+
+def test_knob_zero_is_the_exact_prior_path(monkeypatch):
+    """KEYSTONE_PALLAS=0 and unset must resolve to the IDENTICAL static
+    selection (and therefore the identical jit cache entry / HLO) on CPU —
+    the HLO-level-no-op acceptance. =1 must force the kernels on."""
+    node = SIFTExtractor()
+    img = jnp.zeros((32, 32), jnp.float32)
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    assert _resolve_impl_and_tile(node, img) == ("auto", 0)
+    assert FV._fv_moment_impl() == "f32"  # CPU default, prior behavior
+    monkeypatch.setenv("KEYSTONE_PALLAS", "0")
+    assert _resolve_impl_and_tile(node, img) == ("auto", 0)
+    assert FV._fv_moment_impl() == "f32"
+    assert not E.pallas_enabled()
+    assert not E.pallas_enabled(auto_ok=False)
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    assert _resolve_impl_and_tile(node, img)[0] == "pallas"
+    assert FV._fv_moment_impl() == "pallas"
+    assert E.pallas_enabled() and E.pallas_enabled(auto_ok=False)
+    # KEYSTONE_FV_IMPL stays the stronger force
+    monkeypatch.setenv("KEYSTONE_FV_IMPL", "f32")
+    assert FV._fv_moment_impl() == "f32"
+
+
+def test_knob_validates():
+    from keystone_tpu.utils import knobs
+
+    import os
+
+    os.environ["KEYSTONE_PALLAS"] = "yes"
+    try:
+        with pytest.raises(ValueError):
+            knobs.get("KEYSTONE_PALLAS")
+    finally:
+        del os.environ["KEYSTONE_PALLAS"]
+
+
+# --------------------------------------------------------------------------
+# SIFT: fused binning × selection matmul
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("h,w", [(37, 53), (48, 48)])
+def test_sift_pallas_matches_both_twins(h, w):
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, h, w)).astype(np.float32))
+    args = (3, 4, 9, h, w)  # step, bin, min_bound at scale-0 geometry
+    d_pl, m_pl = _dsift_single_scale(imgs, *args, "pallas", 16)
+    d_mm, m_mm = _dsift_single_scale(imgs, *args, "matmul")
+    d_wd, m_wd = _dsift_single_scale(imgs, *args, "window")
+    _rel_close(d_pl, d_mm)
+    _rel_close(m_pl, m_mm)
+    _rel_close(d_pl, d_wd, tol=2e-4)  # window form sums in another order
+    _rel_close(m_pl, m_wd, tol=2e-4)
+
+
+def test_sift_extractor_end_to_end_knob(monkeypatch):
+    """Whole extractor (all scales, layout, quantization) under the knob:
+    quantized descriptors may differ by at most one 512x-floor step."""
+    rng = np.random.default_rng(1)
+    img = jnp.asarray(rng.uniform(0, 1, (47, 61)).astype(np.float32))
+    node = SIFTExtractor()
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = np.asarray(node.apply(img))
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    out = np.asarray(node.apply(img))
+    assert out.shape == ref.shape == (node.num_descriptors(47, 61), 128)
+    assert np.max(np.abs(out - ref)) <= 1.0
+
+
+def test_sift_pallas_tile_independence():
+    """The autotuned tile is a schedule choice, not a semantics choice."""
+    rng = np.random.default_rng(2)
+    imgs = jnp.asarray(rng.uniform(0, 1, (1, 41, 33)).astype(np.float32))
+    a = _dsift_single_scale(imgs, 3, 4, 9, 41, 33, "pallas", 8)[0]
+    b = _dsift_single_scale(imgs, 3, 4, 9, 41, 33, "pallas", 64)[0]
+    _rel_close(a, b, tol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# Fisher vector: fused posterior × moments
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "lo_hi", [(0, 16), (1, 3), (9, 11), (7, 10)],
+    ids=["full", "mean-only", "var-only", "straddle"],
+)
+def test_fv_pallas_matches_f32_twin(lo_hi):
+    rng = np.random.default_rng(3)
+    k, d, nd = 8, 12, 37  # nd indivisible by every tile candidate
+    gmm = _gmm(rng, k, d)
+    x = jnp.asarray(rng.normal(size=(3, nd, d)).astype(np.float32))
+    lo, hi = lo_hi
+    out = FV._fv_cols_batch_pallas(x, gmm, lo, hi)
+    ref = FV._fv_cols_batch_f32(x, gmm, lo, hi)
+    assert out.shape == ref.shape == (3, (hi - lo) * d)
+    _rel_close(out, ref)
+
+
+def test_fv_pallas_zero_rows():
+    rng = np.random.default_rng(4)
+    gmm = _gmm(rng, 4, 6)
+    out = FV._fv_cols_batch_pallas(jnp.zeros((0, 9, 6)), gmm, 0, 8)
+    assert out.shape == (0, 48)
+
+
+def test_fv_dispatch_under_knob(monkeypatch):
+    """_fv_cols_batch routes through the kernel under the knob and the
+    result matches the default dispatch to f32 rounding — including the
+    streaming L1-norm prepass built on top of it."""
+    rng = np.random.default_rng(5)
+    gmm = _gmm(rng, 6, 8)
+    x = jnp.asarray(rng.normal(size=(4, 21, 8)).astype(np.float32))
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = FV._fv_cols_batch(x, gmm, 0, 12)
+    l1_ref = FV.fisher_l1_norms(x, gmm, chunk=0)
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    out = FV._fv_cols_batch(x, gmm, 0, 12)
+    l1_out = FV.fisher_l1_norms(x, gmm, chunk=0)
+    _rel_close(out, ref)
+    _rel_close(l1_out, l1_ref)
+
+
+# --------------------------------------------------------------------------
+# Convolver: fused im2col + patch normalization
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("normalize", [True, False])
+def test_conv_pallas_matches_xla_twin(normalize):
+    rng = np.random.default_rng(6)
+    k, c, nf = 5, 3, 7  # odd nf -> filter-tile padding engages
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, 17, 19, c)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(nf, k * k * c)).astype(np.float32))
+    conv = Convolver(
+        filters=filters, num_channels=c, normalize_patches=normalize
+    )
+    ref = conv._apply_batch_xla(imgs)
+    out = E.conv_norm(
+        imgs, filters, num_channels=c, normalize=normalize,
+        var_constant=10.0, tile_f=64, interpret=True,
+    )
+    assert out.shape == ref.shape
+    _rel_close(out, ref)
+
+
+def test_conv_pallas_with_whitener_and_knob(monkeypatch):
+    from keystone_tpu.learning.zca import ZCAWhitener
+
+    rng = np.random.default_rng(7)
+    k, c, nf = 3, 3, 5
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, 11, 13, c)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(nf, k * k * c)).astype(np.float32))
+    wh = ZCAWhitener(
+        means=jnp.asarray(rng.normal(size=(k * k * c,)).astype(np.float32)),
+        whitener=jnp.eye(k * k * c),
+    )
+    conv = Convolver(filters=filters, whitener=wh, num_channels=c)
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = conv.apply_batch(imgs)
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    out = conv.apply_batch(imgs)
+    _rel_close(out, ref)
+    # auto grade does NOT engage the conv kernel (explicit-only)
+    monkeypatch.setenv("KEYSTONE_PALLAS", "auto")
+    assert conv._pallas_tile(imgs) is None
+
+
+def test_conv_pallas_vmem_fallback(monkeypatch):
+    """An image too large for any filter tile falls back to the XLA twin
+    instead of overcommitting VMEM."""
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    rng = np.random.default_rng(8)
+    conv = Convolver(
+        filters=jnp.asarray(rng.normal(size=(4, 27)).astype(np.float32)),
+        num_channels=3,
+    )
+    big = jnp.zeros((1, 1300, 1300, 3), jnp.float32)
+    assert conv._pallas_tile(big) is None
+    small = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    assert conv._pallas_tile(small) is not None
+
+
+# --------------------------------------------------------------------------
+# Pooler: fused pixel-fn + separable sum pooling
+# --------------------------------------------------------------------------
+
+
+def test_pool_pallas_matches_xla_twin_clamped_edges(monkeypatch):
+    rng = np.random.default_rng(9)
+    img = jnp.asarray(rng.normal(size=(27, 27, 5)).astype(np.float32))
+    pool = Pooler(stride=13, pool_size=14, pool="sum")  # clamped windows
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = pool.apply(img)
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    out = pool.apply(img)
+    assert out.shape == ref.shape
+    _rel_close(out, ref)
+
+
+def test_pool_pallas_pixel_fn_and_batch(monkeypatch):
+    rng = np.random.default_rng(10)
+    imgs = jnp.asarray(rng.normal(size=(3, 13, 11, 5)).astype(np.float32))
+    pool = Pooler(stride=3, pool_size=6, pixel_function=jnp.abs, pool="sum")
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = pool.apply_batch(imgs)
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    out = pool.apply_batch(imgs)
+    assert out.shape == ref.shape
+    _rel_close(out, ref)
+
+
+def test_pool_channel_mixing_pixel_fn_stays_correct(monkeypatch):
+    """A shape-preserving but channel-MIXING pixel function must still be
+    exact: the kernel hands it the full channel block (no tiling)."""
+    rng = np.random.default_rng(11)
+    imgs = jnp.asarray(rng.normal(size=(2, 9, 9, 4)).astype(np.float32))
+    mix = lambda im: im[..., ::-1] + im.mean(axis=-1, keepdims=True)
+    pool = Pooler(stride=2, pool_size=4, pixel_function=mix, pool="sum")
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = pool.apply_batch(imgs)
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    out = pool.apply_batch(imgs)
+    _rel_close(out, ref)
+
+
+def test_pool_max_stays_on_xla_twin(monkeypatch):
+    rng = np.random.default_rng(12)
+    img = jnp.asarray(rng.normal(size=(12, 12, 3)).astype(np.float32))
+    pool = Pooler(stride=2, pool_size=4, pool="max")
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    assert not pool._pallas_ok(img)
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = pool.apply(img)
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    np.testing.assert_array_equal(np.asarray(pool.apply(img)), np.asarray(ref))
+
+
+def test_pool_shape_changing_pixel_fn_rejected(monkeypatch):
+    """A pixel function that changes the output shape fails the eval_shape
+    probe, so the kernel never engages for it (the XLA twin itself has
+    never supported shape-changing pixel functions — its output assert
+    predates this PR)."""
+    monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+    rng = np.random.default_rng(13)
+    img = jnp.asarray(rng.normal(size=(8, 8, 2)).astype(np.float32))
+    doubler = lambda im: jnp.concatenate([im, im], axis=-1)
+    pool = Pooler(stride=2, pool_size=4, pixel_function=doubler, pool="sum")
+    assert not pool._pallas_ok(img)
