@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint verify-fast telemetry-smoke autotune-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint verify-fast telemetry-smoke autotune-smoke plan-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -41,11 +41,19 @@ verify-fast: lint
 	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=120 $(PY) bench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/autotune_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/plan_smoke.py
 
 # Tiny traced pipeline -> counters non-zero, Chrome trace well-formed,
 # telemetry-report renders (scripts/telemetry_smoke.py); CPU, seconds.
 telemetry-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
+
+# Whole-pipeline-optimizer contract end to end: plan a tiny DAG under a
+# small binding HBM budget -> fits + planned < hand default, zero re-plans
+# on repeat (memo + persisted KEYSTONE_PLAN_CACHE), zero recompiles on the
+# planned pipeline's repeat run (scripts/plan_smoke.py); CPU, seconds.
+plan-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/plan_smoke.py
 
 # Tile-autotuner contract end to end: tiny interpret-mode sweep -> persisted
 # device-keyed cache -> reload with zero re-sweeps -> _pick_tiles consumes

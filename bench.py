@@ -975,6 +975,63 @@ def _try_lint_rows() -> dict:
         return {}
 
 
+def _try_plan_rows() -> dict:
+    """Whole-pipeline-optimizer evidence rows (``core/plan.py``): plan the
+    flagship descriptor-reduction DAG + weighted-solver block site in
+    estimate mode under the HBM budget and record the decisions — chosen
+    block size, segment/cache counts, estimated peak vs the budget, and
+    the repeat-plan count (MUST be zero: the content-fingerprinted plan
+    memo serves the second call). Pre-dispatch shape analysis + one
+    lowering — no pipeline runs. BENCH_PLAN=0 skips."""
+    if not knobs.get("BENCH_PLAN"):
+        return {}
+    try:
+        from keystone_tpu.core import plan
+        from keystone_tpu.telemetry import get_registry
+
+        pipe, sample, sites = plan._TARGETS["imagenet"](_SMOKE)
+        budget = plan.hbm_budget_bytes() or (16 << 30)  # v5e-class default
+        reg = get_registry()
+
+        def build():
+            return plan.plan_pipeline(
+                pipe, sample, mode="estimate", budget_bytes=budget,
+                block_sites=sites,
+            )
+
+        p = build()
+        computed_before = reg.get_counter("plan.computed")
+        p = build()  # repeat: must be served from the plan memo
+        replans = reg.get_counter("plan.computed") - computed_before
+        out = {
+            "plan_block_size": p.block_sizes.get("imagenet.weighted_solver"),
+            "plan_segments": p.num_segments,
+            "plan_cached_stages": len(p.cached_stages),
+            "plan_cache_tiers": sorted(
+                {s.cache_tier for s in p.cached_stages}
+            ),
+            "plan_sharding_boundary": next(
+                (s.name for s in p.stages if s.sharding == "model"), None
+            ),
+            "plan_est_peak_hbm_gb": round(
+                p.est_peak_hbm_bytes / (1 << 30), 3
+            ),
+            "plan_hbm_budget_gb": round(budget / (1 << 30), 3),
+            "plan_fits": p.fits,
+            "plan_bounded": p.bounded,
+            "plan_replans": int(replans),
+        }
+        # NOTE deliberately absent: a plan_measured_peak_hbm row. The
+        # process-wide peak_bytes_in_use here would reflect every earlier
+        # in-process bench section, not the planned configuration (which
+        # this section never runs) — the estimated-vs-measured comparison
+        # belongs to a dedicated fresh-process flagship run (ROADMAP).
+        return out
+    except Exception as e:
+        print(f"plan rows failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"plan_block_size": None}
+
+
 def _run_regime_subprocess(regime: str, fail_key: str,
                            timeout_s: int = None) -> dict:
     """One big-regime row via ``scripts/bench_regime.py`` in a fresh OS
@@ -1107,6 +1164,16 @@ def main():
     # in the same trail as a perf regression.
     out.update(_try_lint_rows())
     _flush(out, "lint")
+    # Whole-pipeline-optimizer evidence (core/plan.py): shape analysis +
+    # one lowering, but SIFT lowering on a cold process is not free — a
+    # reduced floor like telemetry's, with the explicit budget-skip marker.
+    if _budget_remaining() - _FINALIZE_RESERVE_S < 20.0:
+        out["plan_skipped"] = "budget"
+        print("bench section plan skipped: budget exhausted",
+              file=sys.stderr)
+    else:
+        out.update(_try_plan_rows())
+    _flush(out, "plan")
     # Solver GFLOPs ladder (exact BCD + randomized sketch rungs, overlap
     # on/off): a budget-derated SUBPROCESS regime since the sketch rung
     # landed. In-process it was the one heavy section whose runtime the
@@ -1257,6 +1324,12 @@ _COMPACT_KEYS = (
     # static-analysis hygiene (keystone_tpu/analysis; full counts in
     # bench_full.json)
     ("lint", "lint_findings_total"),
+    # whole-pipeline optimizer decisions (core/plan.py; full table via
+    # `keystone-tpu plan imagenet`)
+    ("plan_bs", "plan_block_size"),
+    ("plan_hbm", "plan_est_peak_hbm_gb"),
+    ("plan_fits", "plan_fits"),
+    ("plan_replans", "plan_replans"),
     # flagship regime
     ("fs", "imagenet_refdim_streaming_warm_s"),
     ("fs_cont", "imagenet_refdim_streaming_warm_s_contended"),

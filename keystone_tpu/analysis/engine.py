@@ -47,7 +47,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 PRAGMA_RE = re.compile(r"lint:\s*disable(?:=([A-Za-z0-9_,\- ]+))?")
 
 #: rule ids a bare ``lint: disable`` expands to
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 
 
 # ---------------------------------------------------------------------------

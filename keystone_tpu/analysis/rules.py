@@ -24,6 +24,11 @@ R4  knob-hygiene            — raw ``os.environ``/``getenv`` reads of
 R5  shared-state-lock       — mutation of module/class-level containers in
                               the telemetry/cache/prefetch/overlap modules
                               outside a ``with <lock>`` block.
+R6  unbounded-peak-hbm      — block solvers constructed in
+                              ``keystone_tpu/pipelines/`` with hand-set
+                              block sizes (no ``plan.resolve_block_size``
+                              in the module): nothing bounds the stage's
+                              peak HBM against ``KEYSTONE_HBM_BUDGET``.
 """
 
 from __future__ import annotations
@@ -914,6 +919,84 @@ class SharedStateLock(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# R6: hand-set solver block sizes in pipelines (unbounded peak-HBM estimate)
+# ---------------------------------------------------------------------------
+
+class UnboundedHbmStage(Rule):
+    """A pipeline that constructs a block solver with a hand-set block size
+    has an UNBOUNDED peak-HBM estimate: nothing relates the block to
+    ``KEYSTONE_HBM_BUDGET``, so the configuration OOMs by experiment
+    instead of by computed answer (``core/plan.py::hbm_safe_block_size``).
+    Scope: ``keystone_tpu/pipelines/`` only — bench/test microbenches set
+    fixed-work block sizes deliberately. A module that routes ANY block
+    size through ``plan.resolve_block_size`` is taken to have adopted the
+    precedence chain (approximate in the direction of silence, like R1-R5:
+    a module mixing resolved and literal sites goes unflagged)."""
+
+    id = "R6"
+    title = "unbounded-peak-hbm"
+
+    # callable -> positional index of its block-size argument (the
+    # BlockCoordinateDescent CLASS takes no block size — its
+    # solve_least_squares_with_l2 method and the functional
+    # block_coordinate_descent_l2 do, as the 4th positional / block_size=)
+    SOLVERS = {
+        "BlockLeastSquaresEstimator": 0,
+        "BlockWeightedLeastSquaresEstimator": 0,
+        # BlockCoordinateDescent().solve_least_squares_with_l2(A, b, lams,
+        # num_iter, block_size) — the NormalEquations/TSQR overloads take
+        # no block and fall through (no args[4], no block_size kw)
+        "solve_least_squares_with_l2": 4,
+        "block_coordinate_descent_l2": 3,
+    }
+    RESOLVERS = ("resolve_block_size", "resolved_block_size",
+                 "_resolve_solver_knobs")
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, mod in ctx.modules.items():
+            posix = rel.replace(os.sep, "/")
+            if "keystone_tpu/pipelines/" not in posix:
+                continue
+            resolved = any(
+                isinstance(n, ast.Call)
+                and (call_name(n) or "").split(".")[-1] in self.RESOLVERS
+                for n in ast.walk(mod.tree)
+            )
+            if resolved:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = (call_name(node) or "").split(".")[-1]
+                if name not in self.SOLVERS:
+                    continue
+                pos = self.SOLVERS[name]
+                block = node.args[pos] if len(node.args) > pos else None
+                for kw in node.keywords:
+                    if kw.arg == "block_size":
+                        block = kw.value
+                if block is None:
+                    continue
+                desc = dotted(block) or (
+                    repr(block.value) if isinstance(block, ast.Constant)
+                    else type(block).__name__
+                )
+                out.append(Finding(
+                    rule=self.id, path=rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"{name} block size `{desc}` is hand-set: "
+                            "peak-HBM estimate unbounded (no relation to "
+                            "KEYSTONE_HBM_BUDGET)",
+                    hint="route it through keystone_tpu.core.plan."
+                         "resolve_block_size (explicit/env values still "
+                         "win), or pragma with the sizing justification",
+                    symbol=f"{name}:{desc}",
+                ))
+        return out
+
+
 def default_rules() -> List[Rule]:
     return [
         HostSyncInHotPath(),
@@ -921,4 +1004,5 @@ def default_rules() -> List[Rule]:
         CollectiveSafety(),
         KnobHygiene(),
         SharedStateLock(),
+        UnboundedHbmStage(),
     ]

@@ -117,6 +117,14 @@ def main(argv=None) -> int:
         from keystone_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "plan":
+        # ``keystone-tpu plan <target>``: the cost-based whole-pipeline
+        # planner's decision table (core/plan.py) — cache tiers, fused
+        # segments, sharding boundary, HBM-safe block sizes — plus the
+        # exportable JSON artifact via --json.
+        from keystone_tpu.core.plan import main as plan_main
+
+        return plan_main(argv[1:])
     if not argv or argv[0] in ("-h", "--help", "help"):
         names = "\n  ".join(sorted(PIPELINES))
         print(
@@ -124,7 +132,9 @@ def main(argv=None) -> int:
             "--process-id I | --distributed] [--mesh-model M] "
             f"<Pipeline> [flags]\n"
             "       run-pipeline telemetry-report [path] [--top N]\n"
-            "       run-pipeline lint [paths] [--update-baseline]\n\n"
+            "       run-pipeline lint [paths] [--update-baseline]\n"
+            "       run-pipeline plan <toy|imagenet|voc> [--mode M] "
+            "[--budget-mb N] [--json PATH]\n\n"
             f"pipelines:\n  {names}"
         )
         return 0 if argv else 2

@@ -84,6 +84,10 @@ def _jit_apply_batch(node: "Node", xs: Any) -> Any:
 def _stage_name(node: "Node") -> str:
     if isinstance(node, Chain):
         return ">".join(type(s).__name__ for s in node.stages)
+    if isinstance(node, DAG):
+        return "dag(" + ",".join(type(s).__name__ for s in node.nodes) + ")"
+    if isinstance(node, _DagSegment):
+        return "+".join(type(s).__name__ for s in node.nodes)
     return type(node).__name__
 
 
@@ -100,12 +104,22 @@ def _traced_stage(node: "Node", data: Any, jitted: bool) -> Any:
     from keystone_tpu import telemetry
 
     fp = telemetry.stage_fingerprint(node)
+    # fused segments also carry their member stages' fingerprints, so the
+    # planner's profile mode (core/plan.py) can attribute a segment span
+    # back onto the per-stage cost table
+    members = None
+    if isinstance(node, Chain):
+        members = [telemetry.stage_fingerprint(s) for s in node.stages]
+    elif isinstance(node, _DagSegment):
+        members = [telemetry.stage_fingerprint(s) for s in node.nodes]
     with telemetry.get_tracer().span(f"stage:{_stage_name(node)}") as sp:
         sp.set(
             fingerprint=fp,
             in_shapes=telemetry.tree_shapes(data),
             in_bytes=telemetry.tree_nbytes(data),
         )
+        if members:
+            sp.set(members=members)
         if jitted:
             cost = telemetry.jit_cost(_jit_apply_batch, fp, node, data)
             if cost:
@@ -310,6 +324,20 @@ class Chain(Transformer):
     def memoizable(self) -> bool:  # type: ignore[override]
         return all(s.memoizable for s in self.stages)
 
+    @property
+    def jittable(self) -> bool:  # type: ignore[override]
+        # a Chain embedding a host node must not be traced whole (e.g. as
+        # a DAG member): _call_uncached below routes such a call through
+        # _run_stages, so the jittable runs on either side of the host
+        # node still fuse instead of dispatching eagerly op-by-op
+        return all(s.jittable for s in self.stages)
+
+    def _call_uncached(self, data: Any) -> Any:
+        # reached when this Chain is a member of a DAG (or any caller
+        # using the uncached entry): segmented execution, no memoization
+        # (the enclosing pipeline owns the cache keys)
+        return self._run_stages(data)
+
     def __call__(self, data: Any) -> Any:
         if isinstance(data, Dataset):
             return data.replace(data=self(data.data))
@@ -435,6 +463,320 @@ def chain(*nodes: Any) -> Chain:
                 raise TypeError(f"cannot chain non-Node {type(n).__name__}")
             flat.append(n)
     return Chain(stages=tuple(flat))
+
+
+class Merge(Transformer):
+    """Base of multi-input DAG nodes: ``apply``/``apply_batch`` receive a
+    TUPLE of inputs (one per declared dependency, in ``deps`` order)."""
+
+
+class ConcatFeatures(Merge):
+    """Feature-axis concatenation of the parent branches — the reference's
+    ``ZipVectors`` (``nodes/util/ZipVectors.scala``) as a DAG join."""
+
+    axis: int = struct.field(pytree_node=False, default=-1)
+
+    def apply(self, xs):
+        return jnp.concatenate(xs, axis=self.axis)
+
+    apply_batch = apply
+
+
+class _DagSegment(Node):
+    """One fused jittable subgraph of a :class:`DAG` (internal): the nodes
+    trace into a single XLA program. ``local_deps`` encodes each node's
+    inputs: ``>= 0`` is an earlier node in this segment, ``< 0`` is slot
+    ``-1 - d`` of the external-inputs tuple. ``out_locals`` lists the node
+    outputs the rest of the DAG consumes."""
+
+    nodes: tuple = ()
+    local_deps: tuple = struct.field(pytree_node=False, default=())
+    out_locals: tuple = struct.field(pytree_node=False, default=())
+
+    def apply_batch(self, ext):
+        vals: list = []
+        for node, deps in zip(self.nodes, self.local_deps):
+            ins = [ext[-1 - d] if d < 0 else vals[d] for d in deps]
+            vals.append(
+                node.apply_batch(ins[0] if len(ins) == 1 else tuple(ins))
+            )
+        return tuple(vals[o] for o in self.out_locals)
+
+
+class DAG(Transformer):
+    """Directed-acyclic generalization of :class:`Chain`.
+
+    ``nodes`` is a topologically-ordered tuple of pipeline nodes (pytree
+    children — the whole DAG jits/refits like a Chain); ``deps[i]`` names
+    node ``i``'s producers by index (``-1`` is the DAG input; entries must
+    be ``< i``, so list order IS a topological order and cycles cannot be
+    expressed). Multi-``deps`` nodes must be :class:`Merge` subclasses —
+    they receive a tuple. The LAST node is the output.
+
+    Execution mirrors Chain: maximal runs of jittable nodes fuse into one
+    XLA program per run (:class:`_DagSegment`); host nodes and
+    ``cache_after`` points are materialization boundaries. ``cache_after``
+    (a planner decision — ``core/plan.py::apply_plan``) marks node outputs
+    to materialize and, when an intermediate cache is active, memoize
+    under a content-addressed prefix key; a later call with the same
+    content resumes from the cached intermediate and SKIPS the producing
+    subgraph — the KeystoneML ``.cache()`` reuse on a DAG. A branch whose
+    every consumer is satisfied by cache hits is never executed at all.
+    """
+
+    nodes: tuple = ()
+    deps: tuple = struct.field(pytree_node=False, default=())
+    cache_after: tuple = struct.field(pytree_node=False, default=())
+
+    @property
+    def memoizable(self) -> bool:  # type: ignore[override]
+        return all(n.memoizable for n in self.nodes)
+
+    @property
+    def jittable(self) -> bool:  # type: ignore[override]
+        return all(n.jittable for n in self.nodes)
+
+    # -- eager paths (used when the whole DAG is traced as one node) ------
+    def _run_eager(self, x, batch: bool):
+        vals: dict = {-1: x}
+        for i, (node, dep) in enumerate(zip(self.nodes, self.deps)):
+            ins = [vals[d] for d in dep]
+            arg = ins[0] if len(ins) == 1 else tuple(ins)
+            vals[i] = node.apply_batch(arg) if batch else node.apply(arg)
+        return vals[len(self.nodes) - 1]
+
+    def apply(self, x):
+        return self._run_eager(x, batch=False)
+
+    def apply_batch(self, xs):
+        return self._run_eager(xs, batch=True)
+
+    # -- keys -------------------------------------------------------------
+    def _prefix_key(self, i: int, input_fp: str) -> str:
+        """Content key for node ``i``'s output: fingerprints of its whole
+        producing subgraph (nodes + edge topology) + the input's content
+        fingerprint — the DAG analog of ``cache.stage_key``."""
+        import hashlib
+
+        from keystone_tpu.core.cache import fingerprint
+
+        anc = self._ancestors(i)
+        h = hashlib.blake2b(digest_size=16)
+        for j in anc:
+            h.update(fingerprint(self.nodes[j]).encode())
+            h.update(repr(self.deps[j]).encode())
+        h.update(input_fp.encode())
+        return h.hexdigest()
+
+    def _ancestors(self, i: int) -> list:
+        """Topo-sorted producing subgraph of node ``i`` (inclusive)."""
+        seen = set()
+        stack = [i]
+        while stack:
+            j = stack.pop()
+            if j < 0 or j in seen:
+                continue
+            seen.add(j)
+            stack.extend(self.deps[j])
+        return sorted(seen)
+
+    # -- segmented execution ----------------------------------------------
+    def __call__(self, data: Any) -> Any:
+        if isinstance(data, Dataset):
+            return data.replace(data=self(data.data))
+        cache = _active_cache(self, data)
+        input_fp = None
+        hits: dict = {}
+        out_i = len(self.nodes) - 1
+        if cache is not None:
+            from keystone_tpu.core.cache import fingerprint
+
+            input_fp = fingerprint(data)
+            hit, val = cache.lookup(self._prefix_key(out_i, input_fp))
+            if hit:
+                return val
+            for i in self.cache_after:
+                if i == out_i:
+                    continue  # its prefix key IS the whole key that missed
+                hit, val = cache.lookup(self._prefix_key(i, input_fp))
+                if hit:
+                    hits[i] = val
+        # need-driven: reverse walk from the output, cut at cache hits
+        needed = set()
+        stack = [out_i]
+        while stack:
+            i = stack.pop()
+            if i < 0 or i in needed:
+                continue
+            needed.add(i)
+            if i not in hits:
+                stack.extend(self.deps[i])
+        t0 = time.perf_counter()
+        env: dict = {-1: data}
+        env.update(hits)
+        out = self._run_segments(env, needed, hits, cache, input_fp, t0)
+        if cache is not None:
+            if cache.sync_on_compute:
+                out = jax.block_until_ready(out)
+            cache.stats.computes += 1
+            from keystone_tpu.telemetry import get_registry
+
+            get_registry().inc("cache.compute")
+            cache.put(self._prefix_key(out_i, input_fp), out,
+                      time.perf_counter() - t0)
+        return out
+
+    def _run_segments(self, env, needed, hits, cache, input_fp, t0):
+        from keystone_tpu import telemetry
+
+        run = [
+            i for i in range(len(self.nodes))
+            if i in needed and i not in hits
+        ]
+        with telemetry.get_tracer().span(
+            f"chain:{_stage_name(self)}", sync=False
+        ):
+            segment: list = []
+            for i in run:
+                node = self.nodes[i]
+                if node.jittable:
+                    segment.append(i)
+                    # a cache point ends the fused program: its output must
+                    # materialize (and memoize) before anything consumes it
+                    if i in self.cache_after:
+                        self._flush_segment(segment, env)
+                        self._materialize(i, env, cache, input_fp, t0)
+                        segment = []
+                    continue
+                self._flush_segment(segment, env)
+                segment = []
+                ins = [env[d] for d in self.deps[i]]
+                env[i] = node._call_uncached(
+                    ins[0] if len(ins) == 1 else tuple(ins)
+                )
+                if i in self.cache_after:
+                    self._materialize(i, env, cache, input_fp, t0)
+            self._flush_segment(segment, env)
+        return env[len(self.nodes) - 1]
+
+    def _flush_segment(self, segment: list, env: dict) -> None:
+        """Run the pending jittable node indices as ONE fused program."""
+        if not segment:
+            return
+        local = {g: k for k, g in enumerate(segment)}
+        ext: list = []
+        ext_slot: dict = {}
+
+        def slot(g: int) -> int:
+            if g not in ext_slot:
+                ext_slot[g] = len(ext)
+                ext.append(env[g])
+            return -1 - ext_slot[g]
+
+        local_deps = tuple(
+            tuple(local[d] if d in local else slot(d) for d in self.deps[g])
+            for g in segment
+        )
+        # expose outputs any node OUTSIDE the segment consumes, plus the
+        # DAG output
+        out_i = len(self.nodes) - 1
+        exposed = [
+            g for g in segment
+            if g == out_i or any(
+                g in self.deps[j]
+                for j in range(g + 1, len(self.nodes)) if j not in local
+            )
+        ]
+        seg_node = _DagSegment(
+            nodes=tuple(self.nodes[g] for g in segment),
+            local_deps=local_deps,
+            out_locals=tuple(local[g] for g in exposed),
+        )
+        from keystone_tpu.telemetry import tracing_enabled
+
+        if tracing_enabled():
+            outs = _traced_stage(seg_node, tuple(ext), jitted=True)
+        else:
+            outs = _jit_apply_batch(seg_node, tuple(ext))
+        for g, v in zip(exposed, outs):
+            env[g] = v
+
+    def _call_uncached(self, data: Any) -> Any:
+        # a DAG nested as a host member of another DAG: segmented
+        # execution without this level adding its own memo keys
+        env: dict = {-1: data}
+        needed = set(range(len(self.nodes)))
+        return self._run_segments(env, needed, {}, None, None,
+                                  time.perf_counter())
+
+    def _materialize(self, i: int, env: dict, cache, input_fp, t0) -> None:
+        env[i] = jax.block_until_ready(env[i])
+        # the output node's prefix key IS the whole-DAG key the caller
+        # puts once after the run — storing it here too would double the
+        # serialization and byte accounting for one entry
+        if cache is not None and i < len(self.nodes) - 1:
+            cache.put(self._prefix_key(i, input_fp), env[i],
+                      time.perf_counter() - t0)
+
+    def serve(self, x: Any) -> Any:
+        for n in self.nodes:
+            if not isinstance(n, Transformer):
+                raise TypeError(
+                    f"dag node {type(n).__name__} has no single-item path"
+                )
+        if self.jittable:
+            return _jit_apply(self, x)
+        return self.apply(x)
+
+
+def dag(nodes: Sequence[Node], deps: Sequence[Sequence[int]],
+        cache_after: Sequence[int] = ()) -> DAG:
+    """Validated DAG builder. ``deps[i]`` lists node ``i``'s inputs by
+    index (``-1`` = the pipeline input; entries must precede ``i``). The
+    last node is the output; multi-input nodes must be :class:`Merge`."""
+    nodes = tuple(nodes)
+    deps = tuple(tuple(d) for d in deps)
+    if len(nodes) != len(deps):
+        raise ValueError(
+            f"dag: {len(nodes)} nodes but {len(deps)} dependency lists"
+        )
+    for i, (n, dep) in enumerate(zip(nodes, deps)):
+        if not isinstance(n, Node):
+            raise TypeError(f"dag node {i} is not a Node: {type(n).__name__}")
+        if not dep:
+            raise ValueError(f"dag node {i} ({type(n).__name__}) has no inputs")
+        for d in dep:
+            if not (-1 <= d < i):
+                raise ValueError(
+                    f"dag node {i} depends on {d}: edges must point to "
+                    "earlier nodes (-1 is the input) — list order is the "
+                    "topological order"
+                )
+        if len(dep) > 1 and not isinstance(n, Merge):
+            raise TypeError(
+                f"dag node {i} ({type(n).__name__}) has {len(dep)} inputs "
+                "but is not a Merge (multi-input nodes receive a tuple)"
+            )
+    for i in sorted(cache_after):
+        if not (0 <= i < len(nodes)):
+            raise ValueError(f"dag cache_after index {i} out of range")
+    return DAG(nodes=nodes, deps=deps,
+               cache_after=tuple(sorted(cache_after)))
+
+
+def chain_to_dag(c: Chain) -> DAG:
+    """A Chain is the linear DAG (``Cacher`` stages become cache points)."""
+    nodes, deps, cache_pts = [], [], []
+    for s in c.stages:
+        if isinstance(s, Cacher):
+            if nodes:
+                cache_pts.append(len(nodes) - 1)
+            continue
+        deps.append((len(nodes) - 1,))
+        nodes.append(s)
+    if not nodes:
+        raise ValueError("cannot convert an empty/Cacher-only Chain")
+    return dag(nodes, deps, cache_after=cache_pts)
 
 
 class Cacher(Transformer):

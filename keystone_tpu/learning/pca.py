@@ -12,6 +12,16 @@ TPU design: two fit paths.
   the path for O(1e7)-row samples that never fit on one host (the reference
   would have to collect them).
 
+- ``randomized``: the oversampled randomized range finder ("Panther"'s
+  randomized-NLA direction, Halko-Martinsson-Tropp): project onto
+  ``dims + oversample`` Gaussian directions, sharpen the captured subspace
+  with QR-stabilized power iterations (each a pair of tall-skinny matmuls
+  — MXU work, no O(d³)), then take the exact SVD of the (k, d) projected
+  panel. Cost drops from O(n·d·min(n,d)) to O(n·d·k); the exact paths
+  remain the pinned twins, selected by default. ``KEYSTONE_PCA=randomized``
+  routes ``method="auto"`` fits here; an explicit ``method=`` argument
+  always wins (the knob-precedence contract).
+
 Both transformers keep the reference orientation: ``pca_mat`` is (d, dims)
 and ``apply`` computes ``pca_matᵀ · x``.
 """
@@ -26,6 +36,7 @@ import jax.numpy as jnp
 from keystone_tpu.core.dataset import Dataset
 from keystone_tpu.core.pipeline import Estimator, Transformer
 from keystone_tpu.linalg.solvers import hdot
+from keystone_tpu.utils import knobs
 
 
 class PCATransformer(Transformer):
@@ -86,21 +97,68 @@ def _pca_gram(x, mask, dims: int, precision: str = "highest"):
     return _matlab_sign_convention(v)[:, :dims]
 
 
+@functools.partial(
+    jax.jit, static_argnames=("dims", "oversample", "power_iters", "seed")
+)
+def _pca_randomized(x, mask, dims: int, oversample: int = 8,
+                    power_iters: int = 2, seed: int = 0):
+    """Oversampled randomized range finder + power iterations: Q captures
+    the top-``dims + oversample`` column space of the centered sample; the
+    small (k, d) panel's exact SVD supplies the components. QR
+    re-orthonormalization between power iterations keeps the iteration
+    from collapsing onto the leading component (the float32 -stability
+    form of Halko et al. Alg 4.4)."""
+    if mask is not None:
+        n = jnp.sum(mask)
+        mean = jnp.sum(x * mask[:, None], axis=0) / n
+        centered = (x - mean) * mask[:, None]
+    else:
+        mean = jnp.mean(x, axis=0)
+        centered = x - mean
+    d = centered.shape[1]
+    k = min(dims + oversample, d, centered.shape[0])
+    omega = jax.random.normal(jax.random.PRNGKey(seed), (d, k), jnp.float32)
+    y = centered @ omega  # (n, k)
+    for _ in range(power_iters):
+        q, _ = jnp.linalg.qr(y)
+        y = centered @ (centered.T @ q)
+    q, _ = jnp.linalg.qr(y)  # (n, k) orthonormal range basis
+    b = q.T @ centered  # (k, d) projected panel
+    _, _, vt = jnp.linalg.svd(b, full_matrices=False)
+    return _matlab_sign_convention(vt.T)[:, :dims]
+
+
 class PCAEstimator(Estimator):
     """``method``: "svd" (exact, reference path), "gram" (distributed
-    covariance + eigh), or "auto" (gram when rows ≥ 4·cols)."""
+    covariance + eigh), "randomized" (oversampled range finder), or
+    "auto" (gram when rows ≥ 4·cols; ``KEYSTONE_PCA=randomized`` reroutes
+    auto — and only auto — onto the randomized path)."""
 
-    def __init__(self, dims: int, method: str = "auto"):
+    def __init__(self, dims: int, method: str = "auto", oversample: int = 8,
+                 power_iters: int = 2, seed: int = 0):
         self.dims = dims
         self.method = method
+        self.oversample = oversample
+        self.power_iters = power_iters
+        self.seed = seed
 
     def compute_pca(self, x, mask=None) -> jax.Array:
         x = jnp.asarray(x, jnp.float32)
         method = self.method
         if method == "auto":
-            method = "gram" if x.shape[0] >= 4 * x.shape[1] else "svd"
+            # explicit method= beats the env knob beats the shape heuristic
+            # (the resolve_block_size precedence, applied to the fit path)
+            if knobs.get("KEYSTONE_PCA") == "randomized":
+                method = "randomized"
+            else:
+                method = "gram" if x.shape[0] >= 4 * x.shape[1] else "svd"
         if method == "svd":
             return _pca_svd(x, mask, self.dims)
+        if method == "randomized":
+            return _pca_randomized(
+                x, mask, self.dims, oversample=self.oversample,
+                power_iters=self.power_iters, seed=self.seed,
+            )
         if method == "gram":
             from keystone_tpu.linalg.solvers import get_solver_precision
 

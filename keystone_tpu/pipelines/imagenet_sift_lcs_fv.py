@@ -45,7 +45,12 @@ class ImageNetSiftLcsFVConfig:
     num_gmm_samples: int = 10000000
     lam: float = 6e-5
     mixture_weight: float = 0.25
-    block_size: int = 4096
+    # Solver column block size. 0 = auto (core/plan.py precedence: an
+    # explicitly-set value here > KEYSTONE_BLOCK_SIZE env > the planner's
+    # HBM-budget-safe size under KEYSTONE_OPTIMIZER > the hand-tuned 4096
+    # — the _pick_tiles order from PR 7, documented in the README's
+    # "Pipeline optimizer" section).
+    block_size: int = 0
     num_iter: int = 1
     image_hw: int = 256
     # size-bucketed variable-shape ingest for real archives: comma-separated
@@ -83,13 +88,15 @@ class ImageNetSiftLcsFVConfig:
     fv_row_chunk: int = 1024  # images per FV block-featurization chunk
     desc_dtype: str = "bfloat16"  # resident reduced-descriptor storage
     # FV cache grouping: consecutive solver blocks per shared-posterior
-    # featurization pass (0 = recompute per block). Peak extra HBM = one
-    # group's (n, fv_cache_blocks·block_size) features in fv_cache_dtype.
-    # Default 2 = the HBM-validated flagship configuration (~1.7 GB bf16
-    # group buffer at n=102 400 next to ~6.4 GB resident descriptors on a
-    # 16 GB chip); 4-block groups OOM there and buy no further posterior
-    # savings worth the memory.
-    fv_cache_blocks: int = 2
+    # featurization pass (0 = recompute per block; -1 = auto). Peak extra
+    # HBM = one group's (n, fv_cache_blocks·block_size) features in
+    # fv_cache_dtype. Auto resolves to 2 = the HBM-validated flagship
+    # configuration (~1.7 GB bf16 group buffer at n=102 400 next to
+    # ~6.4 GB resident descriptors on a 16 GB chip; 4-block groups OOM
+    # there) — or, under KEYSTONE_OPTIMIZER, to the widest group whose
+    # buffer fits a slice of KEYSTONE_HBM_BUDGET
+    # (core/plan.py::resolve_cache_blocks; explicit values always win).
+    fv_cache_blocks: int = -1
     # Mid-fit checkpoint/resume for the streaming solve: every N completed
     # blocks the solver state lands at solver_checkpoint (atomic); a rerun
     # with the same path resumes bit-exactly from the last boundary
@@ -159,6 +166,87 @@ class ImageNetSiftLcsFVConfig:
                 "with gmm_ensemble would silently skip probe selection"
             )
 
+
+
+def _resolve_solver_knobs(config: ImageNetSiftLcsFVConfig, n_rows: int,
+                          num_classes: int, sub_k: int = 0,
+                          fixed_bytes: int = 0) -> ImageNetSiftLcsFVConfig:
+    """Concrete solver knobs from the auto sentinels (``block_size=0``,
+    ``fv_cache_blocks=-1``) via the whole-pipeline planner
+    (``core/plan.py``). Precedence per knob: explicitly-set config value >
+    ``KEYSTONE_BLOCK_SIZE`` env > HBM-budget-planned (``KEYSTONE_OPTIMIZER``
+    on) > the hand-tuned flagship defaults (4096 / 2-block groups) — so
+    with the optimizer off this is the byte-identical prior configuration.
+
+    ``sub_k`` (streaming paths) constrains planned blocks to sizes that
+    tile both branches' per-codebook feature layout; ``fixed_bytes`` is
+    the resident-descriptor HBM the block solve must coexist with."""
+    import math
+
+    from keystone_tpu.core import plan
+
+    pcas = (config.sift_pca_dim, config.lcs_pca_dim)
+    quantum = math.lcm(*pcas)
+    valid = None
+    if sub_k:
+        top = min(2 * sub_k * p for p in pcas)
+        valid = [
+            b for b in range(quantum, top + 1, quantum)
+            if all((2 * sub_k) % (b // p) == 0 for p in pcas)
+        ]
+        if not valid:
+            # no planned block can tile BOTH branches' layout at these
+            # dims: an empty valid set must not reach resolve_block_size
+            # (falsy -> no snap -> an untiled block silently truncates
+            # the streaming block loop). Only the PLANNED rung drops out;
+            # explicit config and KEYSTONE_BLOCK_SIZE keep their
+            # documented precedence, then the hand default — exactly the
+            # optimizer-off configuration — and say so.
+            from keystone_tpu.utils import knobs as _knobs
+
+            block = (config.block_size
+                     or _knobs.get("KEYSTONE_BLOCK_SIZE") or 4096)
+            logger.warning(
+                "plan: no block size tiles pca dims %s at 2*sub_k=%d; "
+                "planning skipped, using %d", pcas, 2 * sub_k, block,
+            )
+            return dataclasses.replace(
+                config, block_size=block,
+                fv_cache_blocks=(config.fv_cache_blocks
+                                 if config.fv_cache_blocks >= 0 else 2),
+            )
+    cache_itemsize = jnp.dtype(config.fv_cache_dtype).itemsize
+    block = plan.resolve_block_size(
+        "imagenet.weighted_solver",
+        explicit=config.block_size or None,
+        n_rows=n_rows, num_classes=num_classes, default=4096,
+        cache_blocks=2, cache_dtype_bytes=cache_itemsize,
+        fixed_bytes=fixed_bytes, quantum=quantum,
+        ceiling=max(valid) if valid else None, valid=valid,
+    )
+    cache_blocks = plan.resolve_cache_blocks(
+        "imagenet.fv_cache",
+        explicit=(config.fv_cache_blocks
+                  if config.fv_cache_blocks >= 0 else None),
+        n_rows=n_rows, block_size=block, itemsize=cache_itemsize, default=2,
+    )
+    # the block was sized assuming 2-block groups; a WIDER planned group
+    # must not push the combined peak past the budget the block claims to
+    # provably fit. Clamp only the PLANNED group width (an explicit
+    # fv_cache_blocks is the caller's contract and passes verbatim).
+    if config.fv_cache_blocks < 0 and plan.enabled():
+        budget = plan.hbm_budget_bytes()
+        while budget is not None and cache_blocks > 2 and (
+            plan.block_solve_peak_bytes(
+                block, n_rows=n_rows, num_classes=num_classes,
+                cache_blocks=cache_blocks,
+                cache_dtype_bytes=cache_itemsize, fixed_bytes=fixed_bytes,
+            ) > budget
+        ):
+            cache_blocks -= 1
+    return dataclasses.replace(
+        config, block_size=block, fv_cache_blocks=cache_blocks
+    )
 
 
 def _fit_sklearn_gmm(gmm_sample, k_centers: int, em_seed: int, config):
@@ -377,6 +465,14 @@ def _run_streaming_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
             raw_train, train_labels = reduce_groups(tr)
         del tr
 
+        # planner-derived solver knobs (explicit config/env values win —
+        # see _resolve_solver_knobs): the resident reduced descriptors are
+        # the fixed HBM term the block solve must fit next to
+        config = _resolve_solver_knobs(
+            config, int(train_labels.shape[0]), num_classes,
+            sub_k=config.vocab_size,
+            fixed_bytes=sum(v.nbytes for v in raw_train.values()),
+        )
         bidx = list(range(len(ladder)))
         blocks_s = 2 * config.vocab_size // (
             config.block_size // config.sift_pca_dim
@@ -705,6 +801,13 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
             raw_train, train_labels = reduce_split(train_src, use_cache=True)
         desc_cache.clear()  # nothing may pin raw descriptors past this point
 
+        # planner-derived solver knobs (explicit config/env values win —
+        # see _resolve_solver_knobs): the resident reduced descriptors +
+        # l1 tensors are the fixed HBM the block solve must fit next to
+        config = _resolve_solver_knobs(
+            config, train_src.n, num_classes, sub_k=sub_k,
+            fixed_bytes=sum(v.nbytes for v in raw_train.values()),
+        )
         # per-MEMBER block counts (the grouping unit: groups cannot span
         # ensemble members — each member is its own normalized FV)
         blocks_s = 2 * sub_k // (config.block_size // config.sift_pca_dim)
@@ -832,7 +935,10 @@ def flagship_config(**overrides) -> ImageNetSiftLcsFVConfig:
         num_gmm_samples=2000000,
         lam=6e-5,
         mixture_weight=0.25,
-        block_size=4096,
+        # block_size / fv_cache_blocks stay on auto: with the optimizer
+        # off they resolve to the measured hand values (4096 / 2-block
+        # groups, the BASELINE.md configuration); with KEYSTONE_OPTIMIZER
+        # on they come from the HBM-budget plan (_resolve_solver_knobs)
         synthetic_train=102400,
         synthetic_test=5120,
         synthetic_classes=1000,
@@ -847,10 +953,6 @@ def flagship_config(**overrides) -> ImageNetSiftLcsFVConfig:
         extract_chunk=2048,
         sample_images=8192,
         fv_row_chunk=1024,
-        # 2-block cache groups: the 16 GB chip holds descriptors (~6.4 GB
-        # bf16) + the bf16 group buffer + residual/solve state; wider
-        # groups OOM at this n
-        fv_cache_blocks=2,
     )
     cfg.update(overrides)
     return ImageNetSiftLcsFVConfig(**cfg)
@@ -922,6 +1024,10 @@ def _run_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
             jnp.asarray(train_labels)
         )
 
+        config = _resolve_solver_knobs(
+            config, int(train_feats.shape[0]), num_classes,
+            fixed_bytes=train_feats.nbytes,
+        )
         with Timer("fit.block_weighted_least_squares"):
             model = BlockWeightedLeastSquaresEstimator(
                 config.block_size, config.num_iter, config.lam, config.mixture_weight
@@ -1046,6 +1152,10 @@ def run(config: ImageNetSiftLcsFVConfig) -> dict:
         train_feats = jnp.concatenate([sift_train, lcs_train], axis=1)
         labels = ClassLabelIndicatorsFromIntLabels(num_classes)(jnp.asarray(train[1]))
 
+        config = _resolve_solver_knobs(
+            config, int(train_feats.shape[0]), num_classes,
+            fixed_bytes=train_feats.nbytes,
+        )
         with Timer("fit.block_weighted_least_squares"):
             model = BlockWeightedLeastSquaresEstimator(
                 config.block_size, config.num_iter, config.lam, config.mixture_weight

@@ -34,7 +34,9 @@ class RandomPatchCifarConfig:
     pool_stride: int = 13
     alpha: float = 0.25
     lam: float = 10.0
-    block_size: int = 4096
+    # 0 = auto (core/plan.py precedence: explicit value > KEYSTONE_BLOCK_
+    # SIZE env > HBM-budget-planned under KEYSTONE_OPTIMIZER > 4096)
+    block_size: int = 0
     whitener_size: int = 100000
     seed: int = 0
     synthetic_train: int = 10000
@@ -62,7 +64,16 @@ def run(config: RandomPatchCifarConfig) -> dict:
         featurizer = conv_featurizer(
             filters, whitener, config.alpha, config.pool_stride, config.pool_size
         )
-        est = BlockLeastSquaresEstimator(config.block_size, 1, config.lam)
+        # planner-derived block size (core/plan.py precedence; explicit
+        # config/env values win, optimizer-off keeps the hand-tuned 4096)
+        from keystone_tpu.core import plan
+
+        block_size = plan.resolve_block_size(
+            "cifar.block_solver", explicit=config.block_size or None,
+            n_rows=int(train[1].shape[0]), num_classes=10, default=4096,
+            quantum=128,
+        )
+        est = BlockLeastSquaresEstimator(block_size, 1, config.lam)
         # conv + doubled-rectifier intermediates per row, f32
         conv_hw = (32 - config.patch_size + 1) ** 2
         per_row = 3 * config.num_filters * conv_hw * 4

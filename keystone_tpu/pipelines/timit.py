@@ -103,6 +103,9 @@ def run(config: TimitConfig) -> dict:
                 feature_nodes.append(chain(rf, scaler))
 
         with Timer("fit.streaming_block_least_squares.dispatch"):
+            # lint: disable=R6 (block == one feature node's width by
+            # construction — the streaming fit consumes whole random-FFT
+            # nodes; it is a feature-layout constant, not a memory knob)
             est = BlockLeastSquaresEstimator(
                 config.num_cosine_features, config.num_epochs, config.lam,
                 cache_grams=config.cache_grams,

@@ -38,7 +38,10 @@ class VOCSIFTFisherConfig:
     num_pca_samples: int = 1000000
     num_gmm_samples: int = 1000000
     lam: float = 0.5
-    block_size: int = 4096
+    # Solver column block size. 0 = auto (core/plan.py precedence:
+    # explicitly-set value > KEYSTONE_BLOCK_SIZE env > HBM-budget-planned
+    # under KEYSTONE_OPTIMIZER > the hand-tuned 4096).
+    block_size: int = 0
     sift_scales: int = 4
     image_hw: int = 256
     # size-bucketed variable-shape ingest: comma-separated HxW ladder (e.g.
@@ -72,6 +75,21 @@ class VOCSIFTFisherConfig:
                 "synthetic generator emits one size (drop --buckets or set "
                 "--train-location)"
             )
+
+
+def _resolved_block_size(config: VOCSIFTFisherConfig, n_rows: int,
+                         num_classes: int) -> int:
+    """Planner-derived solver block size (core/plan.py::resolve_block_size
+    precedence; with ``KEYSTONE_OPTIMIZER=0`` this is exactly the prior
+    hand-tuned 4096 unless the config/env set one explicitly)."""
+    from keystone_tpu.core import plan
+
+    return plan.resolve_block_size(
+        "voc.block_solver", explicit=config.block_size or None,
+        n_rows=n_rows, num_classes=num_classes, default=4096,
+        quantum=max(128, config.desc_dim),
+        ceiling=2 * config.desc_dim * config.vocab_size,
+    )
 
 
 def small_config(**overrides) -> VOCSIFTFisherConfig:
@@ -137,9 +155,12 @@ def _run_bucketed(config: VOCSIFTFisherConfig) -> dict:
             np.concatenate([lb for _, _, lb in train])
         )
         labels = ClassLabelIndicatorsFromIntArrayLabels(num_classes)(train_labels)
+        block_size = _resolved_block_size(
+            config, int(train_feats.shape[0]), num_classes
+        )
         with Timer("fit.block_least_squares"):
             model = BlockLeastSquaresEstimator(
-                config.block_size, 1, config.lam
+                block_size, 1, config.lam
             ).fit(train_feats, labels)
 
         with Timer("eval.test_map"):
@@ -215,9 +236,12 @@ def run(config: VOCSIFTFisherConfig) -> dict:
         labels = ClassLabelIndicatorsFromIntArrayLabels(num_classes)(
             jnp.asarray(train[1])
         )
+        block_size = _resolved_block_size(
+            config, int(train_feats.shape[0]), num_classes
+        )
         with Timer("fit.block_least_squares"):
             model = BlockLeastSquaresEstimator(
-                config.block_size, 1, config.lam
+                block_size, 1, config.lam
             ).fit(train_feats, labels)
 
         with Timer("eval.test_map"):

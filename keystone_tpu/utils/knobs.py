@@ -336,6 +336,30 @@ declare("KEYSTONE_SKETCH_TOL", "float", 1e-5,
 declare("KEYSTONE_SKETCH_MAX_ITERS", "int", 100,
         "Iteration cap for the sketch-preconditioned CG.",
         validator=_positive)
+declare("KEYSTONE_OPTIMIZER", "str", "0",
+        "Cost-based whole-pipeline planner (core/plan.py): 0 = off (the "
+        "prior hand-tuned program, byte-identical); 'estimate' plans from "
+        "abstract shapes + analytic flops; 'profile' plans from recorded "
+        "telemetry spans (estimate fallback). Explicit knobs always beat "
+        "planned values.", choices=("0", "estimate", "profile"))
+declare("KEYSTONE_HBM_BUDGET", "int", 0,
+        "Per-chip HBM budget in MiB the planner's block sizes and fused "
+        "segments must provably fit (core/plan.py::hbm_safe_block_size); "
+        "0 = the backend's reported per-device limit, or unbounded when "
+        "it reports none.", validator=_non_negative)
+declare("KEYSTONE_BLOCK_SIZE", "int", 0,
+        "Explicit env override for the solvers' column block size "
+        "(plan.resolve_block_size order: call-site value > this > planned "
+        "> hand-tuned default); 0 = unset.", validator=_non_negative)
+declare("KEYSTONE_PLAN_CACHE", "str", "",
+        "Path of the persisted plan cache (content-fingerprinted plans; "
+        "a repeat run performs zero re-plans). Empty = in-memory only.")
+declare("KEYSTONE_PCA", "str", "exact",
+        "PCA fit path (learning/pca.py): 'exact' keeps the SVD/gram "
+        "twins; 'randomized' routes method='auto' fits through the "
+        "oversampled randomized range finder + power iterations "
+        "(explicit method= arguments still win).",
+        choices=("exact", "randomized"))
 declare("KEYSTONE_SKETCH_BCD", "bool", False,
         "Leverage-score block scheduling for block coordinate descent: "
         "visit feature blocks in descending sketched-energy order instead "
@@ -384,6 +408,10 @@ declare("BENCH_TIMIT_FULL", "bool", True,
 declare("BENCH_LINT", "bool", True,
         "Static-analysis section: run keystone_tpu/analysis over the "
         "package and record lint_findings_total.")
+declare("BENCH_PLAN", "bool", True,
+        "Whole-pipeline-optimizer section (core/plan.py): plan the "
+        "flagship DAG under the HBM budget and record plan_* decision "
+        "keys (block size, segments, est peak, zero-replan pin).")
 declare("BENCH_OVERLAP", "bool", True,
         "bench_regime.py: run the solver ladder with the overlap knob "
         "on.")

@@ -1,0 +1,111 @@
+"""Plan-contract smoke (``make plan-smoke``; folded into verify-fast).
+
+End-to-end pin of the whole-pipeline-optimizer contract on a tiny DAG, in
+seconds on CPU:
+
+1. plan under a deliberately small HBM budget -> the plan FITS and the
+   budget is a BINDING constraint (the chosen block size is below the
+   hand-tuned default — the computed answer differs from the hand answer);
+2. repeat plan in the same process -> served from the in-memory memo,
+   ZERO re-plans;
+3. repeat plan with the in-memory memo cleared (the fresh-process
+   simulation) -> served from the persisted ``KEYSTONE_PLAN_CACHE``
+   artifact, still ZERO re-plans;
+4. run the planned pipeline twice -> bit-identical outputs and ZERO
+   recompiles on the repeat (the shared jit entry's cache size is flat).
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# knob production for the child checks (the bench's subprocess-control
+# idiom): a small budget that binds, optimizer on
+os.environ["KEYSTONE_OPTIMIZER"] = "estimate"
+os.environ["KEYSTONE_HBM_BUDGET"] = "16"
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"plan-smoke FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.core import plan
+    from keystone_tpu.core.pipeline import _jit_apply_batch
+    from keystone_tpu.telemetry import get_registry
+
+    tmp = tempfile.mkdtemp(prefix="plan_smoke_")
+    cache_path = os.path.join(tmp, "plan_cache.json")
+    os.environ["KEYSTONE_PLAN_CACHE"] = cache_path
+
+    pipe, sample, sites = plan._TARGETS["toy"](True)
+    budget = plan.hbm_budget_bytes()
+    if budget != 16 << 20:
+        fail(f"KEYSTONE_HBM_BUDGET not honored: {budget}")
+    reg = get_registry()
+
+    def build():
+        return plan.plan_pipeline(
+            pipe, sample, budget_bytes=budget, block_sites=sites
+        )
+
+    p = build()
+    if not p.fits:
+        fail(f"plan does not fit the {budget >> 20} MiB budget:\n"
+             + p.summary())
+    block = p.block_sizes["toy.solver"]
+    default = sites[0]["default"]
+    if not (0 < block < default):
+        fail(f"budget is not a binding constraint: block {block} vs "
+             f"hand default {default} (expected planned < default)")
+    peak = plan.block_solve_peak_bytes(
+        block, n_rows=sites[0]["n_rows"], num_classes=sites[0]["num_classes"]
+    )
+    if peak > budget:
+        fail(f"chosen block {block} peak {peak} exceeds budget {budget}")
+    print(f"plan-smoke: fits budget, binding block size {block} < {default}")
+
+    # 2: in-process repeat -> memo hit, zero re-plans
+    computed = reg.get_counter("plan.computed")
+    build()
+    if reg.get_counter("plan.computed") != computed:
+        fail("repeat plan_pipeline re-planned (memo miss)")
+    # 3: fresh-process simulation -> persisted cache hit, zero re-plans
+    with plan._PLAN_LOCK:
+        plan._PLAN_MEMO.clear()
+    if not os.path.exists(cache_path):
+        fail("KEYSTONE_PLAN_CACHE artifact was not written")
+    build()
+    if reg.get_counter("plan.computed") != computed:
+        fail("cold repeat re-planned despite the persisted plan cache")
+    if not reg.get_counter("plan.cache_hit", tier="disk"):
+        fail("cold repeat did not hit the persisted plan cache")
+    print("plan-smoke: zero re-plans (memo + persisted cache)")
+
+    # 4: run the planned pipeline twice -> identical outputs, no recompile
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=sample.shape).astype("float32")
+    )
+    planned = plan.apply_plan(pipe, p)
+    out1 = jax.block_until_ready(planned(x))
+    size1 = _jit_apply_batch._cache_size()
+    out2 = jax.block_until_ready(planned(x))
+    size2 = _jit_apply_batch._cache_size()
+    if size2 != size1:
+        fail(f"repeat run recompiled: jit cache {size1} -> {size2}")
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    print("plan-smoke: repeat run zero recompiles, outputs bit-identical")
+    print("plan-smoke PASS")
+
+
+if __name__ == "__main__":
+    main()

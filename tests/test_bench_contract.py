@@ -72,6 +72,15 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     assert "sketch_gflops_per_chip_overlap" in full
     assert "sketch_vs_exact_error_delta_d65536" in full
     assert "sketch_vs_exact_d" in full
+    # whole-pipeline-optimizer rows (core/plan.py): the flagship plan's
+    # decisions landed, and the repeat plan in the same process performed
+    # ZERO re-plans (the content-fingerprinted memo served it)
+    assert full["plan_block_size"] > 0
+    assert full["plan_segments"] >= 1
+    assert isinstance(full["plan_fits"], bool)
+    assert full["plan_replans"] == 0
+    assert full["plan_est_peak_hbm_gb"] >= 0
+    assert compact["plan_replans"] == 0
     # structured-telemetry contract: telemetry_* keys in the COMPACT line,
     # non-zero span/counter headcounts, and a loadable artifact whose
     # Chrome trace is Perfetto-shaped
@@ -123,6 +132,9 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     assert "partial" not in compact
     full = json.loads((tmp_path / "bench_full.json").read_text())
     assert full.get("imagenet_refdim_streaming_warm_s_skipped") == "budget"
+    # the planner section exhausts gracefully too (no plan rows, a marker)
+    assert full.get("plan_skipped") == "budget"
+    assert "plan_block_size" not in full
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

@@ -369,6 +369,69 @@ def test_r5_out_of_scope_module_silent(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R6: hand-set solver block sizes in pipelines (unbounded peak-HBM)
+# ---------------------------------------------------------------------------
+
+R6_POSITIVE = """
+    def run(config):
+        est = BlockWeightedLeastSquaresEstimator(
+            config.block_size, 1, 0.1, 0.25
+        )
+        est2 = BlockLeastSquaresEstimator(block_size=4096, num_iter=1)
+        return est, est2
+"""
+
+
+def test_r6_flags_hand_set_pipeline_block_sizes(tmp_path):
+    res = lint_tree(
+        tmp_path, {"keystone_tpu/pipelines/mod.py": R6_POSITIVE}
+    )
+    r6 = [f for f in res.findings if f.rule == "R6"]
+    assert len(r6) == 2
+    msgs = " | ".join(f.message for f in r6)
+    assert "config.block_size" in msgs and "4096" in msgs
+    assert "peak-HBM" in msgs
+
+
+def test_r6_covers_bcd_method_and_skips_blockless_overloads(tmp_path):
+    """BlockCoordinateDescent passes its block via
+    solve_least_squares_with_l2 (kw or 5th positional), not the
+    constructor; the NormalEquations overload takes no block and must not
+    be misread."""
+    res = lint_tree(tmp_path, {"keystone_tpu/pipelines/mod.py": """
+        def run(config, A, b):
+            bcd = BlockCoordinateDescent()
+            m1 = bcd.solve_least_squares_with_l2(
+                A, b, 0.1, block_size=config.block_size
+            )
+            m2 = NormalEquations().solve_least_squares_with_l2(A, b, 0.1)
+            return m1, m2
+    """})
+    r6 = [f for f in res.findings if f.rule == "R6"]
+    assert len(r6) == 1
+    assert "config.block_size" in r6[0].message
+
+
+def test_r6_silent_when_module_resolves_and_outside_pipelines(tmp_path):
+    # a module that routes through plan.resolve_block_size is clean
+    res = lint_tree(tmp_path, {"keystone_tpu/pipelines/mod.py": """
+        from keystone_tpu.core import plan
+
+
+        def run(config, n):
+            block = plan.resolve_block_size(
+                "x", explicit=config.block_size or None, n_rows=n,
+                num_classes=10, default=4096,
+            )
+            return BlockWeightedLeastSquaresEstimator(block, 1, 0.1, 0.25)
+    """})
+    assert [f for f in res.findings if f.rule == "R6"] == []
+    # bench/scripts/solver microbenches are out of scope
+    res = lint_tree(tmp_path, {"keystone_tpu/linalg/mod.py": R6_POSITIVE})
+    assert [f for f in res.findings if f.rule == "R6"] == []
+
+
+# ---------------------------------------------------------------------------
 # Pragmas
 # ---------------------------------------------------------------------------
 

@@ -42,6 +42,60 @@ def test_pca_gram_matches_svd(rng):
     np.testing.assert_allclose(np.abs(p_svd), np.abs(p_gram), atol=1e-2)
 
 
+def test_pca_randomized_matches_exact_subspace(rng):
+    """Randomized range-finder PCA ("Panther" RRF + power iterations)
+    recovers the exact SVD components on a low-rank-plus-noise sample —
+    the exact path stays the pinned twin."""
+    x = _correlated_data(rng, n=800)
+    p_svd = np.asarray(PCAEstimator(4, "svd").fit(jnp.asarray(x)).pca_mat)
+    p_rrf = np.asarray(
+        PCAEstimator(4, "randomized").fit(jnp.asarray(x)).pca_mat
+    )
+    # same subspace, same sign convention -> same matrix (up to fp noise
+    # in the trailing near-degenerate direction)
+    np.testing.assert_allclose(np.abs(p_svd), np.abs(p_rrf), atol=2e-2)
+    # projector distance pins the subspace itself, not just magnitudes
+    proj = lambda p: p @ p.T  # noqa: E731
+    assert np.linalg.norm(proj(p_svd) - proj(p_rrf)) < 1e-2
+    # sign convention holds on the randomized path too
+    for j in range(4):
+        col = p_rrf[:, j]
+        assert col[np.argmax(np.abs(col))] >= 0
+
+
+def test_pca_knob_routes_auto_only(rng, monkeypatch):
+    """KEYSTONE_PCA=randomized reroutes method='auto'; an explicit method
+    argument still wins (the knob-precedence contract)."""
+    x = _correlated_data(rng, n=800)
+    monkeypatch.setenv("KEYSTONE_PCA", "randomized")
+    p_auto = np.asarray(PCAEstimator(4).fit(jnp.asarray(x)).pca_mat)
+    p_rrf = np.asarray(
+        PCAEstimator(4, "randomized").fit(jnp.asarray(x)).pca_mat
+    )
+    np.testing.assert_array_equal(p_auto, p_rrf)  # auto took the RRF path
+    p_svd_explicit = np.asarray(
+        PCAEstimator(4, "svd").fit(jnp.asarray(x)).pca_mat
+    )
+    monkeypatch.delenv("KEYSTONE_PCA")
+    p_svd = np.asarray(PCAEstimator(4, "svd").fit(jnp.asarray(x)).pca_mat)
+    np.testing.assert_array_equal(p_svd_explicit, p_svd)  # knob ignored
+
+
+def test_pca_randomized_masked_rows_ignored(rng):
+    """Mask semantics match the exact path: padding rows do not move the
+    components."""
+    x = _correlated_data(rng, n=400)
+    pad = np.concatenate([x, 1e3 * np.ones((64, x.shape[1]), np.float32)])
+    mask = jnp.asarray(np.r_[np.ones(400), np.zeros(64)].astype(np.float32))
+    p_plain = np.asarray(
+        PCAEstimator(4, "randomized").fit(jnp.asarray(x)).pca_mat
+    )
+    p_masked = np.asarray(
+        PCAEstimator(4, "randomized").fit(jnp.asarray(pad), mask=mask).pca_mat
+    )
+    np.testing.assert_allclose(np.abs(p_plain), np.abs(p_masked), atol=2e-2)
+
+
 def test_pca_sign_convention(rng):
     x = _correlated_data(rng)
     p = np.asarray(PCAEstimator(4, "svd").fit(jnp.asarray(x)).pca_mat)

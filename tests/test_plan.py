@@ -1,0 +1,474 @@
+"""Cost-based whole-pipeline planner (core/plan.py) + the DAG
+generalization of Chain (core/pipeline.py).
+
+Pins the ISSUE-8 acceptance surface: estimate-vs-profile plan parity on a
+toy DAG, the HBM budget as a binding (and exactly computed) constraint,
+plan-off => the prior program untouched (no plan consulted, hand segment
+boundaries, hand block sizes), and explicit knobs beating planned values.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.core import plan
+from keystone_tpu.core.cache import IntermediateCache, use_cache
+from keystone_tpu.core.pipeline import (
+    Cacher,
+    Chain,
+    ConcatFeatures,
+    Transformer,
+    chain,
+    chain_to_dag,
+    dag,
+)
+from keystone_tpu.learning.pca import PCATransformer
+from keystone_tpu.telemetry import get_registry, get_tracer, use_tracing
+
+
+class Affine(Transformer):
+    w: jax.Array
+
+    def apply(self, x):
+        return x @ self.w
+
+    apply_batch = apply
+
+
+class Host(Transformer):
+    jittable = False
+
+    def apply(self, x):
+        return x
+
+    def apply_batch(self, xs):
+        return jax.block_until_ready(xs)
+
+
+def _mats(d=256, k=64):
+    rng = np.random.default_rng(0)
+    return (
+        jnp.asarray(rng.normal(size=(d, k)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(d, k)).astype(np.float32)),
+    )
+
+
+def _toy_dag(n=512, d=256, k=64):
+    w1, w2 = _mats(d, k)
+    pipe = dag(
+        [Affine(w=w1), Affine(w=w2), ConcatFeatures()],
+        [(-1,), (-1,), (0, 1)],
+    )
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    return pipe, x
+
+
+# ---------------------------------------------------------------------------
+# DAG execution semantics
+# ---------------------------------------------------------------------------
+
+def test_dag_matches_eager_composition():
+    pipe, x = _toy_dag()
+    w1, w2 = pipe.nodes[0].w, pipe.nodes[1].w
+    expect = jnp.concatenate([x @ w1, x @ w2], axis=-1)
+    np.testing.assert_allclose(np.asarray(pipe(x)), np.asarray(expect),
+                               rtol=1e-6)
+    # single-item serving path agrees with the bulk path
+    np.testing.assert_allclose(
+        np.asarray(pipe.serve(x[0])), np.asarray(expect[0]), rtol=1e-6
+    )
+
+
+def test_dag_fan_out_and_host_boundary_segmentation():
+    """A host node is a materialization boundary; jittable runs on either
+    side fuse. Observed through the span names (one span per segment)."""
+    w1, w2 = _mats()
+    pipe = dag(
+        [Affine(w=w1), Host(), Affine(w=w2.T), ConcatFeatures()],
+        [(-1,), (0,), (1,), (1, 2)],
+    )
+    x = jnp.ones((32, 256), jnp.float32)
+    get_tracer().reset()
+    with use_tracing(True):
+        out = pipe(x)
+    assert out.shape == (32, 256 + 64)
+    names = [s["name"] for s in get_tracer().spans_as_dicts()
+             if s["name"].startswith("stage:")]
+    # Affine | Host boundary | Affine+Concat fused into ONE program
+    assert names == ["stage:Affine", "stage:Host",
+                     "stage:Affine+ConcatFeatures"]
+
+
+def test_dag_validation_errors():
+    w1, _ = _mats()
+    with pytest.raises(ValueError, match="topological"):
+        dag([Affine(w=w1)], [(1,)])
+    with pytest.raises(TypeError, match="Merge"):
+        dag([Affine(w=w1), Affine(w=w1)], [(-1,), (-1, 0)])
+    with pytest.raises(ValueError, match="dependency lists"):
+        dag([Affine(w=w1)], [])
+
+
+def test_dag_cache_point_memoizes_and_skips_producer():
+    """A cache_after point stores the intermediate; the repeat call serves
+    it and never re-executes the producing subgraph (fewer stage spans)."""
+    pipe, x = _toy_dag()
+    pipe = pipe.replace(cache_after=(0,))
+    cache = IntermediateCache(cache_dir=None)
+    with use_cache(cache):
+        out1 = pipe(x)
+        first_hits = cache.stats.hits
+        out2 = pipe(x)  # whole-output hit
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert cache.stats.hits > first_hits
+    assert cache.stats.computes == 1
+    # drop the whole-output entry, keep the node-0 intermediate: the rerun
+    # must resume from it (node 0 skipped) and still be exact
+    with use_cache(cache):
+        whole_key = pipe._prefix_key(2, __import__(
+            "keystone_tpu.core.cache", fromlist=["fingerprint"]
+        ).fingerprint(x))
+        e = cache._entries.pop(whole_key)
+        cache._tier_bytes[e.tier] -= e.nbytes
+        get_tracer().reset()
+        with use_tracing(True):
+            out3 = pipe(x)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out3))
+    names = [s["name"] for s in get_tracer().spans_as_dicts()
+             if s["name"].startswith("stage:")]
+    assert "stage:Affine+ConcatFeatures" in names[-1]
+    assert not any(n == "stage:Affine" for n in names)  # node 0 skipped
+
+
+def test_chain_to_dag_preserves_semantics():
+    w1, w2 = _mats()
+    c = chain(Affine(w=w1), Cacher(), Affine(w=w2.T))
+    d = chain_to_dag(c)
+    assert d.cache_after == (0,)
+    x = jnp.ones((16, 256), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(c(x)), np.asarray(d(x)), rtol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# Cost table + decisions
+# ---------------------------------------------------------------------------
+
+def test_cost_table_shapes_consumers_and_bounds():
+    pipe, x = _toy_dag(n=512, d=256, k=64)
+    costs = plan.pipeline_costs(pipe, x, mode="estimate")
+    assert [c.consumers for c in costs] == [1, 1, 1]
+    # fan-out counted
+    pipe2 = dag(
+        [pipe.nodes[0], pipe.nodes[1], ConcatFeatures(), ConcatFeatures()],
+        [(-1,), (-1,), (0, 1), (0, 2)],
+    )
+    costs2 = plan.pipeline_costs(pipe2, x, mode="estimate")
+    assert costs2[0].consumers == 2
+    c0 = costs[0]
+    assert c0.out_bytes == 512 * 64 * 4
+    assert c0.in_bytes == 512 * 256 * 4
+    assert c0.peak_hbm_bytes is not None and c0.peak_hbm_bytes >= (
+        c0.in_bytes + c0.out_bytes
+    )
+    assert all(c.source == "estimate" for c in costs)
+
+
+def test_unbounded_stage_is_reported_not_fatal():
+    class Weird(Transformer):
+        jittable = False
+
+        def apply_batch(self, xs):
+            # data-dependent shape: abstract evaluation cannot bound it
+            return xs[: int(np.asarray(xs)[0, 0]) + 1]
+
+        def apply(self, x):
+            return x
+
+    w1, _ = _mats()
+    c = chain(Weird(), Affine(w=w1))
+    p = plan.plan_pipeline(
+        c, jnp.ones((8, 256), jnp.float32), mode="estimate",
+        budget_bytes=1 << 30,
+    )
+    assert p.bounded is False
+    assert p.fits is False  # an unbounded stage can never prove fit
+    assert p.stages[0].peak_hbm_bytes is None
+
+
+def test_estimate_vs_profile_plan_parity_on_toy_dag():
+    """After a traced run, profile mode replans from measured spans and
+    lands on the SAME decisions (segments, cache tiers, shardings, block
+    sizes) as estimate mode — the cost source changes, the plan does not."""
+    pipe, x = _toy_dag(n=2048, d=512, k=256)
+    sites = [dict(site="s", n_rows=2048, num_classes=16, default=512,
+                  quantum=64, ceiling=1024)]
+    budget = 64 << 20
+    est = plan.plan_pipeline(pipe, x, mode="estimate", budget_bytes=budget,
+                             block_sites=sites)
+    get_tracer().reset()
+    with use_tracing(True):
+        pipe(x)
+    prof = plan.plan_pipeline(pipe, x, mode="profile", budget_bytes=budget,
+                              block_sites=sites)
+    assert any(s.source == "profile" for s in prof.stages)
+    assert [s.segment for s in prof.stages] == [s.segment for s in est.stages]
+    assert [s.cache_tier for s in prof.stages] == [
+        s.cache_tier for s in est.stages
+    ]
+    assert [s.sharding for s in prof.stages] == [
+        s.sharding for s in est.stages
+    ]
+    assert prof.block_sizes == est.block_sizes
+
+
+def test_cache_decision_and_apply_plan_round_trip():
+    """A reused expensive intermediate gets a device-tier cache decision;
+    apply_plan materializes it as a cache point that actually hits."""
+    w1, w2 = _mats(1024, 512)
+    pipe = dag(
+        [Affine(w=w1), ConcatFeatures(), ConcatFeatures()],
+        [(-1,), (0, 0), (0, 1)],
+    )
+    x = jnp.ones((4096, 1024), jnp.float32)
+    p = plan.plan_pipeline(pipe, x, mode="estimate", budget_bytes=8 << 30)
+    assert p.stages[0].cache_tier == "device"
+    planned = plan.apply_plan(pipe, p)
+    assert 0 in planned.cache_after
+    cache = IntermediateCache(cache_dir=None)
+    with use_cache(cache):
+        planned(x)
+        planned(x)
+    assert cache.stats.hits >= 1
+
+
+def test_apply_plan_replaces_hand_cachers_from_cost():
+    """The headline KeystoneML semantic: hand cache points are re-decided.
+    A Cacher after a CHEAP stage is declined (gone from the planned
+    chain); one after an expensive stage survives as a planned point."""
+    w_cheap, _ = _mats(8, 4)
+    c = chain(Affine(w=w_cheap), Cacher(), Affine(w=w_cheap.T))
+    x = jnp.ones((16, 8), jnp.float32)
+    p = plan.plan_pipeline(c, x, mode="estimate", budget_bytes=1 << 30)
+    assert len(p.stages) == 2  # Cacher stripped from the cost table
+    assert all(s.cache_tier is None for s in p.stages)  # declined
+    planned = plan.apply_plan(c, p)
+    assert not any(isinstance(s, Cacher) for s in planned.stages)
+    assert len(planned.stages) == 2
+    # expensive + re-consumed: the hand point is re-confirmed by cost
+    w_big, _ = _mats(1024, 1024)
+    c2 = chain(Affine(w=w_big), Cacher(), Affine(w=w_big))
+    x2 = jnp.ones((8192, 1024), jnp.float32)
+    p2 = plan.plan_pipeline(c2, x2, mode="estimate", budget_bytes=8 << 30)
+    assert p2.stages[0].cache_tier == "device"
+    planned2 = plan.apply_plan(c2, p2)
+    assert any(isinstance(s, Cacher) for s in planned2.stages)
+
+
+def test_apply_plan_dag_materializes_segment_splits():
+    """A budget-forced segment split must survive apply_plan on a DAG:
+    the executed program materializes at the planned boundary instead of
+    fusing past the peak the plan was scored on."""
+    w1, _ = _mats(1024, 1024)
+    pipe = dag(
+        [Affine(w=w1), Affine(w=w1), Affine(w=w1)],
+        [(-1,), (0,), (1,)],
+    )
+    x = jnp.ones((8192, 1024), jnp.float32)
+    budget = 80 << 20  # three 32 MB intermediates cannot stay fused
+    p = plan.plan_pipeline(pipe, x, mode="estimate", budget_bytes=budget)
+    assert p.num_segments > 1
+    planned = plan.apply_plan(pipe, p)
+    assert planned.cache_after  # the split is a materialization point
+    get_tracer().reset()
+    with use_tracing(True):
+        out = planned(x)
+    assert out.shape == (8192, 1024)
+    seg_spans = [s["name"] for s in get_tracer().spans_as_dicts()
+                 if s["name"].startswith("stage:")]
+    assert len(seg_spans) == p.num_segments  # executed as planned
+
+
+def test_sharding_boundary_flips_at_wide_feature_stage():
+    """The first stage whose 2-D feature output is wider than tall (the
+    d >= n solver regime) flips the plan to 'model' sharding onward —
+    the data->model boundary."""
+    w_small = jnp.zeros((2048, 256), jnp.float32)
+    w_big = jnp.zeros((256, 16384), jnp.float32)
+    c = chain(Affine(w=w_small), Affine(w=w_big))
+    x = jnp.ones((512, 2048), jnp.float32)
+    p = plan.plan_pipeline(c, x, mode="estimate", budget_bytes=8 << 30)
+    assert p.stages[0].sharding == "data"  # (512, 256): rows dominate
+    assert p.stages[1].sharding == "model"  # (512, 16384): d >= n
+
+
+# ---------------------------------------------------------------------------
+# HBM budget: binding constraint, exact arithmetic
+# ---------------------------------------------------------------------------
+
+def test_hbm_budget_is_binding_and_exactly_computed():
+    n, classes, quantum, default = 8192, 64, 64, 4096
+    budget = 48 << 20
+
+    def peak(b):
+        return plan.block_solve_peak_bytes(b, n_rows=n, num_classes=classes)
+
+    chosen = plan.hbm_safe_block_size(
+        n_rows=n, num_classes=classes, budget_bytes=budget,
+        default=default, quantum=quantum,
+    )
+    assert chosen < default  # binding
+    assert peak(chosen) <= budget  # provably fits
+    assert peak(chosen + quantum) > budget  # and is maximal
+    # no budget -> the hand default stands
+    assert plan.hbm_safe_block_size(
+        n_rows=n, num_classes=classes, budget_bytes=None,
+        default=default, quantum=quantum,
+    ) == default
+    # impossible budget -> the quantum floor, never a wedge
+    assert plan.hbm_safe_block_size(
+        n_rows=n, num_classes=classes, budget_bytes=1024,
+        default=default, quantum=quantum,
+    ) == quantum
+
+
+def test_plan_fits_flag_tracks_budget():
+    pipe, x = _toy_dag(n=4096, d=1024, k=512)
+    small = plan.plan_pipeline(pipe, x, mode="estimate",
+                               budget_bytes=1 << 20)
+    big = plan.plan_pipeline(pipe, x, mode="estimate",
+                             budget_bytes=8 << 30)
+    assert not small.fits
+    assert big.fits
+    # the tight budget forces more materialization boundaries (segment
+    # splitting at the largest intermediates), never a wedge
+    assert small.num_segments >= big.num_segments
+    assert small.est_peak_hbm_bytes <= big.est_peak_hbm_bytes
+
+
+# ---------------------------------------------------------------------------
+# Knob precedence: explicit > env > planned > default; off == prior program
+# ---------------------------------------------------------------------------
+
+def test_resolve_block_size_precedence(monkeypatch):
+    kw = dict(n_rows=100_000, num_classes=100, default=4096)
+    monkeypatch.delenv("KEYSTONE_OPTIMIZER", raising=False)
+    monkeypatch.delenv("KEYSTONE_BLOCK_SIZE", raising=False)
+    # off -> hand default, no plan consulted
+    assert plan.resolve_block_size("t", **kw) == 4096
+    monkeypatch.setenv("KEYSTONE_OPTIMIZER", "estimate")
+    monkeypatch.setenv("KEYSTONE_HBM_BUDGET", "64")
+    planned = plan.resolve_block_size("t", **kw)
+    assert planned != 4096  # the budget binds at these dims
+    monkeypatch.setenv("KEYSTONE_BLOCK_SIZE", "512")
+    assert plan.resolve_block_size("t", **kw) == 512  # env beats planned
+    assert plan.resolve_block_size("t", explicit=777, **kw) == 777
+
+
+def test_resolve_cache_blocks_precedence(monkeypatch):
+    kw = dict(n_rows=100_000, block_size=4096, itemsize=2, default=2)
+    monkeypatch.delenv("KEYSTONE_OPTIMIZER", raising=False)
+    assert plan.resolve_cache_blocks("t", **kw) == 2
+    assert plan.resolve_cache_blocks("t", explicit=0, **kw) == 0
+    monkeypatch.setenv("KEYSTONE_OPTIMIZER", "estimate")
+    monkeypatch.setenv("KEYSTONE_HBM_BUDGET", "16384")
+    v = plan.resolve_cache_blocks("t", **kw)
+    assert 0 <= v <= 8
+    assert plan.resolve_cache_blocks("t", explicit=4, **kw) == 4
+
+
+def test_optimizer_off_is_the_prior_program(monkeypatch):
+    """KEYSTONE_OPTIMIZER=0: maybe_plan returns None, the hand Cacher
+    segmentation stands untouched, and the lowered segment HLO is the
+    plain Chain program (no planner artifacts)."""
+    monkeypatch.delenv("KEYSTONE_OPTIMIZER", raising=False)
+    assert plan.enabled() is False
+    w1, w2 = _mats()
+    c = chain(Affine(w=w1), Cacher(), Affine(w=w2.T))
+    x = jnp.ones((16, 256), jnp.float32)
+    assert plan.maybe_plan(c, x) is None
+    get_tracer().reset()
+    with use_tracing(True):
+        c(x)
+    names = [s["name"] for s in get_tracer().spans_as_dicts()
+             if s["name"].startswith("stage:")]
+    # the PRIOR segmentation: jit segment | hand Cacher | jit segment
+    assert names == ["stage:Affine", "stage:Cacher", "stage:Affine"]
+    from keystone_tpu.core.pipeline import _jit_apply_batch
+
+    hlo = _jit_apply_batch.lower(
+        Chain(stages=(Affine(w=w1),)), x
+    ).as_text()
+    assert "dot" in hlo  # the same single-matmul program as ever
+
+
+def test_knob_wins_over_plan_in_pipeline_config(monkeypatch):
+    """The migrated pipelines: explicit config block size is passed through
+    verbatim even with the optimizer on (documented precedence)."""
+    from keystone_tpu.pipelines.mnist_random_fft import MnistRandomFFTConfig
+
+    monkeypatch.setenv("KEYSTONE_OPTIMIZER", "estimate")
+    monkeypatch.setenv("KEYSTONE_HBM_BUDGET", "8")
+    cfg = MnistRandomFFTConfig(block_size=1024)
+    assert cfg.resolved_block_size(60000) == 1024
+    auto = MnistRandomFFTConfig()
+    planned = auto.resolved_block_size(60000)
+    assert planned % 512 == 0  # the FFT-width quantum is honored
+    monkeypatch.delenv("KEYSTONE_OPTIMIZER")
+    monkeypatch.delenv("KEYSTONE_HBM_BUDGET")
+    assert auto.resolved_block_size(60000) == 2048  # prior hand value
+
+
+# ---------------------------------------------------------------------------
+# Plan cache + export + CLI
+# ---------------------------------------------------------------------------
+
+def test_plan_cache_zero_replans(tmp_path, monkeypatch):
+    pipe, x = _toy_dag()
+    cache_path = str(tmp_path / "plan_cache.json")
+    reg = get_registry()
+    before = reg.get_counter("plan.computed")
+    kw = dict(mode="estimate", budget_bytes=1 << 30, cache_path=cache_path)
+    p1 = plan.plan_pipeline(pipe, x, **kw)
+    assert reg.get_counter("plan.computed") == before + 1
+    p2 = plan.plan_pipeline(pipe, x, **kw)
+    assert reg.get_counter("plan.computed") == before + 1  # memo hit
+    assert p2.fingerprint == p1.fingerprint
+    # fresh-process simulation: memo cleared, disk cache serves
+    with plan._PLAN_LOCK:
+        plan._PLAN_MEMO.clear()
+    disk_hits = reg.get_counter("plan.cache_hit", tier="disk")
+    p3 = plan.plan_pipeline(pipe, x, **kw)
+    assert reg.get_counter("plan.computed") == before + 1
+    assert reg.get_counter("plan.cache_hit", tier="disk") == disk_hits + 1
+    assert p3.to_json() == p1.to_json()
+
+
+def test_plan_json_round_trip(tmp_path):
+    pipe, x = _toy_dag()
+    p = plan.plan_pipeline(pipe, x, mode="estimate", budget_bytes=1 << 30)
+    path = str(tmp_path / "plan.json")
+    p.save(path)
+    with open(path) as f:
+        loaded = plan.Plan.from_json(json.load(f))
+    assert loaded.to_json() == p.to_json()
+    assert "segments" in p.summary() or p.num_segments >= 1
+
+
+def test_plan_cli_toy(tmp_path, capsys):
+    out_json = str(tmp_path / "p.json")
+    rc = plan.main(["toy", "--smoke", "--budget-mb", "64",
+                    "--json", out_json])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "block_size[toy.solver]" in text
+    with open(out_json) as f:
+        artifact = json.load(f)
+    assert artifact["fits"] is True
+    assert artifact["block_sizes"]["toy.solver"] > 0
