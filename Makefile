@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint verify-fast telemetry-smoke autotune-smoke plan-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -29,6 +29,19 @@ test:
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m keystone_tpu.analysis
 
+# IR-level static analysis (keystone_tpu/analysis/ir_audit.py): lower the
+# registered entry points (overlap schedulers, solver rungs, Pallas
+# kernels + XLA twins, fused DAG segment) to jaxpr + compiled HLO and run
+# rules A1-A5. Non-zero exit ONLY for findings not in the ratcheted
+# ir_baseline.json. Seconds on the 8-device CPU sim.
+audit:
+	JAX_PLATFORMS=cpu $(PY) -m keystone_tpu.cli audit
+
+# Two-target audit smoke (<20 s): zero new findings + the JSON output
+# schema, the contract `make verify-fast` rides (scripts/audit_smoke.py).
+audit-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/audit_smoke.py
+
 # Lint + tier-1 + the BENCH_SMOKE bench contract + the telemetry smoke in
 # ONE command — the pre-merge loop: the static pass first (it is the
 # cheapest failure), then the full (non-slow) test suite on the 8-device
@@ -42,6 +55,7 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/autotune_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/plan_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/audit_smoke.py
 
 # Tiny traced pipeline -> counters non-zero, Chrome trace well-formed,
 # telemetry-report renders (scripts/telemetry_smoke.py); CPU, seconds.

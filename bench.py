@@ -975,6 +975,43 @@ def _try_lint_rows() -> dict:
         return {}
 
 
+def _try_audit_rows() -> dict:
+    """IR-audit hygiene row (``keystone_tpu/analysis/ir_audit.py``): lower
+    the registered entry points the live topology can place and record the
+    A1-A5 finding counts next to the perf numbers — the compiled-program
+    complement of the lint row. ``audit_findings_total`` counts everything
+    surfaced (new + baselined), ``audit_new`` what would fail ``make
+    audit``. A few lowers + compiles (no execution): seconds.
+    BENCH_AUDIT=0 skips."""
+    if not knobs.get("BENCH_AUDIT"):
+        return {}
+    try:
+        from keystone_tpu.analysis.ir_audit import (
+            DEFAULT_IR_BASELINE,
+            run_audit,
+        )
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        baseline = os.path.join(root, DEFAULT_IR_BASELINE)
+        result = run_audit(
+            baseline_path=baseline if os.path.exists(baseline) else None,
+        )
+        return {
+            "audit_findings_total": result.total,
+            "audit_new": len(result.findings),
+            "audit_suppressed": result.suppressed,
+            "audit_targets": len(result.targets) - len(result.skipped),
+            # entries the topology could not place (e.g. collective
+            # entries on a 1-device backend) — honesty key: a clean audit
+            # that skipped half its targets is not a clean audit
+            "audit_targets_skipped": len(result.skipped) or None,
+            "audit_errors": len(result.errors) or None,
+        }
+    except Exception as e:
+        print(f"audit rows failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"audit_findings_total": None}
+
+
 def _try_plan_rows() -> dict:
     """Whole-pipeline-optimizer evidence rows (``core/plan.py``): plan the
     flagship descriptor-reduction DAG + weighted-solver block site in
@@ -1164,6 +1201,17 @@ def main():
     # in the same trail as a perf regression.
     out.update(_try_lint_rows())
     _flush(out, "lint")
+    # IR-audit hygiene (lower + compile the registered entry points; no
+    # execution): seconds, but not milliseconds — a reduced floor like
+    # telemetry's, with the explicit budget-skip marker the section
+    # contract pins.
+    if _budget_remaining() - _FINALIZE_RESERVE_S < 20.0:
+        out["audit_skipped"] = "budget"
+        print("bench section audit skipped: budget exhausted",
+              file=sys.stderr)
+    else:
+        out.update(_try_audit_rows())
+    _flush(out, "audit")
     # Whole-pipeline-optimizer evidence (core/plan.py): shape analysis +
     # one lowering, but SIFT lowering on a cold process is not free — a
     # reduced floor like telemetry's, with the explicit budget-skip marker.
@@ -1324,6 +1372,9 @@ _COMPACT_KEYS = (
     # static-analysis hygiene (keystone_tpu/analysis; full counts in
     # bench_full.json)
     ("lint", "lint_findings_total"),
+    # IR-audit hygiene (keystone_tpu/analysis/ir_audit.py; full counts in
+    # bench_full.json)
+    ("audit", "audit_findings_total"),
     # whole-pipeline optimizer decisions (core/plan.py; full table via
     # `keystone-tpu plan imagenet`)
     ("plan_bs", "plan_block_size"),

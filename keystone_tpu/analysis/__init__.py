@@ -9,10 +9,14 @@
 - ``guard`` — the runtime cross-check: ``jax.transfer_guard`` + a
   recompilation sentinel feeding ``guard.transfer`` / ``guard.recompile``
   counters into the telemetry registry (``KEYSTONE_GUARD=1``).
+- ``ir_audit`` / ``ir_rules`` — keystone-audit: the COMPILED-program
+  complement (rules A1-A5 over jaxpr + HLO of registered entry points,
+  ratcheted by ``ir_baseline.json``; ``keystone-tpu audit``).
 - ``cli`` — the ``keystone-tpu lint`` subcommand.
 
-Import note: everything except ``guard`` is jax-free, so the lint pass
-runs in milliseconds with no backend initialization.
+Import note: everything except ``guard`` and ``ir_audit``/``ir_rules`` is
+jax-free, so the lint pass runs in milliseconds with no backend
+initialization (which is why the audit modules are NOT imported here).
 """
 
 from keystone_tpu.analysis.engine import (

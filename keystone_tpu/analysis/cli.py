@@ -50,6 +50,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--show-baselined", action="store_true",
                     help="also list baselined (non-failing) findings")
+    ap.add_argument("--show-stale-pragmas", action="store_true",
+                    help="list `# lint: disable` pragmas that suppressed "
+                         "zero findings this run (the unused-noqa analog)")
     ap.add_argument("--no-hints", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -64,11 +67,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
 
     if args.update_baseline:
+        from keystone_tpu.analysis.engine import discover_files, load_baseline
+
         result = LintEngine(root, paths).run()
-        save_baseline(baseline_path, result.findings)
+        old = load_baseline(baseline_path)
+        # stale fingerprints (fixed debt, or deleted files) are PRUNED,
+        # not kept, so the ratchet can only tighten — EXCEPT debt of
+        # still-existing files outside this run's path subset, which a
+        # partial `lint <subdir> --update-baseline` must not silently drop
+        linted = {
+            os.path.relpath(p, root) for p in discover_files(root, paths)
+        }
+        keep = {
+            fp: n for fp, n in old.items()
+            if fp.split("::", 1)[0] not in linted
+            and os.path.exists(os.path.join(root, fp.split("::", 1)[0]))
+        }
+        save_baseline(baseline_path, result.findings, keep=keep)
+        pruned = (
+            set(old) - {f.fingerprint for f in result.findings} - set(keep)
+        )
+        kept_note = f", {len(keep)} out-of-scope kept" if keep else ""
         print(
             f"keystone-lint: baselined {len(result.findings)} findings "
-            f"({result.suppressed} pragma-suppressed) -> {baseline_path}"
+            f"({result.suppressed} pragma-suppressed, {len(pruned)} stale "
+            f"fingerprint(s) pruned{kept_note}) -> {baseline_path}"
         )
         return 0
 
@@ -83,6 +106,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             result,
             show_baselined=args.show_baselined,
             hints=not args.no_hints,
+            show_stale_pragmas=args.show_stale_pragmas,
         ))
     if result.errors:
         return 2

@@ -46,7 +46,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 PRAGMA_RE = re.compile(r"lint:\s*disable(?:=([A-Za-z0-9_,\- ]+))?")
 
-#: rule ids a bare ``lint: disable`` expands to
+#: rule ids a bare disable pragma (no ``=<rules>`` part) expands to
 ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 
 
@@ -91,6 +91,9 @@ class LintResult:
     suppressed: int = 0                                        # via pragma
     files: int = 0
     errors: List[str] = field(default_factory=list)           # unparsable
+    #: pragmas that suppressed ZERO findings this run (the unused-noqa
+    #: analog): (path, line, "R1,R5") triples — report-only, never failing
+    stale_pragmas: List[Tuple[str, int, str]] = field(default_factory=list)
 
     @property
     def total(self) -> int:
@@ -103,12 +106,22 @@ class LintResult:
 # Parsed modules
 # ---------------------------------------------------------------------------
 
-def _collect_pragmas(source: str) -> Dict[int, Set[str]]:
-    """line -> set of disabled rule ids (``{"*"}`` = all).  A pragma in a
-    comment-only line covers the rest of its comment block plus the first
-    code line after it (the natural "justification paragraph" shape); a
-    trailing pragma covers its own line."""
-    pragmas: Dict[int, Set[str]] = {}
+@dataclass
+class PragmaSite:
+    """One ``# lint: disable=...`` comment: its own line, the rule ids it
+    names (``{"*"}`` = all), and every line it covers — the unit the
+    stale-pragma check credits when a suppression actually fires."""
+
+    line: int
+    rules: Set[str]
+    covered: Set[int]
+
+
+def collect_sites(source: str) -> List[PragmaSite]:
+    """Every pragma comment in ``source`` with its coverage: a trailing
+    pragma covers its own line; a comment-only pragma covers the rest of
+    its comment block plus the first code line after it."""
+    sites: List[PragmaSite] = []
     lines = source.splitlines()
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
@@ -123,7 +136,7 @@ def _collect_pragmas(source: str) -> Dict[int, Set[str]]:
                 if m.group(1) else {"*"}
             )
             line = tok.start[0]
-            pragmas.setdefault(line, set()).update(rules)
+            covered = {line}
             standalone = tok.line[: tok.start[1]].strip() == ""
             if standalone:
                 nxt = line + 1
@@ -131,11 +144,22 @@ def _collect_pragmas(source: str) -> Dict[int, Set[str]]:
                     not lines[nxt - 1].strip()
                     or lines[nxt - 1].lstrip().startswith("#")
                 ):
-                    pragmas.setdefault(nxt, set()).update(rules)
+                    covered.add(nxt)
                     nxt += 1
-                pragmas.setdefault(nxt, set()).update(rules)
+                covered.add(nxt)
+            sites.append(PragmaSite(line=line, rules=rules, covered=covered))
     except (tokenize.TokenError, IndentationError):
         pass
+    return sites
+
+
+def _collect_pragmas(source: str) -> Dict[int, Set[str]]:
+    """line -> set of disabled rule ids (``{"*"}`` = all) — the flat view
+    of :func:`collect_sites` the suppression filter consumes."""
+    pragmas: Dict[int, Set[str]] = {}
+    for site in collect_sites(source):
+        for line in site.covered:
+            pragmas.setdefault(line, set()).update(site.rules)
     return pragmas
 
 
@@ -148,6 +172,7 @@ class ModuleInfo:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source)
+        self.pragma_sites = collect_sites(source)
         self.pragmas = _collect_pragmas(source)
         # Parent links: rules walk *up* for loop/with/function context.
         for node in ast.walk(self.tree):
@@ -312,17 +337,25 @@ def load_baseline(path: str) -> Dict[str, int]:
     return {str(k): int(v) for k, v in data.get("findings", {}).items()}
 
 
-def save_baseline(path: str, findings: Sequence[Finding]) -> None:
-    counts: Dict[str, int] = {}
+def save_baseline(
+    path: str, findings: Sequence[Finding], tool: str = "lint",
+    keep: Optional[Dict[str, int]] = None,
+) -> None:
+    """Write the ratchet file from the current findings.  ``keep`` carries
+    prior-baseline entries OUTSIDE this run's scope (files that were not
+    linted / entries that were not audited) — their debt is preserved, not
+    silently pruned by a subset run."""
+    counts: Dict[str, int] = dict(keep or {})
     for f in findings:
         counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
     payload = {
         "comment": (
-            "keystone-lint ratchet: pre-existing findings by fingerprint. "
-            "New findings (beyond these counts) fail `make lint`; prefer "
-            "fixing or an inline `# lint: disable=<rule> (<reason>)` pragma "
-            "over baselining. Regenerate with `keystone-tpu lint "
-            "--update-baseline`."
+            f"keystone-{tool} ratchet: pre-existing findings by "
+            f"fingerprint. New findings (beyond these counts) fail `make "
+            f"{tool}`; prefer fixing or an inline `# lint: disable=<rule> "
+            f"(<reason>)` pragma over baselining. Regenerate with "
+            f"`keystone-tpu {tool} --update-baseline` (stale fingerprints "
+            f"are pruned)."
         ),
         "findings": dict(sorted(counts.items())),
     }
@@ -412,13 +445,37 @@ class LintEngine:
             raw.extend(rule.run(ctx))
 
         kept: List[Finding] = []
+        credited: Dict[Tuple[str, int], int] = {}
         for f in raw:
             mod = modules.get(f.path)
             disabled = mod.suppressed_rules(f.line) if mod else set()
             if "*" in disabled or f.rule in disabled:
                 result.suppressed += 1
+                # credit every site whose coverage + rule set fired here
+                for site in mod.pragma_sites:
+                    if f.line in site.covered and (
+                        "*" in site.rules or f.rule in site.rules
+                    ):
+                        key = (f.path, site.line)
+                        credited[key] = credited.get(key, 0) + 1
             else:
                 kept.append(f)
+        # stale pragmas (the unused-noqa analog): sites that suppressed
+        # nothing, restricted to rule ids this run actually executed — a
+        # pragma for a rule family another engine owns (the A-rules of
+        # keystone-audit) is not stale just because this pass ran R1-R6.
+        executed = {getattr(r, "id", None) for r in rules}
+        for rel, mod in modules.items():
+            for site in mod.pragma_sites:
+                if (rel, site.line) in credited:
+                    continue
+                ids = site.rules - {"*"}
+                if ids and not ids & executed:
+                    continue
+                result.stale_pragmas.append(
+                    (rel, site.line, ",".join(sorted(site.rules)))
+                )
+        result.stale_pragmas.sort()
         result.findings = sorted(
             kept, key=lambda f: (f.path, f.line, f.col, f.rule)
         )

@@ -1,0 +1,752 @@
+"""keystone-audit: IR-level static analysis of compiled programs.
+
+keystone-lint (``engine.py``/``rules.py``) audits Python source; nothing
+audited the *compiled* program — the level where XLA can silently
+reintroduce a terminal all-reduce, a weak-type f64 promotion, a host
+callback, a padding-wasteful layout, or a buffer-assignment peak the cost
+model no longer bounds.  This module closes that gap: a registry of entry
+points (both overlap schedulers, the solver ladder rungs, the Pallas
+kernels and their XLA twins, a fused pipeline segment, the flagship solver
+block step) is lowered to jaxpr + compiled StableHLO/HLO under small
+abstract input specs, and the A1–A5 rule families (``ir_rules.py``) run
+over the IR.
+
+Findings flow through the EXISTING keystone-lint machinery: the same
+:class:`~keystone_tpu.analysis.engine.Finding` type anchored at each entry
+point's registration line in THIS file (so ``# lint: disable=A3 (reason)``
+pragmas above a registration suppress exactly like source-rule pragmas),
+the same ratcheted baseline (``ir_baseline.json``), the same stale-pragma
+and stale-baseline reporting.  ``keystone-tpu audit`` is the CLI; ``make
+audit`` / ``make audit-smoke`` the CI entry points; ``audit_findings_total``
+/ ``audit_new`` the bench hygiene series.
+
+Every entry point registered here replaces a hand-written HLO pin: the
+assertion helpers the rules use are the SAME functions
+``tests/test_overlap.py`` imports, so the tests and the auditor cannot
+disagree about what "pipelined" means.
+
+Device note: the collective entries need a multi-device mesh.  The CLI
+requests 8 simulated CPU devices before backend init (the test-suite
+topology); entries whose ``min_devices`` the live backend cannot meet are
+reported as *skipped*, never silently passed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from keystone_tpu.analysis.engine import (
+    Finding,
+    LintResult,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+from keystone_tpu.analysis.ir_rules import (
+    ALL_AUDIT_RULES,
+    AuditProgram,
+    IRRule,
+    default_ir_rules,
+)
+
+DEFAULT_IR_BASELINE = "ir_baseline.json"
+
+#: repo-relative anchor every IR finding carries (the pragma file)
+_SELF_RELPATH = os.path.join("keystone_tpu", "analysis", "ir_audit.py")
+
+
+def ensure_cpu_devices(count: int = 8) -> None:
+    """Request ``count`` simulated CPU devices BEFORE jax initializes its
+    backend (the collective entries need a real mesh to lower against —
+    the same 8-device topology the test suite pins).  A no-op once the
+    backend is up or on a non-CPU platform: the audit then runs against
+    whatever devices exist and skips entries it cannot place."""
+    platform = (os.environ.get("JAX_PLATFORMS") or "").strip().lower()
+    if platform not in ("", "cpu"):
+        return
+    # belt and braces (the tests/conftest.py dance): the env flag works on
+    # every jaxlib as long as the backend has not initialized yet...
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={count}"
+        ).strip()
+    import jax
+
+    try:
+        # ...and the config knob covers jaxlibs that read it instead
+        jax.config.update("jax_num_cpu_devices", count)
+    except Exception:
+        # backend already initialized (or a jaxlib without the knob): run
+        # with what there is — the engine skips under-provisioned entries
+        # loudly rather than silently passing them
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Entry-point registry
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Built:
+    """What a builder returns: the traceable closure + concrete args plus
+    the rule expectations resolved against the actual topology."""
+
+    fn: Callable
+    args: Tuple[Any, ...]
+    k: int = 1                              # sharded-axis size
+    expect: Dict[str, Any] = field(default_factory=dict)
+    peak_estimate: Optional[int] = None     # plan.py closed-form bytes
+
+
+@dataclass(frozen=True)
+class EntryPoint:
+    name: str
+    category: str            # overlap | solver | pallas | pipeline
+    builder: Callable        # (devices) -> Built
+    min_devices: int
+    line: int                # registration line in this file (pragma anchor)
+    doc: str
+
+
+ENTRY_POINTS: Dict[str, EntryPoint] = {}
+
+
+def register(name: str, category: str, min_devices: int = 1):
+    """Register an audit entry point.  The decorated builder's first line
+    is the finding/pragma anchor: a ``# lint: disable=A<n> (reason)``
+    comment immediately above the registration suppresses that rule for
+    this entry, exactly like a source-lint pragma."""
+
+    def deco(fn):
+        ENTRY_POINTS[name] = EntryPoint(
+            name=name, category=category, builder=fn,
+            min_devices=min_devices, line=fn.__code__.co_firstlineno,
+            doc=(fn.__doc__ or "").strip().splitlines()[0]
+            if fn.__doc__ else "",
+        )
+        return fn
+
+    return deco
+
+
+def _data_mesh(devices, model: int = 1):
+    from keystone_tpu.parallel import make_mesh
+
+    k = len(devices) // model
+    return make_mesh(data=k, model=model, devices=devices[: k * model])
+
+
+def _f32(rng, *shape):
+    import numpy as np
+
+    return rng.normal(size=shape).astype("float32")
+
+
+def _rng():
+    import numpy as np
+
+    return np.random.default_rng(7)
+
+
+# -- overlap schedulers ------------------------------------------------------
+
+@register("overlap.tiled_gram", "overlap", min_devices=2)
+def _build_tiled_gram(devices) -> Built:
+    """Tiled reduce-scatter collective matmul (the gram scheduler):
+    k per-tile reduce-scatters, one trailing all-gather, no all-reduce."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel.overlap import tiled_transpose_matmul
+
+    mesh = _data_mesh(devices)
+    k = mesh.shape["data"]
+    x = jnp.asarray(_f32(_rng(), 16 * k, 16 * k))
+    return Built(
+        fn=lambda a: tiled_transpose_matmul(a, mesh=mesh),
+        args=(x,), k=k,
+        expect=dict(
+            reduce_scatter_min="k", all_gather_max=1, check_padding=True,
+        ),
+    )
+
+
+@register("overlap.ring_gram", "overlap", min_devices=2)
+def _build_ring_gram(devices) -> Built:
+    """Bidirectional ring gram (the ppermute scheduler): paired
+    collective-permutes, zero bulk collectives."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel import make_mesh
+    from keystone_tpu.parallel.overlap import bidirectional_ring_gram
+
+    k = len(devices)
+    mesh = make_mesh(data=1, model=k, devices=devices)
+    x = jnp.asarray(_f32(_rng(), 40, 16 * k))
+    return Built(
+        fn=lambda a: bidirectional_ring_gram(a, mesh, axis="model"),
+        args=(x,), k=k,
+        expect=dict(
+            zero_bulk=True, paired_permutes=True,
+            permute_min=2 * ((k - 1) // 2), unpaired_max=1,
+        ),
+    )
+
+
+# -- solver ladder rungs -----------------------------------------------------
+
+@register("solver.normal_equations", "solver", min_devices=2)
+def _build_normal_equations(devices) -> Built:
+    """Overlap-path normal equations: gram + cross term lower to per-tile
+    reduce-scatters, never a terminal all-reduce; f32 throughout."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.linalg.solvers import _normal_equations
+
+    mesh = _data_mesh(devices)
+    k = mesh.shape["data"]
+    rng = _rng()
+    A = jnp.asarray(_f32(rng, 32 * k, 16 * k))
+    b = jnp.asarray(_f32(rng, 32 * k, 8))
+    lam = jax.device_put(jnp.float32(1.0))
+    return Built(
+        fn=lambda A_, b_: _normal_equations(
+            A_, b_, lam, None, precision="high", omesh=mesh
+        ),
+        args=(A, b), k=k,
+        expect=dict(
+            reduce_scatter_min="k", all_gather_max=2, check_padding=True,
+        ),
+    )
+
+
+@register("solver.tsqr", "solver", min_devices=2)
+def _build_tsqr(devices) -> Built:
+    """Overlapped TSQR ring fold: paired ppermutes carrying (R, Qᵀb),
+    zero bulk all-gather/all-reduce."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.linalg.solvers import _tsqr_solve
+
+    mesh = _data_mesh(devices)
+    k = mesh.shape["data"]
+    rng = _rng()
+    A = jnp.asarray(_f32(rng, 32 * k, 16))
+    b = jnp.asarray(_f32(rng, 32 * k, 3))
+    return Built(
+        fn=lambda A_, b_: _tsqr_solve(
+            A_, b_, jnp.float32(0.5), None, mesh, True, "highest", True,
+            None,
+        ),
+        args=(A, b), k=k,
+        expect=dict(
+            zero_bulk=True, paired_permutes=True,
+            permute_min=2 * ((k - 1) // 2),
+            # the even-k middle hop ships the (R, Qᵀb) PAIR: one unpaired
+            # ring hop = two unmatched HLO permutes (one per pytree leaf)
+            unpaired_max=2,
+        ),
+    )
+
+
+@register("solver.sketch", "solver")
+def _build_sketch(devices) -> Built:
+    """Sketch-and-precondition rung (single-program form): f32 discipline
+    and zero host round-trips through sketch + QR + preconditioned CG."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.linalg.sketch import sketched_lstsq_solve
+    from keystone_tpu.parallel import make_mesh
+
+    mesh = make_mesh(data=1, model=1, devices=devices[:1])
+    rng = _rng()
+    A = jnp.asarray(_f32(rng, 128, 16))
+    b = jnp.asarray(_f32(rng, 128, 3))
+    return Built(
+        fn=lambda A_, b_: sketched_lstsq_solve(
+            A_, b_, lam=0.5, mesh=mesh, overlap=False, tol=0.0,
+            max_iters=5,
+        ),
+        args=(A, b), k=1,
+        expect=dict(),
+    )
+
+
+@register("solver.block_step", "solver")
+def _build_block_step(devices) -> Built:
+    """Flagship solver block step (gram + cross + Cholesky + residual
+    update): the A5 target — ``plan.block_solve_peak_bytes`` must bound
+    the compiled buffer-assignment peak."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.plan import block_solve_peak_bytes
+    from keystone_tpu.linalg.solvers import hdot, spd_solve
+
+    n_rows, block, classes = 2048, 512, 16
+    rng = _rng()
+    Ab = jnp.asarray(_f32(rng, n_rows, block))
+    resid = jnp.asarray(_f32(rng, n_rows, classes))
+    w = jnp.asarray(_f32(rng, block, classes))
+
+    def step(Ab_, r_, w_):
+        gram = hdot(Ab_.T, Ab_, "high")
+        gram = gram + 0.1 * jnp.eye(block, dtype=Ab_.dtype)
+        cross = hdot(Ab_.T, r_, "high")
+        w_new = spd_solve(gram, cross)
+        return w_new, r_ - Ab_ @ (w_new - w_)
+
+    return Built(
+        fn=step, args=(Ab, resid, w), k=1,
+        expect=dict(check_padding=True),
+        peak_estimate=block_solve_peak_bytes(
+            block, n_rows=n_rows, num_classes=classes, dtype_bytes=4,
+        ),
+    )
+
+
+# -- Pallas kernels + their XLA twins ----------------------------------------
+
+def _sift_args():
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = _rng()
+    mag = jnp.asarray(rng.uniform(0, 1, (2, 24, 32)).astype(np.float32))
+    ang = jnp.asarray(rng.uniform(0, 6, (2, 24, 32)).astype(np.float32))
+    sel = (rng.uniform(0, 1, (32, 9)) < 0.3).astype(np.float32)
+    return mag, ang, sel
+
+
+@register("pallas.sift_bins", "pallas")
+def _build_sift_bins(devices) -> Built:
+    """Fused SIFT orientation-binning kernel (interpret form off-TPU):
+    no host round-trips, f32 only."""
+    from keystone_tpu.ops.pallas import autotune
+    from keystone_tpu.ops.pallas.extraction import sift_oriented_bins
+
+    mag, ang, sel = _sift_args()
+    # the kernel flattens leading dims x H into its row axis — the same
+    # (rows, width) bucket sift_bins_tile keys the persisted winner on,
+    # so the A4 cross-check sees exactly the tile production would serve
+    rows = mag.shape[0] * mag.shape[1]
+    return Built(
+        fn=lambda m, a: sift_oriented_bins(
+            m, a, sel, tile_r=16, interpret=True
+        ),
+        args=(mag, ang), k=1,
+        expect=dict(
+            check_padding=True,
+            tile_kernel=(
+                "sift.bins",
+                autotune.shape_bucket(rows, mag.shape[-1]),
+                rows,
+            ),
+        ),
+    )
+
+
+@register("pallas.sift_bins_xla", "pallas")
+def _build_sift_bins_xla(devices) -> Built:
+    """The SIFT binning kernel's XLA twin (the selection-matmul prior
+    path): the program KEYSTONE_PALLAS=0 must keep serving."""
+    from keystone_tpu.ops.images.sift import _dsift_single_scale
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = _rng()
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, 48, 48)).astype(np.float32))
+    return Built(
+        fn=lambda im: _dsift_single_scale(im, 3, 4, 9, 48, 48, "matmul"),
+        args=(imgs,), k=1,
+        expect=dict(),
+    )
+
+
+def _fv_args():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.learning.gmm import GaussianMixtureModel
+
+    rng = _rng()
+    k, d = 8, 6
+    gmm = GaussianMixtureModel(
+        means=jnp.asarray(rng.normal(size=(k, d)).astype(np.float32)),
+        variances=jnp.asarray(
+            rng.uniform(0.5, 2.0, (k, d)).astype(np.float32)
+        ),
+        weights=jnp.asarray(rng.dirichlet(np.ones(k)).astype(np.float32)),
+    )
+    x = jnp.asarray(rng.normal(size=(4, 18, d)).astype(np.float32))
+    return x, gmm, k
+
+
+@register("pallas.fv_encode", "pallas")
+def _build_fv_encode(devices) -> Built:
+    """Fused FV posterior×moment kernel (interpret form off-TPU): the
+    (n, n_desc, k) posterior tensor never reaches HBM; f32 only."""
+    from keystone_tpu.ops.images import fisher_vector as FV
+
+    x, gmm, k = _fv_args()
+
+    # the kernel form is addressed directly (no env dispatch), the same
+    # way the parity tests name it
+    def fn(x_):
+        return FV._fv_cols_batch_pallas(x_, gmm, 0, k)
+
+    return Built(fn=fn, args=(x,), k=1, expect=dict())
+
+
+@register("pallas.fv_encode_xla", "pallas")
+def _build_fv_encode_xla(devices) -> Built:
+    """The FV encode kernel's exact-f32 XLA twin."""
+    from keystone_tpu.ops.images import fisher_vector as FV
+
+    x, gmm, k = _fv_args()
+
+    def fn(x_):
+        return FV._fv_cols_batch_f32(x_, gmm, 0, k)
+
+    return Built(fn=fn, args=(x,), k=1, expect=dict())
+
+
+# -- fused pipeline segment --------------------------------------------------
+
+@register("dag.fused_segment", "pipeline")
+def _build_dag_segment(devices) -> Built:
+    """A fused DAG segment (two feature branches joined by
+    ConcatFeatures, all jittable → ONE XLA program): no host transfers,
+    f32 end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.pipeline import ConcatFeatures, dag
+    from keystone_tpu.ops.stats import CosineRandomFeatures
+
+    keys = jax.random.split(jax.random.key(11), 2)
+    n1 = CosineRandomFeatures.create(12, 16, 0.1, keys[0])
+    n2 = CosineRandomFeatures.create(12, 16, 0.1, keys[1])
+    d = dag([n1, n2, ConcatFeatures()], deps=[(-1,), (-1,), (0, 1)])
+    xs = jnp.asarray(_f32(_rng(), 32, 12))
+    return Built(
+        fn=lambda x: d.apply_batch(x), args=(xs,), k=1,
+        expect=dict(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+class AuditResult(LintResult):
+    """LintResult plus the audit-specific accounting."""
+
+    def __init__(self):
+        super().__init__()
+        self.targets: List[str] = []            # audited entry names
+        self.skipped: Dict[str, str] = {}       # name -> reason
+
+
+def resolve_targets(targets: Optional[Sequence[str]] = None) -> List[str]:
+    """Registered entry names matching ``targets`` (exact names or
+    category/dotted prefixes); None/empty = the ``KEYSTONE_AUDIT_TARGETS``
+    knob, else every registered entry.  Unknown targets raise."""
+    if not targets:
+        from keystone_tpu.utils import knobs
+
+        raw = (knobs.get("KEYSTONE_AUDIT_TARGETS") or "").strip()
+        targets = [t.strip() for t in raw.split(",") if t.strip()] or None
+    if not targets:
+        return list(ENTRY_POINTS)
+    out: List[str] = []
+    for t in targets:
+        hits = [
+            n for n in ENTRY_POINTS
+            if n == t or n.startswith(t + ".") or
+            ENTRY_POINTS[n].category == t
+        ]
+        if not hits:
+            raise KeyError(
+                f"unknown audit target {t!r}; registered: "
+                f"{', '.join(sorted(ENTRY_POINTS))}"
+            )
+        out.extend(h for h in hits if h not in out)
+    return out
+
+
+def _fingerprint_entry(fp: str) -> str:
+    """The entry-point name a baseline fingerprint belongs to (findings
+    carry ``path::rule::<entry>::<detail>`` — see ``ir_rules._finding``);
+    '' for malformed fingerprints (always treated as in-scope)."""
+    parts = fp.split("::")
+    return parts[2] if len(parts) >= 4 else ""
+
+
+def _pragma_info():
+    """Pragma map + sites of THIS file, through the lint engine's own
+    collector — the one pragma grammar."""
+    from keystone_tpu.analysis.engine import _collect_pragmas, collect_sites
+
+    path = os.path.abspath(__file__).rstrip("c")
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError:
+        return {}, []
+    return _collect_pragmas(source), collect_sites(source)
+
+
+def lower_entry(entry: EntryPoint, devices) -> AuditProgram:
+    """Build, trace, and compile one entry point into the rule input."""
+    import jax
+
+    built = entry.builder(devices)
+    jaxpr = jax.make_jaxpr(built.fn)(*built.args)
+    compiled = jax.jit(built.fn).lower(*built.args).compile()
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    return AuditProgram(
+        name=entry.name, path=_SELF_RELPATH, line=entry.line,
+        jaxpr=jaxpr, hlo_text=compiled.as_text(), memory_stats=mem,
+        k=built.k, expect=built.expect,
+        peak_estimate=built.peak_estimate,
+    )
+
+
+def run_audit(
+    targets: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+    rules: Optional[Sequence[IRRule]] = None,
+) -> AuditResult:
+    """Lower the selected entry points and run the A-rules, folding the
+    pragma filter and the ratcheted ``ir_baseline.json`` in exactly like
+    ``run_lint``."""
+    import jax
+
+    result = AuditResult()
+    result.targets = resolve_targets(targets)
+    rules = list(rules) if rules is not None else default_ir_rules()
+    executed = {r.id for r in rules}
+    devices = jax.devices()
+    pragmas, sites = _pragma_info()
+
+    raw: List[Finding] = []
+    audited_lines: List[int] = []
+    for name in result.targets:
+        entry = ENTRY_POINTS[name]
+        if len(devices) < entry.min_devices:
+            result.skipped[name] = (
+                f"needs >= {entry.min_devices} devices, have "
+                f"{len(devices)}"
+            )
+            continue
+        try:
+            prog = lower_entry(entry, devices)
+        except Exception as e:  # build/lower failure is an audit error
+            result.errors.append(
+                f"{name}: {type(e).__name__}: {e}"
+            )
+            continue
+        audited_lines.append(entry.line)
+        result.files += 1
+        for rule in rules:
+            raw.extend(rule.run(prog))
+
+    # pragma filter (the engine's semantics, over THIS file's comments)
+    credited: Dict[int, int] = {}
+    kept: List[Finding] = []
+    for f in raw:
+        disabled = pragmas.get(f.line, set())
+        if "*" in disabled or f.rule in disabled:
+            result.suppressed += 1
+            for site in sites:
+                if f.line in site.covered and (
+                    "*" in site.rules or f.rule in site.rules
+                ):
+                    credited[site.line] = credited.get(site.line, 0) + 1
+        else:
+            kept.append(f)
+    # stale A-pragmas: a site whose rules are all audit rules, covering an
+    # audited registration, that suppressed nothing this run
+    for site in sites:
+        if site.line in credited:
+            continue
+        ids = site.rules - {"*"}
+        if not ids or not ids <= set(ALL_AUDIT_RULES):
+            continue
+        if not any(line in site.covered for line in audited_lines):
+            continue  # covers an entry this run did not audit
+        result.stale_pragmas.append(
+            (_SELF_RELPATH, site.line, ",".join(sorted(site.rules)))
+        )
+    result.findings = sorted(
+        kept, key=lambda f: (f.path, f.line, f.col, f.rule)
+    )
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+        new, known, stale = apply_baseline(result.findings, baseline)
+        result.findings = new
+        result.baselined = known
+        result.stale = stale
+    return result
+
+
+# ---------------------------------------------------------------------------
+# CLI: ``keystone-tpu audit``
+# ---------------------------------------------------------------------------
+
+def render_audit_json(result: AuditResult) -> str:
+    from keystone_tpu.analysis.reporters import finding_dict
+
+    return json.dumps({
+        "new": [finding_dict(f) for f in result.findings],
+        "baselined": [finding_dict(f) for f in result.baselined],
+        "stale": result.stale,
+        "stale_pragmas": [
+            {"path": p, "line": l, "rules": r}
+            for p, l, r in result.stale_pragmas
+        ],
+        "suppressed": result.suppressed,
+        "targets": result.targets,
+        "skipped": result.skipped,
+        "errors": result.errors,
+        "total": result.total,
+    }, indent=2) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``keystone-tpu audit`` — exit 0 when no new findings, 1 when new
+    findings exist, 2 on usage/build errors (the lint CLI's contract)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="keystone-tpu audit",
+        description="IR-level static analysis of compiled programs "
+                    "(rules A1-A5 over jaxpr + compiled HLO); fails only "
+                    "on findings not in the ratcheted ir_baseline.json.",
+    )
+    ap.add_argument("--target", action="append", default=None,
+                    help="entry point (or category/prefix) to audit; "
+                         "repeatable; default: KEYSTONE_AUDIT_TARGETS or "
+                         "all registered entries")
+    ap.add_argument("--root", default=".",
+                    help="repo root for the baseline file")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/"
+                         f"{DEFAULT_IR_BASELINE} when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report and fail on every "
+                         "finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(stale fingerprints are pruned) and exit 0")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered entry points and exit")
+    ap.add_argument("--show-stale-pragmas", action="store_true",
+                    help="list audit pragmas that suppressed nothing")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    if args.list:
+        for name in sorted(ENTRY_POINTS):
+            e = ENTRY_POINTS[name]
+            extra = (
+                f" [needs {e.min_devices} devices]"
+                if e.min_devices > 1 else ""
+            )
+            print(f"{name:28s} {e.category:9s} {e.doc}{extra}")
+        return 0
+
+    ensure_cpu_devices()
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_IR_BASELINE)
+    use_baseline = not args.no_baseline and (
+        args.baseline is not None or os.path.exists(baseline_path)
+    )
+
+    try:
+        if args.update_baseline:
+            result = run_audit(args.target, baseline_path=None)
+            if result.errors or result.skipped:
+                # a partial run must never rewrite the ratchet: entries
+                # that did not audit would have their fingerprints
+                # silently pruned, and the next fully-provisioned run
+                # would fail with their findings as "new"
+                print(
+                    "keystone-audit: refusing --update-baseline from a "
+                    f"partial run ({len(result.skipped)} entry point(s) "
+                    f"skipped, {len(result.errors)} error(s)); fix the "
+                    "topology/build first", file=sys.stderr,
+                )
+                for name, reason in sorted(result.skipped.items()):
+                    print(f"  skipped {name}: {reason}", file=sys.stderr)
+                for err in result.errors:
+                    print(f"  error {err}", file=sys.stderr)
+                return 2
+            old = load_baseline(baseline_path)
+            audited = set(result.targets)
+            # debt of entries OUTSIDE this run's --target scope survives
+            # (malformed fingerprints have no entry and stay prunable)
+            keep = {
+                fp: n for fp, n in old.items()
+                if _fingerprint_entry(fp)
+                and _fingerprint_entry(fp) not in audited
+            }
+            save_baseline(
+                baseline_path, result.findings, tool="audit", keep=keep
+            )
+            pruned = (
+                set(old) - {f.fingerprint for f in result.findings}
+                - set(keep)
+            )
+            kept_note = (
+                f", {len(keep)} out-of-scope kept" if keep else ""
+            )
+            print(
+                f"keystone-audit: baselined {len(result.findings)} findings "
+                f"({result.suppressed} pragma-suppressed, "
+                f"{len(pruned)} stale fingerprint(s) pruned{kept_note}) -> "
+                f"{baseline_path}"
+            )
+            return 0
+        result = run_audit(
+            args.target,
+            baseline_path=baseline_path if use_baseline else None,
+        )
+    except KeyError as e:
+        print(str(e.args[0] if e.args else e), file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        sys.stdout.write(render_audit_json(result))
+    else:
+        from keystone_tpu.analysis.reporters import render_text
+
+        print(render_text(
+            result, show_stale_pragmas=args.show_stale_pragmas,
+            label="keystone-audit",
+        ))
+        for name, reason in sorted(result.skipped.items()):
+            print(f"skipped {name}: {reason}")
+        print(
+            f"keystone-audit: {len(result.targets) - len(result.skipped)}"
+            f"/{len(result.targets)} entry points audited"
+        )
+    if result.errors:
+        return 2
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,689 @@
+"""keystone-audit rule families: IR-level checks over compiled programs.
+
+``ir_audit.py`` lowers registered entry points (solver rungs, overlap
+schedulers, Pallas kernels and their XLA twins, fused pipeline segments) to
+jaxpr and compiled HLO; this module holds the rules that run over that IR —
+the compiled-program complement of the source-level R1–R6 rules in
+``rules.py``.  Where keystone-lint catches the *Python* shape of a hazard
+(a raw env read, an unpaired ``paired_ring_perms`` call), these rules catch
+what XLA actually emitted: a terminal ``all-reduce`` the scheduler cannot
+hide, a host callback inside a jitted hot path, an f64 op the TPU would
+emulate at 1/20th throughput, a matmul dim that pads >25 % of an MXU tile,
+a compiled buffer-assignment peak the planner's closed-form estimate does
+not bound.
+
+Rule families (entry points opt in per rule via their ``expect`` dict —
+see ``ir_audit.EntryPoint``):
+
+- **A1 collective shape** — reduce-scatter-pipelined reductions (never a
+  terminal all-reduce on an overlap path), matched bidirectional
+  ``collective-permute`` pairs (every permute table has its inverse), the
+  two-tier replica-group boundary.  The standalone ``check_*``/``assert_*``
+  helpers here ARE the test-suite pins (``tests/test_overlap.py`` imports
+  them), so the tests and the auditor can never disagree about what
+  "pipelined" means.
+- **A2 host transfer** — no host callbacks (``pure_callback`` /
+  ``io_callback`` / ``debug_callback``), no ``infeed``/``outfeed``, no
+  python-callback ``custom-call`` targets inside a jitted hot path: the
+  static complement of the ``KEYSTONE_GUARD`` runtime sentinel, which only
+  sees what actually executes.
+- **A3 precision** — no f64/c128 anywhere in the lowered program (TPU f64
+  is emulated) and no silent widening ``convert``; solver/FV paths stay
+  f32 unless the entry explicitly allowlists.
+- **A4 padding/alignment** — matmul operand dims that pad more than
+  ``PAD_WASTE_MAX`` of the MXU/VPU tile, cross-checked against the
+  device-keyed ``autotune_cache.json`` winner when the entry names its
+  autotune kernel.
+- **A5 memory** — the compiled buffer-assignment peak (argument + output +
+  temp + alias bytes) must be bounded by ``core/plan.py``'s closed-form
+  estimate for the entry (``block_solve_peak_bytes`` for the solver block
+  step): the static cost-model-drift catch.
+
+Every rule returns :class:`~keystone_tpu.analysis.engine.Finding` objects
+anchored at the entry point's registration line in ``ir_audit.py``, so the
+existing pragma (``# lint: disable=A3 (reason)``) and ratcheted-baseline
+(``ir_baseline.json``) machinery applies unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from keystone_tpu.analysis.engine import Finding
+
+#: rule ids a bare pragma / the audit engine expands to
+ALL_AUDIT_RULES = ("A1", "A2", "A3", "A4", "A5")
+
+#: MXU/VPU native tiles (v4/v5 generations): matmul operands are laid out
+#: in (sublane, lane) = (8, 128) registers and the MXU contracts 128x128.
+LANE_TILE = 128
+SUBLANE_TILE = 8
+
+#: a dim wasting more than this fraction of its padded tile is a finding
+PAD_WASTE_MAX = 0.25
+
+#: dims below this are intrinsically small (class counts, bin counts) —
+#: padding them is the cost of doing business, not a layout bug
+PAD_MIN_DIM = 96
+
+
+# ---------------------------------------------------------------------------
+# HLO collective helpers — THE shared pins (tests import these)
+# ---------------------------------------------------------------------------
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Instruction counts of the four collective families in an HLO dump
+    (sync and async ``-start`` forms both count; ``-done`` halves don't
+    double-count)."""
+    return {
+        name: len(re.findall(name + r"\(|" + name + r"-start\(", hlo_text))
+        for name in (
+            "all-reduce", "all-gather", "reduce-scatter",
+            "collective-permute",
+        )
+    }
+
+
+def permute_tables(hlo_text: str) -> List[FrozenSet[Tuple[int, int]]]:
+    """The ``source_target_pairs`` table of every ``collective-permute``
+    instruction, as frozensets of (src, dst) pairs (``-done`` halves carry
+    no table and are skipped)."""
+    tables: List[FrozenSet[Tuple[int, int]]] = []
+    for line in hlo_text.splitlines():
+        if "collective-permute" not in line or "-done" in line:
+            continue
+        m = re.search(
+            r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}", line
+        )
+        if not m:
+            continue
+        pairs = frozenset(
+            (int(a), int(b))
+            for a, b in re.findall(r"\{(\d+),(\d+)\}", m.group(1))
+        )
+        if pairs:
+            tables.append(pairs)
+    return tables
+
+
+def unpaired_permute_count(hlo_text: str) -> int:
+    """How many ``collective-permute`` instructions lack a matched inverse.
+
+    The bidirectional ring schedules send every payload both ways: for each
+    forward permute table T there must be a backward permute with table
+    T⁻¹ = {(d, s) for (s, d) in T}.  Greedy inverse matching; the leftover
+    count is the unpaired surplus (the even-k middle hop legitimately
+    leaves one per ring stage)."""
+    remaining = list(permute_tables(hlo_text))
+    unmatched = 0
+    while remaining:
+        t = remaining.pop()
+        # self-inverse tables (the 2-cycle ring) pair with their own
+        # second copy through the same membership test
+        inv = frozenset((d, s) for s, d in t)
+        if inv in remaining:
+            remaining.remove(inv)
+        else:
+            unmatched += 1
+    return unmatched
+
+
+def check_pipelined_reduce_scatter(
+    hlo_text: str,
+    k: int,
+    min_scatter: Optional[int] = None,
+    all_gather_max: Optional[int] = 1,
+) -> List[str]:
+    """THE overlap-path structure check: >= ``min_scatter`` (default: the
+    axis size ``k`` — one per tile) per-tile reduce-scatters, NO terminal
+    all-reduce, and at most ``all_gather_max`` trailing all-gathers.
+    Returns a list of problems (empty = clean)."""
+    cols = collective_counts(hlo_text)
+    want = k if min_scatter is None else min_scatter
+    problems = []
+    if cols["reduce-scatter"] < want:
+        problems.append(
+            f"expected >= {want} per-tile reduce-scatters, found "
+            f"{cols['reduce-scatter']} ({cols})"
+        )
+    problems.extend(check_no_all_reduce(hlo_text))
+    if all_gather_max is not None and cols["all-gather"] > all_gather_max:
+        problems.append(
+            f"{cols['all-gather']} all-gathers (expected <= "
+            f"{all_gather_max}: one trailing reassembly)"
+        )
+    return problems
+
+
+def check_no_all_reduce(hlo_text: str) -> List[str]:
+    """No terminal all-reduce: the monolithic collective the overlap
+    schedules exist to remove must not be reintroduced by XLA."""
+    n = collective_counts(hlo_text)["all-reduce"]
+    if n:
+        return [
+            f"{n} all-reduce(s) in the compiled program — the terminal "
+            "collective the overlap path must not carry"
+        ]
+    return []
+
+
+def check_no_bulk_collectives(hlo_text: str) -> List[str]:
+    """Zero bulk all-gather AND zero all-reduce (the ring-fold contract:
+    everything rides the paired permutes)."""
+    cols = collective_counts(hlo_text)
+    problems = check_no_all_reduce(hlo_text)
+    if cols["all-gather"]:
+        problems.append(
+            f"{cols['all-gather']} bulk all-gather(s) — the ring fold "
+            "must carry its payload via paired ppermutes only"
+        )
+    return problems
+
+
+def check_paired_permutes(
+    hlo_text: str,
+    min_permutes: int = 1,
+    unpaired_max: int = 1,
+) -> List[str]:
+    """Bidirectional-pairing check: >= ``min_permutes`` collective-permutes
+    and every permute table matched by its inverse, up to ``unpaired_max``
+    leftovers (the even-k middle hop is one legitimate unpaired forward
+    hop per ring stage)."""
+    cols = collective_counts(hlo_text)
+    problems = []
+    if cols["collective-permute"] < min_permutes:
+        problems.append(
+            f"expected >= {min_permutes} collective-permutes (the "
+            f"bidirectional rounds), found {cols['collective-permute']}"
+        )
+    unmatched = unpaired_permute_count(hlo_text)
+    if unmatched > unpaired_max:
+        problems.append(
+            f"{unmatched} collective-permute(s) without a matched inverse "
+            f"(> {unpaired_max} allowed): the ring schedule is not "
+            "bidirectionally paired"
+        )
+    return problems
+
+
+def reduce_scatter_groups(hlo_text: str) -> List[List[FrozenSet[int]]]:
+    """Per reduce-scatter instruction: its ``replica_groups`` as a list of
+    member sets."""
+    out = []
+    for gs in re.findall(
+        r"reduce-scatter[^\n]*replica_groups=\{(\{[^=]*?\})\},", hlo_text
+    ):
+        out.append([
+            frozenset(int(v) for v in grp.split(","))
+            for grp in re.findall(r"\{([^{}]*)\}", gs)
+        ])
+    return out
+
+
+def check_two_tier_replica_groups(
+    hlo_text: str,
+    outer: int,
+    inner: int,
+    min_inner: int = 1,
+    min_outer: int = 1,
+) -> List[str]:
+    """Two-tier (ICI/DCN) boundary check: with ``outer`` declared slices of
+    ``inner`` devices each, EVERY reduce-scatter must be either within one
+    slice (the ICI tier) or one-member-per-slice (the DCN exchange of
+    already-reduced slice partials) — never a monolithic cross-boundary
+    reduction — with at least ``min_inner`` within-slice and ``min_outer``
+    cross-slice instructions present."""
+    slices = [
+        frozenset(range(s * inner, (s + 1) * inner)) for s in range(outer)
+    ]
+    n_inner = n_outer = 0
+    problems = []
+    groups = reduce_scatter_groups(hlo_text)
+    if not groups:
+        problems.append("no reduce-scatter with replica_groups in the HLO")
+    for parsed in groups:
+        if all(any(p <= s for s in slices) for p in parsed):
+            n_inner += 1
+        elif all(len(p & s) == 1 for p in parsed for s in slices):
+            n_outer += 1
+        else:
+            problems.append(
+                f"reduce-scatter crosses the declared slice boundary: "
+                f"{[sorted(p) for p in parsed]}"
+            )
+    if groups and n_inner < min_inner:
+        problems.append(
+            f"{n_inner} within-slice reduce-scatters (expected >= "
+            f"{min_inner}: one per tile on the ICI tier)"
+        )
+    if groups and n_outer < min_outer:
+        problems.append(
+            f"{n_outer} cross-slice exchanges (expected >= {min_outer})"
+        )
+    return problems
+
+
+def _raise_if(problems: Sequence[str], hlo_text: str) -> None:
+    if problems:
+        cols = collective_counts(hlo_text)
+        raise AssertionError("; ".join(problems) + f" [collectives: {cols}]")
+
+
+def assert_pipelined_reduce_scatter(
+    hlo_text: str, k: int,
+    min_scatter: Optional[int] = None, all_gather_max: Optional[int] = 1,
+) -> None:
+    """Test-suite form of :func:`check_pipelined_reduce_scatter`."""
+    _raise_if(
+        check_pipelined_reduce_scatter(hlo_text, k, min_scatter,
+                                       all_gather_max),
+        hlo_text,
+    )
+
+
+def assert_no_all_reduce(hlo_text: str) -> None:
+    _raise_if(check_no_all_reduce(hlo_text), hlo_text)
+
+
+def assert_no_bulk_collectives(hlo_text: str) -> None:
+    _raise_if(check_no_bulk_collectives(hlo_text), hlo_text)
+
+
+def assert_paired_permutes(
+    hlo_text: str, min_permutes: int = 1, unpaired_max: int = 1
+) -> None:
+    _raise_if(
+        check_paired_permutes(hlo_text, min_permutes, unpaired_max),
+        hlo_text,
+    )
+
+
+def assert_two_tier_replica_groups(
+    hlo_text: str, outer: int, inner: int,
+    min_inner: int = 1, min_outer: int = 1,
+) -> None:
+    _raise_if(
+        check_two_tier_replica_groups(hlo_text, outer, inner, min_inner,
+                                      min_outer),
+        hlo_text,
+    )
+
+
+# ---------------------------------------------------------------------------
+# jaxpr helpers
+# ---------------------------------------------------------------------------
+
+def iter_eqns(jaxpr):
+    """Every equation of a (closed) jaxpr, recursing into sub-jaxprs
+    (scan/while/cond bodies, pallas kernels, custom_jvp branches)."""
+    import jax.core as jc
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            yield eqn
+            for v in eqn.params.values():
+                for sub in _subjaxprs(v, jc):
+                    yield from walk(sub)
+
+    yield from walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+
+
+def _subjaxprs(v, jc):
+    if isinstance(v, jc.ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, jc.Jaxpr):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for vv in v:
+            yield from _subjaxprs(vv, jc)
+
+
+#: jaxpr primitives that round-trip through the host — the A2 deny list
+HOST_PRIMITIVES = (
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed", "host_callback",
+)
+
+#: HLO custom-call targets that are python callbacks in disguise (the CPU
+#: LAPACK custom-calls — lapack_*getrf etc. — are NOT host round-trips)
+_CALLBACK_TARGET_RE = re.compile(
+    r'custom_call_target="([^"]*(?:callback|py_func|host)[^"]*)"',
+    re.IGNORECASE,
+)
+
+
+def host_transfer_sites(jaxpr, hlo_text: str) -> List[str]:
+    """Host round-trips in a lowered program: callback/infeed/outfeed
+    primitives in the jaxpr plus python-callback ``custom-call`` targets
+    and infeed/outfeed ops in the compiled HLO."""
+    sites: List[str] = []
+    seen = set()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in HOST_PRIMITIVES and name not in seen:
+            seen.add(name)
+            sites.append(f"jaxpr primitive '{name}'")
+    for target in set(_CALLBACK_TARGET_RE.findall(hlo_text)):
+        sites.append(f"custom-call target '{target}'")
+    for op in ("outfeed(", "infeed("):
+        if op in hlo_text:
+            sites.append(f"HLO {op.rstrip('(')} op")
+    return sites
+
+
+_WIDE_RE = re.compile(r"\b(f64|c128)\[")
+
+
+def wide_dtype_sites(jaxpr, hlo_text: str) -> List[str]:
+    """f64/c128 leaks: wide avals anywhere in the jaxpr (with the producing
+    primitive named — a ``convert_element_type`` producer is the silent
+    weak-type upcast) plus ``f64[``/``c128[`` buffers in the compiled
+    HLO."""
+    sites: List[str] = []
+    seen = set()
+    for eqn in iter_eqns(jaxpr):
+        for v in list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            dt = str(getattr(aval, "dtype", ""))
+            if dt in ("float64", "complex128"):
+                key = (eqn.primitive.name, dt)
+                if key not in seen:
+                    seen.add(key)
+                    kind = (
+                        "silent upcast via"
+                        if eqn.primitive.name == "convert_element_type"
+                        else "produced by"
+                    )
+                    sites.append(f"{dt} {kind} '{eqn.primitive.name}'")
+    for m in sorted(set(_WIDE_RE.findall(hlo_text))):
+        sites.append(f"{m} buffer in compiled HLO")
+    return sites
+
+
+def _pad_waste(dim: int, tile: int) -> float:
+    padded = -(-dim // tile) * tile
+    return (padded - dim) / padded
+
+
+def padded_matmul_dims(
+    jaxpr,
+    min_dim: int = PAD_MIN_DIM,
+    waste_max: float = PAD_WASTE_MAX,
+    lane_tile: int = LANE_TILE,
+    sublane_tile: int = SUBLANE_TILE,
+) -> List[str]:
+    """Matmul operand dims whose MXU-tile padding wastes more than
+    ``waste_max``: for every ``dot_general``, the contracting dim and both
+    result dims are checked against the lane tile (the last minor dim) or
+    sublane tile.  Dims under ``min_dim`` are intrinsically small
+    (class/bin counts) and skipped."""
+    sites: List[str] = []
+    seen = set()
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        ((lc, rc), _batch) = eqn.params["dimension_numbers"]
+        shapes = [tuple(v.aval.shape) for v in eqn.invars]
+        dims = []
+        for opi, (shape, contract) in enumerate(zip(shapes, (lc, rc))):
+            for axis, d in enumerate(shape):
+                # the minor-most axis lives in lanes (128), others in
+                # sublanes (8) — the layout XLA gives matmul operands
+                tile = lane_tile if axis == len(shape) - 1 else sublane_tile
+                dims.append((d, tile, axis in contract))
+        for d, tile, is_contract in dims:
+            if d < min_dim:
+                continue
+            waste = _pad_waste(d, tile)
+            if waste > waste_max and (d, tile) not in seen:
+                seen.add((d, tile))
+                role = "contracting" if is_contract else "output"
+                sites.append(
+                    f"{role} dim {d} pads to {-(-d // tile) * tile} "
+                    f"({waste:.0%} of the {tile}-wide tile wasted)"
+                )
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# The rules (run by ir_audit.AuditEngine over AuditProgram objects)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AuditProgram:
+    """One lowered entry point: everything a rule needs."""
+
+    name: str                  # registered entry-point name
+    path: str                  # repo-relative anchor (ir_audit.py)
+    line: int                  # registration line (pragma anchor)
+    jaxpr: Any                 # ClosedJaxpr of the traced program
+    hlo_text: str              # compiled HLO dump
+    memory_stats: Any          # CompiledMemoryStats or None
+    k: int = 1                 # sharded-axis size (1 = single device)
+    expect: Dict[str, Any] = field(default_factory=dict)
+    peak_estimate: Optional[int] = None  # plan.py closed-form bytes
+
+
+def _finding(
+    prog: AuditProgram, rule: str, detail: str, hint: str = "",
+    symbol: str = "",
+) -> Finding:
+    return Finding(
+        rule=rule, path=prog.path, line=prog.line, col=0,
+        message=f"[{prog.name}] {detail}", hint=hint,
+        symbol=f"{prog.name}::{symbol or detail}",
+    )
+
+
+class IRRule:
+    id = "A?"
+    doc = ""
+
+    def run(self, prog: AuditProgram) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class CollectiveShapeRule(IRRule):
+    """A1: the compiled collective shape matches the schedule the entry
+    point promises (reduce-scatter-pipelined, bidirectionally paired
+    permutes, zero bulk collectives, two-tier boundary)."""
+
+    id = "A1"
+    doc = "collective-shape audit of the compiled program"
+
+    def run(self, prog: AuditProgram) -> List[Finding]:
+        e = prog.expect
+        problems: List[str] = []
+        if e.get("reduce_scatter_min") is not None:
+            want = e["reduce_scatter_min"]
+            problems += check_pipelined_reduce_scatter(
+                prog.hlo_text, prog.k,
+                min_scatter=prog.k if want == "k" else int(want),
+                all_gather_max=e.get("all_gather_max", 1),
+            )
+        elif e.get("no_all_reduce"):
+            problems += check_no_all_reduce(prog.hlo_text)
+        if e.get("zero_bulk"):
+            problems += check_no_bulk_collectives(prog.hlo_text)
+        if e.get("paired_permutes"):
+            problems += check_paired_permutes(
+                prog.hlo_text,
+                min_permutes=int(e.get("permute_min", 1)),
+                unpaired_max=int(e.get("unpaired_max", 1)),
+            )
+        if e.get("two_tier"):
+            outer, inner = e["two_tier"]
+            problems += check_two_tier_replica_groups(
+                prog.hlo_text, outer, inner,
+                min_inner=int(e.get("two_tier_min_inner", 1)),
+            )
+        return [
+            _finding(
+                prog, self.id, p,
+                hint="the overlap schedules (parallel/overlap.py) must "
+                     "survive compilation — if XLA reintroduced the bulk "
+                     "collective, check the tiling/tier arguments the "
+                     "entry registers",
+                symbol=p.split(",")[0][:60],
+            )
+            for p in sorted(set(problems))
+        ]
+
+
+class HostTransferRule(IRRule):
+    """A2: no host round-trips inside the jitted hot path — the static
+    complement of the ``KEYSTONE_GUARD`` runtime sentinel."""
+
+    id = "A2"
+    doc = "host-transfer audit (callbacks/infeed/outfeed in hot paths)"
+
+    def run(self, prog: AuditProgram) -> List[Finding]:
+        if prog.expect.get("allow_host"):
+            return []
+        return [
+            _finding(
+                prog, self.id, f"host round-trip: {site}",
+                hint="hot jitted paths must stay on-device; stage host "
+                     "work outside the jit or behind an explicit "
+                     "materialization boundary (core/pipeline.py)",
+                symbol=site,
+            )
+            for site in host_transfer_sites(prog.jaxpr, prog.hlo_text)
+        ]
+
+
+class PrecisionRule(IRRule):
+    """A3: f32 discipline — no f64/c128 ops or silent weak-type upcasts
+    outside an explicit allowlist (TPUs emulate f64)."""
+
+    id = "A3"
+    doc = "precision audit (f64 leaks / silent upcasts)"
+
+    def run(self, prog: AuditProgram) -> List[Finding]:
+        if prog.expect.get("allow_f64"):
+            return []
+        return [
+            _finding(
+                prog, self.id, f"wide-precision leak: {site}",
+                hint="solver/FV paths are f32-by-contract (solvers.py "
+                     "docstring); cast at the boundary or allowlist the "
+                     "entry with expect allow_f64=True and a reason",
+                symbol=site,
+            )
+            for site in wide_dtype_sites(prog.jaxpr, prog.hlo_text)
+        ]
+
+
+class PaddingRule(IRRule):
+    """A4: MXU/VPU tile alignment of the hot matmuls, cross-checked
+    against the autotuner's persisted tile winners."""
+
+    id = "A4"
+    doc = "padding/alignment audit of hot matmul dims"
+
+    def run(self, prog: AuditProgram) -> List[Finding]:
+        if not prog.expect.get("check_padding"):
+            return []
+        sites = padded_matmul_dims(
+            prog.jaxpr,
+            min_dim=int(prog.expect.get("pad_min_dim", PAD_MIN_DIM)),
+            waste_max=float(prog.expect.get("pad_waste_max", PAD_WASTE_MAX)),
+        )
+        tile_kernel = prog.expect.get("tile_kernel")
+        if tile_kernel:
+            sites += self._autotuned_tile_sites(prog, tile_kernel)
+        return [
+            _finding(
+                prog, self.id, f"tile-padding waste: {site}",
+                hint="round the dim to the 128-lane / 8-sublane tile "
+                     "(or the autotuned tile) at allocation time — "
+                     "padding is paid on every MXU pass",
+                symbol=site,
+            )
+            for site in sites
+        ]
+
+    @staticmethod
+    def _autotuned_tile_sites(prog: AuditProgram, tile_kernel) -> List[str]:
+        """Cross-check against ``autotune_cache.json``: when a persisted
+        winner exists for the entry's kernel, the audited row count must
+        tile it without exceeding the waste bound (a swept tile that no
+        longer divides the production shape is stale tuning)."""
+        kernel, bucket, rows = tile_kernel
+        try:
+            from keystone_tpu.ops.pallas import autotune
+
+            winner = autotune.lookup(kernel, bucket)
+        except Exception:
+            return []
+        if not winner:
+            return []
+        try:
+            tile = int(winner)
+        except (TypeError, ValueError):
+            return []
+        waste = _pad_waste(int(rows), tile)
+        if waste > PAD_WASTE_MAX:
+            return [
+                f"autotuned tile {tile} for {kernel}[{bucket}] pads "
+                f"{rows} rows by {waste:.0%}"
+            ]
+        return []
+
+
+class MemoryRule(IRRule):
+    """A5: the planner's closed-form peak estimate must bound the compiled
+    buffer-assignment peak — cost-model drift caught statically."""
+
+    id = "A5"
+    doc = "memory audit (plan estimate bounds compiled peak)"
+
+    @staticmethod
+    def compiled_peak_bytes(memory_stats) -> Optional[int]:
+        """Buffer-assignment peak of a compiled program: arguments +
+        outputs + temps MINUS aliased bytes — a donated buffer is counted
+        in both the argument and output totals but occupies one
+        allocation, so the alias size must come back out (None when the
+        backend reports no stats)."""
+        if memory_stats is None:
+            return None
+        try:
+            return max(0, int(
+                memory_stats.argument_size_in_bytes
+                + memory_stats.output_size_in_bytes
+                + memory_stats.temp_size_in_bytes
+                - memory_stats.alias_size_in_bytes
+            ))
+        except AttributeError:
+            return None
+
+    def run(self, prog: AuditProgram) -> List[Finding]:
+        if prog.peak_estimate is None:
+            return []
+        compiled = self.compiled_peak_bytes(prog.memory_stats)
+        if compiled is None:
+            return []  # backend without buffer stats: nothing to check
+        if compiled > prog.peak_estimate:
+            return [
+                _finding(
+                    prog, self.id,
+                    f"compiled buffer-assignment peak {compiled} B exceeds "
+                    f"the plan.py closed-form estimate "
+                    f"{prog.peak_estimate} B "
+                    f"({compiled / max(prog.peak_estimate, 1):.2f}x)",
+                    hint="core/plan.py::block_solve_peak_bytes no longer "
+                         "bounds this program — the HBM-safe block sizes "
+                         "it plans would OOM; update the cost model",
+                    symbol="peak_estimate_exceeded",
+                )
+            ]
+        return []
+
+
+def default_ir_rules() -> List[IRRule]:
+    return [
+        CollectiveShapeRule(), HostTransferRule(), PrecisionRule(),
+        PaddingRule(), MemoryRule(),
+    ]

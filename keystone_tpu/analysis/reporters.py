@@ -12,6 +12,8 @@ def render_text(
     result: LintResult,
     show_baselined: bool = False,
     hints: bool = True,
+    show_stale_pragmas: bool = False,
+    label: str = "keystone-lint",
 ) -> str:
     """New findings as ``path:line:col: RULE message`` lines — the triple
     terminals hyperlink — plus a one-line summary the CI log greps."""
@@ -24,6 +26,15 @@ def render_text(
                      f"{len(result.baselined)}")
         for f in result.baselined:
             lines.append("  " + f.format(hints=False))
+    if result.stale_pragmas:
+        lines.append("")
+        lines.append(
+            f"stale pragmas (suppressed nothing this run — remove them, "
+            f"like unused noqa): {len(result.stale_pragmas)}"
+        )
+        if show_stale_pragmas:
+            for path, line, rules in result.stale_pragmas:
+                lines.append(f"  {path}:{line}: lint: disable={rules}")
     if result.stale:
         lines.append("")
         lines.append(
@@ -35,26 +46,34 @@ def render_text(
     for err in result.errors:
         lines.append(f"parse error: {err}")
     summary = (
-        f"keystone-lint: {len(result.findings)} new, "
+        f"{label}: {len(result.findings)} new, "
         f"{len(result.baselined)} baselined, {result.suppressed} "
-        f"pragma-suppressed across {result.files} files"
+        f"pragma-suppressed across {result.files} "
+        f"{'entry points' if label == 'keystone-audit' else 'files'}"
     )
     lines.append(("" if not lines else "\n") + summary)
     return "\n".join(lines)
 
 
-def render_json(result: LintResult) -> str:
-    def enc(f: Finding) -> dict:
-        return {
-            "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
-            "message": f.message, "hint": f.hint,
-            "fingerprint": f.fingerprint,
-        }
+def finding_dict(f: Finding) -> dict:
+    """The one JSON encoding of a finding (lint and audit renderers both
+    use it — the schema the smoke scripts assert)."""
+    return {
+        "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+        "message": f.message, "hint": f.hint,
+        "fingerprint": f.fingerprint,
+    }
 
+
+def render_json(result: LintResult) -> str:
     return json.dumps({
-        "new": [enc(f) for f in result.findings],
-        "baselined": [enc(f) for f in result.baselined],
+        "new": [finding_dict(f) for f in result.findings],
+        "baselined": [finding_dict(f) for f in result.baselined],
         "stale": result.stale,
+        "stale_pragmas": [
+            {"path": p, "line": l, "rules": r}
+            for p, l, r in result.stale_pragmas
+        ],
         "suppressed": result.suppressed,
         "files": result.files,
         "errors": result.errors,

@@ -117,6 +117,17 @@ def main(argv=None) -> int:
         from keystone_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "audit":
+        # ``keystone-tpu audit [--target X]``: the IR-level static
+        # analysis (keystone_tpu/analysis/ir_audit.py) — lowers registered
+        # entry points to jaxpr + compiled HLO and runs rules A1-A5; exits
+        # non-zero only for findings not in the ratcheted ir_baseline.json.
+        # Device request must precede any jax backend use.
+        from keystone_tpu.analysis.ir_audit import ensure_cpu_devices
+        from keystone_tpu.analysis.ir_audit import main as audit_main
+
+        ensure_cpu_devices()
+        return audit_main(argv[1:])
     if argv and argv[0] == "plan":
         # ``keystone-tpu plan <target>``: the cost-based whole-pipeline
         # planner's decision table (core/plan.py) — cache tiers, fused
@@ -133,6 +144,8 @@ def main(argv=None) -> int:
             f"<Pipeline> [flags]\n"
             "       run-pipeline telemetry-report [path] [--top N]\n"
             "       run-pipeline lint [paths] [--update-baseline]\n"
+            "       run-pipeline audit [--target ENTRY] [--list] "
+            "[--update-baseline]\n"
             "       run-pipeline plan <toy|imagenet|voc> [--mode M] "
             "[--budget-mb N] [--json PATH]\n\n"
             f"pipelines:\n  {names}"

@@ -82,7 +82,6 @@ def _registry():
 
 def _warn_once(key: str, msg: str) -> None:
     with _LOCK:
-        # lint: disable=R5 (guarded by _LOCK on the line above)
         if key in _WARNED:
             return
         _WARNED.add(key)
@@ -198,7 +197,6 @@ def clear_memory_cache() -> None:
     test hook for pinning the persisted (not in-process) round trip."""
     global _MEM, _MEM_PATH
     with _LOCK:
-        # lint: disable=R5 (guarded by the with _LOCK above)
         _MEM = None
         _MEM_PATH = None
 

@@ -360,6 +360,10 @@ declare("KEYSTONE_PCA", "str", "exact",
         "oversampled randomized range finder + power iterations "
         "(explicit method= arguments still win).",
         choices=("exact", "randomized"))
+declare("KEYSTONE_AUDIT_TARGETS", "str", "",
+        "Comma-separated entry points (names, dotted prefixes, or "
+        "categories) the IR audit pass (keystone_tpu/analysis/ir_audit.py) "
+        "lowers and checks; empty = every registered entry point.")
 declare("KEYSTONE_SKETCH_BCD", "bool", False,
         "Leverage-score block scheduling for block coordinate descent: "
         "visit feature blocks in descending sketched-energy order instead "
@@ -408,6 +412,10 @@ declare("BENCH_TIMIT_FULL", "bool", True,
 declare("BENCH_LINT", "bool", True,
         "Static-analysis section: run keystone_tpu/analysis over the "
         "package and record lint_findings_total.")
+declare("BENCH_AUDIT", "bool", True,
+        "IR-audit section: lower the registered entry points and record "
+        "audit_findings_total/audit_new (budget-gated; exhaustion emits "
+        "audit_skipped).")
 declare("BENCH_PLAN", "bool", True,
         "Whole-pipeline-optimizer section (core/plan.py): plan the "
         "flagship DAG under the HBM budget and record plan_* decision "

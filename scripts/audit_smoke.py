@@ -1,0 +1,89 @@
+"""End-to-end smoke of the IR audit pass (seconds, CPU).
+
+Audits two registered entry points — one overlap scheduler and one solver
+rung, the pair that exercises the A1 collective checks plus A2/A3/A4 —
+and asserts the contract ``make verify-fast`` rides:
+
+1. Zero NEW findings against the committed ``ir_baseline.json`` (the
+   repo-audits-clean invariant, visible in the terminal).
+2. The ``--format json`` output schema: the keys the bench section and CI
+   consumers parse (``new``/``baselined``/``targets``/``skipped``/
+   ``errors``/``total``).
+3. Wall clock under 20 s — the audit must stay cheap enough to fold into
+   every pre-merge loop.
+
+``make audit-smoke``; folded into ``verify-fast``.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import keystone_tpu  # noqa: E402  (compat shims first)
+from keystone_tpu.analysis.ir_audit import (  # noqa: E402
+    DEFAULT_IR_BASELINE,
+    ensure_cpu_devices,
+    main as audit_main,
+    render_audit_json,
+    run_audit,
+)
+
+_TARGETS = ["overlap.tiled_gram", "solver.normal_equations"]
+_BUDGET_S = 20.0
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    ensure_cpu_devices()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline = os.path.join(root, DEFAULT_IR_BASELINE)
+
+    result = run_audit(
+        _TARGETS,
+        baseline_path=baseline if os.path.exists(baseline) else None,
+    )
+    assert not result.errors, f"audit errors: {result.errors}"
+    assert not result.skipped, (
+        f"smoke targets skipped (device bootstrap broke?): {result.skipped}"
+    )
+    assert len(result.targets) == 2, result.targets
+    assert not result.findings, (
+        "NEW audit findings on the clean repo:\n"
+        + "\n".join(f.format() for f in result.findings)
+    )
+
+    # JSON schema: what the CI/bench consumers parse
+    payload = json.loads(render_audit_json(result))
+    for key in (
+        "new", "baselined", "stale", "stale_pragmas", "suppressed",
+        "targets", "skipped", "errors", "total",
+    ):
+        assert key in payload, f"audit JSON missing {key!r}"
+    assert isinstance(payload["new"], list)
+    assert payload["targets"] == _TARGETS
+
+    # the CLI form agrees (exit 0 = no new findings)
+    rc = audit_main(["--target", _TARGETS[0], "--root", root])
+    assert rc == 0, f"audit CLI exited {rc}"
+
+    elapsed = time.monotonic() - t0
+    assert elapsed < _BUDGET_S, (
+        f"audit smoke took {elapsed:.1f}s (> {_BUDGET_S:.0f}s budget)"
+    )
+    print(
+        f"audit-smoke: {len(result.targets)} targets audited clean "
+        f"({payload['total']} total findings, {result.suppressed} "
+        f"suppressed) in {elapsed:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,9 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # the planner section exhausts gracefully too (no plan rows, a marker)
     assert full.get("plan_skipped") == "budget"
     assert "plan_block_size" not in full
+    # ... and the IR-audit section (PR 9): same reduced-floor contract
+    assert full.get("audit_skipped") == "budget"
+    assert "audit_findings_total" not in full
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

@@ -1,0 +1,481 @@
+"""keystone-audit (keystone_tpu/analysis/ir_audit.py + ir_rules.py):
+IR-level rules A1-A5 over lowered jaxpr + compiled HLO.
+
+Every rule is proven by a deliberately-bad fixture program it must flag
+(terminal all-reduce gram, unpaired one-directional ppermute ring, host
+callback in a jitted path, f64 leak, padding-wasteful matmul, undersized
+plan estimate) AND by the repo-audits-clean invariant over the committed
+``ir_baseline.json`` — mirroring ``test_lint.py``'s structure one IR level
+down.  The acceptance pins: >= 8 registered entry points spanning both
+overlap schedulers, >= 2 solver rungs, >= 2 Pallas kernels with XLA
+twins, and >= 1 fused DAG segment; and A5 asserting ``core/plan.py``'s
+closed-form peak estimate bounds the compiled buffer-assignment peak on
+the flagship solver block.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from keystone_tpu.analysis import ir_audit
+from keystone_tpu.analysis.ir_audit import (
+    ENTRY_POINTS,
+    Built,
+    EntryPoint,
+    lower_entry,
+    resolve_targets,
+    run_audit,
+)
+from keystone_tpu.analysis.ir_rules import (
+    AuditProgram,
+    CollectiveShapeRule,
+    HostTransferRule,
+    MemoryRule,
+    PaddingRule,
+    PrecisionRule,
+    unpaired_permute_count,
+)
+from keystone_tpu.linalg.solvers import hdot
+from keystone_tpu.parallel import make_mesh
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _program(fn, args, **kw):
+    """Lower a fixture into the rule input (the engine's own recipe)."""
+    compiled = jax.jit(fn).lower(*args).compile()
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    return AuditProgram(
+        name=kw.pop("name", "fixture"), path="fixture.py", line=1,
+        jaxpr=jax.make_jaxpr(fn)(*args), hlo_text=compiled.as_text(),
+        memory_stats=mem, **kw,
+    )
+
+
+@pytest.fixture()
+def mesh(devices):
+    return make_mesh(data=8, model=1, devices=devices)
+
+
+# ---------------------------------------------------------------------------
+# A1: collective shape
+# ---------------------------------------------------------------------------
+
+
+def test_a1_flags_terminal_all_reduce_gram(mesh, rng):
+    """The canonical regression: a row-sharded gram whose reduction XLA
+    lowered to ONE bulk all-reduce instead of per-tile reduce-scatters."""
+    x = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    rows = NamedSharding(mesh, P("data", None))
+    fn = jax.jit(lambda a: hdot(a.T, a), in_shardings=rows,
+                 out_shardings=NamedSharding(mesh, P()))
+    compiled = fn.lower(x).compile()
+    prog = AuditProgram(
+        name="bad.gram", path="fixture.py", line=1,
+        jaxpr=jax.make_jaxpr(lambda a: hdot(a.T, a))(x),
+        hlo_text=compiled.as_text(), memory_stats=None, k=8,
+        expect=dict(reduce_scatter_min="k"),
+    )
+    findings = CollectiveShapeRule().run(prog)
+    assert findings, "terminal all-reduce not flagged"
+    assert any("all-reduce" in f.message for f in findings)
+    assert all(f.rule == "A1" for f in findings)
+
+
+def test_a1_flags_unpaired_ppermute_ring(mesh, rng):
+    """A one-directional ring (every permute forward, no inverse) must
+    fail the bidirectional-pairing check."""
+    x = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+
+    def one_dir(xj):
+        perm = [(i, (i + 1) % 8) for i in range(8)]
+        acc = xj
+        for _ in range(7):
+            xj = jax.lax.ppermute(xj, "data", perm)
+            acc = acc + xj
+        return acc
+
+    f = jax.jit(jax.shard_map(
+        one_dir, mesh=mesh, in_specs=P("data", None),
+        out_specs=P("data", None), check_vma=False,
+    ))
+    hlo = f.lower(x).compile().as_text()
+    assert unpaired_permute_count(hlo) == 7
+    prog = _program(lambda a: a, (x,), k=8,
+                    expect=dict(paired_permutes=True, permute_min=2))
+    prog.hlo_text = hlo
+    findings = CollectiveShapeRule().run(prog)
+    assert any("matched inverse" in f.message for f in findings)
+
+
+def test_a1_clean_on_the_real_overlap_schedulers(devices, rng):
+    """The paired schedules themselves stay clean under the same rule —
+    the auditor's expectations match what the schedulers actually emit."""
+    from keystone_tpu.parallel.overlap import (
+        bidirectional_ring_gram,
+        tiled_transpose_matmul,
+    )
+
+    m = make_mesh(data=8, model=1, devices=devices)
+    x = jnp.asarray(rng.normal(size=(128, 128)).astype(np.float32))
+    hlo = jax.jit(
+        lambda a: tiled_transpose_matmul(a, mesh=m)
+    ).lower(x).compile().as_text()
+    prog = _program(lambda a: a, (x,), k=8,
+                    expect=dict(reduce_scatter_min="k", all_gather_max=1))
+    prog.hlo_text = hlo
+    assert CollectiveShapeRule().run(prog) == []
+
+    m2 = make_mesh(data=1, model=8, devices=devices)
+    x2 = jnp.asarray(rng.normal(size=(40, 128)).astype(np.float32))
+    hlo2 = jax.jit(
+        lambda a: bidirectional_ring_gram(a, m2, axis="model")
+    ).lower(x2).compile().as_text()
+    prog2 = _program(lambda a: a, (x2,), k=8,
+                     expect=dict(zero_bulk=True, paired_permutes=True,
+                                 permute_min=6))
+    prog2.hlo_text = hlo2
+    assert CollectiveShapeRule().run(prog2) == []
+
+
+# ---------------------------------------------------------------------------
+# A2: host transfers
+# ---------------------------------------------------------------------------
+
+
+def test_a2_flags_callback_in_hot_path(rng):
+    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+
+    def bad(a):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v) * 2.0,
+            jax.ShapeDtypeStruct(a.shape, a.dtype), a,
+        )
+        return y + 1.0
+
+    prog = _program(bad, (x,))
+    findings = HostTransferRule().run(prog)
+    assert findings, "pure_callback not flagged"
+    assert any("pure_callback" in f.message for f in findings)
+    assert all(f.rule == "A2" for f in findings)
+    # the allowlist escape hatch
+    prog.expect = dict(allow_host=True)
+    assert HostTransferRule().run(prog) == []
+
+
+def test_a2_silent_on_lapack_custom_calls(rng):
+    """CPU linalg lowers to LAPACK custom-calls — those are on-device
+    library calls, NOT host round-trips, and must not be flagged."""
+    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    prog = _program(lambda a: jnp.linalg.qr(a, mode="r"), (x,))
+    assert "custom-call" in prog.hlo_text  # the lapack call IS there
+    assert HostTransferRule().run(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# A3: precision
+# ---------------------------------------------------------------------------
+
+
+def test_a3_flags_f64_leak(rng):
+    x = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    with jax.enable_x64():
+        def leak(a):
+            wide = a.astype(jnp.float64)
+            return (wide @ wide.T).astype(jnp.float32)
+
+        prog = _program(leak, (x,))
+    findings = PrecisionRule().run(prog)
+    assert findings, "f64 leak not flagged"
+    assert any("float64" in f.message or "f64" in f.message
+               for f in findings)
+    # the silent weak-type upcast is named as such
+    assert any("upcast" in f.message for f in findings)
+    assert all(f.rule == "A3" for f in findings)
+    # allowlisted entries (e.g. a deliberate f64 reference path) pass
+    prog.expect = dict(allow_f64=True)
+    assert PrecisionRule().run(prog) == []
+
+
+def test_a3_clean_on_f32_solver(rng):
+    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    prog = _program(lambda a: hdot(a.T, a, "high"), (x,))
+    assert PrecisionRule().run(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# A4: padding/alignment
+# ---------------------------------------------------------------------------
+
+
+def test_a4_flags_padding_wasteful_matmul(rng):
+    """A 130-wide contraction pads to 256 lanes: 49 % of every MXU pass
+    wasted — flagged.  The same matmul at 128 is clean, and dims under
+    the min (class counts etc.) are never flagged."""
+    x = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    w1 = jnp.ones((64, 130), jnp.float32)
+    w2 = jnp.ones((130, 8), jnp.float32)
+    prog = _program(lambda a: a @ w1 @ w2, (x,),
+                    expect=dict(check_padding=True))
+    findings = PaddingRule().run(prog)
+    assert findings, "padding waste not flagged"
+    assert any("130" in f.message for f in findings)
+    assert all(f.rule == "A4" for f in findings)
+    # 8-wide output dim: below PAD_MIN_DIM, not flagged
+    assert not any(" 8 pads" in f.message for f in findings)
+    # aligned shapes are clean
+    w_ok = jnp.ones((64, 128), jnp.float32)
+    clean = _program(lambda a: a @ w_ok, (x,),
+                     expect=dict(check_padding=True))
+    assert PaddingRule().run(clean) == []
+    # the rule is opt-in: without check_padding nothing fires
+    prog.expect = {}
+    assert PaddingRule().run(prog) == []
+
+
+def test_a4_cross_checks_autotuned_tile(tmp_path, monkeypatch, rng):
+    """A persisted autotune winner that no longer tiles the production
+    row count without >25 % padding is stale tuning — flagged."""
+    from keystone_tpu.ops.pallas import autotune
+
+    cache = tmp_path / "autotune_cache.json"
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE_CACHE", str(cache))
+    autotune.clear_memory_cache()
+    bucket = autotune.shape_bucket(48)
+    autotune.record("audit.test_kernel", bucket, 256)  # tiles 48 rows at 81% waste
+    x = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    prog = _program(
+        lambda a: a + 1.0, (x,),
+        expect=dict(check_padding=True,
+                    tile_kernel=("audit.test_kernel", bucket, 48)),
+    )
+    findings = PaddingRule().run(prog)
+    assert any("autotuned tile 256" in f.message for f in findings)
+    autotune.clear_memory_cache()
+
+
+# ---------------------------------------------------------------------------
+# A5: memory (plan estimate bounds compiled peak)
+# ---------------------------------------------------------------------------
+
+
+def test_a5_flags_undersized_plan_estimate(rng):
+    x = jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32))
+    prog = _program(lambda a: hdot(a.T, a, "high"), (x,),
+                    peak_estimate=1024)  # absurdly small: must be flagged
+    findings = MemoryRule().run(prog)
+    assert findings, "undersized estimate not flagged"
+    assert all(f.rule == "A5" for f in findings)
+    assert "exceeds" in findings[0].message
+
+
+def test_a5_estimate_bounds_flagship_solver_block(devices):
+    """THE acceptance pin: ``plan.block_solve_peak_bytes`` bounds the
+    compiled buffer-assignment peak of the flagship solver block step —
+    the cost model the HBM-safe planner trusts has not drifted."""
+    entry = ENTRY_POINTS["solver.block_step"]
+    prog = lower_entry(entry, devices)
+    compiled = MemoryRule.compiled_peak_bytes(prog.memory_stats)
+    assert compiled is not None and compiled > 0
+    assert prog.peak_estimate is not None
+    assert prog.peak_estimate >= compiled, (
+        f"plan estimate {prog.peak_estimate} B no longer bounds the "
+        f"compiled peak {compiled} B"
+    )
+    assert MemoryRule().run(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# Registry + engine
+# ---------------------------------------------------------------------------
+
+
+def test_registry_covers_the_acceptance_surface():
+    """>= 8 entries spanning both overlap schedulers, >= 2 solver rungs,
+    >= 2 Pallas kernels WITH their XLA twins, >= 1 fused DAG segment."""
+    assert len(ENTRY_POINTS) >= 8
+    assert "overlap.tiled_gram" in ENTRY_POINTS   # scheduler 1: tiled RS
+    assert "overlap.ring_gram" in ENTRY_POINTS    # scheduler 2: ppermute ring
+    solvers = [n for n, e in ENTRY_POINTS.items() if e.category == "solver"]
+    assert len(solvers) >= 2
+    pallas = [n for n, e in ENTRY_POINTS.items() if e.category == "pallas"]
+    kernels = {n for n in pallas if not n.endswith("_xla")}
+    twins = {n[: -len("_xla")] for n in pallas if n.endswith("_xla")}
+    assert len(kernels & twins) >= 2, (kernels, twins)
+    assert any(e.category == "pipeline" for e in ENTRY_POINTS.values())
+
+
+def test_resolve_targets_names_prefixes_and_knob(monkeypatch):
+    monkeypatch.delenv("KEYSTONE_AUDIT_TARGETS", raising=False)
+    assert resolve_targets(None) == list(ENTRY_POINTS)
+    assert resolve_targets(["overlap.tiled_gram"]) == ["overlap.tiled_gram"]
+    by_prefix = resolve_targets(["overlap"])
+    assert set(by_prefix) == {
+        n for n, e in ENTRY_POINTS.items() if e.category == "overlap"
+    }
+    with pytest.raises(KeyError, match="unknown audit target"):
+        resolve_targets(["nonsense"])
+    monkeypatch.setenv("KEYSTONE_AUDIT_TARGETS", "pallas.sift_bins")
+    assert resolve_targets(None) == ["pallas.sift_bins"]
+
+
+def test_repo_audits_clean_against_committed_baseline(devices):
+    """The acceptance invariant (mirrors test_lint's): every registered
+    entry point lowers + audits with ZERO new findings on the clean
+    repo against the committed ``ir_baseline.json``."""
+    res = run_audit(
+        baseline_path=os.path.join(REPO_ROOT, ir_audit.DEFAULT_IR_BASELINE),
+    )
+    assert res.errors == [], res.errors
+    assert res.skipped == {}, res.skipped  # 8-device sim places everything
+    assert len(res.targets) >= 8
+    assert res.findings == [], "\n".join(f.format() for f in res.findings)
+
+
+def test_engine_end_to_end_bad_entry_and_baseline_prune(
+    devices, monkeypatch, tmp_path, rng, capsys
+):
+    """A bad entry registered into the engine flows all the way through:
+    finding anchored at the registration line, failing CLI exit, then
+    baselined — and --update-baseline prunes the fingerprint once the
+    entry is gone."""
+    x = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    m = make_mesh(data=8, model=1, devices=devices)
+    rows = NamedSharding(m, P("data", None))
+
+    def build_bad(devs):
+        # committed row sharding: the jitted gram's contraction crosses
+        # shards, so XLA emits the terminal all-reduce the rule bans
+        xs = jax.device_put(x, rows)
+        return Built(fn=lambda a: hdot(a.T, a), args=(xs,), k=8,
+                     expect=dict(reduce_scatter_min="k"))
+
+    bad = EntryPoint(
+        name="fixture.bad_gram", category="solver", builder=build_bad,
+        min_devices=8, line=1, doc="terminal all-reduce fixture",
+    )
+    monkeypatch.setitem(ENTRY_POINTS, "fixture.bad_gram", bad)
+    baseline = tmp_path / "ir_baseline.json"
+
+    res = run_audit(["fixture.bad_gram"], baseline_path=None)
+    assert res.findings and all(f.rule == "A1" for f in res.findings)
+    assert res.findings[0].path == ir_audit._SELF_RELPATH
+
+    # baseline it -> clean
+    from keystone_tpu.analysis.engine import load_baseline, save_baseline
+
+    save_baseline(str(baseline), res.findings, tool="audit")
+    bad_fp = res.findings[0].fingerprint
+    res2 = run_audit(["fixture.bad_gram"], baseline_path=str(baseline))
+    assert res2.findings == [] and res2.baselined
+
+    # --update-baseline scoped to a DIFFERENT target must KEEP the bad
+    # entry's debt (a subset run cannot silently prune out-of-scope
+    # fingerprints)...
+    rc = ir_audit.main([
+        "--root", str(tmp_path), "--baseline", str(baseline),
+        "--target", "overlap.tiled_gram", "--update-baseline",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0 and "out-of-scope kept" in out
+    assert bad_fp in load_baseline(str(baseline))
+
+    # ...then FIXING the entry and updating ITS scope prunes the debt
+    def build_fixed(devs):
+        return Built(fn=lambda a: a + 1.0, args=(x,), k=8)
+
+    monkeypatch.setitem(
+        ENTRY_POINTS, "fixture.bad_gram",
+        EntryPoint(name="fixture.bad_gram", category="solver",
+                   builder=build_fixed, min_devices=8, line=1, doc=""),
+    )
+    rc = ir_audit.main([
+        "--root", str(tmp_path), "--baseline", str(baseline),
+        "--target", "fixture.bad_gram", "--update-baseline",
+    ])
+    out = capsys.readouterr().out
+    # both of the bad gram's fingerprints (terminal all-reduce + missing
+    # reduce-scatters) are now stale and pruned
+    assert rc == 0 and "stale fingerprint(s) pruned" in out
+    assert "0 stale" not in out
+    assert load_baseline(str(baseline)) == {}
+
+
+def test_cli_update_baseline_refuses_partial_runs(
+    monkeypatch, tmp_path, capsys
+):
+    """A run with skipped entries must NEVER rewrite the ratchet: the
+    skipped entries' debt would be silently pruned and resurface as
+    'new' findings on the next fully-provisioned run."""
+    giant = EntryPoint(
+        name="fixture.needs_many", category="overlap",
+        builder=lambda devs: Built(fn=lambda a: a, args=(jnp.zeros(1),)),
+        min_devices=4096, line=1, doc="",
+    )
+    monkeypatch.setitem(ENTRY_POINTS, "fixture.needs_many", giant)
+    baseline = tmp_path / "ir_baseline.json"
+    baseline.write_text(json.dumps({"findings": {"x::A1::e::d": 1}}))
+    rc = ir_audit.main([
+        "--root", str(tmp_path), "--baseline", str(baseline),
+        "--target", "fixture.needs_many", "--update-baseline",
+    ])
+    err = capsys.readouterr().err
+    assert rc == 2 and "refusing --update-baseline" in err
+    from keystone_tpu.analysis.engine import load_baseline
+
+    assert load_baseline(str(baseline)) == {"x::A1::e::d": 1}  # untouched
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_json_and_exit_codes(devices, capsys):
+    rc = ir_audit.main(["--list"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for name in ("overlap.tiled_gram", "solver.tsqr", "pallas.fv_encode",
+                 "dag.fused_segment"):
+        assert name in out
+
+    rc = ir_audit.main([
+        "--root", REPO_ROOT, "--target", "pallas.fv_encode",
+        "--format", "json",
+    ])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    for key in ("new", "baselined", "stale", "stale_pragmas", "suppressed",
+                "targets", "skipped", "errors", "total"):
+        assert key in payload
+    assert payload["targets"] == ["pallas.fv_encode"]
+    assert payload["new"] == [] and payload["errors"] == []
+
+    rc = ir_audit.main(["--target", "nonsense", "--root", REPO_ROOT])
+    assert rc == 2
+    capsys.readouterr()
+
+
+def test_cli_skips_underprovisioned_entries_loudly(monkeypatch, capsys):
+    """An entry the topology cannot place is SKIPPED and reported, never
+    silently passed (the bench honesty key rides this)."""
+    giant = EntryPoint(
+        name="fixture.needs_many", category="overlap",
+        builder=lambda devs: Built(fn=lambda a: a, args=(jnp.zeros(1),)),
+        min_devices=4096, line=1, doc="",
+    )
+    monkeypatch.setitem(ENTRY_POINTS, "fixture.needs_many", giant)
+    res = run_audit(["fixture.needs_many"], baseline_path=None)
+    assert res.skipped == {
+        "fixture.needs_many":
+            f"needs >= 4096 devices, have {len(jax.devices())}"
+    }
+    assert res.findings == [] and res.files == 0

@@ -468,6 +468,50 @@ def test_pragma_bare_disable_suppresses_all(tmp_path):
     assert res.findings == [] and res.suppressed == 1
 
 
+def test_stale_pragma_reported(tmp_path):
+    """A pragma that suppresses ZERO findings is itself reported (the
+    unused-noqa analog) — but a pragma for a rule family this engine does
+    not run (the audit's A-rules) is NOT stale just because R1-R6 ran."""
+    res = lint_tree(tmp_path, {"pkg/core/cache.py": """
+        _STATE = {}
+
+
+        def f(k, v):
+            _STATE[k] = v  # lint: disable=R5 (fires -> credited, not stale)
+
+
+        def g(v):
+            return v + 1  # lint: disable=R5 (suppresses nothing -> stale)
+
+
+        def h(v):
+            return v + 2  # lint: disable=A3 (audit-family rule: not ours)
+
+
+        def i(v):
+            return v + 3  # lint: disable (bare: stale when nothing fired)
+    """})
+    assert res.suppressed == 1
+    # sorted by line: g's unused R5 first, then i's unused bare disable
+    assert [r for _p, _l, r in res.stale_pragmas] == ["R5", "*"]
+
+
+def test_stale_pragma_block_form_counts_once(tmp_path):
+    """The justification-paragraph pragma (comment block + first code
+    line) is ONE site: credited once when its line fires, stale once when
+    nothing does."""
+    res = lint_tree(tmp_path, {"pkg/core/cache.py": """
+        _STATE = {}
+
+
+        def f(k, v):
+            # lint: disable=R5 (covers this block and the
+            # mutation line below)
+            _STATE[k] = v
+    """})
+    assert res.suppressed == 1 and res.stale_pragmas == []
+
+
 # ---------------------------------------------------------------------------
 # Baseline ratchet
 # ---------------------------------------------------------------------------
@@ -558,15 +602,83 @@ def test_cli_exit_codes_and_clickable_triple(tmp_path, capsys):
     assert rc == 1 and payload["total"] == 1
 
 
+def test_cli_show_stale_pragmas(tmp_path, capsys):
+    from keystone_tpu.analysis.cli import main as lint_main
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("x = 1  # lint: disable=R4 (nothing here)\n")
+    rc = lint_main(["pkg", "--root", str(tmp_path), "--no-baseline",
+                    "--show-stale-pragmas"])
+    out = capsys.readouterr().out
+    assert rc == 0  # stale pragmas report, they do not fail the build
+    assert "stale pragmas" in out
+    assert f"pkg{os.sep}mod.py:1: lint: disable=R4" in out
+
+
+def test_update_baseline_prunes_stale_fingerprints(tmp_path, capsys):
+    """--update-baseline must PRUNE fingerprints whose findings were fixed
+    (not keep them as dead allowance): the rewritten file holds exactly
+    the surviving findings."""
+    from keystone_tpu.analysis.cli import main as lint_main
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'import os\na = os.environ.get("KEYSTONE_FOO")\n'
+    )
+    baseline = tmp_path / "lint_baseline.json"
+    stale_fp = "pkg/gone.py::R4::KEYSTONE_GONE"
+    baseline.write_text(json.dumps({
+        "findings": {stale_fp: 2},
+    }))
+    rc = lint_main(["pkg", "--root", str(tmp_path), "--update-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 stale fingerprint(s) pruned" in out
+    kept = load_baseline(str(baseline))
+    assert stale_fp not in kept
+    assert len(kept) == 1 and all("mod.py" in fp for fp in kept)
+
+
+def test_update_baseline_keeps_out_of_scope_debt(tmp_path, capsys):
+    """A subset run (`lint pkg --update-baseline`) must not prune the
+    debt of still-existing files it never linted."""
+    from keystone_tpu.analysis.cli import main as lint_main
+
+    for sub in ("pkg", "other"):
+        d = tmp_path / sub
+        d.mkdir()
+        (d / "mod.py").write_text(
+            'import os\na = os.environ.get("KEYSTONE_FOO")\n'
+        )
+    rc = lint_main(["pkg", "other", "--root", str(tmp_path),
+                    "--update-baseline"])
+    assert rc == 0
+    baseline = load_baseline(str(tmp_path / "lint_baseline.json"))
+    assert len(baseline) == 2
+    # fix pkg's finding, update ONLY pkg: pkg's fp pruned, other's kept
+    (tmp_path / "pkg" / "mod.py").write_text("x = 1\n")
+    rc = lint_main(["pkg", "--root", str(tmp_path), "--update-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 stale fingerprint(s) pruned" in out
+    assert "1 out-of-scope kept" in out
+    kept = load_baseline(str(tmp_path / "lint_baseline.json"))
+    assert len(kept) == 1 and all("other" in fp for fp in kept)
+
+
 def test_repo_lints_clean_against_committed_baseline():
     """The acceptance invariant: the shipped tree has no findings beyond
-    its committed (empty-or-justified) baseline."""
+    its committed (empty-or-justified) baseline — and no stale pragmas
+    (every suppression in the tree suppresses something)."""
     res = run_lint(
         REPO_ROOT, ["keystone_tpu", "bench.py", "scripts"],
         baseline_path=os.path.join(REPO_ROOT, "lint_baseline.json"),
     )
     assert res.errors == []
     assert res.findings == [], "\n".join(f.format() for f in res.findings)
+    assert res.stale_pragmas == [], res.stale_pragmas
 
 
 # ---------------------------------------------------------------------------

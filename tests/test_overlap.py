@@ -6,15 +6,28 @@ the overlap path, paired collective-permutes on the bidirectional ring.
 Numeric equivalence alone cannot catch a silent fall-back to the serialized
 collective (correct numbers, unhidden latency), so every overlap feature
 here carries both pins.
-"""
 
-import re
+The structural pins are the A1 assertion helpers from
+``keystone_tpu/analysis/ir_rules.py`` — the SAME functions the
+``keystone-tpu audit`` pass runs over the registered entry points, so
+these tests and the auditor can never disagree about what "pipelined"
+means (PR 9 migrated the hand-written string pins onto them).
+"""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from keystone_tpu.analysis.ir_rules import (
+    assert_no_all_reduce,
+    assert_no_bulk_collectives,
+    assert_paired_permutes,
+    assert_pipelined_reduce_scatter,
+    assert_two_tier_replica_groups,
+    collective_counts,
+)
 
 from keystone_tpu.learning import BlockLeastSquaresEstimator
 from keystone_tpu.learning.block_weighted import BlockWeightedLeastSquaresEstimator
@@ -41,13 +54,9 @@ from keystone_tpu.parallel.overlap import (
 )
 
 
-def _collectives(hlo_text: str):
-    return {
-        name: len(re.findall(name + r"\(|" + name + r"-start\(", hlo_text))
-        for name in (
-            "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
-        )
-    }
+# the one collective-counting implementation (ir_rules.py) — the contrast
+# tests (monolithic path HAS the all-reduce) read counts directly
+_collectives = collective_counts
 
 
 @pytest.fixture()
@@ -107,12 +116,8 @@ def test_tiled_gram_hlo_is_pipelined(mesh, rng):
     k = mesh.shape["data"]
     x = jnp.asarray(rng.normal(size=(128, 16 * k)).astype(np.float32))
     f = jax.jit(lambda a: tiled_transpose_matmul(a, mesh=mesh))
-    cols = _collectives(f.lower(x).compile().as_text())
-    assert cols["reduce-scatter"] >= k, cols
-    assert cols["all-reduce"] == 0, (
-        f"overlap path still carries a bulk all-reduce: {cols}"
-    )
-    assert cols["all-gather"] == 1, cols
+    # the auditor's A1 check verbatim (ir_rules.py)
+    assert_pipelined_reduce_scatter(f.lower(x).compile().as_text(), k)
 
 
 def test_monolithic_gram_hlo_has_terminal_all_reduce(mesh, rng):
@@ -195,10 +200,14 @@ def test_bidirectional_ring_hlo_paired_permutes(devices, rng):
     x = jnp.asarray(rng.normal(size=(40, 32)).astype(np.float32))
     with use_mesh(m):
         f = jax.jit(lambda a: bidirectional_ring_gram(a, m, axis="model"))
-        cols = _collectives(f.lower(x).compile().as_text())
+        hlo = f.lower(x).compile().as_text()
     k = 8
+    cols = _collectives(hlo)
     assert cols["collective-permute"] == 2 * ((k - 1) // 2) + 1, cols
-    assert cols["all-reduce"] == 0 and cols["all-gather"] == 0, cols
+    # the auditor's pairing + zero-bulk checks verbatim (ir_rules.py):
+    # every permute table has its inverse (one unpaired even-k middle hop)
+    assert_paired_permutes(hlo, min_permutes=2 * ((k - 1) // 2))
+    assert_no_bulk_collectives(hlo)
 
 
 # -- solver entry points: overlap on == overlap off -------------------------
@@ -228,9 +237,10 @@ def test_normal_equations_overlap_hlo_is_pipelined(mesh, rng):
     lowered = _normal_equations.lower(
         A, b, jnp.float32(1.0), None, precision="high", omesh=mesh
     )
-    cols = _collectives(lowered.compile().as_text())
-    assert cols["reduce-scatter"] >= k, cols
-    assert cols["all-reduce"] == 0, cols
+    # gram + cross term: two trailing all-gathers are legitimate
+    assert_pipelined_reduce_scatter(
+        lowered.compile().as_text(), k, all_gather_max=2
+    )
 
 
 def test_tsqr_overlap_matches(mesh, rng):
@@ -413,30 +423,13 @@ def test_two_tier_inner_never_crosses_slice_boundary(mesh, rng):
     x = jnp.asarray(rng.normal(size=(128, 16 * k)).astype(np.float32))
     f = jax.jit(lambda a: tiled_transpose_matmul(a, mesh=mesh, tiers=(2, 4)))
     hlo = f.lower(x).compile().as_text()
-    group_strs = re.findall(
-        r"reduce-scatter[^\n]*replica_groups=\{(\{[^=]*?\})\},", hlo
-    )
-    assert group_strs, "no reduce-scatter with replica_groups in the HLO"
-    slices = [set(range(0, 4)), set(range(4, 8))]
-    inner = outer = 0
-    for gs in group_strs:
-        parsed = [
-            set(int(v) for v in grp.split(","))
-            for grp in re.findall(r"\{([^{}]*)\}", gs)
-        ]
-        if all(any(p <= s for s in slices) for p in parsed):
-            inner += 1  # ICI tier: inside a declared slice
-        elif all(len(p & s) == 1 for p in parsed for s in slices):
-            outer += 1  # DCN tier: exactly one member per slice
-        else:
-            raise AssertionError(
-                f"reduce-scatter crosses the declared slice boundary: {parsed}"
-            )
+    # the auditor's two-tier boundary check verbatim (ir_rules.py): every
+    # reduce-scatter within one slice or one-member-per-slice, >= T
+    # within-slice scatters (one per tile), >= 1 cross-slice exchange,
+    # no all-reduce anywhere
     T = _pick_tiles(x.shape[1], k)
-    assert inner >= T, (inner, T)
-    assert outer >= 1, group_strs
-    cols = _collectives(hlo)
-    assert cols["all-reduce"] == 0, cols
+    assert_two_tier_replica_groups(hlo, 2, 4, min_inner=T)
+    assert_no_all_reduce(hlo)
 
 
 def test_two_tier_tiled_psum_dot_matches(mesh, rng):
@@ -506,12 +499,15 @@ def test_tsqr_overlap_hlo_ring_tree(mesh, rng):
     lowered = _tsqr_solve.lower(
         A, b, jnp.float32(0.5), None, mesh, True, "highest", True
     )
-    cols = _collectives(lowered.compile().as_text())
-    assert cols["collective-permute"] >= 2 * ((k - 1) // 2), cols
-    assert cols["all-gather"] == 0, (
-        f"overlap TSQR still carries a bulk all-gather: {cols}"
+    hlo = lowered.compile().as_text()
+    # the auditor's A1 checks verbatim (ir_rules.py): paired permutes
+    # carrying the (R, Qᵀb) pair — the even-k middle hop ships the pair,
+    # so up to TWO unmatched HLO permutes are the schedule, not a bug —
+    # and zero bulk all-gather/all-reduce
+    assert_paired_permutes(
+        hlo, min_permutes=2 * ((k - 1) // 2), unpaired_max=2
     )
-    assert cols["all-reduce"] == 0, cols
+    assert_no_bulk_collectives(hlo)
     # contrast: the monolithic tree keeps the bulk gather
     lowered = _tsqr_solve.lower(
         A, b, jnp.float32(0.5), None, mesh, True, "highest", False
@@ -552,12 +548,16 @@ def test_model_tiled_gram_hlo_composes_rotation_and_tiles(mesh2d, rng):
         lambda a: model_tiled_transpose_matmul(a, None, mesh2d),
         in_shardings=NamedSharding(mesh2d, P("data", "model")),
     )
-    cols = _collectives(f.lower(x).compile().as_text())
+    hlo = f.lower(x).compile().as_text()
+    cols = _collectives(hlo)
     km, kd = mesh2d.shape["model"], mesh2d.shape["data"]
     T = _pick_tiles(x.shape[1] // km, kd)
     assert cols["collective-permute"] >= 1, cols  # the block rotation
-    assert cols["reduce-scatter"] >= km * T, cols  # tiles x rotations
-    assert cols["all-reduce"] == 0, cols
+    # tiles x rotations reduce-scatters, no terminal all-reduce — the
+    # auditor's pipelined check with the composed-schedule floor
+    assert_pipelined_reduce_scatter(
+        hlo, kd, min_scatter=km * T, all_gather_max=None
+    )
 
 
 def test_model_overlap_spec_gate(mesh2d, rng):
@@ -791,9 +791,9 @@ def test_tiled_psum_matches_psum(mesh, rng):
     out = np.asarray(f(jnp.asarray(x)))[0]
     np.testing.assert_allclose(out, x.sum(0), rtol=1e-4, atol=1e-4)
     jf = jax.jit(f)
-    cols = _collectives(jf.lower(jnp.asarray(x)).compile().as_text())
-    assert cols["reduce-scatter"] >= k, cols
-    assert cols["all-reduce"] == 0, cols
+    assert_pipelined_reduce_scatter(
+        jf.lower(jnp.asarray(x)).compile().as_text(), k
+    )
 
 
 def test_tiled_psum_falls_back_on_indivisible_rows(mesh, rng):
