@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -42,6 +42,20 @@ audit:
 audit-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/audit_smoke.py
 
+# Construction-time pipeline contract checker (keystone_tpu/analysis/
+# check.py): propagate (shape, dtype, PartitionSpec) through the
+# registered pipeline graphs — no data, no compiles — and run rules
+# C1-C5. Non-zero exit ONLY for findings not in the ratcheted
+# check_baseline.json. Seconds.
+check:
+	JAX_PLATFORMS=cpu $(PY) -m keystone_tpu.cli check
+
+# All-pipeline check smoke (<20 s): every registered target clean + the
+# JSON schema + the mis-chained-pipeline construction rejection, the
+# contract `make verify-fast` rides (scripts/check_smoke.py).
+check-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/check_smoke.py
+
 # Lint + tier-1 + the BENCH_SMOKE bench contract + the telemetry smoke in
 # ONE command — the pre-merge loop: the static pass first (it is the
 # cheapest failure), then the full (non-slow) test suite on the 8-device
@@ -56,6 +70,7 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/autotune_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/plan_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/audit_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/check_smoke.py
 
 # Tiny traced pipeline -> counters non-zero, Chrome trace well-formed,
 # telemetry-report renders (scripts/telemetry_smoke.py); CPU, seconds.

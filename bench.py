@@ -975,6 +975,40 @@ def _try_lint_rows() -> dict:
         return {}
 
 
+def _try_check_rows() -> dict:
+    """Pipeline-contract hygiene row (``keystone_tpu/analysis/check.py``):
+    propagate (shape, dtype, PartitionSpec) through the registered
+    pipeline graphs and record the C1-C5 finding counts — the graph-level
+    complement of the lint (source) and audit (HLO) rows.
+    ``check_findings_total`` counts everything surfaced (new + baselined),
+    ``check_new`` what would fail ``make check``. Abstract eval only — no
+    data, no compiles: a couple of seconds. BENCH_CHECK=0 skips."""
+    if not knobs.get("BENCH_CHECK"):
+        return {}
+    try:
+        from keystone_tpu.analysis.check import (
+            DEFAULT_CHECK_BASELINE,
+            run_check,
+        )
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        baseline = os.path.join(root, DEFAULT_CHECK_BASELINE)
+        result = run_check(
+            baseline_path=baseline if os.path.exists(baseline) else None,
+            root=root,
+        )
+        return {
+            "check_findings_total": result.total,
+            "check_new": len(result.findings),
+            "check_suppressed": result.suppressed,
+            "check_targets": len(result.targets),
+            "check_errors": len(result.errors) or None,
+        }
+    except Exception as e:
+        print(f"check rows failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"check_findings_total": None}
+
+
 def _try_audit_rows() -> dict:
     """IR-audit hygiene row (``keystone_tpu/analysis/ir_audit.py``): lower
     the registered entry points the live topology can place and record the
@@ -1201,6 +1235,18 @@ def main():
     # in the same trail as a perf regression.
     out.update(_try_lint_rows())
     _flush(out, "lint")
+    # Pipeline-contract hygiene (abstract shape propagation over the
+    # registered pipeline graphs — no data, no compiles): ~2 s of
+    # eval_shape tracing, so the 20 s reduced floor is generous headroom,
+    # not a heavy-section derate; the explicit budget-skip marker is the
+    # section contract the tests pin.
+    if _budget_remaining() - _FINALIZE_RESERVE_S < 20.0:
+        out["check_skipped"] = "budget"
+        print("bench section check skipped: budget exhausted",
+              file=sys.stderr)
+    else:
+        out.update(_try_check_rows())
+    _flush(out, "check")
     # IR-audit hygiene (lower + compile the registered entry points; no
     # execution): seconds, but not milliseconds — a reduced floor like
     # telemetry's, with the explicit budget-skip marker the section
@@ -1372,6 +1418,9 @@ _COMPACT_KEYS = (
     # static-analysis hygiene (keystone_tpu/analysis; full counts in
     # bench_full.json)
     ("lint", "lint_findings_total"),
+    # pipeline-contract hygiene (keystone_tpu/analysis/check.py; full
+    # counts in bench_full.json)
+    ("check", "check_findings_total"),
     # IR-audit hygiene (keystone_tpu/analysis/ir_audit.py; full counts in
     # bench_full.json)
     ("audit", "audit_findings_total"),

@@ -1,0 +1,650 @@
+"""keystone-check: construction-time shape/dtype/sharding contract checker.
+
+The missing middle layer of the analysis stack: keystone-lint (``rules.py``)
+audits Python *source*, keystone-audit (``ir_audit.py``) audits *compiled
+HLO* — nothing audited the **pipeline graph itself**, the level where
+KeystoneML's typed ``Transformer[A,B]`` composition used to fail at compile
+time.  This module checks it pre-dispatch, in the spirit of "Memory Safe
+Computations with XLA Compiler" (PAPERS.md): whole-program analysis before
+anything runs.
+
+Rule families (over :mod:`contracts`' shared propagation pass — the SAME
+pass ``core/plan.py::pipeline_costs`` consumes, so checker and planner can
+never disagree about a stage's abstract output):
+
+- **C1 chain mismatch** — a stage whose abstract evaluation rejects its
+  producer's output (rank/shape/dtype), reported at the chain construction
+  site with BOTH stages named.
+- **C2 sharding** — a stage whose declared input ``PartitionSpec``
+  requirement conflicts with the committed input spec: the composition
+  would force an implicit all-gather/reshard (the static complement of
+  ``KEYSTONE_GUARD`` and audit rule A2).
+- **C3 estimator fit/apply asymmetry** — the fitted transformer's input
+  contract must accept the fit data's feature layout (trailing dims +
+  dtype of the fit-side and apply-side featurizations must agree).
+- **C4 precision** — pre-dispatch f64/weak-64 leaks in a stage's abstract
+  output (fires BEFORE compilation; complements audit rule A3).
+- **C5 un-evaluable stage** — a node the propagation pass cannot
+  abstract-eval and nobody declared a ``__contract__`` for.  Today this
+  silently degrades the planner (``plan.bounded=False``); here it is a
+  visible finding.
+
+Findings flow through the EXISTING ``engine.py`` machinery: the same
+:class:`Finding` type anchored at each pipeline's *construction site*
+(``chain()``/``dag()`` capture their caller — so ``# lint: disable=C1
+(reason)`` pragmas at the construction line suppress exactly like source
+pragmas), the same ratcheted baseline (``check_baseline.json``, committed
+empty), the same stale-pragma reporting.  ``keystone-tpu check`` is the
+CLI (lint's 0/1/2 exit contract); ``make check`` / ``make check-smoke``
+the CI entry points; ``check_findings_total`` / ``check_new`` the bench
+hygiene series.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from keystone_tpu.analysis.contracts import (
+    ContractIssue,
+    StageRecord,
+    contract_of,
+    format_aval,
+    propagate,
+    propagate_pipeline,
+    site_of,
+    stage_list,
+)
+from keystone_tpu.analysis.engine import (
+    Finding,
+    LintResult,
+    _collect_pragmas,
+    apply_baseline,
+    apply_pragmas,
+    collect_sites,
+    load_baseline,
+    save_baseline,
+)
+
+DEFAULT_CHECK_BASELINE = "check_baseline.json"
+
+#: rule ids of this engine (bare pragmas and the stale-pragma scoping)
+ALL_CHECK_RULES = ("C1", "C2", "C3", "C4", "C5")
+
+
+# ---------------------------------------------------------------------------
+# Findings from propagated records
+# ---------------------------------------------------------------------------
+
+def _finding(rule, path, line, message, hint, symbol) -> Finding:
+    return Finding(rule=rule, path=path, line=line, col=0,
+                   message=message, hint=hint, symbol=symbol)
+
+
+def pipeline_findings(
+    records: Sequence[StageRecord],
+    name: str,
+    site: Optional[Tuple[str, int]] = None,
+    from_template: bool = False,
+) -> List[Finding]:
+    """C1/C2/C4/C5 findings over one pipeline's propagated stage records.
+
+    ``from_template=True`` is the construction-time mode: the input aval
+    was synthesized from a canonical ``in_template`` whose absolute dims
+    are made up, so only template-invariant findings survive — C1
+    rank/dtype mismatches (a ``dim`` mismatch or a C4/C5 could be a
+    template artifact)."""
+    path, line = site if site else ("<unknown>", 0)
+    by_index = {r.index: r for r in records}
+
+    def producer_name(rec: StageRecord) -> str:
+        d = rec.deps[0] if rec.deps else -1
+        return "pipeline input" if d < 0 else by_index[d].name
+
+    out: List[Finding] = []
+    for rec in records:
+        if rec.issue is not None:
+            if rec.issue.kind == "uneval":
+                if from_template:
+                    continue
+                out.append(_finding(
+                    "C5", path, line,
+                    f"[{name}] stage {rec.name} cannot be abstractly "
+                    f"evaluated: {rec.issue.message} — the planner's cost "
+                    f"table degrades to bounded=False here",
+                    hint="declare a __contract__(self) -> NodeContract "
+                         "with an out= abstract transfer "
+                         "(keystone_tpu/analysis/contracts.py)",
+                    symbol=f"{name}::C5::{rec.name}",
+                ))
+            else:
+                if from_template and rec.issue.kind not in ("rank", "dtype"):
+                    continue
+                prod = producer_name(rec)
+                got = format_aval(rec.in_aval)
+                out.append(_finding(
+                    "C1", path, line,
+                    f"[{name}] {rec.name} cannot consume {prod} output "
+                    f"{got}: {rec.issue.message}",
+                    hint="the chain composed here mis-matches these two "
+                         "stages; fix the composition (or the stage's "
+                         "declared contract) at this construction site",
+                    symbol=f"{name}::C1::{prod}>{rec.name}",
+                ))
+            continue
+        if from_template:
+            continue
+        # C2: declared input-spec requirement vs the committed spec —
+        # compared on NAMED axes (trailing Nones are implicit in JAX:
+        # P('data') == P('data', None), and a spec carried through a
+        # rank-changing row-preserving stage keeps its original length)
+        contract = contract_of(rec.node)
+        if (
+            contract is not None and contract.in_spec is not None
+            and rec.in_spec is not None
+            and _spec_key(rec.in_spec) != _spec_key(contract.in_spec)
+        ):
+            out.append(_finding(
+                "C2", path, line,
+                f"[{name}] stage {rec.name} requires input spec "
+                f"{contract.in_spec} but the committed input reaches it as "
+                f"{rec.in_spec}: dispatch would force an implicit "
+                f"all-gather/reshard",
+                hint="re-shard at an explicit boundary (or fix the stage's "
+                     "in_spec); KEYSTONE_GUARD=1 is the runtime twin of "
+                     "this finding",
+                symbol=f"{name}::C2::{rec.name}",
+            ))
+        # C4: f64/weak-64 leaks in the abstract output, pre-compilation —
+        # flagged at the stage that INTRODUCES the wide dtype only (a
+        # downstream stage carrying it through is the same defect; one
+        # finding per leak, like C1/C5's report-once-at-source)
+        allow = contract is not None and contract.allow_f64
+        if not allow:
+            already = _wide_dtypes(rec.in_aval)
+            for leak in _wide_leaves(rec.out_aval):
+                if leak.split(" ")[0] in already:
+                    continue
+                out.append(_finding(
+                    "C4", path, line,
+                    f"[{name}] stage {rec.name} emits {leak} before any "
+                    f"compilation — TPU f64 is emulated (audit rule A3 "
+                    f"would catch this post-lowering; this fires first)",
+                    hint="cast at the stage boundary or declare the "
+                         "contract with allow_f64=True and a reason",
+                    symbol=f"{name}::C4::{rec.name}::{leak}",
+                ))
+    return out
+
+
+def _spec_key(spec: Any) -> Tuple:
+    """Comparable form of a PartitionSpec: trailing ``None``s stripped —
+    ``P('data')``, ``P('data', None)`` and a longer spec carried through a
+    rank-dropping stage all shard the same way."""
+    parts = tuple(spec)
+    while parts and parts[-1] is None:
+        parts = parts[:-1]
+    return parts
+
+
+def _wide_leaves(aval: Any) -> List[str]:
+    import jax
+
+    out = []
+    seen = set()
+    for l in jax.tree_util.tree_leaves(aval or ()):
+        dt = str(getattr(l, "dtype", ""))
+        if dt in ("float64", "complex128") and dt not in seen:
+            seen.add(dt)
+            weak = " (weak-typed)" if getattr(l, "weak_type", False) else ""
+            out.append(f"{dt}{weak}")
+    return out
+
+
+def _wide_dtypes(aval: Any) -> set:
+    """Base wide dtype names present in an aval (the C4 transition test)."""
+    return {leak.split(" ")[0] for leak in _wide_leaves(aval)}
+
+
+@dataclass(frozen=True)
+class FitApply:
+    """One estimator's fit-vs-apply featurization pair: the fitted
+    transformer's input contract must accept the layout it will be applied
+    to (C3)."""
+
+    estimator: str
+    fit_aval: Any
+    apply_aval: Any
+
+
+def fit_apply_findings(
+    pairs: Sequence[FitApply],
+    name: str,
+    site: Optional[Tuple[str, int]] = None,
+) -> List[Finding]:
+    from keystone_tpu.analysis.contracts import leading_leaf
+
+    path, line = site if site else ("<unknown>", 0)
+    out: List[Finding] = []
+    for p in pairs:
+        fit, app = leading_leaf(p.fit_aval), leading_leaf(p.apply_aval)
+        if fit is None or app is None:
+            continue
+        problems = []
+        if tuple(fit.shape[1:]) != tuple(app.shape[1:]):
+            problems.append(
+                f"feature layout {tuple(fit.shape[1:])} at fit vs "
+                f"{tuple(app.shape[1:])} at apply"
+            )
+        if str(fit.dtype) != str(app.dtype):
+            problems.append(f"dtype {fit.dtype} at fit vs {app.dtype} at apply")
+        for prob in problems:
+            out.append(_finding(
+                "C3", path, line,
+                f"[{name}] estimator {p.estimator} is fitted on "
+                f"{format_aval(p.fit_aval)} but applied to "
+                f"{format_aval(p.apply_aval)}: {prob} — the fitted "
+                f"transformer cannot accept the apply-side features",
+                hint="fit-time and apply-time featurizations must be the "
+                     "same chain (KeystoneML's Transformer[A,B] symmetry)",
+                symbol=f"{name}::C3::{p.estimator}",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pipeline check targets (the registry)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PipelineContract:
+    """One checkable pipeline graph: a composed Chain/DAG plus the abstract
+    sample it runs over (and optionally the committed input PartitionSpec
+    and the estimator fit/apply pairs riding the same graph)."""
+
+    name: str
+    pipe: Any
+    sample: Any
+    spec: Any = None
+    fit_apply: List[FitApply] = dc_field(default_factory=list)
+
+
+def check_pipeline(
+    contract: PipelineContract,
+    site: Optional[Tuple[str, int]] = None,
+) -> List[Finding]:
+    """All C-rule findings for one :class:`PipelineContract`.  Findings
+    anchor at the pipe's recorded construction site (``chain()``/``dag()``
+    capture it); ``site`` is the fallback anchor."""
+    anchor = site_of(contract.pipe) or site
+    records = propagate_pipeline(
+        contract.pipe, contract.sample, contract.spec
+    )
+    out = pipeline_findings(records, contract.name, anchor)
+    out.extend(fit_apply_findings(contract.fit_apply, contract.name, anchor))
+    return out
+
+
+@dataclass(frozen=True)
+class CheckEntry:
+    name: str
+    builder: Callable[[], List[PipelineContract]]
+    path: str      # repo-relative fallback anchor (the registration file)
+    line: int
+    doc: str
+
+
+CHECK_TARGETS: Dict[str, CheckEntry] = {}
+
+_SELF_RELPATH = os.path.join("keystone_tpu", "analysis", "check.py")
+
+
+def register_check(name: str):
+    """Register a check target.  The builder returns the pipeline's
+    :class:`PipelineContract` list; its first line is the fallback
+    finding/pragma anchor when a graph has no recorded construction
+    site."""
+
+    def deco(fn):
+        CHECK_TARGETS[name] = CheckEntry(
+            name=name, builder=fn, path=_SELF_RELPATH,
+            line=fn.__code__.co_firstlineno,
+            doc=(fn.__doc__ or "").strip().splitlines()[0]
+            if fn.__doc__ else "",
+        )
+        return fn
+
+    return deco
+
+
+# -- the five shipped pipelines ---------------------------------------------
+# Builders delegate to each pipeline module's ``check_graph()`` so the
+# contract lives NEXT TO the pipeline it describes; the registry here is
+# just the roll call the acceptance test pins.
+
+@register_check("mnist")
+def _mnist_contracts() -> List[PipelineContract]:
+    """MnistRandomFFT: sign-flip → padded FFT → relu chains + block solver."""
+    from keystone_tpu.pipelines.mnist_random_fft import check_graph
+
+    return check_graph()
+
+
+@register_check("cifar")
+def _cifar_contracts() -> List[PipelineContract]:
+    """RandomPatchCifar: conv → rectify → pool → vectorize featurizer."""
+    from keystone_tpu.pipelines.random_patch_cifar import check_graph
+
+    return check_graph()
+
+
+@register_check("timit")
+def _timit_contracts() -> List[PipelineContract]:
+    """Timit: cosine random features → scaler batches + streaming solver."""
+    from keystone_tpu.pipelines.timit import check_graph
+
+    return check_graph()
+
+
+@register_check("voc")
+def _voc_contracts() -> List[PipelineContract]:
+    """VOCSIFTFisher: gray → SIFT → PCA → FV-encode branch."""
+    from keystone_tpu.pipelines.voc_sift_fisher import check_graph
+
+    return check_graph()
+
+
+@register_check("imagenet")
+def _imagenet_contracts() -> List[PipelineContract]:
+    """ImageNetSiftLcsFV: the two-branch SIFT/LCS descriptor-reduction DAG."""
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import check_graph
+
+    return check_graph()
+
+
+def resolve_check_targets(
+    targets: Optional[Sequence[str]] = None,
+) -> List[str]:
+    """Registered target names matching ``targets`` (exact or prefix);
+    None/empty = all.  Unknown targets raise KeyError."""
+    if not targets:
+        return list(CHECK_TARGETS)
+    out: List[str] = []
+    for t in targets:
+        hits = [
+            n for n in CHECK_TARGETS if n == t or n.startswith(t + ".")
+        ]
+        if not hits:
+            raise KeyError(
+                f"unknown check target {t!r}; registered: "
+                f"{', '.join(sorted(CHECK_TARGETS))}"
+            )
+        out.extend(h for h in hits if h not in out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+class CheckResult(LintResult):
+    """LintResult plus the check-specific accounting."""
+
+    def __init__(self):
+        super().__init__()
+        self.targets: List[str] = []     # registry target names
+        #: PipelineContract names actually checked — what baseline
+        #: fingerprints embed (a target may hold several contracts), so
+        #: --update-baseline scoping compares against THESE, never the
+        #: registry names
+        self.contracts: List[str] = []
+
+
+def _relpath(path: str, root: str) -> str:
+    if not os.path.isabs(path):
+        return path
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:
+        return path
+    return path if rel.startswith("..") else rel
+
+
+def _fingerprint_target(fp: str) -> str:
+    """The target name a check fingerprint belongs to (symbols are
+    ``<target>::C<n>::<detail>``); '' when malformed."""
+    parts = fp.split("::")
+    return parts[2] if len(parts) >= 4 else ""
+
+
+def run_check(
+    targets: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+    root: str = ".",
+    registry: Optional[Dict[str, CheckEntry]] = None,
+) -> CheckResult:
+    """Build the selected pipeline targets and run the C-rules, folding in
+    the pragma filter (over each finding's anchor FILE — the construction
+    site) and the ratcheted ``check_baseline.json`` exactly like
+    ``run_lint``/``run_audit``.  ``registry`` overrides the target table
+    (test fixtures)."""
+    reg = registry if registry is not None else CHECK_TARGETS
+    result = CheckResult()
+    if registry is None:
+        result.targets = resolve_check_targets(targets)
+    else:
+        result.targets = [t for t in (targets or reg) if t in reg]
+    root = os.path.abspath(root)
+
+    raw: List[Finding] = []
+    # every construction-site file this run anchored at — scanned for
+    # pragmas whether or not it produced findings, so a pragma whose
+    # finding got FIXED still surfaces as stale (the unused-noqa case)
+    anchor_paths: set = set()
+    for name in result.targets:
+        entry = reg[name]
+        try:
+            contracts_list = entry.builder()
+        except Exception as e:
+            result.errors.append(f"{name}: {type(e).__name__}: {e}")
+            continue
+        result.files += 1
+        for pc in contracts_list:
+            result.contracts.append(pc.name)
+            anchor = site_of(pc.pipe) or (entry.path, entry.line)
+            anchor_paths.add(_relpath(anchor[0], root))
+            try:
+                found = check_pipeline(pc, site=(entry.path, entry.line))
+            except Exception as e:
+                result.errors.append(
+                    f"{name}/{pc.name}: {type(e).__name__}: {e}"
+                )
+                continue
+            for f in found:
+                raw.append(Finding(
+                    rule=f.rule, path=_relpath(f.path, root), line=f.line,
+                    col=f.col, message=f.message, hint=f.hint,
+                    symbol=f.symbol,
+                ))
+
+    # pragma filter over every anchor file — the engine's one grammar AND
+    # one suppression pass (engine.apply_pragmas)
+    sources: Dict[str, str] = {}
+    for path in sorted({f.path for f in raw} | anchor_paths):
+        full = path if os.path.isabs(path) else os.path.join(root, path)
+        try:
+            with open(full, encoding="utf-8") as fh:
+                sources[path] = fh.read()
+        except OSError:
+            pass
+    pragma_maps = {p: _collect_pragmas(src) for p, src in sources.items()}
+    site_maps = {p: collect_sites(src) for p, src in sources.items()}
+    kept, result.suppressed, credited = apply_pragmas(
+        raw, pragma_maps, site_maps
+    )
+    # stale C-pragmas: sites naming only C-rules, in files this run
+    # anchored findings/pragma lookups at, that suppressed nothing
+    for path, sites in site_maps.items():
+        for site in sites:
+            if (path, site.line) in credited:
+                continue
+            ids = site.rules - {"*"}
+            if not ids or not ids <= set(ALL_CHECK_RULES):
+                continue
+            result.stale_pragmas.append(
+                (path, site.line, ",".join(sorted(site.rules)))
+            )
+    result.stale_pragmas.sort()
+    result.findings = sorted(
+        kept, key=lambda f: (f.path, f.line, f.col, f.rule)
+    )
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+        new, known, stale = apply_baseline(result.findings, baseline)
+        result.findings = new
+        result.baselined = known
+        result.stale = stale
+    return result
+
+
+# ---------------------------------------------------------------------------
+# CLI: ``keystone-tpu check``
+# ---------------------------------------------------------------------------
+
+def render_check_json(result: CheckResult) -> str:
+    from keystone_tpu.analysis.reporters import finding_dict
+
+    return json.dumps({
+        "new": [finding_dict(f) for f in result.findings],
+        "baselined": [finding_dict(f) for f in result.baselined],
+        "stale": result.stale,
+        "stale_pragmas": [
+            {"path": p, "line": l, "rules": r}
+            for p, l, r in result.stale_pragmas
+        ],
+        "suppressed": result.suppressed,
+        "targets": result.targets,
+        "errors": result.errors,
+        "total": result.total,
+    }, indent=2) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``keystone-tpu check`` — exit 0 when no new findings, 1 when new
+    findings exist, 2 on usage/build errors (the lint CLI's contract)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="keystone-tpu check",
+        description="Construction-time pipeline contract checker (rules "
+                    "C1-C5 over abstract shape/dtype/PartitionSpec "
+                    "propagation — no data, no compiles); fails only on "
+                    "findings not in the ratcheted check_baseline.json.",
+    )
+    ap.add_argument("--target", action="append", default=None,
+                    help="pipeline target (or prefix) to check; "
+                         "repeatable; default: all registered pipelines")
+    ap.add_argument("--root", default=".",
+                    help="repo root for the baseline file")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/"
+                         f"{DEFAULT_CHECK_BASELINE} when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report and fail on every "
+                         "finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(stale fingerprints are pruned) and exit 0")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered pipeline targets and exit")
+    ap.add_argument("--show-stale-pragmas", action="store_true",
+                    help="list check pragmas that suppressed nothing")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    if args.list:
+        for name in sorted(CHECK_TARGETS):
+            e = CHECK_TARGETS[name]
+            print(f"{name:12s} {e.doc}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(
+        root, DEFAULT_CHECK_BASELINE
+    )
+    use_baseline = not args.no_baseline and (
+        args.baseline is not None or os.path.exists(baseline_path)
+    )
+
+    try:
+        if args.update_baseline:
+            result = run_check(args.target, baseline_path=None, root=root)
+            if result.errors:
+                # a partial run must never rewrite the ratchet (the audit
+                # CLI's contract): an errored target's debt would be
+                # silently pruned and resurface as 'new' next run
+                print(
+                    "keystone-check: refusing --update-baseline from a "
+                    f"partial run ({len(result.errors)} error(s)); fix "
+                    "the build first", file=sys.stderr,
+                )
+                for err in result.errors:
+                    print(f"  error {err}", file=sys.stderr)
+                return 2
+            old = load_baseline(baseline_path)
+            # fingerprints embed the CONTRACT name (mnist.featurizer), not
+            # the registry target (mnist): scope debt-keeping by the
+            # contracts this run actually checked, so in-scope stale
+            # fingerprints prune and persisting ones are counted once
+            checked = set(result.contracts)
+            keep = {
+                fp: n for fp, n in old.items()
+                if _fingerprint_target(fp)
+                and _fingerprint_target(fp) not in checked
+            }
+            save_baseline(
+                baseline_path, result.findings, tool="check", keep=keep
+            )
+            pruned = (
+                set(old) - {f.fingerprint for f in result.findings}
+                - set(keep)
+            )
+            kept_note = f", {len(keep)} out-of-scope kept" if keep else ""
+            print(
+                f"keystone-check: baselined {len(result.findings)} "
+                f"findings ({result.suppressed} pragma-suppressed, "
+                f"{len(pruned)} stale fingerprint(s) pruned{kept_note}) -> "
+                f"{baseline_path}"
+            )
+            return 0
+        result = run_check(
+            args.target,
+            baseline_path=baseline_path if use_baseline else None,
+            root=root,
+        )
+    except KeyError as e:
+        print(str(e.args[0] if e.args else e), file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        sys.stdout.write(render_check_json(result))
+    else:
+        from keystone_tpu.analysis.reporters import render_text
+
+        print(render_text(
+            result, show_stale_pragmas=args.show_stale_pragmas,
+            label="keystone-check", unit="pipeline targets",
+        ))
+    if result.errors:
+        return 2
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

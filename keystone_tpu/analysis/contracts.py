@@ -1,0 +1,543 @@
+"""Pipeline contracts: abstract (shape, dtype, PartitionSpec) interfaces
+for every pipeline node, and ONE shared propagation pass over Chain/DAG
+graphs.
+
+KeystoneML's headline feature was *typed* pipelines — ``Transformer[A,B]``
+chains whose mis-compositions fail at Scala compile time
+(``pipelines/Transformer.scala:16``).  The JAX port lost that guarantee:
+a rank- or dtype-mismatched chain only fails deep inside a jitted dispatch,
+after minutes of data loading.  This module restores the static layer:
+
+- :class:`NodeContract` — a node's declared abstract interface: an
+  ``accepts`` validator over the input aval (rank/dtype/dim), an ``out``
+  abstract-transfer function for nodes ``jax.eval_shape`` cannot handle
+  (host nodes, data-dependent sampling), an optional required input
+  :class:`~jax.sharding.PartitionSpec`, and an ``in_template`` — the
+  canonical abstract input that makes *construction-time* checking
+  possible with no sample in hand.  Nodes declare one via a
+  ``__contract__(self)`` method; undeclared nodes are inferred through
+  ``jax.eval_shape`` over ``apply_batch``.
+
+- :func:`propagate` — the single propagation pass that walks a pipeline's
+  stage graph carrying (aval, PartitionSpec) through every node.  BOTH the
+  checker (``check.py`` rules C1–C5) and the planner
+  (``core/plan.py::pipeline_costs``) consume it, so the two can never
+  disagree about a stage's abstract output.
+
+- Construction-site capture + fail-fast: ``chain()``/``dag()``
+  (``core/pipeline.py``) record their caller's ``file:line`` here and,
+  under ``KEYSTONE_CHECK`` (auto: definite rank/dtype mis-compositions;
+  1: every finding), run :func:`construction_check` — a mis-chained
+  pipeline is rejected *before any data loads or anything compiles*
+  (``jax.eval_shape`` traces abstractly; it never lowers).
+
+Everything is lazy-importing: the module itself stays importable without
+initializing a jax backend.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "NodeContract",
+    "ContractIssue",
+    "ContractViolation",
+    "StageRecord",
+    "contract_of",
+    "stage_list",
+    "propagate",
+    "propagate_pipeline",
+    "abstract_out",
+    "record_site",
+    "site_of",
+    "maybe_check_construction",
+]
+
+
+# ---------------------------------------------------------------------------
+# Declared contracts
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ContractIssue:
+    """One contract failure. ``kind`` classifies it:
+
+    - ``"rank"`` / ``"dtype"``  — template-invariant mis-compositions (a
+      rank-2 tensor where rank-3 descriptors are required): definite bugs,
+      safe to fail fast on even when propagating from a canonical
+      ``in_template`` whose absolute dims are made up.
+    - ``"dim"``  — an exact-size mismatch: definite under a REAL sample
+      spec, but a template artifact under a canonical one (the template's
+      H×W is arbitrary), so construction-time ``auto`` mode does not raise
+      on it.
+    - ``"uneval"`` — the stage cannot be abstractly evaluated at all
+      (data-dependent output shape, host-only node without a declared
+      contract): the C5 family.
+    """
+
+    kind: str
+    message: str
+
+
+@dataclass(frozen=True)
+class NodeContract:
+    """A node's declared abstract interface (see module docstring).
+
+    ``accepts(in_aval) -> Optional[ContractIssue]`` validates the input
+    aval; ``out(in_aval) -> out_aval`` replaces ``jax.eval_shape`` for
+    nodes that cannot be abstractly traced; ``in_template`` is a canonical
+    abstract input (leading item axis 1) enabling construction-time
+    checks; ``in_spec`` is the input PartitionSpec the node requires
+    (conflicts with the committed spec are C2 findings); ``allow_f64``
+    opts the node's output out of the C4 precision rule."""
+
+    accepts: Optional[Callable[[Any], Optional[ContractIssue]]] = None
+    out: Optional[Callable[[Any], Any]] = None
+    in_template: Optional[Callable[[], Any]] = None
+    in_spec: Optional[Any] = None
+    allow_f64: bool = False
+
+
+def contract_of(node: Any) -> Optional[NodeContract]:
+    """The node's declared :class:`NodeContract`, or None (inferred via
+    ``jax.eval_shape``)."""
+    fn = getattr(type(node), "__contract__", None)
+    if fn is None:
+        return None
+    try:
+        return node.__contract__()
+    except Exception:
+        return None
+
+
+# -- small helpers contract declarations share ------------------------------
+
+def spec_struct(*shape, dtype="float32"):
+    """A ``jax.ShapeDtypeStruct`` without importing jax at module scope."""
+    import jax
+    import numpy as np
+
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+def leading_leaf(aval: Any):
+    """First array-like leaf of an aval pytree (None when there is none)."""
+    import jax
+
+    for l in jax.tree_util.tree_leaves(aval):
+        if hasattr(l, "shape") and hasattr(l, "dtype"):
+            return l
+    return None
+
+
+def expect_rank(aval: Any, ranks: Sequence[int],
+                what: str) -> Optional[ContractIssue]:
+    leaf = leading_leaf(aval)
+    if leaf is None:
+        return ContractIssue("uneval", f"no array input for {what}")
+    if len(leaf.shape) not in ranks:
+        want = "/".join(str(r) for r in ranks)
+        return ContractIssue(
+            "rank",
+            f"expects rank-{want} {what}, got rank-{len(leaf.shape)} "
+            f"{_fmt(leaf)}",
+        )
+    return None
+
+
+def expect_floating(aval: Any, what: str) -> Optional[ContractIssue]:
+    import numpy as np
+
+    leaf = leading_leaf(aval)
+    if leaf is not None and not np.issubdtype(np.dtype(leaf.dtype),
+                                              np.floating):
+        return ContractIssue(
+            "dtype", f"expects floating-point {what}, got {leaf.dtype}"
+        )
+    return None
+
+
+def expect_last_dim(aval: Any, dim: int, what: str) -> Optional[ContractIssue]:
+    leaf = leading_leaf(aval)
+    if leaf is not None and leaf.shape and int(leaf.shape[-1]) != int(dim):
+        return ContractIssue(
+            "dim",
+            f"expects last dim {dim} ({what}), got {_fmt(leaf)}",
+        )
+    return None
+
+
+def _fmt(leaf) -> str:
+    import numpy as np
+
+    try:
+        dt = np.dtype(leaf.dtype)
+        code = f"{dt.kind}{dt.itemsize * 8}"
+    except Exception:
+        code = str(getattr(leaf, "dtype", "?"))
+    return f"{code}[{','.join(str(s) for s in leaf.shape)}]"
+
+
+def format_aval(aval: Any) -> str:
+    """Human form of an aval pytree (first leaf; '?' when opaque)."""
+    leaf = leading_leaf(aval)
+    return _fmt(leaf) if leaf is not None else "?"
+
+
+# ---------------------------------------------------------------------------
+# Stage graphs (shared with core/plan.py)
+# ---------------------------------------------------------------------------
+
+def stage_list(pipe) -> Tuple[List[Tuple[Any, Tuple[int, ...]]], List[int]]:
+    """(stages, hand_cache_hints): (node, dep indices) per stage in
+    topological order (dep ``-1`` = the pipeline input; Chains are linear
+    DAGs), plus the indices whose output a HAND ``Cacher`` marked.
+
+    ``Cacher`` stages are materialization markers, not computation — they
+    are stripped (the planner re-decides them from cost; the checker must
+    name real producer/consumer stages, not markers) and surface as reuse
+    hints on their producing stage.  THE one stage-graph extraction both
+    ``check.py`` and ``core/plan.py::pipeline_costs`` consume."""
+    from keystone_tpu.core.pipeline import DAG, Cacher, Chain
+
+    if isinstance(pipe, DAG):
+        return list(zip(pipe.nodes, pipe.deps)), list(pipe.cache_after)
+    if isinstance(pipe, Chain):
+        stages: List[Tuple[Any, Tuple[int, ...]]] = []
+        hints: List[int] = []
+        for s in pipe.stages:
+            if isinstance(s, Cacher):
+                if stages:
+                    hints.append(len(stages) - 1)
+                continue
+            stages.append((s, (len(stages) - 1,)))
+        return stages, hints
+    return [(pipe, (-1,))], []
+
+
+@dataclass
+class StageRecord:
+    """One stage's propagated abstract state. ``out_aval`` is None when the
+    stage could not be evaluated (``issue`` then classifies why — C1
+    mismatch vs C5 un-evaluable); ``in_aval`` is None when a producer
+    already failed (the failure is reported once, at its source)."""
+
+    index: int
+    node: Any
+    deps: Tuple[int, ...]
+    name: str
+    in_aval: Any = None
+    out_aval: Any = None
+    in_spec: Any = None
+    out_spec: Any = None
+    issue: Optional[ContractIssue] = None
+    declared: bool = False
+
+
+def _node_name(node: Any) -> str:
+    from keystone_tpu.core.pipeline import _stage_name
+
+    return _stage_name(node)
+
+
+#: jax exception names that mean "needs concrete values", not "wrong shape"
+_UNEVAL_ERRORS = (
+    "ConcretizationTypeError",
+    "TracerArrayConversionError",
+    "TracerBoolConversionError",
+    "TracerIntegerConversionError",
+    "UnexpectedTracerError",
+)
+
+
+def _classify_exception(exc: BaseException) -> ContractIssue:
+    name = type(exc).__name__
+    msg = str(exc).split("\n")[0][:200]
+    for cls in type(exc).__mro__:
+        if cls.__name__ in _UNEVAL_ERRORS:
+            return ContractIssue("uneval", f"{name}: {msg}")
+    if isinstance(exc, (TypeError, ValueError, IndexError)):
+        # shape/dtype logic errors out of the abstract trace: the stage IS
+        # evaluable, its input is just wrong — a chain mismatch
+        return ContractIssue("dim", f"{name}: {msg}")
+    return ContractIssue("uneval", f"{name}: {msg}")
+
+
+def abstract_out(node: Any, in_aval: Any) -> Tuple[Any, Optional[ContractIssue]]:
+    """(out_aval, issue): one node's abstract transfer — declared
+    ``accepts``/``out`` first, ``jax.eval_shape`` over ``apply_batch``
+    otherwise.  Exactly one of the pair is None."""
+    import jax
+
+    from keystone_tpu.core.pipeline import Cacher
+
+    if isinstance(node, Cacher):
+        return in_aval, None  # identity marker; eval_shape would sync
+    contract = contract_of(node)
+    if contract is not None and contract.accepts is not None:
+        issue = contract.accepts(in_aval)
+        if issue is not None:
+            return None, issue
+    if contract is not None and contract.out is not None:
+        try:
+            return contract.out(in_aval), None
+        except Exception as exc:
+            return None, _classify_exception(exc)
+    try:
+        return jax.eval_shape(
+            lambda n, a: n.apply_batch(a), node, in_aval
+        ), None
+    except Exception as exc:
+        issue = _classify_exception(exc)
+        if not getattr(node, "jittable", True):
+            # a host node eval_shape cannot see and nobody declared:
+            # the planner's cost table silently degrades on these —
+            # surface it as the C5 family instead
+            issue = ContractIssue(
+                "uneval",
+                f"host node with no declared __contract__ "
+                f"({issue.message})",
+            )
+        return None, issue
+
+
+def _propagate_spec(in_aval, out_aval, in_spec):
+    """Committed-PartitionSpec propagation: a stage that preserves the
+    leading (item) axis keeps the input's row sharding; anything else
+    (reductions, global reshapes) drops to None (unknown/replicated)."""
+    if in_spec is None:
+        return None
+    a, b = leading_leaf(in_aval), leading_leaf(out_aval)
+    if a is None or b is None or not a.shape or not b.shape:
+        return None
+    return in_spec if int(a.shape[0]) == int(b.shape[0]) else None
+
+
+def propagate(
+    stages: Sequence[Tuple[Any, Tuple[int, ...]]],
+    sample: Any,
+    spec: Any = None,
+) -> List[StageRecord]:
+    """THE shared propagation pass: walk ``stages`` (from
+    :func:`stage_list`) carrying (aval, PartitionSpec) from ``sample``
+    through every node.  Never runs the pipeline, never compiles.
+
+    ``sample`` may be concrete arrays or ``jax.ShapeDtypeStruct``\\s —
+    only shapes/dtypes are read.  ``spec`` is the committed input
+    PartitionSpec (None = uncommitted: the C2 rule stays quiet)."""
+    avals: Dict[int, Any] = {-1: _aval_of(sample)}
+    specs: Dict[int, Any] = {-1: spec}
+    records: List[StageRecord] = []
+    for i, (node, deps) in enumerate(stages):
+        ins = [avals.get(d) for d in deps]
+        rec = StageRecord(
+            index=i, node=node, deps=tuple(deps), name=_node_name(node),
+            declared=contract_of(node) is not None,
+        )
+        if any(a is None for a in ins):
+            # a producer already failed: blocked, not separately reported
+            avals[i] = None
+            specs[i] = None
+            records.append(rec)
+            continue
+        in_aval = ins[0] if len(ins) == 1 else tuple(ins)
+        rec.in_aval = in_aval
+        rec.in_spec = specs.get(deps[0]) if deps else None
+        rec.out_aval, rec.issue = abstract_out(node, in_aval)
+        rec.out_spec = _propagate_spec(in_aval, rec.out_aval, rec.in_spec)
+        avals[i] = rec.out_aval
+        specs[i] = rec.out_spec
+        records.append(rec)
+    return records
+
+
+def propagate_pipeline(pipe, sample: Any, spec: Any = None) -> List[StageRecord]:
+    """:func:`propagate` over a Chain/DAG/bare node's stage graph."""
+    stages, _ = stage_list(pipe)
+    return propagate(stages, sample, spec)
+
+
+def _aval_of(tree: Any):
+    """Shape/dtype skeleton of a (possibly concrete) pytree — THE one
+    implementation (the planner reads avals through :func:`propagate`)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype)
+        if hasattr(l, "shape") and hasattr(l, "dtype") else l,
+        tree,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Construction sites (chain()/dag() callers)
+# ---------------------------------------------------------------------------
+
+#: id(pipe) -> ((path, line), finalizer) — a side table, NOT a node field:
+#: adding a static field to Chain/DAG would change every pipeline's pytree
+#: treedef (jit cache keys, stage fingerprints) for a purely diagnostic
+#: attribute.  RLock, not Lock: a GC pass during the guarded block can run
+#: a finalizer (_drop_site) on the SAME thread.
+_SITES: Dict[int, Tuple[Tuple[str, int], Any]] = {}
+_SITES_LOCK = threading.RLock()
+
+
+def _drop_site(key: int) -> None:
+    with _SITES_LOCK:
+        _SITES.pop(key, None)
+
+
+_SELF_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _caller_site() -> Optional[Tuple[str, int]]:
+    """(file, line) of the nearest stack frame outside core/pipeline.py and
+    this module — where the user composed the pipeline."""
+    here = (
+        os.path.join("core", "pipeline.py"),
+        os.path.join("analysis", "contracts.py"),
+    )
+    frame = sys._getframe(1)
+    for _ in range(32):
+        if frame is None:
+            return None
+        fn = frame.f_code.co_filename
+        if not fn.endswith(here) and "importlib" not in fn:
+            return fn, frame.f_lineno
+        frame = frame.f_back
+    return None
+
+
+def record_site(pipe: Any) -> Optional[Tuple[str, int]]:
+    """Capture and remember the construction site of a freshly built
+    Chain/DAG (called by ``chain()``/``dag()``)."""
+    site = _caller_site()
+    if site is None:
+        return None
+    key = id(pipe)
+    try:
+        fin = weakref.finalize(pipe, _drop_site, key)
+    except TypeError:
+        fin = None  # not weakref-able: keep the entry (bounded below)
+    with _SITES_LOCK:
+        _SITES[key] = (site, fin)
+        if len(_SITES) > 4096:
+            # runaway guard for UN-finalizable objects only: finalizable
+            # entries are evicted by their weakref when the pipeline dies,
+            # so a long-lived process legitimately holding thousands of
+            # live pipelines must not lose their anchors (pragmas at the
+            # real construction line would silently stop suppressing).
+            # Snapshot the items: finalizers/other threads mutate the dict.
+            stuck = [
+                k for k, (_, f) in list(_SITES.items()) if f is None
+            ][:1024]
+            for k in stuck:
+                _SITES.pop(k, None)
+    return site
+
+
+def site_of(pipe: Any) -> Optional[Tuple[str, int]]:
+    with _SITES_LOCK:
+        entry = _SITES.get(id(pipe))
+    return entry[0] if entry else None
+
+
+# ---------------------------------------------------------------------------
+# Construction-time fail-fast (the KEYSTONE_CHECK wiring)
+# ---------------------------------------------------------------------------
+
+class ContractViolation(TypeError):
+    """A pipeline composition rejected at construction time.  Carries the
+    findings (``check.py`` Finding objects) that triggered it."""
+
+    def __init__(self, message: str, findings=()):
+        super().__init__(message)
+        self.findings = list(findings)
+
+
+def check_mode() -> str:
+    """``KEYSTONE_CHECK``: '0' (off), 'auto' (default — reject definite
+    rank/dtype mis-compositions at construction), '1' (strict — reject
+    every construction-time finding, including template-derived dim
+    mismatches and C4/C5)."""
+    from keystone_tpu.utils import knobs
+
+    return knobs.get("KEYSTONE_CHECK")
+
+
+def maybe_check_construction(pipe, site: Optional[Tuple[str, int]]) -> None:
+    """Run the construction-time contract check on a freshly composed
+    Chain/DAG when ``KEYSTONE_CHECK`` asks for it.
+
+    With no sample in hand, propagation starts at the earliest stage
+    declaring an ``in_template``; chains with no templated stage are a
+    no-op (the CLI registry check covers them with real sample specs).
+    ``auto`` raises only on template-invariant C1 findings (rank/dtype);
+    ``1`` raises on any finding.  Checker bugs must never take a pipeline
+    down: unexpected errors are swallowed (the CLI pass reports them)."""
+    mode = check_mode()
+    if mode == "0":
+        return
+    try:
+        findings = construction_findings(pipe, site, strict=(mode == "1"))
+    except ContractViolation:
+        raise
+    except Exception:
+        return
+    if findings:
+        lines = [f.format(hints=False) for f in findings]
+        raise ContractViolation(
+            "pipeline contract violation at construction time "
+            f"(KEYSTONE_CHECK={mode}):\n  " + "\n  ".join(lines)
+            + "\n  (set KEYSTONE_CHECK=0 to disable construction-time "
+              "checking)",
+            findings,
+        )
+
+
+def construction_findings(pipe, site=None, strict: bool = False):
+    """The construction-time finding set for a composed pipeline: propagate
+    from the earliest ``in_template``-declaring stage and keep the
+    findings that are definite with a made-up template — C1 rank/dtype
+    mismatches — plus, under ``strict``, everything else the C-rules see.
+    Returns ``check.py`` Finding objects ([] when nothing checkable)."""
+    from keystone_tpu.analysis.check import pipeline_findings
+
+    stages, _ = stage_list(pipe)
+    start, template = None, None
+    for i, (node, deps) in enumerate(stages):
+        contract = contract_of(node)
+        if contract is not None and contract.in_template is not None:
+            try:
+                template = contract.in_template()
+            except Exception:
+                continue
+            start = i
+            break
+    if start is None:
+        return []
+    # The template stands in for stage ``start``'s input, so suffix deps
+    # rebase by ``start``: the template stage's producer (or, at start=0,
+    # the pipeline input) becomes -1. A suffix stage reaching FURTHER back
+    # — an earlier branch, or the raw input when start>0 — has no aval to
+    # propagate, so the whole construction pass bails conservatively (the
+    # CLI registry pass with a real sample covers such graphs; a template
+    # on a mid-DAG node therefore buys construction coverage only for
+    # linear suffixes).
+    suffix = [
+        (node, tuple(d - start for d in deps))
+        for node, deps in stages[start:]
+    ]
+    if any(d < -1 for _, deps in suffix for d in deps):
+        return []
+    records = propagate(suffix, template)
+    findings = pipeline_findings(
+        records, name=_node_name(pipe), site=site, from_template=not strict,
+    )
+    return findings

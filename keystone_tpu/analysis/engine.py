@@ -47,7 +47,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 PRAGMA_RE = re.compile(r"lint:\s*disable(?:=([A-Za-z0-9_,\- ]+))?")
 
 #: rule ids a bare disable pragma (no ``=<rules>`` part) expands to
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
 
 
 # ---------------------------------------------------------------------------
@@ -161,6 +161,35 @@ def _collect_pragmas(source: str) -> Dict[int, Set[str]]:
         for line in site.covered:
             pragmas.setdefault(line, set()).update(site.rules)
     return pragmas
+
+
+def apply_pragmas(
+    findings: Sequence[Finding],
+    pragma_maps: Dict[str, Dict[int, Set[str]]],
+    site_maps: Dict[str, List[PragmaSite]],
+) -> Tuple[List[Finding], int, Dict[Tuple[str, int], int]]:
+    """THE pragma-suppression pass every engine shares (lint over parsed
+    modules, check over construction-site files): filter ``findings``
+    through per-file pragma maps, crediting each site whose coverage AND
+    rule set fired.  Returns ``(kept, suppressed_count, credited)`` with
+    ``credited`` keyed ``(path, line)`` — the unit the stale-pragma
+    detectors check against."""
+    credited: Dict[Tuple[str, int], int] = {}
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        disabled = pragma_maps.get(f.path, {}).get(f.line, set())
+        if "*" in disabled or f.rule in disabled:
+            suppressed += 1
+            for site in site_maps.get(f.path, ()):
+                if f.line in site.covered and (
+                    "*" in site.rules or f.rule in site.rules
+                ):
+                    key = (f.path, site.line)
+                    credited[key] = credited.get(key, 0) + 1
+        else:
+            kept.append(f)
+    return kept, suppressed, credited
 
 
 class ModuleInfo:
@@ -444,22 +473,11 @@ class LintEngine:
         for rule in rules:
             raw.extend(rule.run(ctx))
 
-        kept: List[Finding] = []
-        credited: Dict[Tuple[str, int], int] = {}
-        for f in raw:
-            mod = modules.get(f.path)
-            disabled = mod.suppressed_rules(f.line) if mod else set()
-            if "*" in disabled or f.rule in disabled:
-                result.suppressed += 1
-                # credit every site whose coverage + rule set fired here
-                for site in mod.pragma_sites:
-                    if f.line in site.covered and (
-                        "*" in site.rules or f.rule in site.rules
-                    ):
-                        key = (f.path, site.line)
-                        credited[key] = credited.get(key, 0) + 1
-            else:
-                kept.append(f)
+        kept, result.suppressed, credited = apply_pragmas(
+            raw,
+            {rel: mod.pragmas for rel, mod in modules.items()},
+            {rel: mod.pragma_sites for rel, mod in modules.items()},
+        )
         # stale pragmas (the unused-noqa analog): sites that suppressed
         # nothing, restricted to rule ids this run actually executed — a
         # pragma for a rule family another engine owns (the A-rules of

@@ -197,6 +197,32 @@ def _build_ring_gram(devices) -> Built:
     )
 
 
+@register("overlap.tiled_psum", "overlap", min_devices=2)
+def _build_tiled_psum(devices) -> Built:
+    """Standalone tiled reduce-scatter reduction (the CountSketch
+    partials' schedule, ``overlap.py::tiled_psum``): k per-tile
+    reduce-scatters, one trailing all-gather, no all-reduce."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel.overlap import tiled_psum
+
+    mesh = _data_mesh(devices)
+    k = mesh.shape["data"]
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("data", None, None)
+    f = jax.shard_map(
+        lambda xi: tiled_psum(xi[0], "data")[None],
+        mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
+    )
+    x = jnp.asarray(_f32(_rng(), k, 16 * k, 5))
+    return Built(
+        fn=f, args=(x,), k=k,
+        expect=dict(reduce_scatter_min="k", all_gather_max=1),
+    )
+
+
 # -- solver ladder rungs -----------------------------------------------------
 
 @register("solver.normal_equations", "solver", min_devices=2)
@@ -274,6 +300,44 @@ def _build_sketch(devices) -> Built:
         ),
         args=(A, b), k=1,
         expect=dict(),
+    )
+
+
+@register("solver.countsketch_reduce", "solver", min_devices=2)
+def _build_countsketch_reduce(devices) -> Built:
+    """CountSketch cross-shard reduction (``linalg/sketch.py::
+    sketch_matrix`` under a committed row-sharded mesh, overlap live):
+    the (S·A, S·b) partials ride the tiled reduce-scatter schedule —
+    per-tile reduce-scatters, at most two trailing all-gathers (one per
+    pair member), zero all-reduce; f32 end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.linalg.sketch import sketch_matrix
+
+    mesh = _data_mesh(devices)
+    k = mesh.shape["data"]
+    rng = _rng()
+    A = jax.device_put(
+        jnp.asarray(_f32(rng, 16 * k, 16)),
+        NamedSharding(mesh, P("data", None)),
+    )
+    b = jax.device_put(
+        jnp.asarray(_f32(rng, 16 * k, 3)),
+        NamedSharding(mesh, P("data", None)),
+    )
+    m = 8 * k  # sketch rows: tiled per shard by construction
+
+    def fn(A_, b_):
+        return sketch_matrix(
+            A_, m, 7, y=b_, kind="countsketch", mesh=mesh, omesh=mesh,
+        )
+
+    return Built(
+        fn=fn, args=(A, b), k=k,
+        expect=dict(reduce_scatter_min="k", all_gather_max=2),
     )
 
 
@@ -735,7 +799,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         print(render_text(
             result, show_stale_pragmas=args.show_stale_pragmas,
-            label="keystone-audit",
+            label="keystone-audit", unit="entry points",
         ))
         for name, reason in sorted(result.skipped.items()):
             print(f"skipped {name}: {reason}")

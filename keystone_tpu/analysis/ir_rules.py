@@ -208,6 +208,32 @@ def check_paired_permutes(
     return problems
 
 
+def check_permute_count(
+    hlo_text: str, exact: Optional[int] = None, min_count: int = 0,
+) -> List[str]:
+    """Exact (or floor) pin on the number of ``collective-permute``
+    instructions — the tight form of the ring-schedule structure pins
+    (``2·⌊(k-1)/2⌋ + 1`` for the bidirectional ring at odd/even k)."""
+    n = collective_counts(hlo_text)["collective-permute"]
+    problems = []
+    if exact is not None and n != exact:
+        problems.append(
+            f"expected exactly {exact} collective-permutes, found {n}"
+        )
+    if n < min_count:
+        problems.append(
+            f"expected >= {min_count} collective-permutes, found {n}"
+        )
+    return problems
+
+
+def assert_permute_count(
+    hlo_text: str, exact: Optional[int] = None, min_count: int = 0,
+) -> None:
+    """Test-suite form of :func:`check_permute_count`."""
+    _raise_if(check_permute_count(hlo_text, exact, min_count), hlo_text)
+
+
 def reduce_scatter_groups(hlo_text: str) -> List[List[FrozenSet[int]]]:
     """Per reduce-scatter instruction: its ``replica_groups`` as a list of
     member sets."""

@@ -14,6 +14,7 @@ def render_text(
     hints: bool = True,
     show_stale_pragmas: bool = False,
     label: str = "keystone-lint",
+    unit: str = "files",
 ) -> str:
     """New findings as ``path:line:col: RULE message`` lines — the triple
     terminals hyperlink — plus a one-line summary the CI log greps."""
@@ -48,8 +49,7 @@ def render_text(
     summary = (
         f"{label}: {len(result.findings)} new, "
         f"{len(result.baselined)} baselined, {result.suppressed} "
-        f"pragma-suppressed across {result.files} "
-        f"{'entry points' if label == 'keystone-audit' else 'files'}"
+        f"pragma-suppressed across {result.files} {unit}"
     )
     lines.append(("" if not lines else "\n") + summary)
     return "\n".join(lines)

@@ -997,6 +997,75 @@ class UnboundedHbmStage(Rule):
         return out
 
 
+class DeadKnob(Rule):
+    """A knob declared in ``utils/knobs.py`` that NO module ever reads —
+    the inverse of R4 (which catches reads outside the registry, this
+    catches registry entries without readers).  A dead declaration is
+    worse than noise: the README advertises a control that silently does
+    nothing.
+
+    "Read" is approximated as any string literal equal to the knob's name
+    anywhere outside ``knobs.py`` itself — that covers ``knobs.get(...)``
+    / ``get_raw`` / ``is_set``, the bench's subprocess env *production*
+    (``env["BENCH_X"] = "0"`` keeps a knob alive: a knob exists for its
+    writers too), and name-via-module-constant indirection.  Approximate
+    in the direction of silence, like R1-R6."""
+
+    id = "R7"
+    title = "dead-knob"
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        declared = ctx.declared_knobs()
+        knobs_rel = next(
+            (rel for rel in ctx.modules
+             if rel.replace(os.sep, "/").endswith("utils/knobs.py")), None
+        )
+        if knobs_rel is None or not declared:
+            return []  # fixture trees without the registry in scope
+        if not self._full_scope(ctx):
+            # a path-subset run (`lint keystone_tpu/utils`) cannot see the
+            # readers living outside the subset — every live knob would be
+            # flagged dead. Deadness is only decidable over the FULL
+            # default lint scope; skip silently otherwise.
+            return []
+        referenced: set = set()
+        for rel, mod in ctx.modules.items():
+            if rel == knobs_rel:
+                continue
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value in declared
+                ):
+                    referenced.add(node.value)
+        out: List[Finding] = []
+        for knob, line in sorted(declared.items()):
+            if knob in referenced:
+                continue
+            out.append(Finding(
+                rule=self.id, path=knobs_rel, line=line, col=0,
+                message=f"declared knob `{knob}` is never read by any "
+                        f"module (dead knob)",
+                hint="wire it to a knobs.get()/get_raw() call site or "
+                     "delete the declaration and its README row (the "
+                     "inverse of R4)",
+                symbol=f"dead:{knob}",
+            ))
+        return out
+
+    @staticmethod
+    def _full_scope(ctx: LintContext) -> bool:
+        """Whether this run covers every file of the default lint scope
+        (the knob readers' universe: the package + bench.py + scripts)."""
+        from keystone_tpu.analysis.cli import default_paths
+        from keystone_tpu.analysis.engine import discover_files
+
+        wanted = discover_files(ctx.root, default_paths(ctx.root))
+        have = {mod.path for mod in ctx.modules.values()}
+        return set(wanted) <= have
+
+
 def default_rules() -> List[Rule]:
     return [
         HostSyncInHotPath(),
@@ -1005,4 +1074,5 @@ def default_rules() -> List[Rule]:
         KnobHygiene(),
         SharedStateLock(),
         UnboundedHbmStage(),
+        DeadKnob(),
     ]

@@ -103,6 +103,16 @@ def emit_host_commands(hosts, rest, devices_per_host: int = 4,
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # Fail-fast env validation: a typo'd KEYSTONE_*/BENCH_* value dies HERE
+    # with the knob-named message, instead of being silently ignored (or
+    # exploding mid-run at whichever code path first reads it).
+    from keystone_tpu.utils import knobs
+
+    try:
+        knobs.validate_environment()
+    except ValueError as e:
+        print(f"invalid environment: {e}", file=sys.stderr)
+        return 2
     if argv and argv[0] == "telemetry-report":
         # ``keystone-tpu telemetry-report [path]``: pretty-print a telemetry
         # artifact (bench_telemetry.json / telemetry_metrics.json) — the
@@ -128,6 +138,16 @@ def main(argv=None) -> int:
 
         ensure_cpu_devices()
         return audit_main(argv[1:])
+    if argv and argv[0] == "check":
+        # ``keystone-tpu check [--target X]``: the construction-time
+        # pipeline contract checker (keystone_tpu/analysis/check.py) —
+        # propagates (shape, dtype, PartitionSpec) through the registered
+        # pipeline graphs pre-dispatch (no data, no compiles) and runs
+        # rules C1-C5; exits non-zero only for findings not in the
+        # ratcheted check_baseline.json.
+        from keystone_tpu.analysis.check import main as check_main
+
+        return check_main(argv[1:])
     if argv and argv[0] == "plan":
         # ``keystone-tpu plan <target>``: the cost-based whole-pipeline
         # planner's decision table (core/plan.py) — cache tiers, fused
@@ -145,6 +165,8 @@ def main(argv=None) -> int:
             "       run-pipeline telemetry-report [path] [--top N]\n"
             "       run-pipeline lint [paths] [--update-baseline]\n"
             "       run-pipeline audit [--target ENTRY] [--list] "
+            "[--update-baseline]\n"
+            "       run-pipeline check [--target PIPELINE] [--list] "
             "[--update-baseline]\n"
             "       run-pipeline plan <toy|imagenet|voc> [--mode M] "
             "[--budget-mb N] [--json PATH]\n\n"

@@ -453,7 +453,12 @@ def _run_segment(segment: Sequence[Node], data: Any) -> Any:
 
 
 def chain(*nodes: Any) -> Chain:
-    """Compose nodes, flattening nested chains."""
+    """Compose nodes, flattening nested chains.
+
+    Under ``KEYSTONE_CHECK`` (auto, the default) the composed chain is
+    contract-checked HERE — a definite rank/dtype mis-composition raises
+    :class:`~keystone_tpu.analysis.contracts.ContractViolation` before any
+    data loads or anything compiles (``analysis/contracts.py``)."""
     flat: list = []
     for n in nodes:
         if isinstance(n, Chain):
@@ -462,7 +467,18 @@ def chain(*nodes: Any) -> Chain:
             if not isinstance(n, Node):
                 raise TypeError(f"cannot chain non-Node {type(n).__name__}")
             flat.append(n)
-    return Chain(stages=tuple(flat))
+    c = Chain(stages=tuple(flat))
+    _register_construction(c)
+    return c
+
+
+def _register_construction(pipe: "Node") -> None:
+    """Record the construction site (the checker's finding anchor) and run
+    the ``KEYSTONE_CHECK`` construction-time contract pass."""
+    from keystone_tpu.analysis import contracts
+
+    site = contracts.record_site(pipe)
+    contracts.maybe_check_construction(pipe, site)
 
 
 class Merge(Transformer):
@@ -760,8 +776,10 @@ def dag(nodes: Sequence[Node], deps: Sequence[Sequence[int]],
     for i in sorted(cache_after):
         if not (0 <= i < len(nodes)):
             raise ValueError(f"dag cache_after index {i} out of range")
-    return DAG(nodes=nodes, deps=deps,
-               cache_after=tuple(sorted(cache_after)))
+    d = DAG(nodes=nodes, deps=deps,
+            cache_after=tuple(sorted(cache_after)))
+    _register_construction(d)
+    return d
 
 
 def chain_to_dag(c: Chain) -> DAG:

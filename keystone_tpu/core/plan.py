@@ -170,17 +170,6 @@ class StageCost:
         return dataclasses.asdict(self)
 
 
-def _aval_of(tree: Any):
-    """Shape/dtype skeleton of a (possibly concrete) pytree."""
-    import jax
-
-    return jax.tree_util.tree_map(
-        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype)
-        if hasattr(l, "shape") and hasattr(l, "dtype") else l,
-        tree,
-    )
-
-
 def _tree_bytes(aval: Any) -> int:
     import jax
     import numpy as np
@@ -199,30 +188,16 @@ def _tree_bytes(aval: Any) -> int:
 
 
 def _stage_list(pipe) -> Tuple[List[Tuple[Any, Tuple[int, ...]]], List[int]]:
-    """(stages, hand_cache_hints): (node, dep indices) per stage in
-    topological order (dep -1 = the pipeline input; Chains are linear
-    DAGs), plus the indices whose output a HAND cache point marked.
-
+    """(stages, hand_cache_hints) — delegated to the ONE stage-graph
+    extraction the checker shares (``analysis/contracts.py::stage_list``):
     ``Cacher`` stages are materialization markers, not computation — they
     are stripped from the cost table (otherwise their non-jittable
     boundary would bake the hand segmentation into the very decisions
     meant to replace it) and surface instead as reuse hints on their
     producing stage, for the planner to re-decide from cost."""
-    from keystone_tpu.core.pipeline import DAG, Cacher, Chain
+    from keystone_tpu.analysis.contracts import stage_list
 
-    if isinstance(pipe, DAG):
-        return list(zip(pipe.nodes, pipe.deps)), list(pipe.cache_after)
-    if isinstance(pipe, Chain):
-        stages: List[Tuple[Any, Tuple[int, ...]]] = []
-        hints: List[int] = []
-        for s in pipe.stages:
-            if isinstance(s, Cacher):
-                if stages:
-                    hints.append(len(stages) - 1)
-                continue
-            stages.append((s, (len(stages) - 1,)))
-        return stages, hints
-    return [(pipe, (-1,))], []
+    return stage_list(pipe)
 
 
 def _consumer_counts(stages) -> List[int]:
@@ -283,6 +258,8 @@ def pipeline_costs(pipe, sample: Any, mode: Optional[str] = None,
     from keystone_tpu import telemetry
     from keystone_tpu.core.pipeline import Cacher, _jit_apply_batch, _stage_name
 
+    from keystone_tpu.analysis.contracts import propagate
+
     mode = mode or optimizer_mode()
     profiled = _profile_index() if mode == "profile" else {}
     gflops, gbs = _device_roofline()
@@ -294,27 +271,23 @@ def pipeline_costs(pipe, sample: Any, mode: Optional[str] = None,
         # still decline to materialize (the 'replacing hand-placed
         # Cachers' contract)
         consumers[i] += 1
-    avals: Dict[int, Any] = {-1: _aval_of(sample)}
+    # THE shared propagation pass (analysis/contracts.py): the checker's
+    # C-rules and this cost table read the SAME per-stage abstract outputs
+    # (declared __contract__ transfers included), so planner and checker
+    # can never disagree — a stage the pass cannot evaluate degrades this
+    # table to bounded=False AND surfaces as a C5 finding in `keystone-tpu
+    # check`.
+    records = propagate(stages, sample)
     costs: List[StageCost] = []
-    for i, (node, deps) in enumerate(stages):
-        ins = [avals.get(d) for d in deps]
-        in_aval = ins[0] if len(ins) == 1 else tuple(ins)
+    for rec in records:
+        i, node, deps = rec.index, rec.node, rec.deps
         fp = telemetry.stage_fingerprint(node)
-        unbounded = any(a is None for a in ins)
-        out_aval = None
-        if not unbounded:
-            if isinstance(node, Cacher):
-                out_aval = in_aval  # identity marker; eval_shape would sync
-            else:
-                try:
-                    out_aval = jax.eval_shape(
-                        lambda n, a: n.apply_batch(a), node, in_aval
-                    )
-                except Exception as exc:
-                    logger.debug("plan: eval_shape of %s failed: %s",
-                                 _stage_name(node), exc)
-        avals[i] = out_aval
-        in_bytes = _tree_bytes(in_aval) if not unbounded else 0
+        in_aval = rec.in_aval
+        out_aval = rec.out_aval
+        if rec.issue is not None:
+            logger.debug("plan: abstract eval of %s failed: %s",
+                         _stage_name(node), rec.issue.message)
+        in_bytes = _tree_bytes(in_aval) if in_aval is not None else 0
         out_bytes = _tree_bytes(out_aval) if out_aval is not None else 0
         flops = bytes_accessed = 0.0
         if with_flops and out_aval is not None and node.jittable \

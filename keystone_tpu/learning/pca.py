@@ -44,6 +44,17 @@ class PCATransformer(Transformer):
 
     pca_mat: jax.Array  # (d, dims)
 
+    def __contract__(self):
+        from keystone_tpu.analysis import contracts as C
+
+        d = int(self.pca_mat.shape[0])
+        return C.NodeContract(
+            accepts=lambda a: C.expect_last_dim(
+                a, d, "the PCA input dimension"
+            ),
+            in_template=lambda: C.spec_struct(1, d),
+        )
+
     def apply(self, x):
         return x @ self.pca_mat
 
@@ -55,6 +66,18 @@ class BatchPCATransformer(Transformer):
     is an (n_desc, d) matrix -> (n_desc, dims)."""
 
     pca_mat: jax.Array
+
+    def __contract__(self):
+        from keystone_tpu.analysis import contracts as C
+
+        d = int(self.pca_mat.shape[0])
+        return C.NodeContract(
+            accepts=lambda a: (
+                C.expect_rank(a, (2, 3), "descriptor batch (n, n_desc, d)")
+                or C.expect_last_dim(a, d, "the PCA input dimension")
+            ),
+            in_template=lambda: C.spec_struct(1, 8, d),
+        )
 
     def apply(self, mat):
         return mat @ self.pca_mat

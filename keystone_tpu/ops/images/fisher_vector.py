@@ -44,6 +44,22 @@ from keystone_tpu.ops.pallas.moments import _affine_params
 class FisherVector(Transformer):
     gmm: GaussianMixtureModel
 
+    def __contract__(self):
+        """The acceptance-critical contract: FV encode consumes rank-3
+        (n, n_desc, d) descriptor batches whose trailing dim is the GMM's —
+        a flattened/mis-ranked producer is a C1 at chain construction."""
+        from keystone_tpu.analysis import contracts as C
+
+        d = int(self.gmm.means.shape[1])
+        return C.NodeContract(
+            accepts=lambda a: (
+                C.expect_rank(a, (3,), "descriptor batch (n, n_desc, d)")
+                or C.expect_floating(a, "descriptors")
+                or C.expect_last_dim(a, d, "the GMM dimension")
+            ),
+            in_template=lambda: C.spec_struct(1, 8, d),
+        )
+
     def apply(self, descriptors):
         """(n_desc, d) -> (d, 2k). Delegates to :func:`_fv_cols` (the full
         column range) so the dense and sliced/streaming paths share one

@@ -37,6 +37,19 @@ class LCSExtractor(Transformer):
         s = self.sub_patch_size
         return np.arange(-2 * s + s // 2 - 1, s + s // 2, s)  # e.g. [-10,-4,2,8]
 
+    def __contract__(self):
+        from keystone_tpu.analysis import contracts as C
+
+        # a frame that admits at least a few keypoint rows at this stride
+        hw = max(64, 2 * self.stride_start + 4 * self.stride)
+        return C.NodeContract(
+            accepts=lambda a: (
+                C.expect_rank(a, (4,), "color image batch (n, H, W, C)")
+                or C.expect_floating(a, "images")
+            ),
+            in_template=lambda: C.spec_struct(1, hw, hw, 3),
+        )
+
     def apply(self, img):
         """(H, W, C) -> (num_keypoints, C·16·2)."""
         return self.apply_batch(img[None])[0]

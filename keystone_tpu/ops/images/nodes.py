@@ -28,6 +28,17 @@ class GrayScaler(Transformer):
 
     channel_order: str = struct.field(pytree_node=False, default="rgb")
 
+    def __contract__(self):
+        from keystone_tpu.analysis import contracts as C
+
+        return C.NodeContract(
+            accepts=lambda a: (
+                C.expect_rank(a, (4,), "image batch (n, H, W, C)")
+                or C.expect_floating(a, "images")
+            ),
+            in_template=lambda: C.spec_struct(1, 64, 64, 3),
+        )
+
     def apply(self, img):
         from keystone_tpu.ops.images.image_utils import to_grayscale
 

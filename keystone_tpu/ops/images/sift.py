@@ -260,6 +260,21 @@ class SIFTExtractor(Transformer):
     scales: int = struct.field(pytree_node=False, default=4)
     scale_step: int = struct.field(pytree_node=False, default=1)
 
+    def __contract__(self):
+        """Declared contract (``analysis/contracts.py``): rank-3/4 floating
+        image batches in; the template's 64² frame admits every default
+        scale ladder, and the 128-dim descriptor output is H/W-invariant."""
+        from keystone_tpu.analysis import contracts as C
+
+        return C.NodeContract(
+            accepts=lambda a: (
+                C.expect_rank(a, (3, 4),
+                              "grayscale image batch (n, H, W[, C])")
+                or C.expect_floating(a, "images")
+            ),
+            in_template=lambda: C.spec_struct(1, 64, 64),
+        )
+
     def num_descriptors(self, height: int, width: int) -> int:
         total = 0
         for s in range(self.scales):

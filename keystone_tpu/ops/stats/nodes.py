@@ -36,6 +36,17 @@ class RandomSignNode(Transformer):
 
     signs: jax.Array
 
+    def __contract__(self):
+        from keystone_tpu.analysis import contracts as C
+
+        d = int(self.signs.shape[0])
+        return C.NodeContract(
+            accepts=lambda a: C.expect_last_dim(
+                a, d, "the sign-vector width"
+            ),
+            in_template=lambda: C.spec_struct(1, d),
+        )
+
     def apply(self, x):
         return x * self.signs
 
@@ -98,6 +109,18 @@ class CosineRandomFeatures(Transformer):
     w: jax.Array  # (num_output, num_input)
     b: jax.Array  # (num_output,)
 
+    def __contract__(self):
+        from keystone_tpu.analysis import contracts as C
+
+        d = int(self.w.shape[1])
+        return C.NodeContract(
+            accepts=lambda a: (
+                C.expect_rank(a, (2,), "feature batch (n, d)")
+                or C.expect_last_dim(a, d, "the random-feature input dim")
+            ),
+            in_template=lambda: C.spec_struct(1, d),
+        )
+
     def apply(self, x):
         return jnp.cos(x @ self.w.T + self.b)
 
@@ -140,6 +163,30 @@ class ColumnSampler(FunctionNode):
     num_samples: int = struct.field(pytree_node=False)
     seed: int = struct.field(pytree_node=False, default=42)
 
+    def __contract__(self):
+        """Host node with a DECLARED abstract transfer: the sample size is
+        min(num_samples, total descriptors) — data-independent, so the
+        checker's propagation (and the planner's cost table) see through
+        what ``jax.eval_shape`` cannot."""
+        from keystone_tpu.analysis import contracts as C
+
+        def out(a):
+            leaf = C.leading_leaf(a)
+            total = 1
+            for s in leaf.shape[:-1]:
+                total *= int(s)
+            return C.spec_struct(
+                min(int(self.num_samples), total), int(leaf.shape[-1]),
+                dtype=leaf.dtype,
+            )
+
+        return C.NodeContract(
+            accepts=lambda a: C.expect_rank(
+                a, (2, 3), "descriptor batch (n[, n_desc], d)"
+            ),
+            out=out,
+        )
+
     def apply_batch(self, descs):
         if isinstance(descs, jax.Array):
             # Stay on device: pulling a (n·n_desc, d) descriptor tensor to the
@@ -169,6 +216,21 @@ class Sampler(FunctionNode):
     jittable: ClassVar[bool] = False
     size: int = struct.field(pytree_node=False)
     seed: int = struct.field(pytree_node=False, default=42)
+
+    def __contract__(self):
+        from keystone_tpu.analysis import contracts as C
+
+        def out(a):
+            leaf = C.leading_leaf(a)
+            return C.spec_struct(
+                min(int(self.size), int(leaf.shape[0])), *leaf.shape[1:],
+                dtype=leaf.dtype,
+            )
+
+        return C.NodeContract(
+            accepts=lambda a: C.expect_rank(a, (2,), "row batch (n, d)"),
+            out=out,
+        )
 
     def apply_batch(self, xs):
         n = xs.shape[0]

@@ -972,6 +972,68 @@ def small_config(**overrides) -> ImageNetSiftLcsFVConfig:
     return ImageNetSiftLcsFVConfig(**cfg)
 
 
+def check_graph():
+    """Pipeline contracts for `keystone-tpu check`: the two-branch
+    descriptor-reduction DAG (gray → SIFT → Hellinger → PCA zipped with
+    LCS → PCA over the SAME input images — the streaming path's per-chunk
+    compiled unit), plus the weighted-solver fit/apply pair.  PCA mats are
+    zero placeholders: the checker reads shapes, never weights."""
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.analysis.check import FitApply, PipelineContract
+    from keystone_tpu.core.pipeline import ConcatFeatures, Transformer, dag
+    from keystone_tpu.learning.pca import BatchPCATransformer
+    from keystone_tpu.ops.stats import BatchSignedHellingerMapper
+
+    config = small_config()
+    hw = 64  # contract dims: the layout, not the flagship scale
+    sift = SIFTExtractor()
+    lcs = LCSExtractor(config.lcs_stride, config.lcs_border, config.lcs_patch)
+    squeeze = Transformer.from_fn(lambda im: im[..., 0], name="squeeze_gray")
+    spec = jax.ShapeDtypeStruct((1, hw, hw, 3), jnp.float32)
+    d_sift = jax.eval_shape(
+        lambda im: sift.apply_batch(squeeze.apply_batch(
+            GrayScaler().apply_batch(im))), spec
+    ).shape[-1]
+    d_lcs = jax.eval_shape(lcs.apply_batch, spec).shape[-1]
+    pipe = dag(
+        [
+            GrayScaler(), squeeze, sift, BatchSignedHellingerMapper(),
+            BatchPCATransformer(
+                pca_mat=jnp.zeros((d_sift, config.sift_pca_dim), jnp.float32)
+            ),
+            lcs,
+            BatchPCATransformer(
+                pca_mat=jnp.zeros((d_lcs, config.lcs_pca_dim), jnp.float32)
+            ),
+            ConcatFeatures(axis=1),
+        ],
+        [(-1,), (0,), (1,), (2,), (3,), (-1,), (5,), (4, 6)],
+    )
+    sample = jax.ShapeDtypeStruct((2, hw, hw, 3), jnp.float32)
+    # the fit/apply pair is the DAG's own reduced-descriptor interface
+    # (what the FV encode + weighted solver consume), derived by two
+    # INDEPENDENT traces at train-chunk vs test-chunk batch sizes — the
+    # production streaming fit and eval paths share these branch nodes,
+    # so C3 here guards batch-dependent shape logic
+    return [PipelineContract(
+        name="imagenet.descriptor_dag",
+        pipe=pipe,
+        sample=sample,
+        spec=P("data", None, None, None),
+        fit_apply=[FitApply(
+            "weighted_block_solver",
+            fit_aval=jax.eval_shape(pipe.apply_batch, sample),
+            apply_aval=jax.eval_shape(
+                pipe.apply_batch,
+                jax.ShapeDtypeStruct((1, hw, hw, 3), jnp.float32),
+            ),
+        )],
+    )]
+
+
 def _run_bucketed(config: ImageNetSiftLcsFVConfig) -> dict:
     """Variable-size ingest: both branches (SIFT on gray, LCS on RGB) over
     size-bucketed image groups — per-bucket static shapes, no global resize

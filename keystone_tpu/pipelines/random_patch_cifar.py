@@ -43,6 +43,45 @@ class RandomPatchCifarConfig:
     synthetic_test: int = 2000
 
 
+def check_graph():
+    """Pipeline contracts for `keystone-tpu check`: the conv featurizer
+    (Convolver → SymmetricRectifier → Pooler → ImageVectorizer) over the
+    CIFAR image layout — filter weights are zero placeholders, the checker
+    reads shapes only — plus the solver fit/apply pair."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.analysis.check import FitApply, PipelineContract
+
+    config = RandomPatchCifarConfig(num_filters=8)
+    filters = jnp.zeros(
+        (config.num_filters, config.patch_size * config.patch_size * 3),
+        jnp.float32,
+    )
+    featurizer = conv_featurizer(
+        filters, None, config.alpha, config.pool_stride, config.pool_size
+    )
+    sample = jax.ShapeDtypeStruct((4, 32, 32, 3), jnp.float32)
+    # independent traces of the featurizer at fit vs eval batch sizes
+    # (the production predict path reuses the same chain; C3 guards
+    # batch-dependent shape logic)
+    return [PipelineContract(
+        name="cifar.conv_featurizer",
+        pipe=featurizer,
+        sample=sample,
+        spec=P("data", None, None, None),
+        fit_apply=[FitApply(
+            "block_least_squares",
+            fit_aval=jax.eval_shape(featurizer.apply_batch, sample),
+            apply_aval=jax.eval_shape(
+                featurizer.apply_batch,
+                jax.ShapeDtypeStruct((2, 32, 32, 3), jnp.float32),
+            ),
+        )],
+    )]
+
+
 def run(config: RandomPatchCifarConfig) -> dict:
     if config.train_location:
         train = load_cifar_binary(config.train_location)

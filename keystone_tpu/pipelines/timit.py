@@ -62,6 +62,45 @@ class TimitConfig:
     cache_grams: bool = True
 
 
+def check_graph():
+    """Pipeline contracts for `keystone-tpu check`: one cosine-random-
+    feature batch chain (rf → standard scaler, the unit the streaming
+    solver consumes 50 of) over the TIMIT frame layout, plus the
+    streaming-solver fit/apply pair."""
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.analysis.check import FitApply, PipelineContract
+    from keystone_tpu.ops.stats.scaler import StandardScalerModel
+
+    width = 64  # representative batch width; the layout, not the scale
+    rf = CosineRandomFeatures.create(
+        TIMIT_DIMENSION, width, 0.0555, jax.random.key(0)
+    )
+    scaler = StandardScalerModel(
+        mean=jnp.zeros((width,), jnp.float32),
+        std=jnp.ones((width,), jnp.float32),
+    )
+    pipe = chain(rf, scaler)
+    sample = jax.ShapeDtypeStruct((64, TIMIT_DIMENSION), jnp.float32)
+    # independent traces at fit vs eval batch sizes (the streaming solver
+    # and the eval pass consume the same feature_nodes; C3 guards
+    # batch-dependent shape logic)
+    return [PipelineContract(
+        name="timit.feature_batch",
+        pipe=pipe,
+        sample=sample,
+        spec=P("data", None),
+        fit_apply=[FitApply(
+            "streaming_block_least_squares",
+            fit_aval=jax.eval_shape(pipe.apply_batch, sample),
+            apply_aval=jax.eval_shape(
+                pipe.apply_batch,
+                jax.ShapeDtypeStruct((32, TIMIT_DIMENSION), jnp.float32),
+            ),
+        )],
+    )]
+
+
 def run(config: TimitConfig) -> dict:
     if config.train_data_location:
         train = load_timit(config.train_data_location, config.train_labels_location)

@@ -105,6 +105,53 @@ def small_config(**overrides) -> VOCSIFTFisherConfig:
     return VOCSIFTFisherConfig(**cfg)
 
 
+def check_graph():
+    """Pipeline contracts for `keystone-tpu check`: the full VOC branch —
+    gray → squeeze → SIFT → PCA → FV encode → normalize — at contract
+    dims (PCA/GMM weights are zero placeholders; only shapes propagate),
+    plus the block-solver fit/apply pair."""
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.analysis.check import FitApply, PipelineContract
+    from keystone_tpu.core.pipeline import Transformer, chain as _chain
+    from keystone_tpu.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.learning.pca import BatchPCATransformer
+    from keystone_tpu.pipelines._fisher import fisher_featurizer
+
+    desc_dim, vocab = 16, 4
+    gmm = GaussianMixtureModel(
+        means=jnp.zeros((vocab, desc_dim), jnp.float32),
+        variances=jnp.ones((vocab, desc_dim), jnp.float32),
+        weights=jnp.ones((vocab,), jnp.float32) / vocab,
+    )
+    squeeze = Transformer.from_fn(lambda im: im[..., 0], name="squeeze_gray")
+    pipe = _chain(
+        GrayScaler(), squeeze, SIFTExtractor(scales=2),
+        BatchPCATransformer(pca_mat=jnp.zeros((128, desc_dim), jnp.float32)),
+        fisher_featurizer(gmm),
+    )
+    sample = jax.ShapeDtypeStruct((2, 64, 64, 3), jnp.float32)
+    # independent traces of the fitted featurizer at train vs test batch
+    # sizes (the eval path calls the SAME featurizer chain; C3 guards
+    # batch-dependent shape logic)
+    return [PipelineContract(
+        name="voc.fisher_branch",
+        pipe=pipe,
+        sample=sample,
+        spec=P("data", None, None, None),
+        fit_apply=[FitApply(
+            "block_least_squares",
+            fit_aval=jax.eval_shape(pipe.apply_batch, sample),
+            apply_aval=jax.eval_shape(
+                pipe.apply_batch,
+                jax.ShapeDtypeStruct((1, 64, 64, 3), jnp.float32),
+            ),
+        )],
+    )]
+
+
 def parse_buckets(s: str):
     """``"128x128,192x256"`` -> ``[(128, 128), (192, 256)]``."""
     out = []

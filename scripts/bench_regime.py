@@ -536,6 +536,14 @@ _REGIMES = {
 
 
 def main():
+    # Fail-fast env validation (the bench.py contract): a typo'd
+    # KEYSTONE_*/BENCH_* value dies here with the knob-named message
+    # instead of being silently ignored (or exploding) mid-regime.
+    try:
+        knobs.validate_environment()
+    except ValueError as e:
+        print(f"invalid environment: {e}", file=sys.stderr)
+        return 2
     if len(sys.argv) != 2 or sys.argv[1] not in _REGIMES:
         print(f"usage: bench_regime.py {{{'|'.join(_REGIMES)}}}",
               file=sys.stderr)

@@ -1,0 +1,96 @@
+"""Pipeline-contract checker smoke (< 20 s): the contract `make
+verify-fast` rides.
+
+Asserts, end to end through the REAL CLI code path:
+
+1. every registered pipeline target builds and checks CLEAN against the
+   committed (empty) ``check_baseline.json`` — zero new findings, zero
+   build errors, rc=0;
+2. the JSON output schema holds (the keys bench.py and the tests read);
+3. a deliberately mis-chained pipeline (rank mismatch between SIFT
+   extraction and FV encode) is REJECTED at construction time — zero data
+   loaded, zero compiles — with both stages named;
+4. the whole pass stays under the 20 s budget (pre-dispatch abstract
+   evaluation must stay cheap enough to run on every CI loop).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import time
+from contextlib import redirect_stdout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BUDGET_S = 20.0
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    os.chdir(REPO)
+
+    from keystone_tpu.analysis.check import CHECK_TARGETS, main as check_main
+
+    # 1 + 2: all registered targets, JSON schema, rc=0 vs the committed
+    # baseline
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = check_main(["--format", "json", "--root", REPO])
+    payload = json.loads(buf.getvalue())
+    assert rc == 0, f"keystone-tpu check rc={rc}: {payload}"
+    for key in ("new", "baselined", "suppressed", "targets", "errors",
+                "total"):
+        assert key in payload, f"missing JSON key {key}"
+    assert payload["new"] == [], payload["new"]
+    assert payload["errors"] == [], payload["errors"]
+    expected = {"mnist", "cifar", "timit", "voc", "imagenet"}
+    assert expected <= set(payload["targets"]), (
+        f"registry lost a pipeline: {payload['targets']}"
+    )
+    assert expected <= set(CHECK_TARGETS)
+
+    # 3: the acceptance scenario — a rank mismatch inserted between SIFT
+    # extraction and FV encode must be rejected AT CONSTRUCTION
+    import jax.numpy as jnp
+
+    from keystone_tpu.analysis.contracts import ContractViolation
+    from keystone_tpu.core.pipeline import chain
+    from keystone_tpu.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.images import SIFTExtractor
+    from keystone_tpu.ops.images.fisher_vector import FisherVector
+    from keystone_tpu.ops.util import MatrixVectorizer
+
+    gmm = GaussianMixtureModel(
+        means=jnp.zeros((4, 16), jnp.float32),
+        variances=jnp.ones((4, 16), jnp.float32),
+        weights=jnp.ones((4,), jnp.float32) / 4,
+    )
+    try:
+        chain(SIFTExtractor(), MatrixVectorizer(), FisherVector(gmm=gmm))
+    except ContractViolation as e:
+        msg = str(e)
+        assert "MatrixVectorizer" in msg and "FisherVector" in msg, msg
+        assert e.findings and e.findings[0].rule == "C1"
+    else:
+        raise AssertionError(
+            "mis-chained SIFT->vectorize->FV was NOT rejected at "
+            "construction"
+        )
+
+    elapsed = time.monotonic() - t0
+    assert elapsed < BUDGET_S, (
+        f"check smoke took {elapsed:.1f}s (budget {BUDGET_S}s)"
+    )
+    print(
+        f"check-smoke OK: {len(payload['targets'])} targets clean, "
+        f"mis-chain rejected at construction, {elapsed:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

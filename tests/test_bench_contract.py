@@ -81,6 +81,13 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     assert full["plan_replans"] == 0
     assert full["plan_est_peak_hbm_gb"] >= 0
     assert compact["plan_replans"] == 0
+    # pipeline-contract hygiene rows (keystone_tpu/analysis/check.py): all
+    # registered targets checked, zero new findings, and the compact line
+    # carries the series
+    assert full["check_new"] == 0
+    assert full["check_findings_total"] >= 0
+    assert full["check_targets"] >= 5
+    assert compact["check"] == full["check_findings_total"]
     # structured-telemetry contract: telemetry_* keys in the COMPACT line,
     # non-zero span/counter headcounts, and a loadable artifact whose
     # Chrome trace is Perfetto-shaped
@@ -138,6 +145,9 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # ... and the IR-audit section (PR 9): same reduced-floor contract
     assert full.get("audit_skipped") == "budget"
     assert "audit_findings_total" not in full
+    # ... and the pipeline-contract section: same reduced-floor contract
+    assert full.get("check_skipped") == "budget"
+    assert "check_findings_total" not in full
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

@@ -1,0 +1,623 @@
+"""keystone-check (keystone_tpu/analysis/check.py + contracts.py): the
+construction-time pipeline contract checker.
+
+Covers: per-rule positive/negative fixtures (C1–C5), construction-site
+line anchoring, the KEYSTONE_CHECK fail-fast wiring (the acceptance
+scenario: a rank mismatch inserted between SIFT extraction and FV encode
+is rejected at ``chain()`` time with both stages named — zero data, zero
+compiles), pragma + baseline ratchet round trip, CLI exit codes/JSON, the
+all-five-pipelines-check-clean invariant against the committed (empty)
+``check_baseline.json``, and the checker-vs-planner propagation-parity
+pin (``core/plan.py::pipeline_costs`` consumes the SAME pass).
+"""
+
+import inspect
+import io
+import json
+import logging
+import os
+import sys
+from contextlib import redirect_stdout
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import keystone_tpu._compat  # noqa: F401  (jax.enable_x64 shim)
+from keystone_tpu.analysis import check as checkmod
+from keystone_tpu.analysis.check import (
+    CheckEntry,
+    FitApply,
+    PipelineContract,
+    check_pipeline,
+    fit_apply_findings,
+    run_check,
+)
+from keystone_tpu.analysis.contracts import (
+    ContractViolation,
+    NodeContract,
+    propagate_pipeline,
+)
+from keystone_tpu.analysis.engine import save_baseline
+from keystone_tpu.core.pipeline import FunctionNode, Transformer, chain
+from keystone_tpu.learning.gmm import GaussianMixtureModel
+from keystone_tpu.learning.pca import BatchPCATransformer
+from keystone_tpu.ops.images import SIFTExtractor
+from keystone_tpu.ops.images.fisher_vector import FisherVector
+from keystone_tpu.ops.util import MatrixVectorizer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+THIS_FILE = os.path.abspath(__file__)
+
+
+def _gmm(k=4, d=16):
+    return GaussianMixtureModel(
+        means=jnp.zeros((k, d), jnp.float32),
+        variances=jnp.ones((k, d), jnp.float32),
+        weights=jnp.ones((k,), jnp.float32) / k,
+    )
+
+
+@pytest.fixture
+def no_construction_check(monkeypatch):
+    """Build deliberately-broken pipelines without tripping the fail-fast
+    wiring (the unit tests exercise the checker on the finished graph)."""
+    monkeypatch.setenv("KEYSTONE_CHECK", "0")
+
+
+# ---------------------------------------------------------------------------
+# C1: chain mismatch, named stages, construction-site anchoring
+# ---------------------------------------------------------------------------
+
+def test_c1_rank_mismatch_names_both_stages(no_construction_check):
+    site_line = inspect.currentframe().f_lineno + 1
+    pipe = chain(SIFTExtractor(), MatrixVectorizer(), FisherVector(gmm=_gmm()))
+    findings = check_pipeline(PipelineContract(
+        name="fx", pipe=pipe,
+        sample=jax.ShapeDtypeStruct((2, 64, 64), jnp.float32),
+    ))
+    c1 = [f for f in findings if f.rule == "C1"]
+    assert len(c1) == 1, findings
+    # BOTH stages named: the producer and the rejecting consumer
+    assert "MatrixVectorizer" in c1[0].message
+    assert "FisherVector" in c1[0].message
+    assert "rank" in c1[0].message
+    # anchored at the chain() construction site in THIS file
+    assert c1[0].path == THIS_FILE
+    assert c1[0].line == site_line
+    # line-drift-immune fingerprint names both stages too
+    assert "MatrixVectorizer>FisherVector" in c1[0].fingerprint
+
+
+def test_c1_dim_mismatch_flagged_and_good_chain_clean(no_construction_check):
+    # wrong PCA width into FV (dim-kind mismatch: definite under a REAL
+    # sample spec)
+    bad = chain(
+        SIFTExtractor(),
+        BatchPCATransformer(pca_mat=jnp.zeros((128, 8), jnp.float32)),
+        FisherVector(gmm=_gmm(d=16)),
+    )
+    findings = check_pipeline(PipelineContract(
+        name="fx", pipe=bad,
+        sample=jax.ShapeDtypeStruct((2, 64, 64), jnp.float32),
+    ))
+    assert [f.rule for f in findings] == ["C1"]
+    assert "last dim 16" in findings[0].message
+    good = chain(
+        SIFTExtractor(),
+        BatchPCATransformer(pca_mat=jnp.zeros((128, 16), jnp.float32)),
+        FisherVector(gmm=_gmm(d=16)),
+    )
+    assert check_pipeline(PipelineContract(
+        name="fx", pipe=good,
+        sample=jax.ShapeDtypeStruct((2, 64, 64), jnp.float32),
+    )) == []
+
+
+def test_c1_blocked_downstream_reported_once(no_construction_check):
+    """A failure is reported at its source; stages downstream of it are
+    blocked, not separately flagged."""
+    pipe = chain(
+        SIFTExtractor(), MatrixVectorizer(), FisherVector(gmm=_gmm()),
+        MatrixVectorizer(),
+    )
+    findings = check_pipeline(PipelineContract(
+        name="fx", pipe=pipe,
+        sample=jax.ShapeDtypeStruct((2, 64, 64), jnp.float32),
+    ))
+    assert len([f for f in findings if f.rule == "C1"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# The fail-fast wiring (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+def test_mischained_pipeline_rejected_at_construction(monkeypatch, caplog):
+    """THE acceptance pin: a rank mismatch inserted between SIFT
+    extraction and FV encode raises at ``chain()`` time under the default
+    KEYSTONE_CHECK=auto — both stages named, zero compiles (the abstract
+    trace never lowers), zero data loaded (only zero-weight nodes
+    exist)."""
+    monkeypatch.delenv("KEYSTONE_CHECK", raising=False)
+    jax.config.update("jax_log_compiles", True)
+    try:
+        with caplog.at_level(logging.DEBUG, logger="jax"):
+            with pytest.raises(ContractViolation) as e:
+                chain(
+                    SIFTExtractor(), MatrixVectorizer(),
+                    FisherVector(gmm=_gmm()),
+                )
+    finally:
+        jax.config.update("jax_log_compiles", False)
+    msg = str(e.value)
+    assert "MatrixVectorizer" in msg and "FisherVector" in msg
+    assert e.value.findings[0].rule == "C1"
+    # the construction site is THIS file (the finding anchor)
+    assert e.value.findings[0].path == THIS_FILE
+    # zero compiles: nothing was lowered to the backend
+    compiled = [r for r in caplog.records if "compil" in r.message.lower()]
+    assert compiled == [], compiled
+
+
+def test_check_off_and_good_chains_unaffected(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_CHECK", "0")
+    pipe = chain(SIFTExtractor(), MatrixVectorizer(), FisherVector(gmm=_gmm()))
+    assert pipe is not None  # no raise with checking off
+    monkeypatch.delenv("KEYSTONE_CHECK")
+    # a well-typed chain constructs fine under auto
+    good = chain(
+        SIFTExtractor(),
+        BatchPCATransformer(pca_mat=jnp.zeros((128, 16), jnp.float32)),
+        FisherVector(gmm=_gmm(d=16)),
+    )
+    assert good is not None
+
+
+def test_strict_mode_raises_on_template_dim_mismatch(monkeypatch):
+    """auto tolerates exact-dim mismatches at construction (the template's
+    absolute dims are made up); KEYSTONE_CHECK=1 is the strict opt-in."""
+    monkeypatch.setenv("KEYSTONE_CHECK", "auto")
+    pipe = chain(
+        SIFTExtractor(),
+        BatchPCATransformer(pca_mat=jnp.zeros((64, 8), jnp.float32)),
+    )  # SIFT descriptors are 128-wide: a dim mismatch, not rank
+    assert pipe is not None
+    monkeypatch.setenv("KEYSTONE_CHECK", "1")
+    with pytest.raises(ContractViolation):
+        chain(
+            SIFTExtractor(),
+            BatchPCATransformer(pca_mat=jnp.zeros((64, 8), jnp.float32)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# C2: declared input-spec conflicts with the committed spec
+# ---------------------------------------------------------------------------
+
+class _RowShardedOnly(Transformer):
+    """Test node requiring row-sharded P('data', None) input."""
+
+    def __contract__(self):
+        from jax.sharding import PartitionSpec as P
+
+        return NodeContract(in_spec=P("data", None))
+
+    def apply(self, x):
+        return x
+
+
+def test_c2_spec_conflict_flagged_and_match_clean(no_construction_check):
+    from jax.sharding import PartitionSpec as P
+
+    pipe = chain(_RowShardedOnly())
+    sample = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    bad = check_pipeline(PipelineContract(
+        name="fx", pipe=pipe, sample=sample, spec=P(None, "model"),
+    ))
+    assert [f.rule for f in bad] == ["C2"]
+    assert "all-gather" in bad[0].message
+    ok = check_pipeline(PipelineContract(
+        name="fx", pipe=pipe, sample=sample, spec=P("data", None),
+    ))
+    assert ok == []
+    # trailing Nones are implicit (JAX semantics): P('data') satisfies a
+    # declared P('data', None) requirement — no false C2
+    assert check_pipeline(PipelineContract(
+        name="fx", pipe=pipe, sample=sample, spec=P("data"),
+    )) == []
+    # ...and a LONGER committed spec carried through a rank-dropping stage
+    # still matches on the named axes
+    assert check_pipeline(PipelineContract(
+        name="fx", pipe=pipe, sample=sample,
+        spec=P("data", None, None, None),
+    )) == []
+    # an uncommitted input (spec=None) cannot conflict
+    assert check_pipeline(PipelineContract(
+        name="fx", pipe=pipe, sample=sample,
+    )) == []
+
+
+def test_c2_spec_propagates_through_row_preserving_stages(
+    no_construction_check,
+):
+    """The committed spec flows through row-preserving stages and reaches
+    a deep requirement; a row-count-changing stage drops it (no false
+    positive past a reduction)."""
+    from jax.sharding import PartitionSpec as P
+
+    double = Transformer.from_fn(lambda x: x * 2.0, name="double")
+    pipe = chain(double, _RowShardedOnly())
+    sample = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    bad = check_pipeline(PipelineContract(
+        name="fx", pipe=pipe, sample=sample, spec=P(None, "model"),
+    ))
+    assert [f.rule for f in bad] == ["C2"]
+
+    class _Pool(Transformer):
+        def apply_batch(self, xs):
+            return xs.sum(axis=0, keepdims=True)
+
+        def apply(self, x):
+            return x
+
+    pooled = chain(_Pool(), _RowShardedOnly())
+    assert check_pipeline(PipelineContract(
+        name="fx", pipe=pooled, sample=sample, spec=P(None, "model"),
+    )) == []
+
+
+# ---------------------------------------------------------------------------
+# C3: estimator fit/apply asymmetry
+# ---------------------------------------------------------------------------
+
+def test_c3_fit_apply_asymmetry():
+    pairs = [FitApply(
+        "solver",
+        fit_aval=jax.ShapeDtypeStruct((64, 1024), jnp.float32),
+        apply_aval=jax.ShapeDtypeStruct((32, 512), jnp.float32),
+    )]
+    findings = fit_apply_findings(pairs, "fx")
+    assert [f.rule for f in findings] == ["C3"]
+    assert "solver" in findings[0].message
+    assert "(1024,)" in findings[0].message and "(512,)" in findings[0].message
+    # dtype asymmetry is C3 too
+    dt = fit_apply_findings([FitApply(
+        "solver",
+        fit_aval=jax.ShapeDtypeStruct((64, 512), jnp.float32),
+        apply_aval=jax.ShapeDtypeStruct((32, 512), jnp.bfloat16),
+    )], "fx")
+    assert [f.rule for f in dt] == ["C3"]
+    # symmetric layouts (any leading batch) are clean
+    assert fit_apply_findings([FitApply(
+        "solver",
+        fit_aval=jax.ShapeDtypeStruct((64, 512), jnp.float32),
+        apply_aval=jax.ShapeDtypeStruct((7, 512), jnp.float32),
+    )], "fx") == []
+
+
+# ---------------------------------------------------------------------------
+# C4: pre-dispatch f64 leaks
+# ---------------------------------------------------------------------------
+
+class _Widens(Transformer):
+    def apply(self, x):
+        return x.astype(jnp.float64)
+
+
+class _WidensAllowed(_Widens):
+    def __contract__(self):
+        return NodeContract(allow_f64=True)
+
+
+def test_c4_f64_leak_fires_pre_dispatch(no_construction_check):
+    sample = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    with jax.enable_x64():
+        bad = check_pipeline(PipelineContract(
+            name="fx", pipe=chain(_Widens()), sample=sample,
+        ))
+        allowed = check_pipeline(PipelineContract(
+            name="fx", pipe=chain(_WidensAllowed()), sample=sample,
+        ))
+    assert [f.rule for f in bad] == ["C4"]
+    assert "float64" in bad[0].message
+    assert allowed == []
+    # one leak = ONE finding, at the stage that INTRODUCES the wide dtype
+    # — downstream carriers are not re-flagged (report-once-at-source)
+    carry = Transformer.from_fn(lambda x: x * 1, name="carry")
+    with jax.enable_x64():
+        flood = check_pipeline(PipelineContract(
+            name="fx", pipe=chain(_Widens(), carry, carry),
+            sample=jax.ShapeDtypeStruct((4, 8), jnp.float32),
+        ))
+    assert len(flood) == 1, [f.message for f in flood]
+    assert "_Widens" in flood[0].message
+    # with x64 off the widening never happens — clean (the dtype the
+    # dispatch would actually see)
+    assert check_pipeline(PipelineContract(
+        name="fx", pipe=chain(_Widens()), sample=sample,
+    )) == []
+
+
+# ---------------------------------------------------------------------------
+# C5: un-evaluable stages (and the planner parity)
+# ---------------------------------------------------------------------------
+
+class _DataDependent(FunctionNode):
+    """Host node whose output shape depends on VALUES — abstractly
+    un-evaluable, and nobody declared a contract."""
+
+    jittable = False
+
+    def apply_batch(self, xs):
+        return xs[np.asarray(xs[:, 0]) > 0]
+
+
+def test_c5_unevaluable_stage_flagged_declared_host_clean(
+    no_construction_check,
+):
+    from keystone_tpu.ops.stats import ColumnSampler
+
+    sample = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    bad = check_pipeline(PipelineContract(
+        name="fx", pipe=chain(_DataDependent()), sample=sample,
+    ))
+    assert [f.rule for f in bad] == ["C5"]
+    assert "_DataDependent" in bad[0].message
+    assert "bounded=False" in bad[0].message
+    # a host node WITH a declared contract (ColumnSampler) is evaluable
+    descs = jax.ShapeDtypeStruct((4, 6, 8), jnp.float32)
+    recs = propagate_pipeline(chain(ColumnSampler(num_samples=10)), descs)
+    assert recs[0].issue is None
+    assert tuple(recs[0].out_aval.shape) == (10, 8)
+    assert check_pipeline(PipelineContract(
+        name="fx", pipe=chain(ColumnSampler(num_samples=10)), sample=descs,
+    )) == []
+
+
+def test_checker_planner_propagation_parity(no_construction_check):
+    """THE parity pin: ``pipeline_costs`` consumes the checker's
+    propagation pass, so for every stage the cost table's abstract output
+    bytes equal the checker's, and an un-evaluable stage is EXACTLY the
+    planner's unbounded stage (plan.bounded=False <-> a C5 finding)."""
+    from keystone_tpu.core import plan
+    from keystone_tpu.core.plan import _tree_bytes
+
+    pipe = chain(
+        SIFTExtractor(),
+        BatchPCATransformer(pca_mat=jnp.zeros((128, 16), jnp.float32)),
+        _DataDependent(),
+        MatrixVectorizer(),
+    )
+    sample = jax.ShapeDtypeStruct((2, 64, 64), jnp.float32)
+    records = propagate_pipeline(pipe, sample)
+    costs = plan.pipeline_costs(pipe, sample, mode="estimate",
+                                with_flops=False)
+    assert len(costs) == len(records)
+    for cost, rec in zip(costs, records):
+        if rec.out_aval is None:
+            assert cost.peak_hbm_bytes is None
+            assert cost.out_bytes == 0
+        else:
+            assert cost.out_bytes == _tree_bytes(rec.out_aval)
+    # the un-evaluable stage degrades the plan AND is the C5 finding
+    p = plan._decide(costs, "estimate", None, [], {}, "fp")
+    assert p.bounded is False
+    findings = check_pipeline(PipelineContract(
+        name="fx", pipe=pipe, sample=sample,
+    ))
+    assert [f.rule for f in findings] == ["C5"]
+
+
+# ---------------------------------------------------------------------------
+# Pragma + baseline ratchet round trip
+# ---------------------------------------------------------------------------
+
+_FIXTURE_SRC = """\
+import jax.numpy as jnp
+
+from keystone_tpu.core.pipeline import chain
+from keystone_tpu.learning.gmm import GaussianMixtureModel
+from keystone_tpu.ops.images import SIFTExtractor
+from keystone_tpu.ops.images.fisher_vector import FisherVector
+from keystone_tpu.ops.util import MatrixVectorizer
+
+gmm = GaussianMixtureModel(
+    means=jnp.zeros((4, 16), jnp.float32),
+    variances=jnp.ones((4, 16), jnp.float32),
+    weights=jnp.ones((4,), jnp.float32) / 4,
+)
+pipe = chain(SIFTExtractor(), MatrixVectorizer(), FisherVector(gmm=gmm)){pragma}
+"""
+
+
+def _fixture_registry(tmp_path, pragma=""):
+    """Exec a mis-chained fixture module from tmp_path (construction sites
+    anchor THERE) and wrap it as a one-target check registry."""
+    import jax as _jax
+
+    path = tmp_path / "fixture_pipe.py"
+    src = _FIXTURE_SRC.format(pragma=pragma)
+    path.write_text(src)
+    ns: dict = {}
+    exec(compile(src, str(path), "exec"), ns)
+    sample = _jax.ShapeDtypeStruct((2, 64, 64), jnp.float32)
+    entry = CheckEntry(
+        name="fx",
+        builder=lambda: [PipelineContract(
+            name="fx", pipe=ns["pipe"], sample=sample,
+        )],
+        path="fixture_pipe.py", line=1, doc="",
+    )
+    return {"fx": entry}
+
+
+def test_pragma_suppresses_at_construction_site(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_CHECK", "0")
+    reg = _fixture_registry(
+        tmp_path, pragma="  # lint: disable=C1 (fixture debt)"
+    )
+    result = run_check(registry=reg, root=str(tmp_path))
+    assert result.findings == []
+    assert result.suppressed == 1
+    assert result.stale_pragmas == []
+    # the same pragma for a rule that never fires there IS stale
+    reg2 = _fixture_registry(
+        tmp_path, pragma="  # lint: disable=C4 (wrong rule)"
+    )
+    result2 = run_check(registry=reg2, root=str(tmp_path))
+    assert [f.rule for f in result2.findings] == ["C1"]
+    assert result2.suppressed == 0
+    assert [(l, r) for _, l, r in result2.stale_pragmas]
+
+
+def test_stale_pragma_reported_after_finding_fixed(tmp_path, monkeypatch):
+    """The steady-state stale case: a C-pragma at a construction site whose
+    mis-composition got FIXED must still be reported (anchor files are
+    scanned for pragmas whether or not they produced findings)."""
+    monkeypatch.setenv("KEYSTONE_CHECK", "0")
+    import jax as _jax
+
+    src = """\
+import jax.numpy as jnp
+
+from keystone_tpu.core.pipeline import chain
+from keystone_tpu.learning.gmm import GaussianMixtureModel
+from keystone_tpu.learning.pca import BatchPCATransformer
+from keystone_tpu.ops.images import SIFTExtractor
+from keystone_tpu.ops.images.fisher_vector import FisherVector
+
+gmm = GaussianMixtureModel(
+    means=jnp.zeros((4, 16), jnp.float32),
+    variances=jnp.ones((4, 16), jnp.float32),
+    weights=jnp.ones((4,), jnp.float32) / 4,
+)
+pipe = chain(
+    SIFTExtractor(),
+    BatchPCATransformer(pca_mat=jnp.zeros((128, 16), jnp.float32)),
+    FisherVector(gmm=gmm),
+)  # lint: disable=C1 (was a mis-chain once; fixed since)
+"""
+    path = tmp_path / "fixture_fixed.py"
+    path.write_text(src)
+    ns: dict = {}
+    exec(compile(src, str(path), "exec"), ns)
+    reg = {"fx": CheckEntry(
+        name="fx",
+        builder=lambda: [PipelineContract(
+            name="fx", pipe=ns["pipe"],
+            sample=_jax.ShapeDtypeStruct((2, 64, 64), jnp.float32),
+        )],
+        path="fixture_fixed.py", line=1, doc="",
+    )}
+    result = run_check(registry=reg, root=str(tmp_path))
+    assert result.findings == [] and result.suppressed == 0
+    assert len(result.stale_pragmas) == 1, result.stale_pragmas
+    assert result.stale_pragmas[0][2] == "C1"
+
+
+def test_baseline_ratchet_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_CHECK", "0")
+    reg = _fixture_registry(tmp_path)
+    baseline = tmp_path / "check_baseline.json"
+    first = run_check(registry=reg, root=str(tmp_path))
+    assert [f.rule for f in first.findings] == ["C1"]
+    save_baseline(str(baseline), first.findings, tool="check")
+    # baselined now: known debt, nothing new, line drift immune
+    again = run_check(registry=reg, root=str(tmp_path),
+                      baseline_path=str(baseline))
+    assert again.findings == []
+    assert [f.rule for f in again.baselined] == ["C1"]
+    # fixing the debt surfaces the fingerprint as stale (ratchet down)
+    fixed = _fixture_registry(
+        tmp_path, pragma="  # lint: disable=C1 (fixture debt)"
+    )
+    stale = run_check(registry=fixed, root=str(tmp_path),
+                      baseline_path=str(baseline))
+    assert stale.findings == [] and stale.baselined == []
+    assert len(stale.stale) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI + the shipped-pipelines invariant
+# ---------------------------------------------------------------------------
+
+def _cli(argv):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = checkmod.main(argv)
+    return rc, buf.getvalue()
+
+
+def test_cli_json_exit_codes_and_list():
+    rc, out = _cli(["--format", "json", "--root", REPO_ROOT])
+    assert rc == 0, out
+    payload = json.loads(out)
+    assert payload["new"] == []
+    assert payload["errors"] == []
+    assert set(payload["targets"]) >= {
+        "mnist", "cifar", "timit", "voc", "imagenet"
+    }
+    rc, out = _cli(["--list"])
+    assert rc == 0 and "imagenet" in out
+    rc, _ = _cli(["--target", "nosuch", "--root", REPO_ROOT])
+    assert rc == 2
+
+
+def test_cli_update_baseline_prunes_fixed_debt(tmp_path, monkeypatch):
+    """--update-baseline must prune in-scope stale fingerprints (the
+    fingerprint embeds the CONTRACT name, not the registry target name)
+    and must not inflate persisting counts across repeated updates."""
+    monkeypatch.setenv("KEYSTONE_CHECK", "0")
+    baseline = tmp_path / "check_baseline.json"
+    reg = _fixture_registry(tmp_path)
+    monkeypatch.setattr(checkmod, "CHECK_TARGETS", reg)
+    rc, _ = _cli(["--update-baseline", "--root", str(tmp_path),
+                  "--baseline", str(baseline)])
+    assert rc == 0
+    first = json.load(open(baseline))["findings"]
+    assert len(first) == 1 and list(first.values()) == [1]
+    # a second update of the SAME debt keeps the count at 1 (no
+    # keep+re-add double counting)
+    rc, _ = _cli(["--update-baseline", "--root", str(tmp_path),
+                  "--baseline", str(baseline)])
+    assert rc == 0
+    assert json.load(open(baseline))["findings"] == first
+    # fix the mis-chain -> the fingerprint is IN scope and prunes
+    fixed = _fixture_registry(
+        tmp_path, pragma="  # lint: disable=C1 (fixture debt)"
+    )
+    monkeypatch.setattr(checkmod, "CHECK_TARGETS", fixed)
+    rc, _ = _cli(["--update-baseline", "--root", str(tmp_path),
+                  "--baseline", str(baseline)])
+    assert rc == 0
+    assert json.load(open(baseline))["findings"] == {}
+
+
+def test_cli_exits_one_on_new_findings(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_CHECK", "0")
+    reg = _fixture_registry(tmp_path)
+    monkeypatch.setattr(checkmod, "CHECK_TARGETS", reg)
+    rc, out = _cli(["--no-baseline", "--format", "json",
+                    "--root", str(tmp_path)])
+    assert rc == 1
+    payload = json.loads(out)
+    assert payload["new"][0]["rule"] == "C1"
+
+
+def test_all_five_pipelines_check_clean_against_committed_baseline():
+    """The registry-acceptance + hygiene invariant: every shipped pipeline
+    has a registered contract target, and the whole registry checks clean
+    against the committed (EMPTY) check_baseline.json — the checker ships
+    with zero debt."""
+    baseline_path = os.path.join(REPO_ROOT, "check_baseline.json")
+    assert os.path.exists(baseline_path)
+    committed = json.load(open(baseline_path))
+    assert committed["findings"] == {}  # committed EMPTY: zero debt
+    assert set(checkmod.CHECK_TARGETS) >= {
+        "mnist", "cifar", "timit", "voc", "imagenet"
+    }
+    result = run_check(root=REPO_ROOT, baseline_path=baseline_path)
+    assert result.errors == []
+    assert result.findings == [], [f.format() for f in result.findings]
+    assert result.files == len(result.targets)

@@ -58,6 +58,51 @@ def test_empty_and_unknown_names_error_cleanly():
     assert rc == 2 and "unknown pipeline" in err
 
 
+def test_cli_validates_environment_fail_fast(monkeypatch):
+    """A typo'd KEYSTONE_* value dies AT DISPATCH with the knob-named
+    message (rc=2) — every subcommand shares the gate, so a bad knob can
+    never be silently ignored mid-run."""
+    monkeypatch.setenv("KEYSTONE_OVERLAP", "yes")  # bools take '0'/'1'
+    rc, _, err = _run_capture(["--help"])
+    assert rc == 2
+    assert "KEYSTONE_OVERLAP" in err and "invalid environment" in err
+    # lint rides the same dispatch gate
+    rc, _, err = _run_capture(["lint", "--help"])
+    assert rc == 2 and "KEYSTONE_OVERLAP" in err
+    monkeypatch.delenv("KEYSTONE_OVERLAP")
+    rc, _, _ = _run_capture(["--help"])
+    assert rc == 0
+
+
+def test_bench_regime_validates_environment_fail_fast(monkeypatch):
+    """scripts/bench_regime.py shares the same fail-fast contract: an
+    invalid knob value exits 2 with the knob named, before any regime
+    imports jax or touches devices."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_regime_under_test",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "bench_regime.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setenv("KEYSTONE_SKETCH_FACTOR", "0.5")  # must be > 1
+    monkeypatch.setattr(sys, "argv", ["bench_regime.py", "flagship"])
+    err = io.StringIO()
+    old = sys.stderr
+    sys.stderr = err
+    try:
+        rc = mod.main()
+    finally:
+        sys.stderr = old
+    assert rc == 2
+    assert "KEYSTONE_SKETCH_FACTOR" in err.getvalue()
+
+
 def test_case_insensitive_name_resolves(monkeypatch):
     import importlib
 

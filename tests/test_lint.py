@@ -369,6 +369,58 @@ def test_r5_out_of_scope_module_silent(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R7: dead knobs (declared but never read — the inverse of R4)
+# ---------------------------------------------------------------------------
+
+R7_KNOBS = """
+    def declare(name, type, default, doc, **kw):
+        pass
+
+
+    declare("KEYSTONE_LIVE", "bool", False, "read below")
+    declare("KEYSTONE_DEAD", "bool", False, "nobody reads this")
+    declare("BENCH_PRODUCED", "bool", True, "only written, still alive")
+"""
+
+R7_CONSUMER = """
+    import os
+
+    from keystone_tpu.utils import knobs
+
+
+    def f(env):
+        # a knobs.get read keeps a knob alive...
+        live = knobs.get("KEYSTONE_LIVE")
+        # ...and so does env *production* (the bench's subprocess control:
+        # a knob exists for its writers too)
+        env["BENCH_PRODUCED"] = "0"
+        return live
+"""
+
+
+def test_r7_flags_declared_knob_nobody_reads(tmp_path):
+    res = lint_tree(tmp_path, {
+        "keystone_tpu/utils/knobs.py": R7_KNOBS,
+        "keystone_tpu/mod.py": R7_CONSUMER,
+    })
+    r7 = [f for f in res.findings if f.rule == "R7"]
+    assert len(r7) == 1, [(f.symbol, f.message) for f in r7]
+    assert r7[0].symbol == "dead:KEYSTONE_DEAD"
+    assert "never read" in r7[0].message
+    # anchored at the declaration line in knobs.py
+    assert r7[0].path.endswith(os.path.join("utils", "knobs.py"))
+    assert 'KEYSTONE_DEAD' in (tmp_path / r7[0].path).read_text(
+    ).splitlines()[r7[0].line - 1]
+
+
+def test_r7_silent_without_registry_in_scope(tmp_path):
+    """Fixture trees without knobs.py (every other rule's fixtures) must
+    not drown in dead-knob findings for the installed registry."""
+    res = lint_tree(tmp_path, {"keystone_tpu/mod.py": R7_CONSUMER})
+    assert [f for f in res.findings if f.rule == "R7"] == []
+
+
+# ---------------------------------------------------------------------------
 # R6: hand-set solver block sizes in pipelines (unbounded peak-HBM)
 # ---------------------------------------------------------------------------
 

@@ -172,6 +172,36 @@ def test_sketched_solve_overlap_matches(devices, rng):
     telemetry.reset()
 
 
+def test_countsketch_reduction_hlo_pins_tiled_schedule(devices, rng):
+    """Structure pin via the auditor's own helpers (ir_rules.py): the
+    committed-mesh CountSketch (S·A, S·b) reduction lowers to >= k
+    per-tile reduce-scatters, at most two trailing all-gathers (one per
+    pair member), and NO all-reduce — exactly the program
+    `keystone-tpu audit solver.countsketch_reduce` checks, so the test
+    and the auditor cannot drift apart."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.analysis.ir_rules import (
+        assert_pipelined_reduce_scatter,
+    )
+
+    mesh = make_mesh(data=8, model=1, devices=devices)
+    k = mesh.shape["data"]
+    A = jax.device_put(
+        jnp.asarray(rng.normal(size=(16 * k, 16)).astype(np.float32)),
+        NamedSharding(mesh, P("data", None)),
+    )
+    b = jax.device_put(
+        jnp.asarray(rng.normal(size=(16 * k, 3)).astype(np.float32)),
+        NamedSharding(mesh, P("data", None)),
+    )
+    f = jax.jit(lambda A_, b_: sketch_matrix(
+        A_, 8 * k, 7, y=b_, kind="countsketch", mesh=mesh, omesh=mesh,
+    ))
+    hlo = f.lower(A, b).compile().as_text()
+    assert_pipelined_reduce_scatter(hlo, k, all_gather_max=2)
+
+
 # -- convergence-tolerance contract -----------------------------------------
 
 
