@@ -364,6 +364,15 @@ declare("KEYSTONE_AUDIT_TARGETS", "str", "",
         "Comma-separated entry points (names, dotted prefixes, or "
         "categories) the IR audit pass (keystone_tpu/analysis/ir_audit.py) "
         "lowers and checks; empty = every registered entry point.")
+declare("KEYSTONE_CHECK", "str", "auto",
+        "Construction-time pipeline contract checking "
+        "(keystone_tpu/analysis/check.py) wired into the Chain/DAG "
+        "builders: 'auto' (default) rejects definite rank/dtype "
+        "mis-compositions the declared contracts can prove with no sample "
+        "in hand; '1' is strict (every construction-time finding raises, "
+        "including template-derived dim mismatches and C4/C5); '0' "
+        "disables construction-time checking (the `keystone-tpu check` "
+        "CLI still works).", choices=("auto", "0", "1"))
 declare("KEYSTONE_SKETCH_BCD", "bool", False,
         "Leverage-score block scheduling for block coordinate descent: "
         "visit feature blocks in descending sketched-energy order instead "
@@ -416,6 +425,10 @@ declare("BENCH_AUDIT", "bool", True,
         "IR-audit section: lower the registered entry points and record "
         "audit_findings_total/audit_new (budget-gated; exhaustion emits "
         "audit_skipped).")
+declare("BENCH_CHECK", "bool", True,
+        "Pipeline-contract section: run `keystone-tpu check` over the "
+        "registered pipeline targets and record check_findings_total/"
+        "check_new (budget-gated; exhaustion emits check_skipped).")
 declare("BENCH_PLAN", "bool", True,
         "Whole-pipeline-optimizer section (core/plan.py): plan the "
         "flagship DAG under the HBM budget and record plan_* decision "
