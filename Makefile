@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -71,6 +71,13 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/plan_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/audit_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/precision_smoke.py
+
+# Precision-tier contract (<20 s): f32 tier byte-identical to the prior
+# program, bf16 parity within the documented envelope, and the bf16-sketch
+# -> f32-CG composition restoring accuracy (scripts/precision_smoke.py).
+precision-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/precision_smoke.py
 
 # Tiny traced pipeline -> counters non-zero, Chrome trace well-formed,
 # telemetry-report renders (scripts/telemetry_smoke.py); CPU, seconds.

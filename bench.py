@@ -1103,6 +1103,128 @@ def _try_plan_rows() -> dict:
         return {"plan_block_size": None}
 
 
+def _try_precision_rows() -> dict:
+    """Precision-tier evidence rows (``KEYSTONE_PRECISION_TIER``, PR 11):
+    the bf16-storage/f32-accumulate gram and sketch rungs against their f32
+    twins, at the SAME shape under the SAME latency-cancelled protocol —
+    and every speed key PAIRED with a ``*_vs_f32_error_delta`` key, so a
+    tier win can never ratchet without its accuracy cost on record.
+
+    Honesty keys: ``precision_backend`` names the backend the pair ran on,
+    and ``precision_{f32,bf16}_read_gbs`` record the measured streaming
+    read bandwidth of each storage dtype on this host — the bf16 rung's
+    entire value proposition is halved memory traffic, so whether 16-bit
+    loads are fast here (native on TPU; scalarized on some CPU stacks) is
+    THE context the pair must carry. A host whose bf16 read path is slower
+    than f32 will honestly show the bf16 rung losing; the TPU pod run is
+    where the ratchet bites (ROADMAP pod ladder). BENCH_PRECISION=0
+    skips."""
+    if not knobs.get("BENCH_PRECISION"):
+        return {}
+    try:
+        from keystone_tpu.linalg.sketch import sketch_rows, sketched_lstsq_solve
+        from keystone_tpu.linalg.solvers import hdot
+
+        n = 4096 if _SMOKE else 16384
+        d = 256 if _SMOKE else 1024
+        c = 10
+        reps = 2 if _SMOKE else 4
+        cg_iters = 2 if _SMOKE else 8
+        key = jax.random.key(0)
+        A = jax.random.normal(key, (n, d), jnp.float32)
+        b = jax.random.normal(jax.random.key(1), (n, c), jnp.float32)
+        A16 = A.astype(jnp.bfloat16)  # the bf16-STORED operand
+        jax.block_until_ready((A, b, A16))
+
+        gram_f32 = jax.jit(lambda X: hdot(X.T, X, "high"))
+        gram_bf16 = jax.jit(lambda X: hdot(X.T, X, tier="bf16"))
+
+        def lat_cancelled(fn, arg, flops):
+            def chain(k):
+                outs = [fn(arg) for _ in range(k)]
+                jax.block_until_ready(outs[-1])
+
+            chain(1)  # warm the compile
+            t0 = time.perf_counter()
+            chain(1)
+            t1 = time.perf_counter()
+            chain(1 + reps)
+            t2 = time.perf_counter()
+            dt = ((t2 - t1) - (t1 - t0)) / reps
+            if dt <= 0:
+                dt = (t2 - t1) / (1 + reps)
+            return flops / dt / 1e9
+
+        gram_flops = 2.0 * n * d * d
+        out = {
+            "precision_backend": jax.default_backend(),
+            "gram_f32_gflops": round(lat_cancelled(gram_f32, A, gram_flops), 1),
+            "gram_bf16_gflops": round(
+                lat_cancelled(gram_bf16, A16, gram_flops), 1
+            ),
+        }
+        import numpy as np
+
+        G32 = np.asarray(gram_f32(A), np.float64)
+        G16 = np.asarray(gram_bf16(A16), np.float64)
+        out["gram_bf16_vs_f32_error_delta"] = float(
+            np.linalg.norm(G16 - G32) / max(np.linalg.norm(G32), 1e-30)
+        )
+
+        # streaming-read bandwidth of each storage dtype (the honesty probe)
+        probe = jax.random.normal(jax.random.key(2), (1 << 24,), jnp.float32)
+        probe16 = probe.astype(jnp.bfloat16)
+        jax.block_until_ready((probe, probe16))
+        rsum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+        for label, arr, bytes_per in (("f32", probe, 4), ("bf16", probe16, 2)):
+            jax.block_until_ready(rsum(arr))
+            t0 = time.perf_counter()
+            jax.block_until_ready(rsum(arr))
+            dt = time.perf_counter() - t0
+            out[f"precision_{label}_read_gbs"] = round(
+                arr.shape[0] * bytes_per / max(dt, 1e-9) / 1e9, 2
+            )
+
+        # sketch rung: tier pair of the randomized solver (fixed CG work)
+        m = sketch_rows(n, d)
+        sk_flops = (n * (d + c) + 2.0 * (m + d) * d * d
+                    + cg_iters * (4.0 * n * d * c + 2.0 * d * d * c))
+
+        def sk(tier):
+            def run(k):
+                ws = [sketched_lstsq_solve(A, b, lam=1.0 + i, tol=0.0,
+                                           max_iters=cg_iters, tier=tier)
+                      for i in range(k)]
+                jax.block_until_ready(ws[-1])
+                return ws[-1]
+
+            run(1)
+            t0 = time.perf_counter()
+            run(1)
+            t1 = time.perf_counter()
+            w = run(1 + reps)
+            t2 = time.perf_counter()
+            dt = ((t2 - t1) - (t1 - t0)) / reps
+            if dt <= 0:
+                dt = (t2 - t1) / (1 + reps)
+            return sk_flops / dt / 1e9, np.asarray(w, np.float64)
+
+        g32, w32 = sk("f32")
+        g16, w16 = sk("bf16")
+        out["sketch_f32_gflops"] = round(g32, 1)
+        out["sketch_bf16_gflops"] = round(g16, 1)
+        # solution delta, not sketch delta: what the f32 CG cleanup leaves
+        # behind — the number the error-envelope tests bound
+        out["sketch_bf16_vs_f32_error_delta"] = float(
+            np.linalg.norm(w16 - w32) / max(np.linalg.norm(w32), 1e-30)
+        )
+        return out
+    except Exception as e:
+        print(f"precision rows failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {"gram_bf16_gflops": None}
+
+
 def _run_regime_subprocess(regime: str, fail_key: str,
                            timeout_s: int = None) -> dict:
     """One big-regime row via ``scripts/bench_regime.py`` in a fresh OS
@@ -1268,6 +1390,17 @@ def main():
     else:
         out.update(_try_plan_rows())
     _flush(out, "plan")
+    # Precision-tier pair (bf16-storage/f32-accumulate vs f32 twins, each
+    # speed key paired with its error delta): in-process, small shapes — a
+    # reduced floor like telemetry's, with the explicit budget-skip marker
+    # the section contract pins.
+    if _budget_remaining() - _FINALIZE_RESERVE_S < 20.0:
+        out["precision_skipped"] = "budget"
+        print("bench section precision skipped: budget exhausted",
+              file=sys.stderr)
+    else:
+        out.update(_try_precision_rows())
+    _flush(out, "precision")
     # Solver GFLOPs ladder (exact BCD + randomized sketch rungs, overlap
     # on/off): a budget-derated SUBPROCESS regime since the sketch rung
     # landed. In-process it was the one heavy section whose runtime the
@@ -1458,6 +1591,13 @@ _COMPACT_KEYS = (
     # flagship stage attribution (GFLOPs where a formula exists, else s)
     ("g_solver", "solver_gflops_per_chip"),
     ("g_solver_ov", "solver_gflops_per_chip_overlap"),
+    # precision-tier pair (KEYSTONE_PRECISION_TIER): bf16 rungs + their
+    # paired error deltas vs the f32 twins (honesty keys in bench_full)
+    ("g_gram32", "gram_f32_gflops"),
+    ("g_gram16", "gram_bf16_gflops"),
+    ("gram16_err", "gram_bf16_vs_f32_error_delta"),
+    ("g_sk16", "sketch_bf16_gflops"),
+    ("sk16_err", "sketch_bf16_vs_f32_error_delta"),
     # randomized sketch rung (linalg/sketch.py) + equal-test-error delta
     # vs the exact rung (configured d=65536; actual d in bench_full.json)
     ("g_sketch", "sketch_gflops_per_chip"),

@@ -23,7 +23,11 @@ never disagree about a stage's abstract output):
   contract must accept the fit data's feature layout (trailing dims +
   dtype of the fit-side and apply-side featurizations must agree).
 - **C4 precision** — pre-dispatch f64/weak-64 leaks in a stage's abstract
-  output (fires BEFORE compilation; complements audit rule A3).
+  output, plus sub-f32 (bf16/f16) emission while the declared
+  ``KEYSTONE_PRECISION_TIER`` is f32 — the tier-aware downward direction;
+  under ``KEYSTONE_PRECISION_TIER=bf16`` the narrow dtype is the declared
+  program and stays clean (fires BEFORE compilation; complements audit
+  rule A3's intent registry).
 - **C5 un-evaluable stage** — a node the propagation pass cannot
   abstract-eval and nobody declared a ``__contract__`` for.  Today this
   silently degrades the planner (``plan.bounded=False``); here it is a
@@ -99,6 +103,12 @@ def pipeline_findings(
     template artifact)."""
     path, line = site if site else ("<unknown>", 0)
     by_index = {r.index: r for r in records}
+    # C4 knows the precision tier: under KEYSTONE_PRECISION_TIER=bf16 a
+    # stage emitting bfloat16 is the tier working as declared (clean);
+    # under the default f32 tier it is silent downward drift — the
+    # pre-dispatch complement of audit rule A3's intent registry. Resolved
+    # once per check pass (live knob read; the checker runs eagerly).
+    tier = _active_tier()
 
     def producer_name(rec: StageRecord) -> str:
         d = rec.deps[0] if rec.deps else -1
@@ -177,7 +187,38 @@ def pipeline_findings(
                          "contract with allow_f64=True and a reason",
                     symbol=f"{name}::C4::{rec.name}::{leak}",
                 ))
+        # C4 downward: a stage INTRODUCING a sub-f32 storage dtype while
+        # the declared tier is f32 (same report-once-at-source rule as the
+        # wide leaks above; under the bf16 tier this is the intended
+        # program and stays clean)
+        if tier == "f32":
+            already_n = _narrow_dtypes(rec.in_aval)
+            for leak in _narrow_leaves(rec.out_aval):
+                if leak in already_n:
+                    continue
+                out.append(_finding(
+                    "C4", path, line,
+                    f"[{name}] stage {rec.name} emits {leak} below the "
+                    f"declared f32 precision tier — a silent downgrade "
+                    f"loses 16 mantissa bits nobody opted into",
+                    hint="set KEYSTONE_PRECISION_TIER=bf16 if the tier is "
+                         "intended, else cast back to f32 at the stage "
+                         "boundary (audit rule A3's intent registry is "
+                         "the post-lowering twin of this finding)",
+                    symbol=f"{name}::C4::{rec.name}::{leak}",
+                ))
     return out
+
+
+def _active_tier() -> str:
+    """The live ``KEYSTONE_PRECISION_TIER`` value ('f32' when the knob
+    layer is unavailable — the checker must never take a pipeline down)."""
+    try:
+        from keystone_tpu.utils import knobs
+
+        return knobs.get("KEYSTONE_PRECISION_TIER")
+    except Exception:
+        return "f32"
 
 
 def _spec_key(spec: Any) -> Tuple:
@@ -207,6 +248,30 @@ def _wide_leaves(aval: Any) -> List[str]:
 def _wide_dtypes(aval: Any) -> set:
     """Base wide dtype names present in an aval (the C4 transition test)."""
     return {leak.split(" ")[0] for leak in _wide_leaves(aval)}
+
+
+#: sub-f32 floating storage dtypes (mirrors ir_rules.NARROW_DTYPES without
+#: importing the audit layer into the construction-time path)
+_NARROW = ("bfloat16", "float16")
+
+
+def _narrow_leaves(aval: Any) -> List[str]:
+    import jax
+
+    out = []
+    seen = set()
+    for l in jax.tree_util.tree_leaves(aval or ()):
+        dt = str(getattr(l, "dtype", ""))
+        if dt in _NARROW and dt not in seen:
+            seen.add(dt)
+            out.append(dt)
+    return out
+
+
+def _narrow_dtypes(aval: Any) -> set:
+    """Sub-f32 dtype names present in an aval (the downward C4 transition
+    test)."""
+    return set(_narrow_leaves(aval))
 
 
 @dataclass(frozen=True)

@@ -115,6 +115,39 @@ class EntryPoint:
 
 ENTRY_POINTS: Dict[str, EntryPoint] = {}
 
+#: THE intended-precision registry (rule A3, ``ir_rules.
+#: check_intended_precision``): each entry point's declared
+#: (storage, accumulate) dtypes. Storage is what operands are held in —
+#: "bf16" entries exercise the KEYSTONE_PRECISION_TIER routing and MUST
+#: show bf16 in their compiled program (a quietly-f32 program is a
+#: finding: the tier's perf claim would be hollow); "f32" entries must
+#: hold NO sub-f32 value (a silent downgrade nobody opted into is a
+#: finding). Accumulate is the reduction dtype — "f32" everywhere: the
+#: tier never trades away the accumulator. Entries absent here default to
+#: ("f32", "f32"), so a NEW entry is policed strictly until someone
+#: declares otherwise on purpose.
+INTENDED_PRECISION: Dict[str, Tuple[str, str]] = {
+    "overlap.tiled_gram": ("f32", "f32"),
+    "overlap.ring_gram": ("f32", "f32"),
+    "overlap.tiled_psum": ("f32", "f32"),
+    "solver.normal_equations": ("f32", "f32"),
+    "solver.tsqr": ("f32", "f32"),
+    "solver.sketch": ("f32", "f32"),
+    "solver.countsketch_reduce": ("f32", "f32"),
+    "solver.block_step": ("f32", "f32"),
+    "pallas.sift_bins": ("f32", "f32"),
+    "pallas.sift_bins_xla": ("f32", "f32"),
+    "pallas.fv_encode": ("f32", "f32"),
+    "pallas.fv_encode_xla": ("f32", "f32"),
+    "dag.fused_segment": ("f32", "f32"),
+    # the bf16 storage tier's audited programs (KEYSTONE_PRECISION_TIER)
+    "overlap.tiled_gram_bf16": ("bf16", "f32"),
+    "overlap.ring_gram_bf16": ("bf16", "f32"),
+    "solver.normal_equations_bf16": ("bf16", "f32"),
+    "solver.sketch_bf16": ("bf16", "f32"),
+    "pallas.sift_bins_bf16": ("bf16", "f32"),
+}
+
 
 def register(name: str, category: str, min_devices: int = 1):
     """Register an audit entry point.  The decorated builder's first line
@@ -373,6 +406,119 @@ def _build_block_step(devices) -> Built:
     )
 
 
+# -- bf16 precision-tier variants (KEYSTONE_PRECISION_TIER=bf16) -------------
+
+@register("overlap.tiled_gram_bf16", "overlap", min_devices=2)
+def _build_tiled_gram_bf16(devices) -> Built:
+    """bf16-tier tiled gram: the SAME pipelined collective structure as
+    the f32 entry (k per-tile reduce-scatters, one trailing all-gather, no
+    all-reduce — the tier must not cost the overlap schedule) with bf16
+    dot operands and f32 accumulators, per the A3 intent registry."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel.overlap import tiled_transpose_matmul
+
+    mesh = _data_mesh(devices)
+    k = mesh.shape["data"]
+    x = jnp.asarray(_f32(_rng(), 16 * k, 16 * k))
+    return Built(
+        fn=lambda a: tiled_transpose_matmul(a, mesh=mesh, tier="bf16"),
+        args=(x,), k=k,
+        expect=dict(reduce_scatter_min="k", all_gather_max=1),
+    )
+
+
+@register("overlap.ring_gram_bf16", "overlap", min_devices=2)
+def _build_ring_gram_bf16(devices) -> Built:
+    """bf16-tier bidirectional ring gram, reached through the PRODUCTION
+    router (``ring.ring_gram`` with the overlap form + tier): paired
+    permutes and zero bulk collectives exactly like the f32 entry, with
+    bf16 ring payloads and f32 tile accumulators."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel import make_mesh
+    from keystone_tpu.parallel.ring import ring_gram
+
+    k = len(devices)
+    mesh = make_mesh(data=1, model=k, devices=devices)
+    x = jnp.asarray(_f32(_rng(), 40, 16 * k))
+    return Built(
+        fn=lambda a: ring_gram(
+            a, mesh, axis="model", bidirectional=True, tier="bf16"
+        ),
+        args=(x,), k=k,
+        expect=dict(
+            zero_bulk=True, paired_permutes=True,
+            permute_min=2 * ((k - 1) // 2), unpaired_max=1,
+        ),
+    )
+
+
+@register("solver.normal_equations_bf16", "solver", min_devices=2)
+def _build_normal_equations_bf16(devices) -> Built:
+    """bf16-tier normal equations on the overlap path: gram/cross read
+    bf16-stored operands, every reduction and the d×d solve stay f32; the
+    collective shape matches the f32 rung exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.linalg.solvers import _normal_equations
+
+    mesh = _data_mesh(devices)
+    k = mesh.shape["data"]
+    rng = _rng()
+    A = jnp.asarray(_f32(rng, 32 * k, 16 * k))
+    b = jnp.asarray(_f32(rng, 32 * k, 8))
+    lam = jax.device_put(jnp.float32(1.0))
+    return Built(
+        fn=lambda A_, b_: _normal_equations(
+            A_, b_, lam, None, precision="high", omesh=mesh, tier="bf16"
+        ),
+        args=(A, b), k=k,
+        expect=dict(reduce_scatter_min="k", all_gather_max=2),
+    )
+
+
+@register("solver.sketch_bf16", "solver")
+def _build_sketch_bf16(devices) -> Built:
+    """bf16-tier sketch-and-precondition rung (the tier's designated first
+    adopter): bf16 sketch application, f32 QR + f32 CG — the program must
+    hold bf16 values (tier engaged) but never a sub-f32 accumulator."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.linalg.sketch import sketched_lstsq_solve
+    from keystone_tpu.parallel import make_mesh
+
+    mesh = make_mesh(data=1, model=1, devices=devices[:1])
+    rng = _rng()
+    A = jnp.asarray(_f32(rng, 128, 16))
+    b = jnp.asarray(_f32(rng, 128, 3))
+    return Built(
+        fn=lambda A_, b_: sketched_lstsq_solve(
+            A_, b_, lam=0.5, mesh=mesh, overlap=False, tol=0.0,
+            max_iters=5, tier="bf16",
+        ),
+        args=(A, b), k=1,
+        expect=dict(),
+    )
+
+
+@register("pallas.sift_bins_bf16", "pallas")
+def _build_sift_bins_bf16(devices) -> Built:
+    """bf16-input SIFT binning kernel variant (interpret form off-TPU):
+    bf16 tile streams, f32 in-VMEM arithmetic and f32 output."""
+    from keystone_tpu.ops.pallas.extraction import sift_oriented_bins
+
+    mag, ang, sel = _sift_args()
+    return Built(
+        fn=lambda m, a: sift_oriented_bins(
+            m, a, sel, tile_r=16, interpret=True, tier="bf16"
+        ),
+        args=(mag, ang), k=1,
+        expect=dict(),
+    )
+
+
 # -- Pallas kernels + their XLA twins ----------------------------------------
 
 def _sift_args():
@@ -619,6 +765,13 @@ def run_audit(
                 f"{name}: {type(e).__name__}: {e}"
             )
             continue
+        # the A3 intent registry rides in through expect: absent entries
+        # default to strict ("f32", "f32") — a new entry point is policed
+        # until someone declares a different intent on purpose
+        prog.expect.setdefault(
+            "intended_precision",
+            INTENDED_PRECISION.get(name, ("f32", "f32")),
+        )
         audited_lines.append(entry.line)
         result.files += 1
         for rule in rules:

@@ -401,6 +401,105 @@ def host_transfer_sites(jaxpr, hlo_text: str) -> List[str]:
 
 _WIDE_RE = re.compile(r"\b(f64|c128)\[")
 
+#: sub-f32 floating storage dtypes the intent registry polices (the
+#: KEYSTONE_PRECISION_TIER family; f16 included so a mistaken half-float
+#: cast is caught by the same rule)
+NARROW_DTYPES = ("bfloat16", "float16")
+
+
+def narrow_dtype_sites(jaxpr) -> List[str]:
+    """bf16/f16 avals anywhere in the jaxpr, with the producing primitive
+    named — the *downward* complement of :func:`wide_dtype_sites`. Reported
+    only against entries whose intended storage dtype is f32 (a silent
+    f32→bf16 drift loses 16 mantissa bits without anyone opting in)."""
+    sites: List[str] = []
+    seen = set()
+    for eqn in iter_eqns(jaxpr):
+        for v in list(eqn.outvars):
+            dt = str(getattr(getattr(v, "aval", None), "dtype", ""))
+            if dt in NARROW_DTYPES:
+                key = (eqn.primitive.name, dt)
+                if key not in seen:
+                    seen.add(key)
+                    kind = (
+                        "silent downcast via"
+                        if eqn.primitive.name == "convert_element_type"
+                        else "produced by"
+                    )
+                    sites.append(f"{dt} {kind} '{eqn.primitive.name}'")
+    return sites
+
+
+def bf16_dot_stats(jaxpr) -> Tuple[int, int, bool]:
+    """(dots with a bf16/f16 operand, of those the ones whose OUTPUT is
+    also sub-f32 — i.e. the accumulator was NOT widened to f32 — and
+    whether any sub-f32 aval exists at all). The intent registry's three
+    observables: engagement, accumulate discipline, and presence."""
+    narrow_dots = 0
+    narrow_acc = 0
+    any_narrow = False
+    for eqn in iter_eqns(jaxpr):
+        for v in list(eqn.outvars):
+            dt = str(getattr(getattr(v, "aval", None), "dtype", ""))
+            if dt in NARROW_DTYPES:
+                any_narrow = True
+        if eqn.primitive.name != "dot_general":
+            continue
+        in_dts = [str(v.aval.dtype) for v in eqn.invars]
+        if any(dt in NARROW_DTYPES for dt in in_dts):
+            any_narrow = True
+            narrow_dots += 1
+            out_dt = str(eqn.outvars[0].aval.dtype)
+            if out_dt in NARROW_DTYPES:
+                narrow_acc += 1
+    return narrow_dots, narrow_acc, any_narrow
+
+
+def check_intended_precision(
+    jaxpr, storage: str = "f32", accumulate: str = "f32"
+) -> List[str]:
+    """THE intent-registry check (``ir_audit.INTENDED_PRECISION``): each
+    entry point declares its (storage, accumulate) dtypes and BOTH drift
+    directions are findings —
+
+    - declared f32 storage but sub-f32 avals in the program: a silent
+      f32→bf16 downgrade nobody opted into;
+    - declared bf16 storage but no sub-f32 aval anywhere: the tier the
+      entry promises is not engaged (a silent bf16→f32 upgrade — the perf
+      claim the registry exists to pin would be hollow);
+    - declared f32 accumulate but a sub-f32-operand dot whose output stays
+      sub-f32: the ``preferred_element_type=f32`` accumulator contract was
+      dropped, the one place the bf16 tier could actually lose the sum.
+    """
+    if storage not in ("f32", "bf16") or accumulate not in ("f32",):
+        # a typo'd registry entry must never silently disable the rule —
+        # the exact silent-drift class this check exists to catch
+        raise ValueError(
+            f"unknown intended precision ({storage!r}, {accumulate!r}): "
+            "storage must be f32|bf16 and accumulate f32 "
+            "(ir_audit.INTENDED_PRECISION)"
+        )
+    problems: List[str] = []
+    narrow_dots, narrow_acc, any_narrow = bf16_dot_stats(jaxpr)
+    if storage == "f32":
+        problems += [
+            f"intended f32 storage but {site}"
+            for site in narrow_dtype_sites(jaxpr)
+        ]
+    elif storage == "bf16":
+        if not any_narrow:
+            problems.append(
+                "intended bf16 storage but the program holds no bf16 "
+                "value anywhere — the declared tier is not engaged "
+                "(silent bf16->f32 drift)"
+            )
+        if accumulate == "f32" and narrow_acc:
+            problems.append(
+                f"{narrow_acc} bf16-operand dot(s) accumulate in a "
+                "sub-f32 dtype — preferred_element_type=f32 was dropped"
+            )
+    return problems
+
 
 def wide_dtype_sites(jaxpr, hlo_text: str) -> List[str]:
     """f64/c128 leaks: wide avals anywhere in the jaxpr (with the producing
@@ -581,25 +680,54 @@ class HostTransferRule(IRRule):
 
 
 class PrecisionRule(IRRule):
-    """A3: f32 discipline — no f64/c128 ops or silent weak-type upcasts
-    outside an explicit allowlist (TPUs emulate f64)."""
+    """A3: precision discipline in BOTH directions — no f64/c128 ops or
+    silent weak-type upcasts outside an explicit allowlist (TPUs emulate
+    f64), and the entry's declared (storage, accumulate) dtype intent
+    (``ir_audit.INTENDED_PRECISION``) must match what was compiled: a
+    silent f32→bf16 downgrade *or* a bf16 tier that quietly serves f32 is
+    a finding (:func:`check_intended_precision`)."""
 
     id = "A3"
-    doc = "precision audit (f64 leaks / silent upcasts)"
+    doc = "precision audit (f64 leaks / dtype-tier intent drift)"
 
     def run(self, prog: AuditProgram) -> List[Finding]:
-        if prog.expect.get("allow_f64"):
-            return []
-        return [
-            _finding(
-                prog, self.id, f"wide-precision leak: {site}",
-                hint="solver/FV paths are f32-by-contract (solvers.py "
-                     "docstring); cast at the boundary or allowlist the "
-                     "entry with expect allow_f64=True and a reason",
-                symbol=site,
+        findings: List[Finding] = []
+        if not prog.expect.get("allow_f64"):
+            findings += [
+                _finding(
+                    prog, self.id, f"wide-precision leak: {site}",
+                    hint="solver/FV paths are f32-by-contract (solvers.py "
+                         "docstring); cast at the boundary or allowlist the "
+                         "entry with expect allow_f64=True and a reason",
+                    symbol=site,
+                )
+                for site in wide_dtype_sites(prog.jaxpr, prog.hlo_text)
+            ]
+        storage, accumulate = prog.expect.get(
+            "intended_precision", ("f32", "f32")
+        )
+        try:
+            problems = check_intended_precision(
+                prog.jaxpr, storage, accumulate
             )
-            for site in wide_dtype_sites(prog.jaxpr, prog.hlo_text)
+        except ValueError as e:
+            # a malformed registry entry is itself a finding, not a crash:
+            # the audit must fail loudly (rc=1) rather than silently skip
+            # the intent check or take the whole pass down
+            problems = [str(e)]
+        findings += [
+            _finding(
+                prog, self.id, f"precision-intent drift: {p}",
+                hint="the entry's declared (storage, accumulate) dtypes "
+                     "live in ir_audit.INTENDED_PRECISION — either the "
+                     "program drifted (fix the tier threading) or the "
+                     "intent changed (update the registry entry with the "
+                     "rationale)",
+                symbol=p[:60],
+            )
+            for p in problems
         ]
+        return findings
 
 
 class PaddingRule(IRRule):

@@ -67,9 +67,17 @@ def block_coordinate_descent_l2(
     telemetry: Optional[bool] = None,
     block_schedule: Optional[str] = None,
     block_order: Optional[jax.Array] = None,
+    tier: Optional[str] = None,
 ) -> jax.Array:
     """Public entry: resolves the solver precision once (a static jit arg,
     so changing the global never serves a stale compile) and dispatches.
+
+    ``tier`` (None = the ``KEYSTONE_PRECISION_TIER`` knob; resolved here,
+    eagerly, and threaded through jit as a static argument) stores each
+    block's gram/cross/residual-update matmul operands in bfloat16 with
+    f32 accumulation — the per-block (b×b) Cholesky solve always stays
+    f32. Distinct from ``precision`` (MXU passes over f32 operands): the
+    two compose, but ``precision`` is a no-op on bf16-stored operands.
 
     ``block_schedule`` (None = the ``KEYSTONE_SKETCH_BCD`` knob):
     ``"sequential"`` visits feature blocks in index order (the reference's
@@ -121,6 +129,9 @@ def block_coordinate_descent_l2(
     if precision is not None:
         validate_precision(precision)
     precision = precision or get_solver_precision()
+    from keystone_tpu.linalg.solvers import resolve_precision_tier
+
+    tier = resolve_precision_tier(tier)
     # lam rides into the jitted solve as a traced scalar; a raw python
     # float would be an *implicit* h2d transfer on every fit call (the
     # KEYSTONE_GUARD sentinel flags it — see linalg.solvers.device_scalar).
@@ -167,7 +178,7 @@ def block_coordinate_descent_l2(
             return fn(
                 A, b, lam, block_size, num_iter, mask, cache_grams,
                 precision, omesh, model_overlap, with_residuals=trace_on,
-                block_order=block_order,
+                block_order=block_order, tier=tier,
             )
 
     if not trace_on:
@@ -204,6 +215,7 @@ def _bcd_l2_impl(
     model_overlap: bool = False,
     with_residuals: bool = False,
     block_order: Optional[jax.Array] = None,
+    tier: str = "f32",
 ) -> jax.Array:
     """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
 
@@ -260,16 +272,20 @@ def _bcd_l2_impl(
     def _gram(Ak):
         if model_overlap:
             return model_tiled_transpose_matmul(
-                Ak, None, omesh, precision=precision
+                Ak, None, omesh, precision=precision, tier=tier
             )
-        return maybe_tiled_transpose_matmul(Ak, None, omesh, precision=precision)
+        return maybe_tiled_transpose_matmul(
+            Ak, None, omesh, precision=precision, tier=tier
+        )
 
     def _cross(Ak, R):
         if model_overlap:
             return model_tiled_transpose_matmul(
-                Ak, R, omesh, precision=precision
+                Ak, R, omesh, precision=precision, tier=tier
             )
-        return maybe_tiled_transpose_matmul(Ak, R, omesh, precision=precision)
+        return maybe_tiled_transpose_matmul(
+            Ak, R, omesh, precision=precision, tier=tier
+        )
 
     use_cache = num_iter > 1 and cache_grams
     if use_cache:
@@ -291,7 +307,11 @@ def _bcd_l2_impl(
             gram = _gram(Ak)  # sharded matmul -> ICI reduction
         rhs = _cross(Ak, R) + hdot(gram, Wk, precision)  # A_kᵀ(R + A_k W_k)
         Wk_new = spd_solve(gram + lam * eye + jnp.diag(regk), rhs)
-        R = R - hdot(Ak, Wk_new - Wk, precision)
+        # residual update: the third O(n·b·c) matmul of the step — it rides
+        # the tier too (bf16-stored A_k/ΔW, f32-accumulated update), but the
+        # residual R itself stays an f32 carry so rounding never compounds
+        # across the scan
+        R = R - hdot(Ak, Wk_new - Wk, precision, tier=tier)
         W = jax.lax.dynamic_update_slice(W, Wk_new, (start, 0))
         out = jnp.linalg.norm(R) if with_residuals else None
         return (W, R), out
@@ -307,7 +327,7 @@ def _bcd_l2_impl(
 
 _BCD_STATICS = (
     "block_size", "num_iter", "cache_grams", "precision", "omesh",
-    "model_overlap", "with_residuals",
+    "model_overlap", "with_residuals", "tier",
 )
 _bcd_l2 = functools.partial(jax.jit, static_argnames=_BCD_STATICS)(_bcd_l2_impl)
 # Donated variant: b's buffer aliases the scanned residual, A's is freed for

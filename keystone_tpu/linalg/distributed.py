@@ -110,13 +110,20 @@ class RowShardedMatrix(struct.PyTreeNode):
         return self.data * self.mask[:, None]
 
     # -- linear algebra ----------------------------------------------------
-    def gram(self, overlap: Optional[bool] = None) -> jax.Array:
+    def gram(
+        self, overlap: Optional[bool] = None, tier: Optional[str] = None
+    ) -> jax.Array:
         """Replicated XᵀX. The reference's ``treeReduce`` of per-partition
         grams (``BlockWeightedLeastSquares.scala:203-216``) as one sharded
         matmul whose row contraction XLA all-reduces over ICI — or, with
         ``overlap`` (None = the ``KEYSTONE_OVERLAP`` knob), as the tiled
         reduce-scatter collective matmul whose per-tile reductions hide
-        behind the next tile's MXU work (``parallel/overlap.py``)."""
+        behind the next tile's MXU work (``parallel/overlap.py``).
+        ``tier`` (None = the ``KEYSTONE_PRECISION_TIER`` knob) stores the
+        matmul operands bf16 and accumulates f32 — resolved eagerly here,
+        like the precision knob (the class docstring's jit caveat
+        applies)."""
+        from keystone_tpu.linalg.solvers import resolve_precision_tier
         from keystone_tpu.parallel.overlap import (
             maybe_tiled_transpose_matmul,
             overlap_mesh,
@@ -124,15 +131,19 @@ class RowShardedMatrix(struct.PyTreeNode):
 
         X = self._masked()
         # mesh=None (knob off) degrades to exactly hdot(X.T, X) inside
-        return maybe_tiled_transpose_matmul(X, None, overlap_mesh(overlap))
+        return maybe_tiled_transpose_matmul(
+            X, None, overlap_mesh(overlap), tier=resolve_precision_tier(tier)
+        )
 
     def t_times(
         self,
         other: Union["RowShardedMatrix", jax.Array],
         overlap: Optional[bool] = None,
+        tier: Optional[str] = None,
     ) -> jax.Array:
         """Replicated XᵀY for a co-sharded Y (the ``Aᵀb`` reduction);
-        ``overlap`` as in :meth:`gram`."""
+        ``overlap``/``tier`` as in :meth:`gram`."""
+        from keystone_tpu.linalg.solvers import resolve_precision_tier
         from keystone_tpu.parallel.overlap import (
             maybe_tiled_transpose_matmul,
             overlap_mesh,
@@ -140,7 +151,8 @@ class RowShardedMatrix(struct.PyTreeNode):
 
         Y = other._masked() if isinstance(other, RowShardedMatrix) else other
         return maybe_tiled_transpose_matmul(
-            self._masked(), Y, overlap_mesh(overlap)
+            self._masked(), Y, overlap_mesh(overlap),
+            tier=resolve_precision_tier(tier),
         )
 
     def times(self, w: jax.Array) -> "RowShardedMatrix":
@@ -182,6 +194,7 @@ class RowShardedMatrix(struct.PyTreeNode):
         (None = the ``KEYSTONE_OVERLAP`` knob) rides the CountSketch
         reduction on the tiled reduce-scatter schedule."""
         from keystone_tpu.linalg.sketch import resolve_sketch_kind
+        from keystone_tpu.linalg.solvers import resolve_precision_tier
         from keystone_tpu.parallel.mesh import get_mesh
         from keystone_tpu.parallel.overlap import mesh_tiers, overlap_mesh
 
@@ -193,7 +206,7 @@ class RowShardedMatrix(struct.PyTreeNode):
         tiers = mesh_tiers(mesh, "data") if omesh is not None else None
         SA, _ = sketch_matrix(
             X, m, seed, kind=resolve_sketch_kind(kind), mesh=mesh,
-            omesh=omesh, tiers=tiers,
+            omesh=omesh, tiers=tiers, tier=resolve_precision_tier(None),
         )
         return SA
 

@@ -157,19 +157,36 @@ def _committed_sketch_mesh(A, mesh: Optional[Mesh], axis: str) -> Optional[Mesh]
     return smesh
 
 
-def _countsketch_local(A, y, key, m: int, axis: Optional[str], omesh, tiers):
+def _countsketch_local(A, y, key, m: int, axis: Optional[str], omesh, tiers,
+                       tier: str = "f32"):
     """One shard's CountSketch contribution: every local row is scatter-added
     into its ±1-signed bucket (``segment_sum`` — the O(nnz) application of
     the transpose-matmul ``EᵀA``), then the (m, d) partials are reduced over
     the shards — via the tiled reduce-scatter (:func:`~keystone_tpu.parallel.
     overlap.tiled_psum`, two-tier aware) when the overlap knob is live, else
     one monolithic ``psum``. ``axis=None``: the single-program form (no
-    collective)."""
+    collective).
+
+    ``tier="bf16"``: the ±1 sign application reads bfloat16-stored rows
+    (half the memory traffic of the one full-data pass this phase IS), and
+    the products are widened to f32 BEFORE the ``segment_sum`` so the
+    bucket accumulation — and every cross-shard reduction below — carries
+    full f32 precision. ±1 signs are exact in bf16, so only the operand
+    rounding is lost (the CG cleanup's job, module docstring)."""
     n_l = A.shape[0]
     kb, ks = jax.random.split(key)
     buckets = jax.random.randint(kb, (n_l,), 0, m)
     signs = jax.random.rademacher(ks, (n_l,), A.dtype)
-    parts = [jax.ops.segment_sum(x * signs[:, None], buckets, num_segments=m)
+
+    def signed(x):
+        if tier == "bf16":
+            x16 = x.astype(jnp.bfloat16)
+            return (x16 * signs.astype(jnp.bfloat16)[:, None]).astype(
+                jnp.float32
+            )
+        return x * signs[:, None]
+
+    parts = [jax.ops.segment_sum(signed(x), buckets, num_segments=m)
              for x in ((A,) if y is None else (A, y))]
     if axis is None:
         return parts[0], (parts[1] if y is not None else None)
@@ -182,7 +199,7 @@ def _countsketch_local(A, y, key, m: int, axis: Optional[str], omesh, tiers):
     return parts[0], (parts[1] if y is not None else None)
 
 
-def _srht_local(A, y, key, mc: int):
+def _srht_local(A, y, key, mc: int, tier: str = "f32"):
     """One shard's SRHT block: Rademacher row signs, an orthonormal FFT mix
     down the local row axis, then ``mc`` uniformly sampled complex rows
     emitted as 2·mc real rows (real and imaginary parts), scaled
@@ -190,7 +207,11 @@ def _srht_local(A, y, key, mc: int):
     shards: each shard mixes only its own rows — the standard distributed
     SRHT variant, no cross-shard traffic until the final sample gather.
     A shard holding fewer than ``mc`` rows samples what it has and
-    zero-pads to the requested 2·mc rows (:func:`_srht_clamped`)."""
+    zero-pads to the requested 2·mc rows (:func:`_srht_clamped`).
+
+    ``tier="bf16"``: the sign application reads bfloat16-stored rows; the
+    signed product widens to f32 before the FFT (there is no complex-bf16
+    — the mix itself, like every accumulation in the tier, runs f32)."""
     n_l = A.shape[0]
     mc_eff = _srht_clamped(mc, n_l)
     ksgn, kidx = jax.random.split(key)
@@ -199,7 +220,14 @@ def _srht_local(A, y, key, mc: int):
     scale = jnp.sqrt(jnp.float32(n_l) / jnp.float32(mc_eff))
 
     def mix(x):
-        z = jnp.fft.fft(x * signs[:, None], axis=0, norm="ortho")
+        if tier == "bf16":
+            x16 = x.astype(jnp.bfloat16)
+            xs = (x16 * signs.astype(jnp.bfloat16)[:, None]).astype(
+                jnp.float32
+            )
+        else:
+            xs = x * signs[:, None]
+        z = jnp.fft.fft(xs, axis=0, norm="ortho")
         zs = jnp.take(z, idx, axis=0) * scale
         out = jnp.concatenate([jnp.real(zs), jnp.imag(zs)], axis=0)
         if mc_eff < mc:
@@ -219,11 +247,14 @@ def sketch_matrix(
     axis: str = "data",
     omesh: Optional[Mesh] = None,
     tiers: Optional[Tuple[int, int]] = None,
+    tier: str = "f32",
 ) -> Tuple[jax.Array, Optional[jax.Array]]:
     """Replicated ``(S·A, S·y)`` for a row-sharded ``A`` (n, d) and optional
     co-sharded ``y`` (n, c) under ONE shared sketch operator S (m, n) —
     sketching the system and its rhs in a single pass so the
-    sketch-and-solve warm start sees a consistent pair.
+    sketch-and-solve warm start sees a consistent pair. ``tier="bf16"``
+    (caller-resolved static) applies the operator to bfloat16-stored rows
+    with f32 accumulation; the returned sketch is always f32.
 
     Traceable (callable inside jit with ``m``/``kind``/meshes static;
     ``seed`` is an int32 scalar — it rides through the ``shard_map`` as a
@@ -244,8 +275,8 @@ def sketch_matrix(
     if smesh is None:
         key = jax.random.key(seed)
         if kind == "countsketch":
-            return _countsketch_local(A, y, key, m, None, None, None)
-        return _srht_local(A, y, key, m // 2)
+            return _countsketch_local(A, y, key, m, None, None, None, tier)
+        return _srht_local(A, y, key, m // 2, tier)
 
     k = smesh.shape[axis]
     if kind == "srht" and m % (2 * k):
@@ -259,8 +290,8 @@ def sketch_matrix(
             jax.random.key(seed_i), jax.lax.axis_index(axis)
         )
         if kind == "countsketch":
-            return _countsketch_local(Ai, yi, ki, m, axis, omesh, tiers)
-        SAi, Syi = _srht_local(Ai, yi, ki, m // (2 * k))
+            return _countsketch_local(Ai, yi, ki, m, axis, omesh, tiers, tier)
+        SAi, Syi = _srht_local(Ai, yi, ki, m // (2 * k), tier)
         SA = jax.lax.all_gather(SAi, axis).reshape(m, Ai.shape[1])
         Sy = (
             jax.lax.all_gather(Syi, axis).reshape(m, yi.shape[1])
@@ -286,22 +317,34 @@ def sketch_matrix(
 # Sketch-and-precondition solve
 # ---------------------------------------------------------------------------
 
-_SKETCH_STATICS = ("m", "kind", "ridge", "mesh", "omesh", "tiers", "precision")
+_SKETCH_STATICS = (
+    "m", "kind", "ridge", "mesh", "omesh", "tiers", "precision", "tier",
+)
 
 
 @functools.partial(jax.jit, static_argnames=_SKETCH_STATICS)
 def _sketch_and_qr(
     A, b, lam, seed, mask, m: int, kind: str, ridge: bool,
     mesh=None, omesh=None, tiers=None, precision: str = "high",
+    tier: str = "f32",
 ):
     """Phases 1+2: sketch the (A, b) pair, QR the (ridge-augmented) sketch,
     and form the sketch-and-solve warm start ``x0 = argmin ‖(SA)x − Sb‖²
     (+ lam‖x‖²)`` — the O(ε)-accurate initial iterate the preconditioned
-    iteration refines. Returns (R, x0) with R upper-triangular (d, d)."""
+    iteration refines. Returns (R, x0) with R upper-triangular (d, d).
+
+    ``tier="bf16"`` applies to the SKETCH APPLICATION only (the one
+    full-data pass of the solve — where the bandwidth lives); the QR of
+    the small (m, d) sketch and the warm start run f32 regardless: a bf16
+    sketch perturbs the subspace embedding by ~2⁻⁸ (ε grows slightly, the
+    preconditioner stays excellent) while an f32 QR keeps R itself exact —
+    the accuracy-safe composition the module docstring's envelope relies
+    on."""
     A, b = _apply_mask(A, b, mask)
     d = A.shape[1]
     SA, Sb = sketch_matrix(
-        A, m, seed, y=b, kind=kind, mesh=mesh, omesh=omesh, tiers=tiers
+        A, m, seed, y=b, kind=kind, mesh=mesh, omesh=omesh, tiers=tiers,
+        tier=tier,
     )
     if ridge:
         SA = jnp.concatenate(
@@ -391,6 +434,7 @@ def sketched_lstsq_solve(
     tol: Optional[float] = None,
     max_iters: Optional[int] = None,
     seed: int = 0,
+    tier: Optional[str] = None,
 ) -> jax.Array:
     """Solve ``min ‖AW − b‖² (+ lam·‖W‖²)`` by sketch-and-precondition:
     CountSketch/SRHT of the row-sharded system, one small replicated QR,
@@ -402,7 +446,16 @@ def sketched_lstsq_solve(
     ``_MAX_ITERS``; ``overlap`` (None = ``KEYSTONE_OVERLAP``) routes the
     sketch reduction and every CG ``AᵀAp`` through the tiled reduce-scatter
     schedules. ``tol=0`` runs exactly ``max_iters`` iterations — the
-    fixed-work form the bench's GFLOPs rung times."""
+    fixed-work form the bench's GFLOPs rung times.
+
+    ``tier`` (None = the ``KEYSTONE_PRECISION_TIER`` knob) engages the
+    bf16-storage sketch: this solver is the tier's designated first
+    adopter because sketch-and-precondition TOLERATES a low-precision
+    sketch by construction — the sketch only builds the preconditioner and
+    warm start, and the f32 CG on the exact system restores accuracy. The
+    composition is bf16 sketch → f32 QR → f32-preconditioned f32 CG; the
+    iteration itself deliberately stays f32 (its residuals ARE the
+    answer)."""
     from keystone_tpu import telemetry
     from keystone_tpu.parallel.mesh import get_mesh
     from keystone_tpu.parallel.overlap import mesh_tiers, overlap_mesh
@@ -413,6 +466,9 @@ def sketched_lstsq_solve(
     if squeeze:
         b2 = b2[:, None]
     kind = resolve_sketch_kind(kind)
+    from keystone_tpu.linalg.solvers import resolve_precision_tier
+
+    tier = resolve_precision_tier(tier)
     tol = knobs.get("KEYSTONE_SKETCH_TOL") if tol is None else tol
     max_iters = (
         knobs.get("KEYSTONE_SKETCH_MAX_ITERS") if max_iters is None
@@ -468,6 +524,7 @@ def sketched_lstsq_solve(
     with telemetry.get_tracer().span("solver.sketch") as sp:
         sp.set(
             n=n, d=d, c=c, m=m, kind=kind, overlap=omesh is not None,
+            tier=tier,
             flops=sketch_flops + qr_flops + max_iters * per_iter_flops,
         )
         with telemetry.get_tracer().span("solver.sketch.sketch_qr") as sq:
@@ -475,7 +532,7 @@ def sketched_lstsq_solve(
             R, x0 = _sketch_and_qr(
                 A, b2, lam_dev, device_scalar(seed, "int32"), mask,
                 m=m, kind=kind, ridge=ridge, mesh=smesh, omesh=omesh,
-                tiers=tiers, precision=precision,
+                tiers=tiers, precision=precision, tier=tier,
             )
             R = sq.track(R)
         with telemetry.get_tracer().span("solver.sketch.iterate") as si:
@@ -511,10 +568,10 @@ def sketched_lstsq_solve(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "m", "kind", "mesh")
+    jax.jit, static_argnames=("block_size", "m", "kind", "mesh", "tier")
 )
 def _leverage_order(A, seed, mask, block_size: int, m: int, kind: str,
-                    mesh=None):
+                    mesh=None, tier: str = "f32"):
     """Descending-energy feature-block permutation from the sketched R:
     QR the sketch once, read the per-column energies ``diag(RᵀR)`` (the
     ridge-leverage proxy — column j's share of ‖A‖²_F as seen through the
@@ -523,7 +580,7 @@ def _leverage_order(A, seed, mask, block_size: int, m: int, kind: str,
     if mask is not None:
         A = A * mask[:, None]
     d = A.shape[1]
-    SA, _ = sketch_matrix(A, m, seed, kind=kind, mesh=mesh)
+    SA, _ = sketch_matrix(A, m, seed, kind=kind, mesh=mesh, tier=tier)
     Rs = jnp.linalg.qr(SA, mode="r")
     energy = jnp.sum(Rs * Rs, axis=0)  # (d,) = diag(RᵀR) = ‖SA eⱼ‖²
     d_pad = -(-d // block_size) * block_size
@@ -540,6 +597,7 @@ def leverage_block_order(
     kind: Optional[str] = None,
     factor: Optional[float] = None,
     seed: int = 0,
+    tier: Optional[str] = None,
 ) -> jax.Array:
     """Device (num_blocks,) int32 visit order for block-coordinate solvers:
     blocks in descending sketched column energy, so the Gauss–Seidel pass
@@ -550,6 +608,9 @@ def leverage_block_order(
 
     A = jnp.asarray(A, jnp.float32)
     kind = resolve_sketch_kind(kind)
+    from keystone_tpu.linalg.solvers import resolve_precision_tier
+
+    tier = resolve_precision_tier(tier)
     mesh = mesh or get_mesh()
     smesh = _committed_sketch_mesh(A, mesh, "data")
     k = smesh.shape["data"] if smesh is not None else 1
@@ -559,5 +620,5 @@ def leverage_block_order(
     telemetry.get_registry().inc("solver.sketch.leverage_orders")
     return _leverage_order(
         A, device_scalar(seed, "int32"), mask, block_size=block_size,
-        m=m, kind=kind, mesh=smesh,
+        m=m, kind=kind, mesh=smesh, tier=tier,
     )

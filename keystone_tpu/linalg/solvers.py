@@ -23,6 +23,16 @@ compiled programs. ``RowShardedMatrix`` reductions read the knob eagerly at
 call time — correct when called directly, but wrapping those methods in
 your own ``jax.jit`` bakes in the then-current setting. Attention matmuls
 (``parallel/ring.py``) always run at ``"highest"`` regardless of the knob.
+
+Orthogonal to the MXU precision is the **storage dtype tier**
+(``KEYSTONE_PRECISION_TIER=f32|bf16``, per-call ``tier=``): ``bf16``
+stores the gram/cross matmul operands in bfloat16 and accumulates in f32
+(``preferred_element_type``) — half the HBM traffic and the single-pass
+native MXU mode, at ~2⁻⁸ operand rounding. Both knobs resolve EAGERLY per
+solver call and ride through jit as static arguments; the small d×d
+solves/QRs stay f32 at every tier. The A3 audit rule pins each entry
+point's intended (storage, accumulate) dtypes so drift in either
+direction is a finding (``analysis/ir_audit.py``).
 """
 
 from __future__ import annotations
@@ -41,13 +51,48 @@ _PRECISIONS = {
 }
 _solver_precision = "high"
 
+#: storage dtype tiers (KEYSTONE_PRECISION_TIER) — ORTHOGONAL to the MXU
+#: arithmetic precision above: the tier decides what dtype operands are
+#: *stored* in (bf16 halves HBM traffic; products of bf16 values are exact
+#: in the f32 accumulator), the precision knob decides how many MXU passes
+#: an f32-stored matmul spends.
+PRECISION_TIERS = ("f32", "bf16")
+
 
 def validate_precision(name: str) -> str:
     """Validate a precision name; returns it (the shared contract for the
     global setter and per-call ``precision=`` arguments)."""
+    if name in PRECISION_TIERS:
+        raise ValueError(
+            f"{name!r} is a storage dtype tier, not an MXU arithmetic "
+            f"precision — set KEYSTONE_PRECISION_TIER={name} (or pass "
+            f"tier={name!r}) for bf16-storage/f32-accumulate routing; "
+            f"precision must be one of {sorted(_PRECISIONS)}"
+        )
     if name not in _PRECISIONS:
         raise ValueError(f"precision must be one of {sorted(_PRECISIONS)}: {name}")
     return name
+
+
+def resolve_precision_tier(override: Optional[str] = None) -> str:
+    """The storage dtype tier to run: per-call ``override`` beats the
+    ``KEYSTONE_PRECISION_TIER`` knob (default ``"f32"`` — the byte-identical
+    prior program). Resolve EAGERLY at every solver entry and thread the
+    result through ``jax.jit`` as a static argument — the tier changes
+    program structure (operand dtypes), so a knob read inside a traced body
+    would bake the first call's tier into the cached program (the
+    precision-knob staleness class this module's docstring bans)."""
+    from keystone_tpu.utils import knobs
+
+    tier = (
+        override if override is not None
+        else knobs.get("KEYSTONE_PRECISION_TIER")
+    )
+    if tier not in PRECISION_TIERS:
+        raise ValueError(
+            f"precision tier must be one of {PRECISION_TIERS}: {tier!r}"
+        )
+    return tier
 
 
 def set_solver_precision(name: str) -> None:
@@ -92,13 +137,35 @@ def device_scalar(value, dtype=None):
     return jax.device_put(np.asarray(value, dtype or np.float32))
 
 
-def hdot(a: jax.Array, b: jax.Array, precision: Optional[str] = None) -> jax.Array:
+def hdot(
+    a: jax.Array,
+    b: jax.Array,
+    precision: Optional[str] = None,
+    tier: Optional[str] = None,
+) -> jax.Array:
     """Matmul at the solver precision — use for all gram/solve matmuls.
 
     Inside jitted solver bodies, pass the ``precision`` that the caller
     resolved (a static argument); bare ``hdot(a, b)`` reads the global at
     trace time, which is fine only outside jit or where staleness is
-    acceptable."""
+    acceptable.
+
+    ``tier="bf16"`` (the ``KEYSTONE_PRECISION_TIER`` dtype tier — resolved
+    by the caller, a static argument) stores both operands in bfloat16 and
+    accumulates in float32 (``preferred_element_type``): half the HBM
+    traffic and the single-pass native MXU mode. The product of two bf16
+    values is exact in f32, so only the operand rounding (~2⁻⁸ relative)
+    is lost — the accumulation itself carries full f32 precision. The MXU
+    ``precision`` knob is meaningless for bf16-stored operands (there is
+    nothing to multi-pass) and is deliberately not forwarded. ``tier=None``
+    / ``"f32"`` is the exact prior program (already-f32 operands pass
+    through ``astype`` untouched, so the f32 tier emits zero extra ops)."""
+    if tier == "bf16":
+        return jnp.matmul(
+            a.astype(jnp.bfloat16),
+            b.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
     return jnp.matmul(a, b, precision=_PRECISIONS[precision or _solver_precision])
 
 
@@ -120,31 +187,39 @@ def _apply_mask(A, b, mask):
     return A, b
 
 
-def _gram_and_cross(A, b, precision: str, omesh):
+def _gram_and_cross(A, b, precision: str, omesh, tier: str = "f32"):
     """Gram + cross term for the normal-equations system: the tiled
     reduce-scatter collective matmul when ``omesh`` is set (the overlap
     knob, ``parallel/overlap.py``), else the monolithic ``hdot`` whose row
     contraction XLA all-reduces. The choice is static (shapes + mesh), made
-    once per compiled program."""
+    once per compiled program. ``tier="bf16"`` stores the matmul operands
+    in bfloat16 and accumulates f32 (``hdot``); the collective reductions
+    always ride the f32 accumulator outputs."""
     from keystone_tpu.parallel.overlap import maybe_tiled_transpose_matmul
 
-    gram = maybe_tiled_transpose_matmul(A, None, omesh, precision=precision)
-    atb = maybe_tiled_transpose_matmul(A, b, omesh, precision=precision)
+    gram = maybe_tiled_transpose_matmul(
+        A, None, omesh, precision=precision, tier=tier
+    )
+    atb = maybe_tiled_transpose_matmul(
+        A, b, omesh, precision=precision, tier=tier
+    )
     return gram, atb
 
 
-@functools.partial(jax.jit, static_argnames=("precision", "omesh"))
-def _normal_equations(A, b, lam, mask, precision: str, omesh=None):
+@functools.partial(jax.jit, static_argnames=("precision", "omesh", "tier"))
+def _normal_equations(A, b, lam, mask, precision: str, omesh=None,
+                      tier: str = "f32"):
     A, b = _apply_mask(A, b, mask)
-    gram, atb = _gram_and_cross(A, b, precision, omesh)
+    gram, atb = _gram_and_cross(A, b, precision, omesh, tier)
     d = A.shape[1]
     return spd_solve(gram + lam * jnp.eye(d, dtype=A.dtype), atb)
 
 
-@functools.partial(jax.jit, static_argnames=("precision", "omesh"))
-def _normal_equations_lstsq(A, b, mask, precision: str, omesh=None):
+@functools.partial(jax.jit, static_argnames=("precision", "omesh", "tier"))
+def _normal_equations_lstsq(A, b, mask, precision: str, omesh=None,
+                            tier: str = "f32"):
     A, b = _apply_mask(A, b, mask)
-    gram, atb = _gram_and_cross(A, b, precision, omesh)
+    gram, atb = _gram_and_cross(A, b, precision, omesh, tier)
     return jnp.linalg.lstsq(gram, atb)[0]
 
 
@@ -154,6 +229,7 @@ def normal_equations_solve(
     lam: Optional[float] = None,
     mask: Optional[jax.Array] = None,
     overlap: Optional[bool] = None,
+    tier: Optional[str] = None,
 ) -> jax.Array:
     """Solve ``min ||AW - b||² (+ lam·||W||²)`` via the normal equations.
 
@@ -162,6 +238,11 @@ def normal_equations_solve(
     rank deficiency, like the unregularized ``solveLeastSquares``).
     ``overlap`` opts the gram/cross reductions into the tiled reduce-scatter
     collective matmul (None = the ``KEYSTONE_OVERLAP`` knob).
+    ``tier`` (None = the ``KEYSTONE_PRECISION_TIER`` knob) stores the
+    gram/cross matmul operands in bfloat16 with f32 accumulation — the d×d
+    solve itself always runs f32. Note the gram's O(κ²) conditioning
+    amplifies the bf16 operand rounding; κ-sensitive systems belong on the
+    TSQR rung at either tier.
     """
     from keystone_tpu import telemetry
     from keystone_tpu.parallel.overlap import overlap_mesh
@@ -169,6 +250,7 @@ def normal_equations_solve(
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
     precision = get_solver_precision()
+    tier = resolve_precision_tier(tier)
     omesh = overlap_mesh(overlap)
     n, d = A.shape
     c = b.shape[1] if b.ndim == 2 else 1
@@ -186,10 +268,12 @@ def normal_equations_solve(
         )
         if lam is None or lam == 0.0:
             return sp.track(
-                _normal_equations_lstsq(A, b, mask, precision, omesh)
+                _normal_equations_lstsq(A, b, mask, precision, omesh, tier)
             )
         return sp.track(
-            _normal_equations(A, b, device_scalar(lam), mask, precision, omesh)
+            _normal_equations(
+                A, b, device_scalar(lam), mask, precision, omesh, tier
+            )
         )
 
 
@@ -250,18 +334,21 @@ def tsqr_r(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "ridge", "precision", "overlap", "tiers"),
+    static_argnames=("mesh", "ridge", "precision", "overlap", "tiers", "tier"),
 )
 def _tsqr_solve(
     A, b, lam, mask, mesh: Mesh, ridge: bool, precision: str = "highest",
-    overlap: bool = False, tiers=None,
+    overlap: bool = False, tiers=None, tier: str = "f32",
 ):
     A, b = _apply_mask(A, b, mask)
     d = A.shape[1]
 
     def local(Ai, bi):
         Qi, Ri = jnp.linalg.qr(Ai, mode="reduced")
-        Zi = hdot(Qi.T, bi, precision)  # this shard's Qᵀb contribution, rotated
+        # Qᵀb contribution: under the bf16 tier this product stores its
+        # operands bf16/accumulates f32; the QR factorization itself (the
+        # O(κ)-stability source of this rung) always stays f32.
+        Zi = hdot(Qi.T, bi, precision, tier=tier)
         if overlap:
             # overlapped R-tree (parallel/overlap.py::ring_tsqr_fold): the
             # (R_i, Z_i) pairs circulate via paired ppermutes and fold into
@@ -270,12 +357,14 @@ def _tsqr_solve(
             # (tier-aware on multi-slice meshes: slice results only on DCN)
             from keystone_tpu.parallel.overlap import ring_tsqr_fold
 
-            return ring_tsqr_fold(Ri, Zi, "data", precision, tiers=tiers)
+            return ring_tsqr_fold(
+                Ri, Zi, "data", precision, tiers=tiers, tier=tier
+            )
         Rs = jax.lax.all_gather(Ri, "data")  # (k, d, d) over ICI
         Q2, R2 = jnp.linalg.qr(Rs.reshape(-1, d), mode="reduced")
         i = jax.lax.axis_index("data")
         Q2i = jax.lax.dynamic_slice_in_dim(Q2, i * d, d, 0)
-        qtb = jax.lax.psum(hdot(Q2i.T, Zi, precision), "data")
+        qtb = jax.lax.psum(hdot(Q2i.T, Zi, precision, tier=tier), "data")
         return R2, qtb
 
     # Replicated by construction (identical second-level QR everywhere);
@@ -290,6 +379,8 @@ def _tsqr_solve(
 
     if ridge:
         # min ‖AW-b‖²+lam‖W‖² = min ‖[A;√lam·I]W-[b;0]‖²: QR the augmented R.
+        # The (d, d)-sized epilogue stays f32 at every tier — trimming the
+        # already-reduced factors would lose accuracy for zero HBM savings.
         aug = jnp.concatenate(
             [R, jnp.sqrt(lam) * jnp.eye(d, dtype=A.dtype)], axis=0
         )
@@ -305,6 +396,7 @@ def tsqr_solve(
     mask: Optional[jax.Array] = None,
     mesh: Optional[Mesh] = None,
     overlap: Optional[bool] = None,
+    tier: Optional[str] = None,
 ) -> jax.Array:
     """Least squares via TSQR, applying Qᵀ to b through the reduction tree —
     the backward-stable O(κ(A)) path, unlike the normal equations' O(κ²).
@@ -322,6 +414,7 @@ def tsqr_solve(
     mesh = mesh or get_mesh()
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
+    tier = resolve_precision_tier(tier)
     use_ring = overlap_mesh(overlap, mesh) is not None
     # tier map resolved HERE (eager, per call) and threaded through jit as
     # a static argument — read inside the jit body it would bake the first
@@ -347,5 +440,6 @@ def tsqr_solve(
             _tsqr_solve(
                 A, b, jnp.float32(lam), mask, mesh, lam > 0.0,
                 get_solver_precision(), overlap=use_ring, tiers=tiers,
+                tier=tier,
             )
         )

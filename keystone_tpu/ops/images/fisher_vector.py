@@ -244,7 +244,14 @@ def _fv_cols_batch_pallas(x, gmm: GaussianMixtureModel, lo: int, hi: int):
     to f32 rounding (pinned in ``tests/test_pallas_extraction.py``). The
     kernel always accumulates full-k moments — they ride the posterior
     matmuls already in VMEM, so a narrow [lo, hi) block costs the same
-    kernel pass as a full-range call."""
+    kernel pass as a full-range call.
+
+    Under ``KEYSTONE_PRECISION_TIER=bf16`` the kernel streams its
+    descriptor tiles in bfloat16 (half the dominant HBM read) and the tier
+    joins the tile-cache key; resolution happens where the tile is
+    resolved — the same trace-time-read semantics as
+    :func:`_fv_moment_impl`'s own knob."""
+    from keystone_tpu.linalg.solvers import resolve_precision_tier
     from keystone_tpu.ops.pallas.extraction import fv_encode_tile, fv_moments
 
     n_img, nd, d = x.shape
@@ -253,9 +260,12 @@ def _fv_cols_batch_pallas(x, gmm: GaussianMixtureModel, lo: int, hi: int):
         return jnp.zeros((0, (hi - lo) * d), jnp.float32)
     from keystone_tpu.core.cache import has_tracers
 
-    tile_nd = fv_encode_tile(nd, d, k, allow_sweep=not has_tracers(x))
+    tier = resolve_precision_tier(None)
+    tile_nd = fv_encode_tile(
+        nd, d, k, allow_sweep=not has_tracers(x), tier=tier
+    )
     qsum_full, qx_full, qx2_full = fv_moments(
-        x, gmm.means, gmm.variances, gmm.weights, tile_nd=tile_nd
+        x, gmm.means, gmm.variances, gmm.weights, tile_nd=tile_nd, tier=tier
     )
     inv_n = 1.0 / nd
     m_rng = (lo, min(hi, k)) if lo < k else None

@@ -112,6 +112,30 @@ def shape_bucket(*dims: int) -> str:
     return "x".join(parts)
 
 
+#: storage dtype tiers a bucket key may be qualified with (the
+#: KEYSTONE_PRECISION_TIER values; mirrors linalg.solvers.PRECISION_TIERS
+#: without importing jax at module load)
+KNOWN_TIERS = ("f32", "bf16")
+
+
+def precision_bucket(bucket: str, tier: Optional[str] = None) -> str:
+    """Precision joins tile shape in the cache key: a winner swept for
+    bf16-stored operands must never serve an f32 call or vice versa — the
+    two dtypes have different VMEM footprints, MXU pass counts and
+    bandwidth balances, so their optimal tiles differ. ``"f32"``/None keeps
+    the bare shape bucket (every pre-tier cache entry remains a valid f32
+    winner); other tiers append ``@<tier>`` (``"16384x256@bf16"``).
+    Unknown tiers raise — a typo'd tier silently creating its own cache
+    partition would never be served."""
+    if tier in (None, "f32"):
+        return bucket
+    if tier not in KNOWN_TIERS:
+        raise ValueError(
+            f"precision tier must be one of {KNOWN_TIERS}: {tier!r}"
+        )
+    return f"{bucket}@{tier}"
+
+
 def cache_path() -> str:
     """``KEYSTONE_AUTOTUNE_CACHE`` when set, else ``autotune_cache.json`` at
     the repo root (next to ``lint_baseline.json`` — same ratchet-artifact
@@ -151,6 +175,11 @@ def _sanitize(raw: Any) -> Optional[Dict[str, Any]]:
             good = {
                 b: e for b, e in buckets.items()
                 if isinstance(e, dict) and "value" in e
+                # precision-qualified buckets ("<shape>@<tier>") must name
+                # a KNOWN tier: an entry for a tier this build does not
+                # speak (hand edit, future format) is stale and must not
+                # shadow — or be mistaken for — a real winner
+                and ("@" not in b or b.rsplit("@", 1)[1] in KNOWN_TIERS)
             }
             pruned = pruned or len(good) != len(buckets)
             if good:

@@ -105,8 +105,13 @@ def _count(event: str, **labels) -> None:
 
 
 def _sift_bins_kernel(mag_ref, ang_ref, sel_ref, out_ref, *, q_pad: int):
-    mag = mag_ref[:]  # (TR, W)
-    ang = ang_ref[:]
+    # bf16-input variant (KEYSTONE_PRECISION_TIER=bf16): the refs stream
+    # bfloat16 tiles HBM→VMEM (half the traffic of the kernel's dominant
+    # read) and upcast IN VMEM — all binning arithmetic and the selection
+    # matmul accumulate f32. For f32 inputs the astype is a no-op, so the
+    # f32-tier program is byte-identical to the pre-tier kernel.
+    mag = mag_ref[:].astype(jnp.float32)  # (TR, W)
+    ang = ang_ref[:].astype(jnp.float32)
     ft = jnp.mod(ang * (NUM_BIN_T / (2.0 * jnp.pi)), NUM_BIN_T)
     sel = sel_ref[:]  # (W, Qp); padded columns are zero -> poison-free
     for t in range(NUM_BIN_T):
@@ -149,12 +154,18 @@ def _sift_bins_pallas(mag2, ang2, sel_p, *, tile_r: int, interpret: bool):
 
 
 def sift_bins_tile(rows: int, width: int, q: int,
-                   allow_sweep: bool = True) -> int:
-    """Autotuned row-tile height for ``sift.bins`` at this shape bucket.
-    ``allow_sweep=False`` is lookup-only — pass it when resolving from
-    inside a trace (a sweep times real executions)."""
-    bucket = autotune.shape_bucket(rows, width)
+                   allow_sweep: bool = True, tier: str = "f32") -> int:
+    """Autotuned row-tile height for ``sift.bins`` at this shape bucket —
+    and this precision tier: the tier joins the bucket key
+    (``autotune.precision_bucket``), so a bf16-swept winner never serves an
+    f32 call or vice versa, and the sweep itself times operands of the
+    tier's storage dtype. ``allow_sweep=False`` is lookup-only — pass it
+    when resolving from inside a trace (a sweep times real executions)."""
+    bucket = autotune.precision_bucket(
+        autotune.shape_bucket(rows, width), tier
+    )
     q_pad = _round_up(max(q, 1), _LANE)
+    in_dtype = jnp.bfloat16 if tier == "bf16" else jnp.float32
 
     def build(tile):
         key = jax.random.key(0)
@@ -165,7 +176,8 @@ def sift_bins_tile(rows: int, width: int, q: int,
         sel = jnp.zeros((width, q_pad), jnp.float32).at[:, :q].set(1.0)
         interp = default_interpret()
         return lambda i: _sift_bins_pallas(
-            mag + float(i), ang, sel, tile_r=tile, interpret=interp
+            (mag + float(i)).astype(in_dtype), ang.astype(in_dtype), sel,
+            tile_r=tile, interpret=interp,
         )
 
     candidates = [t for t in (128, 256, 512, 1024) if t <= max(rows, 128)]
@@ -176,11 +188,14 @@ def sift_bins_tile(rows: int, width: int, q: int,
 
 
 def sift_oriented_bins(mag, angle, sel: np.ndarray, *, tile_r: int = 256,
-                       interpret: Optional[bool] = None):
+                       interpret: Optional[bool] = None, tier: str = "f32"):
     """Fused ``energies @ sel`` without materializing the energies:
     (..., H, W) magnitude/orientation + (W, Q) 0/1 selection matrix ->
     (..., NUM_BIN_T, H, Q). Traceable (called inside the SIFT extractor's
-    jit); ``tile_r`` must already be resolved (jit-static)."""
+    jit); ``tile_r`` must already be resolved (jit-static). ``tier="bf16"``
+    (caller-resolved, like the tile) stores the streamed mag/angle tiles in
+    bfloat16 — the kernel upcasts in VMEM and accumulates f32; output is
+    always f32."""
     lead = mag.shape[:-2]
     h, w = mag.shape[-2], mag.shape[-1]
     q = sel.shape[1]
@@ -188,9 +203,10 @@ def sift_oriented_bins(mag, angle, sel: np.ndarray, *, tile_r: int = 256,
     sel_p = jnp.zeros((w, q_pad), jnp.float32).at[:, :q].set(
         jnp.asarray(sel, jnp.float32)
     )
+    in_dtype = jnp.bfloat16 if tier == "bf16" else jnp.float32
     rows = int(np.prod(lead, dtype=np.int64)) * h if lead else h
-    mag2 = mag.reshape(rows, w).astype(jnp.float32)
-    ang2 = angle.reshape(rows, w).astype(jnp.float32)
+    mag2 = mag.reshape(rows, w).astype(in_dtype)
+    ang2 = angle.reshape(rows, w).astype(in_dtype)
     if interpret is None:
         interpret = default_interpret()
     _count("engaged", kernel="sift.bins")
@@ -224,7 +240,10 @@ def _fv_moments_kernel(
         qx_ref[:] = jnp.zeros_like(qx_ref)
         qx2_ref[:] = jnp.zeros_like(qx2_ref)
 
-    x = x_ref[0]  # (TND, d)
+    # bf16-input variant: descriptor tiles stream HBM→VMEM in bfloat16
+    # under the tier and upcast here — posterior/moment arithmetic always
+    # accumulates f32 (no-op astype for f32 inputs: byte-identical)
+    x = x_ref[0].astype(jnp.float32)  # (TND, d)
     tile_nd = x.shape[0]
     row_ids = j * tile_nd + jax.lax.broadcasted_iota(
         jnp.int32, (tile_nd, 1), 0
@@ -284,12 +303,15 @@ def _fv_moments_pallas(x, A, B, c, *, tile_nd: int, interpret: bool):
 
 
 def fv_encode_tile(nd: int, d: int, k: int,
-                   allow_sweep: bool = True) -> int:
-    """Autotuned descriptor-tile height for ``fv.encode``.
+                   allow_sweep: bool = True, tier: str = "f32") -> int:
+    """Autotuned descriptor-tile height for ``fv.encode``; the precision
+    tier joins the shape bucket (``autotune.precision_bucket``) and the
+    sweep times operands of the tier's storage dtype.
     ``allow_sweep=False`` is lookup-only (resolution from inside a
     trace)."""
-    bucket = autotune.shape_bucket(nd, d, k)
+    bucket = autotune.precision_bucket(autotune.shape_bucket(nd, d, k), tier)
     k_pad = _round_up(max(k, 1), _LANE)
+    in_dtype = jnp.bfloat16 if tier == "bf16" else jnp.float32
 
     def build(tile):
         key = jax.random.key(1)
@@ -299,7 +321,8 @@ def fv_encode_tile(nd: int, d: int, k: int,
         c = jnp.zeros((1, k_pad), jnp.float32)
         interp = default_interpret()
         return lambda i: _fv_moments_pallas(
-            x + float(i) * 1e-3, A, B, c, tile_nd=tile, interpret=interp
+            (x + float(i) * 1e-3).astype(in_dtype), A, B, c,
+            tile_nd=tile, interpret=interp,
         )
 
     candidates = [t for t in (64, 128, 256, 512) if t <= _round_up(nd, 64)]
@@ -310,15 +333,19 @@ def fv_encode_tile(nd: int, d: int, k: int,
 
 
 def fv_moments(x, means, variances, weights, *, tile_nd: int = 256,
-               interpret: Optional[bool] = None):
+               interpret: Optional[bool] = None, tier: str = "f32"):
     """Per-image uncentered GMM moments without HBM posteriors:
     (n_img, nd, d) descriptors -> ``(qsum (n,k), qx (n,k,d), qx2 (n,k,d))``.
     Traceable; the caller resolves ``tile_nd`` eagerly (jit-static). Same
     affine log-density as every other moments path (``_affine_params`` —
-    the single source of truth the parity tests pin)."""
+    the single source of truth the parity tests pin). ``tier="bf16"``
+    streams the descriptor tiles in bfloat16 (the kernel's dominant read);
+    GMM parameters, posterior math and the moment accumulators stay f32."""
     from keystone_tpu.ops.pallas.moments import _prep_params
 
     x = jnp.asarray(x, jnp.float32)
+    if tier == "bf16":
+        x = x.astype(jnp.bfloat16)
     d = x.shape[2]
     k = means.shape[0]
     k_pad = _round_up(k, _LANE)

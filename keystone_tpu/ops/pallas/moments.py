@@ -66,7 +66,7 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _tile_n(measure=None) -> int:
+def _tile_n(measure=None, tier: str = "f32") -> int:
     """Row-tile height via the shared tile-resolution path. Lookup-only by
     default (``moments_from_aug`` runs inside the jitted EM loop — a sweep
     there would time kernels at trace time); the eager one-shot entry
@@ -74,12 +74,14 @@ def _tile_n(measure=None) -> int:
     sweeps once and persists. Bucket is ``"any"``: the winning row tile is a
     device-generation property (VMEM/MXU balance), not a shape property —
     and a single value keeps :func:`augment_rows` padding and the kernel
-    grid consistent by construction."""
+    grid consistent by construction. The precision tier qualifies the
+    bucket (``"any@bf16"``) — bf16 tiles hold twice the rows per VMEM byte,
+    so the two tiers tune independently."""
     from keystone_tpu.ops.pallas import autotune
 
     return int(autotune.resolve(
-        "moments.tile_n", "any", _TILE_N_CANDIDATES, _TILE_N_DEFAULT,
-        measure=measure,
+        "moments.tile_n", autotune.precision_bucket("any", tier),
+        _TILE_N_CANDIDATES, _TILE_N_DEFAULT, measure=measure,
     ))
 
 
@@ -175,7 +177,13 @@ def _moments_kernel_sep(
         jnp.int32, (tile_n, 1), 0
     )
     valid = row_ids < n_rows  # (T, 1); False only in the final ragged tile
-    x = jnp.where(valid, x_ref[:] - ctr_ref[:], 0.0)  # (T, D) centered
+    # bf16-input variant: the x tile streams HBM→VMEM in bfloat16 under
+    # KEYSTONE_PRECISION_TIER=bf16 and upcasts here; centering, the
+    # log-density matmuls and the moment accumulators all stay f32 (no-op
+    # astype on the f32 tier — byte-identical prior kernel).
+    x = jnp.where(
+        valid, x_ref[:].astype(jnp.float32) - ctr_ref[:], 0.0
+    )  # (T, D) centered
     x2 = x * x
     ll = (
         jnp.dot(x, a_ref[:], preferred_element_type=jnp.float32)
@@ -234,6 +242,7 @@ def gmm_moments_sep(
     *,
     center: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
+    tier: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """:func:`gmm_moments` through the copy-free separate-input kernel.
 
@@ -244,7 +253,17 @@ def gmm_moments_sep(
     Ragged n is handled by the kernel's in-tile row mask (the grid
     ceil-divides n and x is consumed whole), so at n=1e7 — where
     1e7 % 512 = 128 — no near-full slice copy of x is ever materialized.
+
+    ``tier`` (None = the ``KEYSTONE_PRECISION_TIER`` knob, resolved here
+    eagerly): ``"bf16"`` hands the kernel a bfloat16-stored x — HALF the
+    O(n·d) HBM traffic this kernel exists to minimize — with centering and
+    all moment accumulation still f32 in VMEM. The center statistic itself
+    is computed from the f32 input before the cast. The small-n XLA
+    fallbacks below ignore the tier (no bandwidth to save there).
     """
+    from keystone_tpu.linalg.solvers import resolve_precision_tier
+
+    tier = resolve_precision_tier(tier)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     x = jnp.asarray(x, jnp.float32)
@@ -268,6 +287,12 @@ def gmm_moments_sep(
         k_pad,
     )
     ctr = center.reshape(1, d)
+    x32 = x
+    if tier == "bf16":
+        # storage cast AFTER the f32 center statistic; the kernel upcasts
+        # per-tile in VMEM (x32 is kept un-cast for the XLA fallback below
+        # — that path streams nothing, so it must not pay the rounding)
+        x = x.astype(jnp.bfloat16)
 
     def _build(tile):
         # the sweep times THIS call's actual operands — the sweep is the
@@ -278,9 +303,9 @@ def gmm_moments_sep(
 
     from keystone_tpu.ops.pallas import autotune as _autotune
 
-    tile_n = _tile_n(measure=_autotune.chained_measure(_build))
+    tile_n = _tile_n(measure=_autotune.chained_measure(_build), tier=tier)
     if n < tile_n:
-        return gmm_moments_xla(x, means, variances, weights, row_weights,
+        return gmm_moments_xla(x32, means, variances, weights, row_weights,
                                center)
     qsum_p, qxc, qxc2 = _moments_pallas_sep(
         x, w, ctr, A, B, c, tile_n=tile_n, interpret=bool(interpret)

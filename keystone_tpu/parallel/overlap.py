@@ -185,41 +185,48 @@ def _env_tiles() -> Tuple[Optional[int], Optional[int]]:
     return parsed
 
 
-def _autotuned_tiles(dim: int, k: int) -> Optional[int]:
+def _autotuned_tiles(dim: int, k: int, tier: str = "f32") -> Optional[int]:
     """Device-keyed autotuner default for the tile-count target
     (``ops/pallas/autotune.py``, kernel id ``overlap.tiles``): a swept
-    winner for this (dim, k) shape bucket on this device generation, or
-    None. Lookup-only — the scheduler itself never times; winners are
-    recorded by the ``solver_overlap`` bench regime's gram sweep
-    (``scripts/bench_regime.py``, multi-device runs) or by pod tooling
-    via ``autotune.sweep``/``record``. The resolution order stays:
+    winner for this (dim, k) shape bucket — and this precision tier; a
+    bf16 winner must never serve an f32 schedule or vice versa, so the
+    tier joins the bucket key (``autotune.precision_bucket``) — on this
+    device generation, or None. Lookup-only — the scheduler itself never
+    times; winners are recorded by the ``solver_overlap`` bench regime's
+    gram sweep (``scripts/bench_regime.py``, multi-device runs), the
+    ``scripts/autotune_sweep.py`` CPU sweep, or pod tooling via
+    ``autotune.sweep``/``record``. The resolution order stays:
     explicit ``tiles=`` arg beats the ``KEYSTONE_OVERLAP_TILES`` env
     override beats this default beats the axis-size heuristic."""
     try:
         from keystone_tpu.ops.pallas import autotune
 
         val = autotune.lookup(
-            "overlap.tiles", autotune.shape_bucket(dim, k)
+            "overlap.tiles",
+            autotune.precision_bucket(autotune.shape_bucket(dim, k), tier),
         )
         return int(val) if val else None
     except Exception:  # tuning must never break a solver schedule
         return None
 
 
-def _pick_tiles(dim: int, k: int, target: Optional[int] = None) -> int:
+def _pick_tiles(
+    dim: int, k: int, target: Optional[int] = None, tier: str = "f32"
+) -> int:
     """Largest tile count ≤ ``target`` (default: the ``KEYSTONE_OVERLAP_TILES``
     env override when set, else the autotuner's device-keyed winner when
-    persisted (:func:`_autotuned_tiles`), else the axis size — so the
-    pipelined program carries ≥ k per-tile collectives when shapes allow)
-    such that ``dim`` splits into equal tiles each divisible by ``k``
-    (``psum_scatter`` scatters tile rows over the k shards). 0 = no valid
-    tiling (callers fall back to the monolithic reduction)."""
+    persisted (:func:`_autotuned_tiles`, keyed by shape bucket AND ``tier``),
+    else the axis size — so the pipelined program carries ≥ k per-tile
+    collectives when shapes allow) such that ``dim`` splits into equal tiles
+    each divisible by ``k`` (``psum_scatter`` scatters tile rows over the k
+    shards). 0 = no valid tiling (callers fall back to the monolithic
+    reduction)."""
     if dim % k:
         return 0
     if target is None:
         target = _env_tiles()[0]
     if target is None:
-        target = _autotuned_tiles(dim, k)
+        target = _autotuned_tiles(dim, k, tier)
     target = target or max(k, 1)
     for t in range(min(target, dim // k), 0, -1):
         if dim % (t * k) == 0:
@@ -305,6 +312,7 @@ def tiled_transpose_matmul(
     tiles: Optional[int] = None,
     precision: Optional[str] = None,
     tiers: Optional[Tuple[int, int]] = None,
+    tier: str = "f32",
 ) -> jax.Array:
     """Replicated ``XᵀY`` (``y=None`` → the gram ``XᵀX``) for row-sharded
     operands, as a tiled reduce-scatter collective matmul.
@@ -316,6 +324,11 @@ def tiled_transpose_matmul(
     tile *t+1*; one trailing ``all_gather`` + reorder replicates the result.
     ``tiers`` (default: :func:`mesh_tiers` — the probe / ``KEYSTONE_MESH_TIERS``)
     engages the two-tier ICI/DCN schedule on multi-slice meshes.
+    ``tier="bf16"`` (the ``KEYSTONE_PRECISION_TIER`` storage tier, resolved
+    by the caller) stores the per-tile matmul operands in bfloat16 and
+    accumulates f32 — the per-tile reductions and the trailing all-gather
+    always ride the f32 accumulator outputs, so collectives never carry
+    bf16 partial sums.
     Raises ``ValueError`` when n or dx cannot be divided — use
     :func:`maybe_tiled_transpose_matmul` for the silently-falling-back form.
     """
@@ -331,7 +344,7 @@ def tiled_transpose_matmul(
         raise ValueError(
             f"row count {n} must be divisible by the '{axis}' axis size {k}"
         )
-    T = tiles or _pick_tiles(dx, k)
+    T = tiles or _pick_tiles(dx, k, tier=tier)
     if T == 0 or dx % (T * k):
         raise ValueError(
             f"feature dim {dx} cannot be tiled {tiles or '(auto)'}-way over "
@@ -349,7 +362,8 @@ def tiled_transpose_matmul(
         # psum_scatter + trailing all_gather schedule; divisibility was
         # validated above, so the monolithic-psum fallback cannot trigger.
         return tiled_psum_dot(
-            xi.T, yi, axis, tiles=T, precision=precision, tiers=tiers
+            xi.T, yi, axis, tiles=T, precision=precision, tiers=tiers,
+            tier=tier,
         )
 
     spec = P(axis, None)
@@ -367,13 +381,17 @@ def maybe_tiled_transpose_matmul(
     axis: str = "data",
     tiles: Optional[int] = None,
     precision: Optional[str] = None,
+    tier: str = "f32",
 ) -> jax.Array:
     """:func:`tiled_transpose_matmul` when the mesh/shapes allow it, else the
     monolithic ``hdot`` (whose row contraction XLA all-reduces). All checks
     run at trace time — shapes are static — so inside a jitted solver body
     this picks ONE path per compiled program, never a runtime branch.
     A shape-driven fallback on a live overlap mesh is logged once per shape
-    (:func:`_log_fallback`) so a mis-tiled run is visible in the log."""
+    (:func:`_log_fallback`) so a mis-tiled run is visible in the log.
+    ``tier`` (the caller-resolved storage dtype tier) applies on BOTH paths
+    — a fallback must not silently lose the bf16 storage the caller asked
+    for."""
     yy = x if y is None else y
     if (
         mesh is None
@@ -382,23 +400,24 @@ def maybe_tiled_transpose_matmul(
         or x.ndim != 2
         or yy.ndim != 2
     ):
-        return hdot(x.T, yy, precision)
+        return hdot(x.T, yy, precision, tier=tier)
     k = mesh.shape[axis]
     if x.shape[0] % k:
         _log_fallback(
             "maybe_tiled_transpose_matmul",
             f"rows {x.shape[0]} % '{axis}' size {k} != 0",
         )
-        return hdot(x.T, yy, precision)
-    if _pick_tiles(x.shape[1], k, tiles) == 0:
+        return hdot(x.T, yy, precision, tier=tier)
+    if _pick_tiles(x.shape[1], k, tiles, tier=tier) == 0:
         _log_fallback(
             "maybe_tiled_transpose_matmul",
             f"feature dim {x.shape[1]} has no tiling over '{axis}' size {k}"
             + (f" with tiles={tiles}" if tiles else ""),
         )
-        return hdot(x.T, yy, precision)
+        return hdot(x.T, yy, precision, tier=tier)
     return tiled_transpose_matmul(
-        x, yy, mesh=mesh, axis=axis, tiles=tiles, precision=precision
+        x, yy, mesh=mesh, axis=axis, tiles=tiles, precision=precision,
+        tier=tier,
     )
 
 
@@ -410,6 +429,7 @@ def tiled_psum_dot(
     precision: Optional[str] = None,
     tiers: Optional[Tuple[int, int]] = None,
     outer_tiles: Optional[int] = None,
+    tier: str = "f32",
 ) -> jax.Array:
     """``psum(a @ b)`` over ``axis`` for use INSIDE a ``shard_map`` body,
     tiled so each tile's reduce-scatter overlaps the next tile's matmul
@@ -424,10 +444,20 @@ def tiled_psum_dot(
     are batched ``outer_tiles``-wise (default: one per slice, i.e. each DCN
     exchange hides behind ~T/outer inner tiles' MXU work; the second field
     of ``KEYSTONE_OVERLAP_TILES=T,To`` overrides): per-tier tile sizes, so
-    the slow tier always has more compute to hide behind."""
+    the slow tier always has more compute to hide behind.
+
+    ``tier="bf16"`` (the storage dtype tier, caller-resolved static) casts
+    ``a``/``b`` to bfloat16 ONCE before tiling — each per-tile ``hdot``
+    then reads bf16 operands and accumulates f32, so the reductions below
+    always carry f32 partial products."""
     k = jax.lax.axis_size(axis)
     m = a.shape[0]
-    T = tiles or _pick_tiles(m, k)
+    T = tiles or _pick_tiles(m, k, tier=tier)
+    if tier == "bf16":
+        # one cast for all tiles (hdot's own astype is then a no-op); the
+        # f32 path touches nothing — astype is identity on f32 operands
+        a = a.astype(jnp.bfloat16)
+        b = b.astype(jnp.bfloat16)
     if k <= 1 or T == 0 or m % (T * k):
         # per-trace monolithic-psum decision (no log: the eager wrappers
         # already log their own shape fallbacks; the counter keeps the
@@ -436,13 +466,14 @@ def tiled_psum_dot(
             "fallback", site="tiled_psum_dot",
             reason="trivial_axis" if k <= 1 else "no_tiling",
         )
-        return jax.lax.psum(hdot(a, b, precision), axis)
+        return jax.lax.psum(hdot(a, b, precision, tier=tier), axis)
     # a tier map probed from a different axis (or hand-tuned wrong) must
     # not silently run single-tier — _resolve_tiers logs the degradation
     outer, inner = _resolve_tiers(tiers, k, "tiled_psum_dot")
     tb = m // T
     partials = [
-        hdot(a[t * tb : (t + 1) * tb], b, precision) for t in range(T)
+        hdot(a[t * tb : (t + 1) * tb], b, precision, tier=tier)
+        for t in range(T)
     ]
     from keystone_tpu.telemetry import get_registry as _reg
 
@@ -571,6 +602,7 @@ def bidirectional_ring_gram(
     mesh: Optional[Mesh] = None,
     axis: str = "model",
     precision: str = "highest",
+    tier: str = "f32",
 ) -> jax.Array:
     """``XᵀX`` with the feature axis sharded over ``axis`` — the
     bidirectional schedule of ``ring.ring_gram``.
@@ -583,7 +615,9 @@ def bidirectional_ring_gram(
     at most half the ring (half the per-link wire time of the unidirectional
     rotation). Every tile is the same ``hdot`` on the same operands as the
     unidirectional schedule, so the output is bit-identical to
-    ``ring_gram(..., bidirectional=False)``.
+    ``ring_gram(..., bidirectional=False)`` — at the default f32 tier;
+    ``tier="bf16"`` trades that bit-identity for bf16 resident blocks
+    (half the ring's wire bytes) with f32 tile accumulation.
 
     The rounds are unrolled (k is static and small): the compiled HLO shows
     the paired collective-permutes per round — the structure the comm-pattern
@@ -610,11 +644,19 @@ def bidirectional_ring_gram(
     )
 
     def local(xj):
+        # bf16 tier: the RESIDENT block is cast once; ring hops then carry
+        # bf16 payloads (half the per-link wire bytes — the storage tier's
+        # second win on this schedule) while every tile still accumulates
+        # f32 via hdot's preferred_element_type.
+        acc_dtype = jnp.float32 if tier == "bf16" else xj.dtype
+        xj = xj.astype(jnp.bfloat16) if tier == "bf16" else xj
+
         def fold(src, visiting, out):
-            tile = hdot(visiting.T, xj, precision)  # (db, db): X_srcᵀ X_j
+            # (db, db): X_srcᵀ X_j, f32 accumulator under the bf16 tier
+            tile = hdot(visiting.T, xj, precision, tier=tier)
             return jax.lax.dynamic_update_slice(out, tile, (src * db, 0))
 
-        out = jax.lax.pcast(jnp.zeros((d, db), xj.dtype), axis, to="varying")
+        out = jax.lax.pcast(jnp.zeros((d, db), acc_dtype), axis, to="varying")
         return _ring_rotate_fold(xj, axis, k, fold, out)
 
     spec = P(None, axis)
@@ -669,6 +711,7 @@ def ring_tsqr_fold(
     axis: str,
     precision: Optional[str] = None,
     tiers: Optional[Tuple[int, int]] = None,
+    tier: str = "f32",
 ):
     """The overlapped TSQR R-tree, for use INSIDE a ``shard_map`` body.
 
@@ -710,11 +753,15 @@ def ring_tsqr_fold(
     _count("engaged", site="ring_tsqr_fold")
 
     def fold(R_acc, Z_acc, Rs, Zs):
+        # panel QRs stay f32 at every tier (the rung's O(κ) stability);
+        # the tier applies only to the Qᵀ[Z…] product's operand storage
         stack = jnp.concatenate([R_acc] + Rs, axis=0)
         if Z_acc is None:
             return jnp.linalg.qr(stack, mode="r"), None
         Q, R = jnp.linalg.qr(stack, mode="reduced")
-        return R, hdot(Q.T, jnp.concatenate([Z_acc] + Zs, axis=0), precision)
+        return R, hdot(
+            Q.T, jnp.concatenate([Z_acc] + Zs, axis=0), precision, tier=tier
+        )
 
     def circulate(R_acc, Z_acc, R0, Z0, fwd_perm, bwd_perm, ksub):
         """One bidirectional fold stage over a ``ksub``-cycle of the perm
@@ -783,6 +830,7 @@ def model_tiled_transpose_matmul(
     model_axis: str = "model",
     tiles: Optional[int] = None,
     precision: Optional[str] = None,
+    tier: str = "f32",
 ) -> jax.Array:
     """Replicated ``XᵀY`` (``y=None`` → the gram ``XᵀX``) for a
     column-sharded ``x``: (n, dx) with ``P(data_axis, model_axis)`` — the
@@ -836,7 +884,7 @@ def model_tiled_transpose_matmul(
         def local_cross(xij, yi):
             cj = tiled_psum_dot(
                 xij.T, yi, data_axis, tiles=tiles, precision=precision,
-                tiers=tiers,
+                tiers=tiers, tier=tier,
             )  # (dl, c), replicated over data by construction
             full = jax.lax.all_gather(cj, model_axis)  # (km, dl, c)
             return full.reshape(dx, c)
@@ -850,17 +898,23 @@ def model_tiled_transpose_matmul(
         )(x, y)
 
     def local_gram(xij):
+        # bf16 tier: cast the resident block once — model-axis ring hops
+        # carry bf16 payloads; every tile's data-axis reduction still rides
+        # the f32 accumulator (tiled_psum_dot).
+        acc_dtype = jnp.float32 if tier == "bf16" else xij.dtype
+        xij = xij.astype(jnp.bfloat16) if tier == "bf16" else xij
+
         def fold(src, visiting, out):
             # (dl, dl) tile X_srcᵀ X_j, globally row-reduced via the tiled
             # data-axis reduce-scatter (two-tier aware)
             tile = tiled_psum_dot(
                 visiting.T, xij, data_axis, tiles=tiles,
-                precision=precision, tiers=tiers,
+                precision=precision, tiers=tiers, tier=tier,
             )
             return jax.lax.dynamic_update_slice(out, tile, (src * dl, 0))
 
         out = jax.lax.pcast(
-            jnp.zeros((dx, dl), xij.dtype), model_axis, to="varying"
+            jnp.zeros((dx, dl), acc_dtype), model_axis, to="varying"
         )
         out = _ring_rotate_fold(xij, model_axis, km, fold, out)
         # out: (dx, dl) column block, replicated over data; assemble the

@@ -72,6 +72,7 @@ def ring_gram(
     mesh: Optional[Mesh] = None,
     axis: str = "model",
     bidirectional: Optional[bool] = None,
+    tier: Optional[str] = None,
 ) -> jax.Array:
     """XᵀX for ``x`` (n, d) with the feature axis sharded over ``axis``.
 
@@ -87,13 +88,23 @@ def ring_gram(
     ``None`` resolves the overlap knob (``KEYSTONE_OVERLAP`` /
     ``use_overlap``), so existing call sites pick up the pipelined schedule
     when the knob is on.
+
+    ``tier`` (None = the ``KEYSTONE_PRECISION_TIER`` knob) engages
+    bf16-stored resident blocks on the bidirectional schedule — ring hops
+    then carry bf16 payloads (half the per-link wire bytes) while every
+    tile accumulates f32. The unidirectional fallback always runs f32 (it
+    exists as the exact prior program, like the overlap layer's monolithic
+    twins), so the f32 tier remains bit-identical either way.
     """
+    from keystone_tpu.linalg.solvers import resolve_precision_tier
     from keystone_tpu.parallel.mesh import get_mesh
     from keystone_tpu.parallel.overlap import bidirectional_ring_gram, overlap_enabled
 
     mesh = mesh or get_mesh()
     if overlap_enabled(bidirectional):
-        return bidirectional_ring_gram(x, mesh, axis=axis)
+        return bidirectional_ring_gram(
+            x, mesh, axis=axis, tier=resolve_precision_tier(tier)
+        )
     k = mesh.shape[axis]
     d = x.shape[1]
     if d % k:

@@ -373,6 +373,14 @@ declare("KEYSTONE_CHECK", "str", "auto",
         "including template-derived dim mismatches and C4/C5); '0' "
         "disables construction-time checking (the `keystone-tpu check` "
         "CLI still works).", choices=("auto", "0", "1"))
+declare("KEYSTONE_PRECISION_TIER", "str", "f32",
+        "Storage dtype tier for the solver/extraction hot paths: 'f32' "
+        "(default — byte-identical prior programs) or 'bf16' "
+        "(bfloat16-stored operands, float32 accumulation via "
+        "preferred_element_type) across the gram/cross matmuls, the "
+        "sketch application, and the bf16-input Pallas kernel variants. "
+        "Orthogonal to the MXU arithmetic-precision knob "
+        "(solvers.set_solver_precision).", choices=("f32", "bf16"))
 declare("KEYSTONE_SKETCH_BCD", "bool", False,
         "Leverage-score block scheduling for block coordinate descent: "
         "visit feature blocks in descending sketched-energy order instead "
@@ -429,6 +437,10 @@ declare("BENCH_CHECK", "bool", True,
         "Pipeline-contract section: run `keystone-tpu check` over the "
         "registered pipeline targets and record check_findings_total/"
         "check_new (budget-gated; exhaustion emits check_skipped).")
+declare("BENCH_PRECISION", "bool", True,
+        "Precision-tier section: bf16-vs-f32 gram + sketch rungs, each "
+        "speed key paired with a *_vs_f32_error_delta key (budget-gated; "
+        "exhaustion emits precision_skipped).")
 declare("BENCH_PLAN", "bool", True,
         "Whole-pipeline-optimizer section (core/plan.py): plan the "
         "flagship DAG under the HBM budget and record plan_* decision "

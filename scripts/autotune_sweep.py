@@ -1,0 +1,122 @@
+"""KEYSTONE_AUTOTUNE=1 sweep of the tunable kernel family at BOTH precision
+tiers, persisting winners into the repo-root ``autotune_cache.json``.
+
+The ROADMAP pod-ladder item (d) rung that needs no hardware: on the CPU
+backend (8-device sim for the overlap schedulers, interpret-mode Pallas for
+the extraction kernels) sweep
+
+- ``overlap.tiles``  — the tiled reduce-scatter gram's tile-count target at
+  the flagship (d=2048, k=8) bucket; candidates are multiples of k so every
+  winner preserves the >=k per-tile-collective structure the A1 audit pins;
+- ``sift.bins`` / ``fv.encode`` — the extraction kernels' row tiles;
+- ``moments.tile_n`` — the shared moments row tile (bucket "any");
+
+each at tier f32 AND tier bf16, so the committed cache demonstrates
+precision-keyed entries coexisting: ``"<bucket>"`` (f32) next to
+``"<bucket>@bf16"``, resolved independently by ``autotune.precision_bucket``
+consumers. CPU winners are keyed ``cpu:cpu`` — they serve CPU runs (tests,
+the bench host) and never leak to TPU keys.
+
+Run from the repo root: ``python scripts/autotune_sweep.py``; the refreshed
+``autotune_cache.json`` is meant to be committed (the zero-re-sweeps
+contract: every later process on this device generation hits the cache).
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["KEYSTONE_AUTOTUNE"] = "1"
+# bounded but roomy: interpret-mode Pallas candidates are slow on CPU
+os.environ.setdefault("KEYSTONE_AUTOTUNE_BUDGET_S", "60")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+TIERS = ("f32", "bf16")
+
+
+def sweep_overlap_tiles() -> None:
+    from keystone_tpu.ops.pallas import autotune
+    from keystone_tpu.parallel import make_mesh
+    from keystone_tpu.parallel.overlap import tiled_transpose_matmul
+
+    mesh = make_mesh(data=8, model=1)
+    k = mesh.shape["data"]
+    n, d = 1024, 2048  # the flagship feature dim's (dim, k) bucket
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x32 = jax.device_put(
+        jax.random.normal(jax.random.key(0), (n, d), jnp.float32),
+        NamedSharding(mesh, P("data", None)),
+    )
+    bucket = autotune.shape_bucket(d, k)
+    # candidates are multiples of k: every winner keeps >= k per-tile
+    # reduce-scatters (the A1 audit structure; _pick_tiles' heuristic
+    # default is exactly k)
+    candidates = [k, 2 * k, 4 * k]
+    for tier in TIERS:
+        key = autotune.precision_bucket(bucket, tier)
+
+        def build(tiles):
+            return lambda i: tiled_transpose_matmul(
+                x32, mesh=mesh, tiles=int(tiles), tier=tier
+            )
+
+        won = autotune.sweep(
+            "overlap.tiles", key, candidates,
+            autotune.chained_measure(build), reps=2,
+        )
+        print(f"overlap.tiles[{key}] -> {won}")
+
+
+def sweep_extraction() -> None:
+    from keystone_tpu.ops.pallas.extraction import fv_encode_tile, sift_bins_tile
+
+    # representative extraction shapes: a 2048-row/64-wide SIFT chunk and a
+    # 512-descriptor/64-dim/16-center FV encode
+    for tier in TIERS:
+        t = sift_bins_tile(2048, 64, 36, allow_sweep=True, tier=tier)
+        print(f"sift.bins tier={tier} -> {t}")
+    for tier in TIERS:
+        t = fv_encode_tile(512, 64, 16, allow_sweep=True, tier=tier)
+        print(f"fv.encode tier={tier} -> {t}")
+
+
+def sweep_moments() -> None:
+    from keystone_tpu.ops.pallas.moments import gmm_moments_sep
+
+    x = jax.random.normal(jax.random.key(3), (4096, 16), jnp.float32)
+    means = jax.random.normal(jax.random.key(4), (8, 16), jnp.float32)
+    variances = jnp.abs(
+        jax.random.normal(jax.random.key(5), (8, 16), jnp.float32)
+    ) + 0.5
+    weights = jnp.ones((8,), jnp.float32) / 8.0
+    for tier in TIERS:
+        gmm_moments_sep(x, means, variances, weights, tier=tier)
+        print(f"moments.tile_n tier={tier} swept")
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    sweep_extraction()
+    sweep_moments()
+    sweep_overlap_tiles()
+    from keystone_tpu.ops.pallas import autotune
+
+    path = autotune.cache_path()
+    print(f"swept in {time.monotonic() - t0:.1f}s -> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

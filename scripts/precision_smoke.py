@@ -1,0 +1,106 @@
+"""Precision-tier contract smoke (<20 s, CPU): the `make precision-smoke`
+rung of `verify-fast`.
+
+Pins, end to end through the REAL entry points:
+
+1. f32-tier byte-identity — with KEYSTONE_PRECISION_TIER unset, the lowered
+   normal-equations/BCD programs contain no bf16 and are text-identical to
+   an explicit tier="f32" call (the acceptance contract: the default tier
+   is the prior program).
+2. bf16 parity envelope — the bf16-tier normal-equations/BCD solutions land
+   within the documented ~2⁻⁸-operand-rounding envelope of their f32 twins,
+   and the bf16 program actually holds bf16 (the tier engaged — the silent
+   bf16→f32 drift the A3 intent registry polices).
+3. The sketch composition — the bf16 sketch → f32 QR → f32 CG solve's
+   error delta vs the f32 tier is an order of magnitude TIGHTER than the
+   raw gram delta (the CG-cleanup claim the tier's first-adopter choice
+   rests on).
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("KEYSTONE_PRECISION_TIER", None)
+
+t_start = time.monotonic()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+    from keystone_tpu.linalg.sketch import sketched_lstsq_solve
+    from keystone_tpu.linalg.solvers import (
+        hdot,
+        normal_equations_solve,
+        validate_precision,
+    )
+
+    A = jax.random.normal(jax.random.key(0), (1024, 128), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (1024, 8), jnp.float32)
+
+    # 1. f32 byte-identity (unset knob == explicit "f32"; no bf16 anywhere)
+    lowered_unset = jax.jit(lambda X: hdot(X.T, X, "high")).lower(A).as_text()
+    lowered_f32 = (
+        jax.jit(lambda X: hdot(X.T, X, "high", tier="f32")).lower(A).as_text()
+    )
+    assert lowered_unset == lowered_f32, "f32 tier is not the prior program"
+    assert "bf16" not in lowered_unset, "bf16 leaked into the f32 tier"
+
+    # 2. bf16 parity envelope + engagement
+    lowered_bf16 = (
+        jax.jit(lambda X: hdot(X.T, X, tier="bf16")).lower(A).as_text()
+    )
+    assert "bf16" in lowered_bf16, "bf16 tier did not engage"
+    w32 = normal_equations_solve(A, b, lam=1.0)
+    w16 = normal_equations_solve(A, b, lam=1.0, tier="bf16")
+    ne_delta = float(jnp.linalg.norm(w16 - w32) / jnp.linalg.norm(w32))
+    assert ne_delta < 0.02, f"normal-equations bf16 delta {ne_delta}"
+    wb32 = block_coordinate_descent_l2(A, b, 1.0, 32)
+    wb16 = block_coordinate_descent_l2(A, b, 1.0, 32, tier="bf16")
+    bcd_delta = float(jnp.linalg.norm(wb16 - wb32) / jnp.linalg.norm(wb32))
+    assert bcd_delta < 0.02, f"BCD bf16 delta {bcd_delta}"
+
+    # 3. sketch composition: CG cleanup tightens the bf16 rounding by >=10x
+    gram_delta = float(np.linalg.norm(
+        np.asarray(hdot(A.T, A, tier="bf16"), np.float64)
+        - np.asarray(hdot(A.T, A, "high"), np.float64)
+    ) / np.linalg.norm(np.asarray(hdot(A.T, A, "high"), np.float64)))
+    ws32 = sketched_lstsq_solve(A, b, lam=1.0, tol=1e-6, max_iters=50)
+    ws16 = sketched_lstsq_solve(
+        A, b, lam=1.0, tol=1e-6, max_iters=50, tier="bf16"
+    )
+    sk_delta = float(jnp.linalg.norm(ws16 - ws32) / jnp.linalg.norm(ws32))
+    assert sk_delta < gram_delta / 10.0, (
+        f"CG cleanup did not restore accuracy: sketch delta {sk_delta} vs "
+        f"gram delta {gram_delta}"
+    )
+
+    # the two precision vocabularies stay disjoint (the disambiguation)
+    try:
+        validate_precision("bf16")
+    except ValueError as e:
+        assert "KEYSTONE_PRECISION_TIER" in str(e)
+    else:
+        raise AssertionError("validate_precision accepted a tier string")
+
+    elapsed = time.monotonic() - t_start
+    print(
+        f"precision-smoke OK in {elapsed:.1f}s: f32 byte-identical; "
+        f"ne_delta={ne_delta:.2e} bcd_delta={bcd_delta:.2e} "
+        f"gram_delta={gram_delta:.2e} sketch_delta={sk_delta:.2e} "
+        f"(cleanup {gram_delta / max(sk_delta, 1e-12):.0f}x tighter)"
+    )
+    assert elapsed < 20.0, f"smoke took {elapsed:.1f}s (>20s contract)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,23 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     assert "sketch_gflops_per_chip_overlap" in full
     assert "sketch_vs_exact_error_delta_d65536" in full
     assert "sketch_vs_exact_d" in full
+    # precision-tier section (KEYSTONE_PRECISION_TIER): every bf16 speed
+    # key PAIRED with its *_vs_f32_error_delta twin, plus the backend +
+    # 16-bit-read-bandwidth honesty keys that contextualize the pair
+    for key in (
+        "gram_f32_gflops", "gram_bf16_gflops",
+        "gram_bf16_vs_f32_error_delta",
+        "sketch_f32_gflops", "sketch_bf16_gflops",
+        "sketch_bf16_vs_f32_error_delta",
+        "precision_backend", "precision_f32_read_gbs",
+        "precision_bf16_read_gbs",
+    ):
+        assert key in full, key
+    # the paired error deltas are small but REAL numbers (a None/absent
+    # delta next to a ratcheting speed key is the dishonesty this pins)
+    assert 0 <= full["gram_bf16_vs_f32_error_delta"] < 0.05
+    assert 0 <= full["sketch_bf16_vs_f32_error_delta"] < 0.05
+    assert compact["g_gram16"] == full["gram_bf16_gflops"]
     # whole-pipeline-optimizer rows (core/plan.py): the flagship plan's
     # decisions landed, and the repeat plan in the same process performed
     # ZERO re-plans (the content-fingerprinted memo served it)
@@ -148,6 +165,10 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # ... and the pipeline-contract section: same reduced-floor contract
     assert full.get("check_skipped") == "budget"
     assert "check_findings_total" not in full
+    # ... and the precision-tier section (PR 11): same reduced-floor
+    # contract — no speed key may land without its budget story
+    assert full.get("precision_skipped") == "budget"
+    assert "gram_bf16_gflops" not in full
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

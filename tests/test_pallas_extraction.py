@@ -53,10 +53,10 @@ def test_knob_zero_is_the_exact_prior_path(monkeypatch):
     node = SIFTExtractor()
     img = jnp.zeros((32, 32), jnp.float32)
     monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
-    assert _resolve_impl_and_tile(node, img) == ("auto", 0)
+    assert _resolve_impl_and_tile(node, img) == ("auto", 0, "f32")
     assert FV._fv_moment_impl() == "f32"  # CPU default, prior behavior
     monkeypatch.setenv("KEYSTONE_PALLAS", "0")
-    assert _resolve_impl_and_tile(node, img) == ("auto", 0)
+    assert _resolve_impl_and_tile(node, img) == ("auto", 0, "f32")
     assert FV._fv_moment_impl() == "f32"
     assert not E.pallas_enabled()
     assert not E.pallas_enabled(auto_ok=False)

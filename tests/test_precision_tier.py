@@ -1,0 +1,505 @@
+"""The KEYSTONE_PRECISION_TIER dtype tier (PR 11).
+
+Four contracts, each pinned here:
+
+1. **f32 tier == prior program** — with the knob unset (or explicitly
+   "f32") every rerouted path lowers to a program containing no bf16 and
+   returns bit-identical results to the pre-tier code (the tier's
+   acceptance criterion: default is a byte-identical no-op).
+2. **bf16 envelope** — the bf16-storage/f32-accumulate rungs land within
+   the documented ~2⁻⁸-operand-rounding envelope of their f32 twins; the
+   sketch solver specifically keeps its subspace-embedding quality and,
+   thanks to the f32 CG cleanup, a final error an order of magnitude
+   TIGHTER than the raw bf16 gram rounding.
+3. **autotune isolation** — precision joins tile shape in the cache key: a
+   bf16 winner never serves an f32 call (and vice versa), and unknown-tier
+   bucket entries are pruned by the stale-entry sanitizer.
+4. **A3 intent registry** — each audit entry point's declared
+   (storage, accumulate) dtypes are enforced in BOTH directions: silent
+   f32→bf16 drift and a bf16 tier that quietly serves f32 are findings.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+from keystone_tpu.linalg.sketch import (
+    sketch_matrix,
+    sketch_rows,
+    sketched_lstsq_solve,
+)
+from keystone_tpu.linalg.solvers import (
+    hdot,
+    normal_equations_solve,
+    resolve_precision_tier,
+    tsqr_solve,
+    validate_precision,
+)
+from keystone_tpu.parallel import make_mesh
+
+
+def _system(n=512, d=64, c=4, seed=0):
+    A = jax.random.normal(jax.random.key(seed), (n, d), jnp.float32)
+    b = jax.random.normal(jax.random.key(seed + 1), (n, c), jnp.float32)
+    return A, b
+
+
+def _rel(a, b):
+    return float(jnp.linalg.norm(a - b) / jnp.linalg.norm(b))
+
+
+# ---------------------------------------------------------------------------
+# 1. f32 tier is the prior program, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def test_f32_tier_lowers_with_no_bf16_and_matches_unset(monkeypatch):
+    A, _ = _system()
+    monkeypatch.delenv("KEYSTONE_PRECISION_TIER", raising=False)
+    unset = jax.jit(lambda X: hdot(X.T, X, "high")).lower(A).as_text()
+    explicit = (
+        jax.jit(lambda X: hdot(X.T, X, "high", tier="f32"))
+        .lower(A).as_text()
+    )
+    assert unset == explicit
+    assert "bf16" not in unset
+
+
+@pytest.mark.parametrize("entry", ["normal_equations", "bcd", "sketch"])
+def test_f32_tier_results_bit_identical_to_unset(monkeypatch, entry):
+    """Unset knob and explicit tier='f32' resolve to the SAME static
+    arguments, therefore the same compiled program and bitwise-equal
+    outputs — for every rerouted solver path."""
+    A, b = _system()
+
+    def run(**kw):
+        if entry == "normal_equations":
+            return normal_equations_solve(A, b, lam=1.0, **kw)
+        if entry == "bcd":
+            return block_coordinate_descent_l2(A, b, 1.0, 32, **kw)
+        return sketched_lstsq_solve(A, b, lam=1.0, tol=0.0, max_iters=3, **kw)
+
+    monkeypatch.delenv("KEYSTONE_PRECISION_TIER", raising=False)
+    w_unset = run()
+    monkeypatch.setenv("KEYSTONE_PRECISION_TIER", "f32")
+    w_f32_env = run()
+    monkeypatch.delenv("KEYSTONE_PRECISION_TIER", raising=False)
+    w_explicit = run(tier="f32")
+    assert bool(jnp.all(w_unset == w_f32_env))
+    assert bool(jnp.all(w_unset == w_explicit))
+
+
+def test_pallas_f32_tier_bit_identical(monkeypatch):
+    """The bf16-input kernel variants' f32 form is the prior kernel: the
+    in-kernel astype(f32) of an f32 ref is a no-op, pinned bitwise."""
+    from keystone_tpu.ops.pallas.extraction import fv_moments, sift_oriented_bins
+
+    monkeypatch.delenv("KEYSTONE_PRECISION_TIER", raising=False)
+    mag = jax.random.uniform(jax.random.key(0), (2, 24, 32), jnp.float32)
+    ang = jax.random.uniform(
+        jax.random.key(1), (2, 24, 32), jnp.float32, -3.0, 3.0
+    )
+    sel = (np.random.default_rng(0).uniform(size=(32, 9)) < 0.3).astype(
+        np.float32
+    )
+    o_unset = sift_oriented_bins(mag, ang, sel, tile_r=16, interpret=True)
+    o_f32 = sift_oriented_bins(
+        mag, ang, sel, tile_r=16, interpret=True, tier="f32"
+    )
+    assert bool(jnp.all(o_unset == o_f32))
+    x = jax.random.normal(jax.random.key(2), (3, 40, 6), jnp.float32)
+    means = jax.random.normal(jax.random.key(3), (8, 6), jnp.float32)
+    var = jnp.abs(jax.random.normal(jax.random.key(4), (8, 6), jnp.float32)) + 0.5
+    w = jnp.ones((8,), jnp.float32) / 8
+    q_unset = fv_moments(x, means, var, w, tile_nd=16, interpret=True)
+    q_f32 = fv_moments(x, means, var, w, tile_nd=16, interpret=True, tier="f32")
+    for a, c in zip(q_unset, q_f32):
+        assert bool(jnp.all(a == c))
+
+
+def test_knob_routes_same_program_as_per_call_tier(monkeypatch):
+    A, b = _system()
+    w_call = normal_equations_solve(A, b, lam=1.0, tier="bf16")
+    monkeypatch.setenv("KEYSTONE_PRECISION_TIER", "bf16")
+    w_env = normal_equations_solve(A, b, lam=1.0)
+    assert bool(jnp.all(w_call == w_env))
+
+
+def test_resolve_precision_tier_validates():
+    assert resolve_precision_tier(None) == "f32"
+    assert resolve_precision_tier("bf16") == "bf16"
+    with pytest.raises(ValueError, match="precision tier"):
+        resolve_precision_tier("fp8")
+
+
+def test_validate_precision_rejects_tier_strings():
+    """The two precision vocabularies stay disjoint: a dtype-tier string
+    passed as an MXU precision gets a hint naming the right knob."""
+    for tier in ("bf16", "f32"):
+        with pytest.raises(ValueError, match="KEYSTONE_PRECISION_TIER"):
+            validate_precision(tier)
+    with pytest.raises(ValueError, match="precision must be one of"):
+        validate_precision("bogus")
+    assert validate_precision("high") == "high"
+
+
+# ---------------------------------------------------------------------------
+# 2. bf16 envelope
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("entry", ["normal_equations", "bcd", "tsqr"])
+def test_bf16_envelope_exact_rungs(entry):
+    """bf16-tier solutions of the exact rungs land within 2% of the f32
+    twins on a well-conditioned system — and the programs genuinely differ
+    (the tier engaged)."""
+    A, b = _system(n=1024, d=128)
+    mesh = make_mesh()
+    if entry == "normal_equations":
+        w32 = normal_equations_solve(A, b, lam=1.0)
+        w16 = normal_equations_solve(A, b, lam=1.0, tier="bf16")
+    elif entry == "bcd":
+        w32 = block_coordinate_descent_l2(A, b, 1.0, 32)
+        w16 = block_coordinate_descent_l2(A, b, 1.0, 32, tier="bf16")
+    else:
+        w32 = tsqr_solve(A, b, lam=1.0, mesh=mesh)
+        w16 = tsqr_solve(A, b, lam=1.0, mesh=mesh, tier="bf16")
+    delta = _rel(w16, w32)
+    assert 0.0 < delta < 0.02, delta
+
+
+@pytest.mark.parametrize("kind", ["countsketch", "srht"])
+def test_bf16_sketch_subspace_embedding_quality(kind):
+    """The bf16 sketch stays a usable subspace embedding: the
+    preconditioned system's conditioning k(A R^-1) — THE property the
+    solver's iteration count rides on — stays small at the default
+    oversampling, for both operators."""
+    n, d = 2048, 32
+    A, _ = _system(n=n, d=d)
+    m = sketch_rows(n, d)
+    SA, _ = sketch_matrix(A, m, seed=3, kind=kind, tier="bf16")
+    assert SA.dtype == jnp.float32  # the sketch output is always f32
+    R = np.linalg.qr(np.asarray(SA, np.float64), mode="r")
+    AR = np.asarray(A, np.float64) @ np.linalg.inv(R)
+    s = np.linalg.svd(AR, compute_uv=False)
+    assert s[0] / s[-1] < 3.0, s[0] / s[-1]
+
+
+def test_bf16_sketch_solver_residual_envelope():
+    """The full composition: bf16 sketch -> f32 QR -> f32 CG. The final
+    residual matches the f32 tier within 1% and the solution delta is at
+    least 10x TIGHTER than the raw bf16 gram rounding — the CG-cleanup
+    claim that makes this solver the tier's first adopter."""
+    A, b = _system(n=1024, d=128)
+    w32 = sketched_lstsq_solve(A, b, lam=1.0, tol=1e-6, max_iters=50)
+    w16 = sketched_lstsq_solve(
+        A, b, lam=1.0, tol=1e-6, max_iters=50, tier="bf16"
+    )
+    r32 = float(jnp.linalg.norm(A @ w32 - b))
+    r16 = float(jnp.linalg.norm(A @ w16 - b))
+    assert r16 <= 1.01 * r32, (r16, r32)
+    gram_delta = _rel(hdot(A.T, A, tier="bf16"), hdot(A.T, A, "high"))
+    assert _rel(w16, w32) < gram_delta / 10.0
+
+
+def test_ring_gram_routes_tier_to_bidirectional_schedule(monkeypatch):
+    """The production ring-gram router (ring.ring_gram) threads the tier
+    into the bidirectional schedule: knob-engaged bf16 differs from f32
+    within the envelope, and the f32 tier stays bit-identical to the
+    unidirectional prior program."""
+    from keystone_tpu.parallel.ring import ring_gram
+
+    k = jax.device_count()
+    if k < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh = make_mesh(data=1, model=k)
+    x = jax.random.normal(jax.random.key(0), (40, 16 * k), jnp.float32)
+    monkeypatch.delenv("KEYSTONE_PRECISION_TIER", raising=False)
+    g_uni = ring_gram(x, mesh, axis="model", bidirectional=False)
+    g_f32 = ring_gram(x, mesh, axis="model", bidirectional=True)
+    assert bool(jnp.all(g_uni == g_f32))  # f32 tier: bit-identical schedule
+    monkeypatch.setenv("KEYSTONE_PRECISION_TIER", "bf16")
+    g_bf16 = ring_gram(x, mesh, axis="model", bidirectional=True)
+    assert 0.0 < _rel(g_bf16, g_f32) < 0.01
+
+
+def test_moments_small_n_fallback_keeps_f32_input():
+    """gmm_moments_sep's small-n XLA fallback must NOT pay the bf16
+    rounding: the fallback streams nothing, so under tier='bf16' it still
+    computes from the un-cast f32 descriptors (bit-identical to the f32
+    tier)."""
+    from keystone_tpu.ops.pallas.moments import _TILE_N_CANDIDATES, gmm_moments_sep
+
+    n = min(_TILE_N_CANDIDATES) + 8  # past the tiny-n guard, under tile_n
+    x = jax.random.normal(jax.random.key(0), (n, 6), jnp.float32)
+    means = jax.random.normal(jax.random.key(1), (8, 6), jnp.float32)
+    var = jnp.abs(jax.random.normal(jax.random.key(2), (8, 6), jnp.float32)) + 0.5
+    w = jnp.ones((8,), jnp.float32) / 8
+    m32 = gmm_moments_sep(x, means, var, w, tier="f32")
+    m16 = gmm_moments_sep(x, means, var, w, tier="bf16")
+    for a, b in zip(m32, m16):
+        assert bool(jnp.all(a == b))
+
+
+def test_intent_check_rejects_unknown_vocabulary():
+    """A typo'd INTENDED_PRECISION entry must never silently disable the
+    rule: unknown storage/accumulate strings raise from the library check
+    and surface as an A3 finding through the rule."""
+    from keystone_tpu.analysis.ir_rules import (
+        AuditProgram,
+        PrecisionRule,
+        check_intended_precision,
+    )
+
+    x = jnp.ones((8, 8), jnp.float32)
+    jx = _jaxpr(lambda a: a @ a, x)
+    with pytest.raises(ValueError, match="unknown intended precision"):
+        check_intended_precision(jx, "f16", "f32")
+    with pytest.raises(ValueError, match="unknown intended precision"):
+        check_intended_precision(jx, "bf16", "bf16")
+    prog = AuditProgram(
+        name="toy", path="p.py", line=1, jaxpr=jx, hlo_text="",
+        memory_stats=None, expect={"intended_precision": ("fp32", "f32")},
+    )
+    found = PrecisionRule().run(prog)
+    assert any("unknown intended precision" in f.message for f in found)
+
+
+def test_bf16_collective_structure_survives():
+    """The bf16 tiled gram keeps the pipelined collective shape (>= k
+    per-tile reduce-scatters, no terminal all-reduce) — the tier must
+    never cost the overlap schedule. Needs the 8-device sim."""
+    from keystone_tpu.analysis.ir_rules import assert_pipelined_reduce_scatter
+    from keystone_tpu.parallel.overlap import tiled_transpose_matmul
+
+    mesh = make_mesh(data=jax.device_count(), model=1)
+    k = mesh.shape["data"]
+    if k < 2:
+        pytest.skip("needs a multi-device mesh")
+    x = jax.random.normal(jax.random.key(0), (16 * k, 16 * k), jnp.float32)
+    hlo = (
+        jax.jit(lambda a: tiled_transpose_matmul(a, mesh=mesh, tier="bf16"))
+        .lower(x).compile().as_text()
+    )
+    assert_pipelined_reduce_scatter(hlo, k)
+    assert "bf16" in hlo
+    g16 = tiled_transpose_matmul(x, mesh=mesh, tier="bf16")
+    g32 = tiled_transpose_matmul(x, mesh=mesh)
+    assert _rel(g16, g32) < 0.01
+
+
+# ---------------------------------------------------------------------------
+# 3. autotune precision-key isolation
+# ---------------------------------------------------------------------------
+
+
+def test_precision_bucket_forms():
+    from keystone_tpu.ops.pallas import autotune
+
+    assert autotune.precision_bucket("64x8", "f32") == "64x8"
+    assert autotune.precision_bucket("64x8", None) == "64x8"
+    assert autotune.precision_bucket("64x8", "bf16") == "64x8@bf16"
+    with pytest.raises(ValueError, match="precision tier"):
+        autotune.precision_bucket("64x8", "fp8")
+
+
+def test_autotune_precision_key_isolation(tmp_path, monkeypatch):
+    """A bf16 winner never serves an f32 lookup and vice versa — the two
+    tiers' entries coexist under one kernel without shadowing."""
+    from keystone_tpu.ops.pallas import autotune
+
+    monkeypatch.setenv(
+        "KEYSTONE_AUTOTUNE_CACHE", str(tmp_path / "cache.json")
+    )
+    autotune.clear_memory_cache()
+    bucket = autotune.shape_bucket(100, 8)
+    autotune.record("k.test", autotune.precision_bucket(bucket, "f32"), 512)
+    autotune.record("k.test", autotune.precision_bucket(bucket, "bf16"), 128)
+    assert autotune.lookup(
+        "k.test", autotune.precision_bucket(bucket, "f32")
+    ) == 512
+    assert autotune.lookup(
+        "k.test", autotune.precision_bucket(bucket, "bf16")
+    ) == 128
+    # persisted isolation too (fresh load from disk)
+    autotune.clear_memory_cache()
+    assert autotune.lookup("k.test", bucket + "@bf16") == 128
+    assert autotune.lookup("k.test", bucket) == 512
+
+
+def test_autotune_sanitize_prunes_unknown_tier(tmp_path, monkeypatch):
+    """Stale-entry sanitization extended: a bucket qualified with a tier
+    this build does not speak is pruned on load, while same-kernel good
+    entries keep serving."""
+    from keystone_tpu.ops.pallas import autotune
+
+    path = tmp_path / "cache.json"
+    path.write_text(json.dumps({
+        "version": 1,
+        "devices": {
+            autotune.device_key(): {
+                "k.test": {
+                    "64x8": {"value": 256},
+                    "64x8@bf16": {"value": 64},
+                    "64x8@fp8": {"value": 8},       # unknown tier: pruned
+                    "64x8@": {"value": 9},          # malformed: pruned
+                },
+            },
+        },
+    }))
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE_CACHE", str(path))
+    autotune.clear_memory_cache()
+    assert autotune.lookup("k.test", "64x8") == 256
+    assert autotune.lookup("k.test", "64x8@bf16") == 64
+    assert autotune.lookup("k.test", "64x8@fp8") is None
+    assert autotune.lookup("k.test", "64x8@") is None
+    autotune.clear_memory_cache()
+
+
+def test_pick_tiles_consumes_tier_keyed_winner(tmp_path, monkeypatch):
+    """overlap.tiles resolution is tier-keyed end to end: the bf16 winner
+    reshapes the bf16 schedule only."""
+    from keystone_tpu.ops.pallas import autotune
+    from keystone_tpu.parallel.overlap import _pick_tiles
+
+    monkeypatch.setenv(
+        "KEYSTONE_AUTOTUNE_CACHE", str(tmp_path / "cache.json")
+    )
+    monkeypatch.delenv("KEYSTONE_OVERLAP_TILES", raising=False)
+    autotune.clear_memory_cache()
+    k = 4
+    bucket = autotune.shape_bucket(64, k)
+    autotune.record("overlap.tiles", bucket + "@bf16", 2)
+    assert _pick_tiles(64, k, tier="bf16") == 2
+    # the f32 path must NOT see the bf16 winner: heuristic default (= k)
+    assert _pick_tiles(64, k) == k
+    autotune.clear_memory_cache()
+
+
+# ---------------------------------------------------------------------------
+# 4. A3 intent registry
+# ---------------------------------------------------------------------------
+
+
+def _jaxpr(fn, *args):
+    return jax.make_jaxpr(fn)(*args)
+
+
+def test_intent_check_flags_silent_downgrade():
+    """A program doing bf16 dots while its declared storage is f32: the
+    f32->bf16 drift direction."""
+    from keystone_tpu.analysis.ir_rules import check_intended_precision
+
+    x = jnp.ones((8, 8), jnp.float32)
+    jx = _jaxpr(
+        lambda a: jnp.matmul(
+            a.astype(jnp.bfloat16), a.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ),
+        x,
+    )
+    problems = check_intended_precision(jx, "f32", "f32")
+    assert problems and any("intended f32 storage" in p for p in problems)
+    # the same program audited under its true bf16 intent is clean
+    assert check_intended_precision(jx, "bf16", "f32") == []
+
+
+def test_intent_check_flags_unengaged_bf16():
+    """A pure-f32 program declared bf16: the bf16->f32 drift direction —
+    the tier's perf claim would be hollow."""
+    from keystone_tpu.analysis.ir_rules import check_intended_precision
+
+    x = jnp.ones((8, 8), jnp.float32)
+    jx = _jaxpr(lambda a: a @ a, x)
+    problems = check_intended_precision(jx, "bf16", "f32")
+    assert problems and any("not engaged" in p for p in problems)
+    assert check_intended_precision(jx, "f32", "f32") == []
+
+
+def test_intent_check_flags_narrow_accumulation():
+    """bf16 dots whose output stays bf16 (preferred_element_type dropped):
+    the accumulate contract."""
+    from keystone_tpu.analysis.ir_rules import check_intended_precision
+
+    x = jnp.ones((8, 8), jnp.bfloat16)
+    jx = _jaxpr(lambda a: a @ a, x)  # bf16 x bf16 -> bf16 accumulate
+    problems = check_intended_precision(jx, "bf16", "f32")
+    assert problems and any("accumulate" in p for p in problems)
+
+
+def test_intent_registry_covers_every_entry_point():
+    """Every registered audit entry has an explicit intent declaration —
+    nothing rides the implicit default silently."""
+    from keystone_tpu.analysis.ir_audit import ENTRY_POINTS, INTENDED_PRECISION
+
+    missing = set(ENTRY_POINTS) - set(INTENDED_PRECISION)
+    assert not missing, missing
+    # and the bf16-tier variants are declared bf16-storage/f32-accumulate
+    assert INTENDED_PRECISION["solver.sketch_bf16"] == ("bf16", "f32")
+    assert INTENDED_PRECISION["overlap.tiled_gram_bf16"] == ("bf16", "f32")
+
+
+def test_audit_bf16_entries_clean_and_drift_detected(monkeypatch):
+    """End to end through run_audit: the registered bf16 entries audit
+    clean against their declared intent, and flipping an intent makes the
+    SAME program a finding — in each direction."""
+    from keystone_tpu.analysis import ir_audit
+
+    res = ir_audit.run_audit(
+        targets=["solver.sketch_bf16", "pallas.sift_bins_bf16"],
+        baseline_path=None,
+    )
+    assert not res.errors, res.errors
+    assert res.findings == [], [f.message for f in res.findings]
+    # direction 1: declare the bf16 entry f32 -> its bf16 program drifts
+    monkeypatch.setitem(
+        ir_audit.INTENDED_PRECISION, "solver.sketch_bf16", ("f32", "f32")
+    )
+    res = ir_audit.run_audit(
+        targets=["solver.sketch_bf16"], baseline_path=None
+    )
+    assert any("intended f32 storage" in f.message for f in res.findings)
+    # direction 2: declare an f32 entry bf16 -> unengaged-tier finding
+    monkeypatch.setitem(
+        ir_audit.INTENDED_PRECISION, "pallas.sift_bins", ("bf16", "f32")
+    )
+    res = ir_audit.run_audit(targets=["pallas.sift_bins"], baseline_path=None)
+    assert any("not engaged" in f.message for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# C4 learns the tier
+# ---------------------------------------------------------------------------
+
+
+def test_c4_flags_bf16_under_f32_tier_only(monkeypatch):
+    """A stage emitting bfloat16 is a C4 finding under the default f32
+    tier and CLEAN under KEYSTONE_PRECISION_TIER=bf16 — checked pipelines
+    stay clean when the tier is the declared program."""
+    from keystone_tpu.analysis.check import pipeline_findings
+    from keystone_tpu.analysis.contracts import StageRecord
+
+    rec = StageRecord(
+        index=0, node=object(), deps=(-1,), name="caster",
+        in_aval=jax.ShapeDtypeStruct((4, 8), jnp.float32),
+        out_aval=jax.ShapeDtypeStruct((4, 8), jnp.bfloat16),
+    )
+    monkeypatch.delenv("KEYSTONE_PRECISION_TIER", raising=False)
+    found = pipeline_findings([rec], "toy", site=("toy.py", 1))
+    assert [f for f in found if f.rule == "C4" and "bfloat16" in f.message]
+    monkeypatch.setenv("KEYSTONE_PRECISION_TIER", "bf16")
+    found = pipeline_findings([rec], "toy", site=("toy.py", 1))
+    assert not [f for f in found if f.rule == "C4"]
+    # report-once-at-source: a stage CARRYING bf16 through is not re-flagged
+    monkeypatch.delenv("KEYSTONE_PRECISION_TIER", raising=False)
+    carrier = StageRecord(
+        index=0, node=object(), deps=(-1,), name="carrier",
+        in_aval=jax.ShapeDtypeStruct((4, 8), jnp.bfloat16),
+        out_aval=jax.ShapeDtypeStruct((4, 8), jnp.bfloat16),
+    )
+    found = pipeline_findings([carrier], "toy", site=("toy.py", 1))
+    assert not [f for f in found if f.rule == "C4"]
