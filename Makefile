@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke chaos-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -72,6 +72,15 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/audit_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/precision_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
+
+# Chaos-ladder contract (<20 s): a streaming weighted fit killed
+# mid-schedule by an injected KEYSTONE_FAULTS device error resumes from
+# its checkpoint on a RESHAPED (8 -> 4 device) CPU-sim mesh and matches
+# the uninterrupted twin; truncated checkpoints raise the named
+# CheckpointCorruptError (scripts/chaos_smoke.py).
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
 
 # Precision-tier contract (<20 s): f32 tier byte-identical to the prior
 # program, bf16 parity within the documented envelope, and the bf16-sketch

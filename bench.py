@@ -110,6 +110,13 @@ def _flush(out: dict, section: str) -> None:
         sys.stdout.flush()
         sys.stderr.flush()
         os.kill(os.getpid(), signal.SIGKILL)
+    # occurrence-indexed generalization of the named-section hook above:
+    # a KEYSTONE_FAULTS 'bench_section@N[:kill]' entry SIGKILLs (or
+    # raises) right after the Nth section flush (utils/faults.py; no-op
+    # when the knob is unset)
+    from keystone_tpu.utils import faults
+
+    faults.check("bench_section")
 
 
 def _load_cpu_baseline():
@@ -1225,6 +1232,112 @@ def _try_precision_rows() -> dict:
         return {"gram_bf16_gflops": None}
 
 
+class _BenchSlice:
+    """Streaming feature node for the fault-recovery section: one column
+    block of the raw features (module-level so the section's setup mirrors
+    the production fit_streaming call shape)."""
+
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def apply_batch(self, raw):
+        return raw["x"][:, self.lo : self.hi]
+
+
+def _try_fault_rows() -> dict:
+    """Fault-recovery evidence rows (``utils/faults.py`` + the mesh-portable
+    checkpoint path, PR 12): one streaming weighted fit run clean, then the
+    SAME fit killed mid-schedule by a deterministic injected device error
+    and resumed from its mid-fit checkpoint through the production
+    ``fit_streaming_elastic`` retry loop. Emits ``resume_overhead_s`` (the
+    price of the crash: kill-and-resume wall clock minus the uninterrupted
+    fit), ``retry_attempts_total``, and the measured
+    ``checkpoint_save_s`` / ``checkpoint_load_s`` (from the telemetry
+    histograms the checkpoint writer/reader feed). BENCH_FAULTS=0 skips."""
+    if not knobs.get("BENCH_FAULTS"):
+        return {}
+    try:
+        import tempfile
+
+        import numpy as np
+
+        from keystone_tpu.learning.block_weighted import (
+            BlockWeightedLeastSquaresEstimator,
+        )
+        from keystone_tpu.telemetry import get_registry
+        from keystone_tpu.utils import faults, fit_streaming_elastic
+
+        n = 512 if _SMOKE else 8192
+        d = 64 if _SMOKE else 1024
+        c = 8
+        bs = d // 8  # 8 blocks: room for a mid-schedule kill
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        lbl = jnp.asarray(
+            np.eye(c, dtype=np.float32)[np.arange(n) % c] * 2.0 - 1.0
+        )
+        nodes = [_BenchSlice(k * bs, (k + 1) * bs) for k in range(d // bs)]
+        est = BlockWeightedLeastSquaresEstimator(bs, 1, 0.1, 0.25)
+        raw = {"x": x}
+
+        def run_clean():
+            m = est.fit_streaming(nodes, raw, lbl)
+            jax.block_until_ready(m.w)
+
+        run_clean()  # warm the compile so both timed runs are steady-state
+        t0 = time.perf_counter()
+        run_clean()
+        base_s = time.perf_counter() - t0
+
+        reg = get_registry()
+        attempts0 = reg.get_counter("retry.attempt")
+
+        def hist_sum(name):
+            h = reg.get_histogram(name)
+            return (h or {}).get("sum") or 0.0
+
+        save0, load0 = hist_sum("checkpoint.save_s"), hist_sum(
+            "checkpoint.load_s"
+        )
+        ckpt = os.path.join(
+            tempfile.mkdtemp(prefix="bench_faults_"), "fit.ckpt"
+        )
+        faults.reset()
+        os.environ["KEYSTONE_FAULTS"] = f"block@{len(nodes) // 2}:xla"
+        try:
+            t0 = time.perf_counter()
+            m = fit_streaming_elastic(
+                est, nodes, raw, lbl,
+                checkpoint_path=ckpt, checkpoint_every=1,
+                retries=2, backoff_s=0.0,
+            )
+            jax.block_until_ready(m.w)
+            resumed_s = time.perf_counter() - t0
+        finally:
+            os.environ.pop("KEYSTONE_FAULTS", None)
+            faults.reset()
+        return {
+            "resume_overhead_s": round(max(resumed_s - base_s, 0.0), 3),
+            "fault_fit_base_s": round(base_s, 3),
+            "fault_fit_resumed_s": round(resumed_s, 3),
+            "retry_attempts_total": int(
+                reg.get_counter("retry.attempt") - attempts0
+            ),
+            # 6 digits: a smoke-size checkpoint loads in tens of
+            # microseconds — 4 digits would round it to 0.0 and flake the
+            # contract test's > 0 pin
+            "checkpoint_save_s": round(
+                hist_sum("checkpoint.save_s") - save0, 6
+            ),
+            "checkpoint_load_s": round(
+                hist_sum("checkpoint.load_s") - load0, 6
+            ),
+        }
+    except Exception as e:
+        print(f"fault rows failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"resume_overhead_s": None}
+
+
 def _run_regime_subprocess(regime: str, fail_key: str,
                            timeout_s: int = None) -> dict:
     """One big-regime row via ``scripts/bench_regime.py`` in a fresh OS
@@ -1401,6 +1514,17 @@ def main():
     else:
         out.update(_try_precision_rows())
     _flush(out, "precision")
+    # Fault-recovery pair (inject -> crash -> checkpoint-resume through the
+    # production retry loop): in-process, small shapes — a reduced floor
+    # like telemetry's, with the explicit budget-skip marker the section
+    # contract pins.
+    if _budget_remaining() - _FINALIZE_RESERVE_S < 20.0:
+        out["faults_skipped"] = "budget"
+        print("bench section faults skipped: budget exhausted",
+              file=sys.stderr)
+    else:
+        out.update(_try_fault_rows())
+    _flush(out, "faults")
     # Solver GFLOPs ladder (exact BCD + randomized sketch rungs, overlap
     # on/off): a budget-derated SUBPROCESS regime since the sketch rung
     # landed. In-process it was the one heavy section whose runtime the
@@ -1598,6 +1722,11 @@ _COMPACT_KEYS = (
     ("gram16_err", "gram_bf16_vs_f32_error_delta"),
     ("g_sk16", "sketch_bf16_gflops"),
     ("sk16_err", "sketch_bf16_vs_f32_error_delta"),
+    # fault-recovery evidence (utils/faults.py + mesh-portable
+    # checkpoints): the price of a mid-schedule crash and the retry count
+    # that paid it (full rows incl. checkpoint save/load in bench_full)
+    ("resume_ovh", "resume_overhead_s"),
+    ("retry_n", "retry_attempts_total"),
     # randomized sketch rung (linalg/sketch.py) + equal-test-error delta
     # vs the exact rung (configured d=65536; actual d in bench_full.json)
     ("g_sketch", "sketch_gflops_per_chip"),

@@ -541,3 +541,71 @@ def construction_findings(pipe, site=None, strict: bool = False):
         records, name=_node_name(pipe), site=site, from_template=not strict,
     )
     return findings
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-manifest contract (core/checkpoint.py)
+# ---------------------------------------------------------------------------
+
+#: Required manifest fields and the shapes their values must have. The
+#: checkpoint writer validates at build time (a bad manifest is a writer
+#: bug and never ships); the reader validates before any state is consumed
+#: (a schema the reader does not understand is reported as corruption, not
+#: silently half-interpreted). Unknown extra keys are allowed — the schema
+#: is a floor, so writers may grow it without breaking old readers.
+MANIFEST_REQUIRED = ("format", "arrays")
+
+
+def validate_manifest(manifest) -> list:
+    """Issues (strings) with a checkpoint manifest; [] when it satisfies
+    the contract. See ``core/checkpoint.py::build_manifest`` for the
+    writer side."""
+    issues = []
+    if not isinstance(manifest, dict):
+        return [f"manifest must be a dict, got {type(manifest).__name__}"]
+    for key in MANIFEST_REQUIRED:
+        if key not in manifest:
+            issues.append(f"missing required key {key!r}")
+    fmt = manifest.get("format")
+    if "format" in manifest and (not isinstance(fmt, int) or fmt < 2):
+        issues.append(f"format must be an int >= 2, got {fmt!r}")
+    mesh_shape = manifest.get("mesh_shape")
+    if mesh_shape is not None and not (
+        isinstance(mesh_shape, dict)
+        and all(
+            isinstance(k, str) and isinstance(v, int) and v >= 1
+            for k, v in mesh_shape.items()
+        )
+    ):
+        issues.append(f"mesh_shape must be None or {{axis: size>=1}}, "
+                      f"got {mesh_shape!r}")
+    arrays = manifest.get("arrays")
+    if arrays is not None:
+        if not isinstance(arrays, dict):
+            issues.append(f"arrays must be a dict, got {type(arrays).__name__}")
+        else:
+            for name, rec in arrays.items():
+                if not (
+                    isinstance(rec, dict)
+                    and isinstance(rec.get("shape"), list)
+                    and all(isinstance(s, int) and s >= 0
+                            for s in rec["shape"])
+                    and isinstance(rec.get("dtype"), str)
+                ):
+                    issues.append(
+                        f"arrays[{name!r}] must be "
+                        "{'shape': [int...], 'dtype': str}, got "
+                        f"{rec!r}"
+                    )
+                    break  # one malformed entry names the class of problem
+    block_order = manifest.get("block_order")
+    if block_order is not None and not (
+        isinstance(block_order, list)
+        and all(isinstance(b, int) for b in block_order)
+    ):
+        issues.append(f"block_order must be a list of ints, got "
+                      f"{block_order!r}")
+    pos = manifest.get("pos")
+    if pos is not None and not (isinstance(pos, int) and pos >= 0):
+        issues.append(f"pos must be an int >= 0, got {pos!r}")
+    return issues

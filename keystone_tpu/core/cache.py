@@ -343,6 +343,25 @@ class IntermediateCache:
             self._entries.clear()
             self._tier_bytes = {_DEVICE: 0, _HOST: 0, _DISK: 0}
 
+    def release_device_tier(self) -> int:
+        """Free every device-tier entry (demote to host when the host
+        budget holds it, else spill/drop); returns the entry count. The
+        retry path's pre-retry hook (``utils/retry.py``) calls this on
+        RESOURCE_EXHAUSTED errors so the re-dispatch finds the HBM the
+        failed attempt could not — cached intermediates are recomputable
+        by definition, so releasing them can only cost recompute time."""
+        with self._lock:
+            victims = [
+                e for e in self._entries.values() if e.tier == _DEVICE
+            ]
+            for e in victims:
+                self._demote(e, _HOST)
+            # _demote alone only checks that a host tier EXISTS; rebalance
+            # enforces its byte budget (spill to disk / evict), so the
+            # OOM-recovery path cannot itself blow host RAM
+            self._rebalance()
+            return len(victims)
+
     # -- tier mechanics ----------------------------------------------------
 
     def _disk_path(self, key: str) -> str:

@@ -1,4 +1,4 @@
-"""Checkpointing fitted pipeline nodes + load-or-fit switches.
+"""Checkpointing fitted pipeline nodes + solver state, preemption-safe.
 
 Reference behavior (SURVEY.md §5): KeystoneML has no model checkpoint writer —
 "resume" means loading precomputed artifacts from CSV (``--pcaFile``,
@@ -12,6 +12,26 @@ orbax-style upgrade the survey prescribes — while the CSV loaders
 (``GaussianMixtureModel.load``, ``PCATransformer`` from file) remain for
 reference-artifact parity.
 
+Durability contract (the chaos-ladder half — ``scripts/chaos_smoke.py``):
+
+- **Crash-atomic writes.** Payloads go to a same-directory temp file,
+  ``fsync``, then ``os.replace`` (plus a best-effort directory fsync), so a
+  host crash mid-save leaves either the previous checkpoint or the new one —
+  never a torn file.
+- **Checksummed payloads.** The v2 format stores the payload's SHA-256 next
+  to it; a truncated or bit-rotted file raises
+  :class:`CheckpointCorruptError` (a *named* error) before any state is
+  unpickled — a checkpoint is loaded whole or not at all.
+- **Mesh-portable state.** Leaves are host numpy (mesh-agnostic by
+  construction); an optional *manifest* (:func:`build_manifest`) records the
+  mesh shape, block schedule, cursor and per-array logical shapes the state
+  was written under, so a resume on a *different* mesh re-``device_put``s
+  onto the live sharding (counted as ``checkpoint.reshard``) instead of
+  failing — loud (:class:`CheckpointMismatchError`) only when logical shapes
+  genuinely disagree. The manifest schema itself is contract-checked
+  (``analysis/contracts.py::validate_manifest``) on both the write and the
+  read side, so writer/reader drift is a named error, not silent skew.
+
 Static fields are pickled with the treedef, so nodes carrying non-picklable
 statics (lambdas, locally-defined functions) cannot checkpoint —
 :func:`save_node` detects this up front and raises a ``ValueError`` naming
@@ -21,10 +41,12 @@ surfacing pickle's opaque error mid-write.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import tempfile
-from typing import Any, Callable, List, TypeVar
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple, TypeVar
 
 import jax
 import numpy as np
@@ -35,7 +57,31 @@ logger = get_logger("keystone_tpu.checkpoint")
 
 T = TypeVar("T")
 
-_MAGIC = "keystone-tpu-node-v1"
+_MAGIC_V1 = "keystone-tpu-node-v1"  # legacy (pre-checksum); still loadable
+_MAGIC = "keystone-tpu-node-v2"
+
+
+class CheckpointError(ValueError):
+    """Base of every named checkpoint failure."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The file is truncated, bit-rotted, or fails its checksum — nothing
+    was loaded (the whole-or-not-at-all contract)."""
+
+
+class CheckpointMismatchError(CheckpointError):
+    """The checkpoint is intact but belongs to a different fit: logical
+    shapes/schedules genuinely disagree with the live run (resharding onto
+    a new mesh is NOT a mismatch — that path reshards and continues)."""
+
+
+class CheckpointWriteError(CheckpointError):
+    """A WRITE-side failure (e.g. a manifest that violates its own
+    contract at build time) — a code bug in the writer, not a bad file on
+    disk; recovery paths that discard unusable files must NOT treat this
+    as one (deleting a valid checkpoint over a writer bug doubles the
+    damage)."""
 
 
 def _unpicklable_statics(obj: Any, path: str, out: List[str], depth: int = 0) -> None:
@@ -66,8 +112,115 @@ def _unpicklable_statics(obj: Any, path: str, out: List[str], depth: int = 0) ->
             out.append(f"{path} = {getattr(obj, '__qualname__', repr(obj))}")
 
 
-def save_node(node: Any, path: str) -> None:
-    """Checkpoint a (fitted) node/chain/pytree to ``path`` atomically.
+# ---------------------------------------------------------------------------
+# Manifest: what the state was written under (mesh, schedule, shapes)
+# ---------------------------------------------------------------------------
+
+def mesh_shape_of(x: Any) -> Optional[Dict[str, int]]:
+    """The named mesh axes a live array is committed to, or None for
+    single-device / unspecified sharding — the manifest's mesh record."""
+    sharding = getattr(x, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None:
+        return None
+    try:
+        return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    except Exception:
+        return None
+
+
+def device_count_of(x: Any) -> int:
+    sharding = getattr(x, "sharding", None)
+    devs = getattr(sharding, "device_set", None)
+    return len(devs) if devs else 1
+
+
+def build_manifest(state: Any, *, mesh_shape: Optional[Dict[str, int]] = None,
+                   mesh_devices: int = 1, **extra: Any) -> Dict[str, Any]:
+    """Describe ``state`` for the resume side: per-array logical shapes +
+    dtypes (what :class:`CheckpointMismatchError` checks against), the mesh
+    the state was committed to (what the reshard path compares), and caller
+    extras (block schedule, cursor position, plan/schedule fingerprints).
+
+    The payload's SHA-256 — written next to the manifest by
+    :func:`save_node` — is the content checksum; the manifest carries the
+    *logical* description."""
+    arrays: Dict[str, Dict[str, Any]] = {}
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            arrays[jax.tree_util.keystr(key_path)] = {
+                "shape": [int(s) for s in leaf.shape],
+                "dtype": str(leaf.dtype),
+            }
+    manifest: Dict[str, Any] = {
+        "format": 2,
+        "mesh_shape": mesh_shape,
+        "mesh_devices": int(mesh_devices),
+        "arrays": arrays,
+    }
+    manifest.update(extra)
+    from keystone_tpu.analysis.contracts import validate_manifest
+
+    issues = validate_manifest(manifest)
+    if issues:  # a writer bug, caught at write time — never shipped to disk
+        raise CheckpointWriteError(
+            f"built manifest violates its contract: {'; '.join(issues)}"
+        )
+    return manifest
+
+
+def schedule_fingerprint(num_blocks: int, num_iter: int,
+                         block_order) -> str:
+    """Content fingerprint of a solver's block schedule — the manifest's
+    plan identity: two checkpoints agree on it iff a resume can continue
+    one from the other without corrupting the Gauss–Seidel pass."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((int(num_blocks), int(num_iter),
+                   [int(b) for b in block_order])).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Write path: crash-atomic, checksummed
+# ---------------------------------------------------------------------------
+
+def _write_atomic(path: str, write) -> None:
+    """Same-directory temp file → flush → fsync → ``os.replace`` → directory
+    fsync (best effort): a crash at any point leaves either the old file or
+    the new one, and the rename is durable once the directory syncs.
+    ``write(f)`` streams the content — a callback, not a bytes blob, so the
+    caller never has to hold a second full copy of a multi-GB checkpoint in
+    host RAM just to hand it over."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # directory fsync is durability belt-and-braces only
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def save_node(node: Any, path: str,
+              manifest: Optional[Dict[str, Any]] = None) -> None:
+    """Checkpoint a (fitted) node/chain/pytree to ``path``: crash-atomic,
+    with the payload's SHA-256 stored alongside it so a torn or corrupted
+    file is detected (:class:`CheckpointCorruptError`) instead of
+    half-loaded. ``manifest`` (see :func:`build_manifest`) rides in the
+    checksummed payload and comes back from :func:`load_checkpoint`.
 
     Raises ``ValueError`` (naming the offending fields) when the node's
     static metadata cannot be pickled — e.g. ``LambdaTransformer`` or
@@ -75,6 +228,7 @@ def save_node(node: Any, path: str) -> None:
     module-level function instead so the checkpoint can be reloaded in a
     fresh process.
     """
+    t0 = time.perf_counter()
     leaves, treedef = jax.tree.flatten(node)
     try:
         treedef_bytes = pickle.dumps(treedef)
@@ -87,31 +241,122 @@ def save_node(node: Any, path: str) -> None:
             "locally-defined functions with module-level functions."
         ) from e
     del treedef_bytes  # validation only; the payload pickles treedef itself
-    payload = {
-        "magic": _MAGIC,
+    # ONE payload buffer is held (the digest must precede the payload in
+    # the container); the outer pickle then STREAMS into the temp file —
+    # the C pickler writes large bytes objects through to the file without
+    # a second full copy, so a multi-GB checkpoint costs ~1x its size in
+    # transient host RAM, not 2x.
+    payload = pickle.dumps({
         "treedef": treedef,
         "leaves": [np.asarray(l) for l in leaves],
+        "manifest": manifest,
+    })
+    outer = {
+        "magic": _MAGIC,
+        "sha256": hashlib.sha256(payload).hexdigest(),
+        "payload": payload,
     }
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    _write_atomic(path, lambda f: pickle.dump(outer, f))
+    from keystone_tpu.telemetry import get_registry
+
+    get_registry().observe("checkpoint.save_s", time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# Read path: checksum-verified, reshard-aware
+# ---------------------------------------------------------------------------
+
+def _load_payload(path: str) -> Dict[str, Any]:
+    t0 = time.perf_counter()
     try:
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, path)  # atomic: no torn checkpoints on crash
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+        with open(path, "rb") as f:
+            outer = pickle.load(f)
+    except OSError:
         raise
+    except Exception as e:
+        # a truncated pickle stream (EOFError/UnpicklingError/...) must be
+        # the NAMED corruption error, never half-loaded garbage
+        raise CheckpointCorruptError(
+            f"{path} is truncated or corrupt (unreadable checkpoint "
+            f"container: {type(e).__name__}: {e})"
+        ) from e
+    if not isinstance(outer, dict):
+        raise CheckpointError(f"{path} is not a keystone-tpu node checkpoint")
+    magic = outer.get("magic")
+    if magic == _MAGIC_V1:
+        # legacy pre-checksum format: the whole dict IS the payload. .get,
+        # not []: a v1-magic dict missing its fields must be the NAMED
+        # corruption error, not a KeyError that escapes the recovery paths
+        if "treedef" not in outer or "leaves" not in outer:
+            raise CheckpointCorruptError(
+                f"{path} has the v1 magic but is missing its "
+                "treedef/leaves fields — truncated or hand-damaged"
+            )
+        payload = {
+            "treedef": outer["treedef"],
+            "leaves": outer["leaves"],
+            "manifest": None,
+        }
+    elif magic == _MAGIC:
+        blob = outer.get("payload")
+        if (not isinstance(blob, bytes)
+                or hashlib.sha256(blob).hexdigest() != outer.get("sha256")):
+            raise CheckpointCorruptError(
+                f"{path} fails its checksum — the payload was truncated or "
+                "corrupted after write; refusing to unpickle partial state"
+            )
+        payload = pickle.loads(blob)
+        manifest = payload.get("manifest")
+        if manifest is not None:
+            from keystone_tpu.analysis.contracts import validate_manifest
+
+            issues = validate_manifest(manifest)
+            if issues:
+                raise CheckpointCorruptError(
+                    f"{path} manifest violates its contract: "
+                    f"{'; '.join(issues)}"
+                )
+    else:
+        raise CheckpointError(f"{path} is not a keystone-tpu node checkpoint")
+    from keystone_tpu.telemetry import get_registry
+
+    get_registry().observe("checkpoint.load_s", time.perf_counter() - t0)
+    return payload
+
+
+def load_checkpoint(path: str) -> Tuple[Any, Optional[Dict[str, Any]]]:
+    """Load ``(node, manifest)`` — checksum-verified; the manifest is None
+    for legacy (v1) files and saves that passed none."""
+    payload = _load_payload(path)
+    node = jax.tree.unflatten(payload["treedef"], payload["leaves"])
+    return node, payload.get("manifest")
 
 
 def load_node(path: str) -> Any:
     """Load a node checkpointed with :func:`save_node`."""
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    if payload.get("magic") != _MAGIC:
-        raise ValueError(f"{path} is not a keystone-tpu node checkpoint")
-    return jax.tree.unflatten(payload["treedef"], payload["leaves"])
+    return load_checkpoint(path)[0]
+
+
+def load_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The manifest alone (None when the checkpoint carries none)."""
+    return _load_payload(path).get("manifest")
+
+
+def restore_onto(value: Any, like: Any) -> Any:
+    """Re-``device_put`` a checkpointed host array onto a live array's
+    sharding — the reshard-on-load step: because checkpoint leaves are host
+    numpy, placing them onto whatever mesh the *current* run committed is
+    exactly a ``device_put`` (each process uploads only its addressable
+    shards). Raises :class:`CheckpointMismatchError` when logical shapes
+    disagree — a different fit, not a different mesh; the caller counts and
+    logs the mesh change itself (``checkpoint.reshard``)."""
+    if tuple(np.shape(value)) != tuple(np.shape(like)):
+        raise CheckpointMismatchError(
+            f"checkpointed array shape {tuple(np.shape(value))} does not "
+            f"match the live fit's {tuple(np.shape(like))} — this "
+            "checkpoint belongs to a different dataset/configuration"
+        )
+    return jax.device_put(value, like.sharding)
 
 
 def load_or_fit(path: str, fit: Callable[[], T], save: bool = True) -> T:

@@ -444,6 +444,12 @@ class Chain(Transformer):
 def _run_segment(segment: Sequence[Node], data: Any) -> Any:
     if isinstance(data, Dataset):
         return data.replace(data=_run_segment(segment, data.data))
+    # deterministic chaos hook: KEYSTONE_FAULTS 'segment@N' entries fire at
+    # each fused-segment boundary — the materialization points a Retry
+    # wrapper re-runs from (utils/faults.py; no-op when the knob is unset)
+    from keystone_tpu.utils import faults as _faults
+
+    _faults.check("segment")
     node = segment[0] if len(segment) == 1 else Chain(stages=tuple(segment))
     from keystone_tpu.telemetry import tracing_enabled
 
@@ -679,6 +685,11 @@ class DAG(Transformer):
         """Run the pending jittable node indices as ONE fused program."""
         if not segment:
             return
+        # same chaos hook as the Chain path: every fused-segment dispatch
+        # is a 'segment' fault-site crossing (utils/faults.py)
+        from keystone_tpu.utils import faults as _faults
+
+        _faults.check("segment")
         local = {g: k for k, g in enumerate(segment)}
         ext: list = []
         ext_slot: dict = {}

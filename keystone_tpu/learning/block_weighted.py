@@ -650,11 +650,21 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 )
         binv_conds: list = []  # device scalars; synced ONCE after the loop
         if checkpoint_path and _os.path.exists(checkpoint_path):
-            from keystone_tpu.core.checkpoint import load_node
+            from keystone_tpu.core.checkpoint import (
+                CheckpointMismatchError,
+                device_count_of,
+                load_checkpoint,
+                mesh_shape_of,
+                restore_onto,
+            )
 
-            state = load_node(checkpoint_path)
+            # checksum-verified load: a truncated/corrupt file raises the
+            # NAMED CheckpointCorruptError here (never half-loads);
+            # fit_streaming_elastic catches it, discards the file, and
+            # refits from scratch
+            state, manifest = load_checkpoint(checkpoint_path)
             if state["num_blocks"] != num_blocks or state["num_iter"] != self.num_iter:
-                raise ValueError(
+                raise CheckpointMismatchError(
                     f"checkpoint {checkpoint_path} was written for "
                     f"{state['num_blocks']} blocks x {state['num_iter']} iters, "
                     f"not {num_blocks} x {self.num_iter}"
@@ -674,12 +684,37 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # without this a resumed fit under-reports max cond and the
             # conditioning guard silently never fires
             binv_conds = [jnp.asarray(c) for c in state.get("binv_conds", [])]
+            # Mesh portability: checkpoint leaves are host numpy, so the
+            # PR-6 "loud mismatch on resume" is now "reshard and continue"
+            # — a checkpoint written under an 8-device mesh resumes on a
+            # 4-device one by re-device_put'ing the state onto the LIVE
+            # sharding. Loud (CheckpointMismatchError, from restore_onto)
+            # only when logical shapes genuinely disagree.
+            _saved_geom = (
+                (manifest or {}).get("mesh_shape"),
+                (manifest or {}).get("mesh_devices"),
+            )
+            _live_geom = (mesh_shape_of(R), device_count_of(R))
+            if manifest is not None and _saved_geom != _live_geom:
+                from keystone_tpu import telemetry as _tele
+
+                _tele.get_registry().inc("checkpoint.reshard")
+                from keystone_tpu.utils import get_logger as _get_logger
+
+                _get_logger(
+                    "keystone_tpu.learning.block_weighted"
+                ).warning(
+                    "resuming checkpoint written under mesh %s (%s devices)"
+                    " on mesh %s (%s devices): resharding solver state",
+                    _saved_geom[0], _saved_geom[1],
+                    _live_geom[0], _live_geom[1],
+                )
             # restore the checkpointed residual IN the live R's sharding —
-            # load_node returns host numpy, and device_put straight from
+            # the checkpoint holds host numpy, and device_put straight from
             # host uploads only each process's addressable shards; a
             # jnp.asarray first would materialize the full (n, C) residual
             # on one device, the exact allocation the sharding avoids
-            R = jax.device_put(state["R"], R.sharding)
+            R = restore_onto(state["R"], R)
             residual_mean = jnp.asarray(state["residual_mean"])
             models = [jnp.asarray(m) for m in state["models"]]
             joint_means_blocks = [
@@ -702,13 +737,29 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 # legacy (pre-schedule) checkpoint: written sequentially
                 saved_order = list(range(num_blocks))
             if [int(x) for x in saved_order] != order:
-                raise ValueError(
+                raise CheckpointMismatchError(
                     f"checkpoint {checkpoint_path} was written under block "
                     f"order {list(saved_order)}, not {order} — resuming a "
                     "fit under a different visit schedule would corrupt "
                     "the pass (re-fit, or restore the original "
                     "KEYSTONE_SOLVER / block-order setting)"
                 )
+            # the manifest's schedule fingerprint must agree with the
+            # schedule just validated from the state dict — a disagreement
+            # after those direct checks passed means manifest/state skew
+            # (a corruption class the per-field checks cannot see)
+            saved_fp = (manifest or {}).get("schedule_fingerprint")
+            if saved_fp is not None:
+                from keystone_tpu.core.checkpoint import (
+                    schedule_fingerprint as _sched_fp,
+                )
+
+                if saved_fp != _sched_fp(num_blocks, self.num_iter, order):
+                    raise CheckpointMismatchError(
+                        f"checkpoint {checkpoint_path} manifest's schedule "
+                        "fingerprint disagrees with its own saved schedule "
+                        "— the manifest and state are skewed; re-fit"
+                    )
             if "pos" in state:
                 start_pos = int(state["pos"])
             else:
@@ -716,7 +767,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 start_pos = state["iter"] * num_blocks + state["block"]
 
         def _save_checkpoint(it: int, b: int, next_pos: int) -> None:
-            from keystone_tpu.core.checkpoint import save_node
+            from keystone_tpu.core.checkpoint import (
+                build_manifest,
+                device_count_of,
+                mesh_shape_of,
+                save_node,
+                schedule_fingerprint,
+            )
 
             # R is row-sharded: under a process group each controller
             # addresses only its shard (np.asarray would raise) and every
@@ -735,22 +792,36 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             R_global = _host_global(R)  # no-op host copy single-controller
             if jax.process_index() != 0:
                 return
+            state = {
+                "R": R_global, "residual_mean": residual_mean,
+                "models": models,
+                "joint_means_blocks": joint_means_blocks,
+                "pop_stats_cache": pop_stats_cache,
+                "iter": it, "block": b, "pos": next_pos,
+                "block_order": list(order),
+                "num_blocks": num_blocks, "num_iter": self.num_iter,
+                # solve-path marker + the conditioning evidence so far:
+                # resume must neither mix solve paths nor lose the
+                # guard's view of completed blocks
+                "force_dense": _force_dense,
+                "binv_conds": list(binv_conds),
+            }
+            # Manifest: the mesh geometry + schedule + per-array logical
+            # shapes this state was written under, so the resume side can
+            # reshard onto a DIFFERENT mesh (or fail loudly on a genuine
+            # shape mismatch) — core/checkpoint.py module docstring.
             save_node(
-                {
-                    "R": R_global, "residual_mean": residual_mean,
-                    "models": models,
-                    "joint_means_blocks": joint_means_blocks,
-                    "pop_stats_cache": pop_stats_cache,
-                    "iter": it, "block": b, "pos": next_pos,
-                    "block_order": list(order),
-                    "num_blocks": num_blocks, "num_iter": self.num_iter,
-                    # solve-path marker + the conditioning evidence so far:
-                    # resume must neither mix solve paths nor lose the
-                    # guard's view of completed blocks
-                    "force_dense": _force_dense,
-                    "binv_conds": list(binv_conds),
-                },
-                checkpoint_path,
+                state, checkpoint_path,
+                manifest=build_manifest(
+                    state,
+                    mesh_shape=mesh_shape_of(R),
+                    mesh_devices=device_count_of(R),
+                    block_order=[int(x) for x in order],
+                    pos=int(next_pos),
+                    schedule_fingerprint=schedule_fingerprint(
+                        num_blocks, self.num_iter, order
+                    ),
+                ),
             )
 
         policy = (lambda *_: False) if _force_dense else self._woodbury_policy
@@ -815,7 +886,14 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
         _n_rows = R.shape[0]
         _res_norms: list = []  # device scalars; synced ONCE after the loop
+        from keystone_tpu.utils import faults as _faults
+
         for pos, (it, b) in enumerate(schedule, start=start_pos):
+            # deterministic chaos hook: KEYSTONE_FAULTS 'block@N' entries
+            # fire at this schedule-position boundary — the mid-fit
+            # preemption the checkpoint/resume path must survive
+            # (utils/faults.py; returns immediately when the knob is unset)
+            _faults.check("block")
             with _phase("featurize"):
                 Xb = next(block_feed)
             if pop_stats_cache[b] is None:

@@ -138,6 +138,13 @@ def block_coordinate_descent_l2(
     from keystone_tpu.linalg.solvers import device_scalar
 
     lam = device_scalar(lam)
+    # deterministic chaos hook: KEYSTONE_FAULTS 'bcd@N' entries fire at
+    # each solver entry — the transient-device-error rehearsal for callers
+    # wrapping the solve in call_with_device_retries (utils/faults.py;
+    # returns immediately when the knob is unset)
+    from keystone_tpu.utils import faults as _faults
+
+    _faults.check("bcd")
     omesh = overlap_mesh(overlap)
     model_overlap = model_overlap_spec(A, omesh, block_size)
     trace_on = _telemetry.tracing_enabled(telemetry)

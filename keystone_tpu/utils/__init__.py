@@ -9,5 +9,8 @@ from keystone_tpu.utils.profiling import trace, annotate
 from keystone_tpu.utils.retry import (
     Retry,
     call_with_device_retries,
+    default_on_retry,
     fit_streaming_elastic,
+    resolve_retry_budget,
 )
+from keystone_tpu.utils import faults

@@ -1,0 +1,180 @@
+"""Deterministic fault injection at pipeline/solver boundaries.
+
+Spark gave the KeystoneML reference lineage-based recompute *and* a way to
+exercise it: kill an executor and watch tasks re-run (SURVEY.md §5). The
+single-controller JAX runtime here has retry + checkpoint/resume paths
+(``utils/retry.py``, ``core/checkpoint.py``) but — until this module —
+nothing that ever made them fire outside a real hardware failure. A
+recovery path that has never run is a recovery path that does not work.
+
+``KEYSTONE_FAULTS`` (declared in ``utils/knobs.py``) holds a *fault plan*:
+comma-separated entries
+
+    <site>@<occurrence>[:<kind>][*<repeat>]
+
+- ``site`` — a named injection point (:data:`SITES`):
+  ``block`` (the streaming weighted-BCD block loop,
+  ``learning/block_weighted.py``), ``bcd`` (each
+  ``block_coordinate_descent_l2`` entry, ``linalg/bcd.py``), ``segment``
+  (every fused-segment boundary in ``core/pipeline.py``) and
+  ``bench_section`` (each ``bench.py`` section flush — the generalization
+  of the ``BENCH_KILL_AFTER_SECTION`` hook).
+- ``occurrence`` — the 0-based count of crossings of that site *while a
+  plan is armed* (crossings are not counted when the knob is unset, so
+  arming the plan defines t=0; :func:`reset` restarts the count).
+- ``kind`` — ``xla`` (default: raise a retriable
+  ``jaxlib.XlaRuntimeError("INTERNAL: ...")`` — the transient device
+  error), ``oom`` (``RESOURCE_EXHAUSTED`` flavor — exercises the retry
+  hook's cache-tier release), or ``kill`` (``SIGKILL`` the process — the
+  preemption that only a checkpoint survives).
+- ``repeat`` — fire at ``repeat`` consecutive crossings (default 1); use
+  a large repeat to pin retry *exhaustion*.
+
+Example: ``KEYSTONE_FAULTS=block@7:xla`` raises a device error at the
+streaming solver's block-boundary crossing number 7 — the EIGHTH
+crossing; occurrences are 0-based like every other index here — exactly
+the mid-schedule preemption ``scripts/chaos_smoke.py`` and the
+``dryrun_multichip`` kill-and-resume step rehearse.
+
+Unset (the production default) every ``check()`` call returns before
+touching any counter: injection is pure host-side control flow, so the
+compiled programs are byte-identical to the prior build either way.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+SITES: Tuple[str, ...] = ("block", "bcd", "segment", "bench_section")
+KINDS: Tuple[str, ...] = ("xla", "oom", "kill")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    occurrence: int
+    kind: str = "xla"
+    repeat: int = 1
+
+    def matches(self, count: int) -> bool:
+        return self.occurrence <= count < self.occurrence + self.repeat
+
+
+def parse_fault_plan(raw: str) -> Tuple[FaultSpec, ...]:
+    """Parse a ``KEYSTONE_FAULTS`` plan string (module docstring grammar).
+
+    Raises ``ValueError`` naming the malformed entry and the grammar —
+    this is the knob's validator, so a typo'd plan fails at
+    ``knobs.validate_environment()`` time, not mid-fit."""
+    grammar = (
+        "expected '<site>@<occurrence>[:<kind>][*<repeat>]' entries "
+        f"separated by commas; sites: {', '.join(SITES)}; kinds: "
+        f"{', '.join(KINDS)} (e.g. KEYSTONE_FAULTS=block@7:xla)"
+    )
+    specs = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        body, repeat = entry, 1
+        if "*" in body:
+            body, _, rep = body.rpartition("*")
+            try:
+                repeat = int(rep)
+            except ValueError:
+                repeat = 0
+            if repeat < 1:
+                raise ValueError(f"bad repeat in {entry!r}: {grammar}")
+        if "@" not in body:
+            raise ValueError(f"bad entry {entry!r}: {grammar}")
+        site, _, rest = body.partition("@")
+        occ_s, _, kind = rest.partition(":")
+        kind = kind or "xla"
+        if site not in SITES:
+            raise ValueError(f"unknown site {site!r} in {entry!r}: {grammar}")
+        if kind not in KINDS:
+            raise ValueError(f"unknown kind {kind!r} in {entry!r}: {grammar}")
+        try:
+            occurrence = int(occ_s)
+        except ValueError:
+            occurrence = -1
+        if occurrence < 0:
+            raise ValueError(f"bad occurrence in {entry!r}: {grammar}")
+        specs.append(FaultSpec(site, occurrence, kind, repeat))
+    return tuple(specs)
+
+
+# Per-site crossing counters. Only mutated while a plan is armed (check()
+# returns first thing when the knob is unset), under the lock — the
+# prefetch feed and concurrent fits may cross sites from several threads.
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of the per-site crossing counters (tests/diagnostics)."""
+    with _lock:
+        return dict(_counts)
+
+
+def reset() -> None:
+    """Restart every site's crossing count at 0 — call between the
+    reference run and the armed run so occurrence indices are
+    deterministic regardless of process history."""
+    with _lock:
+        _counts.clear()
+
+
+def _raise_injected(kind: str, site: str, count: int):
+    msg = (
+        f"injected fault at site '{site}' occurrence {count} "
+        "(KEYSTONE_FAULTS)"
+    )
+    try:
+        import jaxlib.xla_extension as xe
+
+        err_cls = xe.XlaRuntimeError
+    except Exception:  # pragma: no cover - jaxlib always present in practice
+        err_cls = RuntimeError
+    if kind == "oom":
+        raise err_cls(f"RESOURCE_EXHAUSTED: {msg}")
+    raise err_cls(f"INTERNAL: {msg}")
+
+
+def check(site: str) -> None:
+    """Cross injection site ``site``: count the crossing and fire any armed
+    fault plan entry matching it. No-op (no counting, no parse) when
+    ``KEYSTONE_FAULTS`` is unset — the production fast path."""
+    from keystone_tpu.utils import knobs
+
+    if not knobs.get_raw("KEYSTONE_FAULTS"):
+        return
+    if site not in SITES:
+        raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
+    with _lock:
+        count = _counts.get(site, 0)
+        _counts[site] = count + 1
+    plan = knobs.get("KEYSTONE_FAULTS") or ()
+    for spec in plan:
+        if spec.site != site or not spec.matches(count):
+            continue
+        from keystone_tpu.telemetry import get_registry
+
+        get_registry().inc("faults.injected", site=site, kind=spec.kind)
+        from keystone_tpu.utils.logging import get_logger
+
+        get_logger("keystone_tpu.faults").warning(
+            "injecting %s fault at site %s occurrence %d", spec.kind, site,
+            count,
+        )
+        if spec.kind == "kill":
+            import os
+            import signal
+            import sys
+
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+        _raise_injected(spec.kind, site, count)

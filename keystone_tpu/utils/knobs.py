@@ -198,6 +198,15 @@ def _greater_than_one(v):
     return v
 
 
+def _fault_plan(raw: str):
+    """Normalizing validator: the ONE place the fault-plan grammar is
+    parsed (``utils/faults.py``). Consumers get the tuple of
+    ``FaultSpec``s, never a raw string to re-parse."""
+    from keystone_tpu.utils.faults import parse_fault_plan
+
+    return parse_fault_plan(raw)
+
+
 def _tiles_format(raw: str) -> Tuple[int, Optional[int]]:
     """Normalizing validator: the ONE place the tiles format is parsed.
     Returns ``(inner, outer_or_None)`` — consumers get the tuple, never a
@@ -381,6 +390,30 @@ declare("KEYSTONE_PRECISION_TIER", "str", "f32",
         "sketch application, and the bf16-input Pallas kernel variants. "
         "Orthogonal to the MXU arithmetic-precision knob "
         "(solvers.set_solver_precision).", choices=("f32", "bf16"))
+declare("KEYSTONE_FAULTS", "str", None,
+        "Deterministic fault-injection plan (utils/faults.py): "
+        "comma-separated '<site>@<occurrence>[:<kind>][*<repeat>]' "
+        "entries; occurrences are 0-BASED crossing counts — 'block@7:xla' "
+        "raises a retriable XlaRuntimeError at the streaming weighted "
+        "solver's block-boundary crossing number 7 (the 8th crossing). "
+        "Sites: block (weighted-BCD loop), bcd (BCD solver "
+        "entry), segment (pipeline fused-segment boundary), bench_section "
+        "(bench.py section flush). Kinds: xla (transient device error, "
+        "default), oom (RESOURCE_EXHAUSTED flavor), kill (SIGKILL). Unset "
+        "= zero injection; the compiled programs are byte-identical "
+        "either way (injection is host-side control flow).",
+        validator=_fault_plan)
+declare("KEYSTONE_RETRY_BUDGET", "int", 2,
+        "Default per-call retry budget for call_with_device_retries / "
+        "fit_streaming_elastic (utils/retry.py): the number of "
+        "re-attempts after the first failure; explicit retries= beats "
+        "it. Exhaustion re-raises the original error with the attempt "
+        "count in the message.", validator=_non_negative)
+declare("KEYSTONE_CHECKPOINT_DIR", "str", "",
+        "Default directory for solver checkpoints: fit_streaming_elastic "
+        "called without checkpoint_path= derives a per-fit file name "
+        "under it (utils/retry.py). Empty + no explicit path = error "
+        "(an elastic fit without a checkpoint cannot resume).")
 declare("KEYSTONE_SKETCH_BCD", "bool", False,
         "Leverage-score block scheduling for block coordinate descent: "
         "visit feature blocks in descending sketched-energy order instead "
@@ -456,7 +489,15 @@ declare("BENCH_FULL_PATH", "str", "",
         "Override path for the incremental bench_full.json artifact.")
 declare("BENCH_KILL_AFTER_SECTION", "str", "",
         "Test hook: SIGKILL the bench right after the named section "
-        "(pins incremental-flush survival).")
+        "(pins incremental-flush survival). KEYSTONE_FAULTS with a "
+        "'bench_section@N[:kill]' entry is the occurrence-indexed "
+        "generalization.")
+declare("BENCH_FAULTS", "bool", True,
+        "Fault-recovery section: inject a mid-schedule device error into "
+        "a streaming weighted fit, resume it from its checkpoint, and "
+        "record resume_overhead_s / retry_attempts_total / "
+        "checkpoint_{save,load}_s (budget-gated; exhaustion emits "
+        "faults_skipped).")
 
 
 # ---------------------------------------------------------------------------

@@ -9,22 +9,35 @@ a tunneled transport hiccup, an OOM that a smaller retry survives after
 buffers are freed. Pipeline nodes are pure functions of their inputs, so
 "recompute the segment" is exactly a retry.
 
-:func:`call_with_device_retries` wraps any callable; :class:`Retry` wraps a
-pipeline node as a host-boundary stage (the segment before it materializes,
-the wrapped node's own bulk path re-runs on failure);
-:func:`fit_streaming_elastic` composes the retry loop with the streaming
-weighted solver's mid-fit checkpoint, so a crashed multi-hour flagship fit
-RESUMES from its last completed block instead of restarting — the closest
-single-controller analog of Spark's lineage recompute for the solve itself.
-Deliberate non-feature: no cross-host elasticity (a multi-host mesh that
-loses a host must relaunch — JAX collectives cannot re-shard live; the
-relaunched job resumes from the same checkpoint).
+:func:`call_with_device_retries` wraps any callable with exponential backoff
+(deterministically jittered — reproducible runs, no synchronized thundering
+herd), a per-call retry budget (``KEYSTONE_RETRY_BUDGET`` unless an explicit
+``retries=`` wins), an on-retry hook whose default frees the intermediate
+cache's device tier on RESOURCE_EXHAUSTED errors (the OOM-survives-smaller-
+retry case), and telemetry counters (``retry.attempt`` / ``retry.resumed`` /
+``retry.exhausted``) so recoveries are observable, not silent. Exhaustion
+re-raises the original exception type with the attempt count in the
+message.
+
+:class:`Retry` wraps a pipeline node as a host-boundary stage (the segment
+before it materializes, the wrapped node's own bulk path re-runs on
+failure); :func:`fit_streaming_elastic` composes the retry loop with the
+streaming weighted solver's mid-fit checkpoint, so a crashed multi-hour
+flagship fit RESUMES from its last completed block instead of restarting —
+and, because checkpoints are mesh-portable (``core/checkpoint.py``), the
+resume may land on a *differently shaped* mesh than the crash did.
+Deliberate non-feature: no LIVE cross-host elasticity (a multi-host mesh
+that loses a host must relaunch — JAX collectives cannot re-shard mid-
+dispatch; the relaunched job resumes from the same checkpoint, on whatever
+mesh it comes back with).
 """
 
 from __future__ import annotations
 
+import os
 import time
-from typing import Any, Callable, ClassVar, Tuple, Type, TypeVar
+import zlib
+from typing import Any, Callable, ClassVar, Optional, Tuple, Type, TypeVar
 
 from flax import struct
 
@@ -45,38 +58,141 @@ def _default_retriable() -> Tuple[Type[BaseException], ...]:
         return (RuntimeError,)
 
 
+def resolve_retry_budget(retries: Optional[int] = None) -> int:
+    """Per-call re-attempt budget: explicit ``retries=`` beats the
+    ``KEYSTONE_RETRY_BUDGET`` knob (default 2 — the prior hard-coded
+    value, so unset keeps the exact prior behavior)."""
+    if retries is not None:
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        return int(retries)
+    from keystone_tpu.utils import knobs
+
+    return int(knobs.get("KEYSTONE_RETRY_BUDGET"))
+
+
+def _jitter_frac(token: str, attempt: int) -> float:
+    """Deterministic backoff jitter in [0, 0.25): a stable hash of the
+    call token + attempt number — a pure function, no RNG state, so waits
+    are reproducible within a process (chaos tests stay deterministic).
+    The token the caller builds folds in host + pid (``_retry_token``), so
+    N identical workers hitting the same outage de-synchronize instead of
+    re-dispatching in lockstep every round."""
+    h = zlib.crc32(f"{token}:{attempt}".encode())
+    return (h % 1024) / 4096.0
+
+
+def _retry_token(fn: Callable) -> str:
+    """Per-(host, process, callable) jitter token: without the host/pid
+    component every worker in a fleet retrying the same function would
+    compute identical waits — the exact thundering herd jitter exists to
+    prevent."""
+    import socket
+
+    return (
+        f"{socket.gethostname()}:{os.getpid()}:"
+        f"{getattr(fn, '__qualname__', type(fn).__name__)}"
+    )
+
+
+def _with_attempt_count(e: BaseException, tries: int) -> BaseException:
+    """Exhaustion surfaces the ORIGINAL exception object with the attempt
+    count appended to its message: the first arg is amended IN PLACE (when
+    it is a string), so the type, identity, and every constructor-set
+    attribute (``OSError.errno``, ...) survive — rebuilding via
+    ``type(e)(msg)`` would silently drop multi-arg state. Exceptions whose
+    first arg is not a string (``OSError(errno, strerror)``) are returned
+    untouched; the retry log already carries the attempt trail."""
+    suffix = f" [retry budget exhausted after {tries} attempt(s)]"
+    if e.args and isinstance(e.args[0], str):
+        e.args = (e.args[0] + suffix,) + e.args[1:]
+    elif not e.args:
+        e.args = (suffix.strip(),)
+    return e
+
+
+def default_on_retry(attempt: int, exc: BaseException) -> None:
+    """Pre-retry resource release: on RESOURCE_EXHAUSTED / out-of-memory
+    errors, free the intermediate cache's device tier
+    (``core/cache.py::release_device_tier``) so the retry re-dispatches
+    into HBM the failed attempt could not get — the docstring's
+    OOM-survives-smaller-retry case, now actually wired."""
+    text = str(exc).lower()
+    if "resource_exhausted" not in text and "out of memory" not in text:
+        return
+    from keystone_tpu.core.cache import get_cache
+
+    cache = get_cache()
+    if cache is None:
+        return
+    released = cache.release_device_tier()
+    if released:
+        from keystone_tpu.telemetry import get_registry
+
+        get_registry().inc("retry.cache_released", released)
+        logger.warning(
+            "freed %d device-tier cache entries before retry %d (%s)",
+            released, attempt, type(exc).__name__,
+        )
+
+
 def call_with_device_retries(
     fn: Callable[..., T],
     *args: Any,
-    retries: int = 2,
+    retries: Optional[int] = None,
     backoff_s: float = 1.0,
+    max_backoff_s: float = 60.0,
     retriable: Tuple[Type[BaseException], ...] = (),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
     **kwargs: Any,
 ) -> T:
     """Run ``fn(*args, **kwargs)``, retrying on device/runtime errors.
 
-    ``retries`` is the number of re-attempts after the first failure;
-    ``backoff_s`` doubles per attempt. Non-retriable exceptions propagate
-    immediately.
+    ``retries`` is the number of re-attempts after the first failure
+    (None = the ``KEYSTONE_RETRY_BUDGET`` knob, default 2); ``backoff_s``
+    doubles per attempt up to ``max_backoff_s``, with a deterministic
+    per-attempt jitter so synchronized workers fan out reproducibly.
+    ``on_retry(attempt, exc)`` runs before each re-dispatch — the default
+    (:func:`default_on_retry`) frees the intermediate cache's device tier
+    on OOM-flavored errors; a hook failure is logged, never allowed to
+    mask the retry itself. Non-retriable exceptions propagate immediately;
+    exhaustion re-raises the original exception type with the attempt
+    count in the message and counts ``retry.exhausted``.
 
     Caution: JAX dispatch is asynchronous — a jitted ``fn`` can "return"
     before the device error surfaces. Materialize inside the retried
     callable (``jax.block_until_ready``) or the error escapes the retry;
     :class:`Retry` does this for you.
     """
+    from keystone_tpu.telemetry import get_registry
+
+    reg = get_registry()
     retriable = retriable or _default_retriable()
+    budget = resolve_retry_budget(retries)
+    hook = default_on_retry if on_retry is None else on_retry
+    token = _retry_token(fn)
     attempt = 0
     while True:
         try:
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+            if attempt:
+                reg.inc("retry.resumed")
+            return out
         except retriable as e:
-            if attempt >= retries:
-                raise
+            reg.inc("retry.attempt")
+            if attempt >= budget:
+                reg.inc("retry.exhausted")
+                raise _with_attempt_count(e, attempt + 1)
             attempt += 1
-            wait = backoff_s * (2 ** (attempt - 1))
+            try:
+                hook(attempt, e)
+            except Exception as hook_err:  # the retry matters more
+                logger.warning("on_retry hook failed: %s", hook_err)
+            wait = min(backoff_s * (2 ** (attempt - 1)), max_backoff_s)
+            wait *= 1.0 + _jitter_frac(token, attempt)
             logger.warning(
                 "device error (attempt %d/%d), retrying in %.1fs: %s",
-                attempt, retries, wait, e,
+                attempt, budget, wait, e,
             )
             time.sleep(wait)
 
@@ -113,17 +229,81 @@ class Retry(Transformer):
         )
 
 
+def _default_checkpoint_path(estimator, num_nodes: int, raw, labels) -> str:
+    """Auto-derived checkpoint path under ``KEYSTONE_CHECKPOINT_DIR`` for
+    elastic fits called without an explicit path. Named from the fit's
+    static structure (estimator type, block layout, passes) PLUS a content
+    fingerprint of the labels and the raw inputs' shapes/dtypes — without
+    the data identity, a stale checkpoint from a crashed fit on *different
+    same-shape data* would silently resume into the wrong model (every
+    resume-side guard checks structure, not content). Hashing the labels
+    is cheap (n x C); the raw tensors contribute only their abstract
+    signature, so multi-GB descriptor sets cost nothing here — which also
+    bounds what the name can see: two fits with identical labels whose
+    RAW FEATURES or feature-node parameters differ still collide. The
+    auto path is a convenience for stable configurations; a run whose
+    features change between launches must pass an explicit
+    ``checkpoint_path`` (the caller's promise that the file belongs to
+    the fit). A completed fit removes the file, so the name is reusable
+    across runs."""
+    import hashlib
+
+    import jax
+
+    from keystone_tpu.utils import knobs
+
+    ckdir = knobs.get("KEYSTONE_CHECKPOINT_DIR")
+    if not ckdir:
+        raise ValueError(
+            "fit_streaming_elastic needs checkpoint_path= or "
+            "KEYSTONE_CHECKPOINT_DIR set — an elastic fit without a "
+            "checkpoint cannot resume"
+        )
+    # hash the labels' CONTENT via np.asarray — container- and
+    # mesh-invariant, unlike cache.fingerprint (which prefixes the leaf
+    # type and hashes sharded jax arrays per-slice): a relaunched job that
+    # loads the same labels as numpy, or holds them on a different mesh,
+    # must derive the SAME path or the resume silently never happens
+    import numpy as _np
+
+    h = hashlib.blake2b(digest_size=8)
+    lab = labels
+    if not getattr(lab, "is_fully_addressable", True):
+        # multi-host sharded labels: np.asarray would raise (each process
+        # addresses only its shard) and a per-shard hash would give each
+        # controller a DIFFERENT path — gather the global value so every
+        # process derives the same name (the _host_global pattern)
+        from jax.experimental import multihost_utils
+
+        lab = multihost_utils.process_allgather(lab, tiled=True)
+    lab = _np.asarray(lab)
+    h.update(f"{lab.shape}:{lab.dtype};".encode())
+    h.update(_np.ascontiguousarray(lab).tobytes())
+    for leaf in jax.tree_util.tree_leaves(raw):
+        h.update(
+            f"{tuple(getattr(leaf, 'shape', ()))}:"
+            f"{getattr(leaf, 'dtype', '')};".encode()
+        )
+    name = (
+        f"elastic_{type(estimator).__name__}_{num_nodes}b"
+        f"x{getattr(estimator, 'block_size', 0)}"
+        f"_{getattr(estimator, 'num_iter', 0)}it_{h.hexdigest()}.ckpt"
+    )
+    return os.path.join(ckdir, name)
+
+
 def fit_streaming_elastic(
     estimator,
     feature_nodes,
     raw,
     labels,
     *,
-    checkpoint_path: str,
+    checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 1,
-    retries: int = 2,
+    retries: Optional[int] = None,
     backoff_s: float = 1.0,
     retriable: Tuple[Type[BaseException], ...] = (),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
     **fit_kwargs: Any,
 ):
     """Streaming weighted fit with crash resume: retry x mid-fit checkpoint.
@@ -137,21 +317,75 @@ def fit_streaming_elastic(
     (SURVEY §5); here the checkpoint IS the lineage cut. The completed fit
     removes its checkpoint, so the path is reusable.
 
+    ``checkpoint_path=None`` derives a per-(configuration, data) file under
+    ``KEYSTONE_CHECKPOINT_DIR`` — the name fingerprints the labels'
+    content and the raw inputs' signature, so fits on datasets with
+    different labels never share a file; raw-feature content is NOT
+    hashed (multi-GB), so runs whose features change under identical
+    labels must pass an explicit path — the caller's promise that the
+    file belongs to this fit (see ``_default_checkpoint_path``).
+    ``retries=None`` takes the ``KEYSTONE_RETRY_BUDGET`` knob. Unusable
+    files at the path — failed checksums (``CheckpointCorruptError``: a
+    torn write never survives the v2 atomic protocol, but a truncated copy
+    or disk fault can) or pickle-loadable non-checkpoints — are deleted
+    and the fit restarts from scratch: degraded to a full refit, never
+    wedged on garbage, zero manual intervention. An INTACT checkpoint for
+    a different fit (``CheckpointMismatchError``) stays loud — deleting it
+    could destroy another run's progress.
+
     Progress preservation is pinned in ``tests/test_retry.py`` (a node that
     fails once mid-fit: the rerun must not revisit completed blocks, and the
-    result must equal the uninterrupted fit bit-exactly).
+    result must equal the uninterrupted fit bit-exactly);
+    ``scripts/chaos_smoke.py`` additionally pins the resume on a RESHAPED
+    mesh (the checkpoint is mesh-portable — ``core/checkpoint.py``).
     """
+    if checkpoint_path is None:
+        checkpoint_path = _default_checkpoint_path(
+            estimator, len(feature_nodes), raw, labels
+        )
+
     def attempt():
         import jax
 
-        model = estimator.fit_streaming(
-            feature_nodes,
-            raw,
-            labels,
-            checkpoint_path=checkpoint_path,
-            checkpoint_every=checkpoint_every,
-            **fit_kwargs,
+        from keystone_tpu.core.checkpoint import (
+            CheckpointError,
+            CheckpointMismatchError,
+            CheckpointWriteError,
         )
+
+        def fit():
+            return estimator.fit_streaming(
+                feature_nodes,
+                raw,
+                labels,
+                checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every,
+                **fit_kwargs,
+            )
+
+        try:
+            model = fit()
+        except (CheckpointMismatchError, CheckpointWriteError):
+            # an INTACT checkpoint for a different fit/schedule (deleting
+            # it could destroy another run's progress), or a WRITE-side
+            # bug in this fit's own saver (deleting the last good file
+            # and refitting would hit the same bug at its first save):
+            # both stay loud
+            raise
+        except CheckpointError as e:
+            # corrupt/truncated/not-a-checkpoint garbage at the path must
+            # not wedge the elastic fit: drop it loudly and pay the full
+            # refit (the zero-manual-intervention contract)
+            logger.warning(
+                "checkpoint %s is unusable (%s); removing it and refitting "
+                "from scratch", checkpoint_path, e,
+            )
+            from keystone_tpu.telemetry import get_registry
+
+            get_registry().inc("checkpoint.corrupt_discarded")
+            if os.path.exists(checkpoint_path):
+                os.remove(checkpoint_path)
+            model = fit()
         # materialize INSIDE the retried callable: dispatch is async, so a
         # device error in blocks queued after the last checkpoint would
         # otherwise surface outside the retry loop (see
@@ -159,5 +393,6 @@ def fit_streaming_elastic(
         return jax.block_until_ready(model)
 
     return call_with_device_retries(
-        attempt, retries=retries, backoff_s=backoff_s, retriable=retriable
+        attempt, retries=retries, backoff_s=backoff_s, retriable=retriable,
+        on_retry=on_retry,
     )

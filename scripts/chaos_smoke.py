@@ -1,0 +1,174 @@
+"""Chaos-ladder smoke (<20 s, CPU): the `make chaos-smoke` rung of
+`verify-fast` — inject → crash → resume-on-a-RESHAPED-mesh, end to end.
+
+Pins, through the REAL entry points on the 8-device CPU sim:
+
+1. A streaming weighted fit sharded over an 8-device mesh is killed
+   mid-schedule by a deterministic injected device error
+   (``KEYSTONE_FAULTS=block@K:xla`` — utils/faults.py), leaving its
+   mid-fit checkpoint behind.
+2. The SAME checkpoint resumes the fit on a 4-device mesh — the
+   preempted-pod-comes-back-smaller scenario: the manifest records the
+   mesh the state was written under, the resume reshards onto the live
+   one (``checkpoint.reshard`` counted), and the fit completes with zero
+   manual intervention.
+3. The resumed model matches the uninterrupted twin within the
+   documented envelope (identical math; only the collective reduction
+   geometry changed, so the delta is reduction-order rounding).
+4. The completed fit removes its checkpoint, and a deliberately
+   truncated checkpoint raises the NAMED CheckpointCorruptError — never
+   half-loaded garbage.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# 8-device CPU sim, set BEFORE jax initializes a backend (conftest pattern)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.pop("KEYSTONE_FAULTS", None)
+
+t_start = time.monotonic()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+BUDGET_S = 20.0
+
+
+class _Slice:
+    """Streaming feature node: one column block of the raw features."""
+
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def apply_batch(self, raw):
+        return raw["x"][:, self.lo : self.hi]
+
+
+def _put_rows(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P("data", None)))
+
+
+def main() -> int:
+    import tempfile
+
+    from keystone_tpu.core.checkpoint import (
+        CheckpointCorruptError,
+        load_manifest,
+    )
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+    from keystone_tpu.parallel import make_mesh
+    from keystone_tpu.telemetry import get_registry
+    from keystone_tpu.utils import faults
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"need the 8-device CPU sim, got {len(devices)}"
+    reg = get_registry()
+
+    n, d, c, bs = 128, 32, 4, 8
+    nblocks = d // bs
+    num_iter = 2  # schedule length 8: room for a mid-schedule kill
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    lbl = (np.eye(c, dtype=np.float32)[np.arange(n) % c] * 2.0 - 1.0)
+    nodes = [_Slice(k * bs, (k + 1) * bs) for k in range(nblocks)]
+
+    mesh8 = make_mesh(data=8, model=1, devices=devices[:8])
+    mesh4 = make_mesh(data=4, model=1, devices=devices[:4])
+
+    def fit(mesh, est, **kw):
+        raw = {"x": _put_rows(mesh, jnp.asarray(x))}
+        labels = _put_rows(mesh, jnp.asarray(lbl))
+        m = est.fit_streaming(nodes, raw, labels, **kw)
+        jax.block_until_ready(m.w)
+        return m
+
+    est = BlockWeightedLeastSquaresEstimator(bs, num_iter, 0.1, 0.25)
+
+    # uninterrupted twin on the full 8-device mesh
+    ref = fit(mesh8, est)
+
+    # 1. inject: deterministic device error at schedule position 5 (pass 1,
+    #    second block) — mid-schedule, past the first full pass
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="chaos_smoke_"), "fit.ckpt")
+    kill_pos = 5
+    faults.reset()
+    os.environ["KEYSTONE_FAULTS"] = f"block@{kill_pos}:xla"
+    try:
+        try:
+            fit(mesh8, est, checkpoint_path=ckpt, checkpoint_every=1)
+        except Exception as e:
+            assert "injected fault" in str(e), f"unexpected failure: {e}"
+        else:
+            raise AssertionError("injected fault did not fire")
+    finally:
+        os.environ.pop("KEYSTONE_FAULTS", None)
+        faults.reset()
+    assert os.path.exists(ckpt), "crash left no checkpoint behind"
+    manifest = load_manifest(ckpt)
+    assert manifest and manifest["mesh_shape"] == {"data": 8, "model": 1}, (
+        f"manifest did not record the writing mesh: {manifest}"
+    )
+    assert manifest["pos"] == kill_pos, manifest["pos"]
+
+    # 2. resume the SAME checkpoint on the RESHAPED (8 -> 4 device) mesh
+    reshards0 = reg.get_counter("checkpoint.reshard")
+    resumed = fit(mesh4, est, checkpoint_path=ckpt, checkpoint_every=1)
+    assert reg.get_counter("checkpoint.reshard") > reshards0, (
+        "resume on the reshaped mesh did not count checkpoint.reshard"
+    )
+    assert not os.path.exists(ckpt), "completed fit left its checkpoint"
+
+    # 3. envelope: same math, different reduction geometry — the delta is
+    #    collective reduction-order rounding, orders below model scale
+    w_ref = np.asarray(ref.w, np.float64)
+    w_res = np.asarray(resumed.w, np.float64)
+    delta = float(
+        np.linalg.norm(w_res - w_ref) / max(np.linalg.norm(w_ref), 1e-30)
+    )
+    assert delta < 1e-4, f"reshaped resume diverged from the twin: {delta}"
+    b_delta = float(np.max(np.abs(np.asarray(resumed.b) - np.asarray(ref.b))))
+    assert b_delta < 1e-4, f"intercept diverged: {b_delta}"
+
+    # 4. a truncated checkpoint is a NAMED error, never half-loaded
+    from keystone_tpu.core.checkpoint import save_node
+
+    trunc = ckpt + ".trunc"
+    save_node({"w": np.arange(1024, dtype=np.float32)}, trunc)
+    blob = open(trunc, "rb").read()
+    with open(trunc, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    try:
+        load_manifest(trunc)
+    except CheckpointCorruptError:
+        pass
+    else:
+        raise AssertionError("truncated checkpoint loaded without error")
+
+    elapsed = time.monotonic() - t_start
+    print(
+        f"chaos-smoke OK in {elapsed:.1f}s: injected fault at pos "
+        f"{kill_pos}, resumed 8->4 devices (reshard counted), "
+        f"w_delta={delta:.2e}, truncated file -> CheckpointCorruptError"
+    )
+    assert elapsed < BUDGET_S, f"smoke took {elapsed:.1f}s (>{BUDGET_S}s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,15 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     assert 0 <= full["gram_bf16_vs_f32_error_delta"] < 0.05
     assert 0 <= full["sketch_bf16_vs_f32_error_delta"] < 0.05
     assert compact["g_gram16"] == full["gram_bf16_gflops"]
+    # fault-recovery pair (PR 12): a streaming fit killed mid-schedule by
+    # an injected device error resumed through the production elastic
+    # retry loop — the crash price, the retry count that paid it, and the
+    # measured checkpoint save/load costs all on record
+    assert full["resume_overhead_s"] >= 0
+    assert full["retry_attempts_total"] >= 1
+    assert full["checkpoint_save_s"] > 0
+    assert full["checkpoint_load_s"] > 0
+    assert compact["retry_n"] == full["retry_attempts_total"]
     # whole-pipeline-optimizer rows (core/plan.py): the flagship plan's
     # decisions landed, and the repeat plan in the same process performed
     # ZERO re-plans (the content-fingerprinted memo served it)
@@ -169,6 +178,10 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # contract — no speed key may land without its budget story
     assert full.get("precision_skipped") == "budget"
     assert "gram_bf16_gflops" not in full
+    # ... and the fault-recovery section (PR 12): same reduced-floor
+    # contract
+    assert full.get("faults_skipped") == "budget"
+    assert "resume_overhead_s" not in full
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

@@ -703,3 +703,92 @@ def test_dense_refit_checkpoint_not_resumed_as_woodbury(rng, tmp_path):
         _force_dense=True,
     )
     np.testing.assert_array_equal(np.asarray(W_resumed), np.asarray(W_dense))
+
+
+def test_streaming_checkpoint_resumes_on_reshaped_mesh(rng, tmp_path, devices):
+    """Mesh portability (PR 12): a checkpoint written under an 8-device
+    row-sharded mesh resumes on a 4-device mesh — the PR-6 loud
+    mismatch-on-resume became reshard-and-continue (counted as
+    checkpoint.reshard), loud only on genuine shape mismatch. The resumed
+    model must match the uninterrupted twin within reduction-order
+    rounding (same math, different collective geometry)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.core.checkpoint import load_manifest
+    from keystone_tpu.parallel import make_mesh
+    from keystone_tpu.telemetry import get_registry
+
+    x, labels, ind = _toy(rng, n=160, d=32, balanced=False)
+    bs = 8
+    nblocks = x.shape[1] // bs
+    est = BlockWeightedLeastSquaresEstimator(bs, 2, 0.1, 0.25)
+    mesh8 = make_mesh(data=8, model=1, devices=devices[:8])
+    mesh4 = make_mesh(data=4, model=1, devices=devices[:4])
+
+    def put(mesh, a):
+        return jax.device_put(
+            jnp.asarray(a), NamedSharding(mesh, P("data", None))
+        )
+
+    nodes = [_SliceNode(k * bs, (k + 1) * bs) for k in range(nblocks)]
+    m_ref = est.fit_streaming(nodes, {"x": put(mesh8, x)}, put(mesh8, ind))
+
+    ckpt = str(tmp_path / "reshard.ckpt")
+    fail_at = nblocks + 2  # mid-schedule, in the second pass
+    _FailingSliceNode.calls = 0
+    failing = [
+        _FailingSliceNode(k * bs, (k + 1) * bs, fail_at)
+        for k in range(nblocks)
+    ]
+    with pytest.raises(RuntimeError, match="injected"):
+        est.fit_streaming(
+            failing, {"x": put(mesh8, x)}, put(mesh8, ind),
+            checkpoint_path=ckpt, checkpoint_every=1,
+        )
+    manifest = load_manifest(ckpt)
+    assert manifest["mesh_shape"] == {"data": 8, "model": 1}
+
+    reg = get_registry()
+    r0 = reg.get_counter("checkpoint.reshard")
+    m_res = est.fit_streaming(
+        nodes, {"x": put(mesh4, x)}, put(mesh4, ind),
+        checkpoint_path=ckpt, checkpoint_every=1,
+    )
+    assert reg.get_counter("checkpoint.reshard") > r0
+    assert not (tmp_path / "reshard.ckpt").exists()
+    np.testing.assert_allclose(
+        np.asarray(m_res.w), np.asarray(m_ref.w), rtol=2e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_res.b), np.asarray(m_ref.b), rtol=2e-4, atol=1e-6
+    )
+
+
+def test_streaming_checkpoint_manifest_schedule_skew_is_loud(rng, tmp_path):
+    """A manifest whose schedule fingerprint disagrees with the state's own
+    saved schedule (manifest/state skew — a corruption class the per-field
+    checks cannot see) must fail with the named mismatch error."""
+    from keystone_tpu.core.checkpoint import (
+        CheckpointMismatchError,
+        load_checkpoint,
+        load_manifest,
+        save_node,
+    )
+
+    x, labels, ind = _toy(rng, n=80, d=16, balanced=False)
+    est = BlockWeightedLeastSquaresEstimator(8, 1, 0.1, 0.25)
+    ckpt = str(tmp_path / "skew.ckpt")
+    _FailingSliceNode.calls = 0
+    failing = [_FailingSliceNode(k * 8, (k + 1) * 8, 2) for k in range(2)]
+    with pytest.raises(RuntimeError, match="injected"):
+        est.fit_streaming(failing, {"x": jnp.asarray(x)}, jnp.asarray(ind),
+                          checkpoint_path=ckpt, checkpoint_every=1)
+    state, manifest = load_checkpoint(ckpt)
+    manifest["schedule_fingerprint"] = "0" * 32  # forge the skew
+    save_node(state, ckpt, manifest=manifest)
+    assert load_manifest(ckpt)["schedule_fingerprint"] == "0" * 32
+    nodes = [_SliceNode(k * 8, (k + 1) * 8) for k in range(2)]
+    with pytest.raises(CheckpointMismatchError, match="skew"):
+        est.fit_streaming(nodes, {"x": jnp.asarray(x)}, jnp.asarray(ind),
+                          checkpoint_path=ckpt, checkpoint_every=1)

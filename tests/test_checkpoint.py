@@ -174,3 +174,211 @@ def test_fitted_fisher_pipeline_round_trip(tmp_path, rng):
     np.testing.assert_allclose(
         np.asarray(back(imgs)), np.asarray(pipeline(imgs)), atol=1e-6
     )
+
+
+# ---------------------------------------------------------------------------
+# Durability + mesh-portability contract (PR 12): checksummed v2 payloads,
+# crash-atomic writes, manifests, named errors
+# ---------------------------------------------------------------------------
+
+def test_truncated_checkpoint_raises_named_error(tmp_path):
+    """A truncated file must raise CheckpointCorruptError BEFORE any state
+    is unpickled — loaded whole or not at all, never garbage."""
+    from keystone_tpu.core.checkpoint import (
+        CheckpointCorruptError,
+        load_node,
+        save_node,
+    )
+
+    p = str(tmp_path / "t.ckpt")
+    save_node({"w": np.arange(4096, dtype=np.float32)}, p)
+    blob = open(p, "rb").read()
+    for cut in (len(blob) // 2, 10, 1):
+        with open(p, "wb") as f:
+            f.write(blob[:cut])
+        with pytest.raises(CheckpointCorruptError):
+            load_node(p)
+
+
+def test_bitflip_fails_checksum(tmp_path):
+    """Corruption anywhere in the payload fails the SHA-256 check with the
+    named error (bit-rot is detected, not silently deserialized)."""
+    from keystone_tpu.core.checkpoint import (
+        CheckpointCorruptError,
+        load_node,
+        save_node,
+    )
+
+    p = str(tmp_path / "b.ckpt")
+    save_node({"w": np.arange(4096, dtype=np.float32)}, p)
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) - 100] ^= 0xFF  # flip a byte inside the array payload
+    with open(p, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(CheckpointCorruptError, match="checksum"):
+        load_node(p)
+
+
+def test_legacy_v1_checkpoint_still_loads(tmp_path):
+    """Pre-checksum (v1) files written by earlier builds keep loading —
+    format migration must not strand existing checkpoints."""
+    import pickle
+
+    import jax
+
+    from keystone_tpu.core.checkpoint import load_checkpoint
+
+    value = {"w": np.arange(16, dtype=np.float32)}
+    leaves, treedef = jax.tree.flatten(value)
+    p = tmp_path / "v1.ckpt"
+    p.write_bytes(pickle.dumps({
+        "magic": "keystone-tpu-node-v1",
+        "treedef": treedef,
+        "leaves": [np.asarray(l) for l in leaves],
+    }))
+    node, manifest = load_checkpoint(str(p))
+    np.testing.assert_array_equal(node["w"], value["w"])
+    assert manifest is None
+
+
+def test_manifest_round_trip_and_validation(tmp_path):
+    from keystone_tpu.analysis.contracts import validate_manifest
+    from keystone_tpu.core.checkpoint import (
+        CheckpointError,
+        build_manifest,
+        load_checkpoint,
+        load_manifest,
+        save_node,
+    )
+
+    state = {"R": np.zeros((8, 3), np.float32),
+             "models": [np.zeros((4, 3), np.float32)]}
+    manifest = build_manifest(
+        state, mesh_shape={"data": 8, "model": 1}, mesh_devices=8,
+        block_order=[0, 1], pos=3,
+    )
+    assert validate_manifest(manifest) == []
+    # per-array logical shapes recorded for every leaf
+    assert any("R" in k for k in manifest["arrays"])
+    assert manifest["arrays"]["['R']"] == {"shape": [8, 3],
+                                           "dtype": "float32"}
+    p = str(tmp_path / "m.ckpt")
+    save_node(state, p, manifest=manifest)
+    node, back = load_checkpoint(p)
+    assert back == manifest
+    assert load_manifest(p) == manifest
+    np.testing.assert_array_equal(node["R"], state["R"])
+
+    # the contract rejects malformed manifests on BOTH sides
+    assert validate_manifest({"format": 2}) != []          # arrays missing
+    assert validate_manifest({"arrays": {}}) != []         # format missing
+    assert validate_manifest(
+        {"format": 2, "arrays": {"x": {"shape": "nope", "dtype": "f"}}}
+    ) != []
+    assert validate_manifest(
+        {"format": 2, "arrays": {}, "mesh_shape": {"data": 0}}
+    ) != []
+    with pytest.raises(CheckpointError, match="contract"):
+        build_manifest(state, mesh_shape={"data": 0})  # writer-side catch
+
+
+def test_restore_onto_reshards_and_rejects_mismatch(devices):
+    """restore_onto re-device_puts host state onto the LIVE sharding (the
+    mesh-portable resume step) and raises the named mismatch error when
+    logical shapes genuinely disagree."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.core.checkpoint import (
+        CheckpointMismatchError,
+        mesh_shape_of,
+        restore_onto,
+    )
+    from keystone_tpu.parallel import make_mesh
+
+    mesh4 = make_mesh(data=4, model=1, devices=devices[:4])
+    live = jax.device_put(
+        jnp.zeros((16, 3)), NamedSharding(mesh4, P("data", None))
+    )
+    host = np.arange(48, dtype=np.float32).reshape(16, 3)
+    out = restore_onto(host, live)
+    assert out.sharding == live.sharding
+    np.testing.assert_array_equal(np.asarray(out), host)
+    assert mesh_shape_of(live) == {"data": 4, "model": 1}
+    assert mesh_shape_of(np.zeros(3)) is None
+    with pytest.raises(CheckpointMismatchError, match="shape"):
+        restore_onto(np.zeros((8, 3), np.float32), live)
+
+
+def test_save_is_crash_atomic(tmp_path, monkeypatch):
+    """A crash mid-write leaves the PREVIOUS checkpoint intact: the payload
+    goes to a temp file and only an atomic rename publishes it."""
+    import os
+
+    from keystone_tpu.core.checkpoint import load_node, save_node
+
+    p = str(tmp_path / "a.ckpt")
+    save_node({"v": np.float32(1.0)}, p)
+
+    real_replace = os.replace
+
+    def crashing_replace(src, dst):
+        raise OSError("simulated crash at publish time")
+
+    monkeypatch.setattr(os, "replace", crashing_replace)
+    with pytest.raises(OSError):
+        save_node({"v": np.float32(2.0)}, p)
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert float(load_node(p)["v"]) == 1.0  # old checkpoint intact
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+def test_checkpoint_telemetry_histograms(tmp_path):
+    from keystone_tpu.core.checkpoint import load_node, save_node
+    from keystone_tpu.telemetry import get_registry
+
+    reg = get_registry()
+
+    def count(name):
+        h = reg.get_histogram(name)
+        return (h or {}).get("count", 0)
+
+    s0, l0 = count("checkpoint.save_s"), count("checkpoint.load_s")
+    p = str(tmp_path / "t.ckpt")
+    save_node({"v": np.zeros(8, np.float32)}, p)
+    load_node(p)
+    assert count("checkpoint.save_s") == s0 + 1
+    assert count("checkpoint.load_s") == l0 + 1
+
+
+def test_v1_magic_missing_fields_is_named_corruption(tmp_path):
+    """A v1-magic dict missing treedef/leaves must raise the NAMED
+    corruption error, not a KeyError that escapes the elastic recovery
+    path's except-CheckpointError handler."""
+    import pickle
+
+    from keystone_tpu.core.checkpoint import (
+        CheckpointCorruptError,
+        load_node,
+    )
+
+    p = tmp_path / "v1bad.ckpt"
+    p.write_bytes(pickle.dumps({"magic": "keystone-tpu-node-v1"}))
+    with pytest.raises(CheckpointCorruptError, match="v1"):
+        load_node(str(p))
+
+
+def test_writer_side_manifest_bug_is_distinct_from_corruption():
+    """build_manifest failures are CheckpointWriteError — a code bug in
+    the writer, deliberately NOT a subclass match for the discard-and-
+    refit handler's unusable-file class."""
+    from keystone_tpu.core.checkpoint import (
+        CheckpointCorruptError,
+        CheckpointWriteError,
+        build_manifest,
+    )
+
+    with pytest.raises(CheckpointWriteError):
+        build_manifest({"x": np.zeros(2)}, mesh_shape={"data": 0})
+    assert not issubclass(CheckpointWriteError, CheckpointCorruptError)

@@ -1,0 +1,212 @@
+"""Deterministic fault injection (``utils/faults.py``): the KEYSTONE_FAULTS
+plan grammar, the per-site occurrence counters, each wired injection site
+(streaming block loop, BCD entry, pipeline segment boundary), and the
+off-by-default contract — unset knob means no counting, no behavior change,
+bit-identical results."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.utils import faults, knobs
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("KEYSTONE_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Plan grammar
+# ---------------------------------------------------------------------------
+
+def test_plan_parses_through_knob_registry(monkeypatch):
+    monkeypatch.setenv(
+        "KEYSTONE_FAULTS", "block@7, bcd@0:oom, segment@2:xla*3"
+    )
+    plan = knobs.get("KEYSTONE_FAULTS")
+    assert plan == (
+        faults.FaultSpec("block", 7, "xla", 1),
+        faults.FaultSpec("bcd", 0, "oom", 1),
+        faults.FaultSpec("segment", 2, "xla", 3),
+    )
+
+
+@pytest.mark.parametrize("bad", [
+    "block",            # no occurrence
+    "block@x",          # non-integer occurrence
+    "nope@1",           # unknown site
+    "block@1:zap",      # unknown kind
+    "block@1*0",        # repeat < 1
+    "block@-1",         # negative occurrence
+])
+def test_malformed_plan_is_a_knob_error(monkeypatch, bad):
+    monkeypatch.setenv("KEYSTONE_FAULTS", bad)
+    with pytest.raises(ValueError, match="KEYSTONE_FAULTS"):
+        knobs.get("KEYSTONE_FAULTS")
+    # and validate_environment (the bench's fail-fast) rejects it too
+    with pytest.raises(ValueError):
+        knobs.validate_environment()
+
+
+def test_repeat_fires_consecutive_occurrences(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FAULTS", "bcd@1:xla*2")
+    faults.check("bcd")  # occurrence 0: clean
+    for _ in range(2):   # occurrences 1, 2: both fire
+        with pytest.raises(Exception, match="injected fault"):
+            faults.check("bcd")
+    faults.check("bcd")  # occurrence 3: clean again
+
+
+# ---------------------------------------------------------------------------
+# Off-by-default contract
+# ---------------------------------------------------------------------------
+
+def test_unset_knob_counts_nothing_and_changes_nothing(rng):
+    from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+
+    A = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    w_ref = np.asarray(block_coordinate_descent_l2(A, b, 1.0, 8))
+    # the armed-plan crossings of other tests were reset by the fixture;
+    # unarmed crossings must not count at all
+    assert faults.counters() == {}
+    w_again = np.asarray(block_coordinate_descent_l2(A, b, 1.0, 8))
+    np.testing.assert_array_equal(w_ref, w_again)
+    assert faults.counters() == {}
+
+
+def test_injected_error_is_retriable_and_counted(monkeypatch):
+    """The default kind raises the SAME XlaRuntimeError type the retry
+    wrapper treats as retriable — injection exercises the production
+    recovery path, not a parallel test-only one."""
+    import jaxlib.xla_extension as xe
+
+    from keystone_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    before = reg.get_counter("faults.injected", site="bcd", kind="xla")
+    monkeypatch.setenv("KEYSTONE_FAULTS", "bcd@0")
+    with pytest.raises(xe.XlaRuntimeError, match="INTERNAL: injected"):
+        faults.check("bcd")
+    assert reg.get_counter(
+        "faults.injected", site="bcd", kind="xla"
+    ) == before + 1
+
+
+def test_oom_kind_has_resource_exhausted_flavor(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FAULTS", "segment@0:oom")
+    with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+        faults.check("segment")
+
+
+def test_unknown_site_crossing_is_a_bug(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@99")
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.check("typo_site")
+
+
+# ---------------------------------------------------------------------------
+# Wired sites
+# ---------------------------------------------------------------------------
+
+def test_bcd_entry_site_fires(monkeypatch, rng):
+    from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+
+    A = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    monkeypatch.setenv("KEYSTONE_FAULTS", "bcd@1")
+    w0 = block_coordinate_descent_l2(A, b, 1.0, 8)  # occurrence 0: clean
+    with pytest.raises(Exception, match="injected fault"):
+        block_coordinate_descent_l2(A, b, 1.0, 8)   # occurrence 1: fires
+    assert w0.shape == (16, 3)
+
+
+def test_segment_boundary_site_fires(monkeypatch, rng):
+    from keystone_tpu.core.pipeline import chain
+    from keystone_tpu.ops.stats import LinearRectifier
+
+    pipe = chain(LinearRectifier(), LinearRectifier())
+    x = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    np.testing.assert_array_equal(  # unarmed: the fused segment runs clean
+        np.asarray(pipe(x)), np.maximum(np.asarray(x), 0.0)
+    )
+    monkeypatch.setenv("KEYSTONE_FAULTS", "segment@0")
+    with pytest.raises(Exception, match="injected fault"):
+        pipe(x)
+
+
+def test_streaming_block_site_kills_mid_schedule_and_resumes(
+    monkeypatch, rng, tmp_path
+):
+    """The chaos-ladder core on one mesh: an injected device error at a
+    mid-schedule block boundary leaves the checkpoint behind; the
+    production elastic retry resumes from it and the result equals the
+    uninterrupted fit bit-exactly (same mesh, same reduction geometry)."""
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+    from keystone_tpu.telemetry import get_registry
+    from keystone_tpu.utils import fit_streaming_elastic
+
+    n, d, c, bs = 96, 32, 4, 8
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    lbl = jnp.asarray(
+        np.eye(c, dtype=np.float32)[np.arange(n) % c] * 2.0 - 1.0
+    )
+
+    class Slice:
+        def __init__(self, lo, hi):
+            self.lo, self.hi = lo, hi
+
+        def apply_batch(self, raw):
+            return raw["x"][:, self.lo : self.hi]
+
+    nodes = [Slice(k * bs, (k + 1) * bs) for k in range(d // bs)]
+    est = BlockWeightedLeastSquaresEstimator(bs, 1, 0.1, 0.25)
+    ref = est.fit_streaming(nodes, {"x": x}, lbl)
+
+    reg = get_registry()
+    resumed0 = reg.get_counter("retry.resumed")
+    ckpt = str(tmp_path / "chaos.ckpt")
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@2:xla")
+    m = fit_streaming_elastic(
+        est, nodes, {"x": x}, lbl,
+        checkpoint_path=ckpt, checkpoint_every=1,
+        retries=2, backoff_s=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(m.w), np.asarray(ref.w))
+    np.testing.assert_array_equal(np.asarray(m.b), np.asarray(ref.b))
+    assert reg.get_counter("retry.resumed") == resumed0 + 1
+    assert not os.path.exists(ckpt)  # completed fit cleans up
+
+
+def test_kill_kind_sigkills_the_process(tmp_path):
+    """The 'kill' kind is a real SIGKILL (the preemption only a checkpoint
+    survives) — exercised in a subprocess so this test outlives it."""
+    import signal
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['KEYSTONE_FAULTS'] = 'segment@0:kill'\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from keystone_tpu.utils import faults\n"
+        "faults.check('segment')\n"
+        "print('survived')\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240, env=env,
+    )
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stdout, proc.stderr[-500:]
+    )
+    assert "survived" not in proc.stdout

@@ -126,3 +126,359 @@ def test_fit_streaming_elastic_resumes_not_restarts(tmp_path):
     assert calls["n"] == 3 + (nblocks - 2)
     # completed elastic fit cleans its checkpoint (path reusable)
     assert not (tmp_path / "elastic.ckpt").exists()
+
+
+# ---------------------------------------------------------------------------
+# Hardened retry (PR 12): budget knob, deterministic jitter, on-retry hook,
+# exhaustion message
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_knob_governs_default(monkeypatch):
+    """retries=None takes KEYSTONE_RETRY_BUDGET; explicit retries= wins."""
+    monkeypatch.setenv("KEYSTONE_RETRY_BUDGET", "0")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise _FakeDeviceError("down")
+
+    with pytest.raises(_FakeDeviceError):
+        call_with_device_retries(
+            flaky, backoff_s=0.0, retriable=(_FakeDeviceError,)
+        )
+    assert len(calls) == 1  # budget 0: no re-attempts
+    calls.clear()
+    with pytest.raises(_FakeDeviceError):
+        call_with_device_retries(  # explicit beats the knob
+            flaky, retries=2, backoff_s=0.0, retriable=(_FakeDeviceError,)
+        )
+    assert len(calls) == 3
+
+
+def test_exhaustion_surfaces_original_type_with_attempt_count():
+    def always_fails():
+        raise _FakeDeviceError("device gone")
+
+    with pytest.raises(_FakeDeviceError) as ei:
+        call_with_device_retries(
+            always_fails, retries=2, backoff_s=0.0,
+            retriable=(_FakeDeviceError,),
+        )
+    msg = str(ei.value)
+    assert "device gone" in msg and "3 attempt" in msg, msg
+
+
+def test_exhaustion_preserves_constructor_set_attributes():
+    """The attempt count is amended IN PLACE (string first-arg) or skipped
+    (non-string first-arg) — never a type(e)(msg) rebuild that would drop
+    multi-arg state like OSError.errno, breaking upstream handlers that
+    inspect it."""
+    import errno as _errno
+
+    def fails_with_errno():
+        raise OSError(_errno.ENOSPC, "No space left on device")
+
+    with pytest.raises(OSError) as ei:
+        call_with_device_retries(
+            fails_with_errno, retries=1, backoff_s=0.0, retriable=(OSError,)
+        )
+    assert ei.value.errno == _errno.ENOSPC  # handler-visible state intact
+
+
+def test_backoff_is_deterministic_jittered_and_capped(monkeypatch):
+    """Waits are exponential with a deterministic jitter in [0, 25%) and a
+    hard cap — two identical runs sleep the exact same schedule."""
+    from keystone_tpu.utils.retry import _jitter_frac
+
+    for token in ("a", "b"):
+        for attempt in range(1, 6):
+            f = _jitter_frac(token, attempt)
+            assert 0.0 <= f < 0.25
+            assert f == _jitter_frac(token, attempt)  # deterministic
+
+    waits = []
+    monkeypatch.setattr("time.sleep", lambda s: waits.append(s))
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise _FakeDeviceError("hiccup")
+        return "ok"
+
+    assert call_with_device_retries(
+        flaky, retries=3, backoff_s=1.0, max_backoff_s=2.0,
+        retriable=(_FakeDeviceError,),
+    ) == "ok"
+    assert len(waits) == 3
+    assert 1.0 <= waits[0] < 1.25      # base * jitter
+    assert 2.0 <= waits[1] < 2.5       # doubled
+    assert 2.0 <= waits[2] < 2.5       # capped at max_backoff_s (pre-jitter)
+
+    waits2 = []
+    calls.clear()
+    monkeypatch.setattr("time.sleep", lambda s: waits2.append(s))
+    call_with_device_retries(
+        flaky, retries=3, backoff_s=1.0, max_backoff_s=2.0,
+        retriable=(_FakeDeviceError,),
+    )
+    assert waits2 == waits  # reproducible schedule
+
+
+def test_on_retry_hook_runs_and_its_failure_never_masks_the_retry():
+    seen = []
+
+    def hook(attempt, exc):
+        seen.append((attempt, str(exc)))
+        raise RuntimeError("hook bug")  # must not break the retry loop
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise _FakeDeviceError("hiccup")
+        return 7
+
+    assert call_with_device_retries(
+        flaky, retries=2, backoff_s=0.0, retriable=(_FakeDeviceError,),
+        on_retry=hook,
+    ) == 7
+    assert seen == [(1, "hiccup")]
+
+
+def test_default_hook_frees_device_cache_tier_on_oom():
+    """The OOM-survives-smaller-retry case: RESOURCE_EXHAUSTED errors free
+    the intermediate cache's device tier before the re-dispatch."""
+    import jax
+
+    from keystone_tpu.core.cache import IntermediateCache, use_cache
+
+    cache = IntermediateCache(device_bytes=1 << 20, host_bytes=1 << 20)
+    with use_cache(cache):
+        cache.memoize("k1", lambda: jax.numpy.ones((128,)))
+        assert cache._tier_bytes["device"] > 0
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise _FakeDeviceError("RESOURCE_EXHAUSTED: out of memory")
+            return "ok"
+
+        assert call_with_device_retries(
+            flaky, retries=1, backoff_s=0.0, retriable=(_FakeDeviceError,)
+        ) == "ok"
+        # the entry survived but left HBM (demoted to the host tier)
+        assert cache._tier_bytes["device"] == 0
+        hit, val = cache.lookup("k1")
+        assert hit and val.shape == (128,)
+
+
+def test_retry_telemetry_counters():
+    from keystone_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    a0, r0, e0 = (reg.get_counter("retry.attempt"),
+                  reg.get_counter("retry.resumed"),
+                  reg.get_counter("retry.exhausted"))
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise _FakeDeviceError("hiccup")
+        return 1
+
+    call_with_device_retries(
+        flaky, retries=2, backoff_s=0.0, retriable=(_FakeDeviceError,)
+    )
+    assert reg.get_counter("retry.attempt") == a0 + 1
+    assert reg.get_counter("retry.resumed") == r0 + 1
+    with pytest.raises(_FakeDeviceError):
+        call_with_device_retries(
+            lambda: (_ for _ in ()).throw(_FakeDeviceError("down")),
+            retries=0, backoff_s=0.0, retriable=(_FakeDeviceError,),
+        )
+    assert reg.get_counter("retry.exhausted") == e0 + 1
+
+
+# ---------------------------------------------------------------------------
+# fit_streaming_elastic edge cases (PR 12 satellite): final-block resume,
+# foreign block order, corrupt checkpoint, checkpoint-dir knob
+# ---------------------------------------------------------------------------
+
+def _elastic_fixture(rng_seed=3, n=96, d=32, c=4, bs=8):
+    import numpy as np
+
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    rng = np.random.default_rng(rng_seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    lbl = np.eye(c, dtype=np.float32)[np.arange(n) % c] * 2.0 - 1.0
+
+    class Slice:
+        calls = 0
+
+        def __init__(self, lo, hi):
+            self.lo, self.hi = lo, hi
+
+        def apply_batch(self, raw):
+            Slice.calls += 1
+            return raw["x"][:, self.lo : self.hi]
+
+    nodes = [Slice(k * bs, (k + 1) * bs) for k in range(d // bs)]
+    est = BlockWeightedLeastSquaresEstimator(bs, 1, 0.1, 0.25)
+    return est, nodes, Slice, {"x": jnp.asarray(x)}, jnp.asarray(lbl)
+
+
+def test_elastic_resume_after_final_block_is_noop_completion(
+    tmp_path, monkeypatch
+):
+    """A checkpoint whose cursor sits past the final block resumes as a
+    NO-OP: zero blocks re-featurized, the checkpointed model returned
+    bit-exactly, and the file cleaned up — the crash-after-last-save
+    window."""
+    import os
+
+    est, nodes, Slice, raw, lbl = _elastic_fixture()
+    ckpt = str(tmp_path / "final.ckpt")
+
+    # capture the final checkpoint by disabling the completion-time removal
+    removed = []
+    real_remove = os.remove
+    monkeypatch.setattr(os, "remove", lambda p: removed.append(p))
+    ref = est.fit_streaming(
+        nodes, raw, lbl, checkpoint_path=ckpt, checkpoint_every=1
+    )
+    monkeypatch.setattr(os, "remove", real_remove)
+    assert removed == [ckpt] and os.path.exists(ckpt)
+
+    from keystone_tpu.core.checkpoint import load_manifest
+
+    assert load_manifest(ckpt)["pos"] == len(nodes)  # cursor past the end
+    Slice.calls = 0
+    m = est.fit_streaming(
+        nodes, raw, lbl, checkpoint_path=ckpt, checkpoint_every=1
+    )
+    assert Slice.calls == 0  # no block revisited
+    np.testing.assert_array_equal(np.asarray(m.w), np.asarray(ref.w))
+    np.testing.assert_array_equal(np.asarray(m.b), np.asarray(ref.b))
+    assert not os.path.exists(ckpt)  # completion still cleans up
+
+
+def test_elastic_rejects_checkpoint_under_different_block_order(tmp_path):
+    """A checkpoint written under a foreign visit schedule must fail
+    LOUDLY (silently interleaving two orders would corrupt the
+    Gauss-Seidel pass), and the non-retriable error must escape the
+    elastic retry loop immediately."""
+    from keystone_tpu.core.checkpoint import load_checkpoint, save_node
+    from keystone_tpu.utils import fit_streaming_elastic
+
+    est, nodes, Slice, raw, lbl = _elastic_fixture()
+    ckpt = str(tmp_path / "order.ckpt")
+    import os
+
+    # write a genuine mid-fit checkpoint, then forge its block order
+    os.environ["KEYSTONE_FAULTS"] = "block@2:xla"
+    from keystone_tpu.utils import faults
+
+    faults.reset()
+    try:
+        with pytest.raises(Exception, match="injected fault"):
+            est.fit_streaming(
+                nodes, raw, lbl, checkpoint_path=ckpt, checkpoint_every=1
+            )
+    finally:
+        os.environ.pop("KEYSTONE_FAULTS", None)
+        faults.reset()
+    state, _ = load_checkpoint(ckpt)
+    state["block_order"] = list(reversed(state["block_order"]))
+    save_node(state, ckpt)
+
+    calls = {"n": 0}
+
+    def count_retry(attempt, exc):
+        calls["n"] += 1
+
+    with pytest.raises(ValueError, match="block order|order"):
+        fit_streaming_elastic(
+            est, nodes, raw, lbl, checkpoint_path=ckpt,
+            checkpoint_every=1, retries=3, backoff_s=0.0,
+            on_retry=count_retry,
+        )
+    assert calls["n"] == 0  # a schedule mismatch is not retriable
+
+
+def test_elastic_discards_corrupt_checkpoint_and_refits(tmp_path):
+    """A checkpoint that fails its checksum must not wedge the elastic
+    path: the file is discarded (counted) and the fit restarts from
+    scratch with zero manual intervention."""
+    import os
+
+    from keystone_tpu.telemetry import get_registry
+    from keystone_tpu.utils import fit_streaming_elastic
+
+    est, nodes, Slice, raw, lbl = _elastic_fixture()
+    ref = est.fit_streaming(nodes, raw, lbl)
+
+    ckpt = str(tmp_path / "corrupt.ckpt")
+    from keystone_tpu.core.checkpoint import save_node
+
+    save_node({"junk": np.arange(4096, dtype=np.float32)}, ckpt)
+    blob = open(ckpt, "rb").read()
+    with open(ckpt, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # truncate: checksum now fails
+
+    reg = get_registry()
+    d0 = reg.get_counter("checkpoint.corrupt_discarded")
+    m = fit_streaming_elastic(
+        est, nodes, raw, lbl, checkpoint_path=ckpt, checkpoint_every=1,
+        retries=0, backoff_s=0.0,
+    )
+    assert reg.get_counter("checkpoint.corrupt_discarded") == d0 + 1
+    np.testing.assert_array_equal(np.asarray(m.w), np.asarray(ref.w))
+    assert not os.path.exists(ckpt)
+
+
+def test_elastic_checkpoint_dir_knob_derives_path(tmp_path, monkeypatch):
+    """checkpoint_path=None + KEYSTONE_CHECKPOINT_DIR derives a
+    per-configuration file; without either, the call fails loudly (an
+    elastic fit without a checkpoint cannot resume)."""
+    from keystone_tpu.utils import fit_streaming_elastic
+
+    est, nodes, Slice, raw, lbl = _elastic_fixture()
+    with pytest.raises(ValueError, match="KEYSTONE_CHECKPOINT_DIR"):
+        fit_streaming_elastic(est, nodes, raw, lbl)
+
+    monkeypatch.setenv("KEYSTONE_CHECKPOINT_DIR", str(tmp_path))
+    ref = est.fit_streaming(nodes, raw, lbl)
+    m = fit_streaming_elastic(est, nodes, raw, lbl, backoff_s=0.0)
+    np.testing.assert_array_equal(np.asarray(m.w), np.asarray(ref.w))
+    # completed fit cleaned its auto-derived checkpoint out of the dir
+    assert not any(p.suffix == ".ckpt" for p in tmp_path.iterdir())
+
+
+def test_elastic_discards_non_checkpoint_garbage_too(tmp_path):
+    """A pickle-loadable file that is NOT a checkpoint (leftover artifact
+    at the path) raises plain CheckpointError, which must also be
+    discarded-and-refit — only the intact-but-mismatched checkpoint class
+    stays loud (deleting it could destroy another run's progress)."""
+    import os
+    import pickle
+
+    from keystone_tpu.utils import fit_streaming_elastic
+
+    est, nodes, Slice, raw, lbl = _elastic_fixture()
+    ref = est.fit_streaming(nodes, raw, lbl)
+    ckpt = str(tmp_path / "garbage.ckpt")
+    with open(ckpt, "wb") as f:
+        pickle.dump({"not": "a checkpoint"}, f)
+    m = fit_streaming_elastic(
+        est, nodes, raw, lbl, checkpoint_path=ckpt, checkpoint_every=1,
+        retries=0, backoff_s=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(m.w), np.asarray(ref.w))
+    assert not os.path.exists(ckpt)
