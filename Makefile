@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke chaos-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke chaos-smoke health-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -73,6 +73,15 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/check_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/precision_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/health_smoke.py
+
+# Numerical-health contract (<20 s): KEYSTONE_HEALTH=0 byte-identical to
+# the prior program, sentinel trips on an injected NaN block, on-device
+# quarantine (warn) and the self-healing escalation ladder (heal) landing
+# inside the clean twin's error envelope, malformed KEYSTONE_FAULTS plans
+# rejected eagerly (scripts/health_smoke.py).
+health-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/health_smoke.py
 
 # Chaos-ladder contract (<20 s): a streaming weighted fit killed
 # mid-schedule by an injected KEYSTONE_FAULTS device error resumes from

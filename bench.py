@@ -1338,6 +1338,101 @@ def _try_fault_rows() -> dict:
         return {"resume_overhead_s": None}
 
 
+def _try_health_rows() -> dict:
+    """Numerical-health evidence rows (``utils/health.py``, PR 13): one
+    streaming weighted fit run clean, then the SAME fit with a NaN block
+    injected mid-schedule (``KEYSTONE_FAULTS`` numeric kind) under
+    ``KEYSTONE_HEALTH=heal`` — the sentinels must trip, quarantine the
+    poisoned block on device, and the escalation ladder must re-run it.
+    Emits ``health_quarantined_total`` / ``health_escalations_total`` /
+    ``health_healed_total`` (counter deltas over the injected fit) and
+    ``health_heal_error_delta`` — the healed model's relative distance
+    from the clean twin (the within-envelope acceptance evidence).
+    BENCH_HEALTH=0 skips."""
+    if not knobs.get("BENCH_HEALTH"):
+        return {}
+    try:
+        import numpy as np
+
+        from keystone_tpu.learning.block_weighted import (
+            BlockWeightedLeastSquaresEstimator,
+        )
+        from keystone_tpu.telemetry import get_registry
+        from keystone_tpu.utils import faults
+
+        n = 512 if _SMOKE else 8192
+        d = 64 if _SMOKE else 1024
+        c = 8
+        bs = d // 8  # 8 blocks: room for a mid-schedule poisoning
+        rng = np.random.default_rng(11)
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        lbl = jnp.asarray(
+            np.eye(c, dtype=np.float32)[np.arange(n) % c] * 2.0 - 1.0
+        )
+        nodes = [_BenchSlice(k * bs, (k + 1) * bs) for k in range(d // bs)]
+        est = BlockWeightedLeastSquaresEstimator(bs, 1, 0.1, 0.25)
+        raw = {"x": x}
+
+        clean = est.fit_streaming(nodes, raw, lbl)
+        jax.block_until_ready(clean.w)
+
+        reg = get_registry()
+        counter_sum = reg.counter_family_total
+
+        os.environ["KEYSTONE_FAULTS"] = f"block@{len(nodes) // 2}:nan"
+        os.environ["KEYSTONE_HEALTH"] = "heal"
+        try:
+            # untimed warm run: the guarded program variants + the heal
+            # re-run path trace and compile here, so the timed row below
+            # measures heal OVERHEAD, not jit (the same reason
+            # _try_fault_rows warms its fit before timing)
+            faults.reset()
+            warm = est.fit_streaming(nodes, raw, lbl)
+            jax.block_until_ready(warm.w)
+            # counter baseline AFTER the warm run: the published deltas
+            # cover exactly the timed fit
+            base = {
+                name: counter_sum(name)
+                for name in (
+                    "health.quarantined", "health.escalations",
+                    "health.healed",
+                )
+            }
+            faults.reset()
+            t0 = time.perf_counter()
+            healed = est.fit_streaming(nodes, raw, lbl)
+            jax.block_until_ready(healed.w)
+            healed_s = time.perf_counter() - t0
+        finally:
+            os.environ.pop("KEYSTONE_FAULTS", None)
+            os.environ.pop("KEYSTONE_HEALTH", None)
+            faults.reset()
+        w_ref = np.asarray(clean.w, np.float64)
+        w_heal = np.asarray(healed.w, np.float64)
+        delta = float(
+            np.linalg.norm(w_heal - w_ref)
+            / max(np.linalg.norm(w_ref), 1e-30)
+        )
+        return {
+            "health_quarantined_total": int(
+                counter_sum("health.quarantined")
+                - base["health.quarantined"]
+            ),
+            "health_escalations_total": int(
+                counter_sum("health.escalations")
+                - base["health.escalations"]
+            ),
+            "health_healed_total": int(
+                counter_sum("health.healed") - base["health.healed"]
+            ),
+            "health_heal_error_delta": round(delta, 6),
+            "health_heal_fit_s": round(healed_s, 3),
+        }
+    except Exception as e:
+        print(f"health rows failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"health_quarantined_total": None}
+
+
 def _run_regime_subprocess(regime: str, fail_key: str,
                            timeout_s: int = None) -> dict:
     """One big-regime row via ``scripts/bench_regime.py`` in a fresh OS
@@ -1525,6 +1620,17 @@ def main():
     else:
         out.update(_try_fault_rows())
     _flush(out, "faults")
+    # Numerical-health pair (inject a NaN block -> sentinels trip ->
+    # quarantine + heal through the escalation ladder): in-process, small
+    # shapes — a reduced floor like telemetry's, with the explicit
+    # budget-skip marker the section contract pins.
+    if _budget_remaining() - _FINALIZE_RESERVE_S < 20.0:
+        out["health_skipped"] = "budget"
+        print("bench section health skipped: budget exhausted",
+              file=sys.stderr)
+    else:
+        out.update(_try_health_rows())
+    _flush(out, "health")
     # Solver GFLOPs ladder (exact BCD + randomized sketch rungs, overlap
     # on/off): a budget-derated SUBPROCESS regime since the sketch rung
     # landed. In-process it was the one heavy section whose runtime the
@@ -1727,6 +1833,12 @@ _COMPACT_KEYS = (
     # that paid it (full rows incl. checkpoint save/load in bench_full)
     ("resume_ovh", "resume_overhead_s"),
     ("retry_n", "retry_attempts_total"),
+    # numerical-health evidence (utils/health.py): quarantine/escalation
+    # counts from the injected-NaN heal run + the healed model's distance
+    # from its clean twin (full rows in bench_full)
+    ("health_q", "health_quarantined_total"),
+    ("health_esc", "health_escalations_total"),
+    ("health_err", "health_heal_error_delta"),
     # randomized sketch rung (linalg/sketch.py) + equal-test-error delta
     # vs the exact rung (configured d=65536; actual d in bench_full.json)
     ("g_sketch", "sketch_gflops_per_chip"),

@@ -135,6 +135,7 @@ INTENDED_PRECISION: Dict[str, Tuple[str, str]] = {
     "solver.sketch": ("f32", "f32"),
     "solver.countsketch_reduce": ("f32", "f32"),
     "solver.block_step": ("f32", "f32"),
+    "solver.block_step_guarded": ("f32", "f32"),
     "pallas.sift_bins": ("f32", "f32"),
     "pallas.sift_bins_xla": ("f32", "f32"),
     "pallas.fv_encode": ("f32", "f32"),
@@ -402,6 +403,70 @@ def _build_block_step(devices) -> Built:
         expect=dict(check_padding=True),
         peak_estimate=block_solve_peak_bytes(
             block, n_rows=n_rows, num_classes=classes, dtype_bytes=4,
+        ),
+    )
+
+
+@register("solver.block_step_guarded", "solver", min_devices=2)
+def _build_block_step_guarded(devices) -> Built:
+    """Health-guarded block step (KEYSTONE_HEALTH=warn|heal,
+    utils/health.py): the tiled reduce-scatter gram/cross schedule must
+    SURVIVE the sentinel reductions (A1) and the program must stay f32
+    end to end (A3). The gram-diagonal / cross / update finiteness
+    sentinels ride the already-replicated reduction outputs (zero new
+    collectives); the ONE reduction the guard adds is the scalar
+    residual-norm divergence monitor, budgeted via
+    sentinel_all_reduce_max — a bulk-shaped all-reduce is still a
+    finding."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.linalg.solvers import spd_solve
+    from keystone_tpu.parallel.overlap import tiled_transpose_matmul
+    from keystone_tpu.utils import health
+
+    mesh = _data_mesh(devices)
+    k = mesh.shape["data"]
+    # block wide enough that both tiled schedules (gram + cross) run at
+    # their full >= k tile counts (the overlap.tiled_gram entry's shape
+    # regime)
+    n_rows, block, classes = 16 * k, 16 * k, 4
+    rng = _rng()
+    Xb = jax.device_put(
+        jnp.asarray(_f32(rng, n_rows, block)),
+        NamedSharding(mesh, P("data", None)),
+    )
+    resid = jax.device_put(
+        jnp.asarray(_f32(rng, n_rows, classes)),
+        NamedSharding(mesh, P("data", None)),
+    )
+    valid = jax.device_put(
+        jnp.ones((n_rows,), jnp.float32),
+        NamedSharding(mesh, P("data")),
+    )
+
+    def step(Xb_, r_, valid_):
+        gram = tiled_transpose_matmul(Xb_, mesh=mesh)
+        gram = gram + 0.1 * jnp.eye(block, dtype=Xb_.dtype)
+        cross = tiled_transpose_matmul(Xb_, r_, mesh=mesh)
+        dW = spd_solve(gram, cross)
+        nrm_prev = jnp.linalg.norm(r_)
+        R_out, dW_eff, nrm_out, record = health.guarded_block_update(
+            r_, Xb_, dW, valid_, gram, cross, nrm_prev,
+            jnp.float32(10.0), "high",
+        )
+        return R_out, dW_eff, nrm_out, record
+
+    return Built(
+        fn=step, args=(Xb, resid, valid), k=k,
+        expect=dict(
+            # 2 tiled schedules (gram + cross) -> >= 2k reduce-scatters,
+            # <= 2 trailing all-gathers; the sentinels may add at most a
+            # handful of SCALAR all-reduces (norm + finiteness flags when
+            # XLA lowers them as cross-shard reductions), never bulk
+            reduce_scatter_min="2k", all_gather_max=2,
+            sentinel_all_reduce_max=8,
         ),
     )
 

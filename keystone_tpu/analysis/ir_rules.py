@@ -135,11 +135,19 @@ def check_pipelined_reduce_scatter(
     k: int,
     min_scatter: Optional[int] = None,
     all_gather_max: Optional[int] = 1,
+    sentinel_all_reduce_max: int = 0,
 ) -> List[str]:
     """THE overlap-path structure check: >= ``min_scatter`` (default: the
     axis size ``k`` — one per tile) per-tile reduce-scatters, NO terminal
     all-reduce, and at most ``all_gather_max`` trailing all-gathers.
-    Returns a list of problems (empty = clean)."""
+    Returns a list of problems (empty = clean).
+
+    ``sentinel_all_reduce_max`` relaxes the no-all-reduce clause for
+    health-guarded entries (``utils/health.py``): up to that many
+    SCALAR-SIZED all-reduces (<= ``_SENTINEL_ELEMS_MAX`` result elements —
+    the residual-norm divergence monitor) are tolerated; any bulk-shaped
+    all-reduce is still a finding, so the sentinels can never smuggle the
+    terminal collective back in."""
     cols = collective_counts(hlo_text)
     want = k if min_scatter is None else min_scatter
     problems = []
@@ -148,11 +156,73 @@ def check_pipelined_reduce_scatter(
             f"expected >= {want} per-tile reduce-scatters, found "
             f"{cols['reduce-scatter']} ({cols})"
         )
-    problems.extend(check_no_all_reduce(hlo_text))
+    if sentinel_all_reduce_max > 0:
+        problems.extend(
+            check_sentinel_all_reduces(hlo_text, sentinel_all_reduce_max)
+        )
+    else:
+        problems.extend(check_no_all_reduce(hlo_text))
     if all_gather_max is not None and cols["all-gather"] > all_gather_max:
         problems.append(
             f"{cols['all-gather']} all-gathers (expected <= "
             f"{all_gather_max}: one trailing reassembly)"
+        )
+    return problems
+
+
+#: result-element ceiling below which an all-reduce counts as a sentinel
+#: (a scalar divergence monitor), not a bulk collective
+_SENTINEL_ELEMS_MAX = 16
+
+_ALL_REDUCE_RESULT_RE = re.compile(
+    r"=\s*(.*?)\s+all-reduce(?:-start)?\("
+)
+_SHAPE_DIMS_RE = re.compile(r"\w+\[([0-9,]*)\]")
+
+
+def _result_elems(shape_str: str) -> int:
+    """Total result elements of an HLO result-shape string (tuple shapes
+    sum their members; ``f32[]`` is 1)."""
+    total = 0
+    for dims in _SHAPE_DIMS_RE.findall(shape_str):
+        n = 1
+        for d in dims.split(","):
+            d = d.strip()
+            if d:
+                n *= int(d)
+        total += n
+    return total
+
+
+def check_sentinel_all_reduces(
+    hlo_text: str, max_small: int, max_elems: int = _SENTINEL_ELEMS_MAX
+) -> List[str]:
+    """All-reduces restricted to the sentinel budget: every all-reduce
+    result must be tiny (<= ``max_elems`` elements — a scalar divergence
+    monitor), and at most ``max_small`` of them may appear. A bulk-shaped
+    all-reduce is the terminal collective the overlap schedules exist to
+    remove — always a finding."""
+    problems: List[str] = []
+    small = 0
+    for line in hlo_text.splitlines():
+        if "all-reduce" not in line or "-done" in line:
+            continue
+        m = _ALL_REDUCE_RESULT_RE.search(line)
+        if not m:
+            continue
+        elems = _result_elems(m.group(1))
+        if elems > max_elems:
+            problems.append(
+                f"bulk all-reduce of {elems} elements — sentinel "
+                f"reductions may add only scalar (<= {max_elems}-element) "
+                "monitors"
+            )
+        else:
+            small += 1
+    if small > max_small:
+        problems.append(
+            f"{small} scalar all-reduces (expected <= {max_small} "
+            "sentinel monitors)"
         )
     return problems
 
@@ -623,10 +693,18 @@ class CollectiveShapeRule(IRRule):
         problems: List[str] = []
         if e.get("reduce_scatter_min") is not None:
             want = e["reduce_scatter_min"]
+            # "k" and "<m>k" scale with the audited topology's axis size
+            if isinstance(want, str) and want.endswith("k"):
+                min_scatter = prog.k * int(want[:-1] or 1)
+            else:
+                min_scatter = int(want)
             problems += check_pipelined_reduce_scatter(
                 prog.hlo_text, prog.k,
-                min_scatter=prog.k if want == "k" else int(want),
+                min_scatter=min_scatter,
                 all_gather_max=e.get("all_gather_max", 1),
+                sentinel_all_reduce_max=int(
+                    e.get("sentinel_all_reduce_max", 0)
+                ),
             )
         elif e.get("no_all_reduce"):
             problems += check_no_all_reduce(prog.hlo_text)

@@ -649,6 +649,27 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     "filesystem shared by every process"
                 )
         binv_conds: list = []  # device scalars; synced ONCE after the loop
+        # Numerical health sentinels (utils/health.py): resolved ONCE per
+        # fit — the mode selects program structure (guarded vs plain
+        # residual update), so it must never be read inside a traced body.
+        # "0" (default) keeps the EXACT prior program: no sentinel
+        # reductions traced, no records kept, byte-identical results.
+        from keystone_tpu.utils import health as _health
+
+        hmode = _health.resolve_health_mode()
+        health_on = hmode != "0"
+        if health_on:
+            glimit = device_scalar(_health.resolve_growth_limit())
+            h_nrm = _health.residual_norm(R)
+        else:
+            glimit = h_nrm = None
+        # (pos, it, block, (8,) record) — records stay DEFERRED device
+        # vectors through the loop (zero extra host syncs; module
+        # docstring constraint 1) and sync once at the fit's natural end
+        # alongside the residual trajectory. Checkpoint saves persist them
+        # (the save already syncs R), so a resume replays the same
+        # quarantine/heal decisions.
+        health_records: list = []
         if checkpoint_path and _os.path.exists(checkpoint_path):
             from keystone_tpu.core.checkpoint import (
                 CheckpointMismatchError,
@@ -684,6 +705,25 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # without this a resumed fit under-reports max cond and the
             # conditioning guard silently never fires
             binv_conds = [jnp.asarray(c) for c in state.get("binv_conds", [])]
+            # health-sentinel evidence: the quarantine/heal decisions at
+            # the fit's end are a deterministic function of these records,
+            # so restoring them makes a resume REPLAY the same decisions.
+            # A mode flip across the kill is loud — the decisions would
+            # silently differ (heal vs drop) for the already-recorded
+            # trips.
+            saved_hmode = state.get("health_mode")
+            if saved_hmode is not None and saved_hmode != hmode:
+                raise CheckpointMismatchError(
+                    f"checkpoint {checkpoint_path} was written under "
+                    f"KEYSTONE_HEALTH={saved_hmode!r} but this fit runs "
+                    f"{hmode!r} — resuming would replay different "
+                    "quarantine/escalation decisions; restore the "
+                    "original setting or re-fit"
+                )
+            health_records = [
+                (int(p), int(i2), int(b2), np.asarray(r, np.float32))
+                for (p, i2, b2, r) in state.get("health_records", [])
+            ]
             # Mesh portability: checkpoint leaves are host numpy, so the
             # PR-6 "loud mismatch on resume" is now "reshard and continue"
             # — a checkpoint written under an 8-device mesh resumes on a
@@ -715,6 +755,14 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # jnp.asarray first would materialize the full (n, C) residual
             # on one device, the exact allocation the sharding avoids
             R = restore_onto(state["R"], R)
+            if health_on:
+                # re-baseline the growth monitor on the RESTORED residual:
+                # the pre-restore h_nrm was ‖R₀‖ of the fresh fit, and a
+                # mid-fit residual is (much) smaller — keeping the stale
+                # baseline would let a divergent post-resume step grow up
+                # to glimit·‖R₀‖ unnoticed, and the uninterrupted twin's
+                # norm carry at this point IS ‖restored R‖
+                h_nrm = _health.residual_norm(R)
             residual_mean = jnp.asarray(state["residual_mean"])
             models = [jnp.asarray(m) for m in state["models"]]
             joint_means_blocks = [
@@ -792,6 +840,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             R_global = _host_global(R)  # no-op host copy single-controller
             if jax.process_index() != 0:
                 return
+            # sentinel records go to host HERE (the save is already a
+            # sync point — R_global above blocked on the device queue)
+            health_host = [
+                (int(p), int(i2), int(b2), np.asarray(r, np.float32))
+                for (p, i2, b2, r) in health_records
+            ]
             state = {
                 "R": R_global, "residual_mean": residual_mean,
                 "models": models,
@@ -805,6 +859,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 # guard's view of completed blocks
                 "force_dense": _force_dense,
                 "binv_conds": list(binv_conds),
+                # health-sentinel evidence + the mode it was judged
+                # under: the end-of-fit quarantine/heal pass is a
+                # deterministic function of (mode, records), so a resume
+                # replays the same decisions (utils/health.py)
+                "health_mode": hmode,
+                "health_records": health_host,
             }
             # Manifest: the mesh geometry + schedule + per-array logical
             # shapes this state was written under, so the resume side can
@@ -821,6 +881,15 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     schedule_fingerprint=schedule_fingerprint(
                         num_blocks, self.num_iter, order
                     ),
+                    # the escalation/quarantine context rides the
+                    # manifest too (human/tool-readable without
+                    # unpickling state): mode + the schedule positions
+                    # whose sentinels have tripped so far
+                    health_mode=hmode,
+                    health_tripped=[
+                        int(p) for (p, _i, _b, r) in health_host
+                        if float(r[0]) < 0.5
+                    ],
                 ),
             )
 
@@ -892,10 +961,15 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # deterministic chaos hook: KEYSTONE_FAULTS 'block@N' entries
             # fire at this schedule-position boundary — the mid-fit
             # preemption the checkpoint/resume path must survive
-            # (utils/faults.py; returns immediately when the knob is unset)
-            _faults.check("block")
+            # (utils/faults.py; returns immediately when the knob is
+            # unset). A matched NUMERIC kind (nan|inf|saturate) comes
+            # back as a spec and poisons this block's data below — the
+            # silent-corruption rehearsal the health sentinels catch.
+            _fault_spec = _faults.check("block")
             with _phase("featurize"):
                 Xb = next(block_feed)
+            if _fault_spec is not None:
+                Xb = _faults.poison(Xb, _fault_spec.kind)
             if pop_stats_cache[b] is None:
                 with _phase("pop_stats"):
                     pop_mean, pop_cov, pop_xtr = _pop_stats(
@@ -960,15 +1034,39 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     buckets, inv_perm, base_inv, precision=precision,
                     policy=policy,
                 )
-            models[b] = models[b] + dW
-            with _phase("residual_update"):
-                R = _apply_update(R, Xb, dW, valid, precision=precision)
-                _, residual_mean = _class_col_means(R, class_idx, counts)
-            if _trace_on:
-                # per-(iteration, block) residual trajectory — a replicated
-                # scalar per step, synced once after the loop (no per-block
-                # host round-trip in the hot path)
-                _res_norms.append(jnp.linalg.norm(R))
+            if health_on:
+                # guarded commit (utils/health.py): the sentinels are
+                # traced reductions over values this step ALREADY reduced
+                # (replicated gram/cross/dW) plus the residual norm the
+                # telemetry trajectory already traces; a tripped block's
+                # update is rejected ON DEVICE (where), so the carry
+                # never sees its NaNs and the fit always completes. The
+                # record stays a deferred device vector — zero extra
+                # host syncs in the loop.
+                with _phase("residual_update"):
+                    R, dW_eff, h_nrm, _rec = _health.guarded_block_update(
+                        R, Xb, dW, valid, pop_cov, pop_xtr, h_nrm, glimit,
+                        precision,
+                    )
+                    models[b] = models[b] + dW_eff
+                    _, residual_mean = _class_col_means(
+                        R, class_idx, counts
+                    )
+                health_records.append((pos, it, b, _rec))
+                if _trace_on:
+                    # the guarded program's norm carry IS the post-step
+                    # ‖R‖_F — the trajectory piggybacks on it
+                    _res_norms.append(h_nrm)
+            else:
+                models[b] = models[b] + dW
+                with _phase("residual_update"):
+                    R = _apply_update(R, Xb, dW, valid, precision=precision)
+                    _, residual_mean = _class_col_means(R, class_idx, counts)
+                if _trace_on:
+                    # per-(iteration, block) residual trajectory — a
+                    # replicated scalar per step, synced once after the
+                    # loop (no per-block host round-trip in the hot path)
+                    _res_norms.append(jnp.linalg.norm(R))
             if (
                 checkpoint_path
                 and checkpoint_every > 0
@@ -984,6 +1082,107 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 "solver.weighted_bcd.final_residual_fro",
                 float(np.asarray(_res_norms[-1])),
             )
+
+        if health_on and health_records:
+            # THE health sync: the deferred sentinel records come to host
+            # once, at the fit's natural end (alongside the trajectory
+            # sync above — zero extra syncs in the loop). Quarantine and
+            # heal decisions are a pure function of (mode, records), so a
+            # resume that restored the records replays them identically.
+            from keystone_tpu.utils import get_logger as _hlog_get
+
+            _hlog = _hlog_get("keystone_tpu.health")
+            recs = [
+                (p, i2, b2, np.asarray(r, np.float64))
+                for (p, i2, b2, r) in health_records
+            ]
+            for p, i2, b2, r in recs:
+                if r[0] < 0.5:
+                    reason = _health.trip_reason(r)
+                    _reg.inc("health.tripped", site="block", reason=reason)
+                    _hlog.warning(
+                        "health sentinel tripped at schedule pos %d "
+                        "(iter %d, block %d): %s — update rejected on "
+                        "device", p, i2, b2, reason,
+                    )
+            # a block is POISONED iff its LATEST visit tripped (an early
+            # trip followed by a clean revisit — cache_stats=False
+            # multi-pass — healed itself through the normal schedule)
+            last_by_block: dict = {}
+            for p, i2, b2, r in recs:
+                last_by_block[b2] = r
+            bad_blocks = [
+                b2 for b2 in sorted(last_by_block)
+                if last_by_block[b2][0] < 0.5
+            ]
+            still_bad = list(bad_blocks)
+            if hmode == "heal" and bad_blocks:
+                still_bad = []
+                for hb in bad_blocks:
+                    # deterministic escalation, one rung: re-featurize the
+                    # block (a transient poison source — e.g. an injected
+                    # fault — is gone on the fresh fetch), force f32
+                    # storage (the bf16-envelope-breach fix) and dense
+                    # class solves, then commit through the SAME guarded
+                    # update. Runs against the final residual state: a
+                    # legal Gauss–Seidel visit, just moved to the end of
+                    # the schedule.
+                    _reg.inc(
+                        "health.escalations", site="block",
+                        to="f32_dense_refit",
+                    )
+                    _hlog.warning(
+                        "healing block %d: re-running with f32 storage + "
+                        "dense class solves", hb,
+                    )
+                    Xh = get_block(hb).astype(jnp.float32)
+                    h_pop_mean, h_pop_cov, h_pop_xtr = _pop_stats(
+                        Xh, R, valid, n_eff, precision=precision,
+                        omesh=omesh, model_overlap=model_overlap,
+                    )
+                    h_sums = _class_sums(Xh, class_idx, num_classes)
+                    h_jm = _joint_block_means(h_sums, counts, w, h_pop_mean)
+                    h_dW = _bucketed_class_solves(
+                        Xh, R, counts, h_pop_cov, h_pop_mean, h_pop_xtr,
+                        h_jm, residual_mean, models[hb], lam, w,
+                        buckets, inv_perm, None, precision=precision,
+                        policy=lambda *_: False,
+                    )
+                    R, h_dW_eff, h_nrm, h_rec = (
+                        _health.guarded_block_update(
+                            R, Xh, h_dW, valid, h_pop_cov, h_pop_xtr,
+                            h_nrm, glimit, precision,
+                        )
+                    )
+                    if float(np.asarray(h_rec)[0]) >= 0.5:
+                        models[hb] = models[hb] + h_dW_eff
+                        joint_means_blocks[hb] = h_jm
+                        _, residual_mean = _class_col_means(
+                            R, class_idx, counts
+                        )
+                        _reg.inc("health.healed", site="block")
+                        _hlog.warning("block %d healed", hb)
+                    else:
+                        still_bad.append(hb)
+            for hb in still_bad:
+                # permanent quarantine: the block's poisoned visits
+                # contributed nothing (the on-device gate rejected them;
+                # earlier HEALTHY visits keep their committed model +
+                # joint means), and non-finite joint means are zeroed so
+                # the intercept epilogue stays finite
+                _reg.inc("health.quarantined", site="block")
+                _jm = joint_means_blocks[hb]
+                if _jm is None or not bool(
+                    np.all(np.isfinite(np.asarray(_jm)))
+                ):
+                    joint_means_blocks[hb] = dzeros(
+                        (num_classes, self.block_size)
+                    )
+                _hlog.warning(
+                    "block %d quarantined%s — fit completes without its "
+                    "contribution", hb,
+                    "" if hmode == "heal" else " (KEYSTONE_HEALTH=warn)",
+                )
 
         if (
             checkpoint_path

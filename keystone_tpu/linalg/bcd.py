@@ -141,10 +141,24 @@ def block_coordinate_descent_l2(
     # deterministic chaos hook: KEYSTONE_FAULTS 'bcd@N' entries fire at
     # each solver entry — the transient-device-error rehearsal for callers
     # wrapping the solve in call_with_device_retries (utils/faults.py;
-    # returns immediately when the knob is unset)
+    # returns immediately when the knob is unset). A matched NUMERIC kind
+    # poisons A — the silent-corruption rehearsal the health sentinels
+    # quarantine.
     from keystone_tpu.utils import faults as _faults
 
-    _faults.check("bcd")
+    _fault_spec = _faults.check("bcd")
+    if _fault_spec is not None:
+        A = _faults.poison(A, _fault_spec.kind)
+    # Numerical health sentinels (utils/health.py), resolved EAGERLY: the
+    # mode is a static program choice ("0" keeps the exact prior scan —
+    # no sentinel reductions, byte-identical results).
+    from keystone_tpu.utils import health as _health
+
+    hmode = _health.resolve_health_mode()
+    health_on = hmode != "0"
+    glimit = (
+        device_scalar(_health.resolve_growth_limit()) if health_on else None
+    )
     omesh = overlap_mesh(overlap)
     model_overlap = model_overlap_spec(A, omesh, block_size)
     trace_on = _telemetry.tracing_enabled(telemetry)
@@ -166,38 +180,116 @@ def block_coordinate_descent_l2(
     reg.inc("solver.bcd.gram_flops", gram_flops)
     reg.inc("solver.bcd.cross_flops", cross_flops)
 
-    def run():
+    def run(run_tier: str, allow_donate: bool):
         import contextlib
         import warnings
 
-        fn = _bcd_l2_donated if donate else _bcd_l2
+        use_donate = donate and allow_donate
+        fn = _bcd_l2_donated if use_donate else _bcd_l2
         # Donated calls: the outputs (d, c) can never alias the (n, ·)
         # inputs, so jax warns that donation found no output alias —
         # expected: the donation here transfers buffer ownership so the
         # runtime frees A/b at their last read inside the scan instead of
         # pinning them to the call boundary.
-        ctx = warnings.catch_warnings() if donate else contextlib.nullcontext()
+        ctx = (
+            warnings.catch_warnings() if use_donate
+            else contextlib.nullcontext()
+        )
         with ctx:
-            if donate:
+            if use_donate:
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable"
                 )
             return fn(
                 A, b, lam, block_size, num_iter, mask, cache_grams,
                 precision, omesh, model_overlap, with_residuals=trace_on,
-                block_order=block_order, tier=tier,
+                block_order=block_order, tier=run_tier,
+                with_health=health_on, glimit=glimit,
             )
 
-    if not trace_on:
-        return run()
     import numpy as np
+
+    def _split_and_report(out):
+        """Unpack the impl's mode-dependent return tuple; sync + report
+        the sentinel records (ONE host transfer of the whole (steps, 8)
+        matrix — the end-of-solve sync) and return (W, res,
+        tripped_blocks) where tripped_blocks are the block ids whose
+        LATEST visit tripped."""
+        if not (health_on or trace_on):
+            return out, None, []
+        parts = list(out)
+        W = parts.pop(0)
+        res = parts.pop(0) if trace_on else None
+        recs = parts.pop(0) if health_on else None
+        tripped: list = []
+        if recs is not None:
+            rh = np.asarray(recs, dtype=np.float64)
+            bad_steps = np.nonzero(rh[:, 0] < 0.5)[0]
+            if bad_steps.size:
+                from keystone_tpu.utils.logging import get_logger
+
+                log = get_logger("keystone_tpu.health")
+                order_host = (
+                    np.arange(nblocks) if block_order is None
+                    else np.asarray(block_order)
+                )
+                sched = np.tile(order_host, num_iter)
+                for step in bad_steps:
+                    reason = _health.trip_reason(rh[step])
+                    reg.inc("health.tripped", site="bcd", reason=reason)
+                    log.warning(
+                        "BCD health sentinel tripped at step %d (block "
+                        "%d): %s — update rejected on device",
+                        int(step), int(sched[step]), reason,
+                    )
+                last = {}
+                for step in range(len(sched)):
+                    last[int(sched[step])] = rh[step]
+                tripped = [
+                    bb for bb in sorted(last) if last[bb][0] < 0.5
+                ]
+        return W, res, tripped
+
+    def execute():
+        # the heal ladder may need a second pass over A/b (bf16 -> f32
+        # storage escalation), so the first run must not consume them
+        first_donate = not (hmode == "heal" and tier == "bf16")
+        W, res, tripped = _split_and_report(run(tier, first_donate))
+        if tripped and hmode == "heal":
+            if tier == "bf16":
+                # deterministic storage escalation: the whole solve
+                # re-runs at f32 (the scan is one fused program — there
+                # is no per-block re-entry), sentinels still armed; a
+                # genuinely-poisoned input trips again and stays
+                # quarantined by the f32 run's own gate
+                from keystone_tpu.utils.logging import get_logger
+
+                reg.inc("health.escalations", site="bcd", frm="bf16",
+                        to="f32")
+                get_logger("keystone_tpu.health").warning(
+                    "healing BCD solve: re-running %d tripped block(s) "
+                    "at f32 storage", len(tripped),
+                )
+                W, res, tripped2 = _split_and_report(run("f32", True))
+                if len(tripped2) < len(tripped):
+                    reg.inc(
+                        "health.healed", len(tripped) - len(tripped2),
+                        site="bcd",
+                    )
+                tripped = tripped2
+        for _bb in tripped:
+            reg.inc("health.quarantined", site="bcd")
+        return W, res
+
+    if not trace_on:
+        return execute()[0]
 
     with _telemetry.get_tracer().span("solver.bcd") as sp:
         sp.set(
             flops=gram_flops + cross_flops, n=n, d=d, c=c,
             blocks=nblocks, iters=num_iter, overlap=omesh is not None,
         )
-        W, res = run()
+        W, res = execute()
         W = sp.track(W)
         # per-(iteration, block) residual ‖R‖_F after each block update —
         # one host sync of a (num_iter·nblocks,) vector, traced runs only
@@ -223,6 +315,8 @@ def _bcd_l2_impl(
     with_residuals: bool = False,
     block_order: Optional[jax.Array] = None,
     tier: str = "f32",
+    with_health: bool = False,
+    glimit=None,
 ) -> jax.Array:
     """Returns replicated ``W`` (d, c) after ``num_iter`` passes over blocks.
 
@@ -239,7 +333,17 @@ def _bcd_l2_impl(
     returns the per-step residual Frobenius norms ``(num_iter·num_blocks,)``
     for the telemetry trajectory; the production program (False) carries no
     extra reduction.
+
+    ``with_health`` (static; ``KEYSTONE_HEALTH`` resolved by the caller)
+    folds the divergence sentinels into the scan (``utils/health.py``
+    record layout) and gates each block commit on device: a tripped
+    block's ``W_k``/residual update is rejected by ``where`` so the carry
+    never sees its NaNs, and the per-step records come back as an extra
+    scan output for the caller's one end-of-solve sync. ``glimit`` is the
+    traced residual-growth limit (required when ``with_health``).
     """
+    from keystone_tpu.utils import health as _health
+
     A = jnp.asarray(A, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
     if mask is not None:
@@ -303,7 +407,10 @@ def _bcd_l2_impl(
         _, grams = jax.lax.scan(gram_k, None, jnp.arange(num_blocks))
 
     def block_step(carry, k):
-        W, R = carry
+        if with_health:
+            W, R, hn = carry
+        else:
+            W, R = carry
         start = k * block_size
         Ak = jax.lax.dynamic_slice(A, (0, start), (n, block_size))
         Wk = jax.lax.dynamic_slice(W, (start, 0), (block_size, c))
@@ -318,23 +425,54 @@ def _bcd_l2_impl(
         # the tier too (bf16-stored A_k/ΔW, f32-accumulated update), but the
         # residual R itself stays an f32 carry so rounding never compounds
         # across the scan
-        R = R - hdot(Ak, Wk_new - Wk, precision, tier=tier)
+        R_cand = R - hdot(Ak, Wk_new - Wk, precision, tier=tier)
+        if with_health:
+            # sentinels over values the step already reduced (the
+            # replicated gram/rhs/solve) + the trajectory's own residual
+            # norm, built by the ONE shared record builder so the layout
+            # can never skew from trip_reason's decoder; a tripped
+            # block's commit is rejected ON DEVICE (utils/health.py)
+            gram_diag = jnp.max(jnp.abs(jnp.diagonal(gram)))
+            nrm_cand = jnp.linalg.norm(R_cand)
+            healthy, rec = _health.sentinel_record(
+                gram_diag, rhs, Wk_new, hn, nrm_cand, glimit
+            )
+            Wk_new = jnp.where(healthy, Wk_new, Wk)
+            R = jnp.where(healthy, R_cand, R)
+            hn = jnp.where(healthy, nrm_cand, hn)
+        else:
+            R, rec = R_cand, None
         W = jax.lax.dynamic_update_slice(W, Wk_new, (start, 0))
-        out = jnp.linalg.norm(R) if with_residuals else None
-        return (W, R), out
+        # the gated norm carry IS the post-step ‖R‖_F — the trajectory
+        # piggybacks on it instead of re-reducing the residual
+        if with_health:
+            out = hn if with_residuals else None
+        else:
+            out = jnp.linalg.norm(R) if with_residuals else None
+        if with_health:
+            return (W, R, hn), (out, rec)
+        return (W, R), (out, rec)
 
     if block_order is None:
         block_order = jnp.arange(num_blocks)
     schedule = jnp.tile(block_order, num_iter)
-    (W, _), res = jax.lax.scan(block_step, (W0, b), schedule)
+    if with_health:
+        carry0 = (W0, b, jnp.linalg.norm(b))
+    else:
+        carry0 = (W0, b)
+    carry_out, (res, recs) = jax.lax.scan(block_step, carry0, schedule)
+    W = carry_out[0]
+    ret = (W[:d],)
     if with_residuals:
-        return W[:d], res
-    return W[:d]
+        ret += (res,)
+    if with_health:
+        ret += (recs,)
+    return ret[0] if len(ret) == 1 else ret
 
 
 _BCD_STATICS = (
     "block_size", "num_iter", "cache_grams", "precision", "omesh",
-    "model_overlap", "with_residuals", "tier",
+    "model_overlap", "with_residuals", "tier", "with_health",
 )
 _bcd_l2 = functools.partial(jax.jit, static_argnames=_BCD_STATICS)(_bcd_l2_impl)
 # Donated variant: b's buffer aliases the scanned residual, A's is freed for

@@ -251,17 +251,47 @@ def _solver_args(A, b) -> tuple[jax.Array, jax.Array, Optional[jax.Array]]:
     return A, b, mask
 
 
+def _health_mode() -> str:
+    """The ``KEYSTONE_HEALTH`` mode, resolved eagerly per solve entry
+    (``utils/health.py``): ``"0"`` keeps every class below on the exact
+    prior code path — no certificate program is even traced."""
+    from keystone_tpu.utils.health import resolve_health_mode
+
+    return resolve_health_mode()
+
+
 class NormalEquations:
     """``mlmatrix.NormalEquations`` rebuild: gram + cross-term all-reduced over
     ICI, replicated (d×d) solve. Reference call sites:
-    ``nodes/learning/LinearMapper.scala:87-88``."""
+    ``nodes/learning/LinearMapper.scala:87-88``.
+
+    Under ``KEYSTONE_HEALTH=warn|heal`` the solve runs through the guarded
+    ladder (``utils/health.py``) — this is the TERMINAL rung, so a tripped
+    certificate here cannot escalate further: it warns loudly (and counts
+    ``health.exhausted`` under heal)."""
 
     def solve_least_squares(self, A, b) -> jax.Array:
         A, b, mask = _solver_args(A, b)
+        mode = _health_mode()
+        if mode != "0":
+            from keystone_tpu.utils.health import guarded_lstsq
+
+            return guarded_lstsq(
+                A, b, lam=0.0, mask=mask, rung="normal_equations",
+                mode=mode,
+            )
         return normal_equations_solve(A, b, lam=None, mask=mask)
 
     def solve_least_squares_with_l2(self, A, b, lam: float) -> jax.Array:
         A, b, mask = _solver_args(A, b)
+        mode = _health_mode()
+        if mode != "0":
+            from keystone_tpu.utils.health import guarded_lstsq
+
+            return guarded_lstsq(
+                A, b, lam=lam, mask=mask, rung="normal_equations",
+                mode=mode,
+            )
         return normal_equations_solve(A, b, lam=lam, mask=mask)
 
 
@@ -279,7 +309,21 @@ class TSQR:
         solver: Optional[str] = None,
     ) -> jax.Array:
         A, b, mask = _solver_args(A, b)
-        if resolve_solver_tier(solver) == "sketch":
+        rung = (
+            "sketch" if resolve_solver_tier(solver) == "sketch" else "tsqr"
+        )
+        mode = _health_mode()
+        if mode != "0":
+            # guarded ladder (utils/health.py): certificate-checked, and
+            # under heal a tripped sketch escalates sketch->TSQR->normal
+            # equations deterministically
+            from keystone_tpu.utils.health import guarded_lstsq
+
+            return guarded_lstsq(
+                A, b, lam=lam, mask=mask, overlap=overlap, rung=rung,
+                mode=mode,
+            )
+        if rung == "sketch":
             return sketched_lstsq_solve(A, b, lam=lam, mask=mask, overlap=overlap)
         return tsqr_solve(A, b, lam=lam, mask=mask, overlap=overlap)
 
@@ -304,6 +348,22 @@ class SketchedLeastSquares:
         self, A, b, lam: float = 0.0, overlap: Optional[bool] = None
     ) -> jax.Array:
         A, b, mask = _solver_args(A, b)
+        mode = _health_mode()
+        if mode != "0":
+            # guarded: the CG's own relative residual is the (free)
+            # certificate; heal escalates to the exact rungs with this
+            # instance's sketch configuration applied to the sketch
+            # attempts only
+            from keystone_tpu.utils.health import guarded_lstsq
+
+            return guarded_lstsq(
+                A, b, lam=lam, mask=mask, overlap=overlap, rung="sketch",
+                mode=mode,
+                rung_kwargs=dict(
+                    kind=self.kind, factor=self.factor, tol=self.tol,
+                    max_iters=self.max_iters,
+                ),
+            )
         return sketched_lstsq_solve(
             A, b, lam=lam, mask=mask, overlap=overlap, kind=self.kind,
             factor=self.factor, tol=self.tol, max_iters=self.max_iters,
@@ -348,10 +408,20 @@ class BlockCoordinateDescent:
 
         A, b, mask = _solver_args(A, b)
         if resolve_solver_tier(solver) == "sketch":
-            def solve(l):
-                return sketched_lstsq_solve(
-                    A, b, lam=float(l), mask=mask, overlap=overlap
-                )
+            mode = _health_mode()
+            if mode != "0":
+                from keystone_tpu.utils.health import guarded_lstsq
+
+                def solve(l):
+                    return guarded_lstsq(
+                        A, b, lam=float(l), mask=mask, overlap=overlap,
+                        rung="sketch", mode=mode,
+                    )
+            else:
+                def solve(l):
+                    return sketched_lstsq_solve(
+                        A, b, lam=float(l), mask=mask, overlap=overlap
+                    )
         else:
             # leverage order depends only on (A, mask): computed ONCE and
             # shared across a lambda sweep instead of re-sketching per l

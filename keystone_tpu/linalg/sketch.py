@@ -435,6 +435,7 @@ def sketched_lstsq_solve(
     max_iters: Optional[int] = None,
     seed: int = 0,
     tier: Optional[str] = None,
+    with_certificate: bool = False,
 ) -> jax.Array:
     """Solve ``min ‖AW − b‖² (+ lam·‖W‖²)`` by sketch-and-precondition:
     CountSketch/SRHT of the row-sharded system, one small replicated QR,
@@ -455,7 +456,15 @@ def sketched_lstsq_solve(
     warm start, and the f32 CG on the exact system restores accuracy. The
     composition is bf16 sketch → f32 QR → f32-preconditioned f32 CG; the
     iteration itself deliberately stays f32 (its residuals ARE the
-    answer)."""
+    answer).
+
+    ``with_certificate=True`` additionally returns the CG's final relative
+    preconditioned residual as a DEVICE scalar — the near-free correctness
+    certificate the guarded solver ladder checks (``utils/health.py``;
+    Panther, PAPERS.md): the iteration already tracks it, so no extra
+    matvec is spent. A zero-iteration exit (perfect warm start, or a
+    NaN-poisoned system whose comparison is vacuously false) certifies
+    0.0 — the ladder's separate finite-W check covers the poisoned case."""
     from keystone_tpu import telemetry
     from keystone_tpu.parallel.mesh import get_mesh
     from keystone_tpu.parallel.overlap import mesh_tiers, overlap_mesh
@@ -559,7 +568,15 @@ def sketched_lstsq_solve(
                     "solver.sketch.final_residual_rel", float(traj_host[-1])
                 )
             sp.set(iterations=it_host)
-    return x[:, 0] if squeeze else x
+    x = x[:, 0] if squeeze else x
+    if with_certificate:
+        cert = jnp.where(
+            iters > 0,
+            traj[jnp.maximum(iters - 1, 0)],
+            jnp.zeros((), traj.dtype),
+        )
+        return x, cert
+    return x
 
 
 # ---------------------------------------------------------------------------

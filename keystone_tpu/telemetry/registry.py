@@ -159,6 +159,19 @@ class MetricsRegistry:
     def sum_counters(self, prefix: str) -> float:
         return sum(self.counters(prefix).values())
 
+    def counter_family_total(self, name: str) -> float:
+        """Sum of every series of counter family ``name`` across its
+        label sets — the ``name`` and ``name{label=...}`` keys, exactly.
+        Unlike the prefix-matching :meth:`sum_counters`, a sibling family
+        sharing the prefix (``health.healed`` vs ``health.healed_other``)
+        never leaks in; this is the one place the series-key encoding is
+        interpreted outside the exporters."""
+        with self._lock:
+            return sum(
+                v for k, v in self._counters.items()
+                if k == name or k.startswith(name + "{")
+            )
+
     # -- lifecycle ---------------------------------------------------------
 
     def reset(self) -> None:

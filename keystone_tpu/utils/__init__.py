@@ -14,3 +14,4 @@ from keystone_tpu.utils.retry import (
     resolve_retry_budget,
 )
 from keystone_tpu.utils import faults
+from keystone_tpu.utils import health

@@ -25,8 +25,15 @@ comma-separated entries
 - ``kind`` — ``xla`` (default: raise a retriable
   ``jaxlib.XlaRuntimeError("INTERNAL: ...")`` — the transient device
   error), ``oom`` (``RESOURCE_EXHAUSTED`` flavor — exercises the retry
-  hook's cache-tier release), or ``kill`` (``SIGKILL`` the process — the
-  preemption that only a checkpoint survives).
+  hook's cache-tier release), ``kill`` (``SIGKILL`` the process — the
+  preemption that only a checkpoint survives), or a NUMERIC kind —
+  ``nan`` / ``inf`` / ``saturate`` — which raises nothing: it POISONS the
+  data block crossing the boundary (first row overwritten with NaN, Inf,
+  or near-f32-max values whose products overflow), the silent corruption
+  class the ``KEYSTONE_HEALTH`` sentinels (``utils/health.py``) exist to
+  catch. Numeric kinds are only meaningful at the data-bearing sites
+  (``block``, ``bcd``) and are REJECTED eagerly at plan-validation time
+  anywhere else.
 - ``repeat`` — fire at ``repeat`` consecutive crossings (default 1); use
   a large repeat to pin retry *exhaustion*.
 
@@ -43,12 +50,20 @@ compiled programs are byte-identical to the prior build either way.
 
 from __future__ import annotations
 
+import functools
 import threading
 from dataclasses import dataclass
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
 
 SITES: Tuple[str, ...] = ("block", "bcd", "segment", "bench_section")
-KINDS: Tuple[str, ...] = ("xla", "oom", "kill")
+KINDS: Tuple[str, ...] = ("xla", "oom", "kill", "nan", "inf", "saturate")
+#: kinds that poison data instead of raising — the numerical-fault family
+NUMERIC_KINDS: Tuple[str, ...] = ("nan", "inf", "saturate")
+#: sites that carry a data block a numeric kind can poison
+DATA_SITES: Tuple[str, ...] = ("block", "bcd")
 
 
 @dataclass(frozen=True)
@@ -102,6 +117,13 @@ def parse_fault_plan(raw: str) -> Tuple[FaultSpec, ...]:
             occurrence = -1
         if occurrence < 0:
             raise ValueError(f"bad occurrence in {entry!r}: {grammar}")
+        if kind in NUMERIC_KINDS and site not in DATA_SITES:
+            raise ValueError(
+                f"numeric kind {kind!r} at non-data site {site!r} in "
+                f"{entry!r}: numeric kinds poison a data block, so they "
+                f"are only valid at sites {', '.join(DATA_SITES)}; "
+                f"{grammar}"
+            )
         specs.append(FaultSpec(site, occurrence, kind, repeat))
     return tuple(specs)
 
@@ -143,14 +165,20 @@ def _raise_injected(kind: str, site: str, count: int):
     raise err_cls(f"INTERNAL: {msg}")
 
 
-def check(site: str) -> None:
+def check(site: str) -> Optional[FaultSpec]:
     """Cross injection site ``site``: count the crossing and fire any armed
     fault plan entry matching it. No-op (no counting, no parse) when
-    ``KEYSTONE_FAULTS`` is unset — the production fast path."""
+    ``KEYSTONE_FAULTS`` is unset — the production fast path.
+
+    Error kinds (``xla``/``oom``/``kill``) raise/kill here; a matched
+    NUMERIC kind (``nan``/``inf``/``saturate``) is RETURNED instead — the
+    caller owns the data block and applies :func:`poison` to it (the site
+    boundary itself has nothing to poison). Callers that carry no data may
+    ignore the return value."""
     from keystone_tpu.utils import knobs
 
     if not knobs.get_raw("KEYSTONE_FAULTS"):
-        return
+        return None
     if site not in SITES:
         raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
     with _lock:
@@ -169,6 +197,8 @@ def check(site: str) -> None:
             "injecting %s fault at site %s occurrence %d", spec.kind, site,
             count,
         )
+        if spec.kind in NUMERIC_KINDS:
+            return spec
         if spec.kind == "kill":
             import os
             import signal
@@ -178,3 +208,35 @@ def check(site: str) -> None:
             sys.stderr.flush()
             os.kill(os.getpid(), signal.SIGKILL)
         _raise_injected(spec.kind, site, count)
+    return None
+
+
+#: near-f32-max fill for the ``saturate`` kind: representable in BOTH f32
+#: and bf16 storage, but any product against O(1) data overflows the f32
+#: accumulator — the bf16-envelope-breach rehearsal.
+_SATURATE_VALUE = 3.0e38
+
+
+@functools.partial(jax.jit, static_argnames=("kind",))
+def _poison_rows(x, kind: str):
+    row = jnp.zeros_like(x[:1]) + {
+        "nan": jnp.float32(jnp.nan),
+        "inf": jnp.float32(jnp.inf),
+        "saturate": jnp.float32(_SATURATE_VALUE),
+    }[kind].astype(x.dtype)
+    return jax.lax.dynamic_update_slice_in_dim(x, row, 0, 0)
+
+
+def poison(x, kind: str):
+    """Deterministically poison data array ``x`` per numeric kind: the
+    FIRST row (axis 0) is overwritten with NaN / Inf / near-f32-max
+    values. One poisoned row is enough to trip every downstream sentinel
+    (gram diagonal, cross term, solved update — ``utils/health.py``)
+    while keeping the injection cheap and sharding-friendly (row 0 lives
+    on the first shard). Jitted with the kind static so the poison value
+    is a trace-time constant (no implicit host->device scalar upload)."""
+    if kind not in NUMERIC_KINDS:
+        raise ValueError(
+            f"poison kind must be one of {NUMERIC_KINDS}: {kind!r}"
+        )
+    return _poison_rows(x, kind)

@@ -1,0 +1,404 @@
+"""Numerical health sentinels + self-healing solver escalation.
+
+PR 12 made the stack survive *infrastructure* faults (crashes, preemption,
+OOM); this module guards it against *numerical* faults — a NaN'd input
+block, a bf16 envelope breach (saturated storage -> Inf products), or an
+ill-conditioned sketch whose "solution" is finite garbage. Left unguarded,
+any of them silently poisons an entire streaming fit and surfaces (if at
+all) hours later as a garbage model: "Large Scale Distributed Linear
+Algebra With TPUs" (PAPERS.md) reports precision-induced divergence as the
+dominant failure mode at pod scale, and Panther's sketch residuals are a
+near-free correctness certificate — both map directly onto the existing
+tiers (``KEYSTONE_PRECISION_TIER``, ``KEYSTONE_SOLVER=sketch``).
+
+Design constraints, in order:
+
+1. **Zero extra host syncs in the block loops.** The sentinels are traced
+   reductions *folded into the existing jitted block programs*
+   (:func:`guarded_block_update`, the ``with_health`` BCD scan): gram-
+   diagonal and cross-term finiteness ride the already-replicated gram /
+   cross outputs (zero new collectives — the A1 audit entry
+   ``solver.block_step_guarded`` pins that the tiled reduce-scatter
+   schedule survives them), and the residual-growth monitor piggybacks on
+   the same per-block ``‖R‖_F`` reduction the telemetry trajectory
+   already traces — deferred device scalars, synced ONCE at the fit's
+   natural end alongside the trajectory.
+2. **Quarantine is a traced ``where``.** A tripped block's residual/model
+   update is rejected ON DEVICE (``R_out = where(healthy, R_cand, R)``,
+   ``dW_eff = where(healthy, dW, 0)``), so a poisoned block cannot
+   propagate NaNs into the carry even though the host learns about the
+   trip only at the end-of-fit sync. The fit always completes.
+3. **Escalation is deterministic and replayed on resume.** Under
+   ``KEYSTONE_HEALTH=heal`` the tripped blocks are re-run at the fit's
+   end with the next tier up — storage bf16->f32, solver rung
+   sketch -> TSQR -> normal equations (:func:`escalation_sequence`) — and
+   the sentinel evidence rides in the solver checkpoint (manifest keys
+   ``health_mode`` / ``health_tripped``), so a kill-and-resume replays
+   the exact same quarantine/heal decisions.
+
+``KEYSTONE_HEALTH=0`` (the default) is byte-identical to the prior
+program: no sentinel reductions are traced, no records kept — pinned by
+``scripts/health_smoke.py`` and ``tests/test_health.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+HEALTH_MODES: Tuple[str, ...] = ("0", "warn", "heal")
+
+#: solver escalation ladder, cheapest/least-robust first: the sketch rung
+#: iterates on the gram form (O(kappa^2) floor), TSQR is the O(kappa)
+#: backward-stable rung, normal equations the always-available terminal
+#: rung (SVD min-norm at lam=0 — robust to rank deficiency).
+RUNG_LADDER: Tuple[str, ...] = ("sketch", "tsqr", "normal_equations")
+
+#: record-vector layout emitted by the guarded block programs (f32):
+#: [healthy, gram_ok, cross_ok, update_ok, growth_ok,
+#:  nrm_prev, nrm_cand, gram_diag_max]
+#: — built ONLY by :func:`sentinel_record`, interpreted ONLY by
+#: :func:`trip_reason`; every guarded program shares the one builder so
+#: the layout cannot skew between call sites.
+RECORD_WIDTH = 8
+
+
+def resolve_health_mode(override: Optional[str] = None) -> str:
+    """The health mode to run: per-call ``override`` beats the
+    ``KEYSTONE_HEALTH`` knob (default ``"0"`` — the byte-identical prior
+    program). Resolved EAGERLY at each fit/solve entry — the mode selects
+    program structure (sentinel reductions traced or not), so it must
+    never be read inside a traced body (the precision-knob staleness
+    class ``linalg/solvers.py`` bans)."""
+    from keystone_tpu.utils import knobs
+
+    mode = override if override is not None else knobs.get("KEYSTONE_HEALTH")
+    if mode not in HEALTH_MODES:
+        raise ValueError(
+            f"health mode must be one of {HEALTH_MODES}: {mode!r}"
+        )
+    return mode
+
+
+def resolve_growth_limit() -> float:
+    from keystone_tpu.utils import knobs
+
+    return float(knobs.get("KEYSTONE_HEALTH_GROWTH"))
+
+
+def escalation_sequence(rung: str, tier: str) -> List[Tuple[str, str]]:
+    """The deterministic (rung, storage tier) attempts AFTER a tripped
+    first attempt at ``(rung, tier)``: first the storage escalation
+    (bf16 -> f32, same rung — the cheapest fix when the trip is a bf16
+    envelope breach), then the solver rungs above ``rung`` at f32.
+    A rung outside :data:`RUNG_LADDER` (e.g. the weighted-BCD block loop)
+    escalates storage only."""
+    seq: List[Tuple[str, str]] = []
+    if tier == "bf16":
+        seq.append((rung, "f32"))
+    if rung in RUNG_LADDER:
+        for nxt in RUNG_LADDER[RUNG_LADDER.index(rung) + 1:]:
+            seq.append((nxt, "f32"))
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# Traced sentinel programs (the block-loop form)
+# ---------------------------------------------------------------------------
+
+
+def sentinel_record(gram_diag, cross, update, nrm_prev, nrm_cand, glimit):
+    """The ONE builder of the :data:`RECORD_WIDTH` sentinel record —
+    traced (pure ``jnp``) so every guarded block program
+    (:func:`guarded_block_update`, the ``with_health`` BCD scan) folds
+    the identical checks and emits the identical layout
+    :func:`trip_reason` decodes. Returns ``(healthy, record)``:
+    ``healthy`` is the scalar bool gate, ``record`` the (8,) f32
+    evidence vector."""
+    gram_ok = jnp.isfinite(gram_diag)
+    cross_ok = jnp.all(jnp.isfinite(cross))
+    update_ok = jnp.all(jnp.isfinite(update))
+    growth_ok = jnp.isfinite(nrm_cand) & (
+        nrm_cand <= glimit * nrm_prev + 1e-6
+    )
+    healthy = gram_ok & cross_ok & update_ok & growth_ok
+    record = jnp.stack(
+        [
+            healthy.astype(jnp.float32),
+            gram_ok.astype(jnp.float32),
+            cross_ok.astype(jnp.float32),
+            update_ok.astype(jnp.float32),
+            growth_ok.astype(jnp.float32),
+            nrm_prev.astype(jnp.float32),
+            nrm_cand.astype(jnp.float32),
+            gram_diag.astype(jnp.float32),
+        ]
+    )
+    return healthy, record
+
+
+@functools.partial(
+    jax.jit, static_argnames=("precision",), donate_argnums=(0,)
+)
+def guarded_block_update(
+    R, Xb, dW, valid, gram, cross, nrm_prev, glimit, precision: str
+):
+    """The health-guarded form of the streaming residual update
+    (``learning/block_weighted._apply_update``): same donated
+    ``R - (Xv @ dW)`` program, plus the sentinel reductions and the traced
+    quarantine gate.
+
+    Sentinels (module docstring constraint 1 — all computed from values
+    the step already reduced):
+
+    - ``gram_ok``: the gram/pop-cov diagonal max is finite — a saturated
+      (``Inf``) or NaN'd input block poisons its own gram first, and the
+      gram is already REPLICATED (its cross-shard reduction happened in
+      the tiled reduce-scatter schedule), so the check adds no collective.
+    - ``cross_ok`` / ``update_ok``: the cross term and the solved ``dW``
+      are finite — together they cover a poisoned residual too (a NaN
+      anywhere in ``R`` reaches ``cross = XᵀR``).
+    - ``growth_ok``: ``‖R_cand‖_F <= glimit·‖R_prev‖_F`` — BCD's residual
+      norm is quasi-monotone, so a blow-up marks a divergent (finite but
+      garbage) solve. This is the ONE sentinel that reduces over sharded
+      rows; it is the same scalar reduction the telemetry residual
+      trajectory already traces, and it stays a deferred device scalar
+      (no host sync).
+
+    Returns ``(R_out, dW_eff, nrm_out, record)``: on a trip the residual
+    and update are rejected on device (``where``), the norm carry keeps
+    its pre-step value, and the (8,) f32 ``record`` (:data:`RECORD_WIDTH`)
+    carries the evidence for the end-of-fit sync."""
+    from keystone_tpu.linalg.solvers import hdot
+
+    Xv = Xb.astype(jnp.float32) * valid[:, None]
+    upd = hdot(Xv, dW, precision)
+    R_cand = R - upd
+    nrm_cand = jnp.linalg.norm(R_cand)
+    gram_diag = jnp.max(jnp.abs(jnp.diagonal(gram)))
+    healthy, record = sentinel_record(
+        gram_diag, cross, dW, nrm_prev, nrm_cand, glimit
+    )
+    R_out = jnp.where(healthy, R_cand, R)
+    dW_eff = jnp.where(healthy, dW, jnp.zeros_like(dW))
+    nrm_out = jnp.where(healthy, nrm_cand, nrm_prev)
+    return R_out, dW_eff, nrm_out, record
+
+
+@jax.jit
+def residual_norm(R):
+    """Initial ``‖R‖_F`` for the growth-monitor carry — jitted so the
+    norm's epilogue constants stay trace-time (guard-transfer-clean)."""
+    return jnp.linalg.norm(R)
+
+
+def trip_reason(record) -> str:
+    """Host-side classification of a synced sentinel record — the first
+    failing sentinel in check order (``healthy`` records return 'ok')."""
+    import numpy as np
+
+    rec = np.asarray(record, dtype=np.float64)
+    if rec[0] >= 0.5:
+        return "ok"
+    if rec[1] < 0.5:
+        return "gram_diag"
+    if rec[2] < 0.5:
+        return "nonfinite_cross"
+    if rec[3] < 0.5:
+        return "nonfinite_update"
+    return "residual_growth"
+
+
+# ---------------------------------------------------------------------------
+# One-shot guarded solves (the sketch -> TSQR -> normal-equations ladder)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _residual_certificate(A, b, W, mask, precision: str):
+    """Least-squares optimality certificate: the fitted residual of ANY
+    sane solve satisfies ``‖AW − b‖_F <= ‖b‖_F`` (W = 0 is feasible), so a
+    finite-but-larger residual — or a non-finite W — marks a diverged
+    solve. One extra n·d·c matmul; replicated scalar outputs."""
+    from keystone_tpu.linalg.solvers import hdot
+
+    if mask is not None:
+        A = A * mask[:, None]
+        b = b * mask[:, None]
+    res = jnp.linalg.norm(hdot(A, W, precision) - b)
+    bn = jnp.linalg.norm(b)
+    ok = (
+        jnp.all(jnp.isfinite(W))
+        & jnp.isfinite(res)
+        & (res <= bn * 1.001 + 1e-6)
+    )
+    return ok, res, bn
+
+
+def _run_rung(rung: str, A, b, lam, mask, overlap, tier: str, **kw):
+    """Dispatch one ladder rung. Kept as a named seam so tests can force a
+    rung to fail (monkeypatching the callable) without manufacturing a
+    genuinely divergent system."""
+    fn = _RUNGS[rung]
+    return fn(A, b, lam, mask, overlap, tier, **kw)
+
+
+def _sketch_rung(A, b, lam, mask, overlap, tier, **kw):
+    from keystone_tpu.linalg.sketch import sketched_lstsq_solve
+
+    # the sketch rung's certificate is NEAR-FREE: the preconditioned CG
+    # already tracks its relative residual, so the rung returns it and the
+    # generic (extra-matmul) certificate is skipped (Panther, PAPERS.md)
+    return sketched_lstsq_solve(
+        A, b, lam=lam, mask=mask, overlap=overlap, tier=tier,
+        with_certificate=True, **kw,
+    )
+
+
+def _tsqr_rung(A, b, lam, mask, overlap, tier, **kw):
+    from keystone_tpu.linalg.solvers import tsqr_solve
+
+    return tsqr_solve(A, b, lam=lam, mask=mask, overlap=overlap, tier=tier)
+
+
+def _normal_equations_rung(A, b, lam, mask, overlap, tier, **kw):
+    from keystone_tpu.linalg.solvers import normal_equations_solve
+
+    return normal_equations_solve(
+        A, b, lam=(lam if lam else None), mask=mask, overlap=overlap,
+        tier=tier,
+    )
+
+
+_RUNGS = {
+    "sketch": _sketch_rung,
+    "tsqr": _tsqr_rung,
+    "normal_equations": _normal_equations_rung,
+}
+
+
+def guarded_lstsq(
+    A,
+    b,
+    lam: float = 0.0,
+    mask=None,
+    overlap: Optional[bool] = None,
+    rung: str = "tsqr",
+    tier: Optional[str] = None,
+    mode: Optional[str] = None,
+    rung_kwargs: Optional[dict] = None,
+):
+    """One-shot least squares with divergence sentinels and the
+    self-healing escalation ladder (module docstring): run ``rung`` at
+    the resolved storage ``tier``, check the solution certificate, and —
+    under ``KEYSTONE_HEALTH=heal`` — escalate deterministically
+    (bf16 -> f32 storage first, then sketch -> TSQR -> normal equations)
+    until a rung certifies. ``warn`` checks the first attempt only and
+    returns it regardless (loudly); callers resolve mode ``"0"``
+    themselves and never reach this function (the prior program must stay
+    byte-identical).
+
+    ``rung_kwargs`` (e.g. a ``SketchedLeastSquares`` instance's
+    kind/factor/tol/max_iters) apply to attempts at the STARTING rung
+    only — escalated rungs run with their declared defaults (a
+    deterministic, documented configuration).
+
+    A rung that RAISES (shape constraints, backend errors) counts as a
+    tripped sentinel and escalates like a failed certificate — on the
+    terminal rung it re-raises."""
+    from keystone_tpu import telemetry
+    from keystone_tpu.linalg.solvers import (
+        get_solver_precision,
+        resolve_precision_tier,
+    )
+    from keystone_tpu.utils.logging import get_logger
+
+    mode = resolve_health_mode(mode)
+    tier = resolve_precision_tier(tier)
+    if rung not in _RUNGS:
+        raise ValueError(f"unknown solver rung {rung!r} (known: {RUNG_LADDER})")
+    attempts = [(rung, tier)] + escalation_sequence(rung, tier)
+    reg = telemetry.get_registry()
+    log = get_logger("keystone_tpu.health")
+    precision = get_solver_precision()
+    import numpy as np
+
+    W = None
+    for i, (r, t) in enumerate(attempts):
+        terminal = i == len(attempts) - 1
+        reason = "certificate"
+        kw = rung_kwargs if (rung_kwargs and r == rung) else {}
+        try:
+            out = _run_rung(r, A, b, lam, mask, overlap, t, **kw)
+        except Exception as e:
+            if terminal or mode == "warn":
+                # warn never heals (nothing to fall back on), and the
+                # terminal rung has no rung left — both re-raise
+                raise
+            log.warning(
+                "solver rung %s@%s raised %s: %s", r, t, type(e).__name__, e
+            )
+            ok, res_v, scale_v, reason = False, float("nan"), float("nan"), (
+                "rung_error"
+            )
+        else:
+            if isinstance(out, tuple):
+                # certificate-carrying rung (sketch): (W, rel_residual)
+                W, rel = out
+                rel_v = float(np.asarray(rel))
+                finite = bool(np.all(np.isfinite(np.asarray(W))))
+                ok = (
+                    finite and np.isfinite(rel_v)
+                    and rel_v <= _sketch_cert_limit(kw.get("tol"))
+                )
+                res_v, scale_v = rel_v, 1.0
+            else:
+                W = out
+                okd, res, bn = _residual_certificate(A, b, W, mask, precision)
+                ok = bool(np.asarray(okd))
+                res_v, scale_v = float(np.asarray(res)), float(np.asarray(bn))
+        if ok:
+            if i > 0:
+                reg.inc("health.healed", site="solve")
+            return W
+        reg.inc("health.tripped", site="solve", reason=reason)
+        log.warning(
+            "solver health sentinel tripped at rung %s@%s "
+            "(residual %.3e vs scale %.3e)", r, t, res_v, scale_v,
+        )
+        if mode == "warn":
+            return W
+        if not terminal:
+            nr, nt = attempts[i + 1]
+            reg.inc(
+                "health.escalations", site="solve", frm=f"{r}@{t}",
+                to=f"{nr}@{nt}",
+            )
+            log.warning("escalating solver rung %s@%s -> %s@%s", r, t, nr, nt)
+    # terminal rung still failing its certificate: return it LOUDLY — the
+    # ladder has no rung left, and a best-effort answer with a warning
+    # beats wedging the caller (quarantine semantics for a one-shot solve)
+    reg.inc("health.exhausted", site="solve")
+    log.error(
+        "solver escalation ladder exhausted (%s); returning the terminal "
+        "rung's result UNCERTIFIED", " -> ".join(f"{r}@{t}" for r, t in attempts),
+    )
+    return W
+
+
+def _sketch_cert_limit(tol: Optional[float] = None) -> float:
+    """Pass bar for the sketch rung's free CG relative residual: an order
+    above the tolerance the CG actually ran with still certifies (CG
+    stops on the preconditioned norm; the envelope is documented), two+
+    orders means the iteration stalled or diverged. ``tol`` is the
+    caller's per-instance override (``rung_kwargs``, e.g. a
+    ``SketchedLeastSquares.tol``) — a loose deliberate tolerance must not
+    fail its own certificate; falls back to ``KEYSTONE_SKETCH_TOL``."""
+    from keystone_tpu.utils import knobs
+
+    if tol is None:
+        tol = float(knobs.get("KEYSTONE_SKETCH_TOL"))
+    return max(100.0 * float(tol), 1e-2)

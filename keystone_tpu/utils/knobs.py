@@ -399,10 +399,32 @@ declare("KEYSTONE_FAULTS", "str", None,
         "Sites: block (weighted-BCD loop), bcd (BCD solver "
         "entry), segment (pipeline fused-segment boundary), bench_section "
         "(bench.py section flush). Kinds: xla (transient device error, "
-        "default), oom (RESOURCE_EXHAUSTED flavor), kill (SIGKILL). Unset "
+        "default), oom (RESOURCE_EXHAUSTED flavor), kill (SIGKILL), plus "
+        "the NUMERIC kinds nan|inf|saturate which poison the data block "
+        "crossing the boundary instead of raising (valid only at the "
+        "data-bearing sites block/bcd — rejected eagerly elsewhere; the "
+        "KEYSTONE_HEALTH sentinels' chaos driver). Unset "
         "= zero injection; the compiled programs are byte-identical "
         "either way (injection is host-side control flow).",
         validator=_fault_plan)
+declare("KEYSTONE_HEALTH", "str", "0",
+        "Numerical health sentinels + self-healing escalation "
+        "(utils/health.py): 0 (default) = off, byte-identical prior "
+        "programs; 'warn' folds divergence sentinels (NaN/Inf flags, "
+        "gram-diagonal and residual-growth monitors) into the BCD/"
+        "streaming block loops as traced reductions, quarantines tripped "
+        "blocks on device (fit completes) and reports at the end-of-fit "
+        "sync; 'heal' additionally re-runs tripped blocks with the "
+        "deterministic escalation ladder (bf16->f32 storage, "
+        "sketch->TSQR->normal-equations) and records the decisions in "
+        "the checkpoint manifest so a resume replays them.",
+        choices=("0", "warn", "heal"))
+declare("KEYSTONE_HEALTH_GROWTH", "float", 10.0,
+        "Residual-growth sentinel limit: a block update whose post-step "
+        "residual Frobenius norm exceeds limit x the pre-step norm is "
+        "quarantined (BCD residuals are quasi-monotone; the default 10 "
+        "is generous slack for regularized steps).",
+        validator=_greater_than_one)
 declare("KEYSTONE_RETRY_BUDGET", "int", 2,
         "Default per-call retry budget for call_with_device_retries / "
         "fit_streaming_elastic (utils/retry.py): the number of "
@@ -492,6 +514,12 @@ declare("BENCH_KILL_AFTER_SECTION", "str", "",
         "(pins incremental-flush survival). KEYSTONE_FAULTS with a "
         "'bench_section@N[:kill]' entry is the occurrence-indexed "
         "generalization.")
+declare("BENCH_HEALTH", "bool", True,
+        "Numerical-health section: inject a NaN block into a streaming "
+        "weighted fit under KEYSTONE_HEALTH=heal and record "
+        "health_quarantined_total / health_escalations_total plus the "
+        "healed model's error delta vs the clean twin (budget-gated; "
+        "exhaustion emits health_skipped).")
 declare("BENCH_FAULTS", "bool", True,
         "Fault-recovery section: inject a mid-schedule device error into "
         "a streaming weighted fit, resume it from its checkpoint, and "

@@ -18,6 +18,13 @@ Pins, through the REAL entry points on the 8-device CPU sim:
 4. The completed fit removes its checkpoint, and a deliberately
    truncated checkpoint raises the NAMED CheckpointCorruptError — never
    half-loaded garbage.
+5. NUMERIC chaos (PR 13): a fit whose block 2 is NaN-poisoned
+   (``KEYSTONE_FAULTS`` numeric kind) under ``KEYSTONE_HEALTH=heal`` is
+   killed mid-schedule; the checkpoint manifest records the tripped
+   position + mode, a mode-flipped resume is REJECTED loudly, and the
+   same-mode resume completes, heals the quarantined block through the
+   escalation ladder, and lands inside the clean twin's residual
+   envelope.
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.pop("KEYSTONE_FAULTS", None)
+os.environ.pop("KEYSTONE_HEALTH", None)
 
 t_start = time.monotonic()
 
@@ -160,11 +168,66 @@ def main() -> int:
     else:
         raise AssertionError("truncated checkpoint loaded without error")
 
+    # 5. poisoned-block kill-and-resume (PR 13): NaN block at pos 2, kill
+    #    at pos 5, resume under the SAME health mode -> the restored
+    #    sentinel records replay the quarantine and the heal pass re-runs
+    #    the block; a mode-flipped resume is loudly rejected
+    from keystone_tpu.core.checkpoint import CheckpointMismatchError
+
+    def obj(m):
+        r = x @ np.asarray(m.w, np.float64) + np.asarray(m.b, np.float64)
+        return float(np.linalg.norm(r - lbl))
+
+    ckpt2 = ckpt + ".health"
+    faults.reset()
+    os.environ["KEYSTONE_HEALTH"] = "heal"
+    os.environ["KEYSTONE_FAULTS"] = "block@2:nan,block@5:xla"
+    try:
+        try:
+            fit(mesh8, est, checkpoint_path=ckpt2, checkpoint_every=1)
+        except Exception as e:
+            assert "injected fault" in str(e), f"unexpected failure: {e}"
+        else:
+            raise AssertionError("injected kill did not fire")
+    finally:
+        os.environ.pop("KEYSTONE_FAULTS", None)
+        faults.reset()
+    man2 = load_manifest(ckpt2)
+    assert man2.get("health_mode") == "heal", man2.get("health_mode")
+    assert 2 in man2.get("health_tripped", []), (
+        f"manifest did not record the tripped position: {man2}"
+    )
+    # mode flip across the kill = different quarantine/heal decisions:
+    # loud, never silent
+    os.environ["KEYSTONE_HEALTH"] = "0"
+    try:
+        fit(mesh8, est, checkpoint_path=ckpt2, checkpoint_every=1)
+    except CheckpointMismatchError:
+        pass
+    else:
+        raise AssertionError("mode-flipped resume was not rejected")
+    os.environ["KEYSTONE_HEALTH"] = "heal"
+    healed0 = reg.get_counter("health.healed", site="block")
+    healed = fit(mesh8, est, checkpoint_path=ckpt2, checkpoint_every=1)
+    os.environ.pop("KEYSTONE_HEALTH", None)
+    assert reg.get_counter("health.healed", site="block") > healed0, (
+        "resume did not heal the quarantined block"
+    )
+    assert not os.path.exists(ckpt2), "healed fit left its checkpoint"
+    assert np.all(np.isfinite(np.asarray(healed.w))), "healed model NaN"
+    obj_ref, obj_heal = obj(ref), obj(healed)
+    assert obj_heal <= obj_ref * 1.10 + 1e-6, (
+        f"healed fit outside the clean twin's residual envelope: "
+        f"{obj_heal:.4f} vs {obj_ref:.4f}"
+    )
+
     elapsed = time.monotonic() - t_start
     print(
         f"chaos-smoke OK in {elapsed:.1f}s: injected fault at pos "
         f"{kill_pos}, resumed 8->4 devices (reshard counted), "
-        f"w_delta={delta:.2e}, truncated file -> CheckpointCorruptError"
+        f"w_delta={delta:.2e}, truncated file -> CheckpointCorruptError; "
+        f"poisoned-block kill-and-resume healed "
+        f"(obj {obj_heal:.3f} vs clean {obj_ref:.3f})"
     )
     assert elapsed < BUDGET_S, f"smoke took {elapsed:.1f}s (>{BUDGET_S}s)"
     return 0

@@ -1,0 +1,168 @@
+"""Numerical-health smoke (<20 s, CPU): the `make health-smoke` rung of
+`verify-fast` — sentinel trips, quarantine, self-healing escalation, and
+the off-mode byte-identity pin, end to end through the REAL entry points.
+
+Pins:
+
+1. ``KEYSTONE_HEALTH=0`` (and unset, and ``warn`` with no trip) produce
+   BIT-IDENTICAL models — the sentinels are a pure program add-on whose
+   gate never perturbs a healthy fit, and the default mode is the prior
+   program.
+2. The hazard is real: the same NaN injection under ``KEYSTONE_HEALTH=0``
+   silently poisons the whole model (non-finite weights).
+3. ``warn``: the sentinel trips on the injected NaN block, the block is
+   quarantined ON DEVICE (``health.quarantined`` counted), and the fit
+   completes with a finite model.
+4. ``heal``: the escalation ladder re-runs the poisoned block
+   (``health.escalations``/``health.healed`` counted) and the healed
+   model's test error lands within the clean twin's envelope.
+5. Malformed ``KEYSTONE_FAULTS`` plans — including a numeric kind at a
+   non-data site — fail EAGERLY at ``knobs.validate_environment()``, not
+   mid-fit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+for knob in ("KEYSTONE_FAULTS", "KEYSTONE_HEALTH"):
+    os.environ.pop(knob, None)
+
+t_start = time.monotonic()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+BUDGET_S = 20.0
+
+
+class _Slice:
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def apply_batch(self, raw):
+        return raw["x"][:, self.lo : self.hi]
+
+
+def main() -> int:
+    from keystone_tpu.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+    from keystone_tpu.telemetry import get_registry
+    from keystone_tpu.utils import faults, knobs
+
+    reg = get_registry()
+    counter_sum = reg.counter_family_total
+
+    # synthetic task WITH signal, so test error is meaningful: labels from
+    # a ground-truth linear model over the features
+    n, d, c, bs = 256, 48, 4, 8
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d, c)).astype(np.float32)
+    cls = np.argmax(x @ w_true, axis=1)
+    lbl = np.eye(c, dtype=np.float32)[cls] * 2.0 - 1.0
+    nodes = [_Slice(k * bs, (k + 1) * bs) for k in range(d // bs)]
+    raw = {"x": jnp.asarray(x)}
+    est = BlockWeightedLeastSquaresEstimator(bs, 2, 0.1, 0.25)
+
+    def fit():
+        m = est.fit_streaming(nodes, raw, jnp.asarray(lbl))
+        jax.block_until_ready(m.w)
+        return m
+
+    def err_pct(m):
+        pred = np.argmax(np.asarray(x @ np.asarray(m.w) + np.asarray(m.b)), 1)
+        return 100.0 * float(np.mean(pred != cls))
+
+    def poisoned(env_mode):
+        faults.reset()
+        os.environ["KEYSTONE_FAULTS"] = "block@2:nan"
+        if env_mode is None:
+            os.environ.pop("KEYSTONE_HEALTH", None)
+        else:
+            os.environ["KEYSTONE_HEALTH"] = env_mode
+        try:
+            return fit()
+        finally:
+            os.environ.pop("KEYSTONE_FAULTS", None)
+            os.environ.pop("KEYSTONE_HEALTH", None)
+            faults.reset()
+
+    # 1. byte-identity: unset == "0" == warn-with-no-trip, bitwise
+    ref = fit()
+    os.environ["KEYSTONE_HEALTH"] = "0"
+    m0 = fit()
+    os.environ["KEYSTONE_HEALTH"] = "warn"
+    mw = fit()
+    os.environ.pop("KEYSTONE_HEALTH", None)
+    assert np.array_equal(np.asarray(ref.w), np.asarray(m0.w)), (
+        "KEYSTONE_HEALTH=0 is not byte-identical to unset"
+    )
+    assert np.array_equal(np.asarray(ref.w), np.asarray(mw.w)), (
+        "a no-trip warn-mode fit perturbed the model (the gate must be "
+        "a bit-exact pass-through on healthy blocks)"
+    )
+    clean_err = err_pct(ref)
+
+    # 2. the hazard: unguarded NaN injection poisons the whole model
+    m_bad = poisoned(None)
+    assert not bool(np.all(np.isfinite(np.asarray(m_bad.w)))), (
+        "unguarded NaN block did NOT poison the model — the injection "
+        "is not reaching the solver"
+    )
+
+    # 3. warn: trip -> on-device quarantine, fit completes finite
+    q0, t0 = counter_sum("health.quarantined"), counter_sum("health.tripped")
+    m_warn = poisoned("warn")
+    assert counter_sum("health.tripped") > t0, "sentinel did not trip"
+    assert counter_sum("health.quarantined") > q0, "no quarantine counted"
+    assert bool(np.all(np.isfinite(np.asarray(m_warn.w)))), (
+        "warn-mode model is not finite — quarantine gate leaked"
+    )
+
+    # 4. heal: escalation re-runs the block; test error within envelope
+    e0, h0 = counter_sum("health.escalations"), counter_sum("health.healed")
+    m_heal = poisoned("heal")
+    assert counter_sum("health.escalations") > e0, "no escalation counted"
+    assert counter_sum("health.healed") > h0, "heal did not complete"
+    heal_err = err_pct(m_heal)
+    assert heal_err <= clean_err + 2.0, (
+        f"healed test error {heal_err:.2f}% outside the clean twin's "
+        f"envelope ({clean_err:.2f}% + 2%)"
+    )
+
+    # 5. malformed plans fail EAGERLY at validate_environment
+    for bad in ("block@x", "segment@1:nan", "bench_section@0:saturate"):
+        os.environ["KEYSTONE_FAULTS"] = bad
+        try:
+            knobs.validate_environment()
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(
+                f"malformed plan {bad!r} validated without error"
+            )
+        finally:
+            os.environ.pop("KEYSTONE_FAULTS", None)
+
+    elapsed = time.monotonic() - t_start
+    print(
+        f"health-smoke OK in {elapsed:.1f}s: off-mode byte-identical, "
+        f"unguarded NaN poisons, warn quarantines, heal escalates "
+        f"(clean {clean_err:.2f}% vs healed {heal_err:.2f}%), malformed "
+        "plans rejected eagerly"
+    )
+    assert elapsed < BUDGET_S, f"smoke took {elapsed:.1f}s (>{BUDGET_S}s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

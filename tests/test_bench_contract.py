@@ -98,6 +98,19 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     assert full["checkpoint_save_s"] > 0
     assert full["checkpoint_load_s"] > 0
     assert compact["retry_n"] == full["retry_attempts_total"]
+    # numerical-health pair (PR 13): a NaN block injected under
+    # KEYSTONE_HEALTH=heal — the sentinels trip, the escalation ladder
+    # re-runs the block, and the healed model stays inside the clean
+    # twin's envelope (the error-delta honesty key next to the counters)
+    assert full["health_escalations_total"] >= 1
+    assert full["health_healed_total"] >= 1
+    # the injected poison is transient (gone on the heal pass's fresh
+    # re-featurize), so a WORKING ladder leaves nothing permanently
+    # quarantined — a 1 here means heal regressed into quarantine
+    assert full["health_quarantined_total"] == 0
+    assert 0 <= full["health_heal_error_delta"] < 0.5
+    assert compact["health_q"] == full["health_quarantined_total"]
+    assert compact["health_esc"] == full["health_escalations_total"]
     # whole-pipeline-optimizer rows (core/plan.py): the flagship plan's
     # decisions landed, and the repeat plan in the same process performed
     # ZERO re-plans (the content-fingerprinted memo served it)
@@ -182,6 +195,10 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # contract
     assert full.get("faults_skipped") == "budget"
     assert "resume_overhead_s" not in full
+    # ... and the numerical-health section (PR 13): same reduced-floor
+    # contract — no counter may land without its budget story
+    assert full.get("health_skipped") == "budget"
+    assert "health_quarantined_total" not in full
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

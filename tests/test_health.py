@@ -1,0 +1,539 @@
+"""Numerical health sentinels + self-healing escalation (PR 13,
+``utils/health.py``): mode resolution, the deterministic escalation
+ladder, on-device quarantine gating in the streaming weighted loop and
+the BCD scan, the guarded one-shot solver ladder, numeric fault kinds,
+checkpoint replay of quarantine/heal decisions, and the off-mode
+byte-identity pin."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.learning.block_weighted import (
+    BlockWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.telemetry import get_registry
+from keystone_tpu.utils import faults, health, knobs
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ("KEYSTONE_FAULTS", "KEYSTONE_HEALTH", "KEYSTONE_HEALTH_GROWTH"):
+        monkeypatch.delenv(k, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _counter_sum(name):
+    return get_registry().counter_family_total(name)
+
+
+class _Slice:
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def apply_batch(self, raw):
+        return raw["x"][:, self.lo : self.hi]
+
+
+def _task(n=192, d=32, c=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d, c)).astype(np.float32)
+    cls = np.argmax(x @ w_true, axis=1)
+    lbl = np.eye(c, dtype=np.float32)[cls] * 2.0 - 1.0
+    return x, lbl, cls
+
+
+def _streaming_fit(x, lbl, bs=8, num_iter=2, **kw):
+    d = x.shape[1]
+    nodes = [_Slice(k * bs, (k + 1) * bs) for k in range(d // bs)]
+    est = BlockWeightedLeastSquaresEstimator(bs, num_iter, 0.1, 0.25)
+    m = est.fit_streaming(nodes, {"x": jnp.asarray(x)}, jnp.asarray(lbl), **kw)
+    jax.block_until_ready(m.w)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Mode + ladder resolution
+# ---------------------------------------------------------------------------
+
+def test_mode_resolution(monkeypatch):
+    assert health.resolve_health_mode() == "0"
+    monkeypatch.setenv("KEYSTONE_HEALTH", "warn")
+    assert health.resolve_health_mode() == "warn"
+    assert health.resolve_health_mode("heal") == "heal"  # per-call wins
+    with pytest.raises(ValueError, match="KEYSTONE_HEALTH"):
+        monkeypatch.setenv("KEYSTONE_HEALTH", "loud")
+        knobs.get("KEYSTONE_HEALTH")
+    with pytest.raises(ValueError, match="health mode"):
+        health.resolve_health_mode("bogus")
+
+
+def test_escalation_sequence_is_deterministic():
+    # storage first (bf16 -> f32, same rung), then the rungs above, f32
+    assert health.escalation_sequence("sketch", "bf16") == [
+        ("sketch", "f32"), ("tsqr", "f32"), ("normal_equations", "f32"),
+    ]
+    assert health.escalation_sequence("sketch", "f32") == [
+        ("tsqr", "f32"), ("normal_equations", "f32"),
+    ]
+    assert health.escalation_sequence("tsqr", "f32") == [
+        ("normal_equations", "f32"),
+    ]
+    assert health.escalation_sequence("normal_equations", "f32") == []
+    # a rung outside the ladder (the block loops) escalates storage only
+    assert health.escalation_sequence("weighted_block", "bf16") == [
+        ("weighted_block", "f32"),
+    ]
+    assert health.escalation_sequence("weighted_block", "f32") == []
+
+
+# ---------------------------------------------------------------------------
+# The guarded block update (traced sentinels + on-device gate)
+# ---------------------------------------------------------------------------
+
+def _update_args(seed=3, n=32, bs=8, c=3):
+    rng = np.random.default_rng(seed)
+    R = jnp.asarray(rng.normal(size=(n, c)).astype(np.float32))
+    Xb = jnp.asarray(rng.normal(size=(n, bs)).astype(np.float32))
+    dW = jnp.asarray(0.01 * rng.normal(size=(bs, c)).astype(np.float32))
+    valid = jnp.ones((n,), jnp.float32)
+    gram = jnp.asarray(np.eye(bs, dtype=np.float32))
+    cross = jnp.asarray(rng.normal(size=(bs, c)).astype(np.float32))
+    nrm = jnp.linalg.norm(R)
+    return R, Xb, dW, valid, gram, cross, nrm
+
+
+def test_guarded_update_healthy_is_bit_exact_passthrough():
+    R, Xb, dW, valid, gram, cross, nrm = _update_args()
+    expected = np.asarray(R - (Xb * valid[:, None]) @ dW)
+    R_out, dW_eff, nrm_out, rec = health.guarded_block_update(
+        R, Xb, dW, valid, gram, cross, nrm, jnp.float32(10.0), "highest"
+    )
+    rec = np.asarray(rec)
+    assert rec[0] == 1.0  # healthy
+    assert np.array_equal(np.asarray(dW_eff), np.asarray(dW))
+    np.testing.assert_allclose(np.asarray(R_out), expected, rtol=1e-6)
+    assert float(nrm_out) == pytest.approx(
+        float(np.linalg.norm(expected)), rel=1e-5
+    )
+
+
+@pytest.mark.parametrize("poison_target,reason", [
+    ("gram", "gram_diag"),
+    ("cross", "nonfinite_cross"),
+    ("dW", "nonfinite_update"),
+])
+def test_guarded_update_rejects_nonfinite_on_device(poison_target, reason):
+    R, Xb, dW, valid, gram, cross, nrm = _update_args()
+    bad = {
+        "gram": gram.at[0, 0].set(jnp.inf),
+        "cross": cross.at[0, 0].set(jnp.nan),
+        "dW": dW.at[0, 0].set(jnp.nan),
+    }[poison_target]
+    args = dict(gram=gram, cross=cross, dW=dW)
+    args[poison_target] = bad
+    R_host = np.asarray(R)  # R is DONATED below — snapshot first
+    R_out, dW_eff, nrm_out, rec = health.guarded_block_update(
+        R, Xb, args["dW"], valid, args["gram"], args["cross"], nrm,
+        jnp.float32(10.0), "highest",
+    )
+    assert np.asarray(rec)[0] == 0.0
+    assert health.trip_reason(rec) == reason
+    # the carry never sees the poison: R unchanged, update zeroed, norm kept
+    assert np.array_equal(np.asarray(R_out), R_host)
+    assert np.all(np.asarray(dW_eff) == 0.0)
+    assert float(nrm_out) == float(nrm)
+
+
+def test_guarded_update_growth_sentinel_catches_finite_garbage():
+    # a FINITE but exploding update: every flag is clean except growth
+    R, Xb, dW, valid, gram, cross, nrm = _update_args()
+    huge = dW + 1e6
+    R_host = np.asarray(R)  # R is DONATED below — snapshot first
+    R_out, dW_eff, _, rec = health.guarded_block_update(
+        R, Xb, huge, valid, gram, cross, nrm, jnp.float32(10.0), "highest"
+    )
+    rec = np.asarray(rec)
+    assert rec[0] == 0.0 and rec[3] == 1.0  # unhealthy, but update finite
+    assert health.trip_reason(rec) == "residual_growth"
+    assert np.array_equal(np.asarray(R_out), R_host)
+    assert np.all(np.asarray(dW_eff) == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Streaming weighted loop: byte-identity, quarantine, heal
+# ---------------------------------------------------------------------------
+
+def test_streaming_off_mode_is_byte_identical(monkeypatch):
+    x, lbl, _ = _task()
+    ref = _streaming_fit(x, lbl)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "0")
+    m0 = _streaming_fit(x, lbl)
+    assert np.array_equal(np.asarray(ref.w), np.asarray(m0.w))
+    assert np.array_equal(np.asarray(ref.b), np.asarray(m0.b))
+    # a no-trip guarded fit is a bit-exact pass-through too (the gate
+    # selects the identical R_cand when healthy)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "warn")
+    t0 = _counter_sum("health.tripped")
+    mw = _streaming_fit(x, lbl)
+    assert np.array_equal(np.asarray(ref.w), np.asarray(mw.w))
+    assert _counter_sum("health.tripped") == t0  # no new trips
+
+
+def test_streaming_warn_quarantines_poisoned_block(monkeypatch):
+    x, lbl, _ = _task()
+    monkeypatch.setenv("KEYSTONE_HEALTH", "warn")
+    q0, t0 = _counter_sum("health.quarantined"), _counter_sum(
+        "health.tripped"
+    )
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@2:nan")
+    m = _streaming_fit(x, lbl)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    assert _counter_sum("health.tripped") > t0
+    assert _counter_sum("health.quarantined") == q0 + 1
+    w = np.asarray(m.w)
+    assert np.all(np.isfinite(w)) and np.all(np.isfinite(np.asarray(m.b)))
+    # the poisoned block (schedule pos 2 = block 2, sequential order)
+    # contributed nothing: its weights are exactly zero
+    assert np.all(w[2 * 8 : 3 * 8] == 0.0)
+    assert np.any(w[:8] != 0.0)
+
+
+@pytest.mark.parametrize("kind", ["inf", "saturate"])
+def test_streaming_sentinels_trip_on_every_numeric_kind(monkeypatch, kind):
+    x, lbl, _ = _task(seed=4)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "warn")
+    t0 = _counter_sum("health.tripped")
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_FAULTS", f"block@1:{kind}")
+    m = _streaming_fit(x, lbl)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    assert _counter_sum("health.tripped") > t0
+    assert np.all(np.isfinite(np.asarray(m.w)))
+
+
+def test_streaming_heal_escalates_and_matches_envelope(monkeypatch):
+    x, lbl, cls = _task(seed=5)
+
+    def err(m):
+        pred = np.argmax(
+            x @ np.asarray(m.w) + np.asarray(m.b)[None, :], axis=1
+        )
+        return float(np.mean(pred != cls))
+
+    clean = _streaming_fit(x, lbl)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "heal")
+    e0, h0 = _counter_sum("health.escalations"), _counter_sum("health.healed")
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@2:nan")
+    healed = _streaming_fit(x, lbl)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    assert _counter_sum("health.escalations") > e0
+    assert _counter_sum("health.healed") > h0
+    # the healed block genuinely contributes (not a silent quarantine)
+    assert np.any(np.asarray(healed.w)[2 * 8 : 3 * 8] != 0.0)
+    assert err(healed) <= err(clean) + 0.02
+
+
+def test_streaming_unguarded_poison_is_the_hazard(monkeypatch):
+    # the contrast case: KEYSTONE_HEALTH=0 lets the NaN block poison the
+    # whole model — exactly what the sentinels exist to prevent
+    x, lbl, _ = _task(seed=6)
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@2:nan")
+    m = _streaming_fit(x, lbl)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    assert not np.all(np.isfinite(np.asarray(m.w)))
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint replay: kill mid-fit, resume, same decisions
+# ---------------------------------------------------------------------------
+
+def test_poisoned_kill_and_resume_replays_heal_bit_exact(
+    tmp_path, monkeypatch
+):
+    x, lbl, _ = _task(seed=7)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "heal")
+
+    # uninterrupted poisoned twin (same injection, no kill)
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@2:nan")
+    twin = _streaming_fit(x, lbl)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    faults.reset()
+
+    # poisoned + killed at pos 5, then resumed from the checkpoint
+    ckpt = str(tmp_path / "fit.ckpt")
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@2:nan,block@5:xla")
+    with pytest.raises(Exception, match="injected fault"):
+        _streaming_fit(x, lbl, checkpoint_path=ckpt, checkpoint_every=1)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    faults.reset()
+    assert os.path.exists(ckpt)
+
+    from keystone_tpu.core.checkpoint import load_manifest
+
+    man = load_manifest(ckpt)
+    assert man["health_mode"] == "heal"
+    assert 2 in man["health_tripped"]
+
+    resumed = _streaming_fit(
+        x, lbl, checkpoint_path=ckpt, checkpoint_every=1
+    )
+    assert not os.path.exists(ckpt)
+    # the restored sentinel records + deterministic heal pass make the
+    # resumed fit BIT-EXACT vs the uninterrupted poisoned twin
+    assert np.array_equal(np.asarray(twin.w), np.asarray(resumed.w))
+    assert np.array_equal(np.asarray(twin.b), np.asarray(resumed.b))
+
+
+def test_resume_under_flipped_health_mode_is_loud(tmp_path, monkeypatch):
+    from keystone_tpu.core.checkpoint import CheckpointMismatchError
+
+    x, lbl, _ = _task(seed=8)
+    ckpt = str(tmp_path / "fit.ckpt")
+    monkeypatch.setenv("KEYSTONE_HEALTH", "heal")
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@2:nan,block@5:xla")
+    with pytest.raises(Exception, match="injected fault"):
+        _streaming_fit(x, lbl, checkpoint_path=ckpt, checkpoint_every=1)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_HEALTH", "0")
+    with pytest.raises(CheckpointMismatchError, match="KEYSTONE_HEALTH"):
+        _streaming_fit(x, lbl, checkpoint_path=ckpt, checkpoint_every=1)
+
+
+# ---------------------------------------------------------------------------
+# BCD scan sentinels
+# ---------------------------------------------------------------------------
+
+def _bcd_system(seed=9, n=128, d=32, c=3):
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    Wt = rng.normal(size=(d, c)).astype(np.float32)
+    return jnp.asarray(A), jnp.asarray(A @ Wt)
+
+
+def test_bcd_warn_no_trip_is_bit_identical(monkeypatch):
+    from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+
+    A, b = _bcd_system()
+    ref = block_coordinate_descent_l2(A, b, 1e-3, 8, num_iter=2)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "warn")
+    w = block_coordinate_descent_l2(A, b, 1e-3, 8, num_iter=2)
+    assert np.array_equal(np.asarray(ref), np.asarray(w))
+
+
+def test_bcd_poisoned_entry_quarantines_and_stays_finite(monkeypatch):
+    from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+
+    A, b = _bcd_system()
+    monkeypatch.setenv("KEYSTONE_HEALTH", "warn")
+    q0 = _counter_sum("health.quarantined")
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_FAULTS", "bcd@0:nan")
+    w = block_coordinate_descent_l2(A, b, 1e-3, 8, num_iter=2)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    assert _counter_sum("health.quarantined") > q0
+    assert np.all(np.isfinite(np.asarray(w)))
+
+
+def test_bcd_heal_escalates_bf16_to_f32(monkeypatch):
+    from keystone_tpu.linalg.bcd import block_coordinate_descent_l2
+
+    A, b = _bcd_system(seed=10)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "heal")
+    monkeypatch.setenv("KEYSTONE_PRECISION_TIER", "bf16")
+    e0 = _counter_sum("health.escalations")
+    faults.reset()
+    monkeypatch.setenv("KEYSTONE_FAULTS", "bcd@0:nan")
+    w = block_coordinate_descent_l2(A, b, 1e-3, 8, num_iter=2)
+    monkeypatch.delenv("KEYSTONE_FAULTS")
+    # the storage escalation fired (bf16 -> f32 re-run); the poison is
+    # in-call permanent, so the f32 run's own gate still quarantines —
+    # loud, finite, never wedged
+    assert _counter_sum("health.escalations") > e0
+    assert np.all(np.isfinite(np.asarray(w)))
+
+
+# ---------------------------------------------------------------------------
+# One-shot guarded solver ladder
+# ---------------------------------------------------------------------------
+
+def _lstsq_system(seed=11, n=256, d=16, c=2):
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    Wt = rng.normal(size=(d, c)).astype(np.float32)
+    return jnp.asarray(A), jnp.asarray(A @ Wt), Wt
+
+
+def test_guarded_lstsq_escalates_failed_sketch_to_tsqr(monkeypatch):
+    A, b, Wt = _lstsq_system()
+    monkeypatch.setenv("KEYSTONE_HEALTH", "heal")
+    nan_W = jnp.full((A.shape[1], b.shape[1]), jnp.nan)
+    monkeypatch.setitem(
+        health._RUNGS, "sketch",
+        lambda *a, **k: (nan_W, jnp.float32(jnp.nan)),
+    )
+    e0, h0 = _counter_sum("health.escalations"), _counter_sum("health.healed")
+    W = health.guarded_lstsq(A, b, lam=1e-4, rung="sketch")
+    assert _counter_sum("health.escalations") > e0
+    assert _counter_sum("health.healed") > h0
+    assert np.linalg.norm(np.asarray(W) - Wt) / np.linalg.norm(Wt) < 1e-3
+
+
+def test_guarded_lstsq_warn_returns_first_attempt_loudly(monkeypatch):
+    A, b, _ = _lstsq_system(seed=12)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "warn")
+    nan_W = jnp.full((A.shape[1], b.shape[1]), jnp.nan)
+    monkeypatch.setitem(
+        health._RUNGS, "sketch",
+        lambda *a, **k: (nan_W, jnp.float32(jnp.nan)),
+    )
+    t0 = _counter_sum("health.tripped")
+    W = health.guarded_lstsq(A, b, lam=1e-4, rung="sketch")
+    assert _counter_sum("health.tripped") > t0
+    assert not np.all(np.isfinite(np.asarray(W)))  # warn never substitutes
+
+
+def test_guarded_lstsq_exhaustion_is_loud_not_wedged(monkeypatch):
+    A, b, _ = _lstsq_system(seed=13)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "heal")
+    nan_W = jnp.full((A.shape[1], b.shape[1]), jnp.nan)
+    for rung in health.RUNG_LADDER:
+        fail = (
+            (lambda *a, **k: (nan_W, jnp.float32(jnp.nan)))
+            if rung == "sketch" else (lambda *a, **k: nan_W)
+        )
+        monkeypatch.setitem(health._RUNGS, rung, fail)
+    x0 = _counter_sum("health.exhausted")
+    W = health.guarded_lstsq(A, b, lam=1e-4, rung="sketch")
+    assert _counter_sum("health.exhausted") > x0
+    assert W is not None
+
+
+def test_guarded_lstsq_rung_error_escalates(monkeypatch):
+    A, b, Wt = _lstsq_system(seed=14)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "heal")
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic rung failure")
+
+    monkeypatch.setitem(health._RUNGS, "sketch", boom)
+    W = health.guarded_lstsq(A, b, lam=1e-4, rung="sketch")
+    assert np.linalg.norm(np.asarray(W) - Wt) / np.linalg.norm(Wt) < 1e-3
+
+
+def test_solver_classes_route_through_guard_only_when_armed(monkeypatch):
+    from keystone_tpu.linalg.distributed import TSQR
+
+    A, b, _ = _lstsq_system(seed=15)
+    ref = TSQR().solve_least_squares(A, b, lam=1e-4)
+    monkeypatch.setenv("KEYSTONE_HEALTH", "0")
+    off = TSQR().solve_least_squares(A, b, lam=1e-4)
+    assert np.array_equal(np.asarray(ref), np.asarray(off))
+    # armed: the guarded path certifies the clean system and returns the
+    # same rung's answer
+    monkeypatch.setenv("KEYSTONE_HEALTH", "warn")
+    guarded = TSQR().solve_least_squares(A, b, lam=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(guarded), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_sketch_certificate_is_returned_and_small():
+    from keystone_tpu.linalg.sketch import sketched_lstsq_solve
+
+    A, b, Wt = _lstsq_system(seed=16)
+    x, cert = sketched_lstsq_solve(A, b, lam=1e-4, with_certificate=True)
+    assert np.asarray(cert).shape == ()
+    assert float(cert) < health._sketch_cert_limit()
+    assert np.linalg.norm(np.asarray(x) - Wt) / np.linalg.norm(Wt) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# Numeric fault kinds: grammar + poison + eager validation
+# ---------------------------------------------------------------------------
+
+def test_numeric_kinds_parse_and_return_spec(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FAULTS", "block@1:nan, bcd@0:saturate")
+    plan = knobs.get("KEYSTONE_FAULTS")
+    assert plan == (
+        faults.FaultSpec("block", 1, "nan", 1),
+        faults.FaultSpec("bcd", 0, "saturate", 1),
+    )
+    faults.reset()
+    assert faults.check("bcd") == faults.FaultSpec("bcd", 0, "saturate", 1)
+    assert faults.check("block") is None   # occurrence 0: clean
+    assert faults.check("block") == faults.FaultSpec("block", 1, "nan", 1)
+
+
+@pytest.mark.parametrize("bad", [
+    "segment@1:nan", "bench_section@0:inf", "segment@2:saturate",
+])
+def test_numeric_kind_at_non_data_site_fails_eagerly(monkeypatch, bad):
+    # satellite pin: a malformed plan fails at validate_environment()
+    # (the CLI/bench fail-fast), never deep inside a fit
+    monkeypatch.setenv("KEYSTONE_FAULTS", bad)
+    with pytest.raises(ValueError, match="numeric kind"):
+        knobs.validate_environment()
+
+
+def test_poison_kinds_overwrite_first_row():
+    x = jnp.asarray(np.ones((4, 3), np.float32))
+    assert np.all(np.isnan(np.asarray(faults.poison(x, "nan"))[0]))
+    assert np.all(np.isinf(np.asarray(faults.poison(x, "inf"))[0]))
+    sat = np.asarray(faults.poison(x, "saturate"))
+    assert np.all(sat[0] >= 1e38) and np.all(np.isfinite(sat[0]))
+    # rows past the first are untouched
+    for kind in ("nan", "inf", "saturate"):
+        assert np.all(np.asarray(faults.poison(x, kind))[1:] == 1.0)
+    with pytest.raises(ValueError, match="poison kind"):
+        faults.poison(x, "xla")
+
+
+# ---------------------------------------------------------------------------
+# A1 sentinel allowance + the guarded audit entry
+# ---------------------------------------------------------------------------
+
+def test_sentinel_all_reduce_check_budget():
+    from keystone_tpu.analysis.ir_rules import check_sentinel_all_reduces
+
+    scalar = "  %ar = f32[] all-reduce(f32[] %x), replica_groups={}\n"
+    bulk = (
+        "  %ar2 = f32[128,128]{1,0} all-reduce(f32[128,128]{1,0} %y)\n"
+    )
+    assert check_sentinel_all_reduces(scalar, 2) == []
+    assert any(
+        "bulk all-reduce" in p
+        for p in check_sentinel_all_reduces(bulk, 2)
+    )
+    # budget overflow: three scalars against a budget of two
+    assert any(
+        "scalar all-reduces" in p
+        for p in check_sentinel_all_reduces(scalar * 3, 2)
+    )
+    # tuple result shapes sum their members
+    tup = "  %ar3 = (f32[], f32[4]) all-reduce(f32[] %a, f32[4] %b)\n"
+    assert check_sentinel_all_reduces(tup, 1) == []
+
+
+def test_guarded_block_step_audits_clean(devices):
+    from keystone_tpu.analysis.ir_audit import (
+        INTENDED_PRECISION,
+        run_audit,
+    )
+
+    assert INTENDED_PRECISION["solver.block_step_guarded"] == ("f32", "f32")
+    res = run_audit(["solver.block_step_guarded"], baseline_path=None)
+    assert not res.errors and not res.skipped
+    assert res.findings == []
