@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke chaos-smoke health-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -74,6 +74,7 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/precision_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/health_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
 
 # Numerical-health contract (<20 s): KEYSTONE_HEALTH=0 byte-identical to
 # the prior program, sentinel trips on an injected NaN block, on-device
@@ -90,6 +91,22 @@ health-smoke:
 # CheckpointCorruptError (scripts/chaos_smoke.py).
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
+
+# Serving-gateway contract (<20 s): admission accept/reject at the gate,
+# bit-parity vs the unbatched apply with zero steady-state recompiles,
+# overload shedding with retry-after while admitted work still serves, a
+# poisoned dispatch tripping the breaker and a half-open probe recovering
+# it, and a graceful drain (scripts/serve_smoke.py).
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
+
+# Serve chaos ladder (<30 s): KEYSTONE_FAULTS firing at all three serve
+# sites under sustained synthetic load plus a mid-run SIGKILL/restart —
+# every request gets a response or a structured shed, the breaker
+# round-trips open -> half-open -> closed, and the restarted gateway
+# serves steady state with zero recompiles (scripts/serve_chaos_smoke.py).
+serve-chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_chaos_smoke.py
 
 # Precision-tier contract (<20 s): f32 tier byte-identical to the prior
 # program, bf16 parity within the documented envelope, and the bf16-sketch
@@ -123,7 +140,8 @@ bench:
 # secondary block is switched off for a fast loop.
 bench-cached:
 	BENCH_EXTRAS=0 BENCH_FLAGSHIP=0 BENCH_VOC_REFDIM=0 BENCH_TIMIT_FULL=0 \
-	BENCH_MOMENTS=0 BENCH_CONSTANTS=0 BENCH_SERVE=0 BENCH_STAGES=0 \
+	BENCH_MOMENTS=0 BENCH_CONSTANTS=0 BENCH_SERVE=0 BENCH_SERVE_LATENCY=0 \
+	BENCH_STAGES=0 \
 	$(PY) bench.py
 
 # Tiny-shape end-to-end smoke of the bench contract itself: every shape

@@ -141,6 +141,7 @@ INTENDED_PRECISION: Dict[str, Tuple[str, str]] = {
     "pallas.fv_encode": ("f32", "f32"),
     "pallas.fv_encode_xla": ("f32", "f32"),
     "dag.fused_segment": ("f32", "f32"),
+    "serve.dispatch": ("f32", "f32"),
     # the bf16 storage tier's audited programs (KEYSTONE_PRECISION_TIER)
     "overlap.tiled_gram_bf16": ("bf16", "f32"),
     "overlap.ring_gram_bf16": ("bf16", "f32"),
@@ -711,6 +712,37 @@ def _build_dag_segment(devices) -> Built:
     xs = jnp.asarray(_f32(_rng(), 32, 12))
     return Built(
         fn=lambda x: d.apply_batch(x), args=(xs,), k=1,
+        expect=dict(),
+    )
+
+
+# -- serving gateway ---------------------------------------------------------
+
+@register("serve.dispatch", "serve")
+def _build_serve_dispatch(devices) -> Built:
+    """The gateway's fixed-shape dispatch program
+    (``serve/gateway.py::_serve_apply`` — the SAME function its jitted
+    entry traces): one fused apply-chain over one padded micro-batch.
+    The serving hot path must be host-transfer-free (A2 — a host
+    callback here would gate every request's latency on the Python
+    runtime) and f32 end to end (A3)."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.pipeline import chain
+    from keystone_tpu.ops.stats import CosineRandomFeatures, LinearRectifier
+    from keystone_tpu.serve.gateway import _serve_apply
+
+    keys = jax.random.split(jax.random.key(17), 2)
+    node = chain(
+        CosineRandomFeatures.create(12, 16, 0.1, keys[0]),
+        LinearRectifier(max_val=0.0),
+    )
+    # one ladder rung's padded micro-batch (the gateway pads every
+    # request batch to a compiled rung, so this IS the steady-state shape)
+    xs = jnp.asarray(_f32(_rng(), 8, 12))
+    return Built(
+        fn=lambda x: _serve_apply(node, x), args=(xs,), k=1,
         expect=dict(),
     )
 

@@ -362,6 +362,24 @@ class IntermediateCache:
             self._rebalance()
             return len(victims)
 
+    def demote_device_except(self, keep_keys=()) -> int:
+        """Demote every device-tier entry NOT in ``keep_keys`` to host;
+        returns the demoted count. The serving gateway's degradation
+        ladder (``serve/gateway.py``) uses this under queue/HBM pressure:
+        cold fitted models leave HBM, the hot model's entry stays — a
+        later lookup promotes a demoted model back (the PR-1 tier
+        mechanics, unchanged)."""
+        keep = set(keep_keys)
+        with self._lock:
+            victims = [
+                e for e in self._entries.values()
+                if e.tier == _DEVICE and e.key not in keep
+            ]
+            for e in victims:
+                self._demote(e, _HOST)
+            self._rebalance()
+            return len(victims)
+
     # -- tier mechanics ----------------------------------------------------
 
     def _disk_path(self, key: str) -> str:

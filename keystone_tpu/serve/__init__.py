@@ -1,0 +1,21 @@
+"""Hardened serving tier (``keystone_tpu/serve/gateway.py``): the
+admission-checked prediction gateway with deadline-aware load shedding,
+circuit breaking, and graceful degradation."""
+
+from keystone_tpu.serve.gateway import (
+    DEFAULT_SHAPES,
+    Gateway,
+    PendingResponse,
+    ServeRejected,
+    ServeResponse,
+    serve,
+)
+
+__all__ = [
+    "DEFAULT_SHAPES",
+    "Gateway",
+    "PendingResponse",
+    "ServeRejected",
+    "ServeResponse",
+    "serve",
+]

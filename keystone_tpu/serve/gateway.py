@@ -1,0 +1,1051 @@
+"""Hardened serving tier: an admission-checked prediction gateway with
+deadline-aware load shedding and graceful degradation.
+
+The north star is serving millions of users; until this module every fitted
+pipeline only ran batch fit/eval.  KeystoneML ``Transformer``s are pure
+per-item functions (PAPER.md core-API layer), so a compiled, fixed-shape
+serve path is natural: :func:`serve` compiles the fitted apply-chain ONCE at
+a small ladder of fixed micro-batch shapes (padded dispatch, donated input
+buffers) and fronts it with the robustness substrate PRs 10-13 built:
+
+1. **Admission control** (the PR-10 follow-on): every request is validated
+   against the chain's input contract — the same
+   ``analysis/contracts.propagate`` pass the checker and planner share —
+   *at the gate*.  A bad rank/dtype/dim is rejected with a structured
+   response naming the contract kind and the stage that would have choked,
+   never discovered inside a donated-buffer dispatch.  "Memory Safe
+   Computations with XLA Compiler" (PAPERS.md) motivates the stance:
+   reject work the compiled program cannot safely hold *before* dispatch,
+   not via OOM mid-flight — the gateway only ever dispatches the shapes it
+   compiled.
+
+2. **Deadline-aware coalescing and load shedding.**  A bounded queue
+   (``KEYSTONE_SERVE_QUEUE_DEPTH``) batches compatible requests up the
+   shape ladder; work whose deadline has passed — or provably cannot be
+   met given the measured per-shape dispatch estimate — is dropped with a
+   structured ``deadline`` shed before it wastes device time, and once
+   queue depth or the observed p99 crosses the SLO
+   (``KEYSTONE_SERVE_SLO_MS``) new arrivals shed with a ``retry_after_s``
+   signal.  Overload degrades to partial availability, never collapse.
+
+3. **Graceful degradation ladder.**  Cold fitted models live in the PR-1
+   tiered intermediate cache (HBM -> host): overload demotes them, an
+   OOM-flavored dispatch error runs the PR-12 retry hook
+   (``retry.default_on_retry`` — frees the active intermediate cache's
+   device tier), releases the model pool's device tier, and SHRINKS the
+   batch-shape ladder (``serve.degraded``) so the retry dispatches a
+   smaller program.  A per-model circuit breaker rides the PR-13 health
+   sentinels: a dispatch whose outputs go non-finite is quarantined (its
+   requests fail fast with a ``sentinel`` response — NaNs are never
+   served), ``KEYSTONE_SERVE_BREAKER`` consecutive trips open the breaker,
+   and after a cooldown a half-open probe re-admits the model.
+
+4. **Chaos integration.**  ``KEYSTONE_FAULTS`` gained ``serve.admit`` /
+   ``serve.dispatch`` / ``serve.respond`` sites (``utils/faults.py``);
+   ``scripts/serve_chaos_smoke.py`` fires all three plus a mid-run SIGKILL
+   under sustained synthetic load and asserts availability degrades
+   gracefully — every request gets a response or a structured shed, the
+   breaker round-trips open -> half-open -> closed, and the restarted
+   gateway serves with zero steady-state recompiles.
+
+Telemetry: ``serve.qps`` / ``serve.p99_ms`` / ``serve.breaker_state``
+gauges, ``serve.shed_total{reason}`` / ``serve.degraded`` counters, plus
+request/response/dispatch series — all queryable via the process registry
+(no log scraping).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.utils.logging import get_logger
+
+logger = get_logger("keystone_tpu.serve")
+
+__all__ = [
+    "serve",
+    "Gateway",
+    "ServeResponse",
+    "ServeRejected",
+    "PendingResponse",
+    "DEFAULT_SHAPES",
+]
+
+#: default micro-batch shape ladder (overridden by KEYSTONE_SERVE_SHAPES
+#: or the ``shapes=`` argument): 1 covers interactive single items, the
+#: larger rungs amortize dispatch for coalesced bursts.
+DEFAULT_SHAPES: Tuple[int, ...] = (1, 8, 32)
+
+#: response codes (the structured-availability vocabulary): every submitted
+#: request terminates in exactly one of these.
+CODES: Tuple[str, ...] = (
+    "ok",           # served
+    "rejected",     # admission: contract violation at the gate
+    "shed",         # overload: queue depth / p99-over-SLO (retry_after_s set)
+    "deadline",     # the request's deadline passed or provably cannot be met
+    "breaker_open", # circuit breaker fast-fail (retry_after_s set)
+    "sentinel",     # dispatch output tripped the non-finite sentinel
+    "error",        # gateway-internal failure (injected faults land here)
+    "shutdown",     # gateway closed before the request could be served
+)
+
+
+def _serve_apply(node, xs):
+    """THE fixed-shape serve dispatch program (also the ``serve.dispatch``
+    IR-audit entry point, ``analysis/ir_audit.py``): one fused apply-chain
+    over one padded micro-batch.  Kept as a named pure function so the
+    audit lowers the identical program the jitted entry below traces."""
+    return node.apply_batch(xs)
+
+
+#: the gateway's one compiled dispatch entry: cache keyed on the model's
+#: pytree structure + the (fixed) batch aval, input buffer DONATED — the
+#: padded batch is constructed per dispatch and never reused, so its HBM
+#: is returned to the output.  Steady-state serving holds this function's
+#: compile-cache size constant (the zero-recompile pin in tests/smokes).
+_jit_apply_batch = jax.jit(_serve_apply, donate_argnums=(1,))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _pad_rows(xs, n: int):
+    """Zero-pad a stacked batch up to ladder shape ``n`` (rows are
+    independent per-item programs; padding rows are sliced off after)."""
+    pad = n - xs.shape[0]
+    return jnp.concatenate(
+        [xs, jnp.zeros((pad,) + xs.shape[1:], xs.dtype)], axis=0
+    )
+
+
+@jax.jit
+def _finite_flag(out):
+    """Device-side health sentinel over a dispatch output: True iff every
+    floating leaf is finite (the PR-13 NaN/divergence check, serving
+    form).  One scalar; synced at response time — serving already syncs."""
+    flags = [
+        jnp.all(jnp.isfinite(l))
+        for l in jax.tree_util.tree_leaves(out)
+        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+    ]
+    if not flags:
+        return jnp.bool_(True)
+    return functools.reduce(jnp.logical_and, flags)
+
+
+@dataclass(frozen=True)
+class ServeResponse:
+    """One request's terminal outcome. ``ok`` iff ``code == 'ok'``;
+    non-ok responses are STRUCTURED: ``kind``/``stage`` carry the
+    contract-issue classification for admission rejects, ``retry_after_s``
+    the back-off signal for sheds and open-breaker fast-fails."""
+
+    ok: bool
+    code: str
+    value: Any = None
+    error: Optional[str] = None
+    kind: Optional[str] = None      # contract-issue kind: rank|dtype|dim
+    stage: Optional[str] = None     # stage the contract pass attributes
+    retry_after_s: Optional[float] = None
+    latency_ms: Optional[float] = None
+    model: str = "default"
+
+
+class ServeRejected(RuntimeError):
+    """Raised by :meth:`Gateway.predict` for any non-ok response; carries
+    the structured :class:`ServeResponse` as ``.response``."""
+
+    def __init__(self, response: ServeResponse):
+        super().__init__(
+            f"serve request {response.code}"
+            + (f": {response.error}" if response.error else "")
+        )
+        self.response = response
+
+
+class PendingResponse:
+    """A submitted request's future. ``result(timeout)`` blocks for the
+    terminal :class:`ServeResponse`; an elapsed timeout returns a
+    structured non-ok response instead of raising (the caller always gets
+    a response — the no-wedge contract)."""
+
+    __slots__ = ("_event", "_response")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._response: Optional[ServeResponse] = None
+
+    def _resolve(self, response: ServeResponse) -> None:
+        if self._response is None:
+            self._response = response
+            self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResponse:
+        if not self._event.wait(timeout):
+            return ServeResponse(
+                ok=False, code="error",
+                error=f"no response within {timeout}s (gateway busy/stopped)",
+            )
+        return self._response
+
+
+def _resolved(response: ServeResponse) -> PendingResponse:
+    p = PendingResponse()
+    p._resolve(response)
+    return p
+
+
+@dataclass
+class _Request:
+    x: Any
+    model: str
+    pending: PendingResponse
+    t_submit: float
+    deadline_t: Optional[float]  # absolute monotonic deadline, None = none
+    probe: bool = False
+
+
+@dataclass
+class _ModelState:
+    """Per-model breaker + admission metadata."""
+
+    item_spec: Any                      # ShapeDtypeStruct of ONE item
+    stages: List[Tuple[Any, Tuple[int, ...]]]
+    breaker: str = "closed"             # closed | open | half_open
+    trips: int = 0                      # consecutive sentinel trips
+    t_open: float = 0.0
+    probe_inflight: bool = False
+
+
+def _knob_default(value, knob_name: str):
+    from keystone_tpu.utils import knobs
+
+    return value if value is not None else knobs.get(knob_name)
+
+
+def _mb(name: str) -> int:
+    from keystone_tpu.utils import knobs
+
+    return int(knobs.get(name)) << 20
+
+
+class Gateway:
+    """A long-lived, multi-tenant prediction gateway over fitted pipelines
+    (module docstring).  Build via :func:`serve`; serve via
+    :meth:`predict` (sync) or :meth:`submit` (future).  Thread-safe:
+    submissions may come from any thread; ONE worker thread owns every
+    jax dispatch (single-trace discipline)."""
+
+    def __init__(
+        self,
+        pipe,
+        item_spec=None,
+        *,
+        name: str = "default",
+        shapes: Optional[Sequence[int]] = None,
+        slo_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        breaker_threshold: Optional[int] = None,
+        breaker_cooldown_s: float = 0.25,
+        retries: Optional[int] = None,
+        backoff_s: float = 0.05,
+        coalesce_ms: float = 1.0,
+        warm: bool = True,
+        start: bool = True,
+    ):
+        from keystone_tpu.utils import knobs
+
+        raw_shapes = shapes if shapes is not None else knobs.get(
+            "KEYSTONE_SERVE_SHAPES"
+        )
+        ladder = tuple(sorted(set(int(s) for s in (raw_shapes or
+                                                   DEFAULT_SHAPES))))
+        if not ladder or any(s < 1 for s in ladder):
+            raise ValueError(f"serve shapes must be positive ints: {ladder}")
+        self._ladder: Tuple[int, ...] = ladder
+        self._full_ladder = ladder  # for stats/debug after degradation
+        self.slo_ms = float(_knob_default(slo_ms, "KEYSTONE_SERVE_SLO_MS"))
+        self.queue_depth = int(
+            _knob_default(queue_depth, "KEYSTONE_SERVE_QUEUE_DEPTH")
+        )
+        self.breaker_threshold = int(
+            _knob_default(breaker_threshold, "KEYSTONE_SERVE_BREAKER")
+        )
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self._retries = retries
+        self._backoff_s = float(backoff_s)
+        self._coalesce_s = float(coalesce_ms) / 1e3
+
+        # model pool: the PR-1 tiered cache holds every fitted model;
+        # lookups promote toward HBM, pressure demotes cold models to host
+        from keystone_tpu.core.cache import IntermediateCache
+
+        self._pool = IntermediateCache(
+            device_bytes=_mb("KEYSTONE_CACHE_DEVICE_MB"),
+            host_bytes=_mb("KEYSTONE_CACHE_HOST_MB"),
+            disk_bytes=0, cache_dir=None, sync_on_compute=False,
+        )
+        self._nodes_spec: Dict[str, _ModelState] = {}
+
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._closing = False
+        self._stopped = False
+        self._worker: Optional[threading.Thread] = None
+        self._active_model: Optional[str] = None
+
+        # observed latency window -> qps/p50/p99 gauges + the shed signal
+        self._done: collections.deque = collections.deque(maxlen=512)
+        self._p50_ms = 0.0
+        self._p99_ms = 0.0
+        self._est_ms: Dict[Tuple[str, int], float] = {}  # (model, shape)
+        # shed-path demotion gate: True while a demote sweep may still
+        # find device-tier victims (re-armed when a lookup can promote)
+        self._demote_armed = True
+        self._lat_pending = 0          # ok responses since the last
+        self._lat_refreshed = 0.0      # windowed-percentile refresh
+
+        self.add_model(name, pipe, item_spec, warm=warm)
+        self.default_model = name
+        if start:
+            self.start()
+
+    # -- model pool --------------------------------------------------------
+
+    @staticmethod
+    def _pool_key(name: str) -> str:
+        return f"serve.model:{name}"
+
+    def add_model(self, name: str, pipe, item_spec=None,
+                  warm: bool = True) -> None:
+        """Register a fitted pipeline under ``name``: contract-check the
+        whole chain at the ladder's largest shape (a broken chain is
+        rejected HERE, not at the first request), store it in the tiered
+        model pool, and (``warm=True``) compile every ladder shape."""
+        from keystone_tpu.analysis import contracts
+
+        node, stages = _dispatchable(pipe)
+        spec = _resolve_item_spec(item_spec, stages)
+        batch = jax.ShapeDtypeStruct(
+            (self._ladder[-1],) + tuple(spec.shape), spec.dtype
+        )
+        records = contracts.propagate(stages, batch)
+        bad = [r for r in records if r.issue is not None]
+        if bad:
+            lines = [
+                f"{r.name}: [{r.issue.kind}] {r.issue.message}" for r in bad
+            ]
+            raise contracts.ContractViolation(
+                f"serve({name!r}): the pipeline cannot serve its declared "
+                "input contract:\n  " + "\n  ".join(lines), [],
+            )
+        with self._cond:
+            self._nodes_spec[name] = _ModelState(
+                item_spec=spec, stages=stages,
+            )
+        self._pool.put(self._pool_key(name), node, cost_s=1.0)
+        if warm:
+            self._warmup(name, node, spec)
+        self._registry().set_gauge("serve.breaker_state", 0.0, model=name)
+
+    def _fetch_model(self, name: str):
+        hit, node = self._pool.lookup(self._pool_key(name))
+        if not hit:
+            raise KeyError(
+                f"model {name!r} no longer resident (evicted from every "
+                "cache tier — grow KEYSTONE_CACHE_HOST_MB)"
+            )
+        # the lookup may have promoted the model back to the device
+        # tier, so a later shed-path demote sweep can find victims again
+        self._demote_armed = True
+        return node
+
+    def _warmup(self, name: str, node, spec) -> None:
+        """Compile the dispatch program at every ladder shape with a zero
+        batch, so steady-state serving performs ZERO compiles (and record
+        the per-shape latency estimate the deadline filter uses)."""
+        for n in self._ladder:
+            # first call includes compile; the second times the steady
+            # state for the deadline filter's per-shape estimate
+            jax.block_until_ready(_jit_apply_batch(
+                node, jnp.zeros((n,) + tuple(spec.shape), spec.dtype)
+            ))
+            xs = jnp.zeros((n,) + tuple(spec.shape), spec.dtype)
+            t0 = time.perf_counter()
+            jax.block_until_ready(_jit_apply_batch(node, xs))
+            self._est_ms[(name, n)] = (time.perf_counter() - t0) * 1e3
+
+    # -- admission ---------------------------------------------------------
+
+    def _admit_issue(self, x, state: _ModelState) -> Optional[ServeResponse]:
+        """None = admitted; else the structured rejection.  The shape/dtype
+        gate compares against the model's item spec (the compiled-ladder
+        contract); on mismatch the shared contracts pass attributes the
+        failure to the stage whose declared contract the request breaks."""
+        spec = state.item_spec
+        shape = tuple(getattr(x, "shape", ()))
+        dtype = getattr(x, "dtype", None)
+        kind = None
+        if dtype is None or np.dtype(dtype) != np.dtype(spec.dtype):
+            # the C4 family at the gate: an f64 (or integer) item under the
+            # compiled f32 program is rejected pre-dispatch, never silently
+            # cast inside a donated buffer
+            kind = "dtype"
+            msg = (f"expects {np.dtype(spec.dtype).name} items, got "
+                   f"{np.dtype(dtype).name if dtype is not None else '?'}")
+        elif len(shape) != len(spec.shape):
+            kind = "rank"
+            msg = (f"expects rank-{len(spec.shape)} items "
+                   f"{tuple(spec.shape)}, got rank-{len(shape)} {shape}")
+        elif shape != tuple(spec.shape):
+            kind = "dim"
+            msg = (f"compiled shape ladder serves items {tuple(spec.shape)}, "
+                   f"got {shape}")
+        if kind is None:
+            return None
+        stage, detail = _attribute_stage(state.stages, shape, dtype)
+        return ServeResponse(
+            ok=False, code="rejected", kind=kind, stage=stage,
+            error=msg + (f" [{detail}]" if detail else ""),
+        )
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               model: Optional[str] = None) -> PendingResponse:
+        """Admit one item. Returns a :class:`PendingResponse` that ALWAYS
+        terminates in a structured :class:`ServeResponse` — rejected /
+        shed / breaker responses resolve immediately, admitted requests
+        resolve when the worker serves (or sheds) them."""
+        from keystone_tpu.utils import faults
+
+        reg = self._registry()
+        model = model or self.default_model
+        reg.inc("serve.requests", model=model)
+        try:
+            # chaos site 1: gateway-internal admission failure — the
+            # request still gets a structured response, never a hang
+            faults.check("serve.admit")
+            if not hasattr(x, "shape"):
+                x = np.asarray(x)
+            state = self._nodes_spec.get(model)
+            if state is None:
+                return self._finish(_resolved(ServeResponse(
+                    ok=False, code="rejected", kind="model",
+                    error=f"unknown model {model!r}", model=model,
+                )))
+            reject = self._admit_issue(x, state)
+            if reject is not None:
+                reg.inc("serve.rejected", kind=reject.kind)
+                return self._finish(_resolved(
+                    _with_model(reject, model)
+                ))
+            now = time.monotonic()
+            with self._cond:
+                resp = self._gate_locked(state, model, now)
+                if resp is None:
+                    req = _Request(
+                        x=x, model=model, pending=PendingResponse(),
+                        t_submit=now,
+                        deadline_t=(now + deadline_ms / 1e3
+                                    if deadline_ms is not None else None),
+                        probe=(state.breaker == "half_open"
+                               and state.probe_inflight),
+                    )
+                    self._queue.append(req)
+                    reg.set_gauge("serve.queue_depth", len(self._queue))
+                    self._cond.notify_all()
+            if resp is not None:
+                if resp.code == "shed" and self._demote_armed:
+                    # queue pressure: cold models are not being asked
+                    # for — demote them toward host so the hot model's
+                    # dispatches get the HBM. OUTSIDE the condition (the
+                    # device->host copies would stall every submit and
+                    # the worker); disarmed once a sweep finds no
+                    # victims, re-armed when a lookup can re-promote.
+                    self._demote_armed = self._demote_cold(model) > 0
+                return self._finish(_resolved(resp))
+            return req.pending
+        except Exception as e:  # injected admit faults and gateway bugs
+            logger.warning("admission failed: %s: %s", type(e).__name__, e)
+            return self._finish(_resolved(ServeResponse(
+                ok=False, code="error",
+                error=f"admission failure: {type(e).__name__}: {e}",
+                model=model,
+            )))
+
+    def _gate_locked(self, state: _ModelState, model: str,
+                     now: float) -> Optional[ServeResponse]:
+        """Breaker + shed decisions (under the lock); None admits."""
+        reg = self._registry()
+        if self._closing or self._stopped:
+            resp = ServeResponse(ok=False, code="shutdown",
+                                 error="gateway closed", model=model)
+            reg.inc("serve.shed_total", reason="shutdown")
+            return resp
+        if self.breaker_threshold > 0 and state.breaker != "closed":
+            if state.breaker == "open":
+                remaining = state.t_open + self.breaker_cooldown_s - now
+                if remaining <= 0 and not state.probe_inflight:
+                    state.breaker = "half_open"
+                    state.probe_inflight = True
+                    reg.inc("serve.breaker", event="half_open")
+                    reg.set_gauge("serve.breaker_state", 0.5, model=model)
+                    logger.warning(
+                        "breaker half-open for %s: admitting one probe",
+                        model,
+                    )
+                    return None  # THIS request is the probe
+                reg.inc("serve.breaker_fast_fail")
+                return ServeResponse(
+                    ok=False, code="breaker_open",
+                    error="model quarantined (non-finite outputs)",
+                    retry_after_s=round(max(remaining, 0.0) or
+                                        self.breaker_cooldown_s, 3),
+                    model=model,
+                )
+            # half_open with the probe already in flight: fail fast
+            if state.probe_inflight:
+                reg.inc("serve.breaker_fast_fail")
+                return ServeResponse(
+                    ok=False, code="breaker_open",
+                    error="half-open probe in flight",
+                    retry_after_s=round(self.breaker_cooldown_s, 3),
+                    model=model,
+                )
+            state.probe_inflight = True
+            return None
+        depth = len(self._queue)
+        over_depth = depth >= self.queue_depth
+        over_slo = self._p99_ms > self.slo_ms and depth >= 1
+        if over_depth or over_slo:
+            reason = "overload"
+            reg.inc("serve.shed_total", reason=reason)
+            retry_after = max(
+                depth * max(self._p50_ms, 1.0) / 1e3, self.slo_ms / 1e3
+            )
+            return ServeResponse(
+                ok=False, code="shed",
+                error=("queue full" if over_depth
+                       else f"p99 {self._p99_ms:.1f}ms over SLO"),
+                retry_after_s=round(retry_after, 3), model=model,
+            )
+        return None
+
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                model: Optional[str] = None, timeout: float = 30.0):
+        """Synchronous serve: the value on success, :class:`ServeRejected`
+        (carrying the structured response) otherwise."""
+        resp = self.submit(x, deadline_ms=deadline_ms,
+                           model=model).result(timeout)
+        if not resp.ok:
+            raise ServeRejected(resp)
+        return resp.value
+
+    # -- worker ------------------------------------------------------------
+
+    def start(self) -> None:
+        with self._cond:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._stopped = False
+            self._worker = threading.Thread(
+                target=self._run, name="keystone-serve", daemon=True
+            )
+            self._worker.start()
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the gateway.  ``drain=True`` serves everything already
+        admitted first; ``drain=False`` sheds the backlog with structured
+        ``shutdown`` responses.  Either way no request is left hanging."""
+        with self._cond:
+            self._closing = True
+            if not drain:
+                self._shed_backlog("shutdown")
+            self._cond.notify_all()
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            t0 = time.monotonic()
+            while self._queue and time.monotonic() - t0 < timeout:
+                time.sleep(0.005)
+            with self._cond:
+                self._stopped = True
+                self._cond.notify_all()
+            worker.join(timeout)
+        with self._cond:
+            self._stopped = True
+            self._shed_backlog("shutdown")
+
+    def _shed_backlog(self, code: str) -> None:
+        reg = self._registry()
+        while self._queue:
+            req = self._queue.popleft()
+            reg.inc("serve.shed_total", reason=code)
+            self._respond(req, ServeResponse(
+                ok=False, code=code, error="gateway closed",
+                model=req.model,
+            ))
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            try:
+                self._serve_batch(batch)
+            except BaseException as e:  # the no-wedge contract
+                logger.warning(
+                    "dispatch failed (%s: %s); failing the batch "
+                    "structured", type(e).__name__, e,
+                )
+                for req in batch:
+                    self._respond(req, ServeResponse(
+                        ok=False, code="error",
+                        error=f"dispatch failure: {type(e).__name__}: {e}",
+                        model=req.model,
+                    ))
+
+    def _collect(self) -> Optional[List[_Request]]:
+        """Pop a head-run of same-model requests (up to the ladder max),
+        waiting a short coalesce window to batch a burst. None = stop."""
+        with self._cond:
+            while not self._queue:
+                if self._stopped or (self._closing and not self._queue):
+                    return None
+                self._cond.wait(0.05)
+            # coalesce: give a burst one window to land before dispatching
+            if (len(self._queue) < self._ladder[-1]
+                    and not self._closing and self._coalesce_s > 0):
+                self._cond.wait(self._coalesce_s)
+            if not self._queue:
+                return []
+            head_model = self._queue[0].model
+            batch: List[_Request] = []
+            while (self._queue and len(batch) < self._ladder[-1]
+                   and self._queue[0].model == head_model):
+                batch.append(self._queue.popleft())
+            self._registry().set_gauge(
+                "serve.queue_depth", len(self._queue)
+            )
+            return batch
+
+    def _serve_batch(self, batch: List[_Request]) -> None:
+        from keystone_tpu.utils import faults
+        from keystone_tpu.utils.retry import call_with_device_retries
+
+        reg = self._registry()
+        model = batch[0].model
+        now = time.monotonic()
+        # deadline filter: drop expired work first, then work that
+        # provably cannot meet its deadline at the measured per-shape
+        # dispatch estimate for the SURVIVORS' chunk schedule (a batch
+        # over the ladder max dispatches as several sequential chunks,
+        # and expired entries must not inflate the survivors' estimate)
+        alive: List[_Request] = []
+        for req in batch:
+            if req.deadline_t is not None and now > req.deadline_t:
+                reg.inc("serve.shed_total", reason="deadline")
+                self._respond(req, ServeResponse(
+                    ok=False, code="deadline", error="deadline passed",
+                    model=model,
+                ))
+            else:
+                alive.append(req)
+        est_s = self._estimate_batch_ms(model, len(alive)) / 1e3
+        keep: List[_Request] = []
+        for req in alive:
+            if req.deadline_t is not None and now + est_s > req.deadline_t:
+                reg.inc("serve.shed_total", reason="deadline")
+                self._respond(req, ServeResponse(
+                    ok=False, code="deadline",
+                    error=f"deadline unmeetable (est {est_s * 1e3:.1f}ms)",
+                    model=model,
+                ))
+            else:
+                keep.append(req)
+        if not keep:
+            return
+        node = self._fetch_model(model)
+        xs = jnp.stack([jnp.asarray(r.x) for r in keep])
+        self._active_model = model
+
+        def attempt():
+            # chaos site 2: the dispatch boundary. Error kinds raise into
+            # the retry loop (the production retriable path); a NUMERIC
+            # kind poisons the batch — the breaker's sentinel then catches
+            # the non-finite outputs downstream (PR-13 semantics).
+            spec = faults.check("serve.dispatch")
+            b = xs
+            if spec is not None:
+                b = faults.poison(b, spec.kind)
+            outs, i = [], 0
+            while i < b.shape[0]:
+                n = self._pick_shape(b.shape[0] - i)
+                rows = b[i : i + n]  # python slicing clamps at the tail
+                chunk = _pad_rows(rows, n) if rows.shape[0] < n else rows
+                outs.append(_jit_apply_batch(node, chunk))
+                i += rows.shape[0]
+            out = jax.tree_util.tree_map(
+                lambda *ls: jnp.concatenate(ls, axis=0)[: xs.shape[0]],
+                *outs,
+            ) if len(outs) > 1 else jax.tree_util.tree_map(
+                lambda l: l[: xs.shape[0]], outs[0]
+            )
+            flag = _finite_flag(out)
+            return jax.block_until_ready((out, flag))
+
+        t0 = time.perf_counter()
+        out, flag = call_with_device_retries(
+            attempt, retries=self._retries, backoff_s=self._backoff_s,
+            max_backoff_s=1.0, on_retry=self._on_dispatch_retry,
+        )
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        reg.inc("serve.dispatch_total", model=model)
+        reg.observe("serve.dispatch_ms", dt_ms)
+        self._update_estimate(model, len(keep), dt_ms)
+        healthy = bool(flag)
+        state = self._nodes_spec[model]
+        if not healthy:
+            reg.inc("serve.sentinel_trips", model=model)
+            self._trip_breaker(state, model, probe=any(
+                r.probe for r in keep
+            ))
+            for req in keep:
+                self._respond(req, ServeResponse(
+                    ok=False, code="sentinel",
+                    error="non-finite output quarantined (health sentinel)",
+                    model=model,
+                ))
+            return
+        self._note_healthy(state, model, probe=any(r.probe for r in keep))
+        # chaos site 3: the respond boundary — a failure here still
+        # terminates every request (structured error, not a hang)
+        try:
+            faults.check("serve.respond")
+        except Exception as e:
+            for req in keep:
+                self._respond(req, ServeResponse(
+                    ok=False, code="error",
+                    error=f"respond failure: {type(e).__name__}: {e}",
+                    model=model,
+                ))
+            return
+        now = time.monotonic()
+        for i, req in enumerate(keep):
+            value = jax.tree_util.tree_map(lambda l: l[i], out)
+            self._respond(req, ServeResponse(
+                ok=True, code="ok", value=value,
+                latency_ms=round((now - req.t_submit) * 1e3, 3),
+                model=model,
+            ))
+
+    # -- breaker -----------------------------------------------------------
+
+    def _trip_breaker(self, state: _ModelState, model: str,
+                      probe: bool) -> None:
+        reg = self._registry()
+        with self._cond:
+            state.trips += 1
+            if probe:
+                state.probe_inflight = False
+            if self.breaker_threshold <= 0:
+                return
+            if probe or (state.breaker == "closed"
+                         and state.trips >= self.breaker_threshold):
+                state.breaker = "open"
+                state.t_open = time.monotonic()
+                reg.inc("serve.breaker", event="open")
+                reg.set_gauge("serve.breaker_state", 1.0, model=model)
+                logger.warning(
+                    "breaker OPEN for %s after %d consecutive sentinel "
+                    "trip(s)", model, state.trips,
+                )
+
+    def _note_healthy(self, state: _ModelState, model: str,
+                      probe: bool) -> None:
+        reg = self._registry()
+        with self._cond:
+            state.trips = 0
+            # only a PROBE closes an open breaker: a pre-open request that
+            # happened to be queued and served healthy must not flap it
+            if probe and state.breaker != "closed":
+                state.breaker = "closed"
+                state.probe_inflight = False
+                reg.inc("serve.breaker", event="close")
+                reg.set_gauge("serve.breaker_state", 0.0, model=model)
+                logger.warning("breaker CLOSED for %s (probe served)", model)
+
+    def breaker_state(self, model: Optional[str] = None) -> str:
+        return self._nodes_spec[model or self.default_model].breaker
+
+    # -- degradation -------------------------------------------------------
+
+    def _on_dispatch_retry(self, attempt: int, exc: BaseException) -> None:
+        """Pre-retry degradation: the PR-12 OOM hook first (frees the
+        ACTIVE intermediate cache's device tier, if one is installed),
+        then the gateway's own ladder: demote cold models' device tiers
+        and shrink the batch-shape ladder so the retry dispatches a
+        smaller program into the HBM the failed attempt could not get."""
+        from keystone_tpu.utils.retry import default_on_retry
+
+        default_on_retry(attempt, exc)
+        text = str(exc).lower()
+        if "resource_exhausted" not in text and "out of memory" not in text:
+            return
+        reg = self._registry()
+        released = self._pool.demote_device_except(
+            (self._pool_key(self._active_model or self.default_model),)
+        )
+        if released:
+            reg.inc("serve.model_demotions", released)
+        with self._cond:
+            if len(self._ladder) > 1:
+                self._ladder = self._ladder[:-1]
+                reg.inc("serve.degraded")
+                reg.set_gauge("serve.ladder_max", self._ladder[-1])
+                logger.warning(
+                    "OOM under serve: ladder shrunk to %s (attempt %d)",
+                    self._ladder, attempt,
+                )
+
+    def _demote_cold(self, hot_model: str) -> int:
+        released = self._pool.demote_device_except(
+            (self._pool_key(hot_model),)
+        )
+        if released:
+            self._registry().inc("serve.model_demotions", released)
+        return released
+
+    def _pick_shape(self, n: int) -> int:
+        for s in self._ladder:
+            if s >= n:
+                return s
+        return self._ladder[-1]
+
+    # -- stats -------------------------------------------------------------
+
+    def _chunk_shapes(self, n: int) -> List[int]:
+        """The ladder rungs ``n`` rows dispatch through — the same chunk
+        walk the dispatch loop performs (a batch over the ladder max
+        runs as several sequential fixed-shape programs)."""
+        shapes: List[int] = []
+        i = 0
+        while i < n:
+            s = self._pick_shape(n - i)
+            shapes.append(s)
+            i += min(n - i, s)
+        return shapes
+
+    def _estimate_ms(self, model: str, shape: int) -> float:
+        est = self._est_ms.get((model, shape))
+        if est is None:
+            vals = [v for (m, _), v in self._est_ms.items() if m == model]
+            est = max(vals) if vals else 0.0
+        return est
+
+    def _estimate_batch_ms(self, model: str, n: int) -> float:
+        """Total dispatch estimate for ``n`` rows: the sum over the
+        chunk schedule's per-rung estimates, so deadlines are judged
+        against the sequential dispatches they will actually wait for."""
+        return sum(
+            self._estimate_ms(model, s) for s in self._chunk_shapes(n)
+        )
+
+    def _update_estimate(self, model: str, n: int, ms: float) -> None:
+        shapes = self._chunk_shapes(n)
+        if not shapes:
+            return
+        per = ms / len(shapes)
+        for s in shapes:
+            prev = self._est_ms.get((model, s), per)
+            self._est_ms[(model, s)] = 0.7 * prev + 0.3 * per
+
+    def _respond(self, req: _Request, resp: ServeResponse) -> None:
+        reg = self._registry()
+        reg.inc("serve.responses", code=resp.code)
+        if req.probe and resp.code not in ("ok", "sentinel"):
+            # a probe that was shed/errored before its dispatch must free
+            # the half-open slot, or the breaker wedges half-open forever
+            with self._cond:
+                state = self._nodes_spec.get(req.model)
+                if state is not None:
+                    state.probe_inflight = False
+        if resp.ok:
+            now = time.monotonic()
+            self._done.append((now, resp.latency_ms))
+            # recompute the windowed percentiles at most every 16
+            # responses / 0.5 s: a full filter+sort of the 512-entry
+            # window per served request would tax the dispatch worker at
+            # exactly the QPS the gauges are meant to measure
+            self._lat_pending += 1
+            if self._lat_pending >= 16 or now - self._lat_refreshed >= 0.5:
+                self._refresh_latency(now)
+        req.pending._resolve(resp)
+
+    def _refresh_latency(self, now: float) -> None:
+        self._lat_pending = 0
+        self._lat_refreshed = now
+        window = [l for t, l in self._done if now - t <= 5.0]
+        if not window:
+            return
+        window.sort()
+        self._p50_ms = window[len(window) // 2]
+        self._p99_ms = window[min(len(window) - 1, int(0.99 * len(window)))]
+        reg = self._registry()
+        reg.set_gauge("serve.qps", round(len(window) / 5.0, 3))
+        reg.set_gauge("serve.p50_ms", round(self._p50_ms, 3))
+        reg.set_gauge("serve.p99_ms", round(self._p99_ms, 3))
+
+    def _finish(self, pending: PendingResponse) -> PendingResponse:
+        resp = pending._response
+        if resp is not None:
+            self._registry().inc("serve.responses", code=resp.code)
+        return pending
+
+    @staticmethod
+    def _registry():
+        from keystone_tpu.telemetry import get_registry
+
+        return get_registry()
+
+    def stats(self) -> dict:
+        """Queryable gateway state (mirrors the serve.* telemetry)."""
+        reg = self._registry()
+        with self._cond:
+            return {
+                "qps": reg.get_gauge("serve.qps") or 0.0,
+                "p50_ms": round(self._p50_ms, 3),
+                "p99_ms": round(self._p99_ms, 3),
+                "slo_ms": self.slo_ms,
+                "queue_depth": len(self._queue),
+                "queue_bound": self.queue_depth,
+                "ladder": list(self._ladder),
+                "shed_total": int(
+                    reg.counter_family_total("serve.shed_total")
+                ),
+                "degraded": int(reg.counter_family_total("serve.degraded")),
+                "breakers": {
+                    name: st.breaker
+                    for name, st in self._nodes_spec.items()
+                },
+            }
+
+    def compile_cache_size(self) -> int:
+        """Size of the shared dispatch compile cache — constant across
+        steady-state serving (the zero-recompile pin)."""
+        return _jit_apply_batch._cache_size()
+
+
+# ---------------------------------------------------------------------------
+# construction helpers
+# ---------------------------------------------------------------------------
+
+
+def _dispatchable(pipe):
+    """(dispatch node, stage graph) for a servable pipeline: Cacher
+    markers are stripped (they are bulk-path materialization hints; the
+    serve program is ONE fused dispatch), host nodes are rejected — a
+    gateway serves compiled fixed-shape programs only."""
+    from keystone_tpu.analysis.contracts import stage_list
+    from keystone_tpu.core.pipeline import DAG, Chain, Node
+
+    if not isinstance(pipe, Node):
+        raise TypeError(
+            f"serve() needs a pipeline Node, got {type(pipe).__name__}"
+        )
+    stages, _ = stage_list(pipe)
+    for node, _deps in stages:
+        if not getattr(node, "jittable", True):
+            raise TypeError(
+                f"serve(): stage {type(node).__name__} is a host node — "
+                "the gateway dispatches compiled fixed-shape programs only "
+                "(run host stages offline, serve the jittable suffix)"
+            )
+    if isinstance(pipe, DAG):
+        return pipe, stages
+    if len(stages) == 1:
+        return stages[0][0], stages
+    return Chain(stages=tuple(n for n, _ in stages)), stages
+
+
+def _resolve_item_spec(item_spec, stages):
+    """The per-item abstract input: explicit ``item_spec`` (shape without
+    the batch axis, or a ShapeDtypeStruct) wins; otherwise the earliest
+    stage declaring an ``in_template`` contract provides it."""
+    from keystone_tpu.analysis import contracts
+
+    if item_spec is not None:
+        if hasattr(item_spec, "shape") and hasattr(item_spec, "dtype"):
+            return jax.ShapeDtypeStruct(
+                tuple(item_spec.shape), np.dtype(item_spec.dtype)
+            )
+        raise TypeError(
+            "item_spec must carry shape+dtype (e.g. jax.ShapeDtypeStruct)"
+        )
+    for node, _deps in stages:
+        contract = contracts.contract_of(node)
+        if contract is not None and contract.in_template is not None:
+            try:
+                template = contract.in_template()
+            except Exception:
+                continue
+            leaf = contracts.leading_leaf(template)
+            if leaf is not None and leaf.shape:
+                # templates carry a leading item axis of 1
+                return jax.ShapeDtypeStruct(
+                    tuple(leaf.shape[1:]), np.dtype(leaf.dtype)
+                )
+    raise ValueError(
+        "serve() could not derive the item spec: no stage declares an "
+        "in_template contract — pass item_spec=jax.ShapeDtypeStruct(...)"
+    )
+
+
+def _attribute_stage(stages, item_shape, dtype) -> Tuple[Optional[str], str]:
+    """Run the SHARED contract propagation with the bad request's aval and
+    name the first stage that fails — the admission rejection carries the
+    same attribution a `keystone-tpu check` pass would report."""
+    from keystone_tpu.analysis import contracts
+
+    try:
+        aval = jax.ShapeDtypeStruct(
+            (1,) + tuple(item_shape), np.dtype(dtype or np.float32)
+        )
+        records = contracts.propagate(stages, aval)
+        for r in records:
+            if r.issue is not None:
+                return r.name, r.issue.message
+    except Exception:
+        pass
+    return None, ""
+
+
+def _with_model(resp: ServeResponse, model: str) -> ServeResponse:
+    return ServeResponse(**{**resp.__dict__, "model": model})
+
+
+def serve(pipe, item_spec=None, **kwargs) -> Gateway:
+    """Build a :class:`Gateway` over a fitted pipeline (module docstring).
+
+    ``item_spec`` is the per-item abstract input (shape WITHOUT the batch
+    axis + dtype); omitted, it is derived from the earliest stage's
+    declared ``in_template`` contract.  Keyword knobs (each also an env
+    knob, explicit argument winning): ``shapes`` / ``KEYSTONE_SERVE_SHAPES``
+    (the fixed micro-batch ladder), ``slo_ms`` / ``KEYSTONE_SERVE_SLO_MS``,
+    ``queue_depth`` / ``KEYSTONE_SERVE_QUEUE_DEPTH``,
+    ``breaker_threshold`` / ``KEYSTONE_SERVE_BREAKER`` (0 disables the
+    breaker).  ``start=False`` builds the gateway paused (tests/smokes
+    queue deterministic bursts, then :meth:`Gateway.start`)."""
+    return Gateway(pipe, item_spec, **kwargs)

@@ -16,9 +16,12 @@ comma-separated entries
   ``block`` (the streaming weighted-BCD block loop,
   ``learning/block_weighted.py``), ``bcd`` (each
   ``block_coordinate_descent_l2`` entry, ``linalg/bcd.py``), ``segment``
-  (every fused-segment boundary in ``core/pipeline.py``) and
+  (every fused-segment boundary in ``core/pipeline.py``),
   ``bench_section`` (each ``bench.py`` section flush — the generalization
-  of the ``BENCH_KILL_AFTER_SECTION`` hook).
+  of the ``BENCH_KILL_AFTER_SECTION`` hook), and the serving-gateway
+  boundaries ``serve.admit`` / ``serve.dispatch`` / ``serve.respond``
+  (``serve/gateway.py`` — a fault there must surface as a structured
+  response, never a wedged request).
 - ``occurrence`` — the 0-based count of crossings of that site *while a
   plan is armed* (crossings are not counted when the knob is unset, so
   arming the plan defines t=0; :func:`reset` restarts the count).
@@ -58,12 +61,20 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-SITES: Tuple[str, ...] = ("block", "bcd", "segment", "bench_section")
+SITES: Tuple[str, ...] = (
+    "block", "bcd", "segment", "bench_section",
+    # serving-gateway boundaries (serve/gateway.py): admission, the
+    # fixed-shape dispatch, and the response fan-out — the chaos surface
+    # scripts/serve_chaos_smoke.py drives under sustained load
+    "serve.admit", "serve.dispatch", "serve.respond",
+)
 KINDS: Tuple[str, ...] = ("xla", "oom", "kill", "nan", "inf", "saturate")
 #: kinds that poison data instead of raising — the numerical-fault family
 NUMERIC_KINDS: Tuple[str, ...] = ("nan", "inf", "saturate")
 #: sites that carry a data block a numeric kind can poison
-DATA_SITES: Tuple[str, ...] = ("block", "bcd")
+#: (serve.dispatch carries the stacked request batch: poisoning it is how
+#: chaos drives the gateway's non-finite-output breaker)
+DATA_SITES: Tuple[str, ...] = ("block", "bcd", "serve.dispatch")
 
 
 @dataclass(frozen=True)

@@ -398,12 +398,14 @@ declare("KEYSTONE_FAULTS", "str", None,
         "solver's block-boundary crossing number 7 (the 8th crossing). "
         "Sites: block (weighted-BCD loop), bcd (BCD solver "
         "entry), segment (pipeline fused-segment boundary), bench_section "
-        "(bench.py section flush). Kinds: xla (transient device error, "
+        "(bench.py section flush), serve.admit / serve.dispatch / "
+        "serve.respond (the serving gateway's admission, dispatch, and "
+        "response boundaries). Kinds: xla (transient device error, "
         "default), oom (RESOURCE_EXHAUSTED flavor), kill (SIGKILL), plus "
         "the NUMERIC kinds nan|inf|saturate which poison the data block "
         "crossing the boundary instead of raising (valid only at the "
-        "data-bearing sites block/bcd — rejected eagerly elsewhere; the "
-        "KEYSTONE_HEALTH sentinels' chaos driver). Unset "
+        "data-bearing sites block/bcd/serve.dispatch — rejected eagerly "
+        "elsewhere; the KEYSTONE_HEALTH sentinels' chaos driver). Unset "
         "= zero injection; the compiled programs are byte-identical "
         "either way (injection is host-side control flow).",
         validator=_fault_plan)
@@ -441,6 +443,48 @@ declare("KEYSTONE_SKETCH_BCD", "bool", False,
         "visit feature blocks in descending sketched-energy order instead "
         "of sequentially (linalg/sketch.py::leverage_block_order).")
 
+
+def _serve_shapes(raw: str) -> Tuple[int, ...]:
+    """Normalizing validator: the ONE place the serve shape ladder is
+    parsed. Returns the ascending tuple of distinct micro-batch sizes —
+    consumers get the tuple, never a raw string to re-parse."""
+    parts = [p.strip() for p in raw.strip().split(",") if p.strip()]
+    try:
+        vals = sorted({int(p) for p in parts})
+    except ValueError:
+        vals = []
+    if not vals or any(v < 1 for v in vals):
+        raise ValueError(
+            f"KEYSTONE_SERVE_SHAPES={raw!r} is invalid: expected a "
+            "comma-separated list of positive micro-batch sizes, e.g. "
+            "KEYSTONE_SERVE_SHAPES=1,8,32"
+        )
+    return tuple(vals)
+
+
+declare("KEYSTONE_SERVE_SLO_MS", "float", 50.0,
+        "Serving gateway latency SLO in milliseconds (serve/gateway.py): "
+        "once the observed p99 crosses it while requests are queued, new "
+        "arrivals shed with a retry_after_s signal instead of deepening "
+        "the queue.", validator=_positive)
+declare("KEYSTONE_SERVE_QUEUE_DEPTH", "int", 64,
+        "Serving gateway admission bound: requests arriving with this many "
+        "already queued are shed (structured 'shed' response + retry-after) "
+        "— overload degrades to partial availability, never collapse.",
+        validator=_positive)
+declare("KEYSTONE_SERVE_SHAPES", "str", None,
+        "Fixed micro-batch shape ladder the gateway compiles at serve() "
+        "time, as comma-separated batch sizes (default 1,8,32); requests "
+        "are padded up the ladder and dispatched through donated buffers, "
+        "so steady-state serving performs zero recompiles; reads yield "
+        "the parsed ascending tuple.", validator=_serve_shapes)
+declare("KEYSTONE_SERVE_BREAKER", "int", 3,
+        "Per-model circuit breaker: this many CONSECUTIVE dispatches with "
+        "non-finite outputs (the PR-13 health-sentinel check, serving "
+        "form) quarantine the model — requests fail fast with a "
+        "'breaker_open' response until a half-open probe re-certifies it. "
+        "0 disables the breaker.", validator=_non_negative)
+
 # ---------------------------------------------------------------------------
 # BENCH_* declarations (bench.py / scripts/bench_regime.py sections)
 # ---------------------------------------------------------------------------
@@ -453,7 +497,13 @@ declare("BENCH_EXTRAS", "bool", True,
 declare("BENCH_CONSTANTS", "bool", True,
         "Machine-constants section (matmul roofline probes).")
 declare("BENCH_SERVE", "bool", True,
-        "Serving-latency section.")
+        "Serving-gateway section (serve/gateway.py): sustained QPS at the "
+        "SLO, p50/p99, shed fraction, and the 3-point QPS-vs-p99 "
+        "saturation curve on the primary predict path (budget-gated; "
+        "exhaustion emits serve_skipped).")
+declare("BENCH_SERVE_LATENCY", "bool", True,
+        "Per-item serve() latency section (p50/p95 + device-only ms on "
+        "the fitted MNIST/newsgroups/VOC pipelines).")
 declare("BENCH_MOMENTS", "bool", True,
         "Pallas moments-kernel section.")
 declare("BENCH_STAGES", "bool", True,

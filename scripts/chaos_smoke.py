@@ -25,6 +25,15 @@ Pins, through the REAL entry points on the 8-device CPU sim:
    same-mode resume completes, heals the quarantined block through the
    escalation ladder, and lands inside the clean twin's residual
    envelope.
+6. ELASTIC retry (PR 14 fix): ``fit_streaming_elastic`` with the
+   checkpoint path DERIVED from ``KEYSTONE_CHECKPOINT_DIR`` (no explicit
+   path — the derivation was previously only exercised by batch-fit unit
+   tests) survives a transient injected device error inside its own
+   retry loop: the retried attempt resumes from the mid-fit checkpoint,
+   ``retry.attempt`` and ``retry.resumed`` are both counted >= 1 (the
+   resumed counter was written but never pinned end to end), the result
+   matches the uninterrupted twin, and the completed fit cleans the
+   derived file out of the directory.
 """
 
 from __future__ import annotations
@@ -221,13 +230,54 @@ def main() -> int:
         f"{obj_heal:.4f} vs {obj_ref:.4f}"
     )
 
+    # 6. elastic retry with the DERIVED checkpoint path: a transient
+    #    device error at schedule position 3 is absorbed by the retry
+    #    loop in-process (the long-lived-gateway restart path) — the
+    #    second attempt resumes from the mid-fit checkpoint and
+    #    retry.resumed is finally pinned where it is produced
+    from keystone_tpu.utils.retry import fit_streaming_elastic
+
+    ckdir = tempfile.mkdtemp(prefix="chaos_elastic_")
+    attempts0 = reg.get_counter("retry.attempt")
+    resumed0 = reg.get_counter("retry.resumed")
+    faults.reset()
+    os.environ["KEYSTONE_CHECKPOINT_DIR"] = ckdir
+    os.environ["KEYSTONE_FAULTS"] = "block@3:xla"
+    try:
+        raw = {"x": _put_rows(mesh8, jnp.asarray(x))}
+        labels = _put_rows(mesh8, jnp.asarray(lbl))
+        elastic = fit_streaming_elastic(
+            est, nodes, raw, labels, checkpoint_every=1, backoff_s=0.01,
+        )
+        jax.block_until_ready(elastic.w)
+    finally:
+        os.environ.pop("KEYSTONE_FAULTS", None)
+        os.environ.pop("KEYSTONE_CHECKPOINT_DIR", None)
+        faults.reset()
+    assert reg.get_counter("retry.attempt") > attempts0, (
+        "the injected transient fault never entered the retry loop"
+    )
+    assert reg.get_counter("retry.resumed") > resumed0, (
+        "retry.resumed was not counted for the resumed elastic fit"
+    )
+    w_el = np.asarray(elastic.w, np.float64)
+    el_delta = float(
+        np.linalg.norm(w_el - w_ref) / max(np.linalg.norm(w_ref), 1e-30)
+    )
+    assert el_delta < 1e-6, (
+        f"elastic resumed fit diverged from the twin: {el_delta}"
+    )
+    leftovers = os.listdir(ckdir)
+    assert not leftovers, f"elastic fit left derived checkpoints: {leftovers}"
+
     elapsed = time.monotonic() - t_start
     print(
         f"chaos-smoke OK in {elapsed:.1f}s: injected fault at pos "
         f"{kill_pos}, resumed 8->4 devices (reshard counted), "
         f"w_delta={delta:.2e}, truncated file -> CheckpointCorruptError; "
         f"poisoned-block kill-and-resume healed "
-        f"(obj {obj_heal:.3f} vs clean {obj_ref:.3f})"
+        f"(obj {obj_heal:.3f} vs clean {obj_ref:.3f}); elastic retry "
+        f"resumed in-process (retry.resumed pinned, delta={el_delta:.1e})"
     )
     assert elapsed < BUDGET_S, f"smoke took {elapsed:.1f}s (>{BUDGET_S}s)"
     return 0

@@ -1,0 +1,200 @@
+"""Serving-gateway chaos smoke (<20 s, CPU): the acceptance scenario for
+the hardened serving tier (``keystone_tpu/serve/gateway.py``).
+
+Under sustained synthetic load with ``KEYSTONE_FAULTS`` firing at all
+three serve sites plus one mid-run SIGKILL/restart, the gateway never
+wedges:
+
+1. ``serve.admit`` fault -> the request still terminates, as a STRUCTURED
+   ``error`` response (never a hang); the next request serves normally.
+2. Sustained overload against a bounded queue -> every submitted request
+   terminates as served-or-structured-shed (sheds counted, retry-after
+   set), and the latency/qps gauges populate.
+3. ``serve.respond`` fault -> structured ``error``, next request fine.
+4. ``serve.dispatch`` NaN poison x breaker threshold -> consecutive
+   sentinel trips round-trip the per-model circuit breaker
+   open -> half-open -> closed (fast-fails counted while open, the
+   half-open probe re-certifies the model).
+5. A worker process serving sustained load is SIGKILLed MID-RUN by an
+   injected ``serve.dispatch`` kill fault (the preemption case); the
+   "restarted" worker (a fresh process over the same pipeline) reaches
+   steady state and serves its whole load with ZERO recompiles after
+   warmup — the compiled-ladder contract survives restarts.
+
+``make serve-chaos-smoke``; the gateway-over-MNIST rung lives in
+``scripts/serve_smoke.py`` (``make serve-smoke``).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("KEYSTONE_FAULTS", None)
+
+t_start = time.monotonic()
+
+BUDGET_S = 20.0
+D = 4  # item width of the synthetic serve chain
+
+
+def _build_gateway(**kw):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.core.pipeline import Transformer, chain
+    from keystone_tpu.serve import serve
+
+    class Affine(Transformer):
+        def apply(self, x):
+            return x * 2.0 + 1.0
+
+    kw.setdefault("item_spec", jax.ShapeDtypeStruct((D,), np.float32))
+    return serve(chain(Affine()), **kw)
+
+
+def _item(i=0.0):
+    import numpy as np
+
+    return np.arange(D, dtype=np.float32) + np.float32(i)
+
+
+def _worker(mode: str) -> int:
+    """Child process: sustained synthetic load. ``kill`` mode arms a
+    mid-run SIGKILL at the dispatch boundary (the parent asserts the -9);
+    ``steady`` mode is the restarted gateway — it must serve everything
+    with zero recompiles after warmup."""
+    from keystone_tpu.utils import faults
+
+    if mode == "kill":
+        os.environ["KEYSTONE_FAULTS"] = "serve.dispatch@5:kill"
+    faults.reset()
+    # generous SLO: CPU-sim dispatch is ~100 ms, and THIS phase pins the
+    # no-wedge/zero-recompile contract, not shedding (phase 2 does that)
+    gw = _build_gateway(slo_ms=10_000.0)
+    size0 = gw.compile_cache_size()
+    served = 0
+    for burst in range(12):
+        pend = [gw.submit(_item(burst * 4 + j)) for j in range(4)]
+        rs = [p.result(10) for p in pend]
+        assert all(r.ok for r in rs), [r.code for r in rs]
+        served += len(rs)
+        print(f"worker[{mode}]: burst {burst} served (total {served})",
+              flush=True)
+    assert gw.compile_cache_size() == size0, (
+        f"steady-state recompile: {gw.compile_cache_size()} != {size0}"
+    )
+    gw.close()
+    print(f"worker[{mode}]: DONE served={served} recompiles=0", flush=True)
+    return 0
+
+
+def main() -> int:
+    from keystone_tpu.telemetry import get_registry
+    from keystone_tpu.utils import faults
+
+    reg = get_registry()
+
+    # -- 1. admission fault: structured error, never a hang -------------
+    gw = _build_gateway(queue_depth=8, breaker_threshold=2,
+                        breaker_cooldown_s=0.1)
+    os.environ["KEYSTONE_FAULTS"] = "serve.admit@0:xla"
+    faults.reset()
+    r = gw.submit(_item()).result(5)
+    os.environ.pop("KEYSTONE_FAULTS", None)
+    faults.reset()
+    assert r.code == "error" and "injected fault" in r.error, r
+    assert gw.submit(_item()).result(10).ok, "gateway wedged after fault"
+    print("serve-chaos 1/5: admit fault -> structured error, recovered")
+
+    # -- 2. sustained overload: served-or-shed, nothing hangs ------------
+    gw.close()
+    gw = _build_gateway(queue_depth=8, breaker_threshold=2,
+                        breaker_cooldown_s=0.1, start=False)
+    pend = [gw.submit(_item(i)) for i in range(40)]
+    gw.start()
+    codes = [p.result(15).code for p in pend]
+    assert len(codes) == 40 and all(c is not None for c in codes)
+    n_ok = sum(c == "ok" for c in codes)
+    n_shed = sum(c == "shed" for c in codes)
+    assert n_ok + n_shed == 40, f"unexpected codes under overload: {codes}"
+    assert n_ok >= 8 and n_shed >= 1, (n_ok, n_shed)
+    assert int(reg.counter_family_total("serve.shed_total")) >= n_shed
+    print(f"serve-chaos 2/5: overload degraded to partial availability "
+          f"({n_ok} served, {n_shed} shed, zero wedged)")
+
+    # -- 3. respond fault: structured error, next request fine -----------
+    os.environ["KEYSTONE_FAULTS"] = "serve.respond@0:xla"
+    faults.reset()
+    r = gw.submit(_item()).result(10)
+    os.environ.pop("KEYSTONE_FAULTS", None)
+    faults.reset()
+    assert r.code == "error" and "respond failure" in r.error, r
+    assert gw.submit(_item()).result(10).ok
+    print("serve-chaos 3/5: respond fault -> structured error, recovered")
+
+    # -- 4. dispatch NaN x2 -> breaker open -> half-open -> closed -------
+    os.environ["KEYSTONE_FAULTS"] = "serve.dispatch@0:nan*2"
+    faults.reset()
+    states = [gw.breaker_state()]
+    s1 = gw.submit(_item()).result(10)
+    s2 = gw.submit(_item()).result(10)
+    os.environ.pop("KEYSTONE_FAULTS", None)
+    faults.reset()
+    assert (s1.code, s2.code) == ("sentinel", "sentinel"), (s1, s2)
+    states.append(gw.breaker_state())
+    assert states[-1] == "open", states
+    ff = gw.submit(_item()).result(5)
+    assert ff.code == "breaker_open" and ff.retry_after_s is not None, ff
+    time.sleep(0.12)  # past the cooldown: next request is the probe
+    probe = gw.submit(_item()).result(10)
+    assert probe.ok, probe
+    states.append(gw.breaker_state())
+    assert states[-1] == "closed", states
+    for event in ("open", "half_open", "close"):
+        assert reg.get_counter("serve.breaker", event=event) >= 1, event
+    assert gw.submit(_item()).result(10).ok
+    gw.close()
+    print(f"serve-chaos 4/5: breaker round-trip {' -> '.join(states)} "
+          "(fast-fail while open, probe re-admitted)")
+
+    # -- 5. mid-run SIGKILL under load, then a zero-recompile restart ----
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    kill = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker", "kill"],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert kill.returncode == -signal.SIGKILL, (
+        kill.returncode, kill.stdout[-500:], kill.stderr[-500:]
+    )
+    assert "burst 0 served" in kill.stdout, kill.stdout  # died MID-run
+    assert "DONE" not in kill.stdout
+    steady = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker", "steady"],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert steady.returncode == 0, (
+        steady.returncode, steady.stdout[-800:], steady.stderr[-800:]
+    )
+    assert "DONE served=48 recompiles=0" in steady.stdout, steady.stdout
+    print("serve-chaos 5/5: SIGKILLed mid-run under load; restarted "
+          "gateway served 48/48 with zero steady-state recompiles")
+
+    elapsed = time.monotonic() - t_start
+    print(f"serve-chaos-smoke OK in {elapsed:.1f}s")
+    assert elapsed < BUDGET_S, f"smoke took {elapsed:.1f}s (>{BUDGET_S}s)"
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        sys.exit(_worker(sys.argv[2]))
+    sys.exit(main())

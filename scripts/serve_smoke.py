@@ -1,0 +1,130 @@
+"""Serving-gateway smoke over the MNIST chain (<20 s, CPU): the
+`make serve-smoke` rung of `verify-fast`.
+
+Pins, through the REAL pipeline (``pipelines/mnist_random_fft.py``
+featurizer >> a fitted block-least-squares model) served by
+``keystone_tpu/serve/gateway.py``:
+
+1. Gateway predictions MATCH the batch apply path — the padded
+   fixed-shape dispatch serves the same model the fit produced.
+2. Steady-state serving performs ZERO recompiles (the compiled shape
+   ladder + padded dispatch contract).
+3. Overload against the bounded queue sheds with a structured
+   retry-after response (ONE shed asserted) while admitted work still
+   serves.
+4. A NaN-poisoned dispatch (``KEYSTONE_FAULTS serve.dispatch`` numeric
+   kind) trips the sentinel/breaker (ONE breaker trip asserted), the
+   half-open probe re-admits the model, and serving resumes.
+5. ``close(drain=True)`` serves the whole admitted backlog before
+   stopping — the graceful-drain contract (no request left hanging).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("KEYSTONE_FAULTS", None)
+
+t_start = time.monotonic()
+
+BUDGET_S = 20.0
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.loaders.mnist import synthetic_mnist_device
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+    from keystone_tpu.pipelines.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        build_featurizer,
+    )
+    from keystone_tpu.serve import serve
+    from keystone_tpu.telemetry import get_registry
+    from keystone_tpu.utils import faults
+
+    reg = get_registry()
+
+    # tiny fitted MNIST chain: one random-FFT featurizer >> block LS model
+    cfg = MnistRandomFFTConfig(num_ffts=1, block_size=512, lam=10.0)
+    feat = build_featurizer(cfg)[0]
+    x, y = synthetic_mnist_device(512, seed=7)
+    model = BlockLeastSquaresEstimator(512, num_iter=1, lam=10.0).fit(
+        feat(x), ClassLabelIndicatorsFromIntLabels(10)(y)
+    )
+    pipe = feat >> model
+    spec = jax.ShapeDtypeStruct((x.shape[1],), np.float32)
+
+    # 1+2: parity with the batch apply path, zero steady-state recompiles
+    gw = serve(pipe, item_spec=spec, shapes=(1, 4), slo_ms=10_000.0,
+               queue_depth=32, breaker_threshold=1,
+               breaker_cooldown_s=0.1)
+    size0 = gw.compile_cache_size()
+    ref = np.asarray(pipe.apply_batch(x[:8]))
+    pend = [gw.submit(np.asarray(x[i])) for i in range(8)]
+    rs = [p.result(20) for p in pend]
+    assert all(r.ok for r in rs), [r.code for r in rs]
+    got = np.stack([np.asarray(r.value) for r in rs])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    assert np.array_equal(np.argmax(got, 1), np.argmax(ref, 1))
+    assert gw.compile_cache_size() == size0, "steady-state recompile"
+    print("serve-smoke 1-2/5: gateway matches the batch apply "
+          "(8/8 argmax), zero steady-state recompiles")
+
+    # 3: bounded-queue shed with the gateway paused (deterministic burst)
+    gw.close()
+    gw = serve(pipe, item_spec=spec, shapes=(1, 4), slo_ms=10_000.0,
+               queue_depth=8, breaker_threshold=1,
+               breaker_cooldown_s=0.1, warm=False, start=False)
+    burst = [gw.submit(np.asarray(x[i])) for i in range(10)]
+    shed = [p.result(0.5) for p in burst[8:]]
+    assert all(r.code == "shed" and r.retry_after_s for r in shed), shed
+    gw.start()
+    assert all(p.result(20).ok for p in burst[:8]), "admitted work lost"
+    assert int(reg.counter_family_total("serve.shed_total")) >= 2
+    print("serve-smoke 3/5: overload shed structured (retry-after set), "
+          "admitted backlog still served")
+
+    # 4: NaN-poisoned dispatch -> breaker trip -> half-open recovery
+    trips0 = reg.get_counter("serve.sentinel_trips", model="default")
+    os.environ["KEYSTONE_FAULTS"] = "serve.dispatch@0:nan"
+    faults.reset()
+    r = gw.submit(np.asarray(x[0])).result(20)
+    os.environ.pop("KEYSTONE_FAULTS", None)
+    faults.reset()
+    assert r.code == "sentinel", r
+    assert reg.get_counter(
+        "serve.sentinel_trips", model="default") > trips0
+    assert gw.breaker_state() == "open", gw.breaker_state()
+    time.sleep(0.12)
+    assert gw.submit(np.asarray(x[1])).result(20).ok, "probe failed"
+    assert gw.breaker_state() == "closed"
+    print("serve-smoke 4/5: poisoned dispatch tripped the breaker, "
+          "half-open probe recovered it")
+
+    # 5: graceful drain — everything admitted before close() serves
+    backlog = [gw.submit(np.asarray(x[i])) for i in range(6)]
+    gw.close(drain=True)
+    drained = [p.result(5) for p in backlog]
+    assert all(r.ok for r in drained), [r.code for r in drained]
+    assert gw.submit(np.asarray(x[0])).result(1).code == "shutdown"
+    print("serve-smoke 5/5: graceful drain served 6/6, post-close "
+          "submissions get structured shutdown")
+
+    elapsed = time.monotonic() - t_start
+    print(f"serve-smoke OK in {elapsed:.1f}s")
+    assert elapsed < BUDGET_S, f"smoke took {elapsed:.1f}s (>{BUDGET_S}s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

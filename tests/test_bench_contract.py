@@ -111,6 +111,25 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     assert 0 <= full["health_heal_error_delta"] < 0.5
     assert compact["health_q"] == full["health_quarantined_total"]
     assert compact["health_esc"] == full["health_escalations_total"]
+    # serving-gateway section (PR 14): the sustained-at-SLO row holds
+    # real numbers and the saturation curve has its three points — the
+    # graceful-degradation evidence next to the throughput claim
+    assert full["serve_sustained_qps"] > 0
+    assert full["serve_p50_ms"] > 0 and full["serve_p99_ms"] > 0
+    assert 0.0 <= full["serve_shed_frac"] <= 1.0
+    assert full["serve_slo_ms"] > 0
+    curve = full["serve_saturation"]
+    assert len(curve) == 3
+    for pt in curve:
+        assert set(pt) == {"offered_qps", "qps", "p50_ms", "p99_ms",
+                           "shed_frac"}
+        assert 0.0 <= pt["shed_frac"] <= 1.0
+    # offered load sweeps upward (0.25x -> 1x -> 4x measured capacity)
+    assert curve[0]["offered_qps"] < curve[1]["offered_qps"] \
+        < curve[2]["offered_qps"]
+    assert compact["sv_qps"] == full["serve_sustained_qps"]
+    assert compact["sv_p99"] == full["serve_p99_ms"]
+    assert compact["sv_shed"] == full["serve_shed_frac"]
     # whole-pipeline-optimizer rows (core/plan.py): the flagship plan's
     # decisions landed, and the repeat plan in the same process performed
     # ZERO re-plans (the content-fingerprinted memo served it)
@@ -199,6 +218,10 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # contract — no counter may land without its budget story
     assert full.get("health_skipped") == "budget"
     assert "health_quarantined_total" not in full
+    # ... and the serving-gateway section (PR 14): same reduced-floor
+    # contract — no QPS claim may land without its budget story
+    assert full.get("serve_skipped") == "budget"
+    assert "serve_sustained_qps" not in full
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

@@ -1,0 +1,514 @@
+"""Serving gateway (keystone_tpu/serve/gateway.py): admission control,
+deadline-aware shedding, coalescing parity, the circuit breaker, cache-tier
+degradation, and the zero-recompile steady-state pin.
+
+The admission fixtures reuse the contracts C1/C4 cases (tests/test_check.py):
+the same mis-composed SIFT->vectorize->FV chain the checker rejects is
+rejected by ``serve()`` at registration time, and the C4 family (an f64
+item under the compiled f32 ladder) is rejected AT THE GATE — never
+discovered inside a donated-buffer dispatch.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import keystone_tpu._compat  # noqa: F401
+from keystone_tpu.analysis.contracts import ContractViolation
+from keystone_tpu.core.pipeline import Transformer, chain
+from keystone_tpu.serve import Gateway, ServeRejected, serve
+from keystone_tpu.serve.gateway import DEFAULT_SHAPES, _jit_apply_batch
+from keystone_tpu.telemetry import get_registry
+from keystone_tpu.utils import faults, knobs
+
+
+class Doubler(Transformer):
+    def apply(self, x):
+        return x * 2
+
+
+class AddOne(Transformer):
+    def apply(self, x):
+        return x + 1
+
+
+class PoisonOnMarker(Transformer):
+    """NaNs its whole output when any element exceeds the marker — the
+    deterministic stand-in for a numerically poisoned model (PR-13's
+    sentinel family, serving form)."""
+
+    def apply(self, x):
+        bad = jnp.max(x) > 1e9
+        return jnp.where(bad, jnp.full_like(x, jnp.nan), x * 2)
+
+
+D = 4
+
+
+def _spec(d=D, dtype=np.float32):
+    return jax.ShapeDtypeStruct((d,), dtype)
+
+
+def _item(i=0.0, d=D):
+    return np.arange(d, dtype=np.float32) + np.float32(i)
+
+
+@pytest.fixture()
+def gw():
+    """A started gateway over a tiny elementwise chain; always closed."""
+    g = serve(chain(Doubler(), AddOne()), item_spec=_spec())
+    yield g
+    g.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# admission control (the PR-10 follow-on)
+# ---------------------------------------------------------------------------
+
+def test_admission_accepts_and_serves(gw):
+    out = gw.predict(_item())
+    np.testing.assert_array_equal(np.asarray(out), _item() * 2 + 1)
+
+
+def test_admission_rejects_dtype_at_the_gate(gw):
+    # the C4 family at the gate: an f64 item under the compiled f32
+    # ladder is structured-rejected pre-dispatch, never silently cast
+    with pytest.raises(ServeRejected) as e:
+        gw.predict(_item().astype(np.float64))
+    r = e.value.response
+    assert (r.code, r.kind) == ("rejected", "dtype")
+    assert "float64" in r.error
+
+
+def test_admission_rejects_rank_and_dim(gw):
+    with pytest.raises(ServeRejected) as e:
+        gw.predict(np.zeros((D, 2), np.float32))
+    assert e.value.response.kind == "rank"
+    with pytest.raises(ServeRejected) as e:
+        gw.predict(np.zeros((D + 1,), np.float32))
+    assert e.value.response.kind == "dim"
+    # structured responses carry the code the chaos driver counts
+    assert e.value.response.code == "rejected"
+
+
+def test_admission_rejects_unknown_model(gw):
+    resp = gw.submit(_item(), model="nope").result(1)
+    assert (resp.code, resp.kind) == ("rejected", "model")
+
+
+def test_serve_rejects_c1_broken_chain(monkeypatch):
+    """The contracts C1 fixture: the mis-composed SIFT -> vectorize -> FV
+    chain (rank mismatch) is rejected by serve() at registration, with
+    the stages named — the same pass `keystone-tpu check` runs."""
+    from keystone_tpu.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.images import SIFTExtractor
+    from keystone_tpu.ops.images.fisher_vector import FisherVector
+    from keystone_tpu.ops.util import MatrixVectorizer
+
+    monkeypatch.setenv("KEYSTONE_CHECK", "0")
+    gmm = GaussianMixtureModel(
+        means=jnp.zeros((4, 16)), variances=jnp.ones((4, 16)),
+        weights=jnp.full((4,), 0.25),
+    )
+    bad = chain(SIFTExtractor(), MatrixVectorizer(), FisherVector(gmm=gmm))
+    with pytest.raises(ContractViolation) as e:
+        serve(bad, item_spec=jax.ShapeDtypeStruct((64, 64), np.float32),
+              warm=False, start=False)
+    assert "FisherVector" in str(e.value)
+
+
+def test_serve_rejects_host_stage():
+    class HostNode(Transformer):
+        jittable = False
+
+        def apply(self, x):
+            return np.asarray(x)
+
+    with pytest.raises(TypeError, match="host node"):
+        serve(chain(Doubler(), HostNode()), item_spec=_spec(),
+              warm=False, start=False)
+
+
+def test_item_spec_required_without_contract():
+    with pytest.raises(ValueError, match="item spec"):
+        serve(chain(Doubler()), warm=False, start=False)
+
+
+# ---------------------------------------------------------------------------
+# coalescing + dispatch parity
+# ---------------------------------------------------------------------------
+
+def test_coalesced_burst_bit_parity_vs_unbatched(gw):
+    """A burst coalesced through the padded shape ladder returns, for
+    every item, EXACTLY what the unbatched apply returns — padding rows
+    never leak into real rows."""
+    items = [_item(i) for i in range(20)]  # 20 -> one padded 32-rung
+    pend = [gw.submit(x) for x in items]
+    rs = [p.result(10) for p in pend]
+    assert all(r.ok for r in rs), [r.code for r in rs]
+    pipe = chain(Doubler(), AddOne())
+    for x, r in zip(items, rs):
+        np.testing.assert_array_equal(
+            np.asarray(r.value), np.asarray(pipe.serve(jnp.asarray(x)))
+        )
+        assert r.latency_ms is not None and r.latency_ms >= 0
+
+
+def test_single_item_equals_batch_row():
+    """Rung-1 single dispatch vs a row of a coalesced padded dispatch:
+    identical results on a matmul-bearing chain (allclose; the reduction
+    geometry per row is the same program)."""
+    w = np.asarray(
+        np.random.default_rng(3).normal(size=(D, 8)), np.float32
+    )
+    mat = Transformer.from_fn(lambda x: x @ jnp.asarray(w))
+    g = serve(chain(mat), item_spec=_spec())
+    try:
+        single = np.asarray(g.predict(_item(1.0)))
+        pend = [g.submit(_item(i)) for i in [0.0, 1.0, 2.0]]
+        rows = [np.asarray(p.result(10).value) for p in pend]
+        np.testing.assert_allclose(rows[1], single, rtol=1e-6)
+    finally:
+        g.close(drain=False)
+
+
+def test_zero_recompile_steady_state():
+    """The zero-recompile pin: after warmup, serving any burst size holds
+    the shared dispatch compile cache CONSTANT.  SLO effectively off: in
+    a contended suite process a cold first dispatch can push the 5 s p99
+    window over the default 50 ms SLO and legitimately shed — this test
+    pins recompiles, not shedding (test_p99_over_slo_sheds_new_arrivals
+    covers the shed signal)."""
+    g = serve(chain(Doubler(), AddOne()), item_spec=_spec(),
+              slo_ms=10_000.0)
+    try:
+        size0 = g.compile_cache_size()
+        for burst in (1, 3, 20, 32):
+            pend = [g.submit(_item(i)) for i in range(burst)]
+            assert all(p.result(10).ok for p in pend)
+        assert g.compile_cache_size() == size0
+        assert _jit_apply_batch._cache_size() == size0
+    finally:
+        g.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware shedding + overload
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_is_shed():
+    g = serve(chain(Doubler()), item_spec=_spec(), start=False)
+    try:
+        p = g.submit(_item(), deadline_ms=0.0)
+        time.sleep(0.01)  # the deadline passes while queued
+        g.start()
+        r = p.result(10)
+        assert r.code == "deadline", r
+        assert get_registry().get_counter(
+            "serve.shed_total", reason="deadline") >= 1
+    finally:
+        g.close(drain=False)
+
+
+def test_unmeetable_deadline_is_shed_pre_dispatch(gw):
+    # per-shape estimate is recorded by warmup; a 1000x tighter deadline
+    # is provably unmeetable and dropped before wasting device time
+    est = gw._estimate_ms(gw.default_model, 1)
+    assert est > 0
+    r = gw.submit(_item(), deadline_ms=est / 1000.0).result(10)
+    assert r.code == "deadline"
+    assert "deadline" in r.error
+
+
+def test_queue_depth_shed_with_retry_after():
+    g = serve(chain(Doubler()), item_spec=_spec(), queue_depth=4,
+              start=False)
+    try:
+        pend = [g.submit(_item(i)) for i in range(6)]
+        shed = [p.result(0.1) for p in pend[4:]]
+        assert all(r.code == "shed" for r in shed), [r.code for r in shed]
+        assert all(r.retry_after_s and r.retry_after_s > 0 for r in shed)
+        g.start()
+        served = [p.result(10) for p in pend[:4]]
+        assert all(r.ok for r in served)
+    finally:
+        g.close(drain=False)
+
+
+def test_p99_over_slo_sheds_new_arrivals():
+    g = serve(chain(Doubler()), item_spec=_spec(), slo_ms=50.0,
+              start=False)
+    try:
+        g.submit(_item())           # one queued
+        g._p99_ms = 500.0           # observed p99 10x over the SLO
+        r = g.submit(_item()).result(0.1)
+        assert r.code == "shed"
+        assert "SLO" in r.error
+        assert r.retry_after_s >= 0.05
+    finally:
+        g.close(drain=False)
+
+
+def test_close_drain_false_sheds_backlog_structured():
+    g = serve(chain(Doubler()), item_spec=_spec(), start=False)
+    pend = [g.submit(_item(i)) for i in range(3)]
+    g.close(drain=False)
+    rs = [p.result(1) for p in pend]
+    assert all(r.code == "shutdown" for r in rs)
+    # post-close submissions get a structured shutdown response too
+    assert g.submit(_item()).result(1).code == "shutdown"
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (PR-13 health sentinels, serving form)
+# ---------------------------------------------------------------------------
+
+def _poison_gateway(**kw):
+    kw.setdefault("breaker_threshold", 2)
+    kw.setdefault("breaker_cooldown_s", 0.05)
+    return serve(chain(PoisonOnMarker()), item_spec=_spec(), **kw)
+
+
+POISON = np.full((D,), 2e9, np.float32)
+
+
+def test_sentinel_trips_on_nan_output():
+    g = _poison_gateway()
+    try:
+        r = g.submit(POISON).result(10)
+        assert r.code == "sentinel"
+        assert "non-finite" in r.error
+        assert g.breaker_state() == "closed"  # one trip, threshold 2
+        # a healthy dispatch resets the consecutive-trip count
+        assert g.submit(_item()).result(10).ok
+    finally:
+        g.close(drain=False)
+
+
+def test_breaker_open_half_open_close_roundtrip():
+    g = _poison_gateway()
+    reg = get_registry()
+    try:
+        # two CONSECUTIVE sentinel trips open the breaker
+        for _ in range(2):
+            assert g.submit(POISON).result(10).code == "sentinel"
+        assert g.breaker_state() == "open"
+        assert reg.get_gauge(
+            "serve.breaker_state", model=g.default_model) == 1.0
+        # open = fail fast with retry_after, no dispatch
+        r = g.submit(_item()).result(1)
+        assert r.code == "breaker_open"
+        assert r.retry_after_s is not None
+        # after the cooldown the next request is the half-open probe;
+        # it serves healthy and CLOSES the breaker
+        time.sleep(0.06)
+        r = g.submit(_item()).result(10)
+        assert r.ok, r
+        assert g.breaker_state() == "closed"
+        assert reg.get_gauge(
+            "serve.breaker_state", model=g.default_model) == 0.0
+        assert g.submit(_item()).result(10).ok
+    finally:
+        g.close(drain=False)
+
+
+def test_failed_probe_reopens_breaker():
+    g = _poison_gateway()
+    try:
+        for _ in range(2):
+            g.submit(POISON).result(10)
+        assert g.breaker_state() == "open"
+        time.sleep(0.06)
+        # the probe itself is poisoned -> straight back to open
+        assert g.submit(POISON).result(10).code == "sentinel"
+        assert g.breaker_state() == "open"
+        # ... and a later healthy probe still recovers it
+        time.sleep(0.06)
+        assert g.submit(_item()).result(10).ok
+        assert g.breaker_state() == "closed"
+    finally:
+        g.close(drain=False)
+
+
+def test_breaker_disabled_never_opens():
+    g = _poison_gateway(breaker_threshold=0)
+    try:
+        for _ in range(4):
+            assert g.submit(POISON).result(10).code == "sentinel"
+        assert g.breaker_state() == "closed"
+        assert g.submit(_item()).result(10).ok
+    finally:
+        g.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder: cache tiers + ladder shrink
+# ---------------------------------------------------------------------------
+
+def test_overload_demotes_cold_models_tiny_budget(monkeypatch):
+    """Under a tiny KEYSTONE_CACHE_*_MB budget, queue-pressure sheds
+    demote COLD models' pool entries to the host tier; the hot model
+    stays device-resident, and a later request to the demoted model
+    still serves (lookup promotes it back — the PR-1 tier mechanics)."""
+    from keystone_tpu.core.cache import _DEVICE, _HOST
+
+    monkeypatch.setenv("KEYSTONE_CACHE_DEVICE_MB", "1")
+    monkeypatch.setenv("KEYSTONE_CACHE_HOST_MB", "64")
+    g = serve(chain(Doubler()), item_spec=_spec(), name="hot",
+              queue_depth=2, start=False)
+    try:
+        g.add_model("cold", chain(AddOne()), item_spec=_spec())
+        tiers = {n: g._pool._entries[g._pool_key(n)].tier
+                 for n in ("hot", "cold")}
+        assert tiers == {"hot": _DEVICE, "cold": _DEVICE}
+        # overflow the bounded queue with hot-model requests: the shed
+        # path demotes every model but the hot one
+        backlog = [g.submit(_item(i), model="hot") for i in range(3)]
+        assert g._pool._entries[g._pool_key("cold")].tier == _HOST
+        assert g._pool._entries[g._pool_key("hot")].tier == _DEVICE
+        assert get_registry().get_counter("serve.model_demotions") >= 1
+        g.start()
+        for p in backlog:  # drain the hot backlog before the cold request
+            p.result(10)
+        # the demoted model still serves: lookup promotes it back
+        out = g.predict(_item(), model="cold")
+        np.testing.assert_array_equal(np.asarray(out), _item() + 1)
+    finally:
+        g.close(drain=False)
+
+
+def test_oom_retry_hook_shrinks_ladder_and_demotes():
+    g = serve(chain(Doubler()), item_spec=_spec(), name="hot",
+              start=False)
+    try:
+        g.add_model("cold", chain(AddOne()), item_spec=_spec())
+        reg = get_registry()
+        deg0 = reg.get_counter("serve.degraded")
+        assert g._ladder == DEFAULT_SHAPES
+        g._on_dispatch_retry(
+            1, RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        )
+        assert g._ladder == DEFAULT_SHAPES[:-1]  # largest rung dropped
+        assert reg.get_counter("serve.degraded") == deg0 + 1
+        from keystone_tpu.core.cache import _HOST
+
+        assert g._pool._entries[g._pool_key("cold")].tier == _HOST
+        # a non-OOM error does NOT degrade
+        g._on_dispatch_retry(1, RuntimeError("INTERNAL: transient"))
+        assert g._ladder == DEFAULT_SHAPES[:-1]
+        # the floor: the ladder never shrinks below one rung
+        for _ in range(4):
+            g._on_dispatch_retry(
+                1, RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            )
+        assert g._ladder == DEFAULT_SHAPES[:1]
+    finally:
+        g.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# chaos sites (KEYSTONE_FAULTS serve.admit / serve.dispatch / serve.respond)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    faults.reset()
+    yield monkeypatch
+    monkeypatch.delenv("KEYSTONE_FAULTS", raising=False)
+    faults.reset()
+
+
+def test_injected_admit_fault_is_structured(clean_faults, gw):
+    clean_faults.setenv("KEYSTONE_FAULTS", "serve.admit@0:xla")
+    r = gw.submit(_item()).result(5)
+    assert r.code == "error"
+    assert "injected fault" in r.error
+    # the next request (occurrence past the plan) serves normally
+    assert gw.submit(_item()).result(10).ok
+
+
+def test_injected_dispatch_fault_is_retried(clean_faults, gw):
+    reg = get_registry()
+    a0 = reg.get_counter("retry.attempt")
+    clean_faults.setenv("KEYSTONE_FAULTS", "serve.dispatch@0:xla")
+    r = gw.submit(_item()).result(15)
+    assert r.ok, r  # the retry loop absorbed the transient fault
+    assert reg.get_counter("retry.attempt") > a0
+
+
+def test_injected_dispatch_nan_trips_sentinel(clean_faults):
+    g = _poison_gateway()
+    try:
+        clean_faults.setenv("KEYSTONE_FAULTS", "serve.dispatch@0:nan")
+        r = g.submit(_item()).result(10)  # a HEALTHY item, poisoned batch
+        assert r.code == "sentinel"
+        assert get_registry().get_counter(
+            "serve.sentinel_trips", model=g.default_model) >= 1
+    finally:
+        g.close(drain=False)
+
+
+def test_injected_respond_fault_is_structured(clean_faults, gw):
+    clean_faults.setenv("KEYSTONE_FAULTS", "serve.respond@0:xla")
+    r = gw.submit(_item()).result(10)
+    assert r.code == "error"
+    assert "respond failure" in r.error
+    assert gw.submit(_item()).result(10).ok
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def test_serve_shapes_knob_parses_and_validates(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_SERVE_SHAPES", "16, 2,2, 4")
+    assert knobs.get("KEYSTONE_SERVE_SHAPES") == (2, 4, 16)
+    monkeypatch.setenv("KEYSTONE_SERVE_SHAPES", "8,frogs")
+    with pytest.raises(ValueError, match="KEYSTONE_SERVE_SHAPES"):
+        knobs.get("KEYSTONE_SERVE_SHAPES")
+    monkeypatch.setenv("KEYSTONE_SERVE_SHAPES", "0,4")
+    with pytest.raises(ValueError, match="positive"):
+        knobs.validate_environment()
+
+
+def test_gateway_honors_shape_ladder_knob(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_SERVE_SHAPES", "2,4")
+    g = serve(chain(Doubler()), item_spec=_spec(), start=False,
+              warm=False)
+    try:
+        assert g._ladder == (2, 4)
+        assert g._pick_shape(1) == 2
+        assert g._pick_shape(3) == 4
+        assert g._pick_shape(9) == 4  # above the ladder: chunked at max
+    finally:
+        g.close(drain=False)
+
+
+def test_serve_knobs_validated(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_SERVE_SLO_MS", "-1")
+    with pytest.raises(ValueError, match="KEYSTONE_SERVE_SLO_MS"):
+        knobs.validate_environment()
+    monkeypatch.setenv("KEYSTONE_SERVE_SLO_MS", "25")
+    monkeypatch.setenv("KEYSTONE_SERVE_QUEUE_DEPTH", "7")
+    g = serve(chain(Doubler()), item_spec=_spec(), start=False,
+              warm=False)
+    try:
+        assert g.slo_ms == 25.0 and g.queue_depth == 7
+    finally:
+        g.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# stats surface
+# ---------------------------------------------------------------------------
+
+def test_stats_surface(gw):
+    assert gw.predict(_item()) is not None
+    s = gw.stats()
+    assert s["queue_bound"] == gw.queue_depth
+    assert s["ladder"] == list(DEFAULT_SHAPES)
+    assert s["breakers"] == {"default": "closed"}
+    assert s["p50_ms"] >= 0.0
