@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke ingest-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -75,6 +75,16 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/health_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/ingest_smoke.py
+
+# Streaming-ingest contract (<20 s): overlap-on <= overlap-off on a
+# calibrated progressive-JPEG tar set, the ring bounds live decoded
+# batches (gauge pin) with every buffer recycled, native-vs-fallback
+# parity, an injected bad JPEG costing one image not the stream, and a
+# worker death whose archive the survivors re-run (scripts/
+# ingest_smoke.py).
+ingest-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/ingest_smoke.py
 
 # Numerical-health contract (<20 s): KEYSTONE_HEALTH=0 byte-identical to
 # the prior program, sentinel trips on an injected NaN block, on-device

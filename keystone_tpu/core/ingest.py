@@ -1,0 +1,602 @@
+"""Bounded-memory streaming out-of-core ingest: parallel tar/JPEG decode
+into a fixed ring of reusable host batch buffers.
+
+The loaders layer was the last layer of the rebuild that treated datasets
+as in-core arrays: ``PrefetchImageLoader`` decodes through a synchronous
+generator and every flagship fit assumed the raw images fit in host RAM.
+This module makes "dataset larger than host RAM" a supported scenario the
+same way the solvers made "matrix larger than HBM" one — by streaming
+through a fixed-size working set:
+
+    tar archives ──► decode workers (``KEYSTONE_INGEST_THREADS``)
+                 ──► ring of ``KEYSTONE_INGEST_BUFFERS`` reusable host
+                     batch buffers (allocated ONCE, recycled — never a
+                     per-batch ``np.empty``)
+                 ──► single-threaded consumer ──► device transfer /
+                     extraction (``stream_batches`` +
+                     ``core/prefetch.py``)
+
+Memory bound: decode workers BLOCK on a free ring buffer, so the number of
+simultaneously-live decoded batches can never exceed the ring size — peak
+decoded host memory is ``buffers × batch_size × frame bytes`` regardless
+of dataset size (the ``ingest.buffers_live`` gauge pins it).
+
+Dispatch invariant: workers touch ONLY host memory (tar read, libjpeg
+decode, frame write into their claimed slot). ALL device dispatch happens
+on the consuming thread through :func:`stream_batches`'s ``prefetch_map``
+double buffer, so the host→device transfer of batch *t+1* hides behind the
+extraction of batch *t* while the one-global-enqueue-order deadlock
+invariant of ``core/prefetch.py`` stands untouched.
+
+Fault surface (``KEYSTONE_FAULTS``, ``utils/faults.py``): ``ingest.decode``
+(a fired fault IS a bad JPEG — warn + skip the image), ``ingest.tar`` (a
+fired fault IS a truncated archive — warn + move to the next tar), and
+``ingest.worker`` (kills that decode worker; the pool degrades to the
+survivors and the stream completes — never a wedge).
+
+Telemetry: ``ingest.bytes`` (decoded RGB bytes), ``ingest.decode_s``
+(cumulative worker tar-read+decode seconds), ``ingest.queue_depth`` /
+``ingest.buffers_live`` (+ ``_peak``) gauges, ``ingest.stall_s`` (consumer
+seconds blocked on an empty ready queue — extract-bound when ~0,
+decode-bound when large), ``ingest.batches`` / ``ingest.images`` /
+``ingest.bad_images`` / ``ingest.tar_errors`` / ``ingest.worker_deaths`` /
+``ingest.worker_respawns`` counters, and an ``ingest.batch`` span per
+consumed batch under tracing.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import (
+    Any, Callable, ClassVar, Iterator, List, Optional, Sequence, Tuple,
+)
+
+import flax.struct as struct
+import numpy as np
+
+from keystone_tpu.core.pipeline import FunctionNode
+from keystone_tpu.utils import knobs
+from keystone_tpu.utils.logging import get_logger
+
+logger = get_logger("keystone_tpu.core.ingest")
+
+
+def ingest_buffers(default: Optional[int] = None) -> int:
+    """Effective ring size from ``KEYSTONE_INGEST_BUFFERS``."""
+    return knobs.get("KEYSTONE_INGEST_BUFFERS", default=default)
+
+
+def ingest_threads(default: Optional[int] = None) -> int:
+    """Effective decode worker count from ``KEYSTONE_INGEST_THREADS``."""
+    return knobs.get("KEYSTONE_INGEST_THREADS", default=default)
+
+
+def frame_into(img: np.ndarray, out: np.ndarray) -> None:
+    """Center crop/pad ``img`` (h, w, 3 uint8) into the fixed float32 [0,1]
+    frame ``out`` (H, W, 3) IN PLACE — the slot-write form of the loaders'
+    ``_center_frame`` (no per-image allocation; the slot is a view into a
+    recycled ring buffer, so the pad region must be re-zeroed every fill)."""
+    th, tw = out.shape[:2]
+    h, w = img.shape[:2]
+    out[:] = 0.0
+    ch, cw = min(h, th), min(w, tw)
+    sy, sx = (h - ch) // 2, (w - cw) // 2
+    dy, dx = (th - ch) // 2, (tw - cw) // 2
+    # divide by a float64 255.0 exactly as ``_center_frame`` does (compute
+    # in f64, round on store) so the two paths stay bit-identical; the
+    # buffered ufunc still writes straight into the slot
+    np.divide(
+        img[sy : sy + ch, sx : sx + cw, :3], 255.0,
+        out=out[dy : dy + ch, dx : dx + cw],
+    )
+
+
+class HostBufferRing:
+    """Fixed pool of reusable ``(batch_size, H, W, 3)`` float32 host batch
+    buffers. ``acquire`` blocks until a buffer is free (this blocking IS the
+    memory bound); ``release`` recycles. The ``ingest.buffers_live`` gauge
+    tracks leases and ``ingest.buffers_live_peak`` its high-water mark —
+    the testable form of "``KEYSTONE_INGEST_BUFFERS`` bounds live decoded
+    batches"."""
+
+    def __init__(self, num_buffers: int, batch_shape: Tuple[int, ...],
+                 dtype=np.float32):
+        if num_buffers < 1:
+            raise ValueError(f"need >= 1 buffer, got {num_buffers}")
+        self.num_buffers = int(num_buffers)
+        self._bufs = [np.empty(batch_shape, dtype) for _ in range(num_buffers)]
+        self._free: queue_mod.Queue = queue_mod.Queue()
+        for i in range(num_buffers):
+            self._free.put(i)
+        self._lock = threading.Lock()
+        self._live = 0
+        self.live_peak = 0
+
+    @property
+    def nbytes(self) -> int:
+        """Total bytes of the ring — the peak decoded-batch host footprint."""
+        return sum(b.nbytes for b in self._bufs)
+
+    def buffer(self, idx: int) -> np.ndarray:
+        return self._bufs[idx]
+
+    def try_acquire(self, timeout: float = 0.1) -> Optional[int]:
+        """Next free buffer index, or None if none is recycled within
+        ``timeout`` — the polling primitive under :meth:`acquire` and the
+        claim loop (which must interleave ring waits with re-checking the
+        shared current batch)."""
+        from keystone_tpu.telemetry import get_registry
+
+        try:
+            idx = self._free.get(timeout=timeout)
+        except queue_mod.Empty:
+            return None
+        reg = get_registry()
+        with self._lock:
+            self._live += 1
+            self.live_peak = max(self.live_peak, self._live)
+            reg.set_gauge("ingest.buffers_live", self._live)
+            reg.set_gauge("ingest.buffers_live_peak", self.live_peak)
+        return idx
+
+    def acquire(self, stop: Optional[threading.Event] = None,
+                poll_s: float = 0.1) -> Optional[int]:
+        """Next free buffer index; blocks (polling ``stop``) until one is
+        recycled. None when ``stop`` fires first — the abandoned-consumer
+        exit path, so workers never wedge on a ring nobody drains."""
+        while True:
+            idx = self.try_acquire(timeout=poll_s)
+            if idx is not None:
+                return idx
+            if stop is not None and stop.is_set():
+                return None
+
+    def release(self, idx: int) -> None:
+        from keystone_tpu.telemetry import get_registry
+
+        with self._lock:
+            self._live -= 1
+            get_registry().set_gauge("ingest.buffers_live", self._live)
+        self._free.put(idx)
+
+
+@dataclass
+class IngestBatch:
+    """One decoded batch leased from the ring. ``images`` is the FULL
+    fixed-shape ``(batch_size, H, W, 3)`` buffer (steady-state consumers
+    compile exactly once); only the first ``n_valid`` rows are real data —
+    the final partial batch's tail is zeroed. ``release()`` recycles the
+    buffer; :meth:`StreamingTarIngest.batches` auto-releases on the next
+    pull as a wedge-proofing net, but overlapped consumers should release
+    as soon as the host copy is consumed (``stream_batches`` does)."""
+
+    index: int
+    images: np.ndarray
+    names: List[str]
+    n_valid: int
+    _ring: HostBufferRing = field(repr=False)
+    _buf_idx: int = field(repr=False, default=-1)
+    _released: bool = field(repr=False, default=False)
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._ring.release(self._buf_idx)
+
+
+class StreamingTarIngest:
+    """Parallel tar/JPEG decode of ``tar_paths`` into fixed
+    ``(target_h, target_w)`` frames, batched through the host buffer ring
+    (module docstring). One instance = one pass over the archives;
+    construct a fresh one per pass (instances are cheap — the ring is the
+    only allocation, and it is per-pass state)."""
+
+    def __init__(
+        self,
+        tar_paths: Sequence[str],
+        target_hw: Tuple[int, int],
+        batch_size: int,
+        num_threads: Optional[int] = None,
+        num_buffers: Optional[int] = None,
+        min_hw: int = 36,
+    ):
+        if not tar_paths:
+            raise ValueError("need at least one tar archive")
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self.tar_paths = list(tar_paths)
+        self.target_hw = (int(target_hw[0]), int(target_hw[1]))
+        self.batch_size = int(batch_size)
+        self.num_threads = ingest_threads(num_threads)
+        self.num_buffers = ingest_buffers(num_buffers)
+        self.min_hw = min_hw
+        self.ring = HostBufferRing(
+            self.num_buffers,
+            (self.batch_size, self.target_hw[0], self.target_hw[1], 3),
+        )
+
+    # -- worker side (host memory only; no jax) ---------------------------
+
+    def _claim_slot(self, state) -> Optional[Tuple[int, int]]:
+        """(buffer index, slot) for the next image, in global claim order.
+        Acquires a fresh ring buffer when the current one is exhausted.
+        Blocking on the ring is the backpressure that bounds live decoded
+        batches — but it must happen OUTSIDE the claim lock: a sealed
+        buffer only reaches the ready queue once every claimant's
+        ``_finish_fill`` has run, and ``_finish_fill`` needs the claim
+        lock, so blocking while holding it could wedge the very flush the
+        consumer must see before it can recycle a buffer for us."""
+        while True:
+            with state["claim_lock"]:
+                cur = state["cur"]
+                if cur is not None:
+                    slot = cur["claims"]
+                    cur["claims"] += 1
+                    if cur["claims"] == self.batch_size:
+                        cur["sealed"] = True
+                        state["cur"] = None
+                    return cur["buf"], slot, cur
+            # No current buffer: POLL the ring lock-free, then install.
+            # The wait must be a poll, not a blocking acquire — while this
+            # worker sleeps, a peer may win the freed buffer, install it
+            # as the shared current batch, and exit with slots to spare:
+            # the free queue would then stay empty forever while the slot
+            # this worker needs sits in ``cur`` (re-checked every lap).
+            idx = self.ring.try_acquire(timeout=0.05)
+            if idx is None:
+                if state["stop"].is_set():
+                    return None  # abandoned consumer: unwind, don't wedge
+                continue
+            with state["claim_lock"]:
+                if state["cur"] is None:
+                    state["cur"] = {
+                        "buf": idx, "claims": 0, "fills": 0, "sealed": False,
+                        "names": [None] * self.batch_size,
+                    }
+                else:  # another worker installed first: recycle ours
+                    self.ring.release(idx)
+
+    def _finish_fill(self, state, cur) -> None:
+        """Count a completed slot write; flush the batch when it is the
+        last fill of a sealed buffer."""
+        with state["claim_lock"]:
+            cur["fills"] += 1
+            if cur["sealed"] and cur["fills"] == cur["claims"]:
+                self._flush(state, cur)
+
+    def _flush(self, state, cur) -> None:
+        """Push a sealed, fully-filled buffer to the ready queue (caller
+        holds the claim lock). Zero any unclaimed tail frames first — the
+        recycled buffer holds a previous batch's pixels there."""
+        n = cur["claims"]
+        if n < self.batch_size:
+            self.ring.buffer(cur["buf"])[n:] = 0.0
+        state["ready"].put(
+            ("batch", cur["buf"], n, [s or "" for s in cur["names"][:n]])
+        )
+
+    def _decode_entry(self, name: str, data: bytes) -> Optional[np.ndarray]:
+        from keystone_tpu.native.ingest import decode_jpeg
+        from keystone_tpu.telemetry import get_registry
+        from keystone_tpu.utils import faults
+
+        reg = get_registry()
+        try:
+            faults.check("ingest.decode")
+            img = decode_jpeg(data)
+        except Exception as e:
+            logger.warning("ingest: undecodable entry %s: %s", name, e)
+            img = None
+        if img is None:
+            reg.inc("ingest.bad_images")
+            return None
+        if img.shape[0] < self.min_hw or img.shape[1] < self.min_hw:
+            return None  # reference rejects tiny images (ImageUtils.scala)
+        return img
+
+    def _worker(self, state) -> None:
+        from keystone_tpu.native.ingest import iter_tar_entries
+        from keystone_tpu.telemetry import get_registry
+        from keystone_tpu.utils import faults
+
+        reg = get_registry()
+        i = None
+        try:
+            while not state["stop"].is_set():
+                i = None
+                with state["tar_lock"]:
+                    if state["pending_tars"]:
+                        i = state["pending_tars"].popleft()
+                if i is None:
+                    break
+                # a fired ingest.worker fault kills THIS worker (caught by
+                # the outer except; the pool degrades to the survivors, and
+                # the in-flight archive is RE-QUEUED for them — the Spark
+                # task-re-execution analog, so a worker death loses no
+                # data) — checked at the tar boundary so no claimed slot
+                # leaks
+                faults.check("ingest.worker")
+                path = self.tar_paths[i]
+                try:
+                    faults.check("ingest.tar")
+                    entries = iter_tar_entries(path)
+                    while True:
+                        t0 = time.perf_counter()
+                        try:
+                            faults.check("ingest.tar")
+                            name, data = next(entries)
+                        except StopIteration:
+                            break
+                        img = self._decode_entry(name, data)
+                        dt = time.perf_counter() - t0
+                        reg.inc("ingest.decode_s", dt)
+                        if img is None:
+                            continue
+                        reg.inc("ingest.bytes", img.nbytes)
+                        claim = self._claim_slot(state)
+                        if claim is None:
+                            return  # consumer gone
+                        buf_idx, slot, cur = claim
+                        try:
+                            frame_into(img, self.ring.buffer(buf_idx)[slot])
+                            cur["names"][slot] = name
+                        except Exception:
+                            # never leak a claimed slot: a failed frame
+                            # write counts as a zeroed fill, not a wedge
+                            self.ring.buffer(buf_idx)[slot] = 0.0
+                            reg.inc("ingest.bad_images")
+                        finally:
+                            self._finish_fill(state, cur)
+                        if state["stop"].is_set():
+                            return
+                except Exception as e:
+                    # one truncated/bad tar must not stop this worker's
+                    # remaining archives (the ingest.tar fault fires here)
+                    reg.inc("ingest.tar_errors")
+                    logger.warning("ingest: tar %s failed: %s", path, e)
+                i = None  # completed (or charged to tar_errors): don't requeue
+        except BaseException as e:
+            reg.inc("ingest.worker_deaths")
+            logger.warning("ingest: worker died: %s", e)
+            if i is not None:  # in-flight archive goes back to the pool
+                with state["tar_lock"]:
+                    state["pending_tars"].append(i)
+        finally:
+            with state["tar_lock"]:
+                work_left = bool(state["pending_tars"])
+            respawn = False
+            with state["claim_lock"]:
+                state["live_workers"] -= 1
+                last = state["live_workers"] == 0
+                if (last and work_left and not state["stop"].is_set()
+                        and state["respawns"] < state["respawn_cap"]):
+                    # the LAST worker died with archives still pending: a
+                    # clean exit here would end the stream with data
+                    # silently missing. Spawn a replacement instead (the
+                    # bounded cap keeps a deterministically-crashing pool
+                    # from respawning forever — past it, the done sentinel
+                    # ships and the worker_deaths counter is the evidence).
+                    state["respawns"] += 1
+                    state["live_workers"] += 1
+                    last = False
+                    respawn = True
+                if last:
+                    # all fills are complete once the last worker exits:
+                    # seal + flush the partial current buffer, then wake
+                    # the consumer
+                    cur = state["cur"]
+                    if cur is not None and cur["claims"] > 0:
+                        cur["sealed"] = True
+                        state["cur"] = None
+                        self._flush(state, cur)
+            if respawn:
+                reg.inc("ingest.worker_respawns")
+                t = threading.Thread(
+                    target=self._worker, args=(state,), daemon=True
+                )
+                state["threads"].append(t)
+                t.start()
+            if last:
+                state["ready"].put(("done",))
+
+    # -- consumer side (the ONLY side that may touch jax) -----------------
+
+    def batches(self) -> Iterator[IngestBatch]:
+        """Yield :class:`IngestBatch` leases as decode completes. The
+        previous batch is auto-released on the next pull if the consumer
+        has not released it already (one-lease steady state); release
+        earlier for deeper pipelining. Abandoning the generator (early
+        ``break``) stops the workers and recycles every lease — no thread
+        or buffer leaks."""
+        from keystone_tpu.telemetry import get_registry, get_tracer
+
+        reg = get_registry()
+        from collections import deque
+
+        state = {
+            "stop": threading.Event(),
+            "tar_lock": threading.Lock(),
+            "claim_lock": threading.Lock(),
+            "pending_tars": deque(range(len(self.tar_paths))),
+            "cur": None,
+            "ready": queue_mod.Queue(),
+            "live_workers": self.num_threads,
+            # last-worker-death replacement budget: generous enough to
+            # survive one death per archive plus slack, finite so a
+            # deterministic crash cannot respawn forever
+            "respawns": 0,
+            "respawn_cap": 4 + 2 * len(self.tar_paths),
+        }
+        threads = [
+            threading.Thread(target=self._worker, args=(state,), daemon=True)
+            for _ in range(self.num_threads)
+        ]
+        state["threads"] = threads
+        self._last_state = state  # observability hook (tests poll it)
+        for t in threads:
+            t.start()
+        prev: Optional[IngestBatch] = None
+        index = 0
+        try:
+            while True:
+                reg.set_gauge("ingest.queue_depth", state["ready"].qsize())
+                t0 = time.perf_counter()
+                try:
+                    item = state["ready"].get(block=False)
+                    reg.inc("ingest.ready")
+                except queue_mod.Empty:
+                    item = state["ready"].get()
+                    reg.inc("ingest.stalls")
+                    reg.inc("ingest.stall_s", time.perf_counter() - t0)
+                if item[0] == "done":
+                    break
+                _, buf_idx, n, names = item
+                if prev is not None:
+                    prev.release()  # wedge-proofing net (no-op if released)
+                batch = IngestBatch(
+                    index=index, images=self.ring.buffer(buf_idx),
+                    names=names, n_valid=n, _ring=self.ring,
+                    _buf_idx=buf_idx,
+                )
+                prev = batch
+                index += 1
+                reg.inc("ingest.batches")
+                reg.inc("ingest.images", n)
+                with get_tracer().span("ingest.batch", sync=False,
+                                       n_valid=n, buf=buf_idx):
+                    yield batch
+        finally:
+            state["stop"].set()
+            if prev is not None:
+                prev.release()
+            # drain so workers blocked on the ring can observe stop and
+            # sentinels can land, then join
+            deadline = time.monotonic() + 10.0
+            while any(t.is_alive() for t in threads):
+                try:
+                    item = state["ready"].get(timeout=0.05)
+                    if item[0] == "batch":
+                        self.ring.release(item[1])
+                except queue_mod.Empty:
+                    pass
+                if time.monotonic() > deadline:
+                    break
+            for t in threads:
+                t.join(timeout=5.0)
+            # workers may already have been GONE at abandon time with
+            # flushed batches still queued — their leases must recycle too
+            # (every-lease-recycled contract, buffers_live gauge pin)
+            while True:
+                try:
+                    item = state["ready"].get(block=False)
+                except queue_mod.Empty:
+                    break
+                if item[0] == "batch":
+                    self.ring.release(item[1])
+
+
+def stream_batches(
+    ingest: StreamingTarIngest,
+    to_device: Optional[Callable[[np.ndarray], Any]] = None,
+    depth: Optional[int] = None,
+) -> Iterator[Tuple[Any, List[str], int]]:
+    """The overlapped device feed: yields ``(device_images, names,
+    n_valid)`` with batch *t+1*'s host→device transfer already dispatched
+    (``prefetch_map`` run-ahead, streaming-safe windowed form) while the
+    consumer's extraction ops for batch *t* execute. Recycling a ring
+    slot while its device twin still references it would corrupt
+    already-yielded batches, so the default transfer is ``jnp.array``
+    (copy=True) — NOT ``asarray``/``device_put``, which PJRT
+    **zero-copies** for 64-byte-aligned host buffers on CPU-family
+    backends (measured on this jax: the device array aliases the slot;
+    pinned by a mutate-after-transfer test) — and the slot is released
+    only once the transfer COMPLETES (``block_until_ready``: a TPU DMA
+    may still be reading the buffer when dispatch returns). A custom
+    ``to_device`` must likewise return an array that does not alias its
+    input once ready (an H2D ``device_put`` onto an accelerator
+    qualifies; a host-backend ``device_put`` does NOT). Run-ahead depth
+    therefore never multiplies host memory, and the completion wait runs
+    during the run-ahead window, while the PREVIOUS batch's extraction
+    executes on device.
+
+    All transfers dispatch on the calling thread — the single-threaded
+    dispatch order the ``core/prefetch.py`` deadlock invariant requires.
+
+    ``device_images`` always has the FULL fixed ``(batch_size, H, W, 3)``
+    shape (zero-padded final batch): per-batch jitted consumers compile
+    exactly once — slice their OUTPUT by ``n_valid``, not the input.
+    """
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.prefetch import prefetch_map
+
+    put = to_device if to_device is not None else jnp.array
+
+    def transfer(batch: IngestBatch):
+        arr = put(batch.images)
+        ready = getattr(arr, "block_until_ready", None)
+        if ready is not None:  # custom to_device may return host arrays
+            ready()
+        names, n = batch.names, batch.n_valid
+        batch.release()  # transfer complete: recycle the ring buffer
+        return arr, names, n
+
+    yield from prefetch_map(transfer, ingest.batches(), depth=depth)
+
+
+class TarIngestNode(FunctionNode):
+    """Streaming ingest as a HOST pipeline stage the planner and checker
+    can see (``core/plan.py`` treats host nodes as materialization
+    boundaries; this node's declared C5 ``__contract__`` transfer covers
+    the data-dependent batch shape ``jax.eval_shape`` cannot).
+
+    The declared output is ONE ring batch — ``(batch_size, H, W, 3)``
+    float32 — which is exactly the stage's resident footprint under the
+    streaming contract: the planner costs ingest as a bounded host stage
+    instead of an unbounded (C5) hole. ``apply_batch`` materializes the
+    first batch (the probe/sampling form — e.g. seeding PCA/GMM fits);
+    full passes go through :class:`StreamingTarIngest` /
+    :func:`stream_batches` directly."""
+
+    jittable: ClassVar[bool] = False
+    # reads the filesystem: archive contents are invisible to content
+    # fingerprinting, so the intermediate cache must never memoize this
+    memoizable: ClassVar[bool] = False
+
+    tar_paths: Tuple[str, ...] = struct.field(pytree_node=False)
+    target_hw: Tuple[int, int] = struct.field(pytree_node=False)
+    batch_size: int = struct.field(pytree_node=False)
+
+    @staticmethod
+    def create(tar_paths: Sequence[str], target_hw: Tuple[int, int],
+               batch_size: int) -> "TarIngestNode":
+        return TarIngestNode(
+            tar_paths=tuple(tar_paths),
+            target_hw=(int(target_hw[0]), int(target_hw[1])),
+            batch_size=int(batch_size),
+        )
+
+    def __contract__(self):
+        from keystone_tpu.analysis import contracts as C
+
+        h, w = self.target_hw
+        bs = self.batch_size
+
+        def out(_a):
+            return C.spec_struct(bs, h, w, 3)
+
+        return C.NodeContract(out=out, in_template=lambda: C.spec_struct(1))
+
+    def apply_batch(self, _xs: Any = None) -> np.ndarray:
+        ingest = StreamingTarIngest(
+            list(self.tar_paths), self.target_hw, self.batch_size
+        )
+        for batch in ingest.batches():
+            out = np.array(batch.images[: batch.n_valid])  # copy: lease ends
+            batch.release()
+            return out
+        h, w = self.target_hw
+        return np.zeros((0, h, w, 3), np.float32)

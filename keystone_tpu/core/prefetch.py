@@ -57,64 +57,94 @@ def prefetch_map(
     ahead of consumption on the calling thread (results come back in order;
     an exception in ``fn`` surfaces at the corresponding yield). ``gate(prev,
     nxt)`` returning False defers ``fn(nxt)`` until ``prev``'s result has
-    been yielded."""
+    been yielded.
+
+    ``items`` is consumed LAZILY through a windowed deque: at most
+    ``depth + 1`` raw items are pulled ahead of the yield cursor, so an
+    unbounded iterable — the streaming ingest feed (``core/ingest.py``)
+    is one — flows through without ever materializing. (The previous
+    ``list(items)`` here defeated out-of-core streaming by buffering the
+    whole sequence up front.)"""
     import time
+    from collections import deque
 
     from keystone_tpu.telemetry import get_registry
 
     reg = get_registry()
-    items = list(items)
+    it = iter(items)
     if depth is None:
         depth = prefetch_depth()
     reg.set_gauge("prefetch.depth", depth)
-    if depth <= 0 or len(items) <= 1:
-        for item in items:
+    if depth <= 0:
+        for item in it:
             t0 = time.perf_counter()
             value = fn(item)
             reg.inc("prefetch.stall")
             reg.inc("prefetch.stall_s", time.perf_counter() - t0)
             yield value
         return
-    # j -> ("ok", value) | ("err", exc): run-ahead production must not raise
-    # at the wrong sequence position, so errors are stored and re-raised at
-    # their own yield
-    produced: dict = {}
+    # The run-ahead window. ``raw`` holds items pulled from the iterator but
+    # not yet produced; ``results`` holds ("ok", value) | ("err", exc) in
+    # sequence order — errors are stored and re-raised at their OWN yield,
+    # never at the wrong sequence position. ``prev_raw`` is the most recent
+    # item whose production has been attempted (the gate's left operand;
+    # production is strictly in sequence order, so it is always the
+    # predecessor of ``raw[0]``).
+    raw: deque = deque()
+    results: deque = deque()
+    prev_raw = None
+    exhausted = False
 
-    def produce(j: int) -> None:
-        if j not in produced:
-            try:
-                produced[j] = ("ok", fn(items[j]))
-            except BaseException as exc:  # re-raised at yield j
-                produced[j] = ("err", exc)
+    def pull() -> bool:
+        nonlocal exhausted
+        if exhausted:
+            return False
+        try:
+            raw.append(next(it))
+            return True
+        except StopIteration:
+            exhausted = True
+            return False
 
-    for i in range(len(items)):
-        # Stall accounting: the consumer is about to block on fn(items[i])
+    def produce_one() -> None:
+        nonlocal prev_raw
+        item = raw.popleft()
+        try:
+            results.append(("ok", fn(item)))
+        except BaseException as exc:  # re-raised at this item's yield
+            results.append(("err", exc))
+        prev_raw = item
+
+    while True:
+        # Stall accounting: the consumer is about to block on fn(item)
         # because run-ahead did NOT already produce it (first item, a gate
         # boundary, or depth exhausted). ``prefetch.stall_s`` is therefore
         # the producer time the double buffer failed to hide; items already
         # produced ahead count as ``prefetch.ready``.
-        if i in produced:
+        if results:
             reg.inc("prefetch.ready")
         else:
+            if not raw and not pull():
+                return
             t0 = time.perf_counter()
-            produce(i)  # production order == sequence order, always
+            produce_one()  # production order == sequence order, always
             reg.inc("prefetch.stall")
             reg.inc("prefetch.stall_s", time.perf_counter() - t0)
-        if produced[i][0] == "ok":
-            # run ahead, but never PAST an error: a failed producer call
-            # means the sequence is about to abort (or be retried from a
-            # checkpoint) — producing beyond it would waste exactly the
-            # work an elastic resume is trying to preserve
-            for j in range(i + 1, min(i + 1 + depth, len(items))):
-                if j not in produced:
-                    if gate is not None and not gate(items[j - 1], items[j]):
-                        reg.inc("prefetch.gate_blocked")
-                        break
-                    produce(j)
-                    reg.inc("prefetch.produced_ahead")
-                if produced[j][0] == "err":
-                    break
-        tag, val = produced.pop(i)
+        # Run ahead, but never PAST an error: a failed producer call means
+        # the sequence is about to abort (or be retried from a checkpoint),
+        # so producing beyond it would waste exactly the work an elastic
+        # resume is trying to preserve. Errors only ever sit at the window
+        # tail (production stops at them), so the tail check covers both
+        # "head failed" and "an earlier run-ahead failed".
+        while results[-1][0] == "ok" and len(results) - 1 < depth:
+            if not raw and not pull():
+                break
+            if gate is not None and not gate(prev_raw, raw[0]):
+                reg.inc("prefetch.gate_blocked")
+                break
+            produce_one()
+            reg.inc("prefetch.produced_ahead")
+        tag, val = results.popleft()
         if tag == "err":
             raise val
         yield val

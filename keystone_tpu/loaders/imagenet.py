@@ -27,6 +27,20 @@ def load_labels_map(labels_path: str) -> dict:
     return out
 
 
+def list_tar_archives(data_dir: str) -> list:
+    """Sorted tar archive paths under ``data_dir``. Only tar archives: a
+    labels file / README sitting in data_dir must not be handed to the tar
+    reader."""
+    tars = sorted(
+        os.path.join(data_dir, f)
+        for f in os.listdir(data_dir)
+        if f.endswith(".tar") and not os.path.isdir(os.path.join(data_dir, f))
+    )
+    if not tars:
+        raise FileNotFoundError(f"no .tar archives found in {data_dir}")
+    return tars
+
+
 def iter_imagenet_batches(
     data_dir: str,
     labels_path: str,
@@ -36,15 +50,7 @@ def iter_imagenet_batches(
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Yields (images (n, H, W, 3) float32, labels (n,) int32)."""
     labels_map = load_labels_map(labels_path)
-    # Only tar archives: a labels file / README sitting in data_dir must not
-    # be handed to the tar reader.
-    tars = sorted(
-        os.path.join(data_dir, f)
-        for f in os.listdir(data_dir)
-        if f.endswith(".tar") and not os.path.isdir(os.path.join(data_dir, f))
-    )
-    if not tars:
-        raise FileNotFoundError(f"no .tar archives found in {data_dir}")
+    tars = list_tar_archives(data_dir)
     loader = PrefetchImageLoader(tars, target_hw[0], target_hw[1], num_threads)
     for imgs, names in loader.batches(batch_size):
         labels = np.array(
@@ -52,6 +58,42 @@ def iter_imagenet_batches(
         )
         keep = labels >= 0
         yield imgs[keep], labels[keep]
+
+
+def stream_imagenet_batches(
+    data_dir: str,
+    labels_path: str,
+    target_hw: Tuple[int, int] = (256, 256),
+    batch_size: int = 256,
+    num_threads: Optional[int] = None,
+    num_buffers: Optional[int] = None,
+    depth: Optional[int] = None,
+) -> Iterator[Tuple[object, np.ndarray]]:
+    """The out-of-core form of :func:`iter_imagenet_batches`: batches flow
+    from the bounded streaming-ingest pipeline (``core/ingest.py`` — decode
+    workers into a fixed ring of recycled host buffers) with batch *t+1*'s
+    host→device transfer dispatched while the caller extracts batch *t*.
+
+    Yields ``(images, labels)`` where ``images`` is a DEVICE array of the
+    FULL fixed ``(batch_size, H, W, 3)`` shape (zero-padded final batch —
+    per-batch jitted consumers compile exactly once) and ``labels`` is an
+    int32 host array of the same leading size with ``-1`` marking pad rows
+    and entries missing from the labels map. The raw dataset is never
+    resident: peak decoded host memory is the ring
+    (``KEYSTONE_INGEST_BUFFERS`` × batch × frame bytes)."""
+    from keystone_tpu.core.ingest import StreamingTarIngest, stream_batches
+
+    labels_map = load_labels_map(labels_path)
+    tars = list_tar_archives(data_dir)
+    ingest = StreamingTarIngest(
+        tars, target_hw, batch_size,
+        num_threads=num_threads, num_buffers=num_buffers,
+    )
+    for imgs, names, n in stream_batches(ingest, depth=depth):
+        labels = np.full((batch_size,), -1, np.int32)
+        for i, name in enumerate(names[:n]):
+            labels[i] = labels_map.get(name.split("/")[0], -1)
+        yield imgs, labels
 
 
 def load_imagenet(
@@ -83,13 +125,7 @@ def load_imagenet_bucketed(
     from keystone_tpu.native import BucketedImageLoader
 
     labels_map = load_labels_map(labels_path)
-    tars = sorted(
-        os.path.join(data_dir, f)
-        for f in os.listdir(data_dir)
-        if f.endswith(".tar") and not os.path.isdir(os.path.join(data_dir, f))
-    )
-    if not tars:
-        raise FileNotFoundError(f"no .tar archives found in {data_dir}")
+    tars = list_tar_archives(data_dir)
     loader = BucketedImageLoader(tars, buckets, num_threads)
     groups: dict = {}
     for hw, imgs, names in loader.batches(256):

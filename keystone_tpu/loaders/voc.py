@@ -30,6 +30,26 @@ def load_voc_labels(labels_path: str) -> dict:
     return by_file
 
 
+def labels_for_name(labels_map: dict, name: str):
+    """Label list for an archive entry name, or None. The reference CSV
+    keys label rows by full archive path (VOCLoader.scala:46-58); accept a
+    basename match too so re-rooted archives keep working — the ONE place
+    the matching rule lives (in-core, bucketed, and streaming-ingest VOC
+    paths all route through it)."""
+    return labels_map.get(name) or labels_map.get(name.split("/")[-1])
+
+
+def pad_label_lists(label_lists, width: Optional[int] = None) -> np.ndarray:
+    """Ragged per-image label lists -> (n, width) int32 padded with -1
+    (width defaults to the longest list)."""
+    if width is None:
+        width = max(len(ls) for ls in label_lists)
+    labels = np.full((len(label_lists), width), -1, np.int32)
+    for i, ls in enumerate(label_lists):
+        labels[i, : len(ls)] = ls
+    return labels
+
+
 def load_voc(
     data_path: str,
     labels_path: str,
@@ -46,10 +66,7 @@ def load_voc(
         for i, name in enumerate(names):
             if name_prefix and not name.startswith(name_prefix):
                 continue
-            # The reference CSV keys label rows by full archive path
-            # (VOCLoader.scala:46-58); accept a basename match too so
-            # re-rooted archives keep working.
-            labels = labels_map.get(name) or labels_map.get(name.split("/")[-1])
+            labels = labels_for_name(labels_map, name)
             if labels is None:
                 continue
             imgs_list.append(imgs[i])
@@ -60,11 +77,7 @@ def load_voc(
             f"{len(labels_map)} filenames in {labels_path}; check the archive "
             "layout against the prefix/labels CSV"
         )
-    max_labels = max(len(l) for l in label_lists)
-    labels = np.full((len(label_lists), max_labels), -1, np.int32)
-    for i, ls in enumerate(label_lists):
-        labels[i, : len(ls)] = ls
-    return np.stack(imgs_list), labels
+    return np.stack(imgs_list), pad_label_lists(label_lists)
 
 
 def load_voc_bucketed(
@@ -93,7 +106,7 @@ def load_voc_bucketed(
         for i, name in enumerate(names):
             if name_prefix and not name.startswith(name_prefix):
                 continue
-            labels = labels_map.get(name) or labels_map.get(name.split("/")[-1])
+            labels = labels_for_name(labels_map, name)
             if labels is None:
                 continue
             il, ll = groups.setdefault(hw, ([], []))
@@ -104,14 +117,12 @@ def load_voc_bucketed(
             f"no images in {data_path} matched prefix={name_prefix!r} and the "
             f"{len(labels_map)} filenames in {labels_path}"
         )
+    # one SHARED width across groups so downstream concat keeps its shape
     max_labels = max(len(ls) for _, ll in groups.values() for ls in ll)
     out = []
     for hw in sorted(groups):
         il, ll = groups[hw]
-        labels = np.full((len(ll), max_labels), -1, np.int32)
-        for i, ls in enumerate(ll):
-            labels[i, : len(ls)] = ls
-        out.append((hw, np.stack(il), labels))
+        out.append((hw, np.stack(il), pad_label_lists(ll, width=max_labels)))
     return out
 
 

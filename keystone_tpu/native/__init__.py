@@ -3,6 +3,7 @@ from keystone_tpu.native.ingest import (
     BucketedImageLoader,
     PrefetchImageLoader,
     decode_jpeg,
+    iter_tar_entries,
     native_available,
 )
 from keystone_tpu.native.ngram import count_by_key

@@ -78,12 +78,25 @@ long ks_tar_next(void* h, char* name_out, int name_cap) {
   unsigned char header[512];
   std::string pending_longname;
   for (;;) {
-    if (fread(header, 1, 512, t->f) != 512) return -1;
+    size_t got_hdr = fread(header, 1, 512, t->f);
+    if (got_hdr == 0) return -1;      // clean EOF at a block boundary
+    if (got_hdr != 512) return -2;    // mid-header truncation / not a tar
     // two zero blocks = end; a single all-zero header is terminal enough
     bool all_zero = true;
     for (int i = 0; i < 512; ++i)
       if (header[i]) { all_zero = false; break; }
     if (all_zero) return -1;
+    // Header checksum (bytes 148-155 counted as spaces). A mismatch means
+    // this is not a tar header at all — junk input must surface as -2, not
+    // read as a silent empty archive.
+    long stored = parse_octal((const char*)header + 148, 8);
+    long unsigned_sum = 0, signed_sum = 0;
+    for (int i = 0; i < 512; ++i) {
+      unsigned char u = (i >= 148 && i < 156) ? ' ' : header[i];
+      unsigned_sum += u;
+      signed_sum += (i >= 148 && i < 156) ? ' ' : (signed char)header[i];
+    }
+    if (stored != unsigned_sum && stored != signed_sum) return -2;
 
     long size = parse_octal((const char*)header + 124, 12);
     char type = header[156];
@@ -287,6 +300,10 @@ void* ks_loader_create(const char** tar_paths, int n, int target_h,
 
 // Fills up to `batch` images ((batch, H, W, 3) float32) and their entry names
 // ('\n'-joined into names_out). Returns the number filled; 0 at end of data.
+// May return FEWER than `batch` while data remains: when the next entry's
+// name would overflow names_cap the sample is left queued for the next call
+// instead of the whole tail of the name list silently truncating — callers
+// must keep calling until 0 comes back (the Python side refills its batch).
 int ks_loader_next(void* h, int batch, float* out_imgs, char* names_out,
                    long names_cap) {
   Loader* L = (Loader*)h;
@@ -297,6 +314,14 @@ int ks_loader_next(void* h, int batch, float* out_imgs, char* names_out,
     std::unique_lock<std::mutex> lk(L->mu);
     L->cv_get.wait(lk, [L] { return !L->queue.empty() || L->done(); });
     if (L->queue.empty()) break;
+    // Capacity check BEFORE popping: joined names are '\n'-separated and
+    // NUL-terminated. A first entry whose name alone exceeds the buffer
+    // (unreachable while callers size >= one name slot: ks_tar_next caps
+    // entry names at its name_cap) is truncated by the snprintf below
+    // rather than wedging the stream in a 0-filled loop.
+    size_t need = names.size() + (names.empty() ? 0 : 1)
+        + L->queue.front().name.size() + 1;
+    if (filled > 0 && (long)need > names_cap) break;
     Sample s = std::move(L->queue.front());
     L->queue.pop();
     L->cv_put.notify_one();

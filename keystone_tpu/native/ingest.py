@@ -145,56 +145,82 @@ def decode_jpeg(data: bytes) -> Optional[np.ndarray]:
         return None
 
 
-class TarImageReader:
-    """Iterate (entry_name, rgb_uint8_image) over a tar of JPEGs."""
-
-    def __init__(self, path: str):
-        self.path = path
-
-    def __iter__(self) -> Iterator[Tuple[str, np.ndarray]]:
-        lib = _get_lib()
-        if lib is not None:
-            yield from self._iter_native(lib)
-        else:
-            yield from self._iter_python()
-
-    def _iter_native(self, lib):
-        h = lib.ks_tar_open(self.path.encode())
+def iter_tar_entries(path: str) -> Iterator[Tuple[str, bytes]]:
+    """(entry name, payload bytes) over a tar archive's regular files — the
+    undecoded layer under :class:`TarImageReader` and the streaming ingest
+    pipeline (``core/ingest.py``, which needs decode as a SEPARATE step so
+    its worker pool can time it and inject faults at it). Uses the native
+    ustar walker when the library is available, ``tarfile`` otherwise. A
+    malformed or truncated archive raises ``tarfile.ReadError`` on both
+    paths (the native walker checksums each ustar header, so junk input
+    can never read as a silent empty archive); the streaming ingest wraps
+    either in its truncated-tar fault handling."""
+    lib = _get_lib()
+    if lib is not None:
+        h = lib.ks_tar_open(path.encode())
         if not h:
-            raise FileNotFoundError(self.path)
+            raise FileNotFoundError(path)
         try:
             name_buf = ctypes.create_string_buffer(4096)
             while True:
                 size = lib.ks_tar_next(h, name_buf, 4096)
-                if size < 0:
-                    break  # end of archive (-1) or malformed entry (-2)
+                if size == -1:
+                    break  # end of archive
+                if size < 0:  # -2: malformed header / truncated / not a tar
+                    raise tarfile.ReadError(
+                        f"malformed or truncated tar archive: {path}"
+                    )
                 if size == 0:
                     continue  # empty regular file, keep iterating
                 buf = ctypes.create_string_buffer(size)
                 got = 0
                 while got < size:
                     r = lib.ks_tar_read(
-                        h, ctypes.cast(ctypes.addressof(buf) + got, ctypes.c_char_p),
+                        h,
+                        ctypes.cast(ctypes.addressof(buf) + got, ctypes.c_char_p),
                         size - got,
                     )
                     if r <= 0:
                         break
                     got += r
-                img = decode_jpeg(buf.raw[:got])
-                if img is not None and img.shape[0] >= 36 and img.shape[1] >= 36:
-                    yield name_buf.value.decode(errors="replace"), img
+                if got < size:
+                    # mid-payload truncation: the fallback walker raises
+                    # here too — a silently-short entry must never pass
+                    # for a whole one
+                    raise tarfile.ReadError(
+                        f"truncated tar entry "
+                        f"{name_buf.value.decode(errors='replace')!r} "
+                        f"in {path} ({got}/{size} bytes)"
+                    )
+                yield name_buf.value.decode(errors="replace"), buf.raw[:got]
         finally:
             lib.ks_tar_close(h)
-
-    def _iter_python(self):
-        with tarfile.open(self.path) as tf:
+    else:
+        with tarfile.open(path) as tf:
             for entry in tf:
                 if not entry.isfile():
                     continue
-                data = tf.extractfile(entry).read()
-                img = decode_jpeg(data)
-                if img is not None and img.shape[0] >= 36 and img.shape[1] >= 36:
-                    yield entry.name, img
+                yield entry.name, tf.extractfile(entry).read()
+
+
+class TarImageReader:
+    """Iterate (entry_name, rgb_uint8_image) over a tar of JPEGs."""
+
+    #: reference rejects tiny images (utils/images/ImageUtils.scala:16-46)
+    MIN_HW = 36
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self) -> Iterator[Tuple[str, np.ndarray]]:
+        for name, data in iter_tar_entries(self.path):
+            img = decode_jpeg(data)
+            if (
+                img is not None
+                and img.shape[0] >= self.MIN_HW
+                and img.shape[1] >= self.MIN_HW
+            ):
+                yield name, img
 
 
 def _center_frame(img: np.ndarray, target_h: int, target_w: int) -> np.ndarray:
@@ -357,21 +383,38 @@ class PrefetchImageLoader:
             self.num_threads,
         )
         try:
-            while True:
+            done = False
+            while not done:
                 out = np.empty(
                     (batch_size, self.target_h, self.target_w, 3), np.float32
                 )
-                names_buf = ctypes.create_string_buffer(batch_size * 4096)
-                n = lib.ks_loader_next(
-                    h, batch_size, out.ctypes.data_as(ctypes.c_void_p), names_buf,
-                    len(names_buf),
-                )
-                if n <= 0:
-                    break
-                names = names_buf.value.decode(errors="replace").split("\n")
-                yield out[:n], names[:n]
-                if n < batch_size:
-                    break
+                names: List[str] = []
+                filled = 0
+                # Refill until the batch is full: ks_loader_next may return
+                # short when the next entry's name would overflow the name
+                # buffer (it leaves the sample queued rather than silently
+                # truncating the tail of the name list), so a short return
+                # is NOT end-of-data — only 0 is. The per-call buffer budget
+                # is one max-length tar name (+ NUL) per remaining slot, so
+                # a single name can never exceed the whole buffer.
+                while filled < batch_size:
+                    names_buf = ctypes.create_string_buffer(
+                        (batch_size - filled) * 4097
+                    )
+                    n = lib.ks_loader_next(
+                        h, batch_size - filled,
+                        out[filled:].ctypes.data_as(ctypes.c_void_p),
+                        names_buf, len(names_buf),
+                    )
+                    if n <= 0:
+                        done = True
+                        break
+                    names.extend(
+                        names_buf.value.decode(errors="replace").split("\n")[:n]
+                    )
+                    filled += n
+                if filled:
+                    yield out[:filled], names
         finally:
             lib.ks_loader_destroy(h)
 

@@ -83,6 +83,16 @@ class ImageNetSiftLcsFVConfig:
     # inside the weighted solver instead of materializing the (n, d) matrix
     # (``fit_streaming``; reference regime ImageNetSiftLcsFV.scala:197-218).
     streaming: bool = False
+    # Streaming INGEST mode (real archives only): batches flow straight
+    # from the bounded decode pipeline (core/ingest.py — parallel tar/JPEG
+    # decode into a recycled host buffer ring) into per-batch extraction,
+    # so the RAW image tensor never exists on host or device; peak decoded
+    # host memory is KEYSTONE_INGEST_BUFFERS × ingest_batch × frame bytes
+    # regardless of dataset size (``fit_streaming_ingest``). Implies the
+    # out-of-core solver path; incompatible with --buckets and with the
+    # gmm_* streaming-experiment knobs.
+    ingest: bool = False
+    ingest_batch: int = 256  # images per decoded batch = extraction dispatch
     extract_chunk: int = 2048  # images per descriptor-extraction dispatch
     sample_images: int = 4096  # images whose descriptors feed PCA/GMM fits
     fv_row_chunk: int = 1024  # images per FV block-featurization chunk
@@ -165,6 +175,25 @@ class ImageNetSiftLcsFVConfig:
                 "gmm_probe_candidates selects ONE codebook; combining it "
                 "with gmm_ensemble would silently skip probe selection"
             )
+        if self.ingest:
+            if not (self.train_location and self.test_location):
+                raise ValueError(
+                    "--ingest streams real tar archives (core/ingest.py); "
+                    "set --train-location/--test-location (the synthetic "
+                    "generator has nothing to decode)"
+                )
+            if self.buckets:
+                raise ValueError(
+                    "--ingest decodes into one fixed frame (image_hw); "
+                    "combine with --buckets is not supported yet"
+                )
+            if (self.gmm_backend != "native" or self.gmm_ensemble > 1
+                    or self.gmm_probe_candidates > 1):
+                raise ValueError(
+                    "gmm_backend/gmm_ensemble/gmm_probe_candidates are "
+                    "in-core-sample experiment knobs; the --ingest path "
+                    "would silently ignore them"
+                )
 
 
 
@@ -922,6 +951,260 @@ def _run_streaming(config: ImageNetSiftLcsFVConfig, train_src, test_src,
     return results
 
 
+def _run_streaming_ingest(config: ImageNetSiftLcsFVConfig) -> dict:
+    """Never-resident flagship fit over real tar archives: the streaming
+    ingest pipeline (``core/ingest.py``) decodes into a bounded ring of
+    recycled host buffers and extraction consumes batches AS THEY ARRIVE —
+    the raw image tensor never exists on host or device, so the dataset
+    may exceed host RAM.
+
+    Two passes per split, mirroring ``_run_streaming``'s structure: pass A
+    streams a prefix of the archives for the PCA/GMM descriptor sample;
+    pass B re-streams everything, reducing each decoded batch to the
+    resident bf16 descriptors through ONE fixed-shape jitted program
+    (zero steady-state recompiles — ``ingest_reduce_compiles`` records the
+    jit cache size as evidence). The solver tail is the out-of-core
+    weighted BCD of the plain streaming path."""
+    import jax
+
+    from keystone_tpu.core.ingest import ingest_buffers
+    from keystone_tpu.learning.block_linear import streaming_predict
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.learning.pca import PCAEstimator
+    from keystone_tpu.loaders.imagenet import stream_imagenet_batches
+    from keystone_tpu.ops.images.fisher_vector import (
+        fisher_l1_norms,
+        make_fisher_block_nodes,
+    )
+    from keystone_tpu.ops.stats import BatchSignedHellingerMapper, ColumnSampler
+    from keystone_tpu.telemetry import get_registry
+
+    results: dict = {}
+    reg = get_registry()
+    bs = config.ingest_batch
+    hw = (config.image_hw, config.image_hw)
+    num_classes = IMAGENET_NUM_CLASSES
+    sift = SIFTExtractor()
+    hellinger = BatchSignedHellingerMapper()
+    lcs = LCSExtractor(config.lcs_stride, config.lcs_border, config.lcs_patch)
+    dtype = jnp.dtype(config.desc_dtype)
+
+    def sift_descs(imgs):
+        return hellinger(sift(GrayScaler()(imgs)[..., 0]))
+
+    # ONE compiled program per decoded batch (both branches + PCA + cast),
+    # always at the FULL fixed (ingest_batch, H, W, 3) shape the ring
+    # yields — the steady-state fit performs zero recompiles after the
+    # first batch. PCA mats are arguments so train and test passes share
+    # the executable.
+    @jax.jit
+    def _reduce_batch(imgs, mat_s, mat_l):
+        return (
+            (sift_descs(imgs) @ mat_s).astype(dtype),
+            (lcs(imgs) @ mat_l).astype(dtype),
+        )
+
+    @jax.jit
+    def _batch_descs(imgs):
+        return sift_descs(imgs), lcs(imgs)
+
+    def keep_rows(parts, labels):
+        """Slice a reduced pair down to the labeled rows. Full all-labeled
+        batches (the steady state) pass through untouched; ragged batches
+        (final partial / unlabeled entries) pay one device gather."""
+        keep = np.nonzero(labels >= 0)[0]
+        if keep.size == labels.shape[0]:
+            return parts, keep.size
+        idx = jnp.asarray(keep, jnp.int32)
+        return tuple(p[idx] for p in parts), keep.size
+
+    decode_s0 = reg.get_counter("ingest.decode_s")
+    stall_s0 = reg.get_counter("ingest.stall_s")
+    with use_mesh(get_mesh()), Timer("ImageNetSiftLcsFV.streaming_ingest") as total:
+        # Pass A: descriptor sample for the PCA/GMM fits from the stream's
+        # first ~sample_images labeled rows; the early break abandons the
+        # feed, whose cleanup stops the decode workers.
+        s_parts, l_parts, seen = [], [], 0
+        for imgs, labels in stream_imagenet_batches(
+            config.train_location, config.train_labels, hw, bs
+        ):
+            (sd, ld), n = keep_rows(_batch_descs(imgs), labels)
+            if n == 0:
+                continue
+            s_parts.append(sd[:n])
+            l_parts.append(ld[:n])
+            seen += n
+            if seen >= config.sample_images:
+                break
+        if not s_parts:
+            raise ValueError(
+                f"no labeled images streamed from {config.train_location}"
+            )
+        sample_s = jnp.concatenate(s_parts) if len(s_parts) > 1 else s_parts[0]
+        sample_l = jnp.concatenate(l_parts) if len(l_parts) > 1 else l_parts[0]
+        del s_parts, l_parts
+
+        with Timer("streaming.fit_pca_gmm"):
+            pca_s = PCAEstimator(config.sift_pca_dim).fit_batch(
+                ColumnSampler(config.num_pca_samples, seed=config.seed)(sample_s)
+            )
+            gmm_s = GaussianMixtureModelEstimator(
+                config.vocab_size, n_init=config.gmm_n_init
+            ).fit(ColumnSampler(
+                config.num_gmm_samples, seed=config.seed + 1
+            )(pca_s(sample_s)))
+            pca_l = PCAEstimator(config.lcs_pca_dim).fit_batch(
+                ColumnSampler(
+                    config.num_pca_samples, seed=config.seed + 7
+                )(sample_l)
+            )
+            gmm_l = GaussianMixtureModelEstimator(
+                config.vocab_size, n_init=config.gmm_n_init
+            ).fit(ColumnSampler(
+                config.num_gmm_samples, seed=config.seed + 8
+            )(pca_l(sample_l)))
+        del sample_s, sample_l
+
+        def reduce_stream(location, labels_path):
+            """One full streaming pass: decoded batches → reduced bf16
+            descriptors + l1 norms (the resident representation). Raw
+            images live only inside the ingest ring."""
+            ps_parts, pl_parts, lbl_parts = [], [], []
+            for imgs, labels in stream_imagenet_batches(
+                location, labels_path, hw, bs
+            ):
+                pair = _reduce_batch(imgs, pca_s.pca_mat, pca_l.pca_mat)
+                (ps, pl), n = keep_rows(pair, labels)
+                if n == 0:
+                    continue
+                ps_parts.append(ps[:n])
+                pl_parts.append(pl[:n])
+                lbl_parts.append(labels[labels >= 0])
+            if not ps_parts:
+                raise ValueError(f"no labeled images streamed from {location}")
+            red_s = (jnp.concatenate(ps_parts)
+                     if len(ps_parts) > 1 else ps_parts[0])
+            red_l = (jnp.concatenate(pl_parts)
+                     if len(pl_parts) > 1 else pl_parts[0])
+            raw = {
+                "sift": red_s,
+                "l1_sift": fisher_l1_norms(red_s, gmm_s, config.fv_row_chunk),
+                "lcs": red_l,
+                "l1_lcs": fisher_l1_norms(red_l, gmm_l, config.fv_row_chunk),
+            }
+            return raw, np.concatenate(lbl_parts)
+
+        with Timer("streaming.reduce_train"):
+            raw_train, train_labels = reduce_stream(
+                config.train_location, config.train_labels
+            )
+        n_train = int(train_labels.shape[0])
+
+        config = _resolve_solver_knobs(
+            config, n_train, num_classes, sub_k=config.vocab_size,
+            fixed_bytes=sum(v.nbytes for v in raw_train.values()),
+        )
+        blocks_s = 2 * config.vocab_size // (
+            config.block_size // config.sift_pca_dim
+        )
+        blocks_l = 2 * config.vocab_size // (
+            config.block_size // config.lcs_pca_dim
+        )
+
+        def make_nodes(cache_s: int, cache_l: int):
+            return make_fisher_block_nodes(
+                gmm_s, config.block_size, key="sift", l1_key="l1_sift",
+                row_chunk=config.fv_row_chunk, cache_blocks=cache_s,
+            ) + make_fisher_block_nodes(
+                gmm_l, config.block_size, key="lcs", l1_key="l1_lcs",
+                row_chunk=config.fv_row_chunk, cache_blocks=cache_l,
+            )
+
+        nodes = make_nodes(config.fv_cache_blocks, config.fv_cache_blocks)
+        cache_dtype = (
+            jnp.dtype(config.fv_cache_dtype) if config.fv_cache_blocks else None
+        )
+        labels_ind = ClassLabelIndicatorsFromIntLabels(num_classes)(
+            jnp.asarray(train_labels)
+        )
+        with Timer("fit.block_weighted_least_squares_streaming"):
+            model = BlockWeightedLeastSquaresEstimator(
+                config.block_size, config.num_iter, config.lam,
+                config.mixture_weight,
+            ).fit_streaming(
+                nodes, raw_train, labels_ind, cache_dtype=cache_dtype,
+                checkpoint_path=config.solver_checkpoint or None,
+                checkpoint_every=config.solver_checkpoint_every,
+            )
+        del raw_train
+
+        with Timer("eval.top5_streaming"):
+            # test archives stream only now — nothing test-side was
+            # resident through the memory-critical solve
+            raw_test, test_labels = reduce_stream(
+                config.test_location, config.test_labels
+            )
+            eval_nodes = nodes
+            if config.fv_cache_blocks:
+                n_test = int(test_labels.shape[0])
+                item = cache_dtype.itemsize
+                budget = 1 << 30  # per-branch group-buffer cap
+
+                def eval_cache(blocks: int) -> int:
+                    bytes_ = n_test * blocks * config.block_size * item
+                    return blocks if bytes_ < budget else config.fv_cache_blocks
+
+                eval_nodes = make_nodes(
+                    eval_cache(blocks_s), eval_cache(blocks_l)
+                )
+            scores = streaming_predict(model, eval_nodes, raw_test, cache_dtype)
+            top5 = TopKClassifier(k=min(5, num_classes))(scores)
+            results["test_top5_error"] = get_err_percent(top5, test_labels)
+            top1 = TopKClassifier(k=1)(scores)
+            results["test_top1_error"] = get_err_percent(top1, test_labels)
+
+    frame_bytes = hw[0] * hw[1] * 3 * 4
+    n_total = n_train + int(test_labels.shape[0])
+    results["wallclock_s"] = total.elapsed
+    results["feature_dim"] = 2 * (
+        config.sift_pca_dim + config.lcs_pca_dim
+    ) * config.vocab_size
+    # never-resident evidence pair: the raw decoded footprint the in-core
+    # path would have materialized vs the bounded working set this path
+    # actually held (the ingest ring), plus decode/stall attribution and
+    # the zero-recompile pin
+    results["ingest_images"] = n_total
+    results["ingest_raw_bytes"] = int(n_total * frame_bytes)
+    results["ingest_peak_host_bytes"] = int(
+        ingest_buffers() * bs * frame_bytes
+    )
+    results["ingest_decode_s"] = round(
+        reg.get_counter("ingest.decode_s") - decode_s0, 3
+    )
+    results["ingest_stall_s"] = round(
+        reg.get_counter("ingest.stall_s") - stall_s0, 3
+    )
+    results["ingest_reduce_compiles"] = int(_reduce_batch._cache_size())
+    logger.info(
+        "streaming-ingest TEST top-5: %.2f%%  top-1: %.2f%%  (raw %.1f MB "
+        "streamed through a %.1f MB ring)",
+        results["test_top5_error"], results["test_top1_error"],
+        results["ingest_raw_bytes"] / 1e6,
+        results["ingest_peak_host_bytes"] / 1e6,
+    )
+    return results
+
+
+def fit_streaming_ingest(config: ImageNetSiftLcsFVConfig) -> dict:
+    """Public entry for the never-resident streaming-ingest fit (the
+    ``--ingest`` path of :func:`run`); validates then streams."""
+    config.validate()
+    if not config.ingest:
+        config = dataclasses.replace(config, ingest=True, streaming=True)
+        config.validate()
+    return _run_streaming_ingest(config)
+
+
 def flagship_config(**overrides) -> ImageNetSiftLcsFVConfig:
     """The measured reference-dim streaming configuration (BASELINE.md
     flagship row; `ImageNetSiftLcsFV.scala:197-218` dims): vocab 256,
@@ -1134,6 +1417,8 @@ def run(config: ImageNetSiftLcsFVConfig) -> dict:
     # loudly on EVERY path — the in-core and plain-streaming paths used to
     # silently ignore them (ADVICE.md round 5)
     config.validate()
+    if config.ingest:
+        return _run_streaming_ingest(config)
     if config.buckets:
         if config.streaming:
             return _run_streaming_bucketed(config)

@@ -67,6 +67,13 @@ class VOCSIFTFisherConfig:
     # independent GMM-EM restarts; best likelihood wins (density-fit tool —
     # see BASELINE.md on why it does not stabilize classifier quality)
     gmm_n_init: int = 1
+    # Streaming ingest (real archives only): decoded batches flow straight
+    # from the bounded core/ingest.py pipeline into per-batch SIFT+FV
+    # featurization — the raw image tensor never exists; only the (n, d_fv)
+    # Fisher features are resident (``fit_streaming_ingest``).
+    ingest: bool = False
+    ingest_batch: int = 128  # images per decoded batch
+    sample_images: int = 1024  # prefix images whose descriptors seed PCA/GMM
 
     def validate(self):
         if self.buckets and not self.train_location:
@@ -75,6 +82,17 @@ class VOCSIFTFisherConfig:
                 "synthetic generator emits one size (drop --buckets or set "
                 "--train-location)"
             )
+        if self.ingest:
+            if not (self.train_location and self.test_location):
+                raise ValueError(
+                    "--ingest streams real tar archives (core/ingest.py); "
+                    "set --train-location/--test-location"
+                )
+            if self.buckets:
+                raise ValueError(
+                    "--ingest decodes into one fixed frame (image_hw); "
+                    "combining it with --buckets is not supported yet"
+                )
 
 
 def _resolved_block_size(config: VOCSIFTFisherConfig, n_rows: int,
@@ -233,7 +251,169 @@ def _run_bucketed(config: VOCSIFTFisherConfig) -> dict:
     return results
 
 
+def _run_streaming_ingest(config: VOCSIFTFisherConfig) -> dict:
+    """Never-resident VOC fit: decoded batches stream from the bounded
+    ingest pipeline (``core/ingest.py``) into one fixed-shape jitted
+    gray→SIFT→PCA→FV program per batch. Only the (n, 2·desc_dim·vocab)
+    Fisher features — the solver's input — are ever resident; raw images
+    live only inside the recycled host buffer ring. Pass A streams a
+    prefix of the archive for the PCA/GMM descriptor sample; pass B
+    re-streams everything and featurizes batch-by-batch."""
+    import jax
+
+    from keystone_tpu.core.ingest import (
+        StreamingTarIngest,
+        ingest_buffers,
+        stream_batches,
+    )
+    from keystone_tpu.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.learning.pca import PCAEstimator
+    from keystone_tpu.loaders.voc import (
+        labels_for_name,
+        load_voc_labels,
+        pad_label_lists,
+    )
+    from keystone_tpu.ops.stats import ColumnSampler
+    from keystone_tpu.pipelines._fisher import fisher_featurizer
+
+    results: dict = {}
+    bs = config.ingest_batch
+    hw = (config.image_hw, config.image_hw)
+    num_classes = VOC_NUM_CLASSES
+    extractor = SIFTExtractor(scales=config.sift_scales)
+
+    def gray_descs(imgs):
+        return extractor(GrayScaler()(imgs)[..., 0])
+
+    @jax.jit
+    def _batch_descs(imgs):
+        return gray_descs(imgs)
+
+    def labeled_rows(names, n, labels_map):
+        """(row indices, their label lists) for entries present in the CSV
+        (the shared ``labels_for_name`` match rule, as ``load_voc``)."""
+        rows, labels = [], []
+        for i, name in enumerate(names[:n]):
+            ls = labels_for_name(labels_map, name)
+            if ls is not None:
+                rows.append(i)
+                labels.append(ls)
+        return rows, labels
+
+    def stream(location):
+        return stream_batches(StreamingTarIngest([location], hw, bs))
+
+    with use_mesh(get_mesh()), Timer("VOCSIFTFisher.streaming_ingest") as total:
+        train_map = load_voc_labels(config.train_labels)
+        # Pass A: descriptor sample from the archive's first labeled images
+        parts, seen = [], 0
+        for imgs, names, n in stream(config.train_location):
+            rows, _ = labeled_rows(names, n, train_map)
+            if not rows:
+                continue
+            descs = _batch_descs(imgs)
+            parts.append(descs[jnp.asarray(rows, jnp.int32)])
+            seen += len(rows)
+            if seen >= config.sample_images:
+                break
+        if not parts:
+            raise ValueError(
+                f"no images in {config.train_location} matched the "
+                f"{len(train_map)} filenames in {config.train_labels}"
+            )
+        sample = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        del parts
+        with Timer("fisher.fit_pca"):
+            pca = PCAEstimator(config.desc_dim).fit_batch(
+                ColumnSampler(config.num_pca_samples, seed=config.seed)(sample)
+            )
+        with Timer("fisher.fit_gmm"):
+            gmm = GaussianMixtureModelEstimator(
+                config.vocab_size, n_init=config.gmm_n_init
+            ).fit(ColumnSampler(
+                config.num_gmm_samples, seed=config.seed + 1
+            )(pca(sample)))
+        del sample
+        fisher = fisher_featurizer(gmm)
+
+        # ONE compiled program per decoded batch: extract + PCA + FV encode
+        # at the fixed (ingest_batch, H, W, 3) ring shape — zero
+        # steady-state recompiles (``ingest_featurize_compiles``).
+        @jax.jit
+        def _featurize(imgs, pca_mat):
+            return fisher(gray_descs(imgs) @ pca_mat)
+
+        def featurize_stream(location, labels_map):
+            feat_parts, label_lists = [], []
+            for imgs, names, n in stream(location):
+                rows, labels = labeled_rows(names, n, labels_map)
+                if not rows:
+                    continue
+                F = _featurize(imgs, pca.pca_mat)
+                feat_parts.append(F[jnp.asarray(rows, jnp.int32)])
+                label_lists.extend(labels)
+            if not feat_parts:
+                raise ValueError(f"no labeled images streamed from {location}")
+            feats = (jnp.concatenate(feat_parts)
+                     if len(feat_parts) > 1 else feat_parts[0])
+            return feats, pad_label_lists(label_lists)
+
+        with Timer("streaming.featurize_train"):
+            train_feats, train_labels = featurize_stream(
+                config.train_location, train_map
+            )
+        labels = ClassLabelIndicatorsFromIntArrayLabels(num_classes)(
+            jnp.asarray(train_labels)
+        )
+        block_size = _resolved_block_size(
+            config, int(train_feats.shape[0]), num_classes
+        )
+        with Timer("fit.block_least_squares"):
+            model = BlockLeastSquaresEstimator(
+                block_size, 1, config.lam
+            ).fit(train_feats, labels)
+
+        with Timer("eval.test_map"):
+            test_feats, test_labels = featurize_stream(
+                config.test_location, load_voc_labels(config.test_labels)
+            )
+            scores = model(test_feats)
+            evaluator = MeanAveragePrecisionEvaluator(num_classes)
+            results["test_map"] = evaluator.mean(
+                jnp.asarray(test_labels), scores
+            )
+
+    frame_bytes = hw[0] * hw[1] * 3 * 4
+    n_total = int(train_feats.shape[0]) + int(test_feats.shape[0])
+    results["wallclock_s"] = total.elapsed
+    results["ingest_images"] = n_total
+    results["ingest_raw_bytes"] = int(n_total * frame_bytes)
+    results["ingest_peak_host_bytes"] = int(ingest_buffers() * bs * frame_bytes)
+    results["ingest_featurize_compiles"] = int(_featurize._cache_size())
+    logger.info(
+        "streaming-ingest TEST APs mean: %.4f  (raw %.1f MB through a "
+        "%.1f MB ring)", results["test_map"],
+        results["ingest_raw_bytes"] / 1e6,
+        results["ingest_peak_host_bytes"] / 1e6,
+    )
+    return results
+
+
+def fit_streaming_ingest(config: VOCSIFTFisherConfig) -> dict:
+    """Public entry for the never-resident streaming-ingest VOC fit (the
+    ``--ingest`` path of :func:`run`)."""
+    import dataclasses as _dc
+
+    if not config.ingest:
+        config = _dc.replace(config, ingest=True)
+    config.validate()
+    return _run_streaming_ingest(config)
+
+
 def run(config: VOCSIFTFisherConfig) -> dict:
+    if config.ingest:
+        config.validate()
+        return _run_streaming_ingest(config)
     if config.buckets:
         config.validate()  # bucketed ingest is the real-archive path only
         return _run_bucketed(config)

@@ -67,6 +67,13 @@ SITES: Tuple[str, ...] = (
     # fixed-shape dispatch, and the response fan-out — the chaos surface
     # scripts/serve_chaos_smoke.py drives under sustained load
     "serve.admit", "serve.dispatch", "serve.respond",
+    # streaming-ingest boundaries (core/ingest.py): per-image decode (a
+    # fired fault IS the bad JPEG — the worker warns and skips the image),
+    # per-archive open/walk (a fired fault IS the truncated tar — the
+    # worker warns and moves to the next archive), and the worker loop
+    # itself (a fired fault kills that decode worker; the pool degrades to
+    # the survivors and the stream must complete, never wedge)
+    "ingest.decode", "ingest.tar", "ingest.worker",
 )
 KINDS: Tuple[str, ...] = ("xla", "oom", "kill", "nan", "inf", "saturate")
 #: kinds that poison data instead of raising — the numerical-fault family

@@ -438,6 +438,18 @@ declare("KEYSTONE_CHECKPOINT_DIR", "str", "",
         "called without checkpoint_path= derives a per-fit file name "
         "under it (utils/retry.py). Empty + no explicit path = error "
         "(an elastic fit without a checkpoint cannot resume).")
+declare("KEYSTONE_INGEST_BUFFERS", "int", 4,
+        "Size of the streaming-ingest host buffer ring (core/ingest.py): "
+        "the HARD bound on simultaneously-live decoded batches — decode "
+        "workers block on a free buffer, so peak decoded-batch host memory "
+        "is buffers x batch_size x frame bytes regardless of dataset size.",
+        validator=_positive)
+declare("KEYSTONE_INGEST_THREADS", "int", 4,
+        "Decode worker threads of the streaming-ingest pipeline "
+        "(core/ingest.py): parallel tar walk + JPEG decode into the host "
+        "buffer ring. Workers touch only host memory; ALL device dispatch "
+        "stays on the consuming thread (the core/prefetch.py single-"
+        "threaded-dispatch deadlock invariant).", validator=_positive)
 declare("KEYSTONE_SKETCH_BCD", "bool", False,
         "Leverage-score block scheduling for block coordinate descent: "
         "visit feature blocks in descending sketched-energy order instead "
@@ -564,6 +576,12 @@ declare("BENCH_KILL_AFTER_SECTION", "str", "",
         "(pins incremental-flush survival). KEYSTONE_FAULTS with a "
         "'bench_section@N[:kill]' entry is the occurrence-indexed "
         "generalization.")
+declare("BENCH_INGEST", "bool", True,
+        "Streaming-ingest section (core/ingest.py): sustained decode GB/s "
+        "over a synthetic tar set, overlapped vs strict-sequential "
+        "decode->extract wall clock, and the never-resident streaming fit "
+        "with its raw-footprint vs peak-host-bytes honesty pair "
+        "(budget-gated; exhaustion emits ingest_skipped).")
 declare("BENCH_HEALTH", "bool", True,
         "Numerical-health section: inject a NaN block into a streaming "
         "weighted fit under KEYSTONE_HEALTH=heal and record "

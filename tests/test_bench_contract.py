@@ -130,6 +130,27 @@ def test_bench_smoke_compact_line_contract(tmp_path):
     assert compact["sv_qps"] == full["serve_sustained_qps"]
     assert compact["sv_p99"] == full["serve_p99_ms"]
     assert compact["sv_shed"] == full["serve_shed_frac"]
+    # streaming-ingest section (PR 15, core/ingest.py): sustained decode
+    # GB/s, the overlap pair, and the never-resident flagship fit with
+    # its raw-vs-peak honesty pair. The on<=off ORDERING is pinned by
+    # make ingest-smoke on the calibrated workload, not here — at smoke
+    # shapes the pair is a scheduler coin flip; this contract pins that
+    # both numbers LAND together (a speed claim never ships without its
+    # strict-sequential twin).
+    assert full["ingest_gbs"] > 0
+    assert full["ingest_overlap_on_s"] > 0
+    assert full["ingest_overlap_off_s"] > 0
+    # the never-resident evidence pair: the streamed fit completed at a
+    # dataset scale whose raw footprint EXCEEDS the ring it held, and
+    # its per-batch reduce program compiled exactly once
+    assert full["ingest_never_resident"] is True
+    assert full["ingest_raw_bytes"] > full["ingest_peak_host_bytes"] > 0
+    assert full["ingest_reduce_compiles"] == 1
+    assert full["ingest_fit_s"] > 0
+    assert compact["in_gbs"] == full["ingest_gbs"]
+    assert compact["in_ov_on"] == full["ingest_overlap_on_s"]
+    assert compact["in_ov_off"] == full["ingest_overlap_off_s"]
+    assert compact["in_fit"] == full["ingest_fit_s"]
     # whole-pipeline-optimizer rows (core/plan.py): the flagship plan's
     # decisions landed, and the repeat plan in the same process performed
     # ZERO re-plans (the content-fingerprinted memo served it)
@@ -222,6 +243,10 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # contract — no QPS claim may land without its budget story
     assert full.get("serve_skipped") == "budget"
     assert "serve_sustained_qps" not in full
+    # ... and the streaming-ingest section (PR 15): same reduced-floor
+    # contract — no decode-GB/s claim may land without its budget story
+    assert full.get("ingest_skipped") == "budget"
+    assert "ingest_gbs" not in full
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):
@@ -239,12 +264,18 @@ def test_bench_section_floor_exhaustion_is_graceful(tmp_path):
             # gate) is what skips them
             "BENCH_FLAGSHIP": "1",
             "BENCH_EXTRACTION": "1",
+            # gate the ingest section OFF: checked BEFORE its budget
+            # floor, so the section must emit neither rows nor a marker
+            "BENCH_INGEST": "0",
         },
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
     compact = json.loads(_last_line(proc.stdout))
     assert "partial" not in compact
     full = json.loads((tmp_path / "bench_full.json").read_text())
+    # BENCH_INGEST=0: gated off entirely — no rows AND no budget marker
+    assert "ingest_gbs" not in full
+    assert "ingest_skipped" not in full
     assert full.get("solver_gflops_per_chip_skipped") == "budget"
     assert (
         full.get("sketch_vs_exact_error_delta_d65536_skipped") == "budget"

@@ -1,0 +1,630 @@
+"""Streaming out-of-core ingest (``core/ingest.py`` + the loaders growth).
+
+The tier's contracts, each pinned through the real entry points:
+
+- ``prefetch_map`` consumes LAZILY through a windowed deque — an unbounded
+  iterable flows through without materializing (the old ``list(items)``
+  defeated out-of-core streaming), with the error-at-own-yield semantics
+  intact on the windowed path.
+- The native loader's name plumbing survives GNU long names: batches
+  refill instead of silently truncating the tail of the name list.
+- Native and pure-Python fallback paths agree on a synthetic tar set.
+- ``BucketedImageLoader`` bucket selection (exact fit stays in its bucket,
+  partial per-bucket batches flush at end of input) and
+  ``_threaded_image_iter`` abandoned-generator cleanup.
+- ``KEYSTONE_INGEST_BUFFERS`` provably bounds live decoded batches (the
+  ``ingest.buffers_live`` gauge family), every buffer recycles, and an
+  abandoned stream leaks neither threads nor leases.
+- ``stream_batches`` always yields the FULL fixed ring shape (zero-padded
+  final batch): one compile, zero steady-state recompiles.
+- Fault surface: an undecodable JPEG costs one image, a corrupt archive
+  costs one archive — the stream completes either way.
+- ``TarIngestNode`` is a declared host stage the checker/planner pass can
+  cost (no C5 un-evaluable hole).
+"""
+
+import gc
+import io
+import os
+import tarfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.ingest import (
+    HostBufferRing,
+    StreamingTarIngest,
+    TarIngestNode,
+    frame_into,
+    stream_batches,
+)
+from keystone_tpu.core.prefetch import prefetch_map
+from keystone_tpu.telemetry import get_registry
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _jpeg_bytes(arr: np.ndarray) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=92)
+    return buf.getvalue()
+
+
+def _write_tar(path, entries, fmt=tarfile.USTAR_FORMAT):
+    with tarfile.open(path, "w", format=fmt) as tf:
+        for name, payload in entries:
+            ti = tarfile.TarInfo(name)
+            ti.size = len(payload)
+            tf.addfile(ti, io.BytesIO(payload))
+
+
+def _make_tarset(tmp_path, num_tars=2, per_tar=8, hw=48, seed=3):
+    rng = np.random.default_rng(seed)
+    paths = []
+    for t in range(num_tars):
+        entries = []
+        for i in range(per_tar):
+            arr = (rng.uniform(0, 1, size=(hw, hw, 3)) * 255).astype(np.uint8)
+            entries.append((f"cls{i % 2}/im_{t}_{i}.jpg", _jpeg_bytes(arr)))
+        p = tmp_path / f"part{t}.tar"
+        _write_tar(p, entries)
+        paths.append(str(p))
+    return paths
+
+
+def _native_lib_or_none():
+    from keystone_tpu.native.ingest import _get_lib
+
+    return _get_lib()
+
+
+# ---------------------------------------------------------------------------
+# prefetch_map: windowed, streaming-safe (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_map_streams_lazy_infinite_iterator():
+    """The old ``items = list(items)`` hung forever here: an UNBOUNDED
+    iterator must flow through with at most depth+1 items pulled ahead of
+    the yield cursor."""
+    pulled = []
+
+    def infinite():
+        i = 0
+        while True:
+            pulled.append(i)
+            yield i
+            i += 1
+
+    depth = 2
+    gen = prefetch_map(lambda i: i * 10, infinite(), depth=depth)
+    got = [next(gen) for _ in range(7)]
+    assert got == [i * 10 for i in range(7)]
+    # windowed laziness: never more than depth+1 raw items ahead of the
+    # yield cursor (7 yielded, so at most 7 + depth + 1 ever pulled)
+    assert len(pulled) <= 7 + depth + 1
+    gen.close()
+
+
+def test_prefetch_map_window_bound_holds_at_every_yield():
+    """The run-ahead window stays bounded THROUGHOUT a long lazy stream,
+    not just at the end — the peak-memory contract streaming ingest
+    rides."""
+    n_pulled = 0
+
+    def lazy(n):
+        nonlocal n_pulled
+        for i in range(n):
+            n_pulled += 1
+            yield i
+
+    depth = 3
+    worst = 0
+    n_yielded = 0
+    for v in prefetch_map(lambda i: i + 1, lazy(60), depth=depth):
+        n_yielded += 1
+        worst = max(worst, n_pulled - n_yielded)
+    assert n_yielded == 60
+    assert worst <= depth + 1, worst
+
+
+def test_prefetch_map_error_at_own_yield_on_lazy_stream():
+    """Windowed mode keeps the error-at-own-yield contract: values before
+    a mid-stream producer failure are all served first, and the failure
+    surfaces exactly at its own position — on a GENERATOR input."""
+
+    def produce(i):
+        if i == 4:
+            raise RuntimeError("boom at 4")
+        return i
+
+    got = []
+    gen = prefetch_map(produce, iter(range(100)), depth=3)
+    with pytest.raises(RuntimeError, match="boom at 4"):
+        for v in gen:
+            got.append(v)
+    assert got == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# native loader name plumbing (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(_native_lib_or_none() is None,
+                    reason="native ingest library unavailable")
+def test_native_loader_long_names_no_tail_truncation(tmp_path):
+    """GNU long names near the walker's 4096-char cap round-trip through
+    the batched native loader with names and images ALIGNED — the old
+    fixed per-call name buffer silently truncated the tail of the name
+    list instead of refilling."""
+    from keystone_tpu.native import PrefetchImageLoader
+
+    hw = 40
+    entries = []
+    imgs = {}
+    for i in range(6):
+        name = f"cls{i}/" + "x" * 3800 + f"_{i}.jpg"
+        # solid colors survive JPEG almost losslessly, so a shifted
+        # name->image pairing is unambiguous (noise would drown in
+        # lossy-codec error)
+        arr = np.full((hw, hw, 3), 30 + 30 * i, np.uint8)
+        entries.append((name, _jpeg_bytes(arr)))
+        imgs[name] = arr
+    _write_tar(tmp_path / "long.tar", entries, fmt=tarfile.GNU_FORMAT)
+
+    loader = PrefetchImageLoader([str(tmp_path / "long.tar")], hw, hw,
+                                 num_threads=2)
+    seen = {}
+    for batch, names in loader.batches(6):
+        assert batch.shape[0] == len(names)
+        for j, n in enumerate(names):
+            seen[n] = batch[j]
+    assert set(seen) == set(imgs), "tail of the long-name list lost"
+    # alignment: each name's frame matches ITS image (not a shifted one)
+    for name, arr in imgs.items():
+        expect = arr.astype(np.float32) / 255.0
+        assert float(np.abs(seen[name] - expect).mean()) < 0.02, name
+
+
+# ---------------------------------------------------------------------------
+# native vs pure-Python fallback parity (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(_native_lib_or_none() is None,
+                    reason="native ingest library unavailable")
+def test_native_vs_python_fallback_batch_parity(tmp_path, monkeypatch):
+    """The two PrefetchImageLoader paths agree on a synthetic tar set:
+    same entry names, same image count, pixels within JPEG-decoder
+    tolerance."""
+    from keystone_tpu.native import ingest as native_ingest
+    from keystone_tpu.native.ingest import PrefetchImageLoader
+
+    tars = _make_tarset(tmp_path, num_tars=2, per_tar=6)
+
+    def collect():
+        out = {}
+        loader = PrefetchImageLoader(tars, 48, 48, num_threads=2)
+        for batch, names in loader.batches(4):
+            for j, n in enumerate(names):
+                out[n] = batch[j].copy()
+        return out
+
+    native = collect()
+    monkeypatch.setattr(native_ingest, "_lib", None)
+    monkeypatch.setattr(native_ingest, "_build_attempted", True)
+    fallback = collect()
+    assert set(native) == set(fallback) and len(native) == 12
+    worst = max(
+        float(np.abs(native[k] - fallback[k]).mean()) for k in native
+    )
+    assert worst <= 2.0 / 255.0, worst
+
+
+def test_streaming_ingest_frames_match_center_frame(tmp_path):
+    """``frame_into`` (the in-place ring-slot form) must produce exactly
+    the loaders' ``_center_frame`` result — including re-zeroed padding on
+    a recycled buffer — for undersize, exact and oversize images."""
+    from keystone_tpu.native.ingest import _center_frame
+
+    rng = np.random.default_rng(11)
+    out = np.empty((64, 64, 3), np.float32)
+    out[:] = 7.0  # dirty recycled-slot contents
+    for shape in [(40, 50), (64, 64), (100, 80)]:
+        img = (rng.uniform(0, 1, size=(*shape, 3)) * 255).astype(np.uint8)
+        frame_into(img, out)
+        np.testing.assert_array_equal(out, _center_frame(img, 64, 64))
+        out[:] = 7.0
+
+
+# ---------------------------------------------------------------------------
+# BucketedImageLoader selection + _threaded_image_iter cleanup (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def test_bucketed_loader_exact_fit_and_partial_flush(tmp_path):
+    """An image exactly matching a bucket lands in THAT bucket (not a
+    larger one), and partial per-bucket batches flush at end of input."""
+    from keystone_tpu.native import BucketedImageLoader
+
+    rng = np.random.default_rng(4)
+
+    def img(h, w):
+        return (rng.uniform(0, 1, size=(h, w, 3)) * 255).astype(np.uint8)
+
+    entries = [
+        ("a/exact.jpg", _jpeg_bytes(img(64, 64))),       # exact fit
+        ("a/small.jpg", _jpeg_bytes(img(40, 40))),       # pads into (64,64)
+        ("a/mid.jpg", _jpeg_bytes(img(90, 90))),         # pads into (128,128)
+    ]
+    _write_tar(tmp_path / "b.tar", entries)
+    loader = BucketedImageLoader(
+        [str(tmp_path / "b.tar")], buckets=[(64, 64), (128, 128)],
+        num_threads=1,
+    )
+    got = {}
+    for hw, imgs, names in loader.batches(batch_size=8):
+        assert imgs.shape[1:] == (*hw, 3)
+        got.setdefault(hw, []).extend(n.split("/")[-1] for n in names)
+    # batch_size 8 was never reached: BOTH buckets flushed partial batches
+    assert sorted(got[(64, 64)]) == ["exact.jpg", "small.jpg"]
+    assert got[(128, 128)] == ["mid.jpg"]
+
+
+def test_threaded_image_iter_abandoned_early_break_no_leaked_threads(
+        tmp_path):
+    """Abandoning ``_threaded_image_iter`` (early break) must stop its
+    worker threads — no thread pinned on a full queue after the consumer
+    walks away."""
+    from keystone_tpu.native.ingest import _threaded_image_iter
+
+    tars = _make_tarset(tmp_path, num_tars=2, per_tar=10)
+    before = threading.active_count()
+    it = _threaded_image_iter(tars, num_threads=3)
+    next(it)
+    it.close()  # runs the generator's finally: stop + drain + join
+    gc.collect()
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+# ---------------------------------------------------------------------------
+# ring bound + recycle (acceptance: KEYSTONE_INGEST_BUFFERS bounds memory)
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_buffers_knob_bounds_live_batches(tmp_path, monkeypatch):
+    """The acceptance gauge pin: with KEYSTONE_INGEST_BUFFERS=2 the
+    ``ingest.buffers_live_peak`` gauge never exceeds 2 across a stream of
+    many more batches than buffers, and every lease is recycled by stream
+    end (live == 0)."""
+    monkeypatch.setenv("KEYSTONE_INGEST_BUFFERS", "2")
+    tars = _make_tarset(tmp_path, num_tars=2, per_tar=12)
+    ingest = StreamingTarIngest(tars, (48, 48), batch_size=4, num_threads=2)
+    assert ingest.num_buffers == 2  # the knob resolved
+    reg = get_registry()
+    n_batches = 0
+    for batch in ingest.batches():
+        n_batches += 1
+        assert reg.get_gauge("ingest.buffers_live") <= 2
+        batch.release()
+    assert n_batches >= 6  # many more batches than buffers: recycling real
+    assert reg.get_gauge("ingest.buffers_live_peak") <= 2
+    assert reg.get_gauge("ingest.buffers_live") == 0
+
+
+def test_ring_acquire_blocks_until_release():
+    """``HostBufferRing.acquire`` IS the memory bound: with every buffer
+    leased the next acquire blocks until a release."""
+    ring = HostBufferRing(2, (1, 4, 4, 3))
+    a = ring.acquire()
+    b = ring.acquire()
+    assert {a, b} == {0, 1}
+    got = []
+
+    def blocked():
+        got.append(ring.acquire())
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert got == []  # still blocked: the ring is the bound
+    ring.release(a)
+    t.join(timeout=5.0)
+    assert got == [a]
+    ring.release(b)
+    ring.release(got[0])
+
+
+def test_abandoned_stream_stops_workers_and_recycles(tmp_path):
+    """Early break out of ``StreamingTarIngest.batches`` stops the decode
+    workers and recycles every lease — no thread or buffer leaks (the
+    wedge class an abandoned consumer used to risk)."""
+    tars = _make_tarset(tmp_path, num_tars=2, per_tar=12)
+    before = threading.active_count()
+    reg = get_registry()
+    ingest = StreamingTarIngest(tars, (48, 48), batch_size=4,
+                                num_threads=2, num_buffers=2)
+    for batch in ingest.batches():
+        break  # abandon mid-stream, lease not even released
+    gc.collect()
+    deadline = time.monotonic() + 10.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+    assert reg.get_gauge("ingest.buffers_live") == 0
+
+
+# ---------------------------------------------------------------------------
+# stream_batches: fixed shape, zero recompiles, padded tail
+# ---------------------------------------------------------------------------
+
+
+def test_stream_batches_fixed_shape_zero_recompiles(tmp_path):
+    """Steady-state streaming consumers compile EXACTLY once: every
+    yielded device batch has the full fixed ring shape, the final partial
+    batch is zero-padded (not shape-changed), and the jitted per-batch
+    program's cache holds one entry after the whole stream."""
+    tars = _make_tarset(tmp_path, num_tars=1, per_tar=10)
+
+    @jax.jit
+    def consume(x):
+        return x.sum(axis=(1, 2, 3))
+
+    bs = 4  # 10 images -> 2 full batches + 1 padded partial
+    totals = []
+    for arr, names, n in stream_batches(
+        StreamingTarIngest(tars, (48, 48), bs, num_threads=2,
+                           num_buffers=2)
+    ):
+        assert arr.shape == (bs, 48, 48, 3)
+        if n < bs:  # the padded tail: zeroed, not stale recycled pixels
+            assert float(jnp.abs(arr[n:]).max()) == 0.0
+        totals.append(int(n))
+        consume(arr).block_until_ready()
+    assert sum(totals) == 10 and totals[-1] == 2
+    assert consume._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# fault surface: bad JPEG, corrupt archive
+# ---------------------------------------------------------------------------
+
+
+def test_undecodable_entry_costs_one_image_not_the_stream(tmp_path):
+    """A garbage JPEG payload is skipped with the ``ingest.bad_images``
+    counter — the stream completes with every other image."""
+    rng = np.random.default_rng(6)
+    hw = 48
+    entries = []
+    for i in range(5):
+        arr = (rng.uniform(0, 1, size=(hw, hw, 3)) * 255).astype(np.uint8)
+        entries.append((f"a/ok_{i}.jpg", _jpeg_bytes(arr)))
+    entries.insert(2, ("a/garbage.jpg", b"\xff\xd8 not a real jpeg"))
+    _write_tar(tmp_path / "bad.tar", entries)
+    reg = get_registry()
+    bad0 = reg.get_counter("ingest.bad_images")
+    names = []
+    for arr, batch_names, n in stream_batches(
+        StreamingTarIngest([str(tmp_path / "bad.tar")], (hw, hw), 2)
+    ):
+        names.extend(batch_names[:n])
+    assert sorted(names) == [f"a/ok_{i}.jpg" for i in range(5)]
+    assert reg.get_counter("ingest.bad_images") - bad0 >= 1
+
+
+def test_corrupt_archive_costs_one_archive_not_the_stream(tmp_path):
+    """A non-tar file in the set charges ``ingest.tar_errors`` and the
+    OTHER archive's images all arrive — one bad archive never wedges the
+    pool."""
+    tars = _make_tarset(tmp_path, num_tars=1, per_tar=6)
+    junk = tmp_path / "junk.tar"
+    junk.write_bytes(b"this is not a tar archive at all" * 8)
+    reg = get_registry()
+    e0 = reg.get_counter("ingest.tar_errors")
+    n_tot = sum(
+        n for _, _, n in stream_batches(
+            StreamingTarIngest([tars[0], str(junk)], (48, 48), 4,
+                               num_threads=2, num_buffers=2)
+        )
+    )
+    assert n_tot == 6
+    assert reg.get_counter("ingest.tar_errors") - e0 >= 1
+
+
+# ---------------------------------------------------------------------------
+# planner/checker integration: ingest as a declared host stage
+# ---------------------------------------------------------------------------
+
+
+def test_tar_ingest_node_is_declared_host_stage(tmp_path):
+    """``TarIngestNode`` declares its C5 ``__contract__`` transfer: the
+    shared propagation pass sees ONE bounded ring batch (no un-evaluable
+    hole), and the planner cost table prices the stage instead of
+    degrading to an unbounded plan."""
+    from keystone_tpu.analysis import contracts
+    from keystone_tpu.core.pipeline import chain
+    from keystone_tpu.core.plan import pipeline_costs
+    from keystone_tpu.ops.images import GrayScaler
+
+    tars = _make_tarset(tmp_path, num_tars=1, per_tar=4)
+    node = TarIngestNode.create(tars, (48, 48), batch_size=4)
+    assert node.jittable is False and node.memoizable is False
+    pipe = chain(node, GrayScaler())
+    records = contracts.propagate_pipeline(
+        pipe, contracts.spec_struct(1)
+    )
+    assert records[0].declared is True
+    assert records[0].issue is None
+    lead = contracts.leading_leaf(records[0].out_aval)
+    assert tuple(lead.shape) == (4, 48, 48, 3)
+    # downstream stages see the declared batch (the checker can propagate
+    # THROUGH ingest), and the planner prices every stage: bounded peaks
+    costs = pipeline_costs(pipe, contracts.spec_struct(1),
+                           with_flops=False)
+    assert costs[0].jittable is False  # host stage = boundary
+    assert all(c.peak_hbm_bytes is not None for c in costs)
+    assert costs[0].out_bytes == 4 * 48 * 48 * 3 * 4
+
+
+def test_tar_ingest_node_apply_batch_probe(tmp_path):
+    """``apply_batch`` is the sampling probe: it materializes the FIRST
+    decoded batch only (seeding PCA/GMM fits), releasing its lease."""
+    tars = _make_tarset(tmp_path, num_tars=1, per_tar=6)
+    node = TarIngestNode.create(tars, (48, 48), batch_size=4)
+    out = node.apply_batch()
+    assert out.shape == (4, 48, 48, 3)
+    assert get_registry().get_gauge("ingest.buffers_live") == 0
+
+
+# ---------------------------------------------------------------------------
+# review-pass regressions: claim/flush deadlock, last-worker death, native
+# mid-payload truncation
+# ---------------------------------------------------------------------------
+
+
+def test_exhausted_ring_with_slow_consumer_no_deadlock(tmp_path):
+    """A worker must never block on the ring while holding the claim lock:
+    with every buffer live and a sealed batch still missing a peer's
+    ``_finish_fill``, that flush needs the same lock — the old in-lock
+    ``ring.acquire`` wedged the stream. One buffer, several workers, and a
+    slow consumer drive exactly that contention; the stream must still
+    deliver every image."""
+    tars = _make_tarset(tmp_path, num_tars=2, per_tar=12, seed=21)
+    done = {}
+
+    def consume():
+        total = 0
+        for batch in StreamingTarIngest(
+            tars, (48, 48), 4, num_threads=4, num_buffers=1
+        ).batches():
+            time.sleep(0.02)  # slow consumer: workers pile up on the ring
+            total += batch.n_valid
+            batch.release()
+        done["total"] = total
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=60.0)
+    assert not t.is_alive(), "streaming ingest deadlocked on the ring"
+    assert done["total"] == 24
+
+
+def test_last_worker_death_respawns_no_data_loss(tmp_path, monkeypatch):
+    """A single-worker pool whose worker dies has no survivors to re-run
+    the re-queued archive — the dying LAST worker must respawn a
+    replacement instead of shipping the done sentinel over pending work
+    (the old path completed cleanly with the tail of the dataset silently
+    missing)."""
+    from keystone_tpu.utils import faults
+
+    tars = _make_tarset(tmp_path, num_tars=3, per_tar=5, seed=22)
+    monkeypatch.setenv("KEYSTONE_FAULTS", "ingest.worker@1")
+    faults.reset()
+    reg = get_registry()
+    d0 = reg.get_counter("ingest.worker_deaths")
+    r0 = reg.get_counter("ingest.worker_respawns")
+    try:
+        names = []
+        for _, batch_names, n in stream_batches(
+            StreamingTarIngest(tars, (48, 48), 4,
+                               num_threads=1, num_buffers=2)
+        ):
+            names.extend(batch_names[:n])
+    finally:
+        monkeypatch.delenv("KEYSTONE_FAULTS")
+        faults.reset()
+    assert len(names) == 15 and len(set(names)) == 15
+    assert reg.get_counter("ingest.worker_deaths") - d0 >= 1
+    assert reg.get_counter("ingest.worker_respawns") - r0 >= 1
+
+
+@pytest.mark.skipif(_native_lib_or_none() is None,
+                    reason="native ingest library unavailable")
+def test_native_mid_payload_truncation_raises_like_fallback(tmp_path):
+    """A tar cut mid-payload must raise ``tarfile.ReadError`` on the
+    NATIVE walker too — the old path yielded the short entry as if whole
+    and ended the archive as a clean EOF, diverging from the fallback and
+    from the truncated-tar fault accounting."""
+    from keystone_tpu.native.ingest import iter_tar_entries
+
+    rng = np.random.default_rng(23)
+    arr = (rng.uniform(0, 1, size=(64, 64, 3)) * 255).astype(np.uint8)
+    whole = tmp_path / "whole.tar"
+    _write_tar(whole, [("a/one.jpg", _jpeg_bytes(arr)),
+                       ("a/two.jpg", _jpeg_bytes(arr))])
+    blob = whole.read_bytes()
+    with tarfile.open(whole) as tf:
+        two = tf.getmembers()[1]
+        cut_at = two.offset_data + two.size // 2  # mid two.jpg's payload
+    cut = tmp_path / "cut.tar"
+    cut.write_bytes(blob[:cut_at])
+    with pytest.raises(tarfile.ReadError):
+        list(iter_tar_entries(str(cut)))
+    # and the streaming tier charges it as ONE bad archive, no wedge
+    reg = get_registry()
+    e0 = reg.get_counter("ingest.tar_errors")
+    n_tot = sum(
+        n for _, _, n in stream_batches(
+            StreamingTarIngest([str(cut)], (48, 48), 2, num_threads=1)
+        )
+    )
+    assert n_tot >= 1  # the whole leading entry still arrives
+    assert reg.get_counter("ingest.tar_errors") - e0 == 1
+
+
+def test_transfer_survives_ring_buffer_mutation(tmp_path):
+    """The ring slot is recycled only after the transfer COMPLETES —
+    PJRT host-buffer semantics are backend-dependent (a device DMA may
+    still be reading the numpy buffer when ``device_put`` returns), so
+    ``stream_batches`` must block on transfer readiness before release.
+    Pin it end to end: overwrite every ring buffer the moment each batch
+    is yielded; the already-yielded device arrays must keep their
+    pixels."""
+    tars = _make_tarset(tmp_path, num_tars=1, per_tar=6, seed=24)
+    ingest = StreamingTarIngest(tars, (48, 48), 2, num_threads=1,
+                                num_buffers=2)
+    arrs = []
+    for arr, _, n in stream_batches(ingest, depth=1):
+        host = np.array(arr)  # snapshot before poisoning the ring
+        for i in range(ingest.ring.num_buffers):
+            ingest.ring.buffer(i)[:] = -7.0  # stomp every slot
+        arrs.append((arr, host, n))
+    assert len(arrs) == 3
+    for arr, host, _ in arrs:
+        np.testing.assert_array_equal(np.array(arr), host)
+
+
+def test_abandoned_stream_with_dead_workers_recycles_queued_leases(tmp_path):
+    """Abandoning the generator AFTER the workers already exited (their
+    final batches flushed and queued) must still recycle every queued
+    lease — the drain loop used to stop at 'no thread alive' and leak
+    them, leaving ``ingest.buffers_live`` pinned above zero."""
+    tars = _make_tarset(tmp_path, num_tars=1, per_tar=8, seed=25)
+    ingest = StreamingTarIngest(tars, (48, 48), 2, num_threads=1,
+                                num_buffers=4)
+    gen = ingest.batches()
+    first = next(gen)
+    first.release()
+    # let the single worker decode the whole tiny set and exit: the
+    # remaining batches now sit flushed in the ready queue, workers gone
+    deadline = time.monotonic() + 10.0
+    while any(t.is_alive() for t in ingest._last_state["threads"]):
+        if time.monotonic() > deadline:
+            raise AssertionError("worker did not finish the tiny tar set")
+        time.sleep(0.02)
+    gen.close()  # abandon with queued batches and no live workers
+    assert get_registry().get_gauge("ingest.buffers_live") == 0
