@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke ingest-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke kernel-search-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke ingest-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -68,6 +68,7 @@ verify-fast: lint
 	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=120 $(PY) bench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/autotune_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/kernel_search_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/plan_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/audit_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/check_smoke.py
@@ -141,6 +142,13 @@ plan-smoke:
 # the winner (scripts/autotune_smoke.py); CPU, seconds.
 autotune-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/autotune_smoke.py
+
+# Kernel variant search end to end: tiny interpret-mode sweep of the fused
+# conv.pool span's variant space against a throwaway cache -> persisted
+# bare + #variant entries -> reload with zero re-sweeps -> fused parity vs
+# the split pair (scripts/kernel_search_smoke.py); CPU, <20 s.
+kernel-search-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/kernel_search_smoke.py
 
 bench:
 	$(PY) bench.py

@@ -119,6 +119,45 @@ def _flush(out: dict, section: str) -> None:
     faults.check("bench_section")
 
 
+def _cursor_path() -> str:
+    """The persisted round-robin cursor for the in-process secondary
+    sections (``KEYSTONE_BENCH_CURSOR``; default: ``.bench_cursor.json``
+    at the repo root — local artifact, gitignored)."""
+    p = knobs.get("KEYSTONE_BENCH_CURSOR")
+    if p:
+        return p
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_cursor.json")
+
+
+def _rotate_secondary(sections):
+    """Round-robin start-index rotation of the secondary section list,
+    persisted across runs: run N starts at section ``N % len``, so a
+    budget that exhausts partway down the list (the BENCH_r06–r08 failure
+    mode: the tail sections NEVER ran) still gives every section fresh
+    coverage within ``len(sections)`` runs. The cursor advances even when
+    every section budget-skips — a run that starves the whole list must
+    not freeze the rotation. Returns ``(cursor_used, rotated_list)``; an
+    unreadable/unwritable cursor file degrades to cursor 0 (the exact
+    pre-cursor order) rather than failing the bench."""
+    path = _cursor_path()
+    cursor = 0
+    try:
+        with open(path) as f:
+            cursor = int(json.load(f).get("secondary", 0))
+    except (OSError, ValueError, TypeError, AttributeError):
+        pass
+    cursor %= len(sections)
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"secondary": cursor + 1}, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"bench cursor not persisted: {e}", file=sys.stderr)
+    return cursor, sections[cursor:] + sections[:cursor]
+
+
 def _load_cpu_baseline():
     """The measured CPU anchor (scripts/cpu_baseline.py); None if absent."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -2039,15 +2078,20 @@ def main():
         _flush(out, "voc_refdim")
     # in-process secondary sections: each gated on the remaining budget and
     # flushed on completion, so a driver kill mid-run costs at most ONE
-    # section's rows — never the artifact
-    for name, fn in (
+    # section's rows — never the artifact. The start index round-robins
+    # across runs (persisted cursor), so budget exhaustion partway down
+    # the list rotates WHICH sections starve instead of always the tail.
+    cursor, secondary = _rotate_secondary([
         ("extras", _try_extras),
         ("cache", _try_cache_rows),
         ("prefetch", _try_prefetch_rows),
         ("moments", _try_moments_design_point),
         ("constants", _try_device_count_constants),
         ("serve_latency", _try_serving_latency),
-    ):
+    ])
+    out["bench_secondary_cursor"] = cursor
+    out["bench_secondary_order"] = ",".join(n for n, _ in secondary)
+    for name, fn in secondary:
         if _budget_remaining() - _FINALIZE_RESERVE_S < _SECTION_FLOOR_S:
             out[f"{name}_skipped"] = "budget"
             print(f"bench section {name} skipped: budget exhausted",

@@ -140,6 +140,8 @@ INTENDED_PRECISION: Dict[str, Tuple[str, str]] = {
     "pallas.sift_bins_xla": ("f32", "f32"),
     "pallas.fv_encode": ("f32", "f32"),
     "pallas.fv_encode_xla": ("f32", "f32"),
+    "pallas.conv_pool_fused": ("f32", "f32"),
+    "pallas.conv_pool_split": ("f32", "f32"),
     "dag.fused_segment": ("f32", "f32"),
     "serve.dispatch": ("f32", "f32"),
     # the bf16 storage tier's audited programs (KEYSTONE_PRECISION_TIER)
@@ -148,6 +150,7 @@ INTENDED_PRECISION: Dict[str, Tuple[str, str]] = {
     "solver.normal_equations_bf16": ("bf16", "f32"),
     "solver.sketch_bf16": ("bf16", "f32"),
     "pallas.sift_bins_bf16": ("bf16", "f32"),
+    "pallas.conv_pool_fused_bf16": ("bf16", "f32"),
 }
 
 
@@ -690,6 +693,78 @@ def _build_fv_encode_xla(devices) -> Built:
         return FV._fv_cols_batch_f32(x_, gmm, 0, k)
 
     return Built(fn=fn, args=(x,), k=1, expect=dict())
+
+
+def _conv_pool_args():
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = _rng()
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, 14, 14, 3)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(7, 27)).astype(np.float32))
+    return imgs, filters
+
+
+@register("pallas.conv_pool_fused", "pallas")
+def _build_conv_pool_fused(devices) -> Built:
+    """The fusion-span variant winner (``conv.pool`` → ``fused.yx``): one
+    kernel holding the convolved patch block VMEM-resident through
+    normalization AND sum pooling — the intermediate never reaches HBM.
+    Must be A1-clean (single-device, zero collectives) and A4-clean
+    (no gross MXU padding waste) — the same gate ``variants.
+    validate_variant`` applies before the autotuner may sweep it."""
+    from keystone_tpu.ops.pallas.extraction import conv_norm_pool
+
+    imgs, filters = _conv_pool_args()
+    # no tile_kernel cross-check: conv.pool tiles the FILTER axis, not the
+    # audited row count — the A4 jaxpr walk still covers the matmul dims
+    return Built(
+        fn=lambda im: conv_norm_pool(
+            im, filters, num_channels=3, normalize=True, var_constant=10.0,
+            stride=2, pool_size=3, tile_f=64, interpret=True,
+            variant="fused.yx",
+        ),
+        args=(imgs,), k=1,
+        expect=dict(check_padding=True),
+    )
+
+
+@register("pallas.conv_pool_split", "pallas")
+def _build_conv_pool_split(devices) -> Built:
+    """The fused variant's reference form: the split conv.norm → HBM →
+    pool.sum kernel pair (the incumbent the autotuner times the fusion
+    against, and the program served when the fused variant loses or is
+    rejected)."""
+    from keystone_tpu.ops.pallas.extraction import conv_norm_pool
+
+    imgs, filters = _conv_pool_args()
+    return Built(
+        fn=lambda im: conv_norm_pool(
+            im, filters, num_channels=3, normalize=True, var_constant=10.0,
+            stride=2, pool_size=3, tile_f=64, interpret=True,
+            variant="split",
+        ),
+        args=(imgs,), k=1,
+        expect=dict(check_padding=True),
+    )
+
+
+@register("pallas.conv_pool_fused_bf16", "pallas")
+def _build_conv_pool_fused_bf16(devices) -> Built:
+    """bf16-input fused conv→pool variant: bf16 image streams, f32
+    in-VMEM conv/norm/pool arithmetic and f32 output."""
+    from keystone_tpu.ops.pallas.extraction import conv_norm_pool
+
+    imgs, filters = _conv_pool_args()
+    return Built(
+        fn=lambda im: conv_norm_pool(
+            im, filters, num_channels=3, normalize=True, var_constant=10.0,
+            stride=2, pool_size=3, tile_f=64, interpret=True, tier="bf16",
+            variant="fused.yx",
+        ),
+        args=(imgs,), k=1,
+        expect=dict(),
+    )
 
 
 # -- fused pipeline segment --------------------------------------------------

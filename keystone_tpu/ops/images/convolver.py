@@ -49,20 +49,22 @@ class Convolver(Transformer):
         return self.apply_batch(img[None])[0]
 
     def apply_batch(self, imgs):
-        tile = self._pallas_tile(imgs)
-        if tile is not None:
-            return self._apply_batch_pallas(imgs, tile)
+        plan = self._pallas_plan(imgs)
+        if plan is not None:
+            return self._apply_batch_pallas(imgs, *plan)
         return self._apply_batch_xla(imgs)
 
-    def _pallas_tile(self, imgs):
-        """Autotuned filter-tile width when the fused Pallas kernel should
+    def _pallas_plan(self, imgs):
+        """``(variant, tile_f, tier)`` when the fused Pallas kernel should
         run, else None (the XLA twin). The kernel is explicit-grade
         (``KEYSTONE_PALLAS=1`` only — see ``ops/pallas/extraction.py``) and
         additionally requires a tile whose per-image working set fits
-        VMEM."""
+        VMEM; the loop-order variant is the autotuner's measured
+        cross-variant winner (``conv_norm_plan``)."""
         from keystone_tpu.core.cache import has_tracers
+        from keystone_tpu.linalg.solvers import resolve_precision_tier
         from keystone_tpu.ops.pallas.extraction import (
-            conv_norm_tile,
+            conv_norm_plan,
             pallas_enabled,
         )
 
@@ -76,12 +78,17 @@ class Convolver(Transformer):
         h, w = int(imgs.shape[1]), int(imgs.shape[2])
         if h < k or w < k:
             return None
-        return conv_norm_tile(
+        tier = resolve_precision_tier(None)
+        variant, tile = conv_norm_plan(
             h, w, c, k, int(self.filters.shape[0]),
-            allow_sweep=not has_tracers(imgs),
+            allow_sweep=not has_tracers(imgs), tier=tier,
         )
+        if tile is None:
+            return None
+        return variant, tile, tier
 
-    def _apply_batch_pallas(self, imgs, tile_f: int):
+    def _apply_batch_pallas(self, imgs, variant: str, tile_f: int,
+                            tier: str = "f32"):
         """Fused kernel path: one HBM read of each image, im2col matmul +
         patch statistics + normalization + whitener shift all in VMEM
         (``ops/pallas/extraction.py::conv_norm``) — no raw/s1/s2
@@ -99,6 +106,8 @@ class Convolver(Transformer):
                 None if self.whitener is None else self.whitener.means
             ),
             tile_f=tile_f,
+            tier=tier,
+            variant=variant,
         )
 
     def _apply_batch_xla(self, imgs):

@@ -252,7 +252,7 @@ def _fv_cols_batch_pallas(x, gmm: GaussianMixtureModel, lo: int, hi: int):
     resolved — the same trace-time-read semantics as
     :func:`_fv_moment_impl`'s own knob."""
     from keystone_tpu.linalg.solvers import resolve_precision_tier
-    from keystone_tpu.ops.pallas.extraction import fv_encode_tile, fv_moments
+    from keystone_tpu.ops.pallas.extraction import fv_encode_plan, fv_moments
 
     n_img, nd, d = x.shape
     k = gmm.means.shape[0]
@@ -261,11 +261,12 @@ def _fv_cols_batch_pallas(x, gmm: GaussianMixtureModel, lo: int, hi: int):
     from keystone_tpu.core.cache import has_tracers
 
     tier = resolve_precision_tier(None)
-    tile_nd = fv_encode_tile(
+    variant, tile_nd = fv_encode_plan(
         nd, d, k, allow_sweep=not has_tracers(x), tier=tier
     )
     qsum_full, qx_full, qx2_full = fv_moments(
-        x, gmm.means, gmm.variances, gmm.weights, tile_nd=tile_nd, tier=tier
+        x, gmm.means, gmm.variances, gmm.weights, tile_nd=tile_nd,
+        tier=tier, variant=variant,
     )
     inv_n = 1.0 / nd
     m_rng = (lo, min(hi, k)) if lo < k else None

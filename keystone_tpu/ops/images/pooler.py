@@ -68,35 +68,43 @@ class Pooler(Transformer):
                 return False
         return True
 
-    def _pallas_tile_for(self, imgs):
-        """Channel-tile width when the fused kernel should run on this
+    def _pallas_plan_for(self, imgs):
+        """``(variant, tile_c)`` when the fused kernel should run on this
         (N, H, W, C) batch, else None (the XLA twin). The single decision
         point for both ``apply`` and ``apply_batch`` — ``apply`` must not
         route through ``apply_batch``'s fallback (the inherited twin is
-        vmap-of-apply; a shared fallback would recurse)."""
+        vmap-of-apply; a shared fallback would recurse). The contraction-
+        order variant is the autotuner's measured winner
+        (``pool_sum_plan``)."""
         if imgs.ndim != 4 or not self._pallas_ok(imgs[0]):
             return None
-        from keystone_tpu.ops.pallas.extraction import pool_sum_tile
+        from keystone_tpu.core.cache import has_tracers
+        from keystone_tpu.ops.pallas.extraction import pool_sum_plan
 
         h, w, c = int(imgs.shape[1]), int(imgs.shape[2]), int(imgs.shape[3])
         if self.pixel_function is not None:
             # untiled full channel block (budget-checked in _pallas_ok) —
-            # resolving a channel tile here would be a wasted lookup
-            return c
-        return pool_sum_tile(h, w, c)  # None when no tile fits VMEM
+            # resolving a channel tile here would be a wasted lookup; the
+            # hand-written contraction order rides along
+            return "hw", c
+        variant, tile = pool_sum_plan(
+            h, w, c, stride=self.stride, pool_size=self.pool_size,
+            allow_sweep=not has_tracers(imgs),
+        )
+        return None if tile is None else (variant, tile)
 
-    def _pallas_batch(self, imgs, tile_c: int):
+    def _pallas_batch(self, imgs, variant: str, tile_c: int):
         from keystone_tpu.ops.pallas.extraction import pool_sum
 
         return pool_sum(
             imgs, self.stride, self.pool_size, self.pixel_function,
-            tile_c=tile_c,
+            tile_c=tile_c, variant=variant,
         )
 
     def apply(self, img):
-        tile_c = self._pallas_tile_for(img[None]) if img.ndim == 3 else None
-        if tile_c is not None:
-            return self._pallas_batch(img[None], tile_c)[0]
+        plan = self._pallas_plan_for(img[None]) if img.ndim == 3 else None
+        if plan is not None:
+            return self._pallas_batch(img[None], *plan)[0]
         return self._apply_xla(img)
 
     def apply_batch(self, imgs):
@@ -104,9 +112,9 @@ class Pooler(Transformer):
         (pixel-function + both selection matmuls in VMEM, see
         ``ops/pallas/extraction.py::pool_sum``), else the inherited
         vmap-of-apply twin — byte-identical to the pre-kernel behavior."""
-        tile_c = self._pallas_tile_for(imgs)
-        if tile_c is not None:
-            return self._pallas_batch(imgs, tile_c)
+        plan = self._pallas_plan_for(imgs)
+        if plan is not None:
+            return self._pallas_batch(imgs, *plan)
         return Transformer.apply_batch(self, imgs)
 
     def _apply_xla(self, img):

@@ -160,12 +160,13 @@ def _bin_select_matrix(L: int, n_f: int, step: int, bin_size: int,
     jax.jit,
     static_argnames=(
         "step", "bin_size", "min_bound", "height", "width", "impl",
-        "pallas_tile", "pallas_tier",
+        "pallas_tile", "pallas_tier", "pallas_variant",
     ),
 )
 def _dsift_single_scale(img, step: int, bin_size: int, min_bound: int,
                         height: int, width: int, impl: str = "auto",
-                        pallas_tile: int = 0, pallas_tier: str = "f32"):
+                        pallas_tile: int = 0, pallas_tier: str = "f32",
+                        pallas_variant: str = "unroll"):
     """One dsift scale over a batch: (..., H, W) -> (..., ny*nx, 128) plus
     the pre-normalization gradient mass (..., ny*nx).
 
@@ -182,8 +183,9 @@ def _dsift_single_scale(img, step: int, bin_size: int, min_bound: int,
     ``impl``: "auto" | "matmul" | "window" | "pallas" (forced, for parity
     tests); ``pallas_tile`` is the autotuned row-tile height (0 = the
     kernel default) and ``pallas_tier`` the storage dtype tier
-    (``KEYSTONE_PRECISION_TIER``) — both resolved EAGERLY by the caller
-    and jit-static here."""
+    (``KEYSTONE_PRECISION_TIER``); ``pallas_variant`` the generated
+    kernel form (``sift_bins_plan``'s measured winner) — all resolved
+    EAGERLY by the caller and jit-static here."""
     mag, angle = _gradient_polar(img)
 
     ny, nx = dsift_geometry(width, height, step, bin_size, min_bound)
@@ -206,7 +208,7 @@ def _dsift_single_scale(img, step: int, bin_size: int, min_bound: int,
             # (..., T, H, W) energy tensor in HBM
             gx = sift_oriented_bins(
                 mag, angle, Mx_np, tile_r=pallas_tile or 256,
-                tier=pallas_tier,
+                tier=pallas_tier, variant=pallas_variant,
             )
         else:
             energies = _orientation_energies(mag, angle)  # (..., T, H, W)
@@ -313,14 +315,16 @@ class SIFTExtractor(Transformer):
         # Kernel/twin selection + tile resolution happen HERE, eagerly:
         # the decision and the autotuned tile are jit-static below, so
         # KEYSTONE_PALLAS=0 reproduces the exact prior program.
-        impl, tile, tier = _resolve_impl_and_tile(self, img)
+        impl, tile, tier, variant = _resolve_impl_and_tile(self, img)
         return _extract_jit(
             img, self.step_size, self.bin_size, self.scales,
-            self.scale_step, impl, tile, tier,
+            self.scale_step, impl, tile, tier, variant,
         )
 
 
-def _resolve_impl_and_tile(node: "SIFTExtractor", img) -> Tuple[str, int, str]:
+def _resolve_impl_and_tile(
+    node: "SIFTExtractor", img
+) -> Tuple[str, int, str, str]:
     """``KEYSTONE_PALLAS`` + autotuner + precision-tier resolution for one
     extract call (``"auto"`` keeps the pre-kernel selection verbatim). The
     tile is resolved at scale-0 geometry — the dominant scale — and shared
@@ -328,16 +332,19 @@ def _resolve_impl_and_tile(node: "SIFTExtractor", img) -> Tuple[str, int, str]:
     (``KEYSTONE_PRECISION_TIER``) is resolved here too, so both ride into
     the jit as static arguments and a knob flip always recompiles instead
     of serving a stale program. Sweeps are suppressed when the image is a
-    tracer (extract under an outer jit): lookup/default only."""
+    tracer (extract under an outer jit): lookup/default only. The kernel
+    VARIANT rides along the same way: ``sift_bins_plan`` arbitrates the
+    measured cross-variant winner (persisted entries only unless
+    sweeping), and the name is jit-static like the tile."""
     from keystone_tpu.core.cache import has_tracers
     from keystone_tpu.linalg.solvers import resolve_precision_tier
     from keystone_tpu.ops.pallas.extraction import (
         pallas_enabled,
-        sift_bins_tile,
+        sift_bins_plan,
     )
 
     if not pallas_enabled():
-        return "auto", 0, "f32"
+        return "auto", 0, "f32", "unroll"
     tier = resolve_precision_tier(None)
     shape = img.shape
     height, width = shape[-2], shape[-1]
@@ -347,23 +354,23 @@ def _resolve_impl_and_tile(node: "SIFTExtractor", img) -> Tuple[str, int, str]:
     _, nx = dsift_geometry(
         width, height, node.step_size, node.bin_size, 1 + 2 * node.scales
     )
-    tile = sift_bins_tile(
+    variant, tile = sift_bins_plan(
         lead * height, width, max(nx, 1) * NUM_BIN_S,
         allow_sweep=not has_tracers(img), tier=tier,
     )
-    return "pallas", int(tile), tier
+    return "pallas", int(tile), tier, variant
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "step_size", "bin_size", "scales", "scale_step", "impl",
-        "pallas_tile", "pallas_tier",
+        "pallas_tile", "pallas_tier", "pallas_variant",
     ),
 )
 def _extract_jit(img, step_size: int, bin_size: int, scales: int,
                  scale_step: int, impl: str = "auto", pallas_tile: int = 0,
-                 pallas_tier: str = "f32"):
+                 pallas_tier: str = "f32", pallas_variant: str = "unroll"):
     height, width = img.shape[-2], img.shape[-1]
     per_scale = []
     for s in range(scales):
@@ -373,7 +380,7 @@ def _extract_jit(img, step_size: int, bin_size: int, scales: int,
         smoothed = _gaussian_blur(img, bin_s / 6.0)
         desc, mass = _dsift_single_scale(
             smoothed, step_s, bin_s, min_bound, height, width, impl,
-            pallas_tile, pallas_tier,
+            pallas_tile, pallas_tier, pallas_variant,
         )
         desc = jnp.where((mass > CONTRAST_THRESHOLD)[..., None], desc, 0.0)
         per_scale.append(desc)

@@ -3,15 +3,24 @@
 Each kernel has an XLA fallback selected automatically off-TPU (and usable
 under ``vmap``); the Pallas paths are the HBM-bandwidth-bound inner loops
 where XLA's fusion leaves traffic on the table (SURVEY.md §2.8 TPU mapping).
+Every kernel is generated over a small variant space (loop order, fusion
+span — ``ops/pallas/variants.py``) and the autotuner arbitrates the
+measured winner per ``(device, shape bucket, precision tier, variant)``.
 """
 
-from keystone_tpu.ops.pallas import autotune
+from keystone_tpu.ops.pallas import autotune, variants
 from keystone_tpu.ops.pallas.extraction import (
     conv_norm,
+    conv_norm_plan,
+    conv_norm_pool,
+    conv_pool_plan,
     default_interpret,
+    fv_encode_plan,
     fv_moments,
     pallas_enabled,
     pool_sum,
+    pool_sum_plan,
+    sift_bins_plan,
     sift_oriented_bins,
 )
 from keystone_tpu.ops.pallas.moments import (
@@ -24,7 +33,11 @@ from keystone_tpu.ops.pallas.moments import (
 __all__ = [
     "autotune",
     "conv_norm",
+    "conv_norm_plan",
+    "conv_norm_pool",
+    "conv_pool_plan",
     "default_interpret",
+    "fv_encode_plan",
     "fv_moments",
     "gmm_moments",
     "gmm_moments_auto",
@@ -32,5 +45,8 @@ __all__ = [
     "gmm_moments_xla",
     "pallas_enabled",
     "pool_sum",
+    "pool_sum_plan",
+    "sift_bins_plan",
     "sift_oriented_bins",
+    "variants",
 ]

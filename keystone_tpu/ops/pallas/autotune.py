@@ -49,6 +49,14 @@ The cache file format (``version`` guards future migrations)::
 
 ``value`` is whatever the kernel tunes — a tile height for the row-tiled
 kernels, a tile-count target for the overlap schedulers.
+
+Bucket keys compose ``"<shape>[@tier][#variant]"``: the precision tier
+(:func:`precision_bucket`) and, since the kernel-variant search
+(``ops/pallas/variants.py``), a ``#<variant>`` suffix for non-default
+generated kernel variants (``variants.variant_bucket``). The DEFAULT
+variant of every kernel keeps the bare key, so pre-variant tile-only
+entries remain valid winners; entries naming an unknown tier or variant
+are pruned on load (:func:`_sanitize`), never served.
 """
 
 from __future__ import annotations
@@ -136,6 +144,41 @@ def precision_bucket(bucket: str, tier: Optional[str] = None) -> str:
     return f"{bucket}@{tier}"
 
 
+def _known_variant_spaces() -> Optional[Dict[str, Any]]:
+    """The kernel-variant registry (``ops/pallas/variants.py``), imported
+    LAZILY so sanitization always sees the fully-populated spaces (an
+    import-time snapshot could prune valid ``#variant`` entries registered
+    later). None when the registry is unavailable — in that case variant
+    suffixes cannot be judged and are kept, never silently dropped."""
+    try:
+        from keystone_tpu.ops.pallas import variants
+
+        return variants.VARIANT_SPACES
+    except Exception:
+        return None
+
+
+def _bucket_key_ok(kernel: str, b: str) -> bool:
+    """Whether one bucket key names a (tier, variant) this build speaks.
+    Keys read ``"<shape>[@tier][#variant]"`` — the variant suffix joins
+    LAST (``variants.variant_bucket`` composes over ``precision_bucket``).
+    A precision tier outside :data:`KNOWN_TIERS` or a ``#variant`` not in
+    the kernel's declared space (hand edit, future format, renamed
+    variant) is stale and must not shadow — or be mistaken for — a real
+    winner. Default variants never carry a suffix, so every pre-variant
+    tile-only key passes unchanged."""
+    base, sep, var = b.partition("#")
+    if "@" in base and base.rsplit("@", 1)[1] not in KNOWN_TIERS:
+        return False
+    if not sep:
+        return True
+    spaces = _known_variant_spaces()
+    if spaces is None:  # registry unavailable: keep rather than destroy
+        return True
+    space = spaces.get(kernel)
+    return bool(space) and var in space
+
+
 def cache_path() -> str:
     """``KEYSTONE_AUTOTUNE_CACHE`` when set, else ``autotune_cache.json`` at
     the repo root (next to ``lint_baseline.json`` — same ratchet-artifact
@@ -175,11 +218,7 @@ def _sanitize(raw: Any) -> Optional[Dict[str, Any]]:
             good = {
                 b: e for b, e in buckets.items()
                 if isinstance(e, dict) and "value" in e
-                # precision-qualified buckets ("<shape>@<tier>") must name
-                # a KNOWN tier: an entry for a tier this build does not
-                # speak (hand edit, future format) is stale and must not
-                # shadow — or be mistaken for — a real winner
-                and ("@" not in b or b.rsplit("@", 1)[1] in KNOWN_TIERS)
+                and _bucket_key_ok(str(kname), b)
             }
             pruned = pruned or len(good) != len(buckets)
             if good:
@@ -243,6 +282,21 @@ def _peek(kernel: str, bucket: str) -> Optional[Any]:
     return None if entry is None else entry.get("value")
 
 
+def peek_entry(kernel: str, bucket: str) -> Optional[Dict[str, Any]]:
+    """The FULL persisted entry (``{"value", "us", "swept"}``) for
+    ``(kernel, device_key(), bucket)``, or None — no counters, no sweeps.
+    The variant search (``ops/pallas/variants.py``) arbitrates winners on
+    the persisted ``us`` latencies, which :func:`lookup`'s value-only
+    contract cannot expose."""
+    path = cache_path()
+    with _LOCK:
+        data = _load_locked(path)
+        entry = (
+            data["devices"].get(device_key(), {}).get(kernel, {}).get(bucket)
+        )
+    return None if entry is None else dict(entry)
+
+
 def lookup(kernel: str, bucket: str) -> Optional[Any]:
     """The persisted winner for ``(kernel, device_key(), bucket)``, or None.
 
@@ -280,12 +334,18 @@ def record(
     degrade to best-effort.)"""
     global _MEM, _MEM_PATH
     path = cache_path()
+    # The flock sidecar is created LAZILY, here and only here (the first
+    # actual write), and only when the cache directory already exists —
+    # an unwritable/missing dir must not grow a dangling ``.lock`` while
+    # the entry itself degrades to in-memory-only. The sidecar is a local
+    # artifact: gitignored, never committed (it used to be).
     lockf = None
     try:
-        import fcntl
+        if os.path.isdir(os.path.dirname(os.path.abspath(path))):
+            import fcntl
 
-        lockf = open(f"{path}.lock", "w")
-        fcntl.flock(lockf, fcntl.LOCK_EX)
+            lockf = open(f"{path}.lock", "w")
+            fcntl.flock(lockf, fcntl.LOCK_EX)
     except Exception:
         if lockf is not None:
             lockf.close()

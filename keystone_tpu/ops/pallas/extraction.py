@@ -104,7 +104,8 @@ def _count(event: str, **labels) -> None:
 # the chip.
 
 
-def _sift_bins_kernel(mag_ref, ang_ref, sel_ref, out_ref, *, q_pad: int):
+def _sift_bins_kernel(mag_ref, ang_ref, sel_ref, out_ref, *, q_pad: int,
+                      variant: str = "unroll"):
     # bf16-input variant (KEYSTONE_PRECISION_TIER=bf16): the refs stream
     # bfloat16 tiles HBM→VMEM (half the traffic of the kernel's dominant
     # read) and upcast IN VMEM — all binning arithmetic and the selection
@@ -114,6 +115,25 @@ def _sift_bins_kernel(mag_ref, ang_ref, sel_ref, out_ref, *, q_pad: int):
     ang = ang_ref[:].astype(jnp.float32)
     ft = jnp.mod(ang * (NUM_BIN_T / (2.0 * jnp.pi)), NUM_BIN_T)
     sel = sel_ref[:]  # (W, Qp); padded columns are zero -> poison-free
+    if variant == "stack":
+        # generated loop-order variant: build all 8 weighted magnitude
+        # maps at once and contract them in ONE (8·TR, W) @ (W, Qp)
+        # matmul — 8x taller MXU pass instead of 8 short ones; per-slab
+        # results are identical sums, just batched
+        tr, wdim = mag.shape
+        ts = jax.lax.broadcasted_iota(jnp.float32, (NUM_BIN_T, 1, 1), 0)
+        d = jnp.mod(ft[None, :, :] - ts, float(NUM_BIN_T))
+        w = jnp.maximum(0.0, 1.0 - d) + jnp.maximum(
+            0.0, d - (NUM_BIN_T - 1.0)
+        )
+        res = jnp.dot(
+            (mag[None, :, :] * w).reshape(NUM_BIN_T * tr, wdim), sel,
+            preferred_element_type=jnp.float32,
+        ).reshape(NUM_BIN_T, tr, q_pad)
+        out_ref[:] = jnp.moveaxis(res, 0, 1).reshape(
+            tr, NUM_BIN_T * q_pad
+        )
+        return
     for t in range(NUM_BIN_T):
         d = jnp.mod(ft - float(t), NUM_BIN_T)
         w = jnp.maximum(0.0, 1.0 - d) + jnp.maximum(
@@ -124,8 +144,11 @@ def _sift_bins_kernel(mag_ref, ang_ref, sel_ref, out_ref, *, q_pad: int):
         )
 
 
-@functools.partial(jax.jit, static_argnames=("tile_r", "interpret"))
-def _sift_bins_pallas(mag2, ang2, sel_p, *, tile_r: int, interpret: bool):
+@functools.partial(
+    jax.jit, static_argnames=("tile_r", "interpret", "variant")
+)
+def _sift_bins_pallas(mag2, ang2, sel_p, *, tile_r: int, interpret: bool,
+                      variant: str = "unroll"):
     rows, w = mag2.shape
     q_pad = sel_p.shape[1]
     grid = (pl.cdiv(rows, tile_r),)
@@ -135,7 +158,7 @@ def _sift_bins_pallas(mag2, ang2, sel_p, *, tile_r: int, interpret: bool):
     # lands in output rows >= ``rows`` — trimmed by the caller. The padded
     # ``sel`` columns are zero, so lane padding in Q is poison-free too.
     return pl.pallas_call(
-        functools.partial(_sift_bins_kernel, q_pad=q_pad),
+        functools.partial(_sift_bins_kernel, q_pad=q_pad, variant=variant),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile_r, w), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -161,41 +184,95 @@ def sift_bins_tile(rows: int, width: int, q: int,
     f32 call or vice versa, and the sweep itself times operands of the
     tier's storage dtype. ``allow_sweep=False`` is lookup-only — pass it
     when resolving from inside a trace (a sweep times real executions)."""
+    return sift_bins_plan(rows, width, q, allow_sweep=allow_sweep,
+                          tier=tier, variant_search=False)[1]
+
+
+def _sift_validate_args(tier: str):
+    key = jax.random.key(11)
+    mag = jax.random.uniform(key, (48, 32), jnp.float32)
+    ang = jax.random.uniform(key, (48, 32), jnp.float32, -jnp.pi, jnp.pi)
+    sel = np.zeros((32, 9), np.float32)
+    sel[::3, :] = 1.0
+    return mag, ang, sel
+
+
+def sift_bins_plan(rows: int, width: int, q: int,
+                   allow_sweep: bool = True, tier: str = "f32",
+                   variant_search: bool = True) -> tuple:
+    """``(variant, tile_r)`` for ``sift.bins`` at this bucket/tier: the
+    row tile resolves per variant through the autotuner and the measured
+    cross-variant winner serves (``variants.search``).
+    ``variant_search=False`` restricts to the default (unroll) form — the
+    legacy :func:`sift_bins_tile` contract. EAGER-only when sweeping."""
+    from keystone_tpu.ops.pallas import variants
+
     bucket = autotune.precision_bucket(
         autotune.shape_bucket(rows, width), tier
     )
     q_pad = _round_up(max(q, 1), _LANE)
     in_dtype = jnp.bfloat16 if tier == "bf16" else jnp.float32
 
-    def build(tile):
-        key = jax.random.key(0)
-        mag = jax.random.uniform(key, (rows, width), jnp.float32)
-        ang = jax.random.uniform(
-            key, (rows, width), jnp.float32, -jnp.pi, jnp.pi
-        )
-        sel = jnp.zeros((width, q_pad), jnp.float32).at[:, :q].set(1.0)
-        interp = default_interpret()
-        return lambda i: _sift_bins_pallas(
-            (mag + float(i)).astype(in_dtype), ang.astype(in_dtype), sel,
-            tile_r=tile, interpret=interp,
+    def measure_for(name):
+        def build(tile):
+            key = jax.random.key(0)
+            mag = jax.random.uniform(key, (rows, width), jnp.float32)
+            ang = jax.random.uniform(
+                key, (rows, width), jnp.float32, -jnp.pi, jnp.pi
+            )
+            sel = jnp.zeros((width, q_pad), jnp.float32).at[:, :q].set(1.0)
+            interp = default_interpret()
+            return lambda i: _sift_bins_pallas(
+                (mag + float(i)).astype(in_dtype), ang.astype(in_dtype),
+                sel, tile_r=tile, interpret=interp, variant=name,
+            )
+
+        return autotune.chained_measure(build)
+
+    def validate_for(name):
+        mag, ang, sel = _sift_validate_args(tier)
+
+        def run(variant):
+            return sift_oriented_bins(
+                mag, ang, sel, tile_r=16, tier=tier, variant=variant
+            )
+
+        return variants.validate_variant(
+            "sift.bins", name,
+            lambda: run(name), lambda: run("unroll"),
+            tol=variants.PARITY_TOL[tier],
+            program=lambda m, a: sift_oriented_bins(
+                m, a, sel, tile_r=16, tier=tier, variant=name
+            ),
+            program_args=(mag, ang),
         )
 
     candidates = [t for t in (128, 256, 512, 1024) if t <= max(rows, 128)]
-    return autotune.resolve(
+    if not variant_search:
+        return "unroll", autotune.resolve(
+            "sift.bins", bucket, candidates or [128], 256,
+            measure=(
+                measure_for("unroll") if allow_sweep else None
+            ),
+        )
+    return variants.search(
         "sift.bins", bucket, candidates or [128], 256,
-        measure=autotune.chained_measure(build) if allow_sweep else None,
+        measure_for=measure_for, validate_for=validate_for,
+        allow_sweep=allow_sweep,
     )
 
 
 def sift_oriented_bins(mag, angle, sel: np.ndarray, *, tile_r: int = 256,
-                       interpret: Optional[bool] = None, tier: str = "f32"):
+                       interpret: Optional[bool] = None, tier: str = "f32",
+                       variant: str = "unroll"):
     """Fused ``energies @ sel`` without materializing the energies:
     (..., H, W) magnitude/orientation + (W, Q) 0/1 selection matrix ->
     (..., NUM_BIN_T, H, Q). Traceable (called inside the SIFT extractor's
     jit); ``tile_r`` must already be resolved (jit-static). ``tier="bf16"``
     (caller-resolved, like the tile) stores the streamed mag/angle tiles in
     bfloat16 — the kernel upcasts in VMEM and accumulates f32; output is
-    always f32."""
+    always f32. ``variant`` picks the generated kernel form (caller-
+    resolved via :func:`sift_bins_plan`, jit-static like the tile)."""
     lead = mag.shape[:-2]
     h, w = mag.shape[-2], mag.shape[-1]
     q = sel.shape[1]
@@ -211,7 +288,8 @@ def sift_oriented_bins(mag, angle, sel: np.ndarray, *, tile_r: int = 256,
         interpret = default_interpret()
     _count("engaged", kernel="sift.bins")
     out = _sift_bins_pallas(
-        mag2, ang2, sel_p, tile_r=int(tile_r), interpret=bool(interpret)
+        mag2, ang2, sel_p, tile_r=int(tile_r), interpret=bool(interpret),
+        variant=str(variant),
     )
     out = out[:rows].reshape(*lead, h, NUM_BIN_T, q_pad)[..., :q]
     return jnp.moveaxis(out, -2, -3)  # (..., T, H, Q)
@@ -230,7 +308,8 @@ def sift_oriented_bins(mag, angle, sel: np.ndarray, *, tile_r: int = 256,
 
 
 def _fv_moments_kernel(
-    x_ref, a_ref, b_ref, c_ref, qsum_ref, qx_ref, qx2_ref, *, n_desc: int
+    x_ref, a_ref, b_ref, c_ref, qsum_ref, qx_ref, qx2_ref, *, n_desc: int,
+    variant: str = "pair",
 ):
     j = pl.program_id(1)  # descriptor tile (fastest grid axis)
 
@@ -263,17 +342,32 @@ def _fv_moments_kernel(
 
     qsum_ref[:] += jnp.sum(q, axis=0, keepdims=True)
     qt = q.T  # (Kp, TND)
-    qx_ref[0] += jnp.dot(qt, x, preferred_element_type=jnp.float32)
-    qx2_ref[0] += jnp.dot(qt, x2, preferred_element_type=jnp.float32)
+    if variant == "joint":
+        # generated fusion variant: ONE (Kp, TND) @ (TND, 2d) matmul over
+        # the concatenated [x, x²] block instead of two d-wide passes —
+        # same contractions, twice the MXU width per pass
+        d = x.shape[1]
+        m = jnp.dot(
+            qt, jnp.concatenate([x, x2], axis=1),
+            preferred_element_type=jnp.float32,
+        )  # (Kp, 2d)
+        qx_ref[0] += m[:, :d]
+        qx2_ref[0] += m[:, d:]
+    else:
+        qx_ref[0] += jnp.dot(qt, x, preferred_element_type=jnp.float32)
+        qx2_ref[0] += jnp.dot(qt, x2, preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("tile_nd", "interpret"))
-def _fv_moments_pallas(x, A, B, c, *, tile_nd: int, interpret: bool):
+@functools.partial(
+    jax.jit, static_argnames=("tile_nd", "interpret", "variant")
+)
+def _fv_moments_pallas(x, A, B, c, *, tile_nd: int, interpret: bool,
+                       variant: str = "pair"):
     n_img, nd, d = x.shape
     k_pad = A.shape[1]
     grid = (n_img, pl.cdiv(nd, tile_nd))
     return pl.pallas_call(
-        functools.partial(_fv_moments_kernel, n_desc=nd),
+        functools.partial(_fv_moments_kernel, n_desc=nd, variant=variant),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -309,31 +403,79 @@ def fv_encode_tile(nd: int, d: int, k: int,
     sweep times operands of the tier's storage dtype.
     ``allow_sweep=False`` is lookup-only (resolution from inside a
     trace)."""
+    return fv_encode_plan(nd, d, k, allow_sweep=allow_sweep, tier=tier,
+                          variant_search=False)[1]
+
+
+def fv_encode_plan(nd: int, d: int, k: int, allow_sweep: bool = True,
+                   tier: str = "f32", variant_search: bool = True) -> tuple:
+    """``(variant, tile_nd)`` for ``fv.encode``: per-variant tile
+    resolution + measured cross-variant winner (``variants.search``).
+    ``variant_search=False`` is the legacy default-only contract of
+    :func:`fv_encode_tile`. EAGER-only when sweeping."""
+    from keystone_tpu.ops.pallas import variants
+
     bucket = autotune.precision_bucket(autotune.shape_bucket(nd, d, k), tier)
     k_pad = _round_up(max(k, 1), _LANE)
     in_dtype = jnp.bfloat16 if tier == "bf16" else jnp.float32
 
-    def build(tile):
-        key = jax.random.key(1)
-        x = jax.random.normal(key, (2, nd, d), jnp.float32)
-        A = jax.random.normal(key, (d, k_pad), jnp.float32) * 0.1
-        B = -jnp.abs(jax.random.normal(key, (d, k_pad), jnp.float32)) * 0.1
-        c = jnp.zeros((1, k_pad), jnp.float32)
-        interp = default_interpret()
-        return lambda i: _fv_moments_pallas(
-            (x + float(i) * 1e-3).astype(in_dtype), A, B, c,
-            tile_nd=tile, interpret=interp,
+    def measure_for(name):
+        def build(tile):
+            key = jax.random.key(1)
+            x = jax.random.normal(key, (2, nd, d), jnp.float32)
+            A = jax.random.normal(key, (d, k_pad), jnp.float32) * 0.1
+            B = -jnp.abs(
+                jax.random.normal(key, (d, k_pad), jnp.float32)
+            ) * 0.1
+            c = jnp.zeros((1, k_pad), jnp.float32)
+            interp = default_interpret()
+            return lambda i: _fv_moments_pallas(
+                (x + float(i) * 1e-3).astype(in_dtype), A, B, c,
+                tile_nd=tile, interpret=interp, variant=name,
+            )
+
+        return autotune.chained_measure(build)
+
+    def validate_for(name):
+        key = jax.random.key(12)
+        x = jax.random.normal(key, (2, 37, 6), jnp.float32)
+        means = jax.random.normal(key, (5, 6), jnp.float32)
+        variances = 0.5 + jax.random.uniform(key, (5, 6), jnp.float32)
+        weights = jnp.full((5,), 0.2, jnp.float32)
+
+        def run(variant):
+            return fv_moments(
+                x, means, variances, weights, tile_nd=16, tier=tier,
+                variant=variant,
+            )
+
+        return variants.validate_variant(
+            "fv.encode", name,
+            lambda: run(name), lambda: run("pair"),
+            tol=variants.PARITY_TOL[tier],
+            program=lambda x_: fv_moments(
+                x_, means, variances, weights, tile_nd=16, tier=tier,
+                variant=name,
+            ),
+            program_args=(x,),
         )
 
     candidates = [t for t in (64, 128, 256, 512) if t <= _round_up(nd, 64)]
-    return autotune.resolve(
+    if not variant_search:
+        return "pair", autotune.resolve(
+            "fv.encode", bucket, candidates or [64], 256,
+            measure=measure_for("pair") if allow_sweep else None,
+        )
+    return variants.search(
         "fv.encode", bucket, candidates or [64], 256,
-        measure=autotune.chained_measure(build) if allow_sweep else None,
+        measure_for=measure_for, validate_for=validate_for,
+        allow_sweep=allow_sweep,
     )
 
 
 def fv_moments(x, means, variances, weights, *, tile_nd: int = 256,
-               interpret: Optional[bool] = None, tier: str = "f32"):
+               interpret: Optional[bool] = None, tier: str = "f32",
+               variant: str = "pair"):
     """Per-image uncentered GMM moments without HBM posteriors:
     (n_img, nd, d) descriptors -> ``(qsum (n,k), qx (n,k,d), qx2 (n,k,d))``.
     Traceable; the caller resolves ``tile_nd`` eagerly (jit-static). Same
@@ -359,7 +501,8 @@ def fv_moments(x, means, variances, weights, *, tile_nd: int = 256,
         interpret = default_interpret()
     _count("engaged", kernel="fv.encode")
     qsum, qx, qx2 = _fv_moments_pallas(
-        x, A, B, c, tile_nd=int(tile_nd), interpret=bool(interpret)
+        x, A, B, c, tile_nd=int(tile_nd), interpret=bool(interpret),
+        variant=str(variant),
     )
     return qsum[:, :k], qx[:, :k], qx2[:, :k]
 
@@ -377,26 +520,38 @@ def fv_moments(x, means, variances, weights, *, tile_nd: int = 256,
 # tile. Filter columns are tiled (``tile_f``) so the accumulator fits VMEM.
 
 
-def _conv_norm_kernel(
-    x_ref, f_ref, fsum_ref, mf_ref, out_ref,
+def _conv_offsets(ksz: int, loop: str):
+    """The k² shifted-matmul visit order — the generated loop-order axis:
+    ``"yx"`` (dy-outer, the hand-written form) vs ``"xy"`` (dx-outer).
+    Float accumulation order differs, so the two are bit-envelope (not
+    bitwise) equivalent — exactly what the variant parity gate checks."""
+    if loop == "xy":
+        return [(dy, dx) for dx in range(ksz) for dy in range(ksz)]
+    return [(dy, dx) for dy in range(ksz) for dx in range(ksz)]
+
+
+def _conv_norm_body(
+    x, f_ref, fsum_ref, mf_ref,
     *, ksz: int, chans: int, res_h: int, res_w: int,
-    normalize: bool, var_constant: float,
+    normalize: bool, var_constant: float, loop: str,
 ):
-    x = x_ref[0]  # (H, W, C)
+    """The convolved + normalized (P, tile_f) block from one VMEM-resident
+    image — shared by the ``conv.norm`` kernel and the fused ``conv.pool``
+    kernel (the fusion-span variant applies pooling to this block while it
+    is still VMEM-resident)."""
     tile_f = f_ref.shape[3]
     p = res_h * res_w
     acc = jnp.zeros((p, tile_f), jnp.float32)
     s1 = jnp.zeros((p, 1), jnp.float32)
     s2 = jnp.zeros((p, 1), jnp.float32)
-    for dy in range(ksz):
-        for dx in range(ksz):
-            xs = x[dy : dy + res_h, dx : dx + res_w, :].reshape(p, chans)
-            acc += jnp.dot(
-                xs, f_ref[dy, dx], preferred_element_type=jnp.float32
-            )
-            if normalize:
-                s1 += jnp.sum(xs, axis=1, keepdims=True)
-                s2 += jnp.sum(xs * xs, axis=1, keepdims=True)
+    for dy, dx in _conv_offsets(ksz, loop):
+        xs = x[dy : dy + res_h, dx : dx + res_w, :].reshape(p, chans)
+        acc += jnp.dot(
+            xs, f_ref[dy, dx], preferred_element_type=jnp.float32
+        )
+        if normalize:
+            s1 += jnp.sum(xs, axis=1, keepdims=True)
+            s2 += jnp.sum(xs * xs, axis=1, keepdims=True)
     out = acc
     if normalize:
         n = float(ksz * ksz * chans)
@@ -404,19 +559,35 @@ def _conv_norm_kernel(
         var = (s2 - s1 * mean) / (n - 1.0)
         sd = jnp.sqrt(var + var_constant)
         out = (acc - mean * fsum_ref[:]) / sd
-    out_ref[0] = out - mf_ref[:]
+    return out - mf_ref[:]
+
+
+def _conv_norm_kernel(
+    x_ref, f_ref, fsum_ref, mf_ref, out_ref,
+    *, ksz: int, chans: int, res_h: int, res_w: int,
+    normalize: bool, var_constant: float, loop: str = "yx",
+):
+    # bf16-input streaming (tier axis): the image block arrives in its
+    # storage dtype and upcasts IN VMEM; f32 input makes this a no-op
+    x = x_ref[0].astype(jnp.float32)  # (H, W, C)
+    out_ref[0] = _conv_norm_body(
+        x, f_ref, fsum_ref, mf_ref, ksz=ksz, chans=chans, res_h=res_h,
+        res_w=res_w, normalize=normalize, var_constant=var_constant,
+        loop=loop,
+    )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "ksz", "chans", "res_h", "res_w", "normalize", "var_constant",
-        "tile_f", "interpret",
+        "tile_f", "interpret", "variant",
     ),
 )
 def _conv_norm_pallas(
     imgs, filt, fsum, mf, *, ksz: int, chans: int, res_h: int, res_w: int,
     normalize: bool, var_constant: float, tile_f: int, interpret: bool,
+    variant: str = "yx",
 ):
     n, h, w, _ = imgs.shape
     nf_pad = filt.shape[3]
@@ -426,6 +597,7 @@ def _conv_norm_pallas(
         functools.partial(
             _conv_norm_kernel, ksz=ksz, chans=chans, res_h=res_h,
             res_w=res_w, normalize=normalize, var_constant=var_constant,
+            loop=variant,
         ),
         grid=grid,
         in_specs=[
@@ -452,58 +624,122 @@ def _conv_norm_pallas(
 _CONV_VMEM_BUDGET = 12 << 20  # conservative f32 working-set bound per step
 
 
+def _conv_fits(h: int, w: int, chans: int, ksz: int, tf: int) -> bool:
+    res_h, res_w = h - ksz + 1, w - ksz + 1
+    p = res_h * res_w
+    est = 4 * (
+        h * w * chans            # resident image
+        + ksz * ksz * chans * tf  # filter tile
+        + 3 * p * tf              # acc + epilogue temporaries
+        + 2 * p                   # s1 / s2
+    )
+    return est < _CONV_VMEM_BUDGET
+
+
 def conv_norm_tile(h: int, w: int, chans: int, ksz: int, nf: int,
                    allow_sweep: bool = True):
     """Autotuned filter-tile width for ``conv.norm``, constrained to tiles
     whose per-step working set fits the VMEM budget. Returns None when no
     candidate fits (caller falls back to the XLA twin).
     ``allow_sweep=False`` is lookup-only."""
-    res_h, res_w = h - ksz + 1, w - ksz + 1
-    p = res_h * res_w
+    return conv_norm_plan(h, w, chans, ksz, nf, allow_sweep=allow_sweep,
+                          variant_search=False)[1]
 
-    def fits(tf: int) -> bool:
-        est = 4 * (
-            h * w * chans            # resident image
-            + ksz * ksz * chans * tf  # filter tile
-            + 3 * p * tf              # acc + epilogue temporaries
-            + 2 * p                   # s1 / s2
-        )
-        return est < _CONV_VMEM_BUDGET
 
-    candidates = [t for t in (64, 128, 256, 512) if fits(t)]
+def _conv_validate_args(tier: str):
+    key = jax.random.key(13)
+    imgs = jax.random.uniform(key, (2, 11, 13, 3), jnp.float32)
+    filters = jax.random.normal(key, (7, 3 * 3 * 3), jnp.float32)
+    return imgs, filters
+
+
+def conv_norm_plan(h: int, w: int, chans: int, ksz: int, nf: int,
+                   allow_sweep: bool = True, tier: str = "f32",
+                   variant_search: bool = True) -> tuple:
+    """``(variant, tile_f)`` for ``conv.norm`` — ``(variant, None)`` when
+    no tile fits the VMEM budget (caller falls back to the XLA twin).
+    ``variant_search=False`` restricts to the default dy-outer loop order
+    (the :func:`conv_norm_tile` contract). EAGER-only when sweeping."""
+    from keystone_tpu.ops.pallas import variants
+
+    candidates = [
+        t for t in (64, 128, 256, 512) if _conv_fits(h, w, chans, ksz, t)
+    ]
     if not candidates:
         _count("fallback", kernel="conv.norm", reason="vmem")
-        return None
-    bucket = autotune.shape_bucket(h, w, nf)
+        return "yx", None
+    res_h, res_w = h - ksz + 1, w - ksz + 1
+    bucket = autotune.precision_bucket(
+        autotune.shape_bucket(h, w, nf), tier
+    )
+    in_dtype = jnp.bfloat16 if tier == "bf16" else jnp.float32
 
-    def build(tile):
-        key = jax.random.key(2)
-        xi = jax.random.uniform(key, (2, h, w, chans), jnp.float32)
-        nf_pad = _round_up(nf, tile)
-        fi = jax.random.normal(key, (ksz, ksz, chans, nf_pad), jnp.float32)
-        fs = jnp.sum(fi.reshape(-1, nf_pad), axis=0, keepdims=True)
-        mfz = jnp.zeros((1, nf_pad), jnp.float32)
-        args = dict(
-            ksz=ksz, chans=chans, res_h=res_h, res_w=res_w, normalize=True,
-            var_constant=10.0, tile_f=tile, interpret=default_interpret(),
-        )
-        return lambda i: _conv_norm_pallas(
-            xi + float(i) * 1e-3, fi, fs, mfz, **args
+    def measure_for(name):
+        def build(tile):
+            key = jax.random.key(2)
+            xi = jax.random.uniform(key, (2, h, w, chans), jnp.float32)
+            nf_pad = _round_up(nf, tile)
+            fi = jax.random.normal(
+                key, (ksz, ksz, chans, nf_pad), jnp.float32
+            )
+            fs = jnp.sum(fi.reshape(-1, nf_pad), axis=0, keepdims=True)
+            mfz = jnp.zeros((1, nf_pad), jnp.float32)
+            args = dict(
+                ksz=ksz, chans=chans, res_h=res_h, res_w=res_w,
+                normalize=True, var_constant=10.0, tile_f=tile,
+                interpret=default_interpret(), variant=name,
+            )
+            return lambda i: _conv_norm_pallas(
+                (xi + float(i) * 1e-3).astype(in_dtype), fi, fs, mfz,
+                **args
+            )
+
+        return autotune.chained_measure(build)
+
+    def validate_for(name):
+        imgs, filters = _conv_validate_args(tier)
+
+        def run(variant):
+            return conv_norm(
+                imgs, filters, num_channels=3, normalize=True,
+                var_constant=10.0, tile_f=64, tier=tier, variant=variant,
+            )
+
+        return variants.validate_variant(
+            "conv.norm", name,
+            lambda: run(name), lambda: run("yx"),
+            tol=variants.PARITY_TOL[tier],
+            program=lambda im: conv_norm(
+                im, filters, num_channels=3, normalize=True,
+                var_constant=10.0, tile_f=64, tier=tier, variant=name,
+            ),
+            program_args=(imgs,),
         )
 
-    return autotune.resolve(
+    if not variant_search:
+        return "yx", autotune.resolve(
+            "conv.norm", bucket, candidates, candidates[0],
+            measure=measure_for("yx") if allow_sweep else None,
+        )
+    return variants.search(
         "conv.norm", bucket, candidates, candidates[0],
-        measure=autotune.chained_measure(build) if allow_sweep else None,
+        measure_for=measure_for, validate_for=validate_for,
+        allow_sweep=allow_sweep,
     )
 
 
 def conv_norm(imgs, filters, *, num_channels: int, normalize: bool,
               var_constant: float, whitener_means=None, tile_f: int = 128,
-              interpret: Optional[bool] = None):
+              interpret: Optional[bool] = None, tier: str = "f32",
+              variant: str = "yx"):
     """Fused Convolver forward: (N, H, W, C) images + (nF, k·k·C) filters
     (reference patch layout) -> (N, resH, resW, nF). Traceable; ``tile_f``
-    pre-resolved via :func:`conv_norm_tile`."""
+    and ``variant`` pre-resolved via :func:`conv_norm_plan`. ``tier="bf16"``
+    streams the image blocks in bfloat16 (the kernel upcasts in VMEM);
+    filters and all accumulation stay f32."""
     imgs = jnp.asarray(imgs, jnp.float32)
+    if tier == "bf16":
+        imgs = imgs.astype(jnp.bfloat16)
     n, h, w, c = imgs.shape
     nf = filters.shape[0]
     k2 = filters.shape[1] // num_channels
@@ -529,7 +765,7 @@ def conv_norm(imgs, filters, *, num_channels: int, normalize: bool,
     out = _conv_norm_pallas(
         imgs, filt, fsum, mf, ksz=ksz, chans=c, res_h=res_h, res_w=res_w,
         normalize=bool(normalize), var_constant=float(var_constant),
-        tile_f=tile_f, interpret=bool(interpret),
+        tile_f=tile_f, interpret=bool(interpret), variant=str(variant),
     )
     return out.reshape(n, res_h, res_w, nf_pad)[..., :nf]
 
@@ -559,35 +795,58 @@ def pool_select_matrix(dim: int, stride: int, pool_size: int) -> np.ndarray:
     return m
 
 
-def _pool_sum_kernel(x_ref, my_ref, mx_ref, out_ref, *, pixel_fn):
-    y = x_ref[0]  # (H, W, TC)
-    if pixel_fn is not None:
-        y = pixel_fn(y)
+def _pool_contract(y, my, mx, *, order: str):
+    """Both separable contractions applied to one (H, W, TC) block in VMEM
+    — shared by the ``pool.sum`` kernel and the fused ``conv.pool`` kernel.
+    ``order`` is the generated contraction-order axis: ``"hw"`` (H-axis
+    first, the hand-written form) vs ``"wh"`` (W-axis first); the sums are
+    associatively regrouped, so the two forms are bit-envelope (not
+    bitwise) equivalent."""
     h, w, tc = y.shape
-    p = my_ref.shape[1]
-    q = mx_ref.shape[1]
+    p = my.shape[1]
+    q = mx.shape[1]
+    if order == "wh":
+        # contract W first: (H·TC, W) @ (W, Q), then H: (P, H) @ (H, TC·Q)
+        t1 = jnp.dot(
+            jnp.transpose(y, (0, 2, 1)).reshape(h * tc, w), mx,
+            preferred_element_type=jnp.float32,
+        ).reshape(h, tc, q)
+        t2 = jnp.dot(
+            my.T, t1.reshape(h, tc * q), preferred_element_type=jnp.float32
+        ).reshape(p, tc, q)
+        return jnp.transpose(t2, (0, 2, 1))  # (P, Q, TC)
     # contract H: (P, H) @ (H, W·TC) — one clean 2D matmul
     t1 = jnp.dot(
-        my_ref[:].T, y.reshape(h, w * tc), preferred_element_type=jnp.float32
+        my.T, y.reshape(h, w * tc), preferred_element_type=jnp.float32
     ).reshape(p, w, tc)
     # contract W: regroup channels-major so the second contraction is 2D too
     t2 = jnp.dot(
         jnp.transpose(t1, (0, 2, 1)).reshape(p * tc, w),
-        mx_ref[:],
+        mx,
         preferred_element_type=jnp.float32,
     ).reshape(p, tc, q)
-    out_ref[0] = jnp.transpose(t2, (0, 2, 1))  # (P, Q, TC)
+    return jnp.transpose(t2, (0, 2, 1))  # (P, Q, TC)
+
+
+def _pool_sum_kernel(x_ref, my_ref, mx_ref, out_ref, *, pixel_fn,
+                     order: str = "hw"):
+    # bf16-input streaming (tier axis): upcast in VMEM; no-op for f32
+    y = x_ref[0].astype(jnp.float32)  # (H, W, TC)
+    if pixel_fn is not None:
+        y = pixel_fn(y)
+    out_ref[0] = _pool_contract(y, my_ref[:], mx_ref[:], order=order)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("pixel_fn", "tile_c", "interpret")
+    jax.jit, static_argnames=("pixel_fn", "tile_c", "interpret", "variant")
 )
-def _pool_sum_pallas(imgs, my, mx, *, pixel_fn, tile_c: int, interpret: bool):
+def _pool_sum_pallas(imgs, my, mx, *, pixel_fn, tile_c: int, interpret: bool,
+                     variant: str = "hw"):
     n, h, w, c_pad = imgs.shape
     p, q = my.shape[1], mx.shape[1]
     grid = (n, c_pad // tile_c)
     return pl.pallas_call(
-        functools.partial(_pool_sum_kernel, pixel_fn=pixel_fn),
+        functools.partial(_pool_sum_kernel, pixel_fn=pixel_fn, order=variant),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -619,27 +878,90 @@ def pool_sum_tile(h: int, w: int, c: int):
     """Autotuned channel-tile width for ``pool.sum``, or None when no
     candidate fits the VMEM budget (caller falls back to the XLA twin —
     the same contract as :func:`conv_norm_tile`). EAGER-only."""
+    return pool_sum_plan(h, w, c, allow_sweep=False,
+                         variant_search=False)[1]
+
+
+def pool_sum_plan(h: int, w: int, c: int, *, stride: int = 2,
+                  pool_size: int = 2, allow_sweep: bool = True,
+                  tier: str = "f32", variant_search: bool = True) -> tuple:
+    """``(variant, tile_c)`` for ``pool.sum`` — ``(variant, None)`` when no
+    channel tile fits the VMEM budget. The PR-7 tile path never swept this
+    kernel (``measure=None``); the variant search gives it a real measure
+    builder, so under ``KEYSTONE_AUTOTUNE=1`` both the contraction order
+    AND the channel tile are now measured. ``stride``/``pool_size`` shape
+    the timed pooling geometry only — they do not join the bucket.
+    EAGER-only when sweeping."""
+    from keystone_tpu.ops.pallas import variants
+
     candidates = [
         t for t in (64, 128, 256, 512) if pool_block_fits(h, w, t)
     ]
     if not candidates:
         _count("fallback", kernel="pool.sum", reason="vmem")
-        return None
-    return autotune.resolve(
-        "pool.sum", autotune.shape_bucket(h, w, c), candidates,
-        candidates[0], measure=None,
+        return "hw", None
+    bucket = autotune.precision_bucket(autotune.shape_bucket(h, w, c), tier)
+    in_dtype = jnp.bfloat16 if tier == "bf16" else jnp.float32
+
+    def measure_for(name):
+        def build(tile):
+            key = jax.random.key(3)
+            xi = jax.random.uniform(key, (2, h, w, tile), jnp.float32)
+            my = jnp.asarray(pool_select_matrix(h, stride, pool_size))
+            mx = jnp.asarray(pool_select_matrix(w, stride, pool_size))
+            interp = default_interpret()
+            return lambda i: _pool_sum_pallas(
+                (xi + float(i) * 1e-3).astype(in_dtype), my, mx,
+                pixel_fn=None, tile_c=tile, interpret=interp, variant=name,
+            )
+
+        return autotune.chained_measure(build)
+
+    def validate_for(name):
+        key = jax.random.key(14)
+        imgs = jax.random.uniform(key, (2, 9, 11, 5), jnp.float32)
+
+        def run(variant):
+            return pool_sum(imgs, 2, 3, None, tile_c=64, tier=tier,
+                            variant=variant)
+
+        return variants.validate_variant(
+            "pool.sum", name,
+            lambda: run(name), lambda: run("hw"),
+            tol=variants.PARITY_TOL[tier],
+            program=lambda im: pool_sum(
+                im, 2, 3, None, tile_c=64, tier=tier, variant=name
+            ),
+            program_args=(imgs,),
+        )
+
+    if not variant_search:
+        return "hw", autotune.resolve(
+            "pool.sum", bucket, candidates, candidates[0],
+            measure=measure_for("hw") if allow_sweep else None,
+        )
+    return variants.search(
+        "pool.sum", bucket, candidates, candidates[0],
+        measure_for=measure_for, validate_for=validate_for,
+        allow_sweep=allow_sweep,
     )
 
 
 def pool_sum(imgs, stride: int, pool_size: int,
              pixel_fn: Optional[Callable] = None, *, tile_c: int = 128,
-             interpret: Optional[bool] = None):
+             interpret: Optional[bool] = None, tier: str = "f32",
+             variant: str = "hw"):
     """Fused sum-Pooler forward over a batch: (N, H, W, C) -> (N, P, Q, C).
     ``pixel_fn`` must be shape/dtype-preserving (checked by the caller via
     ``eval_shape``); when one is present the kernel never tiles or pads
     the channel axis — each grid step hands the function the FULL
-    (H, W, C) block, so even a channel-mixing function stays correct."""
+    (H, W, C) block, so even a channel-mixing function stays correct.
+    ``tier="bf16"`` streams the image blocks in bfloat16 (upcast in VMEM
+    before the pixel function); ``variant`` is the contraction order
+    (caller-resolved via :func:`pool_sum_plan`, jit-static)."""
     imgs = jnp.asarray(imgs, jnp.float32)
+    if tier == "bf16":
+        imgs = imgs.astype(jnp.bfloat16)
     n, h, w, c = imgs.shape
     if pixel_fn is not None:
         tile_c = c_pad = c
@@ -655,6 +977,252 @@ def pool_sum(imgs, stride: int, pool_size: int,
     _count("engaged", kernel="pool.sum")
     out = _pool_sum_pallas(
         imgs, my, mx, pixel_fn=pixel_fn, tile_c=tile_c,
-        interpret=bool(interpret),
+        interpret=bool(interpret), variant=str(variant),
     )
     return out[..., :c]
+
+
+# ---------------------------------------------------------------------------
+# Fused conv.norm → pool.sum: the fusion-span variant
+# ---------------------------------------------------------------------------
+#
+# The split pair writes the normalized (N, resH, resW, nF) conv output to
+# HBM and immediately re-reads it for pooling — at CIFAR scale that tensor
+# is the largest intermediate in the featurization chain. The fused kernel
+# reuses ``_conv_norm_body``'s (P, tile_f) block while it is still
+# VMEM-resident: reshape to (resH, resW, tile_f), apply both separable
+# pooling contractions (``_pool_contract``), and write only the pooled
+# (P', Q', tile_f) tile. The conv intermediate NEVER touches HBM. Padded
+# filter columns stay exact zeros through normalization and pooling (sums
+# of zeros), so the trailing trim is unchanged.
+
+
+def _conv_pool_kernel(
+    x_ref, f_ref, fsum_ref, mf_ref, my_ref, mx_ref, out_ref,
+    *, ksz: int, chans: int, res_h: int, res_w: int,
+    normalize: bool, var_constant: float, loop: str,
+):
+    x = x_ref[0].astype(jnp.float32)  # (H, W, C); bf16 tier upcasts here
+    conv = _conv_norm_body(
+        x, f_ref, fsum_ref, mf_ref, ksz=ksz, chans=chans, res_h=res_h,
+        res_w=res_w, normalize=normalize, var_constant=var_constant,
+        loop=loop,
+    )  # (P, tile_f) — still VMEM-resident
+    y = conv.reshape(res_h, res_w, f_ref.shape[3])
+    out_ref[0] = _pool_contract(y, my_ref[:], mx_ref[:], order="hw")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "ksz", "chans", "res_h", "res_w", "normalize", "var_constant",
+        "tile_f", "interpret", "loop",
+    ),
+)
+def _conv_pool_pallas(
+    imgs, filt, fsum, mf, my, mx, *, ksz: int, chans: int, res_h: int,
+    res_w: int, normalize: bool, var_constant: float, tile_f: int,
+    interpret: bool, loop: str,
+):
+    n, h, w, _ = imgs.shape
+    nf_pad = filt.shape[3]
+    p, q = my.shape[1], mx.shape[1]
+    grid = (n, nf_pad // tile_f)
+    return pl.pallas_call(
+        functools.partial(
+            _conv_pool_kernel, ksz=ksz, chans=chans, res_h=res_h,
+            res_w=res_w, normalize=normalize, var_constant=var_constant,
+            loop=loop,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, h, w, chans), lambda i, f: (i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (ksz, ksz, chans, tile_f), lambda i, f: (0, 0, 0, f),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, tile_f), lambda i, f: (0, f), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_f), lambda i, f: (0, f), memory_space=pltpu.VMEM),
+            pl.BlockSpec((res_h, p), lambda i, f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((res_w, q), lambda i, f: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, p, q, tile_f), lambda i, f: (i, 0, 0, f),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, p, q, nf_pad), jnp.float32),
+        interpret=interpret,
+    )(imgs, filt, fsum, mf, my, mx)
+
+
+def _conv_pool_fits(h: int, w: int, chans: int, ksz: int,
+                    stride: int, pool_size: int, tf: int) -> bool:
+    """The fused step's working set: conv's bound plus the pool matrices
+    and the pooled temporaries."""
+    res_h, res_w = h - ksz + 1, w - ksz + 1
+    p_out = pool_select_matrix(res_h, stride, pool_size).shape[1]
+    q_out = pool_select_matrix(res_w, stride, pool_size).shape[1]
+    extra = 4 * (
+        res_h * p_out + res_w * q_out   # selection matrices
+        + 2 * p_out * res_w * tf        # t1 + its regrouped copy
+        + 2 * p_out * q_out * tf        # t2 + output tile
+    )
+    return _conv_fits(h, w, chans, ksz, tf) and (
+        4 * (h * w * chans + ksz * ksz * chans * tf + 3 * res_h * res_w * tf)
+        + extra < _CONV_VMEM_BUDGET
+    )
+
+
+def conv_norm_pool(imgs, filters, *, num_channels: int, normalize: bool,
+                   var_constant: float, stride: int, pool_size: int,
+                   whitener_means=None, tile_f: int = 128,
+                   interpret: Optional[bool] = None, tier: str = "f32",
+                   variant: str = "split"):
+    """The fusion-span entry point: Convolver forward + sum pooling,
+    (N, H, W, C) -> (N, P, Q, nF). ``variant="split"`` composes the
+    :func:`conv_norm` and :func:`pool_sum` kernels through HBM (the
+    reference pair, and the form the autotuner times as the incumbent);
+    ``"fused.yx"``/``"fused.xy"`` run ONE kernel whose conv block stays
+    VMEM-resident through normalization and pooling — the suffix is the
+    conv loop order (:func:`_conv_offsets`). Traceable; ``tile_f`` and
+    ``variant`` pre-resolved via :func:`conv_pool_plan`."""
+    if variant == "split":
+        conv = conv_norm(
+            imgs, filters, num_channels=num_channels, normalize=normalize,
+            var_constant=var_constant, whitener_means=whitener_means,
+            tile_f=tile_f, interpret=interpret, tier=tier,
+        )
+        return pool_sum(
+            conv, stride, pool_size, None, tile_c=min(int(tile_f), 512),
+            interpret=interpret, tier=tier,
+        )
+    loop = variant.split(".", 1)[1]  # "fused.yx" -> "yx"
+    imgs = jnp.asarray(imgs, jnp.float32)
+    if tier == "bf16":
+        imgs = imgs.astype(jnp.bfloat16)
+    n, h, w, c = imgs.shape
+    nf = filters.shape[0]
+    k2 = filters.shape[1] // num_channels
+    ksz = int(round(k2**0.5))
+    res_h, res_w = h - ksz + 1, w - ksz + 1
+    tile_f = int(tile_f)
+    nf_pad = _round_up(nf, tile_f)
+    filt = jnp.zeros((nf_pad, ksz * ksz * c), jnp.float32).at[:nf].set(
+        jnp.asarray(filters, jnp.float32)
+    )
+    filt = filt.reshape(nf_pad, ksz, ksz, c).transpose(1, 2, 3, 0)
+    fsum = jnp.sum(filt.reshape(-1, nf_pad), axis=0, keepdims=True)
+    mf = jnp.zeros((1, nf_pad), jnp.float32)
+    if whitener_means is not None:
+        mf = mf.at[:, :nf].set(
+            (jnp.asarray(whitener_means, jnp.float32) @ filters.T)[None]
+        )
+    my = jnp.asarray(pool_select_matrix(res_h, stride, pool_size))
+    mx = jnp.asarray(pool_select_matrix(res_w, stride, pool_size))
+    if interpret is None:
+        interpret = default_interpret()
+    _count("engaged", kernel="conv.pool")
+    out = _conv_pool_pallas(
+        imgs, filt, fsum, mf, my, mx, ksz=ksz, chans=c, res_h=res_h,
+        res_w=res_w, normalize=bool(normalize),
+        var_constant=float(var_constant), tile_f=tile_f,
+        interpret=bool(interpret), loop=loop,
+    )
+    return out[..., :nf]
+
+
+def _conv_pool_validate_args(tier: str):
+    key = jax.random.key(15)
+    imgs = jax.random.uniform(key, (2, 11, 13, 3), jnp.float32)
+    filters = jax.random.normal(key, (7, 3 * 3 * 3), jnp.float32)
+    return imgs, filters
+
+
+def conv_pool_plan(h: int, w: int, chans: int, ksz: int, nf: int, *,
+                   stride: int, pool_size: int, allow_sweep: bool = True,
+                   tier: str = "f32", variant_search: bool = True) -> tuple:
+    """``(variant, tile_f)`` for the conv→pool span — ``("split", None)``
+    when no tile fits even the split conv budget (caller falls back to the
+    XLA twins). The "split" default's cache entry times the REAL two-kernel
+    pipeline (conv through HBM, then pool), so a fused win is an honest
+    end-to-end win, never an artifact of timing half the work. Fused
+    candidates are additionally bounded by :func:`_conv_pool_fits`.
+    EAGER-only when sweeping."""
+    from keystone_tpu.ops.pallas import variants
+
+    candidates = [
+        t for t in (64, 128, 256, 512) if _conv_fits(h, w, chans, ksz, t)
+    ]
+    if not candidates:
+        _count("fallback", kernel="conv.pool", reason="vmem")
+        return "split", None
+    fused_candidates = [
+        t for t in candidates
+        if _conv_pool_fits(h, w, chans, ksz, stride, pool_size, t)
+    ]
+    res_h, res_w = h - ksz + 1, w - ksz + 1
+    bucket = autotune.precision_bucket(
+        autotune.shape_bucket(h, w, nf), tier
+    )
+    in_dtype = jnp.bfloat16 if tier == "bf16" else jnp.float32
+
+    def measure_for(name):
+        def build(tile):
+            key = jax.random.key(4)
+            xi = jax.random.uniform(key, (2, h, w, chans), jnp.float32)
+            fi = jax.random.normal(
+                key, (nf, ksz * ksz * chans), jnp.float32
+            )
+            args = dict(
+                num_channels=chans, normalize=True, var_constant=10.0,
+                stride=stride, pool_size=pool_size, tile_f=tile,
+                interpret=default_interpret(), tier=tier, variant=name,
+            )
+            return lambda i: conv_norm_pool(
+                (xi + float(i) * 1e-3).astype(in_dtype), fi, **args
+            )
+
+        return autotune.chained_measure(build)
+
+    def validate_for(name):
+        imgs, filters = _conv_pool_validate_args(tier)
+
+        def run(variant):
+            return conv_norm_pool(
+                imgs, filters, num_channels=3, normalize=True,
+                var_constant=10.0, stride=2, pool_size=3, tile_f=64,
+                tier=tier, variant=variant,
+            )
+
+        return variants.validate_variant(
+            "conv.pool", name,
+            lambda: run(name), lambda: run("split"),
+            tol=variants.PARITY_TOL[tier],
+            program=lambda im: conv_norm_pool(
+                im, filters, num_channels=3, normalize=True,
+                var_constant=10.0, stride=2, pool_size=3, tile_f=64,
+                tier=tier, variant=name,
+            ),
+            program_args=(imgs,),
+        )
+
+    def validate_gate(name):
+        # fused candidates must also FIT: a fused variant whose working
+        # set overflows the budget at every tile is skipped, not swept
+        if name.startswith("fused.") and not fused_candidates:
+            return False
+        return validate_for(name)
+
+    if not variant_search:
+        return "split", autotune.resolve(
+            "conv.pool", bucket, candidates, candidates[0],
+            measure=measure_for("split") if allow_sweep else None,
+        )
+    return variants.search(
+        "conv.pool", bucket, candidates, candidates[0],
+        measure_for=measure_for, validate_for=validate_gate,
+        allow_sweep=allow_sweep,
+    )

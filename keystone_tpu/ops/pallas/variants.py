@@ -1,0 +1,261 @@
+"""Kernel variant registry + search for the generated extraction kernels.
+
+PR-7 gave every extraction kernel ONE hand-written form and let the
+autotuner pick its tile. The TVM matmul-generator result (PAPERS.md,
+"Automatic Generators for a Family of Matrix Multiplication Routines with
+Apache TVM") says the bigger win is searching over *generated kernel
+variants* — loop order, block mapping, fusion span — with the same
+measured-winner discipline. This module is that layer: each kernel in
+``ops/pallas/extraction.py`` declares a small variant space (the first
+name is always the pre-variant hand-written form), the autotuner's cache
+grows a ``#<variant>`` bucket suffix for non-default variants (the default
+keeps the BARE bucket, so every pre-variant tile-only entry remains a
+valid winner), and :func:`search` arbitrates: per variant the tile is
+resolved through ``autotune.resolve`` at the variant-qualified bucket, and
+the cross-variant winner is the entry with the smallest persisted ``us``.
+
+The safety net (a generated kernel can win on speed, never on wrong
+answers): before a non-default variant's FIRST sweep it must pass
+:func:`validate_variant` — bit-envelope parity against the reference form
+plus the A1/A4 ``ir_rules`` checks (no collectives in a single-device
+extraction program; no gross MXU-tile padding waste) on its lowered
+program. A variant that fails is never swept, never recorded, never
+served (``variants.rejected`` counts it); an entry someone hand-edits into
+the cache under an UNKNOWN variant name is pruned by ``autotune._sanitize``
+on load.
+
+Variant spaces (the table the README mirrors):
+
+==========  ==========================  =====================================
+kernel      variants (default first)    what varies
+==========  ==========================  =====================================
+sift.bins   unroll | stack              per-bin loop of 8 small matmuls vs
+                                        one stacked (8·TR, W) matmul
+fv.encode   pair | joint                two (Kp, d) moment matmuls vs one
+                                        (Kp, 2d) matmul on concat [x, x²]
+conv.norm   yx | xy                     k² shifted-matmul accumulation order
+                                        (dy-outer vs dx-outer)
+pool.sum    hw | wh                     separable contraction order (H-axis
+                                        first vs W-axis first)
+conv.pool   split | fused.yx|fused.xy   fusion span: conv.norm→HBM→pool.sum
+                                        vs one kernel holding the convolved
+                                        patch block VMEM-resident through
+                                        normalization AND pooling
+==========  ==========================  =====================================
+
+The bf16-input vs f32 streaming axis is NOT a variant name — it is the
+existing precision-tier bucket qualifier (``@bf16``), orthogonal to the
+variant suffix: a full key reads ``"<shape>[@tier][#variant]"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from keystone_tpu.ops.pallas import autotune
+from keystone_tpu.utils import knobs
+
+#: kernel -> variant names; index 0 is the DEFAULT (the pre-variant
+#: hand-written form, cached under the bare bucket key). ``autotune.
+#: _sanitize`` prunes cache entries whose ``#<variant>`` suffix is not
+#: listed here — an unknown variant must never shadow or serve.
+VARIANT_SPACES: Dict[str, Tuple[str, ...]] = {
+    "sift.bins": ("unroll", "stack"),
+    "fv.encode": ("pair", "joint"),
+    "conv.norm": ("yx", "xy"),
+    "pool.sum": ("hw", "wh"),
+    "conv.pool": ("split", "fused.yx", "fused.xy"),
+}
+
+#: default rel tolerance of the bit-envelope parity gate per storage tier
+#: (mirrors the parity-test envelopes: f32 interpret-mode reassociation
+#: noise vs bf16 storage rounding)
+PARITY_TOL = {"f32": 2e-5, "bf16": 2e-2}
+
+
+def _count(event: str, **labels) -> None:
+    from keystone_tpu.telemetry import get_registry
+
+    get_registry().inc(f"variants.{event}", **labels)
+
+
+def known_variants(kernel: str) -> Tuple[str, ...]:
+    """The kernel's declared variant space (default first). Unknown
+    kernels raise — a typo'd kernel name silently creating its own space
+    would never be searched."""
+    try:
+        return VARIANT_SPACES[kernel]
+    except KeyError:
+        raise ValueError(
+            f"no variant space declared for kernel {kernel!r}"
+        ) from None
+
+
+def default_variant(kernel: str) -> str:
+    return known_variants(kernel)[0]
+
+
+def variant_bucket(bucket: str, kernel: str, variant: str) -> str:
+    """Variant joins the cache key AFTER the precision tier:
+    ``"<shape>[@tier][#variant]"``. The default variant keeps the bare
+    bucket — every pre-variant tile-only cache entry stays a valid winner
+    for it — and unknown variants raise (same contract as
+    ``autotune.precision_bucket``: a typo must not mint a partition)."""
+    space = known_variants(kernel)
+    if variant not in space:
+        raise ValueError(
+            f"unknown {kernel} variant {variant!r} (known: {space})"
+        )
+    if variant == space[0]:
+        return bucket
+    return f"{bucket}#{variant}"
+
+
+# ---------------------------------------------------------------------------
+# The safety net: parity + program-shape checks before a variant may sweep
+# ---------------------------------------------------------------------------
+
+
+def _max_rel_err(got, want) -> float:
+    import jax
+    import numpy as np
+
+    errs = [0.0]
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(want)):
+        a = np.asarray(a, dtype=np.float64)
+        b = np.asarray(b, dtype=np.float64)
+        denom = float(np.max(np.abs(b))) + 1e-9
+        errs.append(float(np.max(np.abs(a - b))) / denom)
+    # np.max propagates NaN (Python's max() would silently drop it, and a
+    # NaN-producing variant must fail the gate, not slip past it)
+    return float(np.max(errs))
+
+
+def check_program(fn: Callable, *args) -> list:
+    """The A1/A4 ``ir_rules`` shape of one candidate program: extraction
+    kernels are single-device, so ANY collective is a finding (A1 family),
+    and matmul operand dims must not waste the MXU tile past the audit
+    threshold (A4). Returns the list of problems (empty = clean)."""
+    import jax
+
+    from keystone_tpu.analysis import ir_rules
+
+    problems = list(ir_rules.padded_matmul_dims(jax.make_jaxpr(fn)(*args)))
+    hlo = jax.jit(fn).lower(*args).compile().as_text()
+    problems += ir_rules.check_no_all_reduce(hlo)
+    problems += ir_rules.check_no_bulk_collectives(hlo)
+    return problems
+
+
+def validate_variant(
+    kernel: str,
+    variant: str,
+    run: Callable[[], Any],
+    run_reference: Callable[[], Any],
+    *,
+    tol: float,
+    program: Optional[Callable] = None,
+    program_args: Sequence[Any] = (),
+) -> bool:
+    """The gate between "generated" and "allowed to sweep": bit-envelope
+    parity of ``run()`` against ``run_reference()`` (max-normalized rel
+    error <= ``tol``) plus :func:`check_program` on the variant's lowered
+    form when ``program`` is given. A failing variant is counted
+    (``variants.rejected{kernel,variant,reason}``) and must never be
+    recorded or served; a passing one counts ``variants.validated``."""
+    try:
+        err = _max_rel_err(run(), run_reference())
+    except Exception as e:  # a variant that cannot even run is rejected
+        _count("rejected", kernel=kernel, variant=variant,
+               reason=type(e).__name__)
+        return False
+    if not err <= tol:  # NaN-safe: NaN comparisons are False
+        _count("rejected", kernel=kernel, variant=variant, reason="parity")
+        return False
+    if program is not None:
+        try:
+            problems = check_program(program, *program_args)
+        except Exception as e:
+            _count("rejected", kernel=kernel, variant=variant,
+                   reason=type(e).__name__)
+            return False
+        if problems:
+            _count("rejected", kernel=kernel, variant=variant,
+                   reason="ir_rules")
+            return False
+    _count("validated", kernel=kernel, variant=variant)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# The search driver
+# ---------------------------------------------------------------------------
+
+
+def search(
+    kernel: str,
+    bucket: str,
+    candidates: Sequence[Any],
+    default: Any,
+    *,
+    measure_for: Optional[Callable[[str], Callable[[Any, int], float]]] = None,
+    validate_for: Optional[Callable[[str], bool]] = None,
+    allow_sweep: bool = True,
+) -> Tuple[str, Any]:
+    """Variant-space resolution on top of ``autotune.resolve``; returns
+    ``(variant, value)``.
+
+    The default variant rides the existing single-kernel path at the bare
+    bucket (sweeping under ``KEYSTONE_AUTOTUNE=1`` exactly as before).
+    Non-default variants resolve at their ``#``-qualified buckets:
+    persisted entries serve lookup-only like any tile winner; a MISSING
+    entry is swept only when ``KEYSTONE_AUTOTUNE=1`` AND
+    ``KEYSTONE_AUTOTUNE_VARIANTS`` is on AND the variant first passes
+    ``validate_for`` (the parity + ir_rules gate) — so after one full
+    sweep a reload performs ZERO re-sweeps, the same contract tiles pin.
+
+    Winner selection is the measured-winner protocol ACROSS variants: a
+    challenger is served only when both it and the default carry a
+    persisted latency (``us``) and the challenger's is strictly smaller —
+    a variant can win on measured speed, never by default. Out-of-grid
+    values (a winner swept at the small end of a pow2 bucket that no
+    longer fits this shape's candidates) are skipped, mirroring
+    ``resolve``'s own guard."""
+    space = known_variants(kernel)
+    dflt = space[0]
+    sweep_ok = bool(
+        allow_sweep and measure_for is not None
+        and knobs.get("KEYSTONE_AUTOTUNE")
+    )
+    variants_ok = sweep_ok and knobs.get("KEYSTONE_AUTOTUNE_VARIANTS")
+    value = autotune.resolve(
+        kernel, bucket, candidates, default,
+        measure=measure_for(dflt) if sweep_ok else None,
+    )
+    base = autotune.peek_entry(kernel, bucket)
+    base_us = None if base is None else base.get("us")
+    if base_us is None:
+        # no measured incumbent: nothing to beat, the default serves
+        return dflt, value
+    best_name, best_value, best_us = dflt, value, float(base_us)
+    for name in space[1:]:
+        vb = variant_bucket(bucket, kernel, name)
+        entry = autotune.peek_entry(kernel, vb)
+        if entry is None and variants_ok:
+            if validate_for is None or validate_for(name):
+                autotune.resolve(
+                    kernel, vb, candidates, default,
+                    measure=measure_for(name),
+                )
+                entry = autotune.peek_entry(kernel, vb)
+        if entry is None:
+            continue
+        v, us = entry.get("value"), entry.get("us")
+        if us is None or (candidates and v not in candidates):
+            continue
+        if float(us) < best_us:
+            best_name, best_value, best_us = name, v, float(us)
+    if best_name != dflt:
+        _count("selected", kernel=kernel, variant=best_name)
+    return best_name, best_value

@@ -308,6 +308,12 @@ declare("KEYSTONE_AUTOTUNE_BUDGET_S", "float", 30.0,
 declare("KEYSTONE_AUTOTUNE_GRID", "int", 8,
         "Maximum candidates per autotune sweep (the bounded grid).",
         validator=_positive)
+declare("KEYSTONE_AUTOTUNE_VARIANTS", "bool", True,
+        "Under KEYSTONE_AUTOTUNE=1, also sweep each kernel's generated "
+        "variant space (loop order, fusion span — ops/pallas/variants.py) "
+        "after the parity + ir_rules validation gate; 0 restricts sweeps "
+        "to the default variant's tile grid. Persisted variant winners "
+        "still serve either way.")
 declare("KEYSTONE_EVAL_CACHED_TIMING", "bool", False,
         "Record the cached-featurization eval timing rows "
         "(featurize_cached_s / predict_cached_s) during pipeline eval.")
@@ -318,6 +324,12 @@ declare("KEYSTONE_BENCH_BUDGET_S", "float", 840.0,
 declare("KEYSTONE_BENCH_SECTION_FLOOR_S", "float", 60.0,
         "Minimum per-section budget the bench derates subprocess regimes "
         "to.", validator=_non_negative)
+declare("KEYSTONE_BENCH_CURSOR", "str", "",
+        "Path of the bench's persisted round-robin cursor for the "
+        "secondary sections (default: .bench_cursor.json at the repo "
+        "root); each run starts the rotation one section later, so a "
+        "budget that exhausts mid-list still covers every section within "
+        "a few runs.")
 declare("KEYSTONE_GUARD", "bool", False,
         "Arm the runtime guard: jax transfer_guard plus a recompilation "
         "sentinel, feeding guard.transfer / guard.recompile counters into "

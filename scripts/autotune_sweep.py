@@ -80,16 +80,38 @@ def sweep_overlap_tiles() -> None:
 
 
 def sweep_extraction() -> None:
-    from keystone_tpu.ops.pallas.extraction import fv_encode_tile, sift_bins_tile
+    """Sweep the generated-variant spaces, not just tiles: each plan call
+    resolves the default variant's tile at the bare bucket (pre-variant
+    entries stay valid), then validates + sweeps every non-default variant
+    at its ``#``-qualified bucket and arbitrates the measured winner."""
+    from keystone_tpu.ops.pallas.extraction import (
+        conv_norm_plan,
+        conv_pool_plan,
+        fv_encode_plan,
+        pool_sum_plan,
+        sift_bins_plan,
+    )
 
-    # representative extraction shapes: a 2048-row/64-wide SIFT chunk and a
-    # 512-descriptor/64-dim/16-center FV encode
+    # representative extraction shapes: a 2048-row/64-wide SIFT chunk, a
+    # 512-descriptor/64-dim/16-center FV encode, and the CIFAR-scale
+    # conv/pool geometry (32² RGB, 5² patches, 256 filters)
     for tier in TIERS:
-        t = sift_bins_tile(2048, 64, 36, allow_sweep=True, tier=tier)
-        print(f"sift.bins tier={tier} -> {t}")
+        v, t = sift_bins_plan(2048, 64, 36, allow_sweep=True, tier=tier)
+        print(f"sift.bins tier={tier} -> {v}/{t}")
     for tier in TIERS:
-        t = fv_encode_tile(512, 64, 16, allow_sweep=True, tier=tier)
-        print(f"fv.encode tier={tier} -> {t}")
+        v, t = fv_encode_plan(512, 64, 16, allow_sweep=True, tier=tier)
+        print(f"fv.encode tier={tier} -> {v}/{t}")
+    for tier in TIERS:
+        v, t = conv_norm_plan(32, 32, 3, 5, 256, allow_sweep=True, tier=tier)
+        print(f"conv.norm tier={tier} -> {v}/{t}")
+    for tier in TIERS:
+        v, t = pool_sum_plan(28, 28, 256, stride=2, pool_size=3,
+                             allow_sweep=True, tier=tier)
+        print(f"pool.sum tier={tier} -> {v}/{t}")
+    for tier in TIERS:
+        v, t = conv_pool_plan(32, 32, 3, 5, 256, stride=2, pool_size=3,
+                              allow_sweep=True, tier=tier)
+        print(f"conv.pool tier={tier} -> {v}/{t}")
 
 
 def sweep_moments() -> None:

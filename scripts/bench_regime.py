@@ -469,8 +469,10 @@ def _extraction_kernels() -> dict:
     from keystone_tpu.ops.images.fisher_vector import _fv_cols_batch_pallas
     from keystone_tpu.learning.gmm import GaussianMixtureModel
     from keystone_tpu.ops.pallas.extraction import (
-        fv_encode_tile,
-        sift_bins_tile,
+        conv_norm_pool,
+        conv_pool_plan,
+        fv_encode_plan,
+        sift_bins_plan,
     )
 
     smoke = bench._SMOKE
@@ -488,7 +490,11 @@ def _extraction_kernels() -> dict:
     # both arms share the selection-matmul flop model: binned energies @
     # Mx then the H-axis contraction with My
     flops = 2.0 * b * 8 * hw * hw * q + 2.0 * b * 8 * q * hw * ny * NUM_BIN_S
-    tile = sift_bins_tile(b * hw, hw, q)
+    # variant honesty: the row times whatever form the search serves, and
+    # the artifact names it — a reader can tell a generated-variant win
+    # from the hand-written default without opening the cache
+    sift_variant, tile = sift_bins_plan(b * hw, hw, q)
+    out["sift_bins_variant_winner"] = sift_variant
     iters = 2 if small else 4
     for arm, impl in (("on", "pallas"), ("off", "auto")):
         key_name = f"sift_pallas_{arm}_gflops"
@@ -496,7 +502,7 @@ def _extraction_kernels() -> dict:
             key_name,
             lambda i, impl=impl: _dsift_single_scale(
                 imgs + (i * 1e-4), step, bin_size, min_bound, hw, hw,
-                impl, tile,
+                impl, tile, "f32", sift_variant,
             )[0],
             flops, iters,
         )
@@ -512,7 +518,8 @@ def _extraction_kernels() -> dict:
     )
     # posterior gemms (2d-wide affine form) + the two moment contractions
     fv_flops = n_img * nd * (2.0 * 2 * d * k + 2.0 * 2 * k * 2 * d)
-    fv_encode_tile(nd, d, k)  # resolve (and possibly sweep) OUTSIDE timing
+    # resolve (and possibly sweep) OUTSIDE timing; record the served form
+    out["fv_encode_variant_winner"] = fv_encode_plan(nd, d, k)[0]
     xla_twin = FV._fv_cols_batch_mxu if tpu else FV._fv_cols_batch_f32
     for arm, fn in (("on", _fv_cols_batch_pallas), ("off", xla_twin)):
         key_name = f"fv_encode_pallas_{arm}_gflops"
@@ -521,6 +528,42 @@ def _extraction_kernels() -> dict:
             lambda i, fn=fn: fn(x + (i * 1e-4), gmm, 0, 2 * k),
             fv_flops, iters,
         )
+
+    # --- conv.norm → pool.sum fusion span: fused kernel vs split pair ---
+    cb, ch, cw, cc = (2, 20, 20, 3) if small else (16, 32, 32, 3)
+    ksz, nf, stride, pool_size = 5, 64 if small else 256, 2, 3
+    cimgs = jax.random.uniform(key, (cb, ch, cw, cc), jnp.float32)
+    cfilt = jax.random.normal(key, (nf, ksz * ksz * cc), jnp.float32)
+    res_h, res_w = ch - ksz + 1, cw - ksz + 1
+    # conv matmuls dominate; pooling's two selection matmuls ride along
+    conv_flops = 2.0 * cb * res_h * res_w * ksz * ksz * cc * nf
+    cp_variant, cp_tile = conv_pool_plan(
+        ch, cw, cc, ksz, nf, stride=stride, pool_size=pool_size
+    )
+    out["conv_pool_variant_winner"] = cp_variant
+    if cp_tile is not None:
+        fused_variant = (
+            cp_variant if cp_variant.startswith("fused.") else "fused.yx"
+        )
+        for key_name, variant in (
+            ("conv_pool_fused_gflops", fused_variant),
+            ("conv_pool_split_gflops", "split"),
+        ):
+            out[key_name] = _try_gflops(
+                key_name,
+                lambda i, v=variant: conv_norm_pool(
+                    cimgs + (i * 1e-4), cfilt, num_channels=cc,
+                    normalize=True, var_constant=10.0, stride=stride,
+                    pool_size=pool_size, tile_f=cp_tile, variant=v,
+                ),
+                conv_flops, iters,
+            )
+        fused = out.get("conv_pool_fused_gflops")
+        split = out.get("conv_pool_split_gflops")
+        if fused and split:
+            out["conv_pool_fused_vs_split_gflops"] = round(fused / split, 3)
+    else:
+        out["conv_pool_fused_gflops_skipped"] = "vmem"
     return out
 
 

@@ -1,0 +1,117 @@
+"""End-to-end smoke of the kernel variant search (<20 s, CPU).
+
+The contract ``make verify-fast`` rides, visible in the terminal instead
+of buried in a fixture: against a THROWAWAY cache (never the committed
+``autotune_cache.json``), a tiny interpret-mode sweep of the fused-span
+kernel's full variant space (``conv.pool``: split | fused.yx | fused.xy)
+
+1. validates every challenger (parity + ir_rules gate: ``variants.
+   rejected`` stays zero on the clean repo), sweeps each variant's tile
+   grid once, and persists bare + ``#variant`` entries side by side;
+2. RELOADED (in-memory mirror dropped = the fresh-process case) serves
+   the measured cross-variant winner with ZERO re-sweeps — the
+   ``autotune.sweep`` counter is flat across the reload;
+3. the fused variants stay bit-envelope equivalent to the split pair
+   (the conv intermediate leaving VMEM must never change the answer).
+
+``make kernel-search-smoke``; folded into ``verify-fast``.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_TMP = tempfile.mkdtemp(prefix="kernel_search_smoke_")
+os.environ["KEYSTONE_AUTOTUNE_CACHE"] = os.path.join(
+    _TMP, "autotune_cache.json"
+)
+os.environ["KEYSTONE_AUTOTUNE"] = "1"
+os.environ["KEYSTONE_AUTOTUNE_BUDGET_S"] = "10"
+# one tile candidate per variant: the smoke pins the SEARCH protocol
+# (validate -> sweep -> persist -> reload -> zero re-sweeps), not the grid
+os.environ["KEYSTONE_AUTOTUNE_GRID"] = "1"
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from keystone_tpu.ops.pallas import autotune, variants  # noqa: E402
+from keystone_tpu.ops.pallas.extraction import (  # noqa: E402
+    conv_norm_pool,
+    conv_pool_plan,
+)
+from keystone_tpu.telemetry import get_registry  # noqa: E402
+
+_BUDGET_S = 20.0
+# tiny CIFAR-shaped geometry: every tile candidate fits, sweeps are ms
+_H, _W, _C, _KSZ, _NF = 14, 14, 3, 5, 32
+_STRIDE, _POOL = 2, 3
+
+
+def _count(name: str) -> float:
+    return sum(get_registry().counters(name).values())
+
+
+def main() -> int:
+    t0 = time.monotonic()
+
+    s0 = _count("autotune.sweep")
+    r0 = _count("variants.rejected")
+    variant, tile = conv_pool_plan(
+        _H, _W, _C, _KSZ, _NF, stride=_STRIDE, pool_size=_POOL,
+    )
+    swept = _count("autotune.sweep") - s0
+    assert tile is not None, "no tile fit the smoke geometry"
+    assert variant in variants.known_variants("conv.pool"), variant
+    assert swept >= 2, f"expected a full variant sweep, got {swept} sweeps"
+    assert _count("variants.rejected") == r0, (
+        "a variant failed the parity/ir_rules gate on the clean repo"
+    )
+    # bare + #variant entries persisted side by side
+    bucket = autotune.shape_bucket(_H, _W, _NF)
+    assert autotune.peek_entry("conv.pool", bucket) is not None
+    for name in variants.known_variants("conv.pool")[1:]:
+        assert autotune.peek_entry("conv.pool", f"{bucket}#{name}"), name
+
+    # the fresh-process case: reload -> same winner, ZERO re-sweeps
+    autotune.clear_memory_cache()
+    s1 = _count("autotune.sweep")
+    again = conv_pool_plan(
+        _H, _W, _C, _KSZ, _NF, stride=_STRIDE, pool_size=_POOL,
+    )
+    assert again == (variant, tile), (again, variant, tile)
+    assert _count("autotune.sweep") == s1, "a persisted winner was re-swept"
+
+    # fused parity vs the split pair on the served tile
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(
+        rng.uniform(0, 1, (2, _H, _W, _C)).astype(np.float32)
+    )
+    filters = jnp.asarray(
+        rng.normal(size=(_NF, _KSZ * _KSZ * _C)).astype(np.float32)
+    )
+    kw = dict(num_channels=_C, normalize=True, var_constant=10.0,
+              stride=_STRIDE, pool_size=_POOL, tile_f=tile, interpret=True)
+    split = np.asarray(conv_norm_pool(imgs, filters, variant="split", **kw))
+    denom = float(np.max(np.abs(split))) + 1e-9
+    for name in ("fused.yx", "fused.xy"):
+        fused = np.asarray(conv_norm_pool(imgs, filters, variant=name, **kw))
+        err = float(np.max(np.abs(fused - split))) / denom
+        assert err <= 2e-5, f"{name} diverged from split: rel err {err:.2e}"
+
+    dt = time.monotonic() - t0
+    assert dt < _BUDGET_S, f"kernel-search smoke too slow: {dt:.1f}s"
+    print(
+        f"kernel-search smoke OK in {dt:.1f}s: winner {variant}/{tile} "
+        f"after {swept:.0f} sweeps, reload re-swept 0, fused==split"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,9 @@ def _run_bench(tmp_path, extra_env):
         BENCH_FULL_PATH=str(tmp_path / "bench_full.json"),
         BENCH_TELEMETRY_PATH=str(tmp_path / "bench_telemetry.json"),
         BENCH_XLA_CACHE=str(tmp_path / "xla_cache"),
+        # isolate the secondary-section rotation from the repo's cursor
+        # (and from other tests sharing this tmp_path)
+        KEYSTONE_BENCH_CURSOR=str(tmp_path / "bench_cursor.json"),
     )
     env.update(extra_env)
     return subprocess.run(
@@ -247,6 +250,43 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # contract — no decode-GB/s claim may land without its budget story
     assert full.get("ingest_skipped") == "budget"
     assert "ingest_gbs" not in full
+    # the secondary sections starve too, but the rotation STILL advances
+    # and is recorded — a fully-starved run must not freeze the cursor
+    assert full["bench_secondary_cursor"] == 0
+    assert full["bench_secondary_order"].startswith("extras,")
+    cursor = json.loads((tmp_path / "bench_cursor.json").read_text())
+    assert cursor["secondary"] == 1
+
+
+def test_bench_secondary_cursor_rotates_across_runs(tmp_path):
+    """The bench-budget rebalance (BENCH_r06–r08): the in-process secondary
+    sections rotate their start index across runs via the persisted
+    cursor, so a budget that exhausts partway down the list starves a
+    DIFFERENT suffix each run — every section gets fresh coverage within
+    len(sections) runs instead of the tail never running. Zero budget
+    keeps both runs fast; the rotation must advance regardless."""
+    runs = []
+    for _ in range(2):
+        proc = _run_bench(tmp_path, {"KEYSTONE_BENCH_BUDGET_S": "0"})
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        runs.append(
+            json.loads((tmp_path / "bench_full.json").read_text())
+        )
+    first, second = runs
+    assert first["bench_secondary_cursor"] == 0
+    assert second["bench_secondary_cursor"] == 1
+    order1 = first["bench_secondary_order"].split(",")
+    order2 = second["bench_secondary_order"].split(",")
+    # same sections, rotated by one: run 2 starts where run 1's second
+    # section was, and the full multiset is preserved
+    assert sorted(order1) == sorted(order2)
+    assert order1 != order2
+    assert order2[0] == order1[1]
+    assert order2 == order1[1:] + order1[:1]
+    # every secondary section in run 2 still got its budget marker (zero
+    # budget): rotation changes WHO starves first, never the contract
+    for name in order2:
+        assert second.get(f"{name}_skipped") == "budget"
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

@@ -1,0 +1,410 @@
+"""Generated kernel variants vs the XLA twins, and the search protocol
+(``ops/pallas/variants.py`` + ``ops/pallas/extraction.py``).
+
+Three contracts pinned here:
+
+- PARITY: every variant in every kernel's declared space matches the
+  untouched XLA twin on odd / tile-straddling shapes, at BOTH precision
+  tiers (f32 bit-envelope; bf16 within the storage-rounding envelope) —
+  a generated kernel may win on measured speed, never on wrong answers.
+- CACHE MIGRATION: pre-variant tile-only cache entries keep serving as
+  the default variant's winners (bare bucket = default variant), while
+  entries naming an UNKNOWN ``#variant`` are pruned on load and never
+  shadow a real winner.
+- WINNER SELECTION: a challenger variant serves only when both it and
+  the default carry a persisted measured latency and the challenger's is
+  strictly smaller; a variant failing the validation gate is never
+  swept, never recorded, never served; after one full sweep a reload
+  performs ZERO re-sweeps (the contract ``tests/test_autotune.py`` pins
+  for tiles, extended across the variant axis).
+
+Counter assertions are DELTAS against the shared process registry, same
+discipline as ``tests/test_autotune.py``.
+"""
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.learning.gmm import GaussianMixtureModel
+from keystone_tpu.ops.images import fisher_vector as FV
+from keystone_tpu.ops.images.convolver import Convolver
+from keystone_tpu.ops.images.pooler import Pooler
+from keystone_tpu.ops.images.sift import _dsift_single_scale
+from keystone_tpu.ops.pallas import autotune, variants
+from keystone_tpu.ops.pallas import extraction as E
+from keystone_tpu.telemetry import get_registry
+
+TIERS = ("f32", "bf16")
+
+
+def _count(name: str) -> float:
+    return sum(get_registry().counters(name).values())
+
+
+def _rel_close(a, b, tol):
+    a, b = np.asarray(a), np.asarray(b)
+    denom = np.max(np.abs(b)) + 1e-9
+    np.testing.assert_allclose(a / denom, b / denom, atol=tol)
+
+
+def _tol(tier: str) -> float:
+    return variants.PARITY_TOL[tier]
+
+
+@pytest.fixture()
+def tuner_cache(tmp_path, monkeypatch):
+    path = tmp_path / "autotune_cache.json"
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE_CACHE", str(path))
+    autotune.clear_memory_cache()
+    yield path
+    autotune.clear_memory_cache()
+
+
+# --------------------------------------------------------------------------
+# key composition
+# --------------------------------------------------------------------------
+
+
+def test_variant_bucket_composition():
+    """``"<shape>[@tier][#variant]"``: default variants keep the bare
+    bucket (pre-variant entries stay valid winners); the variant suffix
+    joins LAST, after the precision tier; typos raise instead of minting
+    a cache partition nobody will ever serve."""
+    for kernel, space in variants.VARIANT_SPACES.items():
+        assert variants.known_variants(kernel) == space
+        assert variants.default_variant(kernel) == space[0]
+        assert variants.variant_bucket("64x64", kernel, space[0]) == "64x64"
+    assert variants.variant_bucket("64x64", "conv.norm", "xy") == "64x64#xy"
+    assert (
+        variants.variant_bucket("32x32@bf16", "conv.pool", "fused.yx")
+        == "32x32@bf16#fused.yx"
+    )
+    with pytest.raises(ValueError):
+        variants.variant_bucket("b", "conv.norm", "zz")
+    with pytest.raises(ValueError):
+        variants.known_variants("no.such.kernel")
+
+
+# --------------------------------------------------------------------------
+# parity: every variant vs the XLA twin, odd shapes, both tiers
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_sift_stack_variant_matches_matmul_twin(tier):
+    rng = np.random.default_rng(20)
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, 37, 53)).astype(np.float32))
+    args = (3, 4, 9, 37, 53)
+    d_ref, m_ref = _dsift_single_scale(imgs, *args, "matmul")
+    d_out, m_out = _dsift_single_scale(imgs, *args, "pallas", 16, tier,
+                                       "stack")
+    _rel_close(d_out, d_ref, _tol(tier))
+    _rel_close(m_out, m_ref, _tol(tier))
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_fv_joint_variant_matches_f32_twin(tier, monkeypatch):
+    """The joint (Kp, 2d) moment matmul through the full FV dispatch path
+    (plan monkeypatched to force the variant; the lazy import inside
+    ``_fv_cols_batch_pallas`` re-reads the extraction module attribute)."""
+    rng = np.random.default_rng(21)
+    k, d, nd = 8, 12, 37  # nd indivisible by every tile candidate
+    gmm = GaussianMixtureModel(
+        means=jnp.asarray(rng.normal(size=(k, d)).astype(np.float32)),
+        variances=jnp.asarray(
+            rng.uniform(0.5, 2.0, (k, d)).astype(np.float32)
+        ),
+        weights=jnp.asarray(rng.dirichlet(np.ones(k)).astype(np.float32)),
+    )
+    x = jnp.asarray(rng.normal(size=(3, nd, d)).astype(np.float32))
+    ref = FV._fv_cols_batch_f32(x, gmm, 0, 2 * k)
+    monkeypatch.setenv("KEYSTONE_PRECISION_TIER", tier)
+    monkeypatch.setattr(E, "fv_encode_plan", lambda *a, **kw: ("joint", 16))
+    out = FV._fv_cols_batch_pallas(x, gmm, 0, 2 * k)
+    assert out.shape == ref.shape
+    _rel_close(out, ref, _tol(tier))
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_conv_xy_variant_matches_xla_twin(tier):
+    rng = np.random.default_rng(22)
+    k, c, nf = 5, 3, 7  # odd nf -> filter-tile padding engages
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, 17, 19, c)).astype(np.float32))
+    filters = jnp.asarray(
+        rng.normal(size=(nf, k * k * c)).astype(np.float32)
+    )
+    conv = Convolver(filters=filters, num_channels=c, normalize_patches=True)
+    ref = conv._apply_batch_xla(imgs)
+    out = E.conv_norm(
+        imgs, filters, num_channels=c, normalize=True, var_constant=10.0,
+        tile_f=64, interpret=True, tier=tier, variant="xy",
+    )
+    assert out.shape == ref.shape
+    _rel_close(out, ref, _tol(tier))
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_pool_wh_variant_matches_xla_twin(tier, monkeypatch):
+    rng = np.random.default_rng(23)
+    imgs = jnp.asarray(rng.normal(size=(2, 13, 11, 5)).astype(np.float32))
+    pool = Pooler(stride=3, pool_size=5, pool="sum")
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = pool.apply_batch(imgs)  # the XLA twin (kernel is explicit-only)
+    out = E.pool_sum(imgs, 3, 5, None, tile_c=8, interpret=True, tier=tier,
+                     variant="wh")
+    assert out.shape == ref.shape
+    _rel_close(out, ref, _tol(tier))
+
+
+@pytest.mark.parametrize("variant", ["split", "fused.yx", "fused.xy"])
+@pytest.mark.parametrize("tier", TIERS)
+def test_conv_pool_variants_match_xla_twin_pair(tier, variant, monkeypatch):
+    """The fusion span vs the untouched two-stage XLA reference (conv twin
+    through HBM, then pool twin), with the filter axis STRADDLING two
+    64-wide tiles (nf=70) and odd image geometry — ragged tiles, lane
+    padding and the pooled-block trim all engage."""
+    rng = np.random.default_rng(24)
+    k, c, nf = 3, 3, 70
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, 11, 13, c)).astype(np.float32))
+    filters = jnp.asarray(
+        rng.normal(size=(nf, k * k * c)).astype(np.float32)
+    )
+    conv = Convolver(filters=filters, num_channels=c, normalize_patches=True)
+    pool = Pooler(stride=2, pool_size=3, pool="sum")
+    monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
+    ref = pool.apply_batch(conv._apply_batch_xla(imgs))
+    out = E.conv_norm_pool(
+        imgs, filters, num_channels=c, normalize=True, var_constant=10.0,
+        stride=2, pool_size=3, tile_f=64, interpret=True, tier=tier,
+        variant=variant,
+    )
+    assert out.shape == ref.shape
+    _rel_close(out, ref, _tol(tier))
+
+
+def test_conv_pool_fused_equals_split_bit_envelope():
+    """The acceptance headline: at f32 the fused kernel is bit-envelope
+    equivalent to the split pair (same arithmetic, same order — only the
+    HBM round-trip is removed)."""
+    rng = np.random.default_rng(25)
+    imgs = jnp.asarray(rng.uniform(0, 1, (2, 14, 14, 3)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(7, 75)).astype(np.float32))
+    kw = dict(num_channels=3, normalize=True, var_constant=10.0, stride=2,
+              pool_size=3, tile_f=64, interpret=True)
+    split = E.conv_norm_pool(imgs, filters, variant="split", **kw)
+    for variant in ("fused.yx", "fused.xy"):
+        fused = E.conv_norm_pool(imgs, filters, variant=variant, **kw)
+        _rel_close(fused, split, 2e-5)
+
+
+# --------------------------------------------------------------------------
+# cache migration: pre-variant entries serve, unknown variants are pruned
+# --------------------------------------------------------------------------
+
+
+def test_pre_variant_tile_only_entry_still_serves_default(
+    tuner_cache, monkeypatch
+):
+    """A cache written BEFORE the variant search existed (bare bucket,
+    tile winner only) must keep serving — as the default variant, with
+    zero sweeps and zero validation."""
+    monkeypatch.delenv("KEYSTONE_AUTOTUNE", raising=False)
+    bucket = autotune.precision_bucket(autotune.shape_bucket(16, 16, 7),
+                                      "f32")
+    tuner_cache.write_text(json.dumps({
+        "version": 1,
+        "devices": {autotune.device_key(): {
+            "conv.norm": {bucket: {"value": 64, "us": 10.0, "swept": 2}},
+        }},
+    }))
+    autotune.clear_memory_cache()
+    s0 = _count("autotune.sweep")
+    variant, tile = E.conv_norm_plan(16, 16, 3, 3, 7, allow_sweep=False)
+    assert (variant, tile) == ("yx", 64)
+    assert _count("autotune.sweep") == s0
+
+
+def test_unknown_variant_and_tier_entries_pruned_known_survive(tuner_cache):
+    dev = autotune.device_key()
+    tuner_cache.write_text(json.dumps({
+        "version": 1,
+        "devices": {dev: {"conv.norm": {
+            "64x64": {"value": 128, "us": 5.0},
+            "64x64#xy": {"value": 64, "us": 4.0},
+            "64x64@bf16#xy": {"value": 64, "us": 3.0},
+            "64x64#bogus": {"value": 8, "us": 0.1},      # unknown variant
+            "64x64@f16": {"value": 8, "us": 0.1},        # unknown tier
+            "64x64@f16#xy": {"value": 8, "us": 0.1},
+        }, "made.up.kernel": {
+            "8x8#xy": {"value": 8, "us": 0.1},           # no declared space
+        }}},
+    }))
+    autotune.clear_memory_cache()
+    assert autotune.lookup("conv.norm", "64x64") == 128
+    assert autotune.lookup("conv.norm", "64x64#xy") == 64
+    assert autotune.lookup("conv.norm", "64x64@bf16#xy") == 64
+    assert autotune.lookup("conv.norm", "64x64#bogus") is None
+    assert autotune.lookup("conv.norm", "64x64@f16") is None
+    assert autotune.lookup("conv.norm", "64x64@f16#xy") is None
+    assert autotune.lookup("made.up.kernel", "8x8#xy") is None
+    # a pruned phantom cannot shadow: search at this bucket arbitrates
+    # over the surviving entries only
+    variant, value = variants.search("conv.norm", "64x64", (64, 128), 128)
+    assert (variant, value) == ("xy", 64)
+
+
+# --------------------------------------------------------------------------
+# winner selection: measured-winner protocol across variants
+# --------------------------------------------------------------------------
+
+
+def test_challenger_needs_strictly_smaller_measured_us(
+    tuner_cache, monkeypatch
+):
+    monkeypatch.delenv("KEYSTONE_AUTOTUNE", raising=False)
+    autotune.record("pool.sum", "64x64", 128, micros=100.0, swept=2)
+    # challenger without a measured us: the default serves
+    autotune.record("pool.sum", "64x64#wh", 64, micros=None, swept=1)
+    assert variants.search("pool.sum", "64x64", (64, 128), 128) \
+        == ("hw", 128)
+    # slower challenger: the default serves
+    autotune.record("pool.sum", "64x64#wh", 64, micros=150.0, swept=1)
+    assert variants.search("pool.sum", "64x64", (64, 128), 128) \
+        == ("hw", 128)
+    # strictly faster challenger: it serves
+    autotune.record("pool.sum", "64x64#wh", 64, micros=50.0, swept=1)
+    assert variants.search("pool.sum", "64x64", (64, 128), 128) \
+        == ("wh", 64)
+    # ... but an out-of-candidates winner value is skipped (same guard as
+    # resolve: a tile swept at the small end of the bucket may not fit)
+    assert variants.search("pool.sum", "64x64", (128,), 128) == ("hw", 128)
+
+
+def test_unmeasured_default_serves_even_against_measured_challenger(
+    tuner_cache, monkeypatch
+):
+    """No measured incumbent -> nothing to beat: a challenger may only win
+    a MEASURED comparison, never by default."""
+    monkeypatch.delenv("KEYSTONE_AUTOTUNE", raising=False)
+    autotune.record("pool.sum", "32x32", 128, swept=0)  # no us
+    autotune.record("pool.sum", "32x32#wh", 64, micros=5.0, swept=1)
+    assert variants.search("pool.sum", "32x32", (64, 128), 128) \
+        == ("hw", 128)
+
+
+def test_rejected_variant_never_swept_recorded_or_served(
+    tuner_cache, monkeypatch
+):
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE", "1")
+    measured = []
+
+    def measure_for(name):
+        def measure(cand, reps):
+            measured.append((name, cand))
+            return 0.01 * reps
+        return measure
+
+    s0 = _count("autotune.sweep")
+    variant, value = variants.search(
+        "pool.sum", "8x8", (8, 16), 8,
+        measure_for=measure_for, validate_for=lambda name: False,
+    )
+    assert variant == "hw"
+    assert all(name == "hw" for name, _ in measured)  # default swept only
+    assert autotune.peek_entry("pool.sum", "8x8#wh") is None
+    assert _count("autotune.sweep") == s0 + 1
+
+
+def test_validate_variant_counts_and_gates():
+    reg = get_registry()
+    v0 = sum(reg.counters("variants.validated").values())
+    r0 = sum(reg.counters("variants.rejected").values())
+    ok = lambda: jnp.ones((3,))
+    assert variants.validate_variant("pool.sum", "wh", ok, ok, tol=1e-6)
+    assert sum(reg.counters("variants.validated").values()) == v0 + 1
+    # parity failure
+    assert not variants.validate_variant(
+        "pool.sum", "wh", lambda: 2.0 * ok(), ok, tol=1e-6
+    )
+    # NaN is a failure, not a vacuous pass
+    assert not variants.validate_variant(
+        "pool.sum", "wh", lambda: jnp.full((3,), jnp.nan), ok, tol=1e-6
+    )
+    # a variant that cannot even run is rejected, not fatal
+    def boom():
+        raise RuntimeError("unlowerable")
+    assert not variants.validate_variant("pool.sum", "wh", boom, ok,
+                                         tol=1e-6)
+    assert sum(reg.counters("variants.rejected").values()) == r0 + 3
+
+
+def test_variants_knob_off_restricts_sweep_to_default_grid(
+    tuner_cache, monkeypatch
+):
+    """KEYSTONE_AUTOTUNE_VARIANTS=0 under KEYSTONE_AUTOTUNE=1: only the
+    default variant's tile grid sweeps — but a PERSISTED variant winner
+    still serves (the knob gates sweeping, not serving)."""
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE", "1")
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE_VARIANTS", "0")
+    measured = []
+
+    def measure_for(name):
+        def measure(cand, reps):
+            measured.append((name, cand))
+            return (0.01 if name == "hw" else 0.001) * reps
+        return measure
+
+    def never(name):
+        raise AssertionError("validated a variant with the knob off")
+
+    variant, value = variants.search(
+        "pool.sum", "4x4", (8, 16), 8,
+        measure_for=measure_for, validate_for=never,
+    )
+    assert variant == "hw"
+    assert all(name == "hw" for name, _ in measured)
+    assert autotune.peek_entry("pool.sum", "4x4#wh") is None
+    # persisted challenger from a prior full sweep still serves
+    autotune.record("pool.sum", "4x4#wh", 16, micros=1.0, swept=2)
+    assert variants.search(
+        "pool.sum", "4x4", (8, 16), 8,
+        measure_for=measure_for, validate_for=never,
+    ) == ("wh", 16)
+
+
+def test_full_search_persists_then_reload_zero_resweeps(
+    tuner_cache, monkeypatch
+):
+    """The zero-re-sweeps contract across the variant axis: one full sweep
+    (default + challenger), then a fresh process against the persisted
+    file serves the measured winner with no measurement at all."""
+    monkeypatch.setenv("KEYSTONE_AUTOTUNE", "1")
+    measured = []
+
+    def measure_for(name):
+        def measure(cand, reps):
+            measured.append((name, cand))
+            base = {"hw": 0.02, "wh": 0.005}[name]
+            return base * reps
+        return measure
+
+    s0 = _count("autotune.sweep")
+    variant, value = variants.search(
+        "pool.sum", "16x16", (8, 16), 8,
+        measure_for=measure_for, validate_for=lambda name: True,
+    )
+    assert variant == "wh"  # the measured winner
+    assert _count("autotune.sweep") == s0 + 2  # bare + #wh, once each
+    assert {n for n, _ in measured} == {"hw", "wh"}
+
+    measured.clear()
+    autotune.clear_memory_cache()  # the fresh-process case
+    assert variants.search(
+        "pool.sum", "16x16", (8, 16), 8,
+        measure_for=measure_for, validate_for=lambda name: True,
+    ) == (variant, value)
+    assert not measured, "a persisted variant winner was re-swept"
+    assert _count("autotune.sweep") == s0 + 2

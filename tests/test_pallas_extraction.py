@@ -53,10 +53,10 @@ def test_knob_zero_is_the_exact_prior_path(monkeypatch):
     node = SIFTExtractor()
     img = jnp.zeros((32, 32), jnp.float32)
     monkeypatch.delenv("KEYSTONE_PALLAS", raising=False)
-    assert _resolve_impl_and_tile(node, img) == ("auto", 0, "f32")
+    assert _resolve_impl_and_tile(node, img) == ("auto", 0, "f32", "unroll")
     assert FV._fv_moment_impl() == "f32"  # CPU default, prior behavior
     monkeypatch.setenv("KEYSTONE_PALLAS", "0")
-    assert _resolve_impl_and_tile(node, img) == ("auto", 0, "f32")
+    assert _resolve_impl_and_tile(node, img) == ("auto", 0, "f32", "unroll")
     assert FV._fv_moment_impl() == "f32"
     assert not E.pallas_enabled()
     assert not E.pallas_enabled(auto_ok=False)
@@ -211,7 +211,7 @@ def test_conv_pallas_with_whitener_and_knob(monkeypatch):
     _rel_close(out, ref)
     # auto grade does NOT engage the conv kernel (explicit-only)
     monkeypatch.setenv("KEYSTONE_PALLAS", "auto")
-    assert conv._pallas_tile(imgs) is None
+    assert conv._pallas_plan(imgs) is None
 
 
 def test_conv_pallas_vmem_fallback(monkeypatch):
@@ -224,9 +224,9 @@ def test_conv_pallas_vmem_fallback(monkeypatch):
         num_channels=3,
     )
     big = jnp.zeros((1, 1300, 1300, 3), jnp.float32)
-    assert conv._pallas_tile(big) is None
+    assert conv._pallas_plan(big) is None
     small = jnp.zeros((1, 16, 16, 3), jnp.float32)
-    assert conv._pallas_tile(small) is not None
+    assert conv._pallas_plan(small) is not None
 
 
 # --------------------------------------------------------------------------
