@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke kernel-search-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke ingest-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke kernel-search-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke fleet-smoke ingest-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -65,7 +65,7 @@ check-smoke:
 # tiny traced pipeline run asserting the telemetry contract end to end.
 verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
-	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=120 $(PY) bench.py
+	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=180 $(PY) bench.py
 	JAX_PLATFORMS=cpu $(PY) scripts/telemetry_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/autotune_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/kernel_search_smoke.py
@@ -76,6 +76,7 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/health_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/fleet_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/ingest_smoke.py
 
 # Streaming-ingest contract (<20 s): overlap-on <= overlap-off on a
@@ -118,6 +119,15 @@ serve-smoke:
 # serves steady state with zero recompiles (scripts/serve_chaos_smoke.py).
 serve-chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_chaos_smoke.py
+
+# Fleet-serving contract (<20 s): 2 replica worker processes x 2 tenants
+# — fleet predictions match a locally built deterministic twin (the
+# coalesced cross-process batch path vs the single-request apply), a
+# concurrent multi-tenant burst serves with zero steady-state recompiles
+# summed across the fleet, and both tenants land on the shared stats
+# view (scripts/fleet_smoke.py).
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/fleet_smoke.py
 
 # Precision-tier contract (<20 s): f32 tier byte-identical to the prior
 # program, bf16 parity within the documented envelope, and the bf16-sketch
@@ -163,11 +173,11 @@ bench-cached:
 	$(PY) bench.py
 
 # Tiny-shape end-to-end smoke of the bench contract itself: every shape
-# shrunk to CPU scale (BENCH_SMOKE=1), heavy sections off, 120 s budget —
+# shrunk to CPU scale (BENCH_SMOKE=1), heavy sections off, 180 s budget —
 # exercises the incremental-flush / budget-skip / compact-line machinery in
 # seconds. The bench-contract tier-1 test runs exactly this.
 bench-smoke:
-	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=120 $(PY) bench.py
+	BENCH_SMOKE=1 KEYSTONE_BENCH_BUDGET_S=180 $(PY) bench.py
 
 cpu-baseline:
 	JAX_PLATFORMS=cpu $(PY) scripts/cpu_baseline.py

@@ -144,6 +144,7 @@ INTENDED_PRECISION: Dict[str, Tuple[str, str]] = {
     "pallas.conv_pool_split": ("f32", "f32"),
     "dag.fused_segment": ("f32", "f32"),
     "serve.dispatch": ("f32", "f32"),
+    "serve.pool_dispatch": ("f32", "f32"),
     # the bf16 storage tier's audited programs (KEYSTONE_PRECISION_TIER)
     "overlap.tiled_gram_bf16": ("bf16", "f32"),
     "overlap.ring_gram_bf16": ("bf16", "f32"),
@@ -819,6 +820,35 @@ def _build_serve_dispatch(devices) -> Built:
     return Built(
         fn=lambda x: _serve_apply(node, x), args=(xs,), k=1,
         expect=dict(),
+    )
+
+
+@register("serve.pool_dispatch", "serve")
+def _build_serve_pool_dispatch(devices) -> Built:
+    """The multi-tenant pool's batched predict ladder: the SAME
+    ``_serve_apply`` the pool's gateways jit, traced over a COALESCED
+    micro-batch (requests from many client processes padded to a ladder
+    rung).  A4 (``check_padding``) polices the pad: the zero rows the
+    batcher appends must not widen into a full-batch copy.  A5 pins the
+    compiled buffer-assignment peak under ``ladder_peak_bytes`` — the
+    same closed-form bound the pool's HBM admission check enforces
+    BEFORE dispatch, so an optimistic bound would surface here, not as
+    an OOM in serving."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.serve.builders import cosine
+    from keystone_tpu.serve.gateway import _serve_apply
+    from keystone_tpu.serve.pool import ladder_peak_bytes
+
+    spec = cosine()[0]
+    ladder = (1, 4, 8)
+    rows = _f32(_rng(), 6, spec.item_spec.shape[0])
+    xs = jnp.zeros((max(ladder), spec.item_spec.shape[0]), jnp.float32)
+    xs = xs.at[: rows.shape[0]].set(rows)  # coalesced batch, zero-padded
+    return Built(
+        fn=lambda x: _serve_apply(spec.pipe, x), args=(xs,), k=1,
+        expect=dict(check_padding=True),
+        peak_estimate=ladder_peak_bytes(spec.pipe, spec.item_spec, ladder),
     )
 
 

@@ -380,6 +380,28 @@ class IntermediateCache:
             self._rebalance()
             return len(victims)
 
+    def demote(self, key: str) -> bool:
+        """Demote ONE device-tier entry to host (rebalance may spill it
+        further down its budgets); False when the key is absent or already
+        off-device.  The serving model pool's HBM-envelope eviction policy
+        (``serve/pool.py``) uses this for TARGETED victims — the coldest,
+        lowest-priority tenant leaves HBM, not the whole device tier."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.tier != _DEVICE:
+                return False
+            self._demote(e, _HOST)
+            self._rebalance()
+            return True
+
+    def tier_of(self, key: str) -> Optional[str]:
+        """The tier currently holding ``key`` ('device'|'host'|'disk'), or
+        None — placement introspection for eviction policies; never
+        promotes (unlike :meth:`lookup`)."""
+        with self._lock:
+            e = self._entries.get(key)
+            return None if e is None else e.tier
+
     # -- tier mechanics ----------------------------------------------------
 
     def _disk_path(self, key: str) -> str:

@@ -1,7 +1,11 @@
-"""Hardened serving tier (``keystone_tpu/serve/gateway.py``): the
-admission-checked prediction gateway with deadline-aware load shedding,
-circuit breaking, and graceful degradation."""
+"""Hardened serving tier: the admission-checked prediction gateway
+(``gateway.py``) plus the fleet layer above it — multi-tenant model pools
+with declared HBM envelopes (``pool.py``), the cross-process batching
+front (``front.py``), and replicated gateways behind one admission
+surface (``fleet.py``)."""
 
+from keystone_tpu.serve.fleet import Fleet, FleetDown
+from keystone_tpu.serve.front import BatchingFront, FrontClient, FrontError
 from keystone_tpu.serve.gateway import (
     DEFAULT_SHAPES,
     Gateway,
@@ -10,12 +14,21 @@ from keystone_tpu.serve.gateway import (
     ServeResponse,
     serve,
 )
+from keystone_tpu.serve.pool import ModelPool, ladder_peak_bytes, pool
 
 __all__ = [
+    "BatchingFront",
     "DEFAULT_SHAPES",
+    "Fleet",
+    "FleetDown",
+    "FrontClient",
+    "FrontError",
     "Gateway",
+    "ModelPool",
     "PendingResponse",
     "ServeRejected",
     "ServeResponse",
+    "ladder_peak_bytes",
+    "pool",
     "serve",
 ]

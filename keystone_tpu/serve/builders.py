@@ -1,0 +1,95 @@
+"""Named, deterministic model builders for fleet replica workers.
+
+A replica is a fresh OS process (``serve/fleet.py --worker``); it cannot
+be handed a fitted pipeline object, so it is handed a BUILDER NAME and
+reconstructs the model itself.  Every builder here is seeded and
+deterministic: N replicas built from the same name serve bit-identical
+models, which is what makes the fleet smoke's coalesced-batch parity check
+(front output vs a locally built twin) meaningful.
+
+``resolve`` also accepts ``"module:attr"`` for builders living outside
+this registry (the same spec convention the ingest worker pool uses for
+its decode hooks).
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ModelSpec", "BUILDERS", "resolve", "build"]
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """One tenant: a fitted pipeline + its per-item input spec and the
+    per-tenant pool kwargs (:meth:`ModelPool.add_model`)."""
+
+    name: str
+    pipe: Any
+    item_spec: Any
+    slo_ms: Optional[float] = None
+    priority: int = 0
+
+
+def _cosine_chain(dim: int, feats: int, seed: int):
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.pipeline import chain
+    from keystone_tpu.ops.stats import CosineRandomFeatures, LinearRectifier
+
+    node = chain(
+        CosineRandomFeatures.create(
+            dim, feats, 0.1, jax.random.key(seed)
+        ),
+        LinearRectifier(max_val=0.0),
+    )
+    spec = jax.ShapeDtypeStruct((dim,), jnp.float32)
+    return node, spec
+
+
+def cosine() -> List[ModelSpec]:
+    """One tenant, MXU-shaped enough to measure: a cosine random-feature
+    chain (the same family the ``serve.dispatch`` IR audit lowers).  No
+    fitting — replicas build it in milliseconds."""
+    node, spec = _cosine_chain(dim=64, feats=512, seed=17)
+    return [ModelSpec(name="default", pipe=node, item_spec=spec)]
+
+
+def two_tenant() -> List[ModelSpec]:
+    """Two tenants with distinct chains and widths: 'hot' (the flood
+    tenant in fairness tests) and 'cold' (the one fairness protects)."""
+    hot, hot_spec = _cosine_chain(dim=24, feats=96, seed=3)
+    cold, cold_spec = _cosine_chain(dim=16, feats=64, seed=5)
+    return [
+        ModelSpec(name="hot", pipe=hot, item_spec=hot_spec),
+        ModelSpec(name="cold", pipe=cold, item_spec=cold_spec),
+    ]
+
+
+BUILDERS: Dict[str, Callable[[], List[ModelSpec]]] = {
+    "cosine": cosine,
+    "two_tenant": two_tenant,
+}
+
+
+def resolve(name: str) -> Callable[[], List[ModelSpec]]:
+    """Builder by registry name, or ``module:attr`` for external ones."""
+    if name in BUILDERS:
+        return BUILDERS[name]
+    if ":" in name:
+        mod, _, attr = name.partition(":")
+        return getattr(importlib.import_module(mod), attr)
+    raise KeyError(
+        f"unknown builder {name!r}: registry has {sorted(BUILDERS)}, or "
+        "pass 'module:attr'"
+    )
+
+
+def build(name: str) -> List[ModelSpec]:
+    specs = resolve(name)()
+    if not specs:
+        raise ValueError(f"builder {name!r} produced no models")
+    return list(specs)

@@ -1,0 +1,352 @@
+"""Replicated gateways: N serving processes behind ONE admission surface.
+
+The third fleet tier (pool -> front -> replicas): a :class:`Fleet` spawns
+``KEYSTONE_SERVE_REPLICAS`` worker processes, each hosting a
+:class:`~keystone_tpu.serve.pool.ModelPool` (built from a named
+deterministic builder, ``serve/builders.py``) behind a
+:class:`~keystone_tpu.serve.front.BatchingFront` unix socket.  The parent
+is the admission surface:
+
+- **Routing** is least-loaded: each live replica's outstanding-request
+  count (parent-side) breaks toward the emptiest socket; drivers that want
+  raw throughput take :meth:`routes` and connect directly (the router
+  hands out ROUTES, it is not a proxy bottleneck).
+- **Shared load-shedding state**: :meth:`stats` polls every replica's
+  front (queue depth, shed totals, compile-cache size, per-tenant
+  accounting) into one view; a replica whose socket errors is marked dead
+  and leaves the route set.
+- **No wedge under replica death** (the chaos contract): a predict whose
+  replica dies mid-flight gets ONE retry on a surviving replica; with no
+  survivors it returns a structured ``fleet_down`` dict.  SIGKILLing a
+  replica under load (``Fleet.kill`` or a per-replica
+  ``KEYSTONE_FAULTS=serve.dispatch@N:kill`` plan riding the existing
+  fault sites) rebalances traffic onto the survivors.
+
+Replica environments are scrubbed: ``XLA_FLAGS`` is dropped (the 8-device
+host-platform sim is a test harness concern; a serving replica wants the
+real device set) and ``JAX_PLATFORMS`` defaults to the parent's value.
+Workers signal readiness by printing ``READY <socket>`` and exit when the
+parent closes their stdin — so a crashed parent reaps its fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from keystone_tpu.serve.front import FrontClient, FrontError
+
+__all__ = ["Fleet", "FleetDown"]
+
+
+class FleetDown(RuntimeError):
+    """Every replica is dead — the admission surface has nothing to route
+    to (returned as a structured dict by :meth:`Fleet.predict`; raised
+    only by :meth:`Fleet.require_live`)."""
+
+
+class _Replica:
+    def __init__(self, index: int, proc: subprocess.Popen, path: str):
+        self.index = index
+        self.proc = proc
+        self.path = path
+        self.client: Optional[FrontClient] = None
+        self.dead = False
+        self.outstanding = 0
+
+
+class Fleet:
+    """Spawn + route over N replica gateways (module docstring).
+
+    ``builder`` names a ``serve/builders.py`` entry (or ``module:attr``);
+    ``faults`` maps replica index -> a ``KEYSTONE_FAULTS`` plan armed in
+    that replica only (the chaos hook).  Worker knobs (``shapes``,
+    ``coalesce_ms``, ``slo_ms``, ``queue_depth``, ``hbm_mb``) are passed
+    through on the worker command line."""
+
+    def __init__(self, builder: str, replicas: Optional[int] = None, *,
+                 socket_dir: Optional[str] = None,
+                 shapes: Optional[str] = None,
+                 coalesce_ms: Optional[float] = None,
+                 slo_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 hbm_mb: Optional[float] = None,
+                 faults: Optional[Dict[int, str]] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 ready_timeout_s: float = 120.0):
+        from keystone_tpu.utils import knobs
+
+        self.builder = builder
+        n = int(replicas if replicas is not None
+                else knobs.get("KEYSTONE_SERVE_REPLICAS"))
+        if n < 1:
+            raise ValueError(f"fleet needs >= 1 replica, got {n}")
+        self._own_dir = socket_dir is None
+        self.socket_dir = socket_dir or tempfile.mkdtemp(
+            prefix="keystone-fleet-"
+        )
+        self._worker_args: List[str] = []
+        if shapes is not None:
+            self._worker_args += ["--shapes", str(shapes)]
+        if coalesce_ms is not None:
+            self._worker_args += ["--coalesce-ms", str(coalesce_ms)]
+        if slo_ms is not None:
+            self._worker_args += ["--slo-ms", str(slo_ms)]
+        if queue_depth is not None:
+            self._worker_args += ["--queue-depth", str(queue_depth)]
+        if hbm_mb is not None:
+            self._worker_args += ["--hbm-mb", str(hbm_mb)]
+        self._extra_env = dict(env or {})
+        self._faults = dict(faults or {})
+        self._lock = threading.Lock()
+        self.replicas: List[_Replica] = [
+            self._spawn(i) for i in range(n)
+        ]
+        self._await_ready(ready_timeout_s)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn(self, index: int) -> _Replica:
+        path = os.path.join(self.socket_dir, f"replica-{index}.sock")
+        # -c (not -m): runpy would import keystone_tpu.serve, whose
+        # __init__ imports this module, and then re-execute it — a
+        # double-import warning and two module objects
+        cmd = [
+            sys.executable, "-c",
+            "import sys; from keystone_tpu.serve.fleet import _worker_main;"
+            " sys.exit(_worker_main(sys.argv[1:]))",
+            "--worker", "--builder", self.builder, "--socket", path,
+        ] + self._worker_args
+        env = dict(os.environ)
+        # the 8-device host-platform sim (tests' XLA_FLAGS) would make
+        # every replica trace sharded programs it doesn't want; serving
+        # replicas see the real device set
+        env.pop("XLA_FLAGS", None)
+        env.update(self._extra_env)
+        plan = self._faults.get(index)
+        if plan is not None:
+            env["KEYSTONE_FAULTS"] = plan
+        proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=None, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+        )
+        return _Replica(index, proc, path)
+
+    def _await_ready(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        for rep in self.replicas:
+            while True:
+                if time.monotonic() > deadline:
+                    self.close()
+                    raise TimeoutError(
+                        f"replica {rep.index} not READY within {timeout_s}s"
+                    )
+                line = rep.proc.stdout.readline()
+                if not line:
+                    rc = rep.proc.poll()
+                    self.close()
+                    raise RuntimeError(
+                        f"replica {rep.index} exited (rc={rc}) before READY"
+                    )
+                if line.startswith("READY "):
+                    break
+                print(f"[replica-{rep.index}] {line.rstrip()}",
+                      file=sys.stderr)
+            rep.client = FrontClient(rep.path)
+
+    def kill(self, index: int) -> None:
+        """SIGKILL one replica (the chaos hammer — no drain, no goodbye)."""
+        rep = self.replicas[index]
+        try:
+            rep.proc.kill()
+        except OSError:
+            pass
+        self._mark_dead(rep)
+
+    def close(self) -> None:
+        for rep in self.replicas:
+            if rep.client is not None:
+                rep.client.close()
+            if rep.proc.poll() is None:
+                try:
+                    rep.proc.stdin.close()  # workers exit on stdin EOF
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 5.0
+        for rep in self.replicas:
+            while rep.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if rep.proc.poll() is None:
+                try:
+                    rep.proc.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+            try:
+                rep.proc.wait(timeout=5.0)
+            except Exception:
+                pass
+        if self._own_dir:
+            import shutil
+
+            shutil.rmtree(self.socket_dir, ignore_errors=True)
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- routing (the admission surface) -----------------------------------
+
+    def _mark_dead(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.dead = True
+        if rep.client is not None:
+            rep.client.close()
+            rep.client = None
+
+    def _live(self) -> List[_Replica]:
+        with self._lock:
+            return [r for r in self.replicas
+                    if not r.dead and r.client is not None]
+
+    def live_count(self) -> int:
+        return len(self._live())
+
+    def routes(self) -> List[str]:
+        """Live replica socket paths — high-volume drivers connect
+        directly; the fleet hands out routes instead of proxying bytes."""
+        return [r.path for r in self._live()]
+
+    def require_live(self) -> None:
+        if not self._live():
+            raise FleetDown("no live replicas")
+
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                model: Optional[str] = None) -> Dict[str, Any]:
+        """Route one request to the least-loaded live replica.  A socket
+        failure marks the replica dead and retries ONCE on a survivor;
+        with no survivors the caller gets a structured ``fleet_down`` dict
+        — never an unhandled socket error, never a wedge."""
+        for _attempt in range(2):
+            live = self._live()
+            if not live:
+                break
+            rep = min(live, key=lambda r: (r.outstanding, r.index))
+            rep.outstanding += 1
+            try:
+                return rep.client.predict(
+                    x, deadline_ms=deadline_ms, model=model
+                )
+            except FrontError:
+                self._mark_dead(rep)
+                continue  # one retry on a survivor
+            finally:
+                rep.outstanding -= 1
+        return {
+            "ok": False, "code": "fleet_down",
+            "error": "no live replicas", "model": model or "default",
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        """The shared load-shedding view: per-replica front stats (queue
+        depth, shed totals, compile-cache size, tenants) plus the live
+        set.  Polling failures mark replicas dead — the router and the
+        stats view agree on liveness."""
+        per: Dict[str, Any] = {}
+        for rep in self.replicas:
+            if rep.dead or rep.client is None:
+                per[str(rep.index)] = {"dead": True}
+                continue
+            try:
+                per[str(rep.index)] = rep.client.stats()
+            except FrontError:
+                self._mark_dead(rep)
+                per[str(rep.index)] = {"dead": True}
+        return {
+            "replicas": per,
+            "live": self.live_count(),
+            "total": len(self.replicas),
+        }
+
+
+# ---------------------------------------------------------------------------
+# worker entry (one replica process)
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(argv: List[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="keystone-fleet-worker")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--builder", required=True)
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--shapes", default=None)
+    ap.add_argument("--coalesce-ms", type=float, default=None)
+    ap.add_argument("--slo-ms", type=float, default=None)
+    ap.add_argument("--queue-depth", type=int, default=None)
+    ap.add_argument("--hbm-mb", type=float, default=None)
+    args = ap.parse_args(argv)
+    if not args.worker:
+        print("fleet.py is a worker entry: pass --worker (parents build "
+              "Fleet objects)", file=sys.stderr)
+        return 2
+
+    # A serving replica is one dispatch-worker thread against a herd of
+    # per-connection reader/writer threads that all wake when a batch
+    # responds; at the 5 ms default GIL switch interval each wakeup
+    # preempts the worker for a full slice between ITS dispatch steps.
+    # 0.5 ms keeps handoffs short — a replica process owns its
+    # interpreter, so this is process policy, not library policy.
+    sys.setswitchinterval(0.0005)
+
+    from keystone_tpu.serve.builders import build
+    from keystone_tpu.serve.front import BatchingFront
+    from keystone_tpu.serve.pool import ModelPool
+
+    specs = build(args.builder)
+    kwargs: Dict[str, Any] = {}
+    if args.shapes is not None:
+        kwargs["shapes"] = tuple(
+            int(s) for s in args.shapes.split(",") if s.strip()
+        )
+    if args.coalesce_ms is not None:
+        kwargs["coalesce_ms"] = args.coalesce_ms
+    if args.slo_ms is not None:
+        kwargs["slo_ms"] = args.slo_ms
+    if args.queue_depth is not None:
+        kwargs["queue_depth"] = args.queue_depth
+    if args.hbm_mb is not None:
+        kwargs["hbm_mb"] = args.hbm_mb
+    first, rest = specs[0], specs[1:]
+    gw = ModelPool(
+        first.pipe, first.item_spec, name=first.name, **kwargs
+    )
+    for spec in rest:
+        gw.add_model(
+            spec.name, spec.pipe, spec.item_spec,
+            slo_ms=spec.slo_ms, priority=spec.priority,
+        )
+    front = BatchingFront(gw, path=args.socket)
+    print(f"READY {args.socket}", flush=True)
+    try:
+        sys.stdin.read()  # block until the parent closes our stdin
+    except KeyboardInterrupt:
+        pass
+    front.close()
+    gw.close(drain=False)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    sys.exit(_worker_main(sys.argv[1:]))

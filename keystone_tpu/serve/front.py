@@ -1,0 +1,428 @@
+"""Cross-process request batching front: many client PROCESSES, one
+micro-batch ladder.
+
+One gateway is one process, so until this module every client of the
+compiled ladder lived in the server's interpreter and per-request dispatch
+overhead dominated the saturation knee (BENCH_r08: ~188 QPS, host-bound).
+:class:`BatchingFront` listens on an AF_UNIX socket and funnels each
+connection's requests into ONE gateway's queue, where the existing
+coalesce window batches them ACROSS connections — N single-request client
+processes turn into padded micro-batches on the ladder, exactly the
+dispatch amortization the in-process path already had.
+
+Wire protocol (local IPC only — a unix socket owned by the serving user;
+pickle is acceptable in that trust domain, documented here on purpose):
+4-byte big-endian length prefix + pickled dict.  Requests:
+``{"op": "predict", "id": n, "x": ndarray, "deadline_ms": f|None,
+"model": str|None}`` or ``{"op": "stats", "id": n}``.  Responses mirror
+:class:`~keystone_tpu.serve.gateway.ServeResponse` as a plain dict (values
+as numpy) so CLIENTS NEED NO JAX — this module imports only
+stdlib + numpy at the top level, and ``scripts/front_client.py`` loads it
+standalone for the bench's closed-loop driver subprocesses.
+
+Per connection the front runs a reader thread (decode -> ``gateway.
+submit`` — admission happens on the reader, so sheds/rejections cost no
+worker time) and a writer thread (resolve pending futures in FIFO order,
+encode, write back).  The no-wedge contract is inherited: every submitted
+request terminates in a structured response, so the writer never blocks
+forever.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["BatchingFront", "FrontClient", "FrontError", "drive_main"]
+
+_LEN = struct.Struct(">I")
+_MAX_MSG = 64 << 20  # 64 MiB: a corrupt length prefix must not OOM us
+
+
+class FrontError(ConnectionError):
+    """Socket-level failure talking to a front (server died, bad frame)."""
+
+
+def _send_msg(sock: socket.socket, obj: Any, lock=None) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    frame = _LEN.pack(len(payload)) + payload
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise FrontError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_MSG:
+        raise FrontError(f"frame length {n} exceeds {_MAX_MSG}")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def default_socket_path(tag: str = "front") -> str:
+    return os.path.join(
+        tempfile.gettempdir(), f"keystone-{tag}-{os.getpid()}.sock"
+    )
+
+
+class BatchingFront:
+    """Serve a gateway (or :class:`~keystone_tpu.serve.pool.ModelPool`)
+    over an AF_UNIX socket (module docstring).  ``path`` is created fresh
+    (a stale socket file is unlinked); :meth:`close` unlinks it again."""
+
+    def __init__(self, gateway, path: Optional[str] = None,
+                 result_timeout_s: float = 30.0):
+        self.gateway = gateway
+        self.path = path or default_socket_path()
+        self._result_timeout_s = float(result_timeout_s)
+        self._closing = False
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(self.path)
+        self._srv.listen(64)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="keystone-front-accept",
+            daemon=True,
+        )
+        self._accept_thread.start()
+
+    # -- server loops ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return  # closed
+            with self._lock:
+                self._conns.append(conn)
+            # per-connection FIFO of (req_id, PendingResponse): the reader
+            # feeds it, the writer drains it — responses go back in request
+            # order, so the sync client's next frame is always its own
+            fifo: List[Tuple[int, Any]] = []
+            cond = threading.Condition()
+            threading.Thread(
+                target=self._reader, args=(conn, fifo, cond),
+                name="keystone-front-reader", daemon=True,
+            ).start()
+            threading.Thread(
+                target=self._writer, args=(conn, fifo, cond),
+                name="keystone-front-writer", daemon=True,
+            ).start()
+
+    def _reader(self, conn: socket.socket, fifo, cond) -> None:
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg.get("op")
+                if op == "predict":
+                    pending = self.gateway.submit(
+                        msg["x"], deadline_ms=msg.get("deadline_ms"),
+                        model=msg.get("model"),
+                    )
+                    with cond:
+                        fifo.append((msg.get("id"), pending))
+                        cond.notify()
+                elif op == "stats":
+                    with cond:
+                        fifo.append((msg.get("id"), self._stats()))
+                        cond.notify()
+                else:
+                    with cond:
+                        fifo.append((msg.get("id"), {
+                            "ok": False, "code": "error",
+                            "error": f"unknown op {op!r}",
+                        }))
+                        cond.notify()
+        except (FrontError, OSError, EOFError, pickle.UnpicklingError):
+            pass  # client went away; the writer drains what was admitted
+        finally:
+            with cond:
+                fifo.append((None, None))  # writer stop marker
+                cond.notify()
+
+    def _writer(self, conn: socket.socket, fifo, cond) -> None:
+        try:
+            while True:
+                with cond:
+                    while not fifo:
+                        cond.wait(0.1)
+                    req_id, item = fifo.pop(0)
+                if item is None:
+                    return  # reader ended
+                if isinstance(item, dict):  # stats / error passthrough
+                    payload = dict(item, id=req_id)
+                else:
+                    resp = item.result(self._result_timeout_s)
+                    payload = self._encode(resp, req_id)
+                _send_msg(conn, payload)
+        except (OSError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    @staticmethod
+    def _encode(resp, req_id) -> Dict[str, Any]:
+        value = resp.value
+        if value is not None:
+            # device -> host on the FRONT thread, never the dispatch worker
+            value = np.asarray(value)
+        return {
+            "id": req_id, "ok": resp.ok, "code": resp.code, "value": value,
+            "error": resp.error, "kind": resp.kind, "stage": resp.stage,
+            "retry_after_s": resp.retry_after_s,
+            "latency_ms": resp.latency_ms, "model": resp.model,
+        }
+
+    def _stats(self) -> Dict[str, Any]:
+        gw = self.gateway
+        models = {
+            name: {
+                "shape": list(st.item_spec.shape),
+                "dtype": np.dtype(st.item_spec.dtype).name,
+            }
+            for name, st in gw._nodes_spec.items()
+        }
+        out = {
+            "id": None, "ok": True, "code": "stats",
+            "stats": gw.stats(),
+            "models": models,
+            "est_one_ms": {
+                name: gw._estimate_ms(name, 1)
+                for name in gw._nodes_spec
+            },
+            "compile_cache_size": gw.compile_cache_size(),
+            "pid": os.getpid(),
+        }
+        return out
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class FrontClient:
+    """Synchronous, jax-free client of a :class:`BatchingFront` socket:
+    one outstanding request per connection (cross-process batching comes
+    from MANY client processes, each sync — the open-loop shape real
+    single-request traffic has).  Thread-safe via an internal lock."""
+
+    def __init__(self, path: str, timeout_s: float = 30.0):
+        self.path = path
+        self._timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(self._timeout_s)
+        try:
+            self._sock.connect(path)
+        except OSError as e:
+            raise FrontError(f"cannot connect to {path}: {e}") from e
+
+    def _call(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            self._next_id += 1
+            msg["id"] = self._next_id
+            try:
+                _send_msg(self._sock, msg)
+                while True:
+                    resp = _recv_msg(self._sock)
+                    if resp.get("id") == msg["id"]:
+                        return resp
+            except (OSError, EOFError, pickle.UnpicklingError) as e:
+                raise FrontError(
+                    f"front at {self.path} unreachable: "
+                    f"{type(e).__name__}: {e}"
+                ) from e
+
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                model: Optional[str] = None) -> Dict[str, Any]:
+        """One request -> the structured response dict (``ok``/``code``/
+        ``value``/...).  Raises :class:`FrontError` only for SOCKET
+        failures; sheds and rejections come back as structured dicts."""
+        return self._call({
+            "op": "predict", "x": np.asarray(x),
+            "deadline_ms": deadline_ms, "model": model,
+        })
+
+    def stats(self) -> Dict[str, Any]:
+        return self._call({"op": "stats"})
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# closed-loop driver (the bench fleet regime's client subprocess)
+# ---------------------------------------------------------------------------
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * len(sorted_vals)))]
+
+
+def drive_main(argv: List[str]) -> int:
+    """Closed-loop load driver: connect to a front socket, discover the
+    model's item shape from the stats op, then keep ``--window``
+    outstanding requests pipelined on the one connection for
+    ``--seconds`` and print ONE JSON line of client-side results (ok/shed
+    counts, wall, qps, p50/p99 end-to-end ms).  ``--window 1`` is the
+    strict sync request/response loop; a larger window is how a real
+    multi-request client process offers concurrency WITHOUT a process per
+    in-flight request — the server-side coalesce then batches the window
+    across client processes.  No jax — ``scripts/front_client.py`` runs
+    this in a plain numpy process."""
+    import argparse
+    import heapq
+    import json
+
+    ap = argparse.ArgumentParser(prog="front_client")
+    ap.add_argument("--drive", required=True, help="front socket path")
+    ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--window", type=int, default=1,
+                    help="outstanding requests kept in flight")
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    client = FrontClient(args.drive)
+    info = client.stats()
+    models = info.get("models", {})
+    model = args.model or next(iter(models))
+    spec = models[model]
+    rng = np.random.default_rng(
+        args.seed if args.seed is not None else os.getpid()
+    )
+    item = rng.standard_normal(spec["shape"]).astype(spec["dtype"])
+
+    sock = client._sock
+    sent: Dict[int, float] = {}  # id -> send time
+    next_id = [0]
+
+    def send_one() -> None:
+        next_id[0] += 1
+        _send_msg(sock, {
+            "op": "predict", "id": next_id[0], "x": item,
+            "deadline_ms": args.deadline_ms, "model": model,
+        })
+        sent[next_id[0]] = time.perf_counter()
+
+    n_ok = n_shed = n_other = 0
+    lats: List[float] = []
+    paused: List[float] = []  # due times of shed slots (a heap)
+    t0 = time.perf_counter()
+    err: Optional[str] = None
+    try:
+        for _ in range(max(1, args.window)):
+            send_one()
+        while time.perf_counter() - t0 < args.seconds:
+            # resume shed slots whose retry-after elapsed; if EVERY slot
+            # is paused there is nothing to recv, so sleep to the next due
+            now = time.perf_counter()
+            while paused and paused[0] <= now:
+                heapq.heappop(paused)
+                send_one()
+            if not sent:
+                if paused:
+                    time.sleep(min(max(paused[0] - now, 0.0), 0.05))
+                    continue
+                send_one()
+            resp = _recv_msg(sock)
+            t1 = sent.pop(resp.get("id"), None)
+            dt_ms = ((time.perf_counter() - t1) * 1e3
+                     if t1 is not None else 0.0)
+            if resp.get("ok"):
+                n_ok += 1
+                lats.append(dt_ms)
+                send_one()
+            elif resp.get("code") == "shed":
+                # honor retry_after_s (capped): a slot that resent
+                # immediately would feed the overload that shed it —
+                # the sync loop's backoff, pipelined form
+                n_shed += 1
+                ra = float(resp.get("retry_after_s") or 0.01)
+                heapq.heappush(
+                    paused, time.perf_counter() + min(ra, 0.05)
+                )
+            else:
+                n_other += 1
+                send_one()
+        while sent:  # drain the tail; past the window, not counted
+            resp = _recv_msg(sock)
+            sent.pop(resp.get("id"), None)
+    except (FrontError, OSError, EOFError, pickle.UnpicklingError) as e:
+        err = str(e)  # server died mid-drive: report what we measured
+    wall = time.perf_counter() - t0
+    lats.sort()
+    print(json.dumps({
+        "n_ok": n_ok, "n_shed": n_shed, "n_other": n_other,
+        "wall_s": round(wall, 3),
+        "qps": round(n_ok / wall, 2) if wall > 0 else 0.0,
+        "p50_ms": _percentile(lats, 0.50),
+        "p99_ms": _percentile(lats, 0.99),
+        "model": model,
+        "error": err,
+    }), flush=True)
+    client.close()
+    return 0 if err is None else 3
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    import sys
+
+    sys.exit(drive_main(sys.argv[1:]))

@@ -525,6 +525,9 @@ class Gateway:
                 )
             state.probe_inflight = True
             return None
+        resp = self._tenant_gate(state, model, now)
+        if resp is not None:
+            return resp
         depth = len(self._queue)
         over_depth = depth >= self.queue_depth
         over_slo = self._p99_ms > self.slo_ms and depth >= 1
@@ -540,6 +543,15 @@ class Gateway:
                        else f"p99 {self._p99_ms:.1f}ms over SLO"),
                 retry_after_s=round(retry_after, 3), model=model,
             )
+        return None
+
+    def _tenant_gate(self, state: _ModelState, model: str,
+                     now: float) -> Optional[ServeResponse]:
+        """Per-tenant admission hook (under the lock, after the breaker,
+        before the global depth/SLO shed).  The base gateway has no
+        per-tenant policy — :class:`keystone_tpu.serve.pool.ModelPool`
+        overrides this with the HBM-envelope rejection and the fair-share
+        / per-tenant-SLO sheds.  None admits."""
         return None
 
     def predict(self, x, deadline_ms: Optional[float] = None,
@@ -678,7 +690,17 @@ class Gateway:
         if not keep:
             return
         node = self._fetch_model(model)
-        xs = jnp.stack([jnp.asarray(r.x) for r in keep])
+        # HOST-side batch assembly (numpy), one C-level call: every
+        # python-level jax dispatch here is a GIL preemption point, and
+        # after a batch response the thundering herd of woken waiters
+        # (in-process callers or the front's writer threads) preempted
+        # the worker between each of its many small stack/slice/pad
+        # dispatches — measured ~45 QPS at p50 44 ms for a 6-row
+        # coalesced batch whose actual device program runs in 0.2 ms.
+        # numpy stack + pad keep the assembly two C calls; the one
+        # jnp.asarray per chunk below is the single transfer, which also
+        # makes _jit_apply_batch's donated input buffer genuinely fresh.
+        xs = np.stack([np.asarray(r.x) for r in keep])
         self._active_model = model
 
         def attempt():
@@ -689,13 +711,17 @@ class Gateway:
             spec = faults.check("serve.dispatch")
             b = xs
             if spec is not None:
-                b = faults.poison(b, spec.kind)
+                b = np.asarray(faults.poison(b, spec.kind))
             outs, i = [], 0
             while i < b.shape[0]:
                 n = self._pick_shape(b.shape[0] - i)
                 rows = b[i : i + n]  # python slicing clamps at the tail
-                chunk = _pad_rows(rows, n) if rows.shape[0] < n else rows
-                outs.append(_jit_apply_batch(node, chunk))
+                if rows.shape[0] < n:
+                    chunk = np.zeros((n,) + rows.shape[1:], rows.dtype)
+                    chunk[: rows.shape[0]] = rows
+                else:
+                    chunk = rows
+                outs.append(_jit_apply_batch(node, jnp.asarray(chunk)))
                 i += rows.shape[0]
             out = jax.tree_util.tree_map(
                 lambda *ls: jnp.concatenate(ls, axis=0)[: xs.shape[0]],

@@ -1,0 +1,373 @@
+"""Multi-tenant model pool: N fitted chains behind ONE gateway process.
+
+The PR-14 :class:`~keystone_tpu.serve.gateway.Gateway` already hosts
+multiple models in the PR-1 tiered cache, but its admission policy is
+global: one hot tenant can fill the queue (starving everyone else) and
+nothing bounds how much HBM the registered ladders may claim.  The pool
+makes both into DECLARED policy, the same stance "Memory Safe Computations
+with XLA Compiler" (PAPERS.md) takes for the solver: obligations are
+computed up front, never discovered as OOM mid-flight.
+
+1. **HBM-envelope admission** (``KEYSTONE_SERVE_HBM_MB`` / ``hbm_mb=``).
+   :func:`ladder_peak_bytes` is the serving analogue of
+   ``plan.block_solve_peak_bytes``: a closed-form bound over the model's
+   resident leaves plus the widest stage boundary (operand + result) of the
+   compiled ladder's LARGEST rung.  A model whose ladder provably overflows
+   the declared envelope is registered cold — never warmed, every request
+   rejected pre-dispatch with a structured ``rejected``/``kind='hbm'``
+   response.  The overflow is a gate decision, not an OOM-retry outcome.
+
+2. **LRU/priority eviction** over the PR-1 cache tiers.  Before each
+   dispatch the worker checks the device-resident tenants' summed peak
+   bytes against the envelope; the coldest (least-recently-requested),
+   lowest-priority tenants are demoted HBM -> host
+   (:meth:`IntermediateCache.demote`) until the hot model's ladder fits.
+   A later request promotes a demoted model back — tier mechanics
+   unchanged, the pool only chooses VICTIMS deliberately instead of
+   sweeping the whole device tier.
+
+3. **Per-tenant SLOs and fair shedding** (``KEYSTONE_SERVE_FAIR_FRAC``).
+   Each tenant gets its own latency window/SLO and a fair share of the
+   queue: with more than one tenant registered, a tenant may hold at most
+   ``max(1, int(queue_depth * fair_frac))`` queued slots — a hot tenant
+   saturates its share and sheds (``fair_share`` reason) while a cold
+   tenant's occasional requests still admit.  One tenant cannot starve
+   the rest by arrival rate alone.
+
+Telemetry: ``serve.pool_peak_bytes{model}`` gauges,
+``serve.shed_total{reason=fair_share|tenant_slo}``,
+``serve.rejected{kind=hbm}``, ``serve.model_demotions`` — all per-process
+registry series, queryable via :meth:`ModelPool.tenant_stats`.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from keystone_tpu.serve.gateway import (
+    Gateway,
+    ServeResponse,
+    _ModelState,
+)
+from keystone_tpu.utils.logging import get_logger
+
+logger = get_logger("keystone_tpu.serve.pool")
+
+__all__ = ["ModelPool", "pool", "ladder_peak_bytes"]
+
+
+def _leaf_bytes(tree) -> int:
+    """Summed bytes of every array-shaped leaf (concrete or abstract)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
+def ladder_peak_bytes(node, item_spec, ladder, stages=None) -> int:
+    """Closed-form peak-bytes bound for serving ``node`` through the
+    compiled shape ladder — the serving analogue of
+    ``plan.block_solve_peak_bytes`` (operand + result convention): the
+    model's resident leaves plus, at the ladder's LARGEST rung, the widest
+    consecutive (stage input + stage output) pair.  XLA's buffer assignment
+    reuses everything beyond the live pair, so the bound is conservative
+    but honest — the A5 IR-audit entry (``serve.pool_dispatch``) pins the
+    compiled peak under it.
+
+    ``stages`` (the contract stage graph) refines the bound via the shared
+    ``analysis/contracts.propagate`` pass; without it the whole chain is
+    treated as one stage (input + final output)."""
+    from keystone_tpu.analysis import contracts
+
+    model_bytes = _leaf_bytes(node)
+    n = int(max(ladder))
+    batch = jax.ShapeDtypeStruct(
+        (n,) + tuple(item_spec.shape), np.dtype(item_spec.dtype)
+    )
+    boundary = 0
+    if stages:
+        try:
+            records = contracts.propagate(stages, batch)
+            boundary = max(
+                _leaf_bytes(r.in_aval) + _leaf_bytes(r.out_aval)
+                for r in records
+            )
+        except Exception as e:  # propagate refusal -> whole-chain fallback
+            logger.warning(
+                "ladder_peak_bytes: contract propagation failed (%s: %s); "
+                "falling back to eval_shape", type(e).__name__, e,
+            )
+    if boundary == 0:
+        out = jax.eval_shape(lambda x: node.apply_batch(x), batch)
+        boundary = _leaf_bytes(batch) + _leaf_bytes(out)
+    return model_bytes + boundary
+
+
+@dataclass
+class _Tenant:
+    """Per-tenant accounting the pool layers over ``_ModelState``."""
+
+    slo_ms: float
+    priority: int = 0
+    peak_bytes: int = 0
+    over_envelope: bool = False
+    last_used: float = 0.0
+    served: int = 0
+    shed: int = 0
+    rejected: int = 0
+    responses: int = 0
+    p99_ms: float = 0.0
+    done: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=256)
+    )
+
+
+#: shed-flavored terminal codes (per-tenant shed_frac accounting); contract
+#: rejections are counted separately — a malformed request is not overload.
+_SHED_CODES = ("shed", "deadline", "breaker_open")
+
+
+class ModelPool(Gateway):
+    """A :class:`Gateway` with declared multi-tenant policy (module
+    docstring): HBM-envelope admission, LRU/priority eviction over the
+    cache tiers, per-tenant SLOs and fair-share shedding.  Build via
+    :func:`pool`; register tenants with :meth:`add_model` (now accepting
+    per-tenant ``slo_ms`` / ``priority``)."""
+
+    def __init__(self, pipe, item_spec=None, *,
+                 hbm_mb: Optional[float] = None,
+                 fair_frac: Optional[float] = None,
+                 **kwargs):
+        from keystone_tpu.utils import knobs
+
+        mb = float(hbm_mb if hbm_mb is not None
+                   else knobs.get("KEYSTONE_SERVE_HBM_MB"))
+        #: declared HBM envelope in bytes; 0 = unbounded (gateway behavior)
+        self.hbm_bytes = int(mb * (1 << 20))
+        self.fair_frac = float(
+            fair_frac if fair_frac is not None
+            else knobs.get("KEYSTONE_SERVE_FAIR_FRAC")
+        )
+        self._tenants: Dict[str, _Tenant] = {}
+        # Gateway.__init__ registers the first model through our overridden
+        # add_model, so the pool attributes above must already exist.
+        super().__init__(pipe, item_spec, **kwargs)
+
+    # -- registration ------------------------------------------------------
+
+    def add_model(self, name: str, pipe, item_spec=None, warm: bool = True,
+                  *, slo_ms: Optional[float] = None,
+                  priority: int = 0) -> None:
+        """Register a tenant: contract-check + store (the Gateway path),
+        compute its ladder-peak bound, and gate it against the declared
+        HBM envelope.  An over-envelope tenant is NEVER warmed (warming
+        would dispatch exactly the program the envelope says cannot fit);
+        its requests reject pre-dispatch with ``kind='hbm'``."""
+        super().add_model(name, pipe, item_spec, warm=False)
+        state = self._nodes_spec[name]
+        hit, node = self._pool.lookup(self._pool_key(name))
+        assert hit, f"model {name!r} vanished between put and lookup"
+        peak = ladder_peak_bytes(
+            node, state.item_spec, self._full_ladder, stages=state.stages
+        )
+        over = self.hbm_bytes > 0 and peak > self.hbm_bytes
+        with self._cond:
+            self._tenants[name] = _Tenant(
+                slo_ms=float(slo_ms if slo_ms is not None else self.slo_ms),
+                priority=int(priority), peak_bytes=peak, over_envelope=over,
+            )
+        reg = self._registry()
+        reg.set_gauge("serve.pool_peak_bytes", float(peak), model=name)
+        if over:
+            logger.warning(
+                "model %s ladder peak %d B exceeds the declared HBM "
+                "envelope %d B: registered cold, requests will reject "
+                "pre-dispatch (kind='hbm')", name, peak, self.hbm_bytes,
+            )
+        elif warm:
+            self._warmup(name, node, state.item_spec)
+
+    # -- admission ---------------------------------------------------------
+
+    def _tenant_gate(self, state: _ModelState, model: str,
+                     now: float) -> Optional[ServeResponse]:
+        ts = self._tenants.get(model)
+        if ts is None:
+            return None
+        reg = self._registry()
+        ts.last_used = now
+        if ts.over_envelope:
+            reg.inc("serve.rejected", kind="hbm")
+            return ServeResponse(
+                ok=False, code="rejected", kind="hbm",
+                error=(
+                    f"ladder peak {ts.peak_bytes} B exceeds the declared "
+                    f"HBM envelope {self.hbm_bytes} B "
+                    "(KEYSTONE_SERVE_HBM_MB) — rejected pre-dispatch"
+                ),
+                model=model,
+            )
+        if len(self._tenants) > 1 and self.fair_frac > 0:
+            cap = max(1, int(self.queue_depth * self.fair_frac))
+            queued = sum(1 for r in self._queue if r.model == model)
+            if queued >= cap:
+                reg.inc("serve.shed_total", reason="fair_share")
+                return ServeResponse(
+                    ok=False, code="shed",
+                    error=f"tenant queue share full ({queued}/{cap})",
+                    retry_after_s=round(max(
+                        cap * max(self._p50_ms, 1.0) / 1e3,
+                        ts.slo_ms / 1e3,
+                    ), 3),
+                    model=model,
+                )
+        if ts.p99_ms > ts.slo_ms and any(
+            r.model == model for r in self._queue
+        ):
+            reg.inc("serve.shed_total", reason="tenant_slo")
+            return ServeResponse(
+                ok=False, code="shed",
+                error=(f"tenant p99 {ts.p99_ms:.1f}ms over its "
+                       f"{ts.slo_ms:.1f}ms SLO"),
+                retry_after_s=round(ts.slo_ms / 1e3, 3), model=model,
+            )
+        return None
+
+    # -- eviction ----------------------------------------------------------
+
+    def _fetch_model(self, name: str):
+        if self.hbm_bytes > 0:
+            self._evict_for(name)
+        return super()._fetch_model(name)
+
+    def _evict_for(self, hot: str) -> int:
+        """LRU/priority eviction: demote cold tenants' device-tier entries
+        until the device-resident peak-bytes sum (hot model included) fits
+        the declared envelope.  Victim order: lowest priority first, then
+        least-recently-requested."""
+        with self._cond:
+            hot_ts = self._tenants.get(hot)
+            total = hot_ts.peak_bytes if hot_ts is not None else 0
+            resident: List[Tuple[int, float, str, int]] = []
+            for name, ts in self._tenants.items():
+                if name == hot:
+                    continue
+                if self._pool.tier_of(self._pool_key(name)) == "device":
+                    resident.append(
+                        (ts.priority, ts.last_used, name, ts.peak_bytes)
+                    )
+            total += sum(p for _, _, _, p in resident)
+            if total <= self.hbm_bytes:
+                return 0
+            resident.sort()
+            demoted = 0
+            for _, _, name, peak in resident:
+                if total <= self.hbm_bytes:
+                    break
+                if self._pool.demote(self._pool_key(name)):
+                    total -= peak
+                    demoted += 1
+        if demoted:
+            self._registry().inc("serve.model_demotions", demoted)
+            logger.info(
+                "HBM envelope pressure: demoted %d cold tenant(s) for %s",
+                demoted, hot,
+            )
+        return demoted
+
+    # -- per-tenant accounting --------------------------------------------
+
+    def _note_outcome(self, model: str, resp: ServeResponse) -> None:
+        ts = self._tenants.get(model)
+        if ts is None:
+            return
+        ts.responses += 1
+        if resp.ok:
+            ts.served += 1
+            ts.done.append((time.monotonic(), resp.latency_ms))
+            if ts.served % 8 == 0:
+                self._refresh_tenant(ts)
+        elif resp.code in _SHED_CODES:
+            ts.shed += 1
+        elif resp.code == "rejected":
+            ts.rejected += 1
+
+    @staticmethod
+    def _refresh_tenant(ts: _Tenant) -> None:
+        now = time.monotonic()
+        window = sorted(l for t, l in ts.done if now - t <= 5.0)
+        if window:
+            ts.p99_ms = window[min(len(window) - 1,
+                                   int(0.99 * len(window)))]
+
+    def _respond(self, req, resp: ServeResponse) -> None:
+        super()._respond(req, resp)
+        self._note_outcome(req.model, resp)
+
+    def _finish(self, pending):
+        pending = super()._finish(pending)
+        resp = pending._response
+        if resp is not None:
+            # submit-path terminals (gate sheds / rejections) never reach
+            # _respond; ok responses never come through here
+            self._note_outcome(resp.model, resp)
+        return pending
+
+    def tenant_stats(self, model: Optional[str] = None) -> dict:
+        """Per-tenant accounting (one tenant, or all keyed by name):
+        served/shed/rejected counts, shed fraction, the tenant's own
+        p99/SLO, its declared ladder-peak bytes and envelope verdict, and
+        its current cache tier."""
+        with self._cond:
+            if model is None:
+                names = list(self._tenants)
+            else:
+                names = [model]
+            out = {}
+            for name in names:
+                ts = self._tenants[name]
+                self._refresh_tenant(ts)
+                out[name] = {
+                    "served": ts.served,
+                    "shed": ts.shed,
+                    "rejected": ts.rejected,
+                    "responses": ts.responses,
+                    "shed_frac": round(
+                        ts.shed / max(ts.responses, 1), 4
+                    ),
+                    "p99_ms": round(ts.p99_ms, 3),
+                    "slo_ms": ts.slo_ms,
+                    "priority": ts.priority,
+                    "peak_bytes": ts.peak_bytes,
+                    "over_envelope": ts.over_envelope,
+                    "tier": self._pool.tier_of(self._pool_key(name)),
+                }
+            return out[model] if model is not None else out
+
+    def stats(self) -> dict:
+        s = super().stats()
+        s["hbm_envelope_bytes"] = self.hbm_bytes
+        s["fair_frac"] = self.fair_frac
+        s["tenants"] = self.tenant_stats()
+        return s
+
+
+def pool(pipe, item_spec=None, **kwargs) -> ModelPool:
+    """Build a :class:`ModelPool` over a fitted pipeline.  Accepts every
+    :func:`keystone_tpu.serve.serve` keyword plus ``hbm_mb`` /
+    ``KEYSTONE_SERVE_HBM_MB`` (declared HBM envelope, 0 = unbounded) and
+    ``fair_frac`` / ``KEYSTONE_SERVE_FAIR_FRAC`` (per-tenant queue share
+    with >1 tenant registered, 0 disables).  Register further tenants with
+    :meth:`ModelPool.add_model`, which gains per-tenant ``slo_ms`` and
+    ``priority``."""
+    return ModelPool(pipe, item_spec, **kwargs)

@@ -508,6 +508,34 @@ declare("KEYSTONE_SERVE_BREAKER", "int", 3,
         "form) quarantine the model — requests fail fast with a "
         "'breaker_open' response until a half-open probe re-certifies it. "
         "0 disables the breaker.", validator=_non_negative)
+declare("KEYSTONE_SERVE_HBM_MB", "float", 0.0,
+        "Declared HBM envelope of the multi-tenant model pool in MiB "
+        "(serve/pool.py): a model whose ladder_peak_bytes bound provably "
+        "overflows it is registered cold and its requests are rejected "
+        "pre-dispatch (kind='hbm'), and device-resident tenants beyond "
+        "the envelope are demoted coldest/lowest-priority first before "
+        "each dispatch. 0 = unbounded (plain gateway behavior).",
+        validator=_non_negative)
+
+
+def _unit_fraction(v):
+    if not 0.0 <= v <= 1.0:
+        raise ValueError(f"must be a fraction in [0, 1], got {v}")
+    return v
+
+
+declare("KEYSTONE_SERVE_FAIR_FRAC", "float", 0.5,
+        "Per-tenant fair share of the pool's queue depth (serve/pool.py): "
+        "with more than one tenant registered, a tenant may hold at most "
+        "max(1, int(queue_depth * frac)) queued slots — beyond that its "
+        "arrivals shed (reason='fair_share') while other tenants still "
+        "admit, so one hot tenant cannot starve the rest. 0 disables "
+        "fair-share shedding.", validator=_unit_fraction)
+declare("KEYSTONE_SERVE_REPLICAS", "int", 3,
+        "Default replica count of a serving Fleet (serve/fleet.py): N "
+        "gateway worker processes behind one admission surface, each a "
+        "ModelPool served over a unix-socket BatchingFront.",
+        validator=_positive)
 
 # ---------------------------------------------------------------------------
 # BENCH_* declarations (bench.py / scripts/bench_regime.py sections)
@@ -528,6 +556,12 @@ declare("BENCH_SERVE", "bool", True,
 declare("BENCH_SERVE_LATENCY", "bool", True,
         "Per-item serve() latency section (p50/p95 + device-only ms on "
         "the fitted MNIST/newsgroups/VOC pipelines).")
+declare("BENCH_FLEET", "bool", True,
+        "Fleet serving regime (subprocess; scripts/bench_regime.py fleet): "
+        "aggregate-QPS scaling of 3 replicated gateways vs 1 at pinned "
+        "p99 (fleet_qps_scale + per-replica honesty keys, zero steady-"
+        "state recompiles) and the batched-front vs unbatched N-client "
+        "coalescing comparison.")
 declare("BENCH_MOMENTS", "bool", True,
         "Pallas moments-kernel section.")
 declare("BENCH_STAGES", "bool", True,

@@ -1,0 +1,127 @@
+"""Fleet-serving smoke (<20 s, CPU): the `make fleet-smoke` rung of
+`verify-fast`.
+
+Pins, through REAL replica worker processes (``keystone_tpu/serve/
+fleet.py`` spawning ``ModelPool`` + ``BatchingFront`` per replica over
+the deterministic ``two_tenant`` builder):
+
+1. Every fleet prediction MATCHES a locally built deterministic twin of
+   the same builder — the coalesced cross-process batch path returns
+   bit-for-bit what the single-request apply produces, for BOTH tenants.
+2. A concurrent multi-tenant burst (two threads per tenant) is served
+   with ZERO steady-state recompiles across every replica (the warmed
+   shape-ladder contract, summed over the fleet).
+3. Both tenants' requests land (per-tenant served counts over the
+   fleet's shared stats view), and the routed load reaches both
+   replicas' sockets.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("KEYSTONE_FAULTS", None)
+
+t_start = time.monotonic()
+
+BUDGET_S = 20.0
+
+
+def _ccs(fleet) -> int:
+    return sum(
+        r.get("compile_cache_size", 0)
+        for r in fleet.stats()["replicas"].values()
+        if not r.get("dead")
+    )
+
+
+def main() -> int:
+    import numpy as np
+
+    from keystone_tpu.serve.builders import two_tenant
+    from keystone_tpu.serve.fleet import Fleet
+
+    # the deterministic local twin: same builder, same seeds, no fleet
+    twins = {s.name: s for s in two_tenant()}
+    items = {
+        name: np.linspace(-1.0, 1.0, int(s.item_spec.shape[0]),
+                          dtype=np.float32)
+        for name, s in twins.items()
+    }
+    want = {
+        name: np.asarray(twins[name].pipe.serve(items[name]))
+        for name in twins
+    }
+
+    with Fleet("two_tenant", replicas=2, shapes="1,4",
+               coalesce_ms=0.0, queue_depth=32, slo_ms=10_000.0) as f:
+        assert f.live_count() == 2, f.stats()
+
+        # 1: parity vs the local twin, each tenant, single requests
+        for name in twins:
+            r = f.predict(items[name], model=name, deadline_ms=10_000)
+            assert r["ok"] is True, r
+            np.testing.assert_allclose(
+                np.asarray(r["value"]), want[name], rtol=1e-6, atol=1e-6
+            )
+        print("fleet-smoke 1/3: fleet predictions match the local "
+              "deterministic twin for both tenants")
+
+        # 2: concurrent burst -> coalesced batches, zero recompiles
+        ccs0 = _ccs(f)
+        results: list = []
+        lock = threading.Lock()
+
+        def worker(name):
+            for _ in range(8):
+                r = f.predict(items[name], model=name, deadline_ms=10_000)
+                with lock:
+                    results.append((name, r))
+
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in twins for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(results) == 8 * len(threads), len(results)
+        for name, r in results:
+            assert r["ok"] is True, (name, r)
+            np.testing.assert_allclose(
+                np.asarray(r["value"]), want[name], rtol=1e-6, atol=1e-6
+            )
+        recompiles = _ccs(f) - ccs0
+        assert recompiles == 0, f"{recompiles} steady-state recompiles"
+        print(f"fleet-smoke 2/3: {len(results)} coalesced responses "
+              "match the single-request path, zero steady-state "
+              "recompiles across the fleet")
+
+        # 3: both tenants served, on live shared stats
+        s = f.stats()
+        served = {name: 0 for name in twins}
+        for rep in s["replicas"].values():
+            for name, ts in rep.get("stats", {}).get("tenants", {}).items():
+                served[name] += ts["served"]
+        assert all(v > 0 for v in served.values()), served
+        assert s["live"] == 2, s
+        print(f"fleet-smoke 3/3: both tenants served across the fleet "
+              f"({served}), 2/2 replicas live")
+
+    dt = time.monotonic() - t_start
+    print(f"fleet-smoke PASS in {dt:.1f}s")
+    if dt > BUDGET_S:
+        print(f"fleet-smoke OVER BUDGET ({dt:.1f}s > {BUDGET_S}s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

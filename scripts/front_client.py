@@ -1,0 +1,33 @@
+"""Jax-free closed-loop client driver for a serving front socket.
+
+The fleet bench regime (``scripts/bench_regime.py fleet``) spawns N of
+these per replica to generate genuinely cross-PROCESS single-request
+traffic.  ``keystone_tpu/serve/front.py`` is loaded standalone (by file
+path, not through the package) so the driver never imports jax — client
+processes start in ~0.2 s and cost numpy, not a backend.
+
+Usage: ``python scripts/front_client.py --drive /path/to.sock
+[--seconds 2] [--model name] [--deadline-ms F] [--seed N]`` — prints ONE
+JSON line of client-side results (see ``front.drive_main``).
+"""
+
+import importlib.util
+import os
+import sys
+
+_FRONT_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "keystone_tpu", "serve", "front.py",
+)
+
+
+def _load_front():
+    spec = importlib.util.spec_from_file_location("_keystone_front",
+                                                  _FRONT_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    sys.exit(_load_front().drive_main(sys.argv[1:]))

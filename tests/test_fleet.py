@@ -1,0 +1,242 @@
+"""Fleet serving tier (keystone_tpu/serve/{pool,front,fleet}.py): the
+multi-tenant pool's declared policies (HBM-envelope admission, LRU/priority
+eviction over the cache tiers, per-tenant fair shedding), the socket
+front's cross-process coalescing parity, and the replicated fleet's chaos
+contract (kill one replica under load -> traffic rebalances, no wedge).
+
+The pool tests run against UNSTARTED gateways where the policy under test
+is a submit-path gate (deterministic: no worker races), and against
+started ones only where dispatch itself is the subject (eviction).  The
+chaos test spawns real replica worker processes and rides the existing
+``KEYSTONE_FAULTS`` serve.dispatch site — the same plan grammar every
+other fault drill uses.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import keystone_tpu._compat  # noqa: F401
+from keystone_tpu.core.pipeline import Transformer, chain
+from keystone_tpu.serve import BatchingFront, Fleet, FrontClient, pool
+from keystone_tpu.serve.pool import ladder_peak_bytes
+from keystone_tpu.telemetry import get_registry
+
+
+class Doubler(Transformer):
+    def apply(self, x):
+        return x * 2
+
+
+D = 4
+
+
+def _spec(d=D):
+    return jax.ShapeDtypeStruct((d,), np.float32)
+
+
+def _item(i=0.0, d=D):
+    return np.arange(d, dtype=np.float32) + np.float32(i)
+
+
+# ---------------------------------------------------------------------------
+# ladder_peak_bytes (the A5 bound the admission gate enforces)
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_peak_bytes_counts_model_and_widest_rung():
+    node = chain(Doubler())
+    small = ladder_peak_bytes(node, _spec(), (1,))
+    big = ladder_peak_bytes(node, _spec(), (1, 64))
+    # elementwise chain: boundary = rung * (in + out) item bytes
+    assert small >= 2 * D * 4
+    assert big >= 64 * 2 * D * 4
+    assert big > small  # monotone in the largest rung
+
+
+# ---------------------------------------------------------------------------
+# HBM-envelope admission (overflow rejects PRE-dispatch, never OOM-retry)
+# ---------------------------------------------------------------------------
+
+
+def test_over_envelope_tenant_rejects_pre_dispatch():
+    reg = get_registry()
+    before = reg.get_counter("serve.rejected", kind="hbm")
+    # 16-byte envelope: no ladder fits; the model must register cold
+    p = pool(chain(Doubler()), item_spec=_spec(),
+             hbm_mb=16 / (1 << 20), warm=False, start=False)
+    try:
+        ts = p.tenant_stats("default")
+        assert ts["over_envelope"] is True
+        assert ts["peak_bytes"] > p.hbm_bytes
+        r = p.submit(_item()).result(1)
+        # the declared-envelope gate decision: a structured rejection at
+        # the gate, not a shed and NOT an OOM dug out of a dispatch retry
+        assert r.ok is False
+        assert r.code == "rejected"
+        assert r.kind == "hbm"
+        assert "envelope" in (r.error or "")
+        assert reg.get_counter("serve.rejected", kind="hbm") == before + 1
+        assert p.tenant_stats("default")["rejected"] == 1
+    finally:
+        p.close(drain=False)
+
+
+def test_envelope_zero_is_unbounded():
+    p = pool(chain(Doubler()), item_spec=_spec(), hbm_mb=0.0,
+             warm=False, start=False)
+    try:
+        assert p.tenant_stats("default")["over_envelope"] is False
+    finally:
+        p.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant fair shedding (asymmetric load cannot starve the cold tenant)
+# ---------------------------------------------------------------------------
+
+
+def test_fair_share_sheds_hot_tenant_not_cold():
+    p = pool(chain(Doubler()), item_spec=_spec(), name="hot",
+             queue_depth=8, fair_frac=0.25, warm=False, start=False)
+    try:
+        p.add_model("cold", chain(Doubler()), _spec())
+        cap = max(1, int(p.queue_depth * p.fair_frac))  # = 2
+        pend = [p.submit(_item(i), model="hot") for i in range(6)]
+        # first `cap` admit; the rest shed at the tenant gate
+        assert sum(1 for q in pend if not q.done()) == cap
+        sheds = [q.result(0.1) for q in pend if q.done()]
+        assert all(r.code == "shed" for r in sheds)
+        assert all("share" in (r.error or "") for r in sheds)
+        assert all((r.retry_after_s or 0) > 0 for r in sheds)
+        # the cold tenant's request still admits through its own share
+        q = p.submit(_item(), model="cold")
+        assert not q.done()
+        stats = p.tenant_stats()
+        assert stats["hot"]["shed"] == 6 - cap
+        assert stats["hot"]["shed_frac"] > 0
+        assert stats["cold"]["shed"] == 0
+        assert stats["cold"]["shed_frac"] == 0.0
+    finally:
+        p.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# LRU/priority eviction over the cache tiers (declared, not a sweep)
+# ---------------------------------------------------------------------------
+
+
+def test_envelope_pressure_demotes_lru_tenant():
+    reg = get_registry()
+    before = reg.get_counter("serve.model_demotions")
+    node = chain(Doubler())
+    peak = ladder_peak_bytes(node, _spec(), (1, 2))
+    # envelope fits ONE tenant's ladder, not two
+    p = pool(node, item_spec=_spec(), name="a", shapes=(1, 2),
+             hbm_mb=1.5 * peak / (1 << 20), coalesce_ms=0.0)
+    try:
+        p.add_model("b", chain(Doubler()), _spec())
+        assert p.predict(_item(), model="a", deadline_ms=5000) is not None
+        assert p.predict(_item(), model="b", deadline_ms=5000) is not None
+        stats = p.tenant_stats()
+        # dispatching "b" had to demote "a" (the LRU victim) to host
+        assert stats["b"]["tier"] == "device"
+        assert stats["a"]["tier"] == "host"
+        assert reg.get_counter("serve.model_demotions") > before
+        # a later request PROMOTES "a" back — tier mechanics unchanged
+        assert p.predict(_item(), model="a", deadline_ms=5000) is not None
+        assert p.tenant_stats("a")["tier"] == "device"
+    finally:
+        p.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# socket front: cross-process parity + cross-connection coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_front_parity_and_cross_connection_coalescing(tmp_path):
+    reg = get_registry()
+    pipe = chain(Doubler())
+    g = pool(pipe, item_spec=_spec(), shapes=(1, 4), coalesce_ms=0.0,
+             start=False)
+    front = BatchingFront(g, path=str(tmp_path / "front.sock"))
+    try:
+        results = {}
+
+        def one(i):
+            c = FrontClient(front.path)
+            try:
+                results[i] = c.predict(_item(float(i)))
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        while len(g._queue) < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)  # let every connection's request enqueue
+        d0 = reg.counter_family_total("serve.dispatch_total")
+        g.start()
+        for t in threads:
+            t.join(10)
+        d1 = reg.counter_family_total("serve.dispatch_total")
+        assert len(results) == 4
+        for i, r in results.items():
+            assert r["ok"] is True
+            np.testing.assert_allclose(
+                np.asarray(r["value"]),
+                np.asarray(pipe.serve(_item(float(i)))),
+            )
+        # 4 requests from 4 CONNECTIONS coalesced into one padded rung
+        assert d1 - d0 == 1
+    finally:
+        front.close()
+        g.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL one replica under load -> rebalance, no wedge
+# ---------------------------------------------------------------------------
+
+
+def test_kill_one_replica_rebalances_no_wedge():
+    x = np.zeros(64, np.float32)
+    # replica 0 carries a fault plan on the EXISTING serve.dispatch site:
+    # its 3rd dispatch SIGKILLs the process mid-flight
+    with Fleet("cosine", replicas=2, shapes="1,2", coalesce_ms=0.0,
+               faults={0: "serve.dispatch@2:kill"}) as f:
+        assert f.live_count() == 2
+        outcomes = []
+        for _ in range(12):
+            r = f.predict(x, deadline_ms=5000)
+            outcomes.append(r)
+            assert isinstance(r, dict)  # structured, never a raw error
+            if f.live_count() == 1:
+                break
+        deadline = time.monotonic() + 10.0
+        while f.live_count() == 2 and time.monotonic() < deadline:
+            f.predict(x, deadline_ms=5000)
+        assert f.live_count() == 1  # the kill landed and was detected
+        # traffic rebalances onto the survivor: served, not wedged
+        for _ in range(3):
+            r = f.predict(x, deadline_ms=5000)
+            assert r["ok"] is True
+        s = f.stats()
+        assert s["live"] == 1
+        assert s["replicas"]["0"] == {"dead": True}
+        tenants = s["replicas"]["1"]["stats"]["tenants"]
+        assert tenants["default"]["served"] > 0
+        # no survivors left -> structured fleet_down, still no wedge
+        f.kill(1)
+        r = f.predict(x)
+        assert r["ok"] is False
+        assert r["code"] == "fleet_down"
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -165,11 +165,17 @@ def test_single_item_equals_batch_row():
         np.random.default_rng(3).normal(size=(D, 8)), np.float32
     )
     mat = Transformer.from_fn(lambda x: x @ jnp.asarray(w))
-    g = serve(chain(mat), item_spec=_spec())
+    # SLO effectively off: this test pins PARITY, not shedding — in a
+    # contended suite process the cold first dispatch can push the p99
+    # window over the default SLO and legitimately shed the burst
+    # (same rationale as test_zero_recompile_steady_state below).
+    g = serve(chain(mat), item_spec=_spec(), slo_ms=10_000.0)
     try:
         single = np.asarray(g.predict(_item(1.0)))
         pend = [g.submit(_item(i)) for i in [0.0, 1.0, 2.0]]
-        rows = [np.asarray(p.result(10).value) for p in pend]
+        rs = [p.result(10) for p in pend]
+        assert all(r.ok for r in rs), [r.code for r in rs]
+        rows = [np.asarray(r.value) for r in rs]
         np.testing.assert_allclose(rows[1], single, rtol=1e-6)
     finally:
         g.close(drain=False)
