@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke kernel-search-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke fleet-smoke ingest-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke kernel-search-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke fleet-smoke ingest-smoke obs-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -78,6 +78,16 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/fleet_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/ingest_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/obs_smoke.py
+
+# Fleet-observability contract (<20 s): 2 replica workers + driver each
+# write a pid+role-unique telemetry shard, merged counter totals exactly
+# equal the per-shard sums, a client-minted trace id rides the unix-socket
+# frame and stitches into ONE Perfetto trace spanning >= 2 OS processes
+# with flow arrows, and the `keystone-tpu obs` CLI renders the dir with
+# rc=0 (scripts/obs_smoke.py).
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/obs_smoke.py
 
 # Streaming-ingest contract (<20 s): overlap-on <= overlap-off on a
 # calibrated progressive-JPEG tar set, the ring bounds live decoded

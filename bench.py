@@ -2285,6 +2285,14 @@ _COMPACT_KEYS = (
     ("fleet_x", "fleet_qps_scale"),
     ("fleet_q1", "fleet_qps_1"),
     ("fleet_coal", "fleet_coalesce_gain"),
+    # fleet observability plane (telemetry shards merged across replica
+    # processes): server-side shed fraction / breaker trips / p99 from
+    # the merged serve.latency_ms histograms; telemetry_merge_procs is
+    # the honesty key (how many process shards the merge saw)
+    ("fleet_shed", "fleet_shed_frac"),
+    ("fleet_brk", "fleet_breaker_trips"),
+    ("fleet_p99", "fleet_p99_ms"),
+    ("obs_procs", "telemetry_merge_procs"),
     # per-item serve latency (tunneled p50 + device-only component)
     ("sv_mnist", "mnist_serve_p50_ms"),
     ("sv_mnist_dev", "mnist_serve_device_ms"),

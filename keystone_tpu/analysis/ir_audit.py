@@ -144,6 +144,7 @@ INTENDED_PRECISION: Dict[str, Tuple[str, str]] = {
     "pallas.conv_pool_split": ("f32", "f32"),
     "dag.fused_segment": ("f32", "f32"),
     "serve.dispatch": ("f32", "f32"),
+    "serve.dispatch_traced": ("f32", "f32"),
     "serve.pool_dispatch": ("f32", "f32"),
     # the bf16 storage tier's audited programs (KEYSTONE_PRECISION_TIER)
     "overlap.tiled_gram_bf16": ("bf16", "f32"),
@@ -821,6 +822,40 @@ def _build_serve_dispatch(devices) -> Built:
         fn=lambda x: _serve_apply(node, x), args=(xs,), k=1,
         expect=dict(),
     )
+
+
+@register("serve.dispatch_traced", "serve")
+def _build_serve_dispatch_traced(devices) -> Built:
+    """``serve.dispatch`` with request tracing ACTIVE: the same
+    ``_serve_apply`` program lowered under an active trace id + recording
+    span (``telemetry.trace``).  Trace ids are host metadata only — the
+    span context manager runs at trace time on the host, so the lowered
+    module must be free of host callbacks (A2) exactly like the untraced
+    entry; any drift here means tracing leaked into the jitted program
+    and the zero-overhead-when-off pin is broken."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.pipeline import chain
+    from keystone_tpu.ops.stats import CosineRandomFeatures, LinearRectifier
+    from keystone_tpu.serve.gateway import _serve_apply
+    from keystone_tpu.telemetry.spans import use_tracing
+    from keystone_tpu.telemetry.trace import mint, request_span, use_trace
+
+    keys = jax.random.split(jax.random.key(17), 2)
+    node = chain(
+        CosineRandomFeatures.create(12, 16, 0.1, keys[0]),
+        LinearRectifier(max_val=0.0),
+    )
+    xs = jnp.asarray(_f32(_rng(), 8, 12))
+    tid = mint()
+
+    def traced(x):
+        with use_tracing(True), use_trace(tid):
+            with request_span("serve.rung", tid, n=8):
+                return _serve_apply(node, x)
+
+    return Built(fn=traced, args=(xs,), k=1, expect=dict())
 
 
 @register("serve.pool_dispatch", "serve")

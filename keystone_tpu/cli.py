@@ -120,6 +120,16 @@ def main(argv=None) -> int:
         from keystone_tpu.telemetry.report import main as report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "obs":
+        # ``keystone-tpu obs [dir]``: merge the per-process telemetry
+        # shards a fleet exported under KEYSTONE_TELEMETRY_DIR into one
+        # fleet-wide view (exact counter sums, proc-labeled gauges,
+        # unioned histograms, SLO signals) — text/json/prometheus, plus
+        # ``--traces`` for the stitched multi-process Perfetto file.
+        # No jax import needed.
+        from keystone_tpu.telemetry.fleet import obs_main
+
+        return obs_main(argv[1:])
     if argv and argv[0] == "lint":
         # ``keystone-tpu lint [paths]``: the static-analysis pass
         # (keystone_tpu/analysis) — exits non-zero only for findings not
@@ -163,6 +173,8 @@ def main(argv=None) -> int:
             "--process-id I | --distributed] [--mesh-model M] "
             f"<Pipeline> [flags]\n"
             "       run-pipeline telemetry-report [path] [--top N]\n"
+            "       run-pipeline obs [dir] [--format text|json|prometheus]"
+            " [--traces OUT.json]\n"
             "       run-pipeline lint [paths] [--update-baseline]\n"
             "       run-pipeline audit [--target ENTRY] [--list] "
             "[--update-baseline]\n"

@@ -108,11 +108,17 @@ def prefetch_map(
 
     def produce_one() -> None:
         nonlocal prev_raw
+        from keystone_tpu.telemetry.trace import request_span
+
         item = raw.popleft()
-        try:
-            results.append(("ok", fn(item)))
-        except BaseException as exc:  # re-raised at this item's yield
-            results.append(("err", exc))
+        # joins the thread's active trace (telemetry.trace.use_trace) when
+        # one is set; a null span otherwise — the ingest pipeline's spans
+        # then stitch into the same fleet-wide Perfetto view as serving
+        with request_span("prefetch.produce", None):
+            try:
+                results.append(("ok", fn(item)))
+            except BaseException as exc:  # re-raised at this item's yield
+                results.append(("err", exc))
         prev_raw = item
 
     while True:

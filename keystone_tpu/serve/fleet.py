@@ -129,6 +129,9 @@ class Fleet:
         # replicas see the real device set
         env.pop("XLA_FLAGS", None)
         env.update(self._extra_env)
+        # pid+role-unique telemetry shard names: each replica exports as
+        # replica-<i> unless the caller tagged the fleet itself
+        env.setdefault("KEYSTONE_TELEMETRY_ROLE", f"replica-{index}")
         plan = self._faults.get(index)
         if plan is not None:
             env["KEYSTONE_FAULTS"] = plan
@@ -232,11 +235,14 @@ class Fleet:
             raise FleetDown("no live replicas")
 
     def predict(self, x, deadline_ms: Optional[float] = None,
-                model: Optional[str] = None) -> Dict[str, Any]:
+                model: Optional[str] = None,
+                trace_id: Optional[str] = None) -> Dict[str, Any]:
         """Route one request to the least-loaded live replica.  A socket
         failure marks the replica dead and retries ONCE on a survivor;
         with no survivors the caller gets a structured ``fleet_down`` dict
-        — never an unhandled socket error, never a wedge."""
+        — never an unhandled socket error, never a wedge.  ``trace_id``
+        rides the front frame so the replica's spans join the caller's
+        distributed trace."""
         for _attempt in range(2):
             live = self._live()
             if not live:
@@ -245,7 +251,8 @@ class Fleet:
             rep.outstanding += 1
             try:
                 return rep.client.predict(
-                    x, deadline_ms=deadline_ms, model=model
+                    x, deadline_ms=deadline_ms, model=model,
+                    trace_id=trace_id,
                 )
             except FrontError:
                 self._mark_dead(rep)

@@ -14,11 +14,18 @@ Wire protocol (local IPC only — a unix socket owned by the serving user;
 pickle is acceptable in that trust domain, documented here on purpose):
 4-byte big-endian length prefix + pickled dict.  Requests:
 ``{"op": "predict", "id": n, "x": ndarray, "deadline_ms": f|None,
-"model": str|None}`` or ``{"op": "stats", "id": n}``.  Responses mirror
+"model": str|None, "trace": str|None}`` or ``{"op": "stats", "id": n}``.
+The optional ``trace`` field carries a request-scoped trace id (see
+``keystone_tpu.telemetry.trace``) across the process boundary: the
+server's reader thread hands it to ``gateway.submit``, and the response
+dict echoes it back as ``trace`` — so a client-minted id stitches front
+enqueue, gateway admission, dispatch and reply spans from BOTH processes
+into one Perfetto trace.  Responses mirror
 :class:`~keystone_tpu.serve.gateway.ServeResponse` as a plain dict (values
 as numpy) so CLIENTS NEED NO JAX — this module imports only
 stdlib + numpy at the top level, and ``scripts/front_client.py`` loads it
-standalone for the bench's closed-loop driver subprocesses.
+standalone for the bench's closed-loop driver subprocesses (telemetry
+spans are imported lazily and only server-side).
 
 Per connection the front runs a reader thread (decode -> ``gateway.
 submit`` — admission happens on the reader, so sheds/rejections cost no
@@ -41,10 +48,44 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["BatchingFront", "FrontClient", "FrontError", "drive_main"]
+__all__ = [
+    "BatchingFront", "FrontClient", "FrontError", "drive_main",
+    "mint_trace_id",
+]
 
 _LEN = struct.Struct(">I")
 _MAX_MSG = 64 << 20  # 64 MiB: a corrupt length prefix must not OOM us
+
+
+def mint_trace_id() -> str:
+    """A compact request trace id (16 hex chars) — pure stdlib, so jax-free
+    standalone clients can mint one without importing ``keystone_tpu``.
+    Same format as ``keystone_tpu.telemetry.trace.mint``."""
+    return os.urandom(8).hex()
+
+
+def _request_span(name: str, trace_id, **args):
+    """Server-side span hook: resolves the telemetry tracer lazily so this
+    module stays importable with stdlib+numpy only (standalone clients
+    never enter spans — the server process always has the package)."""
+    if trace_id is None:
+        return _NULL_CM
+    try:
+        from keystone_tpu.telemetry.trace import request_span
+    except ImportError:  # standalone load: no keystone_tpu on the path
+        return _NULL_CM
+    return request_span(name, trace_id, **args)
+
+
+class _NullCM:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CM = _NullCM()
 
 
 class FrontError(ConnectionError):
@@ -140,10 +181,13 @@ class BatchingFront:
                 msg = _recv_msg(conn)
                 op = msg.get("op")
                 if op == "predict":
-                    pending = self.gateway.submit(
-                        msg["x"], deadline_ms=msg.get("deadline_ms"),
-                        model=msg.get("model"),
-                    )
+                    tid = msg.get("trace")
+                    with _request_span("front.enqueue", tid,
+                                       model=msg.get("model") or ""):
+                        pending = self.gateway.submit(
+                            msg["x"], deadline_ms=msg.get("deadline_ms"),
+                            model=msg.get("model"), trace_id=tid,
+                        )
                     with cond:
                         fifo.append((msg.get("id"), pending))
                         cond.notify()
@@ -202,6 +246,7 @@ class BatchingFront:
             "error": resp.error, "kind": resp.kind, "stage": resp.stage,
             "retry_after_s": resp.retry_after_s,
             "latency_ms": resp.latency_ms, "model": resp.model,
+            "trace": getattr(resp, "trace_id", None),
         }
 
     def _stats(self) -> Dict[str, Any]:
@@ -284,13 +329,18 @@ class FrontClient:
                 ) from e
 
     def predict(self, x, deadline_ms: Optional[float] = None,
-                model: Optional[str] = None) -> Dict[str, Any]:
+                model: Optional[str] = None,
+                trace_id: Optional[str] = None) -> Dict[str, Any]:
         """One request -> the structured response dict (``ok``/``code``/
         ``value``/...).  Raises :class:`FrontError` only for SOCKET
-        failures; sheds and rejections come back as structured dicts."""
+        failures; sheds and rejections come back as structured dicts.
+        Pass ``trace_id`` (e.g. :func:`mint_trace_id`) to stitch the
+        server-side spans for THIS request into a distributed trace; it
+        is echoed back in the response's ``trace`` field."""
         return self._call({
             "op": "predict", "x": np.asarray(x),
             "deadline_ms": deadline_ms, "model": model,
+            "trace": trace_id,
         })
 
     def stats(self) -> Dict[str, Any]:

@@ -67,6 +67,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from keystone_tpu.telemetry.registry import LATENCY_BUCKETS_MS
+from keystone_tpu.telemetry.trace import maybe_mint, request_span
 from keystone_tpu.utils.logging import get_logger
 
 logger = get_logger("keystone_tpu.serve")
@@ -156,6 +158,7 @@ class ServeResponse:
     retry_after_s: Optional[float] = None
     latency_ms: Optional[float] = None
     model: str = "default"
+    trace_id: Optional[str] = None  # request-scoped trace id (when sampled)
 
 
 class ServeRejected(RuntimeError):
@@ -213,6 +216,7 @@ class _Request:
     t_submit: float
     deadline_t: Optional[float]  # absolute monotonic deadline, None = none
     probe: bool = False
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -422,66 +426,79 @@ class Gateway:
     # -- submission --------------------------------------------------------
 
     def submit(self, x, deadline_ms: Optional[float] = None,
-               model: Optional[str] = None) -> PendingResponse:
+               model: Optional[str] = None,
+               trace_id: Optional[str] = None) -> PendingResponse:
         """Admit one item. Returns a :class:`PendingResponse` that ALWAYS
         terminates in a structured :class:`ServeResponse` — rejected /
         shed / breaker responses resolve immediately, admitted requests
-        resolve when the worker serves (or sheds) them."""
+        resolve when the worker serves (or sheds) them.
+
+        ``trace_id`` joins this request to an existing distributed trace
+        (e.g. minted at a :class:`~keystone_tpu.serve.front.FrontClient`);
+        when None the admission edge mints one itself iff
+        ``KEYSTONE_TRACE_SAMPLE`` selects the request.  Trace ids are pure
+        host metadata — they never reach a jitted program."""
         from keystone_tpu.utils import faults
 
         reg = self._registry()
         model = model or self.default_model
         reg.inc("serve.requests", model=model)
+        tid = trace_id if trace_id is not None else maybe_mint()
         try:
-            # chaos site 1: gateway-internal admission failure — the
-            # request still gets a structured response, never a hang
-            faults.check("serve.admit")
-            if not hasattr(x, "shape"):
-                x = np.asarray(x)
-            state = self._nodes_spec.get(model)
-            if state is None:
-                return self._finish(_resolved(ServeResponse(
-                    ok=False, code="rejected", kind="model",
-                    error=f"unknown model {model!r}", model=model,
-                )))
-            reject = self._admit_issue(x, state)
-            if reject is not None:
-                reg.inc("serve.rejected", kind=reject.kind)
-                return self._finish(_resolved(
-                    _with_model(reject, model)
-                ))
-            now = time.monotonic()
-            with self._cond:
-                resp = self._gate_locked(state, model, now)
-                if resp is None:
-                    req = _Request(
-                        x=x, model=model, pending=PendingResponse(),
-                        t_submit=now,
-                        deadline_t=(now + deadline_ms / 1e3
-                                    if deadline_ms is not None else None),
-                        probe=(state.breaker == "half_open"
-                               and state.probe_inflight),
-                    )
-                    self._queue.append(req)
-                    reg.set_gauge("serve.queue_depth", len(self._queue))
-                    self._cond.notify_all()
-            if resp is not None:
-                if resp.code == "shed" and self._demote_armed:
-                    # queue pressure: cold models are not being asked
-                    # for — demote them toward host so the hot model's
-                    # dispatches get the HBM. OUTSIDE the condition (the
-                    # device->host copies would stall every submit and
-                    # the worker); disarmed once a sweep finds no
-                    # victims, re-armed when a lookup can re-promote.
-                    self._demote_armed = self._demote_cold(model) > 0
-                return self._finish(_resolved(resp))
-            return req.pending
+            with request_span("serve.admit", tid, model=model):
+                # chaos site 1: gateway-internal admission failure — the
+                # request still gets a structured response, never a hang
+                faults.check("serve.admit")
+                if not hasattr(x, "shape"):
+                    x = np.asarray(x)
+                state = self._nodes_spec.get(model)
+                if state is None:
+                    return self._finish(_resolved(ServeResponse(
+                        ok=False, code="rejected", kind="model",
+                        error=f"unknown model {model!r}", model=model,
+                        trace_id=tid,
+                    )))
+                reject = self._admit_issue(x, state)
+                if reject is not None:
+                    reg.inc("serve.rejected", kind=reject.kind)
+                    return self._finish(_resolved(
+                        _with_model(reject, model, trace_id=tid)
+                    ))
+                now = time.monotonic()
+                with self._cond:
+                    resp = self._gate_locked(state, model, now)
+                    if resp is None:
+                        req = _Request(
+                            x=x, model=model, pending=PendingResponse(),
+                            t_submit=now,
+                            deadline_t=(now + deadline_ms / 1e3
+                                        if deadline_ms is not None else None),
+                            probe=(state.breaker == "half_open"
+                                   and state.probe_inflight),
+                            trace_id=tid,
+                        )
+                        self._queue.append(req)
+                        reg.set_gauge("serve.queue_depth", len(self._queue))
+                        self._cond.notify_all()
+                if resp is not None:
+                    if resp.code == "shed" and self._demote_armed:
+                        # queue pressure: cold models are not being asked
+                        # for — demote them toward host so the hot model's
+                        # dispatches get the HBM. OUTSIDE the condition (the
+                        # device->host copies would stall every submit and
+                        # the worker); disarmed once a sweep finds no
+                        # victims, re-armed when a lookup can re-promote.
+                        self._demote_armed = self._demote_cold(model) > 0
+                    return self._finish(_resolved(
+                        _with_model(resp, model, trace_id=tid)
+                    ))
+                return req.pending
         except Exception as e:  # injected admit faults and gateway bugs
             logger.warning("admission failed: %s: %s", type(e).__name__, e)
             return self._finish(_resolved(ServeResponse(
                 ok=False, code="error",
                 error=f"admission failure: {type(e).__name__}: {e}",
-                model=model,
+                model=model, trace_id=tid,
             )))
 
     def _gate_locked(self, state: _ModelState, model: str,
@@ -689,6 +706,8 @@ class Gateway:
                 keep.append(req)
         if not keep:
             return
+        tids = [r.trace_id for r in keep if r.trace_id is not None]
+        btid = tids[0] if tids else None  # batch span joins the 1st trace
         node = self._fetch_model(model)
         # HOST-side batch assembly (numpy), one C-level call: every
         # python-level jax dispatch here is a GIL preemption point, and
@@ -700,7 +719,9 @@ class Gateway:
         # numpy stack + pad keep the assembly two C calls; the one
         # jnp.asarray per chunk below is the single transfer, which also
         # makes _jit_apply_batch's donated input buffer genuinely fresh.
-        xs = np.stack([np.asarray(r.x) for r in keep])
+        with request_span("serve.coalesce", btid, model=model,
+                          batch=len(keep), traced=len(tids)):
+            xs = np.stack([np.asarray(r.x) for r in keep])
         self._active_model = model
 
         def attempt():
@@ -721,7 +742,8 @@ class Gateway:
                     chunk[: rows.shape[0]] = rows
                 else:
                     chunk = rows
-                outs.append(_jit_apply_batch(node, jnp.asarray(chunk)))
+                with request_span("serve.rung", btid, model=model, n=n):
+                    outs.append(_jit_apply_batch(node, jnp.asarray(chunk)))
                 i += rows.shape[0]
             out = jax.tree_util.tree_map(
                 lambda *ls: jnp.concatenate(ls, axis=0)[: xs.shape[0]],
@@ -733,10 +755,12 @@ class Gateway:
             return jax.block_until_ready((out, flag))
 
         t0 = time.perf_counter()
-        out, flag = call_with_device_retries(
-            attempt, retries=self._retries, backoff_s=self._backoff_s,
-            max_backoff_s=1.0, on_retry=self._on_dispatch_retry,
-        )
+        with request_span("serve.dispatch", btid, model=model,
+                          batch=len(keep)):
+            out, flag = call_with_device_retries(
+                attempt, retries=self._retries, backoff_s=self._backoff_s,
+                max_backoff_s=1.0, on_retry=self._on_dispatch_retry,
+            )
         dt_ms = (time.perf_counter() - t0) * 1e3
         reg.inc("serve.dispatch_total", model=model)
         reg.observe("serve.dispatch_ms", dt_ms)
@@ -901,24 +925,34 @@ class Gateway:
     def _respond(self, req: _Request, resp: ServeResponse) -> None:
         reg = self._registry()
         reg.inc("serve.responses", code=resp.code)
-        if req.probe and resp.code not in ("ok", "sentinel"):
-            # a probe that was shed/errored before its dispatch must free
-            # the half-open slot, or the breaker wedges half-open forever
-            with self._cond:
-                state = self._nodes_spec.get(req.model)
-                if state is not None:
-                    state.probe_inflight = False
-        if resp.ok:
-            now = time.monotonic()
-            self._done.append((now, resp.latency_ms))
-            # recompute the windowed percentiles at most every 16
-            # responses / 0.5 s: a full filter+sort of the 512-entry
-            # window per served request would tax the dispatch worker at
-            # exactly the QPS the gauges are meant to measure
-            self._lat_pending += 1
-            if self._lat_pending >= 16 or now - self._lat_refreshed >= 0.5:
-                self._refresh_latency(now)
-        req.pending._resolve(resp)
+        if req.trace_id is not None and resp.trace_id is None:
+            resp = ServeResponse(
+                **{**resp.__dict__, "trace_id": req.trace_id}
+            )
+        with request_span("serve.reply", req.trace_id,
+                          model=resp.model, code=resp.code):
+            if req.probe and resp.code not in ("ok", "sentinel"):
+                # a probe that was shed/errored before its dispatch must
+                # free the half-open slot, or the breaker wedges
+                # half-open forever
+                with self._cond:
+                    state = self._nodes_spec.get(req.model)
+                    if state is not None:
+                        state.probe_inflight = False
+            if resp.ok:
+                now = time.monotonic()
+                self._done.append((now, resp.latency_ms))
+                reg.observe("serve.latency_ms", resp.latency_ms,
+                            buckets=LATENCY_BUCKETS_MS, model=resp.model)
+                # recompute the windowed percentiles at most every 16
+                # responses / 0.5 s: a full filter+sort of the 512-entry
+                # window per served request would tax the dispatch worker
+                # at exactly the QPS the gauges are meant to measure
+                self._lat_pending += 1
+                if (self._lat_pending >= 16
+                        or now - self._lat_refreshed >= 0.5):
+                    self._refresh_latency(now)
+            req.pending._resolve(resp)
 
     def _refresh_latency(self, now: float) -> None:
         self._lat_pending = 0
@@ -1058,8 +1092,12 @@ def _attribute_stage(stages, item_shape, dtype) -> Tuple[Optional[str], str]:
     return None, ""
 
 
-def _with_model(resp: ServeResponse, model: str) -> ServeResponse:
-    return ServeResponse(**{**resp.__dict__, "model": model})
+def _with_model(resp: ServeResponse, model: str,
+                trace_id: Optional[str] = None) -> ServeResponse:
+    fields = {**resp.__dict__, "model": model}
+    if trace_id is not None and fields.get("trace_id") is None:
+        fields["trace_id"] = trace_id
+    return ServeResponse(**fields)
 
 
 def serve(pipe, item_spec=None, **kwargs) -> Gateway:

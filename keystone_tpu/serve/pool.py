@@ -126,6 +126,7 @@ class _Tenant:
     shed: int = 0
     rejected: int = 0
     responses: int = 0
+    slo_violations: int = 0  # ok-but-late + shed: burned SLO budget
     p99_ms: float = 0.0
     done: collections.deque = field(
         default_factory=lambda: collections.deque(maxlen=256)
@@ -291,14 +292,24 @@ class ModelPool(Gateway):
         ts = self._tenants.get(model)
         if ts is None:
             return
+        reg = self._registry()
         ts.responses += 1
+        reg.inc("serve.tenant_responses", model=model)
         if resp.ok:
             ts.served += 1
+            reg.inc("serve.tenant_served", model=model)
             ts.done.append((time.monotonic(), resp.latency_ms))
+            if resp.latency_ms is not None and resp.latency_ms > ts.slo_ms:
+                # served, but late: the request still burned SLO budget
+                ts.slo_violations += 1
+                reg.inc("serve.tenant_slo_violations", model=model)
             if ts.served % 8 == 0:
                 self._refresh_tenant(ts)
         elif resp.code in _SHED_CODES:
             ts.shed += 1
+            ts.slo_violations += 1
+            reg.inc("serve.tenant_shed", model=model)
+            reg.inc("serve.tenant_slo_violations", model=model)
         elif resp.code == "rejected":
             ts.rejected += 1
 
@@ -344,6 +355,10 @@ class ModelPool(Gateway):
                     "responses": ts.responses,
                     "shed_frac": round(
                         ts.shed / max(ts.responses, 1), 4
+                    ),
+                    "slo_violations": ts.slo_violations,
+                    "slo_violation_frac": round(
+                        ts.slo_violations / max(ts.responses, 1), 4
                     ),
                     "p99_ms": round(ts.p99_ms, 3),
                     "slo_ms": ts.slo_ms,

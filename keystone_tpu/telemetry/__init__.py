@@ -5,12 +5,18 @@
 - ``spans``: opt-in nested stage spans (dispatch-vs-synced wall-clock,
   shapes/bytes, per-jit ``cost_analysis()`` flops) exporting
   Chrome-trace/Perfetto JSON.
+- ``fleet``: the cross-process plane — pid+role-unique crash-atomic shard
+  export, exact-sum merge with stale-shard pruning, stitched multi-process
+  Perfetto traces, and :func:`signals` (the stable planner-facing dict).
+- ``trace``: request-scoped trace ids (``KEYSTONE_TRACE_SAMPLE``) that
+  ride the serve tier's cross-process frames and stitch spans fleet-wide.
 - ``report``: the ``telemetry-report`` CLI renderer.
 
 Knobs: ``KEYSTONE_TELEMETRY=1`` enables span tracing;
-``KEYSTONE_TELEMETRY_DIR=<dir>`` additionally auto-exports the trace +
-metrics there at process exit; ``KEYSTONE_TELEMETRY_COST=0`` disables the
-compile-time flop extraction; ``use_tracing(True)`` scopes tracing in code.
+``KEYSTONE_TELEMETRY_DIR=<dir>`` additionally auto-exports this process's
+metric + trace SHARDS there at exit (merged by ``keystone-tpu obs``);
+``KEYSTONE_TELEMETRY_COST=0`` disables the compile-time flop extraction;
+``use_tracing(True)`` scopes tracing in code.
 """
 
 from keystone_tpu.telemetry.registry import MetricsRegistry, get_registry
@@ -26,18 +32,35 @@ from keystone_tpu.telemetry.spans import (
     tree_shapes,
     use_tracing,
 )
+from keystone_tpu.telemetry.fleet import (
+    export_process,
+    merge_shards,
+    merge_traces,
+    signals,
+)
+from keystone_tpu.telemetry.trace import (
+    current_trace_id,
+    maybe_mint,
+    use_trace,
+)
 from keystone_tpu.telemetry.report import render_live, render_report
 
 __all__ = [
     "MetricsRegistry",
     "SpanTracer",
+    "current_trace_id",
     "export_dir",
+    "export_process",
     "get_registry",
     "get_tracer",
     "jit_cost",
+    "maybe_mint",
+    "merge_shards",
+    "merge_traces",
     "render_live",
     "render_report",
     "reset",
+    "signals",
     "stage_fingerprint",
     "tracing_enabled",
     "tree_nbytes",

@@ -1,0 +1,665 @@
+"""Fleet-wide observability plane: cross-process metric shards, one merged
+view, and the SLO signals the planner consumes.
+
+PR 4's telemetry is process-local; PRs 14-18 grew the system into a
+multi-process fleet (replica gateway workers, a unix-socket BatchingFront,
+ingest subprocesses) where each process keeps its own registry and the old
+``KEYSTONE_TELEMETRY_DIR`` atexit export wrote FIXED filenames — N
+concurrent exits clobbered one file.  This module is the cross-process
+half:
+
+- **Shard export** (:func:`export_process`): each process writes its
+  registry snapshot and Chrome-trace spans to pid+role-unique shard files
+  (``telemetry_shard-<role>-<pid>.json``), crash-atomically (same-dir temp
+  -> fsync -> ``os.replace``, the ``core/checkpoint.py`` pattern) — a
+  process killed mid-export leaves the previous shard or none, never a
+  torn file.  The ``spans.py`` atexit hook routes here whenever
+  ``KEYSTONE_TELEMETRY_DIR`` is set.
+- **Merge** (:func:`merge_shards`): counters SUM exactly across shards,
+  histograms union bucket-wise (count/sum/min/max/buckets), gauges stay
+  per-process under an added ``proc=<role>-<pid>`` label (summing two
+  processes' queue depths or HBM gauges would be a lie).  Stale shards —
+  a DEAD pid older than ``KEYSTONE_TELEMETRY_STALE_S`` — are pruned, not
+  silently summed into the totals; a fresh shard from a dead pid (the
+  normal atexit case: worker exported, then exited) still merges.
+- **Trace stitch** (:func:`merge_traces`): per-process span shards carry
+  an epoch offset (``time.time_ns() - perf_counter_ns`` at export), so
+  their monotonic-clock events rebase onto one shared timeline; events
+  sharing a ``trace_id`` arg gain Chrome flow arrows (``ph: s/t/f``) —
+  ONE Perfetto file showing a request hop processes.
+- **Signals** (:func:`signals`): the stable dict the planner's ``profile``
+  mode and the future refresh loop consume — serve shed fraction, breaker
+  trips, demotions, merged p50/p99 latency quantiles, per-tenant SLO burn
+  (``slo_violation_frac``), per-process device-memory gauges ("Memory
+  Safe Computations with XLA": verify bounds against MEASURED state).
+
+Rendered by ``keystone-tpu obs`` (text / ``--format json|prometheus``);
+no jax import required on the merge/render path — the CLI runs anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from keystone_tpu.telemetry.registry import (
+    _series_key,
+    _split_series_key,
+    get_registry,
+    render_prometheus,
+)
+from keystone_tpu.utils import knobs
+
+__all__ = [
+    "bench_keys",
+    "export_process",
+    "merge_shards",
+    "merge_traces",
+    "obs_main",
+    "process_role",
+    "quantile_from_hist",
+    "record_memory_gauges",
+    "signals",
+]
+
+SHARD_SCHEMA = 1
+_SHARD_PREFIX = "telemetry_shard-"
+_TRACE_PREFIX = "telemetry_trace_shard-"
+
+_ENV_ROLE = "KEYSTONE_TELEMETRY_ROLE"
+_ENV_STALE = "KEYSTONE_TELEMETRY_STALE_S"
+
+
+# ---------------------------------------------------------------------------
+# Shard export (the per-process half)
+# ---------------------------------------------------------------------------
+
+
+def _write_atomic_text(path: str, text: str) -> None:
+    """Crash-atomic text write: same-directory temp file -> flush -> fsync
+    -> ``os.replace`` -> best-effort directory fsync (the
+    ``core/checkpoint._write_atomic`` pattern, without that module's jax
+    import) — a crash leaves the old shard or the new one, never a torn
+    file, and two processes exporting concurrently never interleave."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def process_role() -> str:
+    """This process's shard role: ``KEYSTONE_TELEMETRY_ROLE`` when set
+    (the Fleet parent tags each replica ``replica-<i>``), else ``proc``.
+    Sanitized — the role lands in a filename."""
+    role = str(knobs.get(_ENV_ROLE) or "proc")
+    return "".join(
+        c if (c.isalnum() or c in "-_.") else "_" for c in role
+    ) or "proc"
+
+
+def _shard_paths(dir_path: str, role: str, pid: int) -> Tuple[str, str]:
+    stem = f"{role}-{pid}.json"
+    return (
+        os.path.join(dir_path, _SHARD_PREFIX + stem),
+        os.path.join(dir_path, _TRACE_PREFIX + stem),
+    )
+
+
+def record_memory_gauges(reg=None) -> int:
+    """Per-device ``memory_stats()`` HBM gauges (``device.bytes_in_use`` /
+    ``device.peak_bytes_in_use``, labeled by device) into the registry.
+    Best-effort: CPU backends report None, and a process that never
+    imported jax must not start now — returns the device count gauged."""
+    if "jax" not in sys.modules:
+        return 0
+    reg = reg if reg is not None else get_registry()
+    n = 0
+    try:
+        import jax
+
+        for d in jax.devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if not ms:
+                continue
+            label = f"{d.platform}:{d.id}"
+            for key in ("bytes_in_use", "peak_bytes_in_use"):
+                if key in ms:
+                    reg.set_gauge(f"device.{key}", float(ms[key]),
+                                  device=label)
+            n += 1
+    except Exception:
+        return n
+    return n
+
+
+def export_process(dir_path: str, registry=None, tracer=None) -> Dict[str, str]:
+    """Write THIS process's metric + trace shards under ``dir_path``
+    (pid+role-unique names, crash-atomic).  Returns ``{kind: path}``.
+    This is what the ``KEYSTONE_TELEMETRY_DIR`` atexit hook calls — the
+    fix for the fixed-filename clobber the fleet tier exposed."""
+    from keystone_tpu.telemetry.spans import get_tracer
+
+    reg = registry if registry is not None else get_registry()
+    tr = tracer if tracer is not None else get_tracer()
+    record_memory_gauges(reg)
+    role, pid = process_role(), os.getpid()
+    metrics_path, trace_path = _shard_paths(dir_path, role, pid)
+    shard = {
+        "schema": SHARD_SCHEMA,
+        "pid": pid,
+        "role": role,
+        "host": socket.gethostname(),
+        "argv0": os.path.basename(sys.argv[0] or "python"),
+        "exported_at": time.time(),
+        "metrics": reg.as_dict(),
+    }
+    _write_atomic_text(metrics_path, json.dumps(shard, sort_keys=True))
+    trace_shard = {
+        "schema": SHARD_SCHEMA,
+        "pid": pid,
+        "role": role,
+        "exported_at": shard["exported_at"],
+        # monotonic->epoch bridge: chrome_trace ts are perf_counter µs;
+        # adding this offset puts every process on one shared timeline
+        "epoch_offset_us": (time.time_ns() - time.perf_counter_ns()) / 1e3,
+        "trace": tr.chrome_trace(),
+    }
+    _write_atomic_text(trace_path, json.dumps(trace_shard))
+    return {"metrics": metrics_path, "trace": trace_path}
+
+
+# ---------------------------------------------------------------------------
+# Merge (the fleet half)
+# ---------------------------------------------------------------------------
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError, ValueError, TypeError):
+        return True  # exists but not ours / unknowable: treat as alive
+    return True
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _list_shards(dir_path: str, prefix: str) -> List[str]:
+    try:
+        names = sorted(os.listdir(dir_path))
+    except OSError:
+        return []
+    return [os.path.join(dir_path, n) for n in names
+            if n.startswith(prefix) and n.endswith(".json")]
+
+
+def _is_stale(shard: Optional[dict], now: float, stale_s: float) -> bool:
+    """A shard is stale iff unparseable, or its pid is DEAD and its export
+    is older than the staleness horizon.  A fresh shard from a dead pid —
+    the normal atexit export of a worker that then exited — still merges;
+    yesterday's leftovers from a previous run do not."""
+    if shard is None or "metrics" not in shard and "trace" not in shard:
+        return True
+    age = now - float(shard.get("exported_at") or 0.0)
+    return age > stale_s and not _pid_alive(shard.get("pid", -1))
+
+
+def _merge_hist(into: Dict[str, Any], h: Mapping[str, Any]) -> None:
+    """Bucket-wise histogram union at the exported-dict level (count/sum/
+    min/max/buckets): exact for counts and sums, bounds unioned by key."""
+    into["count"] = into.get("count", 0) + int(h.get("count") or 0)
+    into["sum"] = into.get("sum", 0.0) + float(h.get("sum") or 0.0)
+    for field, pick in (("min", min), ("max", max)):
+        v = h.get(field)
+        if v is not None:
+            cur = into.get(field)
+            into[field] = v if cur is None else pick(cur, v)
+    buckets = into.setdefault("buckets", {})
+    for bound, count in (h.get("buckets") or {}).items():
+        buckets[bound] = buckets.get(bound, 0) + int(count)
+    into["mean"] = (into["sum"] / into["count"]) if into["count"] else None
+
+
+def merge_shards(dir_path: str, prune: bool = True) -> Dict[str, Any]:
+    """Merge every metric shard under ``dir_path`` into one view:
+
+    - ``merged``: an ``as_dict()``-shaped snapshot — counters summed
+      exactly, histograms unioned, gauges kept per-process under an added
+      ``proc=<role>-<pid>`` label;
+    - ``procs``: the per-shard provenance (pid, role, alive, export age);
+    - ``pruned``: stale shard files (dead pid past the
+      ``KEYSTONE_TELEMETRY_STALE_S`` horizon, or unparseable) — deleted
+      when ``prune``, and never summed either way.
+    """
+    now = time.time()
+    stale_s = float(knobs.get(_ENV_STALE))
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    procs: List[Dict[str, Any]] = []
+    pruned: List[str] = []
+    for path in _list_shards(dir_path, _SHARD_PREFIX):
+        shard = _load_json(path)
+        if _is_stale(shard, now, stale_s):
+            pruned.append(os.path.basename(path))
+            if prune:
+                for p in (path,
+                          path.replace(_SHARD_PREFIX, _TRACE_PREFIX, 1)):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            continue
+        pid = shard.get("pid", 0)
+        role = shard.get("role", "proc")
+        proc_label = f"{role}-{pid}"
+        metrics = shard.get("metrics") or {}
+        for key, value in (metrics.get("counters") or {}).items():
+            counters[key] = counters.get(key, 0) + value
+        for key, value in (metrics.get("gauges") or {}).items():
+            name, labels = _split_series_key(key)
+            gauges[_series_key(
+                name, dict(labels, proc=proc_label)
+            )] = value
+        for key, h in (metrics.get("histograms") or {}).items():
+            _merge_hist(hists.setdefault(key, {}), h)
+        procs.append({
+            "pid": pid,
+            "role": role,
+            "host": shard.get("host"),
+            "alive": _pid_alive(pid),
+            "age_s": round(
+                now - float(shard.get("exported_at") or now), 3
+            ),
+            "shard": os.path.basename(path),
+        })
+    return {
+        "schema": SHARD_SCHEMA,
+        "dir": dir_path,
+        "procs": procs,
+        "pruned": pruned,
+        "merged": {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+        },
+    }
+
+
+def quantile_from_hist(h: Mapping[str, Any], q: float) -> Optional[float]:
+    """Quantile estimate from an exported histogram's cumulative bucket
+    counts, linearly interpolated within the target bucket (the standard
+    Prometheus ``histogram_quantile`` scheme).  Clamped to the observed
+    ``min``/``max``; None for an empty histogram."""
+    count = int(h.get("count") or 0)
+    if count <= 0:
+        return None
+    buckets = sorted(
+        ((float("inf") if b == "+Inf" else float(b)), int(c))
+        for b, c in (h.get("buckets") or {}).items()
+    )
+    if not buckets:
+        return h.get("max")
+    target = q * count
+    cum = 0
+    lo = h.get("min") if h.get("min") is not None else 0.0
+    for bound, c in buckets:
+        prev_cum = cum
+        cum += c
+        if cum >= target:
+            if bound == float("inf"):
+                return h.get("max") if h.get("max") is not None else lo
+            if c <= 0:
+                est = bound
+            else:
+                frac = (target - prev_cum) / c
+                est = lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+            hi_clamp = h.get("max")
+            if hi_clamp is not None:
+                est = min(est, hi_clamp)
+            if h.get("min") is not None:
+                est = max(est, h["min"])
+            return est
+        lo = bound
+    return h.get("max")
+
+
+# ---------------------------------------------------------------------------
+# Signals: the stable planner-facing dict
+# ---------------------------------------------------------------------------
+
+
+def _family(counters: Mapping[str, float], name: str) -> float:
+    """Sum of a counter family across its label sets (the
+    ``counter_family_total`` key predicate, snapshot form)."""
+    return sum(
+        v for k, v in counters.items()
+        if k == name or k.startswith(name + "{")
+    )
+
+
+def _family_by_label(series: Mapping[str, Any], name: str,
+                     label: str) -> Dict[str, Any]:
+    """``{label_value: series_value}`` for one family, keyed by one label
+    (e.g. per-``model`` latency histograms)."""
+    out: Dict[str, Any] = {}
+    for key, value in series.items():
+        base, labels = _split_series_key(key)
+        if base != name:
+            continue
+        lv = dict(labels).get(label)
+        if lv is not None:
+            out[lv] = value
+    return out
+
+
+def signals(snapshot: Optional[Mapping[str, Any]] = None) -> Dict[str, Any]:
+    """The STABLE signals dict the planner's ``profile`` mode and the
+    refresh loop consume (schema pinned by ``tests/test_obs.py``).  Works
+    over the local process registry (default) or a fleet-merged snapshot
+    from :func:`merge_shards` — same schema either way, so a planner does
+    not care whether it watches one process or the fleet.
+
+    Top-level keys: ``schema`` / ``scope`` / ``serve`` / ``tenants`` /
+    ``memory`` / ``ingest``.  ``serve.shed_frac`` and per-tenant
+    ``slo_violation_frac`` are burn-rate style fractions of responses.
+    """
+    if snapshot is None:
+        record_memory_gauges()
+        snapshot = get_registry().as_dict()
+        scope = "process"
+    else:
+        scope = "fleet"
+        # accept the full merge_shards() view as well as its bare
+        # ``merged`` metrics dict — callers pass either
+        if "merged" in snapshot and "counters" not in snapshot:
+            snapshot = snapshot["merged"]
+    counters = snapshot.get("counters") or {}
+    gauges = snapshot.get("gauges") or {}
+    hists = snapshot.get("histograms") or {}
+
+    responses = _family(counters, "serve.responses")
+    shed = _family(counters, "serve.shed_total")
+    lat_all: Dict[str, Any] = {}
+    for model, h in _family_by_label(hists, "serve.latency_ms",
+                                     "model").items():
+        _merge_hist(lat_all, h)
+    serve_block = {
+        "requests": _family(counters, "serve.requests"),
+        "responses": responses,
+        "shed_total": shed,
+        "shed_frac": round(shed / responses, 4) if responses else 0.0,
+        "breaker_trips": _family(counters, "serve.breaker{event=open}"),
+        "sentinel_trips": _family(counters, "serve.sentinel_trips"),
+        "demotions": _family(counters, "serve.model_demotions"),
+        "p50_ms": quantile_from_hist(lat_all, 0.50) if lat_all else None,
+        "p99_ms": quantile_from_hist(lat_all, 0.99) if lat_all else None,
+    }
+
+    tenants: Dict[str, Dict[str, Any]] = {}
+    t_resp = _family_by_label(counters, "serve.tenant_responses", "model")
+    t_served = _family_by_label(counters, "serve.tenant_served", "model")
+    t_shed = _family_by_label(counters, "serve.tenant_shed", "model")
+    t_viol = _family_by_label(counters, "serve.tenant_slo_violations",
+                              "model")
+    t_lat = _family_by_label(hists, "serve.latency_ms", "model")
+    for model in sorted(set(t_resp) | set(t_served) | set(t_shed)
+                        | set(t_viol) | set(t_lat)):
+        n_resp = float(t_resp.get(model, 0.0))
+        viol = float(t_viol.get(model, 0.0))
+        h = t_lat.get(model)
+        tenants[model] = {
+            "responses": n_resp,
+            "served": float(t_served.get(model, 0.0)),
+            "shed": float(t_shed.get(model, 0.0)),
+            "slo_violations": viol,
+            "slo_violation_frac": round(viol / n_resp, 4) if n_resp
+            else 0.0,
+            "p50_ms": quantile_from_hist(h, 0.50) if h else None,
+            "p99_ms": quantile_from_hist(h, 0.99) if h else None,
+        }
+
+    memory = {
+        key: value for key, value in sorted(gauges.items())
+        if key.startswith("device.")
+    }
+    ingest_block = {
+        "prefetch_stalls": _family(counters, "prefetch.stall"),
+        "prefetch_ready": _family(counters, "prefetch.ready"),
+        "ingest_batches": _family(counters, "ingest.batches"),
+    }
+    return {
+        "schema": 1,
+        "scope": scope,
+        "serve": serve_block,
+        "tenants": tenants,
+        "memory": memory,
+        "ingest": ingest_block,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Trace stitching
+# ---------------------------------------------------------------------------
+
+
+def merge_traces(dir_path: str, out_path: Optional[str] = None,
+                 prune: bool = True) -> Dict[str, Any]:
+    """Stitch every trace shard under ``dir_path`` into ONE
+    Perfetto-loadable Chrome trace: per-process monotonic timestamps
+    rebase onto a shared epoch timeline (each shard's
+    ``epoch_offset_us``), process-name metadata events label the rows,
+    and events sharing a ``trace_id`` arg gain flow arrows
+    (``ph: s/t/f``) so a request's hops connect visually.  Staleness
+    follows :func:`merge_shards` (same horizon, same pid liveness)."""
+    now = time.time()
+    stale_s = float(knobs.get(_ENV_STALE))
+    events: List[dict] = []
+    meta: List[dict] = []
+    by_trace: Dict[str, List[dict]] = {}
+    for path in _list_shards(dir_path, _TRACE_PREFIX):
+        shard = _load_json(path)
+        if _is_stale(shard, now, stale_s):
+            if prune:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            continue
+        pid = shard.get("pid", 0)
+        role = shard.get("role", "proc")
+        offset_us = float(shard.get("epoch_offset_us") or 0.0)
+        shard_events = (shard.get("trace") or {}).get("traceEvents") or []
+        if shard_events:
+            meta.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"{role} (pid {pid})"},
+            })
+        for ev in shard_events:
+            ev = dict(ev)
+            ev["ts"] = float(ev.get("ts", 0.0)) + offset_us
+            ev["pid"] = pid
+            events.append(ev)
+            tid_arg = (ev.get("args") or {}).get("trace_id")
+            if tid_arg:
+                by_trace.setdefault(str(tid_arg), []).append(ev)
+    events.sort(key=lambda e: e["ts"])
+    t0 = events[0]["ts"] if events else 0.0
+    for ev in events:
+        ev["ts"] = round(ev["ts"] - t0, 3)
+    flows: List[dict] = []
+    for trace_id, evs in sorted(by_trace.items()):
+        if len(evs) < 2:
+            continue  # a flow arrow needs two ends
+        evs.sort(key=lambda e: e["ts"])
+        for i, ev in enumerate(evs):
+            ph = "s" if i == 0 else ("f" if i == len(evs) - 1 else "t")
+            flow = {
+                "name": f"trace:{trace_id}", "cat": "request", "ph": ph,
+                "id": trace_id, "pid": ev["pid"], "tid": ev["tid"],
+                "ts": ev["ts"],
+            }
+            if ph == "f":
+                flow["bp"] = "e"
+            flows.append(flow)
+    merged = {
+        "traceEvents": meta + events + flows,
+        "displayTimeUnit": "ms",
+    }
+    if out_path is not None:
+        _write_atomic_text(out_path, json.dumps(merged))
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# Bench keys + the `keystone-tpu obs` CLI
+# ---------------------------------------------------------------------------
+
+
+def bench_keys(dir_path: str) -> Dict[str, Any]:
+    """The BENCH_FLEET regime's merged-telemetry keys: shed fraction,
+    breaker trips and p99 computed from the MERGED registry shards (not
+    client-side timing), plus the ``telemetry_merge_procs`` honesty key —
+    a p99 claim always ships with how many processes backed it."""
+    view = merge_shards(dir_path, prune=False)
+    merged = view["merged"]
+    sig = signals(merged)
+    return {
+        "fleet_shed_frac": sig["serve"]["shed_frac"],
+        "fleet_breaker_trips": sig["serve"]["breaker_trips"],
+        "fleet_p99_ms": (round(sig["serve"]["p99_ms"], 3)
+                         if sig["serve"]["p99_ms"] is not None else None),
+        "telemetry_merge_procs": len(view["procs"]),
+    }
+
+
+def _render_text(view: Dict[str, Any], sig: Dict[str, Any]) -> str:
+    merged = view["merged"]
+    lines = [f"fleet observability: {view['dir']}"]
+    lines.append(
+        f"processes: {len(view['procs'])} merged, "
+        f"{len(view['pruned'])} stale pruned"
+    )
+    for p in view["procs"]:
+        state = "alive" if p["alive"] else "exited"
+        lines.append(
+            f"  {p['role']:<12} pid={p['pid']:<8} {state:<7} "
+            f"exported {p['age_s']:.1f}s ago"
+        )
+    if merged["counters"]:
+        lines.append("counters (summed across shards):")
+        for key, value in sorted(merged["counters"].items()):
+            v = int(value) if float(value).is_integer() else value
+            lines.append(f"  {key:<52} {v}")
+    if merged["gauges"]:
+        lines.append("gauges (per-process, proc-labeled):")
+        for key, value in sorted(merged["gauges"].items()):
+            lines.append(f"  {key:<52} {value}")
+    if merged["histograms"]:
+        lines.append("histograms (bucket-unioned):")
+        for key, h in sorted(merged["histograms"].items()):
+            p50 = quantile_from_hist(h, 0.50)
+            p99 = quantile_from_hist(h, 0.99)
+            lines.append(
+                f"  {key:<40} n={h.get('count', 0):<7} "
+                f"p50={p50 if p50 is None else round(p50, 3)} "
+                f"p99={p99 if p99 is None else round(p99, 3)} "
+                f"max={h.get('max')}"
+            )
+    s = sig["serve"]
+    lines.append(
+        "signals: "
+        f"shed_frac={s['shed_frac']} breaker_trips={s['breaker_trips']} "
+        f"demotions={s['demotions']} p99_ms="
+        f"{s['p99_ms'] if s['p99_ms'] is None else round(s['p99_ms'], 3)}"
+    )
+    for model, ts in sig["tenants"].items():
+        lines.append(
+            f"  tenant {model}: responses={ts['responses']:.0f} "
+            f"slo_violation_frac={ts['slo_violation_frac']}"
+        )
+    return "\n".join(lines)
+
+
+def obs_main(argv: Optional[List[str]] = None) -> int:
+    """``keystone-tpu obs [dir]``: merge + render the fleet shards.
+    ``--format text|json|prometheus``; ``--traces PATH`` additionally
+    writes the stitched Perfetto trace; ``--keep-stale`` disables the
+    stale-shard prune (inspection of a crashed run's leftovers)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="keystone-tpu obs")
+    ap.add_argument("dir", nargs="?", default=None,
+                    help="telemetry shard dir (default: "
+                         "$KEYSTONE_TELEMETRY_DIR)")
+    ap.add_argument("--format", choices=("text", "json", "prometheus"),
+                    default="text")
+    ap.add_argument("--traces", default=None, metavar="PATH",
+                    help="also write the stitched Perfetto trace here")
+    ap.add_argument("--keep-stale", action="store_true",
+                    help="do not delete stale shards while merging")
+    args = ap.parse_args(argv)
+    dir_path = args.dir or knobs.get("KEYSTONE_TELEMETRY_DIR")
+    if not dir_path:
+        print("obs: no shard dir (pass one or set KEYSTONE_TELEMETRY_DIR)",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(dir_path):
+        print(f"obs: {dir_path} is not a directory", file=sys.stderr)
+        return 2
+    prune = not args.keep_stale
+    view = merge_shards(dir_path, prune=prune)
+    sig = signals(view["merged"])
+    if args.format == "json":
+        print(json.dumps({
+            "procs": view["procs"], "pruned": view["pruned"],
+            "merged": view["merged"], "signals": sig,
+        }, sort_keys=True))
+    elif args.format == "prometheus":
+        sys.stdout.write(render_prometheus(view["merged"]))
+    else:
+        print(_render_text(view, sig))
+    if args.traces is not None:
+        merged = merge_traces(dir_path, out_path=args.traces, prune=prune)
+        n_procs = len({e["pid"] for e in merged["traceEvents"]
+                       if e.get("ph") == "X"})
+        print(f"stitched trace: {args.traces} "
+              f"({len(merged['traceEvents'])} events, "
+              f"{n_procs} process(es))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via CLI tests
+    sys.exit(obs_main(sys.argv[1:]))

@@ -43,6 +43,15 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     float("inf"),
 )
 
+# Millisecond-scale latency buckets (sub-ms interactive serves up through
+# multi-second stragglers): the serve tier's per-tenant latency histograms
+# observe in ms, so the decade DEFAULT_BUCKETS would collapse everything
+# into two buckets and quantile estimates would be useless.
+LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 10000.0, float("inf"),
+)
+
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -124,12 +133,19 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[_series_key(name, labels)] = value
 
-    def observe(self, name: str, value: float, **labels) -> None:
+    def observe(self, name: str, value: float,
+                buckets: Optional[Tuple[float, ...]] = None,
+                **labels) -> None:
+        """Record one histogram observation.  ``buckets`` sets the series'
+        bucket bounds on FIRST observation (later calls keep the series'
+        existing bounds — a series' buckets never reshape mid-run)."""
         key = _series_key(name, labels)
         with self._lock:
             h = self._hists.get(key)
             if h is None:
-                h = self._hists[key] = _Histogram()
+                h = self._hists[key] = _Histogram(
+                    tuple(buckets) if buckets else DEFAULT_BUCKETS
+                )
             h.observe(value)
 
     # -- queries (the no-log-scraping contract for tests) ------------------
@@ -192,77 +208,99 @@ class MetricsRegistry:
 
     def to_jsonl(self) -> str:
         """One JSON object per line per series (stream-appendable)."""
-        d = self.as_dict()
-        lines = []
-        for kind in ("counters", "gauges"):
-            for key, value in sorted(d[kind].items()):
-                name, labels = _split_series_key(key)
-                lines.append(json.dumps({
-                    "type": kind[:-1], "name": name,
-                    "labels": dict(labels), "value": value,
-                }))
-        for key, h in sorted(d["histograms"].items()):
-            name, labels = _split_series_key(key)
-            lines.append(json.dumps({
-                "type": "histogram", "name": name, "labels": dict(labels),
-                **h,
-            }))
-        return "\n".join(lines) + ("\n" if lines else "")
+        return render_jsonl(self.as_dict())
 
     def dump_jsonl(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(self.to_jsonl())
 
     def to_prometheus(self, namespace: str = "keystone") -> str:
-        """Prometheus text exposition format. Dotted metric names sanitize
-        to underscores; histograms export the cumulative ``_bucket`` /
-        ``_sum`` / ``_count`` triplet the format requires."""
-        d = self.as_dict()
-        out = []
+        """Prometheus text exposition format (:func:`render_prometheus`
+        over this registry's snapshot)."""
+        return render_prometheus(self.as_dict(), namespace)
 
-        def prom_name(name: str) -> str:
-            return _PROM_BAD.sub("_", f"{namespace}_{name}")
 
-        def labels_str(labels, extra=()):
-            items = list(labels) + list(extra)
-            if not items:
-                return ""
-            return "{" + ",".join(
-                f'{_PROM_BAD.sub("_", k)}="{v}"' for k, v in items
-            ) + "}"
+# ---------------------------------------------------------------------------
+# Snapshot renderers: shared by per-process exports AND the fleet-merged
+# view (telemetry/fleet.py), which renders a snapshot no live registry
+# backs — one formatter, no drift between the local and merged outputs.
+# ---------------------------------------------------------------------------
 
-        for kind, prom_kind in (("counters", "counter"), ("gauges", "gauge")):
-            seen = set()
-            for key, value in sorted(d[kind].items()):
-                name, labels = _split_series_key(key)
-                p = prom_name(name)
-                if p not in seen:
-                    seen.add(p)
-                    out.append(f"# TYPE {p} {prom_kind}")
-                out.append(f"{p}{labels_str(labels)} {value}")
+
+def render_jsonl(d: Mapping[str, Any]) -> str:
+    """One JSON object per line per series of an ``as_dict()``-shaped
+    snapshot (stream-appendable)."""
+    lines = []
+    for kind in ("counters", "gauges"):
+        for key, value in sorted(d[kind].items()):
+            name, labels = _split_series_key(key)
+            lines.append(json.dumps({
+                "type": kind[:-1], "name": name,
+                "labels": dict(labels), "value": value,
+            }))
+    for key, h in sorted(d["histograms"].items()):
+        name, labels = _split_series_key(key)
+        lines.append(json.dumps({
+            "type": "histogram", "name": name, "labels": dict(labels),
+            **h,
+        }))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_prometheus(d: Mapping[str, Any],
+                      namespace: str = "keystone") -> str:
+    """Prometheus text exposition format over an ``as_dict()``-shaped
+    snapshot.  Dotted metric names sanitize to underscores; histograms
+    export the cumulative ``_bucket`` / ``_sum`` / ``_count`` triplet the
+    format requires."""
+    out = []
+
+    def prom_name(name: str) -> str:
+        return _PROM_BAD.sub("_", f"{namespace}_{name}")
+
+    def labels_str(labels, extra=()):
+        items = list(labels) + list(extra)
+        if not items:
+            return ""
+        return "{" + ",".join(
+            f'{_PROM_BAD.sub("_", k)}="{v}"' for k, v in items
+        ) + "}"
+
+    for kind, prom_kind in (("counters", "counter"), ("gauges", "gauge")):
         seen = set()
-        for key, h in sorted(d["histograms"].items()):
+        for key, value in sorted(d[kind].items()):
             name, labels = _split_series_key(key)
             p = prom_name(name)
             if p not in seen:
                 seen.add(p)
-                out.append(f"# TYPE {p} histogram")
-            cum = 0
-            for bound, count in h["buckets"].items():
-                cum += count
-                out.append(
-                    f"{p}_bucket{labels_str(labels, (('le', bound),))} {cum}"
-                )
-            # the +Inf bucket must equal _count even when no value landed
-            # in it explicitly
-            if "+Inf" not in h["buckets"]:
-                out.append(
-                    f"{p}_bucket{labels_str(labels, (('le', '+Inf'),))} "
-                    f"{h['count']}"
-                )
-            out.append(f"{p}_sum{labels_str(labels)} {h['sum']}")
-            out.append(f"{p}_count{labels_str(labels)} {h['count']}")
-        return "\n".join(out) + ("\n" if out else "")
+                out.append(f"# TYPE {p} {prom_kind}")
+            out.append(f"{p}{labels_str(labels)} {value}")
+    seen = set()
+    for key, h in sorted(d["histograms"].items()):
+        name, labels = _split_series_key(key)
+        p = prom_name(name)
+        if p not in seen:
+            seen.add(p)
+            out.append(f"# TYPE {p} histogram")
+        cum = 0
+        for bound, count in sorted(
+            h["buckets"].items(),
+            key=lambda kv: float("inf") if kv[0] == "+Inf" else float(kv[0]),
+        ):
+            cum += count
+            out.append(
+                f"{p}_bucket{labels_str(labels, (('le', bound),))} {cum}"
+            )
+        # the +Inf bucket must equal _count even when no value landed
+        # in it explicitly
+        if "+Inf" not in h["buckets"]:
+            out.append(
+                f"{p}_bucket{labels_str(labels, (('le', '+Inf'),))} "
+                f"{h['count']}"
+            )
+        out.append(f"{p}_sum{labels_str(labels)} {h['sum']}")
+        out.append(f"{p}_count{labels_str(labels)} {h['count']}")
+    return "\n".join(out) + ("\n" if out else "")
 
 
 # ---------------------------------------------------------------------------

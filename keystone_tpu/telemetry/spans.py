@@ -30,8 +30,11 @@ dispatch-side dict updates.
 Export: :meth:`SpanTracer.chrome_trace` emits the Chrome trace-event format
 (``ph: "X"`` complete events, microsecond ``ts``/``dur``) that
 ``chrome://tracing`` and https://ui.perfetto.dev load directly;
-``KEYSTONE_TELEMETRY_DIR`` auto-writes ``telemetry_trace.json`` +
-``telemetry_metrics.json`` there at process exit so CLI runs need no code.
+``KEYSTONE_TELEMETRY_DIR`` auto-writes pid+role-unique metric + trace
+SHARD files there at process exit (``telemetry/fleet.py`` — crash-atomic,
+so N fleet processes share one dir without clobbering; ``keystone-tpu
+obs`` merges them).  :func:`export_dir` keeps the fixed single-process
+filenames for explicit callers.
 """
 
 from __future__ import annotations
@@ -269,6 +272,16 @@ class SpanTracer:
         if not tracing_enabled(enabled):
             return _NULL_SPAN
         s = _Span(self, name, sync)
+        if "trace_id" not in args:
+            # join the thread's active request trace (telemetry/trace.py):
+            # an ingest/prefetch span opened inside use_trace() carries the
+            # request's id without the stage knowing about serving. Only
+            # reached when tracing is ON — zero cost on the disabled path.
+            from keystone_tpu.telemetry.trace import current_trace_id
+
+            tid = current_trace_id()
+            if tid is not None:
+                s.set(trace_id=tid)
         if args:
             s.set(**args)
         return s
@@ -434,7 +447,13 @@ if knobs.is_set(_ENV_DIR):
     @atexit.register
     def _autoexport():  # pragma: no cover - exercised via subprocess tests
         try:
-            export_dir(knobs.get(_ENV_DIR))
+            # pid+role-unique shard files, crash-atomic (telemetry/fleet.py)
+            # — N fleet processes sharing one dir export concurrently
+            # without clobbering; `keystone-tpu obs` merges the shards.
+            # (export_dir's fixed filenames remain for explicit callers.)
+            from keystone_tpu.telemetry.fleet import export_process
+
+            export_process(knobs.get(_ENV_DIR))
         except Exception as exc:
             # last-gasp path: stderr, not a raise, at interpreter exit
             import sys

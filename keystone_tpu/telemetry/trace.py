@@ -1,0 +1,114 @@
+"""Request-scoped distributed tracing: compact trace ids that ride the
+serve tier's cross-process frames.
+
+A trace id is minted ONCE at the admission edge (``FrontClient.predict``
+when the caller opts in, else ``Gateway.submit``), rides the unix-socket
+frame as the ``"trace"`` field, and is carried by every span the request
+touches — front enqueue, gateway admit, coalesced batch, ladder-rung
+dispatch, reply — in whichever PROCESS that span runs.  The per-process
+span shards (``telemetry/fleet.py``) then stitch into one merged Perfetto
+trace where the shared ``trace_id`` arg (and its flow arrows) connect the
+client's request to the worker's dispatch.
+
+Sampling (``KEYSTONE_TRACE_SAMPLE``, a fraction in [0, 1]) gates minting
+at the edge, so the hot path stays zero-overhead when off:
+
+- **Unset/0**: :func:`maybe_mint` is one dict lookup returning ``None`` —
+  no id, no spans, no allocation (the ``faults.get_raw`` fast-path
+  pattern).  The compiled serve programs are byte-identical either way:
+  trace ids are HOST-side metadata and never enter a jitted program (the
+  ``serve.dispatch_traced`` IR-audit entry pins this).
+- **(0, 1)**: that fraction of admissions mint an id.
+- **1**: every admission is traced.
+
+A minted id forces span recording (``request_span`` passes
+``enabled=True``), so a sampled request is traced end to end even when
+global tracing (``KEYSTONE_TELEMETRY``) is off.  Spans opened WITHOUT an
+explicit id while a request is in scope (:func:`use_trace`) inherit the
+thread's current id — this is how ingest/prefetch stage spans join a
+trace without the stages knowing about serving.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+from typing import Optional
+
+from keystone_tpu.utils import knobs
+
+_ENV_SAMPLE = "KEYSTONE_TRACE_SAMPLE"
+
+_TLS = threading.local()
+
+__all__ = [
+    "current_trace_id",
+    "maybe_mint",
+    "mint",
+    "request_span",
+    "sample_rate",
+    "use_trace",
+]
+
+
+def mint() -> str:
+    """A fresh compact trace id: 16 hex chars (64 random bits) — unique
+    across processes without coordination, cheap to pickle into a frame."""
+    return os.urandom(8).hex()
+
+
+def sample_rate() -> float:
+    return float(knobs.get(_ENV_SAMPLE))
+
+
+def maybe_mint() -> Optional[str]:
+    """Mint a trace id with probability ``KEYSTONE_TRACE_SAMPLE``; ``None``
+    otherwise.  The unset/empty case is ONE dict lookup (``knobs.get_raw``,
+    the faults.py zero-overhead pattern) — the per-request price of
+    disabled tracing on the admission hot path."""
+    raw = knobs.get_raw(_ENV_SAMPLE)
+    if not raw:
+        return None
+    rate = sample_rate()
+    if rate <= 0.0:
+        return None
+    if rate < 1.0 and random.random() >= rate:
+        return None
+    return mint()
+
+
+def current_trace_id() -> Optional[str]:
+    """The thread's active trace id (set by :func:`use_trace`), or None."""
+    return getattr(_TLS, "trace_id", None)
+
+
+@contextlib.contextmanager
+def use_trace(trace_id: Optional[str]):
+    """Scope ``trace_id`` as the thread's current trace: spans opened
+    inside (without an explicit ``trace_id`` arg) carry it, which is how
+    non-serve stages (ingest, prefetch) join a request's trace."""
+    prev = getattr(_TLS, "trace_id", None)
+    _TLS.trace_id = trace_id
+    try:
+        yield trace_id
+    finally:
+        _TLS.trace_id = prev
+
+
+def request_span(name: str, trace_id: Optional[str], sync: bool = False,
+                 **args):
+    """A span for one request-path step.  With a trace id the span ALWAYS
+    records (``enabled=True`` — a sampled request is traced end to end
+    regardless of the global knob) and carries ``trace_id``; without one
+    it defers to the global tracing knob (the plain ``tracer.span``
+    semantics), so sampling=0 adds zero span records unless the operator
+    turned tracing on wholesale."""
+    from keystone_tpu.telemetry.spans import get_tracer
+
+    if trace_id is None:
+        return get_tracer().span(name, sync=sync, **args)
+    return get_tracer().span(
+        name, sync=sync, enabled=True, trace_id=trace_id, **args
+    )

@@ -279,6 +279,14 @@ declare("KEYSTONE_TELEMETRY_COST", "bool", True,
 declare("KEYSTONE_TELEMETRY_MAX_SPANS", "int", 200000,
         "Runaway guard: spans beyond this cap are counted "
         "(telemetry.spans_dropped) but not stored.", validator=_positive)
+declare("KEYSTONE_TELEMETRY_ROLE", "str", "",
+        "Shard-file role tag for this process's KEYSTONE_TELEMETRY_DIR "
+        "export (telemetry_shard-<role>-<pid>.json); Fleet tags replicas "
+        "replica-<i> automatically. Empty = 'proc'.")
+declare("KEYSTONE_TELEMETRY_STALE_S", "float", 3600.0,
+        "Shard staleness horizon: a shard whose pid is dead AND whose "
+        "export is older than this is pruned on merge (keystone-tpu obs / "
+        "telemetry.fleet), never silently summed.", validator=_positive)
 declare("KEYSTONE_TPU_TRACE_DIR", "str", "",
         "Capture a jax.profiler device trace (TensorBoard/Perfetto) for "
         "blocks under utils.profiling.trace().")
@@ -536,6 +544,13 @@ declare("KEYSTONE_SERVE_REPLICAS", "int", 3,
         "gateway worker processes behind one admission surface, each a "
         "ModelPool served over a unix-socket BatchingFront.",
         validator=_positive)
+declare("KEYSTONE_TRACE_SAMPLE", "float", 0.0,
+        "Request-trace sampling fraction in [0,1]: that share of serve "
+        "admissions mint a trace id that rides the front frame and forces "
+        "span recording end to end (telemetry/trace.py). 0/unset = "
+        "zero-overhead off — the admission fast path is one dict lookup "
+        "and the compiled serve programs are byte-identical.",
+        validator=_unit_fraction)
 
 # ---------------------------------------------------------------------------
 # BENCH_* declarations (bench.py / scripts/bench_regime.py sections)

@@ -738,6 +738,25 @@ def _fleet() -> dict:
     )
     if qps1 > 0:
         out["fleet_qps_scale"] = round(qpsN / qps1, 2)
+    # --- fleet-wide observability plane: the N-replica config once more
+    # with KEYSTONE_TELEMETRY_DIR exported to every worker, so each
+    # replica writes its pid+role-unique telemetry shard at exit and the
+    # merged view yields SERVER-side keys (fleet_p99_ms is the gateways'
+    # own serve.latency_ms histogram quantile — the client-side p99
+    # above includes socket turnaround).  Its OWN arm, so span recording
+    # never rides the capacity arms; telemetry_merge_procs is the
+    # honesty key (how many process shards the merge actually saw).
+    import shutil
+    import tempfile
+
+    from keystone_tpu.telemetry.fleet import bench_keys
+    tdir = tempfile.mkdtemp(prefix="keystone-bench-obs-")
+    try:
+        measure(replicas, total_clients, seed0=1200,
+                env={"KEYSTONE_TELEMETRY_DIR": tdir})
+        out.update(bench_keys(tdir))
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
     return out
 
 

@@ -1,0 +1,144 @@
+"""Fleet observability smoke (<20 s, CPU): the `make obs-smoke` rung of
+`verify-fast`.
+
+Pins, through REAL replica worker processes (``keystone_tpu/serve/
+fleet.py`` with ``KEYSTONE_TELEMETRY_DIR`` exported to every worker):
+
+1. Each replica writes its OWN pid+role-unique telemetry shard at exit
+   (no atexit clobber), and the merged counter totals EXACTLY equal the
+   per-shard sums — `keystone-tpu obs` totals are exact, not sampled.
+2. A client-minted trace id rides the unix-socket frame into a replica:
+   the stitched Perfetto file contains spans from >= 2 OS processes
+   (driver + replica) sharing that id, connected by flow arrows.
+3. The ``keystone-tpu obs`` CLI renders the merged dir with rc=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("KEYSTONE_FAULTS", None)
+os.environ.pop("KEYSTONE_TELEMETRY_DIR", None)
+
+t_start = time.monotonic()
+
+BUDGET_S = 20.0
+
+
+def main() -> int:
+    import subprocess
+
+    import numpy as np
+
+    from keystone_tpu.serve.builders import two_tenant
+    from keystone_tpu.serve.fleet import Fleet
+    from keystone_tpu.serve.front import mint_trace_id
+    from keystone_tpu.telemetry import (
+        export_process,
+        get_tracer,
+        merge_shards,
+        merge_traces,
+        use_tracing,
+    )
+    from keystone_tpu.telemetry.trace import request_span
+
+    tdir = tempfile.mkdtemp(prefix="keystone-obs-smoke-")
+    tid = mint_trace_id()
+    with Fleet("two_tenant", replicas=2, shapes="1,4",
+               coalesce_ms=0.0, queue_depth=32, slo_ms=10_000.0,
+               env={"KEYSTONE_TELEMETRY_DIR": tdir}) as f:
+        assert f.live_count() == 2, f.stats()
+        items = {
+            s.name: np.linspace(-1.0, 1.0, int(s.item_spec.shape[0]),
+                                dtype=np.float32)
+            for s in two_tenant()
+        }
+        models = sorted(items)
+        model = models[0]
+        # the driver's half of the distributed trace: a client-side span
+        # carrying the same id the replica's serve-path spans will carry
+        with use_tracing(True):
+            with request_span("client.predict", tid, model=model):
+                r = f.predict(items[model], model=model,
+                              deadline_ms=10_000, trace_id=tid)
+        assert r["ok"] is True, r
+        assert r["trace"] == tid, r
+        n_req = 6
+        for i in range(n_req - 1):
+            m = models[i % len(models)]
+            r = f.predict(items[m], model=m, deadline_ms=10_000)
+            assert r["ok"] is True, r
+    # fleet closed: every worker's atexit wrote its shard. The driver's
+    # half of the trace (the client-side span) exports alongside them.
+    os.environ["KEYSTONE_TELEMETRY_ROLE"] = "driver"
+    export_process(tdir, tracer=get_tracer())
+
+    # 1: unique shards, merged totals == exact per-shard sums
+    shard_files = sorted(n for n in os.listdir(tdir)
+                         if n.startswith("telemetry_shard-"))
+    assert len(shard_files) == 3, shard_files  # 2 replicas + driver
+    per_shard = 0.0
+    for name in shard_files:
+        with open(os.path.join(tdir, name)) as fh:
+            metrics = json.load(fh)["metrics"]
+        for key, value in (metrics.get("counters") or {}).items():
+            if key.startswith("serve.requests"):
+                per_shard += value
+    view = merge_shards(tdir, prune=False)
+    merged_total = sum(
+        v for k, v in view["merged"]["counters"].items()
+        if k.startswith("serve.requests")
+    )
+    assert merged_total == per_shard == n_req, (merged_total, per_shard)
+    roles = sorted(p["role"] for p in view["procs"])
+    assert roles == ["driver", "replica-0", "replica-1"], roles
+    print(f"obs-smoke 1/3: {len(shard_files)} pid+role-unique shards, "
+          f"merged serve.requests == exact shard sum == {n_req}")
+
+    # 2: one stitched Perfetto trace spanning >= 2 OS processes
+    trace_path = os.path.join(tdir, "stitched_trace.json")
+    merged = merge_traces(tdir, out_path=trace_path, prune=False)
+    traced = [e for e in merged["traceEvents"] if e.get("ph") == "X"
+              and (e.get("args") or {}).get("trace_id") == tid]
+    pids = {e["pid"] for e in traced}
+    assert len(pids) >= 2, (pids, [e["name"] for e in traced])
+    flows = [e for e in merged["traceEvents"]
+             if e.get("ph") in ("s", "t", "f") and e.get("id") == tid]
+    assert flows, "no flow arrows for the request trace"
+    names = {e["name"] for e in traced}
+    assert "serve.admit" in names and "serve.reply" in names, names
+    print(f"obs-smoke 2/3: trace {tid} stitched across {len(pids)} OS "
+          f"processes ({len(traced)} spans, {len(flows)} flow arrows)")
+
+    # 3: the obs CLI renders the dir, rc=0
+    proc = subprocess.run(
+        [sys.executable, "-m", "keystone_tpu.cli", "obs", tdir,
+         "--format", "json"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout)
+    assert out["signals"]["serve"]["requests"] == n_req, out["signals"]
+    print("obs-smoke 3/3: `keystone-tpu obs` rc=0, signals.serve."
+          f"requests == {n_req}")
+
+    dt = time.monotonic() - t_start
+    print(f"obs-smoke PASS in {dt:.1f}s")
+    if dt > BUDGET_S:
+        print(f"obs-smoke OVER BUDGET ({dt:.1f}s > {BUDGET_S}s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

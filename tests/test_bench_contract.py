@@ -269,6 +269,12 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # ... and the fleet regime: no scaling claim without its budget story
     assert full.get("fleet_qps_scale_skipped") == "budget"
     assert full.get("fleet_qps_scale") is None
+    # the fleet observability keys ride the same regime — a skipped fleet
+    # run must not land server-side shed/p99 claims either
+    assert full.get("fleet_shed_frac") is None
+    assert full.get("fleet_p99_ms") is None
+    assert full.get("fleet_breaker_trips") is None
+    assert full.get("telemetry_merge_procs") is None
     # the secondary sections starve too, but the rotation STILL advances
     # and is recorded — a fully-starved run must not freeze the cursor
     assert full["bench_secondary_cursor"] == 0
@@ -344,3 +350,37 @@ def test_bench_section_floor_exhaustion_is_graceful(tmp_path):
     assert full.get("sift_pallas_on_gflops_skipped") == "budget"
     # the primary metric itself still landed
     assert compact["metric"] == "mnist_random_fft_fit_eval_wallclock"
+
+
+def test_fleet_obs_bench_keys(tmp_path, monkeypatch):
+    """The BENCH_FLEET observability emissions are exact functions of the
+    merged per-process shards: shed fraction and breaker trips equal the
+    cross-shard counter sums, fleet_p99_ms comes from the UNIONED
+    serve.latency_ms histograms, and telemetry_merge_procs honestly counts
+    the process shards the merge saw (no subprocess needed — bench_keys is
+    the same code path the fleet regime calls after its observed arm)."""
+    from keystone_tpu.telemetry.fleet import bench_keys, export_process
+    from keystone_tpu.telemetry.registry import (
+        LATENCY_BUCKETS_MS,
+        MetricsRegistry,
+    )
+
+    for role, lats in (("replica-0", (2.0, 4.0)), ("replica-1", (8.0, 400.0))):
+        monkeypatch.setenv("KEYSTONE_TELEMETRY_ROLE", role)
+        reg = MetricsRegistry()
+        reg.inc("serve.responses", 2, code="ok")
+        reg.inc("serve.responses", code="shed")
+        reg.inc("serve.shed_total", reason="overload")
+        reg.inc("serve.breaker", event="open")
+        for lat in lats:
+            reg.observe("serve.latency_ms", lat,
+                        buckets=LATENCY_BUCKETS_MS, model="default")
+        export_process(str(tmp_path), registry=reg)
+
+    keys = bench_keys(str(tmp_path))
+    assert keys["telemetry_merge_procs"] == 2
+    assert keys["fleet_breaker_trips"] == 2
+    assert keys["fleet_shed_frac"] == round(2 / 6, 4)
+    # 4 merged observations (2, 4, 8, 400): the q=0.99 estimate must land
+    # in the top histogram bucket, clamped by the recorded max
+    assert 250.0 < keys["fleet_p99_ms"] <= 400.0

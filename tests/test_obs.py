@@ -1,0 +1,418 @@
+"""Fleet-wide observability plane (keystone_tpu/telemetry/fleet.py +
+trace.py): pid+role-unique crash-atomic shard export, exact-sum merge
+under concurrent writers, stale-shard pruning, request-scoped trace-id
+propagation through a REAL BatchingFront -> gateway round trip stitched
+into one multi-process Perfetto trace, the zero-overhead-when-off pin
+(no span records, stable compile cache, byte-identical lowered HLO), and
+the ``signals()`` schema the planner consumes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import keystone_tpu._compat  # noqa: F401
+from keystone_tpu.core.pipeline import Transformer, chain
+from keystone_tpu.serve import serve
+from keystone_tpu.serve.front import BatchingFront, FrontClient, mint_trace_id
+from keystone_tpu.telemetry import reset as telemetry_reset
+from keystone_tpu.telemetry.fleet import (
+    bench_keys,
+    export_process,
+    merge_shards,
+    merge_traces,
+    obs_main,
+    signals,
+)
+from keystone_tpu.telemetry.registry import LATENCY_BUCKETS_MS, MetricsRegistry
+from keystone_tpu.telemetry.spans import get_tracer
+from keystone_tpu.utils import knobs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Doubler(Transformer):
+    def apply(self, x):
+        return x * 2
+
+
+def _spec(d=4):
+    return jax.ShapeDtypeStruct((d,), np.float32)
+
+
+def _item(d=4):
+    return np.arange(d, dtype=np.float32)
+
+
+def _clean_env(**extra):
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("KEYSTONE_TELEMETRY", None)
+    env.pop("KEYSTONE_TELEMETRY_DIR", None)
+    env.update(JAX_PLATFORMS="cpu", **extra)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Shard export + merge
+# ---------------------------------------------------------------------------
+
+
+def test_shard_names_are_pid_and_role_unique(tmp_path, monkeypatch):
+    """Two roles in one process -> two shard files; re-exporting the same
+    role overwrites ITS OWN shard (idempotent), never another's — the fix
+    for the fixed-filename atexit clobber."""
+    reg = MetricsRegistry()
+    reg.inc("x.count", 3)
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_ROLE", "alpha")
+    paths_a = export_process(str(tmp_path), registry=reg)
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_ROLE", "beta")
+    paths_b = export_process(str(tmp_path), registry=reg)
+    assert paths_a["metrics"] != paths_b["metrics"]
+    assert str(os.getpid()) in os.path.basename(paths_a["metrics"])
+    n_before = len(list(tmp_path.iterdir()))
+    export_process(str(tmp_path), registry=reg)  # same role+pid: overwrite
+    assert len(list(tmp_path.iterdir())) == n_before
+    view = merge_shards(str(tmp_path), prune=False)
+    assert view["merged"]["counters"]["x.count"] == 6  # alpha + beta
+    assert not view["pruned"]
+    # no temp droppings: the atomic write cleaned up after itself
+    assert not [p for p in tmp_path.iterdir() if p.suffix == ".tmp"]
+
+
+def test_merge_exact_sums_under_concurrent_process_writers(tmp_path):
+    """N real OS processes exporting concurrently into one dir: the merged
+    counters equal the exact per-process sums, gauges stay per-process
+    under the added proc label, histograms union bucket-wise."""
+    code = (
+        "import sys\n"
+        "from keystone_tpu.telemetry.fleet import export_process\n"
+        "from keystone_tpu.telemetry.registry import (\n"
+        "    LATENCY_BUCKETS_MS, MetricsRegistry)\n"
+        "i = int(sys.argv[1])\n"
+        "reg = MetricsRegistry()\n"
+        "reg.inc('w.count', i + 1)\n"
+        "reg.inc('w.labeled', 2, kind='a')\n"
+        "reg.set_gauge('w.depth', float(i))\n"
+        "reg.observe('w.lat_ms', 5.0 * (i + 1),\n"
+        "            buckets=LATENCY_BUCKETS_MS)\n"
+        "export_process(sys.argv[2], registry=reg)\n"
+    )
+    n = 4
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(i), str(tmp_path)],
+            cwd=_REPO,
+            env=_clean_env(KEYSTONE_TELEMETRY_ROLE=f"writer-{i}"),
+        )
+        for i in range(n)
+    ]
+    for p in procs:
+        assert p.wait(timeout=60) == 0
+    view = merge_shards(str(tmp_path), prune=False)
+    assert len(view["procs"]) == n
+    assert {p["role"] for p in view["procs"]} == {
+        f"writer-{i}" for i in range(n)
+    }
+    merged = view["merged"]
+    assert merged["counters"]["w.count"] == sum(i + 1 for i in range(n))
+    assert merged["counters"]["w.labeled{kind=a}"] == 2 * n
+    # gauges NOT summed: one proc-labeled series per writer
+    depth_keys = [k for k in merged["gauges"] if k.startswith("w.depth{")]
+    assert len(depth_keys) == n
+    assert sorted(merged["gauges"][k] for k in depth_keys) == [
+        float(i) for i in range(n)
+    ]
+    h = merged["histograms"]["w.lat_ms"]
+    assert h["count"] == n
+    assert h["sum"] == pytest.approx(sum(5.0 * (i + 1) for i in range(n)))
+    assert h["min"] == 5.0 and h["max"] == 5.0 * n
+
+
+def test_stale_shards_pruned_fresh_dead_pid_kept(tmp_path, monkeypatch):
+    """A DEAD pid's shard past the staleness horizon is pruned (and never
+    summed); a fresh shard from a dead pid — the normal atexit export of
+    an exited worker — still merges.  Unparseable shards are pruned too."""
+    import time as _time
+
+    dead_pid = 2 ** 22 + 12345  # beyond pid_max defaults: never alive
+    stale = {
+        "schema": 1, "pid": dead_pid, "role": "old", "host": "h",
+        "exported_at": _time.time() - 86400.0,
+        "metrics": {"counters": {"x.count": 100}, "gauges": {},
+                    "histograms": {}},
+    }
+    fresh_dead = dict(stale, role="worker", exported_at=_time.time(),
+                      metrics={"counters": {"x.count": 7}, "gauges": {},
+                               "histograms": {}})
+    (tmp_path / f"telemetry_shard-old-{dead_pid}.json").write_text(
+        json.dumps(stale)
+    )
+    (tmp_path / f"telemetry_trace_shard-old-{dead_pid}.json").write_text(
+        json.dumps({"schema": 1, "pid": dead_pid, "role": "old",
+                    "exported_at": stale["exported_at"],
+                    "epoch_offset_us": 0.0,
+                    "trace": {"traceEvents": []}})
+    )
+    (tmp_path / f"telemetry_shard-worker-{dead_pid}.json").write_text(
+        json.dumps(fresh_dead)
+    )
+    (tmp_path / "telemetry_shard-torn-1.json").write_text("{not json")
+    view = merge_shards(str(tmp_path))
+    assert view["merged"]["counters"]["x.count"] == 7  # stale NOT summed
+    assert f"telemetry_shard-old-{dead_pid}.json" in view["pruned"]
+    assert "telemetry_shard-torn-1.json" in view["pruned"]
+    # pruning removed the stale metric shard AND its trace twin
+    assert not (tmp_path / f"telemetry_shard-old-{dead_pid}.json").exists()
+    assert not (
+        tmp_path / f"telemetry_trace_shard-old-{dead_pid}.json"
+    ).exists()
+    assert (tmp_path / f"telemetry_shard-worker-{dead_pid}.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing
+# ---------------------------------------------------------------------------
+
+
+def test_trace_id_rides_front_frame_and_stitches_one_trace(
+        tmp_path, monkeypatch):
+    """A client-minted trace id rides the unix-socket frame through a REAL
+    BatchingFront -> gateway round trip: the response echoes it, every
+    serve-path span carries it, and merge_traces stitches spans from TWO
+    OS processes into ONE Perfetto trace with flow arrows on the id."""
+    monkeypatch.setenv("KEYSTONE_TELEMETRY", "1")
+    telemetry_reset()
+    g = serve(chain(Doubler()), item_spec=_spec(), slo_ms=10_000.0)
+    front = BatchingFront(g)
+    client = FrontClient(front.path)
+    tid = mint_trace_id()
+    try:
+        resp = client.predict(_item(), trace_id=tid)
+        assert resp["ok"], resp
+        assert resp["trace"] == tid
+        np.testing.assert_allclose(np.asarray(resp["value"]), _item() * 2)
+        # an untraced request stays untraced (no ambient id leaks in)
+        resp2 = client.predict(_item())
+        assert resp2["ok"] and resp2["trace"] is None
+    finally:
+        client.close()
+        front.close()
+        g.close()
+    spans = [
+        (e["name"], (e.get("args") or {}).get("trace_id"))
+        for e in get_tracer().chrome_trace()["traceEvents"]
+        if e.get("ph") == "X"
+    ]
+    traced_names = {name for name, t in spans if t == tid}
+    for want in ("front.enqueue", "serve.admit", "serve.coalesce",
+                 "serve.rung", "serve.dispatch", "serve.reply"):
+        assert want in traced_names, (want, spans)
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_ROLE", "gateway")
+    export_process(str(tmp_path))
+    # a second OS process records its half of the SAME request trace
+    code = (
+        "import os, sys\n"
+        "from keystone_tpu.telemetry.fleet import export_process\n"
+        "from keystone_tpu.telemetry.trace import request_span\n"
+        "with request_span('client.send', sys.argv[1]):\n"
+        "    pass\n"
+        "export_process(sys.argv[2])\n"
+    )
+    rc = subprocess.run(
+        [sys.executable, "-c", code, tid, str(tmp_path)],
+        cwd=_REPO,
+        env=_clean_env(KEYSTONE_TELEMETRY="1",
+                       KEYSTONE_TELEMETRY_ROLE="client"),
+        timeout=60,
+    ).returncode
+    assert rc == 0
+    merged = merge_traces(str(tmp_path),
+                          out_path=str(tmp_path / "trace.json"))
+    evs = merged["traceEvents"]
+    traced = [e for e in evs if e.get("ph") == "X"
+              and (e.get("args") or {}).get("trace_id") == tid]
+    assert len({e["pid"] for e in traced}) >= 2  # spans from BOTH processes
+    flows = [e for e in evs if e.get("ph") in ("s", "t", "f")
+             and e.get("id") == tid]
+    assert [e for e in flows if e["ph"] == "s"]
+    assert [e for e in flows if e["ph"] == "f" and e.get("bp") == "e"]
+    # the written artifact is the same Perfetto-loadable JSON
+    on_disk = json.loads((tmp_path / "trace.json").read_text())
+    assert on_disk["traceEvents"]
+    # every event has the Chrome-trace required fields
+    for e in on_disk["traceEvents"]:
+        assert "ph" in e and "pid" in e
+        if e["ph"] == "X":
+            assert "ts" in e and "dur" in e and "name" in e
+
+
+def test_tracing_off_zero_spans_no_recompile_identical_hlo(monkeypatch):
+    """KEYSTONE_TRACE_SAMPLE=0 and telemetry off: serving records ZERO
+    spans, the jit cache never grows past warmup, and the dispatch
+    program lowers to byte-identical HLO with tracing active vs not —
+    trace ids are host metadata, never program inputs."""
+    from keystone_tpu.serve.gateway import _jit_apply_batch, _serve_apply
+    from keystone_tpu.telemetry.spans import use_tracing
+    from keystone_tpu.telemetry.trace import maybe_mint, request_span, \
+        use_trace
+
+    monkeypatch.delenv("KEYSTONE_TELEMETRY", raising=False)
+    monkeypatch.delenv("KEYSTONE_TELEMETRY_DIR", raising=False)
+    monkeypatch.setenv("KEYSTONE_TRACE_SAMPLE", "0.0")
+    telemetry_reset()
+    assert maybe_mint() is None  # sampling off: the edge mints nothing
+    g = serve(chain(Doubler()), item_spec=_spec(), slo_ms=10_000.0)
+    try:
+        g.predict(_item())
+        size0 = g.compile_cache_size()
+        for i in range(5):
+            g.predict(_item())
+        assert g.compile_cache_size() == size0
+        assert _jit_apply_batch._cache_size() == size0
+    finally:
+        g.close()
+    evs = get_tracer().chrome_trace()["traceEvents"]
+    assert [e for e in evs if e.get("ph") == "X"] == []
+    # byte-identical lowered programs, traced vs untraced
+    node = chain(Doubler())
+    xs = np.zeros((4, 4), np.float32)
+    plain = jax.jit(lambda x: _serve_apply(node, x)).lower(xs).as_text()
+    with use_tracing(True), use_trace("deadbeefdeadbeef"):
+        with request_span("serve.rung", "deadbeefdeadbeef", n=4):
+            traced = jax.jit(
+                lambda x: _serve_apply(node, x)
+            ).lower(xs).as_text()
+    assert plain == traced
+    telemetry_reset()
+
+
+def test_sample_rate_mints_when_selected(monkeypatch):
+    """KEYSTONE_TRACE_SAMPLE=1.0 mints an id at the admission edge even
+    when the caller passed none (and the knob validates as a fraction)."""
+    from keystone_tpu.telemetry.trace import maybe_mint
+
+    monkeypatch.setenv("KEYSTONE_TRACE_SAMPLE", "1.0")
+    tid = maybe_mint()
+    assert tid is not None and len(tid) == 16
+    monkeypatch.setenv("KEYSTONE_TRACE_SAMPLE", "2.0")
+    with pytest.raises(ValueError):
+        knobs.validate_environment()
+
+
+# ---------------------------------------------------------------------------
+# Signals + CLI
+# ---------------------------------------------------------------------------
+
+_SERVE_KEYS = {
+    "requests", "responses", "shed_total", "shed_frac", "breaker_trips",
+    "sentinel_trips", "demotions", "p50_ms", "p99_ms",
+}
+_TENANT_KEYS = {
+    "responses", "served", "shed", "slo_violations", "slo_violation_frac",
+    "p50_ms", "p99_ms",
+}
+_INGEST_KEYS = {"prefetch_stalls", "prefetch_ready", "ingest_batches"}
+
+
+def test_signals_schema_is_stable_process_and_fleet_scope(tmp_path,
+                                                          monkeypatch):
+    """The planner-facing dict: same pinned schema over the local registry
+    and over a fleet-merged snapshot, fractions consistent with the raw
+    counters."""
+    reg = MetricsRegistry()
+    reg.inc("serve.requests", 4, model="m")
+    reg.inc("serve.responses", 3, code="ok")
+    reg.inc("serve.responses", code="shed")
+    reg.inc("serve.shed_total", reason="overload")
+    reg.inc("serve.breaker", event="open")
+    reg.inc("serve.tenant_responses", 4, model="m")
+    reg.inc("serve.tenant_served", 3, model="m")
+    reg.inc("serve.tenant_shed", 1, model="m")
+    reg.inc("serve.tenant_slo_violations", 2, model="m")
+    for lat in (1.0, 2.0, 40.0):
+        reg.observe("serve.latency_ms", lat, buckets=LATENCY_BUCKETS_MS,
+                    model="m")
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_ROLE", "sig")
+    export_process(str(tmp_path), registry=reg)
+
+    for sig in (signals(reg.as_dict()),
+                signals(merge_shards(str(tmp_path), prune=False))):
+        assert set(sig) == {"schema", "scope", "serve", "tenants",
+                            "memory", "ingest"}
+        assert sig["schema"] == 1
+        assert set(sig["serve"]) == _SERVE_KEYS
+        assert sig["serve"]["requests"] == 4
+        assert sig["serve"]["shed_frac"] == round(1 / 4, 4)
+        assert sig["serve"]["breaker_trips"] == 1
+        assert sig["serve"]["p99_ms"] is not None
+        assert set(sig["tenants"]) == {"m"}
+        assert set(sig["tenants"]["m"]) == _TENANT_KEYS
+        assert sig["tenants"]["m"]["slo_violation_frac"] == 0.5
+        assert set(sig["ingest"]) == _INGEST_KEYS
+    assert signals(reg.as_dict())["scope"] == "fleet"  # explicit snapshot
+    local = signals()
+    assert local["scope"] == "process" and set(local["serve"]) == _SERVE_KEYS
+
+
+def test_tenant_stats_and_signals_agree_on_slo_burn(monkeypatch):
+    """ModelPool per-tenant SLO accounting: a shed burns SLO budget, and
+    tenant_stats / the registry counters / signals() tell one story."""
+    from keystone_tpu.serve.pool import pool
+
+    telemetry_reset()
+    g = pool(chain(Doubler()), item_spec=_spec(), name="t0",
+             slo_ms=10_000.0, queue_depth=64)
+    try:
+        for _ in range(3):
+            g.predict(_item())
+        ts = g.tenant_stats("t0")
+        assert ts["slo_violations"] == 0
+        assert ts["slo_violation_frac"] == 0.0
+        assert {"slo_violations", "slo_violation_frac"} <= set(ts)
+        sig = signals()
+        assert sig["tenants"]["t0"]["served"] == 3
+        assert sig["tenants"]["t0"]["slo_violation_frac"] == 0.0
+    finally:
+        g.close()
+
+
+def test_obs_cli_text_json_prometheus(tmp_path, monkeypatch, capsys):
+    """``keystone-tpu obs``: rc=0 with a shard dir (rc=2 without), totals
+    in every format equal the shard sums exactly."""
+    reg = MetricsRegistry()
+    reg.inc("serve.requests", 5, model="default")
+    reg.observe("serve.latency_ms", 3.0, buckets=LATENCY_BUCKETS_MS,
+                model="default")
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_ROLE", "cli-a")
+    export_process(str(tmp_path), registry=reg)
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_ROLE", "cli-b")
+    export_process(str(tmp_path), registry=reg)
+
+    monkeypatch.delenv("KEYSTONE_TELEMETRY_DIR", raising=False)
+    assert obs_main([]) == 2  # no dir anywhere
+    assert obs_main([str(tmp_path / "nope")]) == 2
+
+    assert obs_main([str(tmp_path), "--format", "json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["merged"]["counters"]["serve.requests{model=default}"] == 10
+    assert len(out["procs"]) == 2
+    assert out["signals"]["serve"]["requests"] == 10
+
+    assert obs_main([str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    assert "2 merged" in text and "serve.requests{model=default}" in text
+
+    assert obs_main([str(tmp_path), "--format", "prometheus"]) == 0
+    prom = capsys.readouterr().out
+    assert 'keystone_serve_requests{model="default"} 10' in prom
+    assert "keystone_serve_latency_ms_bucket" in prom
+
+    trace_out = tmp_path / "stitched.json"
+    assert obs_main([str(tmp_path), "--traces", str(trace_out)]) == 0
+    assert json.loads(trace_out.read_text())["traceEvents"] is not None
