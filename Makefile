@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all native test lint audit audit-smoke check check-smoke verify-fast telemetry-smoke autotune-smoke kernel-search-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke fleet-smoke ingest-smoke obs-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
+.PHONY: all native test lint audit audit-smoke check check-smoke race race-smoke verify-fast telemetry-smoke autotune-smoke kernel-search-smoke plan-smoke precision-smoke chaos-smoke health-smoke serve-smoke serve-chaos-smoke fleet-smoke ingest-smoke obs-smoke bench bench-cached bench-smoke cpu-baseline flagship clean
 
 all: native test
 
@@ -56,6 +56,23 @@ check:
 check-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/check_smoke.py
 
+# Lock-discipline static analysis (keystone_tpu/analysis/concurrency.py):
+# model every lock creation site, `with <lock>:` span and thread/atexit
+# entry point into an acquisition graph and run rules T1-T5 (inversions,
+# blocking-under-lock, unguarded shared state, thread lifecycles,
+# unlocked read-merge-replace). Non-zero exit ONLY for findings not in
+# the ratcheted race_baseline.json. Seconds, no backend init.
+race:
+	JAX_PLATFORMS=cpu $(PY) -m keystone_tpu.cli race
+
+# Lock-discipline smoke (<20 s): seeded bad fixtures fire every T rule,
+# the real tree sweeps clean against the committed baseline with the JSON
+# schema intact, and the KEYSTONE_LOCK_WITNESS runtime sanitizer catches
+# a replayed PR-15 deadlock while the unset-knob path returns locks
+# unchanged (scripts/race_smoke.py).
+race-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/race_smoke.py
+
 # Lint + tier-1 + the BENCH_SMOKE bench contract + the telemetry smoke in
 # ONE command — the pre-merge loop: the static pass first (it is the
 # cheapest failure), then the full (non-slow) test suite on the 8-device
@@ -79,6 +96,7 @@ verify-fast: lint
 	JAX_PLATFORMS=cpu $(PY) scripts/fleet_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/ingest_smoke.py
 	JAX_PLATFORMS=cpu $(PY) scripts/obs_smoke.py
+	JAX_PLATFORMS=cpu $(PY) scripts/race_smoke.py
 
 # Fleet-observability contract (<20 s): 2 replica workers + driver each
 # write a pid+role-unique telemetry shard, merged counter totals exactly

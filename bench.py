@@ -139,8 +139,24 @@ def _rotate_secondary(sections):
     every section budget-skips — a run that starves the whole list must
     not freeze the rotation. Returns ``(cursor_used, rotated_list)``; an
     unreadable/unwritable cursor file degrades to cursor 0 (the exact
-    pre-cursor order) rather than failing the bench."""
+    pre-cursor order) rather than failing the bench.
+
+    The read→increment→replace window runs under an exclusive ``flock``
+    on a ``<path>.lock`` sidecar (the ``autotune.record`` shape): two
+    bench processes sharing a cursor file must each advance it by one, or
+    a lost increment replays the same prefix and the tail sections starve
+    again. Filesystems without flock degrade to best-effort."""
     path = _cursor_path()
+    lockf = None
+    try:
+        import fcntl
+
+        lockf = open(f"{path}.lock", "w")
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+    except Exception:
+        if lockf is not None:
+            lockf.close()
+            lockf = None
     cursor = 0
     try:
         with open(path) as f:
@@ -155,6 +171,9 @@ def _rotate_secondary(sections):
         os.replace(tmp, path)
     except OSError as e:
         print(f"bench cursor not persisted: {e}", file=sys.stderr)
+    finally:
+        if lockf is not None:
+            lockf.close()  # drops the flock
     return cursor, sections[cursor:] + sections[:cursor]
 
 
@@ -1055,6 +1074,42 @@ def _try_check_rows() -> dict:
         return {"check_findings_total": None}
 
 
+def _try_race_rows() -> dict:
+    """Lock-discipline hygiene row (``keystone_tpu/analysis/
+    concurrency.py``): sweep the package with rules T1-T5 over the
+    lockgraph model and record the finding counts — the concurrency
+    complement of the lint (source) and check (graph) rows.
+    ``race_findings_total`` counts everything surfaced (new + baselined),
+    ``race_new`` what would fail ``make race``. Pure AST walk — no
+    backend, no execution: ~2 s. BENCH_RACE=0 skips."""
+    if not knobs.get("BENCH_RACE"):
+        return {}
+    try:
+        from keystone_tpu.analysis.concurrency import (
+            DEFAULT_RACE_BASELINE,
+            default_paths,
+            run_race,
+        )
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        baseline = os.path.join(root, DEFAULT_RACE_BASELINE)
+        result = run_race(
+            root,
+            default_paths(root),
+            baseline_path=baseline if os.path.exists(baseline) else None,
+        )
+        return {
+            "race_findings_total": result.total,
+            "race_new": len(result.findings),
+            "race_suppressed": result.suppressed,
+            "race_files": result.files,
+            "race_errors": len(result.errors) or None,
+        }
+    except Exception as e:
+        print(f"race rows failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"race_findings_total": None}
+
+
 def _try_audit_rows() -> dict:
     """IR-audit hygiene row (``keystone_tpu/analysis/ir_audit.py``): lower
     the registered entry points the live topology can place and record the
@@ -1925,6 +1980,17 @@ def main():
     else:
         out.update(_try_check_rows())
     _flush(out, "check")
+    # Lock-discipline hygiene (AST sweep of the concurrent tier, rules
+    # T1-T5): ~2 s of parsing, so the 20 s reduced floor is generous
+    # headroom; the explicit budget-skip marker is the section contract
+    # the tests pin.
+    if _budget_remaining() - _FINALIZE_RESERVE_S < 20.0:
+        out["race_skipped"] = "budget"
+        print("bench section race skipped: budget exhausted",
+              file=sys.stderr)
+    else:
+        out.update(_try_race_rows())
+    _flush(out, "race")
     # IR-audit hygiene (lower + compile the registered entry points; no
     # execution): seconds, but not milliseconds — a reduced floor like
     # telemetry's, with the explicit budget-skip marker the section

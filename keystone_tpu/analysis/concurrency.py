@@ -1,0 +1,591 @@
+"""keystone-race: lock-discipline static analysis for the concurrent tier.
+
+The ladder so far reads source (lint R1-R7), construction-time graphs
+(check C1-C5), and compiled IR (audit A1-A5); none of it polices the lock
+discipline of the genuinely concurrent subsystems PRs 14-19 grew — and
+PR 15's review caught a real buffers=1/threads>=2 deadlock
+(``_claim_slot`` blocking on the buffer ring *inside* the claim lock)
+that only a human read found.  This pass turns that review into rules,
+over the :mod:`lockgraph` model:
+
+- **T1 lock-order-inversion** — a cycle in the acquisition graph: some
+  site acquires ``B`` while holding ``A`` and some other site can do the
+  reverse.  Two threads interleaving those sites deadlock.
+- **T2 blocking-under-lock** — an unbounded blocking call
+  (``queue.get/put``, socket ``recv``/``accept``, ``join``, ``sleep``,
+  ``subprocess.wait``, ``block_until_ready``, ``device_put``, a bare
+  ``acquire``) lexically inside a ``with <lock>:`` span — the exact
+  PR-15 bug class.  Bounded waits (an explicit ``timeout=``) and a
+  ``Condition.wait`` on the held condition (which *releases* it) are
+  exempt.
+- **T3 unguarded-shared-state** — mutation of a module/class-level
+  container outside a lock, in any module with a thread/process/atexit
+  entry point or a module-level lock (generalizes lint R5 repo-wide and
+  subsumes it: R5's scope list is included, and existing
+  ``# lint: disable=R5`` pragmas suppress T3 at the same sites).
+- **T4 thread-lifecycle** — spawning an OS process while holding a lock
+  (the child inherits the locked mutex state), and non-daemon threads
+  that are never joined (atexit-ordering hangs).
+- **T5 unlocked-read-merge-replace** — a function that reads persisted
+  JSON and writes it back with ``os.replace``/``os.rename`` without an
+  ``fcntl.flock`` sidecar window: two processes interleaving lose one
+  writer's merge (the autotune/plan-cache cross-process pattern —
+  ``ops/pallas/autotune.py::record`` is the correct shape).
+
+Findings ride the exact lint machinery — :class:`engine.Finding`
+fingerprints, ``# lint: disable=T2 (reason)`` pragmas, the ratcheted
+``race_baseline.json`` (committed empty: the tree is clean), the 0/1/2
+exit contract — via ``keystone-tpu race`` / ``make race``.  The runtime
+complement is ``utils/lockwitness.py`` (``KEYSTONE_LOCK_WITNESS=1``),
+which watches the same two hazard classes on live lock traffic, the way
+C5 cross-checks the planner.
+
+Like R1-R7 the rules approximate in the direction of silence: an
+expression the model cannot name is not an acquisition, a call it cannot
+classify is not blocking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from keystone_tpu.analysis.engine import (
+    Finding,
+    LintResult,
+    ModuleInfo,
+    PragmaSite,
+    ancestors,
+    apply_baseline,
+    apply_pragmas,
+    call_name,
+    discover_files,
+    load_baseline,
+    save_baseline,
+)
+from keystone_tpu.analysis.lockgraph import (
+    PROCESS_SPAWNS,
+    LockGraph,
+    LockModel,
+    build_graph,
+    build_models,
+)
+from keystone_tpu.analysis.reporters import render_json, render_text
+
+#: rule ids this engine executes (stale-pragma scoping, bare-pragma docs)
+ALL_RACE_RULES = ("T1", "T2", "T3", "T4", "T5")
+
+DEFAULT_RACE_BASELINE = "race_baseline.json"
+
+
+def _short(key: str) -> str:
+    """`serve/front.py::FrontClient._lock` -> `FrontClient._lock`."""
+    return key.split("::", 1)[-1]
+
+
+def held_keys(node: ast.AST, model: LockModel) -> List[str]:
+    """Lock keys of every ``with``-ancestor of ``node`` inside its own
+    function (innermost first) — lexical holding, the same approximation
+    as ``engine.under_lock`` but with identities."""
+    keys: List[str] = []
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            break
+        if isinstance(a, ast.With):
+            for item in a.items:
+                k = model.lock_key(item.context_expr)
+                if k:
+                    keys.append(k)
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# T1: lock-order inversion
+# ---------------------------------------------------------------------------
+
+class LockOrderInversion:
+    id = "T1"
+    title = "lock-order-inversion"
+
+    def run(self, models: Dict[str, LockModel],
+            graph: LockGraph) -> List[Finding]:
+        out: List[Finding] = []
+        for a, b, (path, line, col) in graph.inversions():
+            pair = sorted((a, b))
+            out.append(Finding(
+                rule=self.id, path=path, line=line, col=col,
+                message=(
+                    f"lock-order inversion: `{_short(a)}` -> `{_short(b)}` "
+                    f"here, but another site orders `{_short(b)}` -> "
+                    f"`{_short(a)}` — two threads interleaving these "
+                    f"deadlock"
+                ),
+                hint="pick one global order for the pair and re-nest the "
+                     "minority site (or drop to a single lock)",
+                symbol=f"{_short(pair[0])}<->{_short(pair[1])}",
+            ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# T2: blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+#: method tails that block indefinitely by default
+_SOCKET_TAILS = ("recv", "recv_into", "accept", "connect", "sendall")
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _is_false(node: Optional[ast.expr]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+def classify_blocking(
+    call: ast.Call, model: LockModel, held: Sequence[str]
+) -> Optional[str]:
+    """The blocking-call tail when ``call`` can block indefinitely while
+    a lock is held, else None.  Bounded waits (``timeout=``) and waits on
+    the held condition itself (released for the wait) are exempt."""
+    name = call_name(call) or ""
+    if not name:
+        return None
+    tail = name.split(".")[-1]
+    recv_key = None
+    if isinstance(call.func, ast.Attribute):
+        recv_key = model.lock_key(call.func.value)
+    timeout = _kw(call, "timeout")
+    if tail == "sleep" and (name == "time.sleep" or "." not in name):
+        return tail
+    if tail in ("block_until_ready", "device_put"):
+        return tail
+    if tail in _SOCKET_TAILS and isinstance(call.func, ast.Attribute):
+        return tail
+    if tail == "put":
+        if _is_false(_kw(call, "block")) or timeout is not None:
+            return None
+        return tail
+    if tail == "get":
+        # queue.get() is zero-arg; dict.get(key[, default]) never is
+        if call.args or timeout is not None \
+                or _is_false(_kw(call, "block")):
+            return None
+        return tail if isinstance(call.func, ast.Attribute) else None
+    if tail == "join":
+        if name.startswith(("os.path.", "posixpath.", "ntpath.")):
+            return None
+        if isinstance(call.func, ast.Attribute) and isinstance(
+            call.func.value, ast.Constant
+        ):
+            return None  # "sep".join(...)
+        if call.args or timeout is not None:
+            return None  # join(timeout) is bounded; join(iterable) is str
+        return tail if isinstance(call.func, ast.Attribute) else None
+    if tail == "wait":
+        if recv_key is not None and recv_key in held:
+            return None  # Condition.wait releases the held condition
+        if timeout is not None or call.args:
+            return None
+        return tail if isinstance(call.func, ast.Attribute) else None
+    if tail == "acquire":
+        if recv_key is not None and recv_key in held:
+            return None
+        if timeout is not None or _is_false(_kw(call, "blocking")):
+            return None
+        if call.args:  # acquire(False) / acquire(True, t)
+            return None
+        return tail if isinstance(call.func, ast.Attribute) else None
+    if tail == "result":
+        if call.args or timeout is not None:
+            return None
+        return tail if isinstance(call.func, ast.Attribute) else None
+    return None
+
+
+class BlockingUnderLock:
+    id = "T2"
+    title = "blocking-under-lock"
+
+    def run(self, models: Dict[str, LockModel],
+            graph: LockGraph) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, model in models.items():
+            for node in ast.walk(model.mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                held = held_keys(node, model)
+                if not held:
+                    continue
+                tail = classify_blocking(node, model, held)
+                if tail is None:
+                    continue
+                a = held[0]
+                out.append(Finding(
+                    rule=self.id, path=rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"blocking `{call_name(node)}` while holding "
+                        f"`{_short(a)}` — every other user of the lock "
+                        f"stalls behind this wait (the PR-15 "
+                        f"`_claim_slot` deadlock class)"
+                    ),
+                    hint="move the wait outside the guarded span, or "
+                         "poll with a short timeout and re-check state "
+                         "under the lock",
+                    symbol=f"{_short(a)}->{tail}",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# T3: unguarded shared state (generalizes + subsumes lint R5)
+# ---------------------------------------------------------------------------
+
+def _shared_state_rule(concurrent_rels: Set[str]):
+    """R5's detector, repo-wide: same container tracking and mutation
+    set, scope widened from the hand-kept hot list to every module with a
+    thread/process/atexit entry point or a module-level lock."""
+    from keystone_tpu.analysis.rules import SharedStateLock
+
+    class SharedStateAnywhere(SharedStateLock):
+        id = "T3"
+        title = "unguarded-shared-state"
+
+        def _in_scope(self, rel: str) -> bool:
+            norm = rel.replace(os.sep, "/")
+            return norm in concurrent_rels or super()._in_scope(rel)
+
+    return SharedStateAnywhere()
+
+
+# ---------------------------------------------------------------------------
+# T4: fork/spawn while locked + non-daemon never-joined threads
+# ---------------------------------------------------------------------------
+
+class ThreadLifecycle:
+    id = "T4"
+    title = "thread-lifecycle"
+
+    def run(self, models: Dict[str, LockModel],
+            graph: LockGraph) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, model in models.items():
+            for node in ast.walk(model.mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node) or ""
+                if name not in PROCESS_SPAWNS \
+                        and name.split(".")[-1] != "Popen":
+                    continue
+                held = held_keys(node, model)
+                if not held:
+                    continue
+                out.append(Finding(
+                    rule=self.id, path=rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"`{name}` while holding `{_short(held[0])}` — "
+                        f"the child inherits a copy of the locked mutex "
+                        f"state (fork) and the spawn latency serializes "
+                        f"every other holder"
+                    ),
+                    hint="snapshot what the spawn needs under the lock, "
+                         "then spawn outside it",
+                    symbol=f"{_short(held[0])}->spawn",
+                ))
+            for t in model.threads:
+                if t.daemon is True or t.daemon_set_later or t.joined:
+                    continue
+                out.append(Finding(
+                    rule=self.id, path=rel, line=t.line, col=t.col,
+                    message=(
+                        "non-daemon thread is never joined — interpreter "
+                        "shutdown blocks on it (atexit shard writers "
+                        "hang behind a stuck worker)"
+                    ),
+                    hint="pass daemon=True, or keep the handle and join "
+                         "it on the owner's close()",
+                    symbol=f"thread@{t.var or 'unbound'}",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# T5: persisted-JSON read-merge-replace outside a flock window
+# ---------------------------------------------------------------------------
+
+class UnlockedReadMergeReplace:
+    id = "T5"
+    title = "unlocked-read-merge-replace"
+
+    _READS = ("json.load", "json.loads")
+    _REPLACES = ("os.replace", "os.rename", "shutil.move")
+
+    def run(self, models: Dict[str, LockModel],
+            graph: LockGraph) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, model in models.items():
+            for func in model.funcs.values():
+                reads = replaces = flocked = False
+                first: Optional[ast.Call] = None
+                for node in ast.walk(func):
+                    if isinstance(node, ast.Call):
+                        name = call_name(node) or ""
+                        if name in self._READS:
+                            reads = True
+                        if name in self._REPLACES:
+                            replaces = True
+                            first = first or node
+                        if "flock" in name or "lockf" in name:
+                            flocked = True
+                    elif isinstance(node, ast.Attribute) \
+                            and node.attr in ("flock", "lockf", "LOCK_EX"):
+                        flocked = True
+                if reads and replaces and not flocked:
+                    anchor = first or func
+                    out.append(Finding(
+                        rule=self.id, path=rel, line=anchor.lineno,
+                        col=anchor.col_offset,
+                        message=(
+                            f"`{getattr(func, 'name', '?')}` "
+                            f"read-merge-replaces persisted JSON with no "
+                            f"flock sidecar — two processes interleaving "
+                            f"lose one writer's merge"
+                        ),
+                        hint="take `fcntl.flock(<path>.lock, LOCK_EX)` "
+                             "around the fresh read + merge + os.replace "
+                             "(the autotune.record shape)",
+                        symbol=getattr(func, "name", "?"),
+                    ))
+        return out
+
+
+def race_rules(concurrent_rels: Set[str]) -> List:
+    return [
+        LockOrderInversion(),
+        BlockingUnderLock(),
+        _shared_state_rule(concurrent_rels),
+        ThreadLifecycle(),
+        UnlockedReadMergeReplace(),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+class RaceEngine:
+    """LintEngine's loop with the lockgraph model threaded through the
+    rules and one addition: a ``# lint: disable=R5`` pragma also
+    suppresses T3 at its site (T3 subsumes R5 — existing justifications
+    carry over without a rewrite), while an R5-only pragma that
+    suppresses nothing here is *lint's* stale-pragma business, not
+    ours."""
+
+    def __init__(self, root: str, paths: Optional[Sequence[str]] = None):
+        self.root = os.path.abspath(root)
+        self.paths = list(paths) if paths else ["keystone_tpu"]
+
+    def run(self) -> LintResult:
+        result = LintResult()
+        modules: Dict[str, ModuleInfo] = {}
+        for path in discover_files(self.root, self.paths):
+            rel = os.path.relpath(path, self.root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                modules[rel] = ModuleInfo(path, rel, source)
+            except (OSError, SyntaxError, ValueError) as e:
+                result.errors.append(f"{rel}: {type(e).__name__}: {e}")
+        result.files = len(modules)
+
+        models = build_models(modules)
+        graph = build_graph(models.values())
+        concurrent_rels = {
+            m.rel for m in models.values()
+            if m.entries or any(
+                d.module_level for d in m.lock_defs.values()
+            )
+        }
+
+        raw: List[Finding] = []
+        from keystone_tpu.analysis.engine import LintContext
+
+        ctx = LintContext(self.root, modules)
+        for rule in race_rules(concurrent_rels):
+            if rule.id == "T3":
+                raw.extend(rule.run(ctx))     # R5-shaped rule: ctx API
+            else:
+                raw.extend(rule.run(models, graph))
+
+        # Pragma maps with the R5 -> T3 alias folded in.
+        site_maps: Dict[str, List[PragmaSite]] = {}
+        pragma_maps: Dict[str, Dict[int, Set[str]]] = {}
+        for rel, mod in modules.items():
+            sites = []
+            for s in mod.pragma_sites:
+                rules_set = set(s.rules)
+                if "R5" in rules_set:
+                    rules_set = rules_set | {"T3"}
+                sites.append(PragmaSite(
+                    line=s.line, rules=rules_set, covered=set(s.covered),
+                ))
+            site_maps[rel] = sites
+            pm: Dict[int, Set[str]] = {}
+            for s in sites:
+                for line in s.covered:
+                    pm.setdefault(line, set()).update(s.rules)
+            pragma_maps[rel] = pm
+
+        kept, result.suppressed, credited = apply_pragmas(
+            raw, pragma_maps, site_maps
+        )
+        # Stale pragmas scoped to the T family: judge by the ORIGINAL rule
+        # ids (an R5-only pragma belongs to lint even though we honor it).
+        executed = set(ALL_RACE_RULES)
+        for rel, mod in modules.items():
+            for site in mod.pragma_sites:
+                if (rel, site.line) in credited:
+                    continue
+                ids = site.rules - {"*"}
+                if ids and not ids & executed:
+                    continue
+                if not ids:
+                    continue  # bare disables are lint's to police
+                result.stale_pragmas.append(
+                    (rel, site.line, ",".join(sorted(site.rules)))
+                )
+        result.stale_pragmas.sort()
+        result.findings = sorted(
+            kept, key=lambda f: (f.path, f.line, f.col, f.rule)
+        )
+        return result
+
+
+def run_race(
+    root: str,
+    paths: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+) -> LintResult:
+    """One-call entry point: scan and fold in the ratcheted baseline."""
+    result = RaceEngine(root, paths).run()
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+        new, known, stale = apply_baseline(result.findings, baseline)
+        result.findings = new
+        result.baselined = known
+        result.stale = stale
+    return result
+
+
+# ---------------------------------------------------------------------------
+# CLI: ``keystone-tpu race`` — lint's exact exit contract (0/1/2)
+# ---------------------------------------------------------------------------
+
+def default_paths(root: str) -> List[str]:
+    out = [
+        p for p in ("keystone_tpu", "bench.py", "scripts")
+        if os.path.exists(os.path.join(root, p))
+    ]
+    return out or ["."]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="keystone-tpu race",
+        description="Lock-discipline static analysis (rules T1-T5) over "
+                    "the concurrent tier; fails only on findings not in "
+                    "the ratcheted race_baseline.json.",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: keystone_tpu, "
+                         "bench.py, scripts)")
+    ap.add_argument("--root", default=".",
+                    help="repo root for relative paths + baseline")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/"
+                         f"{DEFAULT_RACE_BASELINE} when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report and fail on every "
+                         "finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "and exit 0 (the ratchet reset)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also list baselined (non-failing) findings")
+    ap.add_argument("--show-stale-pragmas", action="store_true",
+                    help="list pragmas that suppressed zero findings "
+                         "this run")
+    ap.add_argument("--no-hints", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    root = os.path.abspath(args.root)
+    paths = args.paths or default_paths(root)
+    baseline_path = args.baseline or os.path.join(
+        root, DEFAULT_RACE_BASELINE
+    )
+    use_baseline = not args.no_baseline and (
+        args.baseline is not None or os.path.exists(baseline_path)
+    )
+
+    if args.update_baseline:
+        result = RaceEngine(root, paths).run()
+        old = load_baseline(baseline_path)
+        # Stale fingerprints are pruned so the ratchet only tightens —
+        # except debt of still-existing files outside this run's path
+        # subset, which a partial run must not silently drop.
+        scanned = {
+            os.path.relpath(p, root) for p in discover_files(root, paths)
+        }
+        keep = {
+            fp: n for fp, n in old.items()
+            if fp.split("::", 1)[0] not in scanned
+            and os.path.exists(os.path.join(root, fp.split("::", 1)[0]))
+        }
+        save_baseline(baseline_path, result.findings, tool="race",
+                      keep=keep)
+        pruned = (
+            set(old) - {f.fingerprint for f in result.findings} - set(keep)
+        )
+        kept_note = f", {len(keep)} out-of-scope kept" if keep else ""
+        print(
+            f"keystone-race: baselined {len(result.findings)} findings "
+            f"({result.suppressed} pragma-suppressed, {len(pruned)} stale "
+            f"fingerprint(s) pruned{kept_note}) -> {baseline_path}"
+        )
+        return 0
+
+    result = run_race(
+        root, paths,
+        baseline_path=baseline_path if use_baseline else None,
+    )
+    if args.format == "json":
+        sys.stdout.write(render_json(result))
+    else:
+        print(render_text(
+            result,
+            show_baselined=args.show_baselined,
+            hints=not args.no_hints,
+            show_stale_pragmas=args.show_stale_pragmas,
+            label="keystone-race",
+        ))
+    if result.errors:
+        return 2
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

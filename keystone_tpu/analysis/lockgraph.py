@@ -1,0 +1,457 @@
+"""Lock-acquisition model for keystone-race (``concurrency.py``).
+
+The concurrent tier (gateway dispatch threads, fleet worker processes, the
+ingest decode ring, telemetry atexit shard writers, flock-sidecar'd
+persisted caches) is held together by ~20 locks whose discipline was
+policed only by review — and PR 15's review caught a real deadlock
+(`_claim_slot` blocking on the buffer ring *inside* the claim lock) that
+no test ever would have.  This module turns the source into the model the
+T-rules need:
+
+- :class:`LockModel` — one pass over a parsed tree collecting every lock
+  **identity** (name-based: ``module::CLASS.attr`` / ``module::NAME`` /
+  ``module::state[key]``), every ``with <lock>:`` span, every
+  thread/process/atexit **entry point**, and every ``Thread(...)``
+  creation with its daemon/join story.
+- :func:`build_graph` — the directed **acquisition graph**: an edge
+  ``A -> B`` when some span acquires ``B`` (lexically, or via a
+  depth-limited walk into module-local calls) while ``A`` is held.  A
+  cycle in this graph is a lock-order inversion (rule T1).
+
+Identity is deliberately *name-based*, not alias-analysis: two sites
+spelling ``self._lock`` inside the same class are the same lock, a lock
+threaded through a ``state`` dict keeps its key string.  Like R1-R5 the
+model approximates in the direction of silence — an expression it cannot
+name is not an acquisition, not a false edge.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from keystone_tpu.analysis.engine import (
+    ModuleInfo,
+    ancestors,
+    call_name,
+    dotted,
+)
+
+#: substrings that mark a name as a lock-like synchronization object —
+#: the same approximation ``engine.under_lock`` uses, widened to the
+#: Condition/Semaphore spellings the serve tier actually uses.
+LOCKISH_RE = re.compile(r"lock|cond|mutex|sem", re.IGNORECASE)
+
+#: dotted-name tails that construct a lock object
+LOCK_FACTORIES = (
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+)
+
+#: dotted-name tails that start an OS process (fork-while-locked, T4)
+PROCESS_SPAWNS = (
+    "subprocess.Popen", "subprocess.run", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.call", "os.fork",
+    "multiprocessing.Process", "Popen",
+)
+
+
+def lockish(name: Optional[str]) -> bool:
+    return bool(name) and bool(LOCKISH_RE.search(name))
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    for a in ancestors(node):
+        if isinstance(a, ast.ClassDef):
+            return a
+    return None
+
+
+def enclosing_funcdef(node: ast.AST) -> Optional[ast.AST]:
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+@dataclass(frozen=True)
+class LockDef:
+    """A ``threading.Lock()``-family creation site."""
+
+    key: str
+    kind: str          # Lock | RLock | Condition | Semaphore | ...
+    path: str
+    line: int
+    module_level: bool
+
+
+@dataclass(frozen=True)
+class EntryPoint:
+    """A place execution escapes the current thread: ``Thread(target=f)``,
+    ``atexit.register(f)``, a process spawn, or a pool submit."""
+
+    kind: str          # thread | atexit | process
+    path: str
+    line: int
+    target: str = ""   # dotted target when resolvable
+
+
+@dataclass
+class ThreadCreation:
+    """One ``threading.Thread(...)`` call with its lifecycle facts — the
+    T4 non-daemon-never-joined input."""
+
+    path: str
+    line: int
+    col: int
+    daemon: Optional[bool]      # None = not set at construction
+    var: str = ""               # name it was bound to ("" = unbound)
+    joined: bool = False        # a `.join(` on the bound name exists
+    daemon_set_later: bool = False
+    node: Optional[ast.Call] = None
+
+
+@dataclass
+class WithSpan:
+    """One ``with <lock>:`` (or multi-item) acquisition span."""
+
+    key: str
+    node: ast.With
+    path: str
+    line: int
+    col: int
+
+
+class LockModel:
+    """Per-module lock model; :func:`build_model` pools them."""
+
+    def __init__(self, rel: str, mod: ModuleInfo):
+        self.rel = rel.replace(os.sep, "/")
+        self.mod = mod
+        self.lock_defs: Dict[str, LockDef] = {}
+        self.spans: List[WithSpan] = []
+        self.entries: List[EntryPoint] = []
+        self.threads: List[ThreadCreation] = []
+        #: (owner_class_or_"" , func_name) -> FunctionDef
+        self.funcs: Dict[Tuple[str, str], ast.AST] = {}
+        self._closure_memo: Dict[Tuple[str, str], Set[str]] = {}
+        self._collect()
+
+    # -- lock identity ------------------------------------------------------
+
+    def lock_key(self, expr: ast.AST) -> Optional[str]:
+        """Name-based identity for a lock expression, or None when the
+        expression is not nameable / not lock-like."""
+        if isinstance(expr, ast.Subscript):
+            base = dotted(expr.value)
+            sl = expr.slice
+            if base is not None and isinstance(sl, ast.Constant) \
+                    and isinstance(sl.value, str) and lockish(sl.value):
+                return f"{self.rel}::{self._scope_name(base, expr)}[{sl.value}]"
+            return None
+        name = dotted(expr)
+        if name is None or not lockish(name.split(".")[-1]):
+            return None
+        return f"{self.rel}::{self._scope_name(name, expr)}"
+
+    def _scope_name(self, name: str, node: ast.AST) -> str:
+        """``self.X`` / ``cls.X`` -> ``Class.X`` (same spelling from any
+        method); everything else keeps its dotted spelling."""
+        parts = name.split(".")
+        if parts[0] in ("self", "cls"):
+            cls = enclosing_class(node)
+            owner = cls.name if cls is not None else "self"
+            return ".".join([owner] + parts[1:])
+        return name
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect(self) -> None:
+        tree = self.mod.tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls = enclosing_class(node)
+                self.funcs[(cls.name if cls else "", node.name)] = node
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    key = self.lock_key(item.context_expr)
+                    if key is not None:
+                        self.spans.append(WithSpan(
+                            key=key, node=node, path=self.rel,
+                            line=node.lineno, col=node.col_offset,
+                        ))
+            elif isinstance(node, ast.Call):
+                self._collect_call(node)
+        self._resolve_thread_lifecycles()
+
+    def _collect_call(self, node: ast.Call) -> None:
+        name = call_name(node) or ""
+        tail = name.split(".")[-1]
+        if tail in LOCK_FACTORIES and (
+            name.startswith("threading.") or name == tail
+        ):
+            key = self._def_key(node)
+            if key is not None:
+                self.lock_defs[key] = LockDef(
+                    key=key, kind=tail, path=self.rel, line=node.lineno,
+                    module_level=enclosing_funcdef(node) is None
+                    and enclosing_class(node) is None,
+                )
+        if tail == "Thread" and (
+            name.startswith("threading.") or name == tail
+        ):
+            daemon: Optional[bool] = None
+            for kw in node.keywords:
+                if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                    daemon = bool(kw.value.value)
+                if kw.arg == "target":
+                    tgt = dotted(kw.value) or ""
+                    self.entries.append(EntryPoint(
+                        kind="thread", path=self.rel, line=node.lineno,
+                        target=self._scope_name(tgt, node) if tgt else "",
+                    ))
+            self.threads.append(ThreadCreation(
+                path=self.rel, line=node.lineno, col=node.col_offset,
+                daemon=daemon, var=self._bound_name(node), node=node,
+            ))
+        if name in ("atexit.register",) and node.args:
+            tgt = dotted(node.args[0]) or ""
+            self.entries.append(EntryPoint(
+                kind="atexit", path=self.rel, line=node.lineno,
+                target=self._scope_name(tgt, node) if tgt else "",
+            ))
+        if name in PROCESS_SPAWNS or tail == "Popen":
+            self.entries.append(EntryPoint(
+                kind="process", path=self.rel, line=node.lineno,
+                target=name,
+            ))
+
+    def _def_key(self, node: ast.Call) -> Optional[str]:
+        """Key for the target a lock-factory call is assigned to."""
+        p = getattr(node, "_lint_parent", None)
+        # threading.Condition(threading.Lock()) — credit the outer target
+        while isinstance(p, ast.Call):
+            p = getattr(p, "_lint_parent", None)
+        if isinstance(p, ast.Assign) and len(p.targets) == 1:
+            return self.lock_key(p.targets[0]) or self._forced_key(
+                p.targets[0]
+            )
+        if isinstance(p, ast.AnnAssign):
+            return self.lock_key(p.target) or self._forced_key(p.target)
+        if isinstance(p, ast.keyword) or isinstance(p, ast.Dict):
+            # dict value: state = {"tar_lock": threading.Lock()}
+            if isinstance(p, ast.Dict):
+                for k, v in zip(p.keys, p.values):
+                    if v is node and isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        gp = getattr(p, "_lint_parent", None)
+                        base = ""
+                        if isinstance(gp, ast.Assign) and len(gp.targets) == 1:
+                            base = dotted(gp.targets[0]) or ""
+                        return (
+                            f"{self.rel}::"
+                            f"{self._scope_name(base, node)}[{k.value}]"
+                        )
+        return None
+
+    def _forced_key(self, target: ast.AST) -> Optional[str]:
+        """A lock assigned to a non-lockish name still gets an identity —
+        the definition IS the evidence it's a lock."""
+        name = dotted(target)
+        if name is None:
+            return None
+        return f"{self.rel}::{self._scope_name(name, target)}"
+
+    def _bound_name(self, node: ast.Call) -> str:
+        p = getattr(node, "_lint_parent", None)
+        if isinstance(p, ast.Assign) and len(p.targets) == 1:
+            return dotted(p.targets[0]) or ""
+        if isinstance(p, ast.AnnAssign):
+            return dotted(p.target) or ""
+        return ""
+
+    def _resolve_thread_lifecycles(self) -> None:
+        """Mark created threads joined / daemon-set-later by a textual
+        scan for ``<var>.join(`` / ``<var>.daemon = True`` — coarse, but
+        approximate in the direction of silence."""
+        src = self.mod.source
+        for t in self.threads:
+            if not t.var:
+                # comprehension-built pools: `ts = [Thread(...) for ...]`
+                # joined via `for x in ts: x.join(...)`
+                pool = self._comprehension_pool(t)
+                if pool:
+                    m = re.search(
+                        rf"for\s+(\w+)\s+in\s+{re.escape(pool)}\b", src
+                    )
+                    if m and re.search(
+                        rf"\b{m.group(1)}\s*\.\s*join\s*\(", src
+                    ):
+                        t.joined = True
+                    if re.search(rf"\b{re.escape(pool)}\b.*daemon=True",
+                                 src):
+                        t.daemon_set_later = True
+                continue
+            tails = {t.var, t.var.split(".")[-1]}
+            for v in tails:
+                if re.search(rf"\b{re.escape(v)}\s*\.\s*join\s*\(", src):
+                    t.joined = True
+                if re.search(
+                    rf"\b{re.escape(v)}\s*\.\s*daemon\s*=\s*True", src
+                ):
+                    t.daemon_set_later = True
+            # pooled via `container.append(t)` and joined by iterating
+            # the container — credit the module that does both.
+            if not t.joined and re.search(
+                rf"\b(append|add)\s*\(\s*{re.escape(t.var.split('.')[-1])}"
+                rf"\s*[,)]", src
+            ) and re.search(r"\.\s*join\s*\(", src):
+                t.joined = True
+
+    def _comprehension_pool(self, t: ThreadCreation) -> str:
+        """Name the comprehension result a bare ``Thread(...)`` lands in
+        (``ts = [Thread(...) for ...]``), or ''."""
+        if t.node is None:
+            return ""
+        comp = None
+        for a in ancestors(t.node):
+            if isinstance(a, (ast.ListComp, ast.SetComp,
+                              ast.GeneratorExp)):
+                comp = a
+            elif comp is not None and isinstance(a, ast.Assign) \
+                    and len(a.targets) == 1:
+                name = dotted(a.targets[0])
+                return (name or "").split(".")[-1]
+            elif comp is not None and not isinstance(a, (ast.ListComp,
+                                                         ast.SetComp)):
+                break
+        return ""
+
+    # -- lock closure / graph ----------------------------------------------
+
+    def resolve_call(self, call: ast.Call) -> Optional[ast.AST]:
+        """Module-local callee of ``call``: bare names hit module
+        functions, ``self.m``/``cls.m`` hit methods of the call site's
+        class, ``C.m`` hits class C's method."""
+        name = call_name(call)
+        if not name:
+            return None
+        parts = name.split(".")
+        if len(parts) == 1:
+            return self.funcs.get(("", parts[0]))
+        if len(parts) == 2:
+            owner, meth = parts
+            if owner in ("self", "cls"):
+                cls = enclosing_class(call)
+                if cls is not None:
+                    return self.funcs.get((cls.name, meth))
+                return None
+            return self.funcs.get((owner, meth))
+        return None
+
+    def func_lock_closure(self, func: ast.AST, _depth: int = 0,
+                          _seen: Optional[Set[int]] = None) -> Set[str]:
+        """Every lock key ``func`` may acquire: its own lexical with-spans
+        plus (depth-limited) those of module-local callees."""
+        cls = enclosing_class(func)
+        memo_key = (cls.name if cls else "", getattr(func, "name", ""))
+        if _depth == 0 and memo_key in self._closure_memo:
+            return self._closure_memo[memo_key]
+        seen = _seen if _seen is not None else set()
+        if id(func) in seen or _depth > 4:
+            return set()
+        seen.add(id(func))
+        out: Set[str] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.With) and node is not func:
+                if enclosing_funcdef(node) is not func:
+                    continue  # nested def's spans are not ours
+                for item in node.items:
+                    key = self.lock_key(item.context_expr)
+                    if key:
+                        out.add(key)
+            elif isinstance(node, ast.Call) \
+                    and enclosing_funcdef(node) is func:
+                callee = self.resolve_call(node)
+                if callee is not None:
+                    out |= self.func_lock_closure(
+                        callee, _depth + 1, seen
+                    )
+        if _depth == 0:
+            self._closure_memo[memo_key] = out
+        return out
+
+
+def build_models(
+    modules: Dict[str, ModuleInfo]
+) -> Dict[str, LockModel]:
+    return {rel: LockModel(rel, mod) for rel, mod in modules.items()}
+
+
+@dataclass
+class LockGraph:
+    """The pooled acquisition graph: ``edges[(A, B)]`` = first site where
+    ``B`` was acquired while ``A`` was held."""
+
+    edges: Dict[Tuple[str, str], Tuple[str, int, int]] = field(
+        default_factory=dict
+    )
+
+    def add(self, a: str, b: str, path: str, line: int, col: int) -> None:
+        if a != b and (a, b) not in self.edges:
+            self.edges[(a, b)] = (path, line, col)
+
+    def reachable(self, src: str, dst: str) -> bool:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        stack, seen = [src], set()
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(adj.get(cur, ()))
+        return False
+
+    def inversions(self) -> List[Tuple[str, str, Tuple[str, int, int]]]:
+        """Unordered lock pairs on a cycle, anchored at the reporting
+        edge's site — each pair reported once."""
+        out = []
+        seen_pairs: Set[Tuple[str, str]] = set()
+        for (a, b), site in sorted(self.edges.items()):
+            pair = tuple(sorted((a, b)))
+            if pair in seen_pairs:
+                continue
+            if self.reachable(b, a):
+                seen_pairs.add(pair)  # type: ignore[arg-type]
+                out.append((a, b, site))
+        return out
+
+
+def build_graph(models: Iterable[LockModel]) -> LockGraph:
+    """Acquisition edges across every module: for each ``with A:`` span,
+    every lock acquired in its body — by a lexically nested ``with`` or
+    by a module-local callee — is an ``A -> B`` edge."""
+    graph = LockGraph()
+    for model in models:
+        for span in model.spans:
+            a = span.key
+            for node in ast.walk(span.node):
+                if isinstance(node, ast.With) and node is not span.node:
+                    for item in node.items:
+                        b = model.lock_key(item.context_expr)
+                        if b:
+                            graph.add(a, b, model.rel, node.lineno,
+                                      node.col_offset)
+                elif isinstance(node, ast.Call):
+                    callee = model.resolve_call(node)
+                    if callee is not None:
+                        for b in model.func_lock_closure(callee):
+                            graph.add(a, b, model.rel, node.lineno,
+                                      node.col_offset)
+    return graph

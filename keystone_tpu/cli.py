@@ -158,6 +158,16 @@ def main(argv=None) -> int:
         from keystone_tpu.analysis.check import main as check_main
 
         return check_main(argv[1:])
+    if argv and argv[0] == "race":
+        # ``keystone-tpu race [paths]``: the lock-discipline static
+        # analysis (keystone_tpu/analysis/concurrency.py) — models every
+        # lock creation, ``with <lock>:`` span and thread/atexit entry
+        # point into an acquisition graph and runs rules T1-T5; exits
+        # non-zero only for findings not in the ratcheted
+        # race_baseline.json. No jax import needed.
+        from keystone_tpu.analysis.concurrency import main as race_main
+
+        return race_main(argv[1:])
     if argv and argv[0] == "plan":
         # ``keystone-tpu plan <target>``: the cost-based whole-pipeline
         # planner's decision table (core/plan.py) — cache tiers, fused
@@ -180,6 +190,7 @@ def main(argv=None) -> int:
             "[--update-baseline]\n"
             "       run-pipeline check [--target PIPELINE] [--list] "
             "[--update-baseline]\n"
+            "       run-pipeline race [paths] [--update-baseline]\n"
             "       run-pipeline plan <toy|imagenet|voc> [--mode M] "
             "[--budget-mb N] [--json PATH]\n\n"
             f"pipelines:\n  {names}"

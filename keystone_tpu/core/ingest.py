@@ -59,6 +59,7 @@ import numpy as np
 
 from keystone_tpu.core.pipeline import FunctionNode
 from keystone_tpu.utils import knobs
+from keystone_tpu.utils.lockwitness import register_lock
 from keystone_tpu.utils.logging import get_logger
 
 logger = get_logger("keystone_tpu.core.ingest")
@@ -111,7 +112,7 @@ class HostBufferRing:
         self._free: queue_mod.Queue = queue_mod.Queue()
         for i in range(num_buffers):
             self._free.put(i)
-        self._lock = threading.Lock()
+        self._lock = register_lock(threading.Lock(), "ingest.ring")
         self._live = 0
         self.live_peak = 0
 
@@ -418,8 +419,8 @@ class StreamingTarIngest:
 
         state = {
             "stop": threading.Event(),
-            "tar_lock": threading.Lock(),
-            "claim_lock": threading.Lock(),
+            "tar_lock": register_lock(threading.Lock(), "ingest.tar"),
+            "claim_lock": register_lock(threading.Lock(), "ingest.claim"),
             "pending_tars": deque(range(len(self.tar_paths))),
             "cur": None,
             "ready": queue_mod.Queue(),

@@ -70,11 +70,12 @@ import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
 from keystone_tpu.utils import knobs
+from keystone_tpu.utils.lockwitness import register_lock
 
 _VERSION = 1
 # RLock: record() calls _warn_once() (which takes the lock for the
 # warned-set) while already holding it for the cache mutation.
-_LOCK = threading.RLock()
+_LOCK = register_lock(threading.RLock(), "autotune.cache")
 # In-memory mirror of the cache file, keyed by the path it was loaded from
 # so tests that repoint KEYSTONE_AUTOTUNE_CACHE get a fresh load.
 _MEM: Optional[Dict[str, Any]] = None

@@ -41,6 +41,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from keystone_tpu.serve.front import FrontClient, FrontError
+from keystone_tpu.utils.lockwitness import register_lock
 
 __all__ = ["Fleet", "FleetDown"]
 
@@ -104,7 +105,7 @@ class Fleet:
             self._worker_args += ["--hbm-mb", str(hbm_mb)]
         self._extra_env = dict(env or {})
         self._faults = dict(faults or {})
-        self._lock = threading.Lock()
+        self._lock = register_lock(threading.Lock(), "serve.fleet")
         self.replicas: List[_Replica] = [
             self._spawn(i) for i in range(n)
         ]

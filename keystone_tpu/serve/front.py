@@ -48,6 +48,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from keystone_tpu.utils.lockwitness import register_lock
+
 __all__ = [
     "BatchingFront", "FrontClient", "FrontError", "drive_main",
     "mint_trace_id",
@@ -97,6 +99,9 @@ def _send_msg(sock: socket.socket, obj: Any, lock=None) -> None:
     frame = _LEN.pack(len(payload)) + payload
     if lock is not None:
         with lock:
+            # lint: disable=T2 (the lock exists to serialize whole frames
+            # onto one socket — sendall under it IS the framing contract;
+            # a stalled peer stalls only this connection's writers)
             sock.sendall(frame)
     else:
         sock.sendall(frame)
@@ -137,7 +142,7 @@ class BatchingFront:
         self._result_timeout_s = float(result_timeout_s)
         self._closing = False
         self._conns: List[socket.socket] = []
-        self._lock = threading.Lock()
+        self._lock = register_lock(threading.Lock(), "serve.front.batching")
         try:
             os.unlink(self.path)
         except OSError:
@@ -303,7 +308,7 @@ class FrontClient:
     def __init__(self, path: str, timeout_s: float = 30.0):
         self.path = path
         self._timeout_s = float(timeout_s)
-        self._lock = threading.Lock()
+        self._lock = register_lock(threading.Lock(), "serve.front.client")
         self._next_id = 0
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.settimeout(self._timeout_s)

@@ -551,6 +551,15 @@ declare("KEYSTONE_TRACE_SAMPLE", "float", 0.0,
         "zero-overhead off — the admission fast path is one dict lookup "
         "and the compiled serve programs are byte-identical.",
         validator=_unit_fraction)
+declare("KEYSTONE_LOCK_WITNESS", "bool", False,
+        "Runtime lock-witness sanitizer (utils/lockwitness.py): wrap the "
+        "registered serve/ingest/autotune locks in an order-recording "
+        "witness — per-thread acquisition stacks detect lock-order "
+        "inversions and held-while-blocking waits at runtime (counted "
+        "into telemetry as witness.* and listed by "
+        "lockwitness.events()), the live complement of `keystone-tpu "
+        "race`. 0/unset = zero overhead: register_lock() returns the "
+        "bare threading lock unchanged (no wrapping, pinned by test).")
 
 # ---------------------------------------------------------------------------
 # BENCH_* declarations (bench.py / scripts/bench_regime.py sections)
@@ -615,6 +624,10 @@ declare("BENCH_CHECK", "bool", True,
         "Pipeline-contract section: run `keystone-tpu check` over the "
         "registered pipeline targets and record check_findings_total/"
         "check_new (budget-gated; exhaustion emits check_skipped).")
+declare("BENCH_RACE", "bool", True,
+        "Lock-discipline section: run `keystone-tpu race` (rules T1-T5) "
+        "over the package and record race_findings_total/race_new/"
+        "race_suppressed (budget-gated; exhaustion emits race_skipped).")
 declare("BENCH_PRECISION", "bool", True,
         "Precision-tier section: bf16-vs-f32 gram + sketch rungs, each "
         "speed key paired with a *_vs_f32_error_delta key (budget-gated; "

@@ -1,0 +1,225 @@
+"""Runtime lock-witness sanitizer (``KEYSTONE_LOCK_WITNESS=1``).
+
+``keystone-tpu race`` (analysis/concurrency.py) reads the *source* of the
+concurrent tier; this module watches its *live* lock traffic — the same
+two hazard classes, cross-checked at runtime the way C5 cross-checks the
+planner and ``KEYSTONE_GUARD`` cross-checks R1:
+
+- **Order inversion** (the static T1): every witnessed acquisition made
+  while other witnessed locks are held records an order edge
+  ``held -> acquired``; the first acquisition whose reverse edge was ever
+  recorded — by any thread — is an inversion event.  This fires on the
+  *order*, not the deadlock: two threads that interleave A->B / B->A
+  only rarely actually deadlock in a test run, but the witness flags the
+  pattern on the first clean execution.
+- **Held-while-blocking** (the static T2, the PR-15 ``_claim_slot``
+  class): an indefinitely-blocking ``acquire`` made while the thread
+  holds other witnessed locks is polled in short slices; once the wait
+  exceeds :data:`HELD_BLOCK_THRESHOLD_S` the witness records a
+  ``held_blocking`` event naming the held lock and the one being waited
+  for — so the buffers=1/threads>=2 deadlock shape surfaces in seconds
+  with a diagnosis, not as a hung process.
+
+Events are counted into the telemetry registry (``witness.inversion`` /
+``witness.held_blocking``) and kept in a bounded in-memory list
+(:func:`events`) for tests and post-mortems.  Semantics of the wrapped
+lock are preserved: the witness never steals, times out, or reorders an
+acquisition — it only observes.
+
+**Zero overhead when off** (the default): :func:`register_lock` reads the
+knob once at lock-creation time and returns the bare ``threading`` lock
+*unchanged* — no wrapper type, no indirection, byte-identical lock
+behavior (pinned by test).  Locks used as the backing lock of a
+``threading.Condition`` must not be registered (Condition reaches into
+``_is_owned``/``_release_save`` internals the wrapper does not forward);
+the gateway's ``_cond`` stays bare for exactly that reason.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from keystone_tpu.utils import knobs
+
+__all__ = [
+    "HELD_BLOCK_THRESHOLD_S",
+    "WitnessLock",
+    "enabled",
+    "events",
+    "register_lock",
+    "reset",
+]
+
+#: an indefinite blocking acquire made while holding another witnessed
+#: lock is reported once its wait exceeds this many seconds
+HELD_BLOCK_THRESHOLD_S = 1.0
+
+#: poll slice for the held-while-blocking watch (small enough that the
+#: PR-15 replay fixture flags well inside its 5 s test budget)
+_POLL_S = 0.05
+
+#: bounded event buffer — a pathological run must not grow memory
+_MAX_EVENTS = 256
+
+_WLOCK = threading.Lock()  # guards the witness's own tables
+_EDGES: Dict[Tuple[str, str], str] = {}     # (held, acquired) -> thread
+_INVERSIONS: set = set()                     # frozenset pairs, report-once
+_BLOCK_PAIRS: set = set()                    # (held, blocked_on), once
+_EVENTS: List[Dict[str, Any]] = []
+_TLS = threading.local()
+
+
+def enabled() -> bool:
+    return bool(knobs.get("KEYSTONE_LOCK_WITNESS"))
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_TLS, "held", None)
+    if stack is None:
+        stack = _TLS.held = []
+    return stack
+
+
+def _inc(counter: str) -> None:
+    try:
+        from keystone_tpu.telemetry import get_registry
+
+        get_registry().inc(counter)
+    except Exception:
+        pass  # witness must never take down the code it watches
+
+
+def _record(kind: str, **fields: Any) -> None:
+    with _WLOCK:
+        if len(_EVENTS) < _MAX_EVENTS:
+            _EVENTS.append({"kind": kind, **fields})
+    _inc(f"witness.{kind}")
+
+
+def events(kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Snapshot of recorded events, optionally filtered by kind
+    (``"inversion"`` / ``"held_blocking"``)."""
+    with _WLOCK:
+        out = list(_EVENTS)
+    return [e for e in out if kind is None or e["kind"] == kind]
+
+
+def reset() -> None:
+    """Drop all witness state (tests): edges, events, report-once sets.
+    Per-thread held stacks are left alone — they mirror real lock state."""
+    with _WLOCK:
+        _EDGES.clear()
+        _INVERSIONS.clear()
+        _BLOCK_PAIRS.clear()
+        del _EVENTS[:]
+
+
+class WitnessLock:
+    """Order-recording wrapper around ``threading.Lock``/``RLock``.
+
+    Supports the context-manager protocol and the
+    ``acquire``/``release``/``locked`` surface the package's lock sites
+    use.  Do NOT hand one to ``threading.Condition``."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, lock: Any, name: str):
+        self._lock = lock
+        self.name = name
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _note_attempt(self, held: List[str]) -> None:
+        """Record order edges (every held lock -> this one) and report a
+        fresh inversion the moment the reverse edge exists."""
+        me = threading.current_thread().name
+        for h in held:
+            if h == self.name:
+                continue  # RLock re-entry is not an order edge
+            with _WLOCK:
+                _EDGES.setdefault((h, self.name), me)
+                reverse = _EDGES.get((self.name, h))
+                pair = frozenset((h, self.name))
+                fresh = reverse is not None and pair not in _INVERSIONS
+                if fresh:
+                    _INVERSIONS.add(pair)
+            if fresh:
+                _record(
+                    "inversion",
+                    order=f"{h}->{self.name}",
+                    reverse=f"{self.name}->{h}",
+                    thread=me,
+                    reverse_thread=reverse,
+                )
+
+    # -- lock protocol ------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held_stack()
+        if held:
+            self._note_attempt(held)
+        if not blocking:
+            ok = self._lock.acquire(False)
+        elif timeout is not None and timeout >= 0:
+            ok = self._lock.acquire(True, timeout)
+        elif not held:
+            ok = self._lock.acquire()
+        else:
+            # Indefinite wait while holding other locks: poll in slices
+            # so the PR-15 deadlock shape gets DIAGNOSED, not just hung.
+            ok = self._lock.acquire(False)
+            waited = 0.0
+            flagged = False
+            while not ok:
+                ok = self._lock.acquire(True, _POLL_S)
+                waited += _POLL_S
+                if not ok and not flagged \
+                        and waited >= HELD_BLOCK_THRESHOLD_S:
+                    flagged = True
+                    key = (held[-1], self.name)
+                    with _WLOCK:
+                        fresh = key not in _BLOCK_PAIRS
+                        _BLOCK_PAIRS.add(key)
+                    if fresh:
+                        _record(
+                            "held_blocking",
+                            held=held[-1],
+                            blocked_on=self.name,
+                            thread=threading.current_thread().name,
+                            waited_s=round(waited, 3),
+                        )
+        if ok:
+            held.append(self.name)
+        return ok
+
+    def release(self) -> None:
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == self.name:
+                del held[i]
+                break
+        self._lock.release()
+
+    def __enter__(self) -> "WitnessLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __repr__(self) -> str:
+        return f"WitnessLock({self.name!r}, {self._lock!r})"
+
+
+def register_lock(lock: Any, name: str) -> Any:
+    """Wrap ``lock`` in the witness when ``KEYSTONE_LOCK_WITNESS=1``;
+    return it UNCHANGED (same object — zero overhead, no wrapper) when
+    the knob is off.  ``name`` is the stable identity events report
+    (``serve.front.client``, ``ingest.claim``, ...)."""
+    if not enabled():
+        return lock
+    return WitnessLock(lock, name)

@@ -1,0 +1,128 @@
+"""Lock-discipline smoke (< 20 s): the contract `make verify-fast` rides.
+
+Asserts, end to end through the REAL CLI code path:
+
+1. the committed bad fixtures (tests/fixtures/race/) fire EVERY rule
+   T1-T5 — the detectors cannot silently rot;
+2. the real tree sweeps CLEAN against the committed (empty)
+   ``race_baseline.json`` — zero new findings, zero parse errors, rc=0 —
+   and the JSON output schema holds (the keys bench.py and the tests
+   read);
+3. ``KEYSTONE_LOCK_WITNESS=1`` catches a replay of the PR-15
+   ``_claim_slot`` deadlock shape (blocking on the ring while holding
+   the claim lock) within seconds, with the held/blocked locks named;
+4. with the knob unset, :func:`register_lock` returns the bare lock
+   UNCHANGED — the zero-overhead off path is identity, not a wrapper;
+5. the whole pass stays under the 20 s budget.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+from contextlib import redirect_stdout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BUDGET_S = 20.0
+DEADLOCK_FLAG_BUDGET_S = 5.0
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    os.chdir(REPO)
+
+    from keystone_tpu.analysis.concurrency import ALL_RACE_RULES, RaceEngine
+    from keystone_tpu.analysis.concurrency import main as race_main
+
+    # 1: every T rule fires on its committed bad fixture
+    bad = RaceEngine(REPO, ["tests/fixtures/race"]).run()
+    assert not bad.errors, bad.errors
+    fired = {f.rule for f in bad.findings}
+    assert fired == set(ALL_RACE_RULES), (
+        f"fixtures fired {sorted(fired)}, want {list(ALL_RACE_RULES)}"
+    )
+
+    # 2: the real tree is clean vs the committed baseline + JSON schema
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = race_main(["--format", "json", "--root", REPO])
+    payload = json.loads(buf.getvalue())
+    assert rc == 0, f"keystone-tpu race rc={rc}: {payload['new']}"
+    for key in ("new", "baselined", "stale", "suppressed", "files",
+                "errors", "total"):
+        assert key in payload, f"missing JSON key {key}"
+    assert payload["new"] == [], payload["new"]
+    assert payload["errors"] == [], payload["errors"]
+    assert payload["files"] > 100, payload["files"]
+
+    # 4 (before flipping the knob): off path is identity, no wrapper
+    os.environ.pop("KEYSTONE_LOCK_WITNESS", None)
+    from keystone_tpu.utils import lockwitness
+    from keystone_tpu.utils.lockwitness import register_lock
+
+    bare = threading.Lock()
+    assert register_lock(bare, "smoke.off") is bare, (
+        "KEYSTONE_LOCK_WITNESS unset must return the lock unchanged"
+    )
+
+    # 3: the PR-15 deadlock shape, replayed and DIAGNOSED in seconds.
+    # Main holds the ring (a full buffer ring that will never drain);
+    # the worker blocks acquiring it while holding the claim lock —
+    # exactly `_claim_slot` before the fix.
+    os.environ["KEYSTONE_LOCK_WITNESS"] = "1"
+    try:
+        lockwitness.reset()
+        ring = register_lock(threading.Lock(), "replay.ring")
+        claim = register_lock(threading.Lock(), "replay.claim")
+        assert isinstance(ring, lockwitness.WitnessLock)
+
+        ring.acquire()
+
+        def worker():
+            with claim:
+                with ring:
+                    pass
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        deadline = time.monotonic() + DEADLOCK_FLAG_BUDGET_S
+        events = []
+        while time.monotonic() < deadline:
+            events = lockwitness.events("held_blocking")
+            if events:
+                break
+            time.sleep(0.05)
+        ring.release()
+        t.join(5.0)
+        assert events, (
+            f"witness failed to flag the replayed deadlock within "
+            f"{DEADLOCK_FLAG_BUDGET_S}s"
+        )
+        ev = events[0]
+        assert ev["held"] == "replay.claim", ev
+        assert ev["blocked_on"] == "replay.ring", ev
+        assert not t.is_alive(), "replay worker did not finish"
+    finally:
+        os.environ.pop("KEYSTONE_LOCK_WITNESS", None)
+        lockwitness.reset()
+
+    elapsed = time.monotonic() - t0
+    assert elapsed < BUDGET_S, (
+        f"race smoke took {elapsed:.1f}s (budget {BUDGET_S}s)"
+    )
+    print(
+        f"race-smoke OK: {len(bad.findings)} fixture findings across "
+        f"{len(fired)} rules, tree clean over {payload['files']} files, "
+        f"witness flagged the PR-15 replay, {elapsed:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

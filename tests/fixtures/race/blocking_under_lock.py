@@ -1,0 +1,18 @@
+"""Bad fixture: T2 blocking call while holding a lock.
+
+``queue.Queue.get()`` with no timeout inside the ``with work_lock:``
+span — the PR-15 ``_claim_slot`` deadlock class.  Scanned by
+tests/test_race.py and scripts/race_smoke.py — never imported.
+"""
+
+import queue
+import threading
+
+work_lock = threading.Lock()
+work_q: "queue.Queue" = queue.Queue()
+
+
+def drain_one():
+    with work_lock:
+        item = work_q.get()
+        return item

@@ -1,0 +1,23 @@
+"""Bad fixture: T1 lock-order inversion.
+
+``forward`` nests a_lock -> b_lock; ``backward`` nests b_lock -> a_lock.
+Two threads interleaving these deadlock.  Scanned by tests/test_race.py
+and scripts/race_smoke.py — never imported, never executed.
+"""
+
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def forward():
+    with a_lock:
+        with b_lock:
+            return True
+
+
+def backward():
+    with b_lock:
+        with a_lock:
+            return True

@@ -1,0 +1,24 @@
+"""Bad fixture: T5 unlocked read-merge-replace.
+
+``bump_counter`` reads persisted JSON, merges, and ``os.replace``s it
+back with no ``fcntl.flock`` sidecar window — two processes
+interleaving lose one writer's increment.  Scanned by
+tests/test_race.py and scripts/race_smoke.py — never imported.
+"""
+
+import json
+import os
+
+
+def bump_counter(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    data["n"] = int(data.get("n", 0)) + 1
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+    return data["n"]

@@ -1,0 +1,20 @@
+"""Bad fixture: T4 thread lifecycle — both shapes.
+
+``launch`` spawns an OS process while holding a lock (the child
+inherits the locked mutex state), and starts a non-daemon thread it
+never joins (interpreter shutdown blocks on it).  Scanned by
+tests/test_race.py and scripts/race_smoke.py — never imported.
+"""
+
+import subprocess
+import threading
+
+spawn_lock = threading.Lock()
+
+
+def launch():
+    t = threading.Thread(target=print)
+    t.start()
+    with spawn_lock:
+        subprocess.run(["true"])
+    return t

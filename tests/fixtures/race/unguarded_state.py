@@ -1,0 +1,20 @@
+"""Bad fixture: T3 unguarded shared state.
+
+The module-level lock marks this module as concurrent; ``publish``
+mutates the module-level container WITHOUT taking it.  Scanned by
+tests/test_race.py and scripts/race_smoke.py — never imported.
+"""
+
+import threading
+
+state_lock = threading.Lock()
+RESULTS = []
+
+
+def publish(value):
+    RESULTS.append(value)
+
+
+def read_all():
+    with state_lock:
+        return list(RESULTS)

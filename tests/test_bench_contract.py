@@ -246,6 +246,10 @@ def test_bench_budget_skips_big_regimes(tmp_path):
     # ... and the pipeline-contract section: same reduced-floor contract
     assert full.get("check_skipped") == "budget"
     assert "check_findings_total" not in full
+    # ... and the lock-discipline section (PR 20): same reduced-floor
+    # contract — no hygiene count may land without its budget story
+    assert full.get("race_skipped") == "budget"
+    assert "race_findings_total" not in full
     # ... and the precision-tier section (PR 11): same reduced-floor
     # contract — no speed key may land without its budget story
     assert full.get("precision_skipped") == "budget"
@@ -312,6 +316,45 @@ def test_bench_secondary_cursor_rotates_across_runs(tmp_path):
     # budget): rotation changes WHO starves first, never the contract
     for name in order2:
         assert second.get(f"{name}_skipped") == "budget"
+
+
+def test_bench_cursor_concurrent_rotations_lose_no_increment(tmp_path):
+    """Regression for the keystone-race T5 finding on ``_rotate_secondary``:
+    the cursor read->increment->replace window now runs under the flock
+    sidecar, so N bench processes sharing one cursor file each advance it
+    by exactly one — a lost increment would replay the same prefix and
+    starve the tail sections again.  Four concurrent rotations of a
+    2-section list must use cursors 0,1,0,1 (each section twice), never a
+    duplicated read."""
+    script = (
+        "import bench\n"
+        "cursor, rotated = bench._rotate_secondary(['a', 'b'])\n"
+        "assert rotated in (['a', 'b'], ['b', 'a'])\n"
+        "print('CURSOR', cursor)\n"
+    )
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        KEYSTONE_BENCH_CURSOR=str(tmp_path / "cursor.json"),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=_REPO,
+        )
+        for _ in range(4)
+    ]
+    cursors = []
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-2000:]
+        cursors.append(int(out.split()[-1]))
+    assert sorted(cursors) == [0, 0, 1, 1], cursors
+    # flock-serialized: the last writer saw cursor 1 and persisted 2
+    final = json.loads((tmp_path / "cursor.json").read_text())
+    assert final["secondary"] == 2
 
 
 def test_bench_section_floor_exhaustion_is_graceful(tmp_path):

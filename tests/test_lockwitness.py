@@ -1,0 +1,187 @@
+"""The KEYSTONE_LOCK_WITNESS runtime sanitizer (utils/lockwitness.py):
+the zero-overhead off path (identity, no wrapper — pinned), inversion
+detection on an A->B / B->A interleave, the PR-15 ``_claim_slot``
+deadlock replay flagged in seconds, telemetry counters, and the
+preserved lock semantics of the wrapper itself.
+"""
+
+import threading
+import time
+
+import pytest
+
+from keystone_tpu.utils import lockwitness
+from keystone_tpu.utils.lockwitness import WitnessLock, register_lock
+
+
+@pytest.fixture
+def witness_on(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_LOCK_WITNESS", "1")
+    lockwitness.reset()
+    yield
+    lockwitness.reset()
+
+
+# ---------------------------------------------------------------------------
+# The off path: identity, not a wrapper
+# ---------------------------------------------------------------------------
+
+def test_knob_off_returns_bare_lock_unchanged(monkeypatch):
+    """The zero-overhead contract: with the knob unset (the default) and
+    with an explicit 0, register_lock returns the SAME object — no
+    wrapper type, no indirection, byte-identical lock behavior."""
+    monkeypatch.delenv("KEYSTONE_LOCK_WITNESS", raising=False)
+    bare = threading.Lock()
+    assert register_lock(bare, "off.lock") is bare
+    rlock = threading.RLock()
+    assert register_lock(rlock, "off.rlock") is rlock
+
+    monkeypatch.setenv("KEYSTONE_LOCK_WITNESS", "0")
+    assert register_lock(bare, "off.lock") is bare
+    assert not lockwitness.enabled()
+
+
+def test_knob_on_wraps(witness_on):
+    wrapped = register_lock(threading.Lock(), "on.lock")
+    assert isinstance(wrapped, WitnessLock)
+    assert wrapped.name == "on.lock"
+
+
+# ---------------------------------------------------------------------------
+# The wrapper preserves lock semantics
+# ---------------------------------------------------------------------------
+
+def test_wrapper_semantics_preserved(witness_on):
+    lk = register_lock(threading.Lock(), "sem.lock")
+    assert lk.acquire() is True
+    assert lk.locked()
+    assert lk.acquire(blocking=False) is False  # a Lock, not an RLock
+    lk.release()
+    assert not lk.locked()
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+    # bounded acquire passes through
+    assert lk.acquire(timeout=0.1) is True
+    lk.release()
+
+
+def test_rlock_reentry_is_not_an_order_edge(witness_on):
+    rl = register_lock(threading.RLock(), "re.lock")
+    with rl:
+        with rl:
+            pass
+    assert lockwitness.events() == []
+
+
+# ---------------------------------------------------------------------------
+# Inversion: A->B somewhere, B->A anywhere = one event
+# ---------------------------------------------------------------------------
+
+def test_inversion_detected_without_deadlocking(witness_on):
+    """The static T1, at runtime: the witness flags the ORDER on a clean
+    sequential interleave — no actual deadlock required."""
+    from keystone_tpu.telemetry import get_registry
+
+    before = get_registry().get_counter("witness.inversion")
+    a = register_lock(threading.Lock(), "inv.a")
+    b = register_lock(threading.Lock(), "inv.b")
+    with a:
+        with b:
+            pass
+    assert lockwitness.events("inversion") == []
+    with b:
+        with a:
+            pass
+    events = lockwitness.events("inversion")
+    assert len(events) == 1, events
+    ev = events[0]
+    assert ev["order"] == "inv.b->inv.a"
+    assert ev["reverse"] == "inv.a->inv.b"
+    assert get_registry().get_counter("witness.inversion") == before + 1
+
+    # report-once: replaying the same pair stays one event
+    with b:
+        with a:
+            pass
+    assert len(lockwitness.events("inversion")) == 1
+
+
+# ---------------------------------------------------------------------------
+# Held-while-blocking: the PR-15 _claim_slot deadlock replay
+# ---------------------------------------------------------------------------
+
+def test_pr15_deadlock_replay_flagged_fast(witness_on):
+    """The buffers=1/threads>=2 shape from PR 15's review: a worker
+    blocks on the (held, never-draining) ring while holding the claim
+    lock.  The witness must DIAGNOSE it — a held_blocking event naming
+    both locks — well inside 5 s, instead of the process just hanging."""
+    from keystone_tpu.telemetry import get_registry
+
+    before = get_registry().get_counter("witness.held_blocking")
+    ring = register_lock(threading.Lock(), "replay.ring")
+    claim = register_lock(threading.Lock(), "replay.claim")
+    ring.acquire()
+    try:
+        def worker():
+            with claim:
+                with ring:
+                    pass
+
+        t = threading.Thread(target=worker, daemon=True)
+        t0 = time.monotonic()
+        t.start()
+        events = []
+        while time.monotonic() - t0 < 5.0:
+            events = lockwitness.events("held_blocking")
+            if events:
+                break
+            time.sleep(0.05)
+        flagged_s = time.monotonic() - t0
+    finally:
+        ring.release()
+    t.join(5.0)
+    assert not t.is_alive()
+    assert events, f"no held_blocking event within {flagged_s:.1f}s"
+    ev = events[0]
+    assert ev["held"] == "replay.claim"
+    assert ev["blocked_on"] == "replay.ring"
+    assert ev["waited_s"] >= lockwitness.HELD_BLOCK_THRESHOLD_S
+    assert flagged_s < 5.0
+    assert get_registry().get_counter("witness.held_blocking") == before + 1
+
+
+def test_bounded_wait_under_lock_not_flagged(witness_on):
+    """A timeout= acquire is a bounded wait — the witness records no
+    held_blocking event for it (mirrors the static T2 exemption)."""
+    outer = register_lock(threading.Lock(), "bounded.outer")
+    inner = register_lock(threading.Lock(), "bounded.inner")
+    inner.acquire()
+    try:
+        with outer:
+            assert inner.acquire(timeout=0.2) is False
+    finally:
+        inner.release()
+    assert lockwitness.events("held_blocking") == []
+
+
+def test_reset_clears_events_and_report_once_state(witness_on):
+    a = register_lock(threading.Lock(), "rst.a")
+    b = register_lock(threading.Lock(), "rst.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert lockwitness.events("inversion")
+    lockwitness.reset()
+    assert lockwitness.events() == []
+    # after reset the pair reports fresh again
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert len(lockwitness.events("inversion")) == 1

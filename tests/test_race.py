@@ -1,0 +1,502 @@
+"""keystone-race (keystone_tpu/analysis/concurrency.py): rule fixtures
+T1-T5 over the lockgraph model, the R5 -> T3 pragma alias, stale-pragma
+scoping, the baseline ratchet, the CLI exit contract, and the repo-wide
+invariant that the shipped tree sweeps clean against its committed
+``race_baseline.json``.
+
+Rule tests run the real engine over tiny fixture trees written to
+``tmp_path`` — one positive (must flag) and one negative (must stay
+silent) per rule family — mirroring tests/test_lint.py.
+"""
+
+import io
+import json
+import os
+import textwrap
+from contextlib import redirect_stdout
+
+from keystone_tpu.analysis.concurrency import (
+    ALL_RACE_RULES,
+    RaceEngine,
+    default_paths,
+    run_race,
+)
+from keystone_tpu.analysis.concurrency import main as race_main
+from keystone_tpu.analysis.engine import (
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def race_tree(tmp_path, files):
+    """Write {relpath: source} under tmp_path and run the engine on it."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return RaceEngine(str(tmp_path), sorted(files)).run()
+
+
+def rules_of(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ---------------------------------------------------------------------------
+# T1: lock-order inversion
+# ---------------------------------------------------------------------------
+
+T1_POSITIVE = """
+    import threading
+
+    a_lock = threading.Lock()
+    b_lock = threading.Lock()
+
+
+    def forward():
+        with a_lock:
+            with b_lock:
+                return 1
+
+
+    def backward():
+        with b_lock:
+            with a_lock:
+                return 2
+"""
+
+
+def test_t1_flags_inversion(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": T1_POSITIVE})
+    t1 = [f for f in res.findings if f.rule == "T1"]
+    assert t1, rules_of(res)
+    assert "a_lock" in t1[0].message and "b_lock" in t1[0].message
+
+
+def test_t1_silent_on_consistent_order(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+
+        def one():
+            with a_lock:
+                with b_lock:
+                    return 1
+
+
+        def two():
+            with a_lock:
+                with b_lock:
+                    return 2
+    """})
+    assert not [f for f in res.findings if f.rule == "T1"], rules_of(res)
+
+
+def test_t1_inversion_through_called_function(tmp_path):
+    """The acquisition graph follows resolvable calls: holding ``a`` and
+    calling a function that takes ``b`` is an a->b edge even with no
+    lexically nested ``with``."""
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+
+        def take_b():
+            with b_lock:
+                return 1
+
+
+        def forward():
+            with a_lock:
+                return take_b()
+
+
+        def backward():
+            with b_lock:
+                with a_lock:
+                    return 2
+    """})
+    assert [f for f in res.findings if f.rule == "T1"], rules_of(res)
+
+
+# ---------------------------------------------------------------------------
+# T2: blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+def test_t2_flags_blocking_under_lock(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import queue
+        import threading
+        import time
+
+        work_lock = threading.Lock()
+        q = queue.Queue()
+
+
+        def bad_get():
+            with work_lock:
+                return q.get()
+
+
+        def bad_sleep():
+            with work_lock:
+                time.sleep(5)
+
+
+        def bad_send(sock, frame):
+            with work_lock:
+                sock.sendall(frame)
+    """})
+    t2 = [f for f in res.findings if f.rule == "T2"]
+    tails = {f.symbol.split("->")[-1] for f in t2}
+    assert tails == {"get", "sleep", "sendall"}, t2
+
+
+def test_t2_silent_on_bounded_and_lookalike_calls(tmp_path):
+    """timeout= kwargs, dict.get(key), str.join(iterable), and a
+    Condition.wait on the HELD condition (which releases it) are all
+    exempt — the PR-15 class is the indefinite wait only."""
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import queue
+        import threading
+
+        cond = threading.Condition()
+        work_lock = threading.Lock()
+        q = queue.Queue()
+        TABLE = {}
+
+
+        def ok_bounded():
+            with work_lock:
+                return q.get(timeout=0.5)
+
+
+        def ok_dict_get(key):
+            with work_lock:
+                return TABLE.get(key, None)
+
+
+        def ok_join(parts):
+            with work_lock:
+                return ",".join(parts)
+
+
+        def ok_cond_wait():
+            with cond:
+                cond.wait()
+    """})
+    assert not [f for f in res.findings if f.rule == "T2"], res.findings
+
+
+# ---------------------------------------------------------------------------
+# T3: unguarded shared state (generalizes + subsumes lint R5)
+# ---------------------------------------------------------------------------
+
+T3_POSITIVE = """
+    import threading
+
+    state_lock = threading.Lock()
+    RESULTS = []
+
+
+    def publish(x):
+        RESULTS.append(x)
+"""
+
+
+def test_t3_flags_unguarded_mutation_in_concurrent_module(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": T3_POSITIVE})
+    t3 = [f for f in res.findings if f.rule == "T3"]
+    assert t3 and "RESULTS" in t3[0].message
+
+
+def test_t3_silent_under_lock_and_out_of_scope(tmp_path):
+    res = race_tree(tmp_path, {
+        # guarded mutation: silent
+        "pkg/guarded.py": """
+            import threading
+
+            state_lock = threading.Lock()
+            RESULTS = []
+
+
+            def publish(x):
+                with state_lock:
+                    RESULTS.append(x)
+        """,
+        # no entry point, no module-level lock: out of scope, silent
+        # even though the mutation is bare
+        "pkg/sequential.py": """
+            CACHE = []
+
+
+            def remember(x):
+                CACHE.append(x)
+        """,
+    })
+    assert not [f for f in res.findings if f.rule == "T3"], res.findings
+
+
+def test_t3_honors_existing_r5_pragma(tmp_path):
+    """The R5 -> T3 alias: a ``# lint: disable=R5`` pragma written for
+    lint keeps suppressing at the same site under race — existing
+    justifications carry over without a rewrite — and the R5-only pragma
+    is NOT race's stale-pragma business."""
+    src = T3_POSITIVE.replace(
+        "RESULTS.append(x)",
+        "RESULTS.append(x)  # lint: disable=R5 (single-writer by design)",
+    )
+    res = race_tree(tmp_path, {"pkg/mod.py": src})
+    assert not [f for f in res.findings if f.rule == "T3"], res.findings
+    assert res.suppressed == 1
+    assert res.stale_pragmas == []
+
+
+def test_t3_native_pragma_and_stale_scoping(tmp_path):
+    """A ``disable=T3`` pragma suppresses like any lint pragma; one that
+    suppresses nothing IS reported stale (T rules are race's scope),
+    while a bare ``disable`` is left to lint to police."""
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import threading
+
+        state_lock = threading.Lock()
+        RESULTS = []
+
+
+        def publish(x):
+            RESULTS.append(x)  # lint: disable=T3 (single writer)
+
+
+        def quiet(x):
+            return x  # lint: disable=T2 (nothing blocks here)
+
+
+        def also_quiet(x):
+            return x  # lint: disable
+    """})
+    assert not [f for f in res.findings if f.rule == "T3"], res.findings
+    assert res.suppressed == 1
+    assert len(res.stale_pragmas) == 1
+    assert res.stale_pragmas[0][2] == "T2"
+
+
+# ---------------------------------------------------------------------------
+# T4: thread lifecycles
+# ---------------------------------------------------------------------------
+
+def test_t4_flags_spawn_under_lock_and_unjoined_thread(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import subprocess
+        import threading
+
+        spawn_lock = threading.Lock()
+
+
+        def launch():
+            t = threading.Thread(target=print)
+            t.start()
+            with spawn_lock:
+                subprocess.run(["true"])
+    """})
+    t4 = [f for f in res.findings if f.rule == "T4"]
+    symbols = {f.symbol for f in t4}
+    assert "spawn_lock->spawn" in symbols, t4
+    assert "thread@t" in symbols, t4
+
+
+def test_t4_silent_on_daemon_joined_and_pool_joined(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import subprocess
+        import threading
+
+
+        def ok():
+            d = threading.Thread(target=print, daemon=True)
+            d.start()
+            j = threading.Thread(target=print)
+            j.start()
+            j.join()
+            pool = [threading.Thread(target=print) for _ in range(3)]
+            for t in pool:
+                t.start()
+            for t in pool:
+                t.join(30)
+            subprocess.run(["true"])
+    """})
+    assert not [f for f in res.findings if f.rule == "T4"], res.findings
+
+
+# ---------------------------------------------------------------------------
+# T5: unlocked read-merge-replace
+# ---------------------------------------------------------------------------
+
+def test_t5_flags_read_merge_replace_without_flock(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import json
+        import os
+
+
+        def bump(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except OSError:
+                data = {}
+            data["n"] = data.get("n", 0) + 1
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, path)
+    """})
+    t5 = [f for f in res.findings if f.rule == "T5"]
+    assert t5 and t5[0].symbol == "bump"
+
+
+def test_t5_silent_with_flock_sidecar(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": """
+        import json
+        import os
+
+
+        def bump(path):
+            import fcntl
+
+            lockf = open(path + ".lock", "w")
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except OSError:
+                data = {}
+            data["n"] = data.get("n", 0) + 1
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, path)
+            lockf.close()
+    """})
+    assert not [f for f in res.findings if f.rule == "T5"], res.findings
+
+
+def test_bench_cursor_rotation_is_flocked():
+    """Regression for the genuine T5 finding this pass surfaced: the
+    bench secondary-section cursor read->increment->replace now runs
+    under the flock sidecar, so the sweep must stay silent on bench.py."""
+    res = RaceEngine(REPO_ROOT, ["bench.py"]).run()
+    t5 = [f for f in res.findings if f.rule == "T5"]
+    assert not t5, t5
+
+
+# ---------------------------------------------------------------------------
+# Baseline ratchet + CLI
+# ---------------------------------------------------------------------------
+
+def test_baseline_ratchet(tmp_path):
+    res = race_tree(tmp_path, {"pkg/mod.py": T1_POSITIVE})
+    assert res.findings
+    baseline_path = tmp_path / "race_baseline.json"
+    save_baseline(str(baseline_path), res.findings, tool="race")
+    baseline = json.loads(baseline_path.read_text())
+    assert baseline["findings"]
+
+    # same tree re-swept: everything baselined, nothing new
+    res2 = race_tree(tmp_path, {"pkg/mod.py": T1_POSITIVE})
+    new, known, stale = apply_baseline(
+        res2.findings, load_baseline(str(baseline_path))
+    )
+    assert new == [] and known and not stale
+
+    # fixed tree (both sites order a -> b): nothing new, the old
+    # fingerprints count as stale
+    res3 = race_tree(tmp_path, {"pkg/mod.py": """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+
+        def forward():
+            with a_lock:
+                with b_lock:
+                    return 1
+
+
+        def backward():
+            with a_lock:
+                with b_lock:
+                    return 2
+    """})
+    assert res3.findings == []
+    new, known, stale = apply_baseline(
+        res3.findings, load_baseline(str(baseline_path))
+    )
+    assert new == [] and stale
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(T1_POSITIVE))
+
+    # findings, no baseline: rc=1 with the clickable triple
+    rc = race_main(["--root", str(tmp_path), "mod.py"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "mod.py:" in out and "T1" in out
+
+    # ratchet reset: rc=0, baseline written
+    rc = race_main(["--root", str(tmp_path), "--update-baseline", "mod.py"])
+    assert rc == 0
+    assert (tmp_path / "race_baseline.json").exists()
+    capsys.readouterr()
+
+    # same debt, now baselined: rc=0
+    rc = race_main(["--root", str(tmp_path), "mod.py"])
+    assert rc == 0
+    capsys.readouterr()
+
+    # JSON format carries the schema
+    rc = race_main(["--root", str(tmp_path), "--format", "json", "mod.py"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    for key in ("new", "baselined", "stale", "suppressed", "files",
+                "errors", "total"):
+        assert key in payload
+
+    # a file that does not parse: rc=2
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    rc = race_main(["--root", str(tmp_path), "broken.py"])
+    assert rc == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# The shipped tree
+# ---------------------------------------------------------------------------
+
+def test_repo_sweeps_clean_against_committed_baseline():
+    """The tier-1 invariant `make race` enforces: zero new findings over
+    the real tree vs the committed (empty) race_baseline.json."""
+    baseline = os.path.join(REPO_ROOT, "race_baseline.json")
+    assert os.path.exists(baseline), "race_baseline.json must be committed"
+    result = run_race(REPO_ROOT, default_paths(REPO_ROOT),
+                      baseline_path=baseline)
+    assert result.errors == [], result.errors
+    assert result.findings == [], [
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in result.findings
+    ]
+    # the ratchet starts EMPTY: the tree carries no baselined race debt
+    assert result.baselined == []
+
+
+def test_committed_fixtures_fire_every_rule():
+    """The detectors cannot silently rot: each committed bad fixture in
+    tests/fixtures/race/ keeps firing its rule."""
+    res = RaceEngine(REPO_ROOT, ["tests/fixtures/race"]).run()
+    assert not res.errors, res.errors
+    assert {f.rule for f in res.findings} == set(ALL_RACE_RULES)
